@@ -1,0 +1,22 @@
+"""Root conftest: force a virtual 8-device CPU backend for the test suite.
+
+The reference validates its distributed path without a cluster by running the same
+train fn at np=-1 then np=2 (SURVEY.md §4.1/§4.5); our analog is an 8-device
+forced-host CPU mesh (SURVEY.md §4 "Implication for the build"). Must run before
+any jax backend initialization; the axon/TPU sitecustomize force-selects the TPU
+platform via jax.config, so we override both env and config here.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(__file__))

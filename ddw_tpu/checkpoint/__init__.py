@@ -1,0 +1,1 @@
+from ddw_tpu.checkpoint.ckpt import save_checkpoint, restore_checkpoint, latest_step, CheckpointManager  # noqa: F401

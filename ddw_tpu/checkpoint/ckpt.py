@@ -1,0 +1,108 @@
+"""Step-indexed checkpoint / resume — rank-0 writer discipline.
+
+The reference checkpoints per epoch with Keras ``ModelCheckpoint(save_weights_only=
+True)`` on rank 0 only, "to prevent conflicts between workers"
+(``Part 2 - Distributed Tuning & Inference/02_hyperopt_distributed_model.py:206-211``),
+into a timestamped root (``:65-67``); consistent restart comes from rank-0 broadcast
+(``Part 1 - Distributed Training/03_model_training_distributed.py:305-308``).
+
+TPU-native translation (SURVEY.md §5 "Checkpoint / resume"): serialize the full
+:class:`TrainState` (params + batch_stats + opt state + step) with flax msgpack into
+``<dir>/step_<N>/state.msgpack`` plus a JSON metadata sidecar; only process 0
+writes (atomic rename); every host restores the same file, so restore-then-broadcast
+is free under SPMD. A retention policy keeps the newest K checkpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+
+import jax
+from flax import serialization
+
+
+def _is_writer() -> bool:
+    return jax.process_index() == 0
+
+
+def save_checkpoint(ckpt_dir: str, state, step: int, metadata: dict | None = None, keep: int = 3) -> str | None:
+    """Write ``state`` at ``step``; rank-0 only (no-op elsewhere). Atomic via
+    tmp-dir + rename. Returns the checkpoint path on the writer, None elsewhere."""
+    if not _is_writer():
+        return None
+    os.makedirs(ckpt_dir, exist_ok=True)
+    final = os.path.join(ckpt_dir, f"step_{step:010d}")
+    tmp = final + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    # Device arrays -> host before serializing.
+    host_state = jax.device_get(state)
+    with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(host_state))
+    meta = {"step": step, "created_unix": time.time(), **(metadata or {})}
+    with open(os.path.join(tmp, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    if os.path.exists(final):
+        shutil.rmtree(final)
+    os.replace(tmp, final)
+    _apply_retention(ckpt_dir, keep)
+    return final
+
+
+def _apply_retention(ckpt_dir: str, keep: int) -> None:
+    steps = sorted(_list_steps(ckpt_dir))
+    for s in steps[:-keep] if keep > 0 else []:
+        shutil.rmtree(os.path.join(ckpt_dir, f"step_{s:010d}"), ignore_errors=True)
+
+
+def _list_steps(ckpt_dir: str) -> list[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for d in os.listdir(ckpt_dir):
+        if d.startswith("step_") and not d.endswith(".tmp"):
+            try:
+                out.append(int(d[len("step_"):]))
+            except ValueError:
+                pass
+    return out
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    steps = _list_steps(ckpt_dir)
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, target, step: int | None = None):
+    """Restore into ``target``'s structure (a template TrainState). Every host reads
+    the same file — identical restore replaces the rank-0 broadcast. Returns
+    (state, step) or (target, None) when no checkpoint exists."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            return target, None
+    path = os.path.join(ckpt_dir, f"step_{step:010d}", "state.msgpack")
+    with open(path, "rb") as f:
+        state = serialization.from_bytes(target, f.read())
+    return state, step
+
+
+class CheckpointManager:
+    """Convenience wrapper binding a directory + retention policy."""
+
+    def __init__(self, ckpt_dir: str, keep: int = 3):
+        self.ckpt_dir = ckpt_dir
+        self.keep = keep
+
+    def save(self, state, step: int, metadata: dict | None = None):
+        return save_checkpoint(self.ckpt_dir, state, step, metadata, self.keep)
+
+    def restore(self, target, step: int | None = None):
+        return restore_checkpoint(self.ckpt_dir, target, step)
+
+    def latest_step(self):
+        return latest_step(self.ckpt_dir)

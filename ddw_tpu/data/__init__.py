@@ -1,0 +1,3 @@
+from ddw_tpu.data.store import Table, TableStore, RecordSchema  # noqa: F401
+from ddw_tpu.data.prep import prepare_flowers, generate_synthetic_flowers  # noqa: F401
+from ddw_tpu.data.loader import ShardedLoader, preprocess_image  # noqa: F401

@@ -1,0 +1,277 @@
+"""Per-host sharded loader — the Petastorm SparkDatasetConverter role.
+
+The reference feeds training via Petastorm: a parquet cache materialized from the
+table, then ``make_tf_dataset(batch_size, cur_shard=hvd.rank(),
+shard_count=hvd.size(), num_epochs=None)`` with a reader thread pool
+(``Part 1 - Distributed Training/03_model_training_distributed.py:137-144,200,332-337``).
+Two semantics are load-bearing (SURVEY.md §2b.8, §7 hard-part 2):
+
+- **shard selection by rank**: each worker reads a disjoint shard subset;
+- **infinite repeat** (``num_epochs=None``): every worker can take the same floor
+  -divided number of steps despite unequal shard sizes — the identical-step-count
+  guarantee that under SPMD becomes "fixed shapes, same batch count on every host".
+
+This loader reads ddw_tpu table shards directly (no intermediate cache: the store's
+codec *is* the cache format), decodes/resizes JPEGs on a host-side thread pool
+(tf.data/petastorm worker-pool role), and prefetches batches to device HBM on a
+background thread (double buffering), so the TPU never waits on host IO.
+
+Preprocessing is THE shared implementation for training and serving —
+:func:`preprocess_image` is the single decode path ``ddw_tpu.serving`` packages with
+models — deliberately fixing the reference's train/serve skew (tf.image in training,
+``02_model_training_single_node.py:119-126``, vs PIL at inference,
+``03_pyfunc_distributed_inference.py:231-234``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from io import BytesIO
+from typing import Iterator
+
+import numpy as np
+
+from ddw_tpu.data.store import Table, read_shard
+
+
+def preprocess_image(content: bytes, height: int, width: int) -> np.ndarray:
+    """JPEG bytes -> float32 [H, W, 3] in [-1, 1].
+
+    decode -> resize (bilinear) -> MobileNetV2-style scaling ``x/127.5 - 1``
+    (the ``tf.image.decode_jpeg`` + ``resize`` + ``preprocess_input`` chain,
+    reference ``02_model_training_single_node.py:119-126``). Single implementation
+    shared by the training loader and the packaged model's predict path.
+    """
+    from PIL import Image
+
+    img = Image.open(BytesIO(content))
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    img = img.resize((width, height), Image.BILINEAR)
+    arr = np.asarray(img, dtype=np.float32)
+    return arr / 127.5 - 1.0
+
+
+class ShardedLoader:
+    """Iterate (images, labels) batches from a table, sharded by worker rank.
+
+    Args:
+      table: silver table with ``label_idx`` set.
+      batch_size: per-worker batch size (reference semantics — global batch is
+        ``batch_size * shard_count``).
+      image_size: (height, width).
+      cur_shard / shard_count: worker rank / world size (``make_tf_dataset``
+        parameters, reference ``:332-337``). Defaults to 0/1 (single worker).
+      num_epochs: None = infinite repeat (training default, reference ``:199-200``);
+        an int for finite passes (eval).
+      shuffle: shuffle shard order and a record-level buffer, seeded; epoch-varying.
+      drop_remainder: keep shapes static for XLA (always True under jit).
+      workers: decode thread pool size (petastorm ``workers_count`` role, ``:200``).
+      prefetch_to: optional ``jax.sharding.Sharding`` — batches are transferred to
+        device(s) on a background thread, ``prefetch`` deep.
+    """
+
+    def __init__(
+        self,
+        table: Table,
+        batch_size: int,
+        image_size: tuple[int, int] = (224, 224),
+        cur_shard: int = 0,
+        shard_count: int = 1,
+        num_epochs: int | None = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        shuffle_buffer: int = 1024,
+        workers: int = 4,
+        prefetch: int = 2,
+        prefetch_to=None,
+    ):
+        if not 0 <= cur_shard < shard_count:
+            raise ValueError(f"cur_shard {cur_shard} out of range for shard_count {shard_count}")
+        self.table = table
+        self.batch_size = batch_size
+        self.height, self.width = image_size
+        self.cur_shard = cur_shard
+        self.shard_count = shard_count
+        self.num_epochs = num_epochs
+        self.shuffle = shuffle
+        self.seed = seed
+        self.shuffle_buffer = shuffle_buffer
+        self.workers = workers
+        self.prefetch = prefetch
+        self.prefetch_to = prefetch_to
+
+        shards = list(table.shard_paths)
+        if len(shards) >= shard_count:
+            # Shard-level selection (petastorm semantics): disjoint round-robin.
+            self._my_shards = shards[cur_shard::shard_count]
+            self._record_stride = None
+        else:
+            # Fewer shards than workers: fall back to record-level modulo sharding
+            # (the reference instead repartitions >= worker count,
+            # ``03_model_training_distributed.py:110-111``; prep normally makes
+            # enough shards, this keeps small tables correct).
+            self._my_shards = shards
+            self._record_stride = (cur_shard, shard_count)
+
+    # -- sizing ----------------------------------------------------------------
+    @property
+    def records_per_worker(self) -> int:
+        """Lower-bound records this worker owns (for step accounting; the trainer
+        uses the *global* table size // (batch * world), reference ``:350-351``)."""
+        if self._record_stride is None:
+            # exact: manifest carries per-shard counts
+            counts = {m["file"]: m["num_records"] for m in self.table.manifest["shards"]}
+            import os
+
+            return sum(counts[os.path.basename(p)] for p in self._my_shards)
+        n, (r, k) = self.table.num_records, self._record_stride
+        return n // k + (1 if r < n % k else 0)
+
+    def steps_per_epoch(self) -> int:
+        """Global-size floor accounting: ``table_size // (batch * shard_count)``
+        (reference ``03_model_training_distributed.py:350-351``)."""
+        return max(1, self.table.num_records // (self.batch_size * self.shard_count))
+
+    # -- host pipeline ---------------------------------------------------------
+    def _iter_decoded(self) -> Iterator[tuple[np.ndarray, np.int32]]:
+        """Infinite (or num_epochs-bounded) stream of decoded records for this
+        worker, with epoch-varying shard shuffle + record shuffle buffer, decoding
+        on a thread pool."""
+        epoch = 0
+        pool = ThreadPoolExecutor(max_workers=self.workers)
+        try:
+            while self.num_epochs is None or epoch < self.num_epochs:
+                rng = np.random.RandomState((self.seed * 100003 + epoch * 7919 + self.cur_shard) & 0x7FFFFFFF)
+                shards = list(self._my_shards)
+                if self.shuffle:
+                    rng.shuffle(shards)
+
+                def records():
+                    for sp in shards:
+                        if self._record_stride is None:
+                            yield from read_shard(sp)
+                        else:
+                            r, k = self._record_stride
+                            for i, rec in enumerate(read_shard(sp)):
+                                if i % k == r:
+                                    yield rec
+
+                def decode(rec):
+                    return (
+                        preprocess_image(rec.content, self.height, self.width),
+                        np.int32(rec.label_idx),
+                    )
+
+                def bounded_decode_stream(window=self.workers * 4):
+                    # Bounded in-flight window: Executor.map would eagerly submit
+                    # the whole epoch (decoding the entire shard into memory);
+                    # this keeps at most `window` records pending.
+                    from collections import deque
+
+                    pending: deque = deque()
+                    it = records()
+                    for rec in it:
+                        pending.append(pool.submit(decode, rec))
+                        if len(pending) >= window:
+                            yield pending.popleft().result()
+                    while pending:
+                        yield pending.popleft().result()
+
+                stream = bounded_decode_stream()
+                if not self.shuffle:
+                    yield from stream
+                else:
+                    buf = []
+                    for item in stream:
+                        buf.append(item)
+                        if len(buf) >= self.shuffle_buffer:
+                            j = rng.randint(len(buf))
+                            buf[j], buf[-1] = buf[-1], buf[j]
+                            yield buf.pop()
+                    rng.shuffle(buf)
+                    yield from buf
+                epoch += 1
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def _iter_batches(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        imgs = np.empty((self.batch_size, self.height, self.width, 3), np.float32)
+        lbls = np.empty((self.batch_size,), np.int32)
+        i = 0
+        for img, lbl in self._iter_decoded():
+            imgs[i], lbls[i] = img, lbl
+            i += 1
+            if i == self.batch_size:
+                yield imgs.copy(), lbls.copy()
+                i = 0
+        # drop remainder: static shapes for XLA
+
+    def __iter__(self):
+        """Yield batches; when ``prefetch_to`` is set, a background thread runs the
+        host pipeline + device transfer ``prefetch`` batches ahead."""
+        if self.prefetch_to is None:
+            yield from self._iter_batches()
+            return
+
+        import jax
+
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        _SENTINEL = object()
+
+        multihost = jax.process_count() > 1
+
+        def transfer(imgs, lbls):
+            if multihost:
+                # Per-host local batches assemble into one global sharded array
+                # (global batch = local batch * process_count along dim 0).
+                return (
+                    jax.make_array_from_process_local_data(self.prefetch_to, imgs),
+                    jax.make_array_from_process_local_data(self.prefetch_to, lbls),
+                )
+            return jax.device_put((imgs, lbls), self.prefetch_to)
+
+        def put_or_stop(item) -> bool:
+            # Never block forever on a full queue: an abandoned consumer (e.g. the
+            # trainer dropping a val iterator after val_steps) sets `stop`; re-check
+            # it between bounded put attempts so the thread can exit.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for imgs, lbls in self._iter_batches():
+                    if stop.is_set():
+                        return
+                    if not put_or_stop(transfer(imgs, lbls)):
+                        return
+                put_or_stop(_SENTINEL)
+            except Exception as e:  # surface errors on the consumer side
+                put_or_stop(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # Drain so device-resident batches are released promptly.
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

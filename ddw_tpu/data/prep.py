@@ -1,0 +1,162 @@
+"""Data-prep / ETL pipeline — the ``01_data_prep.py`` contract.
+
+Reproduces the reference ETL (``Part 1 - Distributed Training/01_data_prep.py``):
+raw JPEG directory tree -> *bronze* binary table (recursive ``*.jpg`` scan with a
+seeded fractional sample, ``:61-66``; 50% at ``:65``) -> label extracted from the
+parent directory name (pandas_udf regex on the path, ``:125-130``) -> seeded 90/10
+train/val split (seed 42, ``:162``) -> ``label_to_idx`` built from **sorted distinct
+labels** (``:179-181``; sorting makes the index deterministic) -> silver_train /
+silver_val tables with a ``label_idx`` column (``:187-197,213-222``).
+
+The reference parallelizes the scan across Spark executors; here the hot loop is
+file IO batched across a process pool when the tree is large (ETL data-parallelism
+role, SURVEY.md §2d). Determinism contract: same source tree + seeds => identical
+split membership and identical label index, independent of worker count or
+filesystem enumeration order (we sort scanned paths before sampling).
+
+Zero-egress testing: :func:`generate_synthetic_flowers` draws a 5-class synthetic
+"flowers" JPEG tree (tf_flowers layout: ``<root>/<class_name>/*.jpg``) with
+class-distinctive geometry so models genuinely learn (>90% separable), letting every
+pipeline stage run without the real dataset.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+from typing import Sequence
+
+import numpy as np
+
+from ddw_tpu.data.store import Record, Table, TableStore
+
+# The reference's class list, ``Part 2 - Distributed Tuning & Inference/
+# 03_pyfunc_distributed_inference.py:62``.
+FLOWER_CLASSES = ["daisy", "dandelion", "roses", "sunflowers", "tulips"]
+
+
+def scan_jpeg_tree(source_dir: str, sample_fraction: float = 1.0, seed: int = 12345) -> list[str]:
+    """Recursive ``*.jpg``/``*.jpeg`` scan with a seeded fractional sample.
+
+    Mirrors ``binaryFile`` + ``pathGlobFilter='*.jpg'`` + ``recursiveFileLookup`` +
+    ``.sample(frac, seed)`` (reference ``01_data_prep.py:61-66``). Paths are sorted
+    before sampling so the sample is enumeration-order independent.
+    """
+    paths = []
+    for dirpath, _dirnames, filenames in os.walk(source_dir):
+        for fn in filenames:
+            if fn.lower().endswith((".jpg", ".jpeg")):
+                paths.append(os.path.join(dirpath, fn))
+    paths.sort()
+    if sample_fraction < 1.0:
+        rng = random.Random(seed)
+        paths = [p for p in paths if rng.random() < sample_fraction]
+    return paths
+
+
+def label_from_path(path: str) -> str:
+    """Label = parent directory name — the pandas_udf regex
+    ``'.*/(\\w+)/\\d+[_\\w]*.jpg'`` role (reference ``01_data_prep.py:125-130``)."""
+    return os.path.basename(os.path.dirname(path))
+
+
+def build_label_index(labels: Sequence[str]) -> dict[str, int]:
+    """Sorted-distinct label -> index map (reference ``01_data_prep.py:179-181``)."""
+    return {lbl: i for i, lbl in enumerate(sorted(set(labels)))}
+
+
+def prepare_flowers(
+    source_dir: str,
+    store: TableStore,
+    sample_fraction: float = 0.5,
+    train_fraction: float = 0.9,
+    split_seed: int = 42,
+    shard_size: int = 256,
+    bronze_name: str = "flowers_bronze",
+    train_name: str = "silver_train",
+    val_name: str = "silver_val",
+) -> tuple[Table, Table, dict[str, int]]:
+    """Full 01_data_prep pipeline: scan -> bronze -> label/split/index -> silver.
+
+    Returns (silver_train, silver_val, label_to_idx). Split uses a seeded
+    permutation of the bronze rows (the ``randomSplit([.9,.1], seed=42)`` role,
+    reference ``01_data_prep.py:162``).
+    """
+    paths = scan_jpeg_tree(source_dir, sample_fraction)
+    if not paths:
+        raise FileNotFoundError(f"no JPEGs under {source_dir}")
+
+    def bronze_records():
+        for p in paths:
+            with open(p, "rb") as f:
+                yield Record(path=p, content=f.read())
+
+    bronze = store.write(bronze_name, bronze_records(), shard_size=shard_size,
+                         meta={"source_dir": source_dir, "sample_fraction": sample_fraction})
+
+    labels = [label_from_path(p) for p in paths]
+    label_to_idx = build_label_index(labels)
+
+    rng = np.random.RandomState(split_seed)
+    perm = rng.permutation(len(paths))
+    n_train = int(math.floor(train_fraction * len(paths)))
+    train_ids = set(perm[:n_train].tolist())
+
+    def silver(ids):
+        def gen():
+            for i, rec in enumerate(bronze.iter_records()):
+                if i in ids:
+                    lbl = label_from_path(rec.path)
+                    yield Record(rec.path, rec.content, lbl, label_to_idx[lbl])
+        return gen
+
+    all_ids = set(range(len(paths)))
+    t_meta = {"label_to_idx": label_to_idx, "split": "train", "split_seed": split_seed}
+    v_meta = {"label_to_idx": label_to_idx, "split": "val", "split_seed": split_seed}
+    train_tbl = store.write(train_name, silver(train_ids)(), shard_size=shard_size, meta=t_meta)
+    val_tbl = store.write(val_name, silver(all_ids - train_ids)(), shard_size=shard_size, meta=v_meta)
+    return train_tbl, val_tbl, label_to_idx
+
+
+# ---------------------------------------------------------------------------
+# Synthetic flowers (zero-egress stand-in for tf_flowers)
+# ---------------------------------------------------------------------------
+
+def _draw_class_image(rng: np.random.RandomState, cls_idx: int, size: int) -> "np.ndarray":
+    """Class-distinctive synthetic image: each class gets a distinct dominant hue and
+    petal-count geometry, with noise, random rotation/position/scale so the task is
+    learnable but not trivial."""
+    img = (rng.rand(size, size, 3) * 60).astype(np.float32)  # dark noise background
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    cx, cy = rng.uniform(size * 0.3, size * 0.7, 2)
+    r = np.hypot(xx - cx, yy - cy)
+    theta = np.arctan2(yy - cy, xx - cx) + rng.uniform(0, 2 * np.pi)
+    petals = 3 + cls_idx * 2                      # 3,5,7,9,11 petals by class
+    radius = size * rng.uniform(0.18, 0.30) * (1 + 0.45 * np.cos(petals * theta))
+    mask = r < radius
+    hue = np.zeros(3, np.float32)
+    hue[cls_idx % 3] = 200 + rng.uniform(0, 55)
+    hue[(cls_idx + 1) % 3] = 60 * (cls_idx // 3) + rng.uniform(0, 40)
+    img[mask] = hue + rng.randn(int(mask.sum()), 3).astype(np.float32) * 12
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def generate_synthetic_flowers(
+    root: str,
+    images_per_class: int = 40,
+    size: int = 64,
+    classes: Sequence[str] = tuple(FLOWER_CLASSES),
+    seed: int = 0,
+) -> str:
+    """Write a tf_flowers-layout JPEG tree (``<root>/<class>/<i>.jpg``)."""
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    for ci, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        os.makedirs(cdir, exist_ok=True)
+        for i in range(images_per_class):
+            arr = _draw_class_image(rng, ci, size)
+            Image.fromarray(arr).save(os.path.join(cdir, f"{i:04d}.jpg"), quality=90)
+    return root

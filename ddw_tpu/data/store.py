@@ -1,0 +1,203 @@
+"""Sharded binary-image table store — the Delta Lake / Parquet role.
+
+The reference stores training data as Delta tables of JPEG bytes: a *bronze* table of
+``(path, content)`` rows written by the binaryFile reader
+(``Part 1 - Distributed Training/01_data_prep.py:61-95``) and *silver* train/val
+tables adding ``label`` and ``label_idx`` columns (``:216-222``), stored as
+uncompressed parquet (``:92`` — JPEG bytes don't recompress).
+
+In-tree TPU-native equivalent: a table is a directory of fixed-schema binary shard
+files plus a JSON manifest; versions are append-only subdirectories with a ``latest``
+pointer, giving Delta's versioned-table semantics without a JVM. The record codec is
+deliberately trivial — length-prefixed fields, no compression (same rationale as
+``:92``) — so a C++ reader (``ddw_tpu/native``) can mmap/stream shards when the
+Python loader becomes the bottleneck.
+
+Shard file format (little-endian):
+    magic ``DDWS`` | u32 format_version | u32 nrecords
+    then per record: u32 path_len, path, u32 content_len, content,
+                     u32 label_len, label, i32 label_idx   (label_idx -1 = unlabeled)
+
+Shards are the unit of parallelism for the loader (``cur_shard``/``shard_count``
+selection, Petastorm role) and for the distributed batch scorer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import struct
+import time
+from typing import Iterable, Iterator
+
+_MAGIC = b"DDWS"
+_FORMAT_VERSION = 1
+
+
+@dataclasses.dataclass
+class RecordSchema:
+    """Fixed schema shared by bronze (label empty, label_idx -1) and silver tables."""
+
+    fields: tuple[str, ...] = ("path", "content", "label", "label_idx")
+
+
+@dataclasses.dataclass
+class Record:
+    path: str
+    content: bytes
+    label: str = ""
+    label_idx: int = -1
+
+
+def _write_shard(path: str, records: list[Record]) -> dict:
+    h = hashlib.sha256()
+    with open(path, "wb") as f:
+        head = _MAGIC + struct.pack("<II", _FORMAT_VERSION, len(records))
+        f.write(head)
+        h.update(head)
+        for r in records:
+            pb, lb = r.path.encode(), r.label.encode()
+            buf = (
+                struct.pack("<I", len(pb)) + pb
+                + struct.pack("<I", len(r.content)) + r.content
+                + struct.pack("<I", len(lb)) + lb
+                + struct.pack("<i", r.label_idx)
+            )
+            f.write(buf)
+            h.update(buf)
+    return {
+        "file": os.path.basename(path),
+        "num_records": len(records),
+        "bytes": os.path.getsize(path),
+        "sha256": h.hexdigest(),
+    }
+
+
+def read_shard(path: str) -> Iterator[Record]:
+    """Stream records from one shard file (pure-Python codec; see ddw_tpu/native for
+    the C++ fast path used by the loader when built)."""
+    with open(path, "rb") as f:
+        head = f.read(12)
+        if head[:4] != _MAGIC:
+            raise ValueError(f"{path}: bad magic {head[:4]!r}")
+        fmt, n = struct.unpack("<II", head[4:])
+        if fmt != _FORMAT_VERSION:
+            raise ValueError(f"{path}: unsupported format version {fmt}")
+        for _ in range(n):
+            (plen,) = struct.unpack("<I", f.read(4))
+            p = f.read(plen).decode()
+            (clen,) = struct.unpack("<I", f.read(4))
+            content = f.read(clen)
+            (llen,) = struct.unpack("<I", f.read(4))
+            label = f.read(llen).decode()
+            (idx,) = struct.unpack("<i", f.read(4))
+            yield Record(p, content, label, idx)
+
+
+class Table:
+    """One immutable version of a table: manifest + shard files."""
+
+    def __init__(self, version_dir: str):
+        self.version_dir = version_dir
+        with open(os.path.join(version_dir, "manifest.json")) as f:
+            self.manifest = json.load(f)
+
+    @property
+    def num_records(self) -> int:
+        return self.manifest["num_records"]
+
+    @property
+    def shard_paths(self) -> list[str]:
+        return [os.path.join(self.version_dir, "shards", s["file"]) for s in self.manifest["shards"]]
+
+    @property
+    def meta(self) -> dict:
+        return self.manifest.get("meta", {})
+
+    def iter_records(self) -> Iterator[Record]:
+        for sp in self.shard_paths:
+            yield from read_shard(sp)
+
+    def take(self, n: int) -> list[Record]:
+        out = []
+        for r in self.iter_records():
+            out.append(r)
+            if len(out) >= n:
+                break
+        return out
+
+
+class TableStore:
+    """Versioned table namespace rooted at a directory (the database_name role,
+    reference ``00_setup.py:3-9``)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _table_dir(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def write(
+        self,
+        name: str,
+        records: Iterable[Record],
+        shard_size: int = 256,
+        meta: dict | None = None,
+    ) -> Table:
+        """Write a new version of table ``name`` (append-only versioning)."""
+        tdir = self._table_dir(name)
+        os.makedirs(tdir, exist_ok=True)
+        existing = sorted(d for d in os.listdir(tdir) if d.startswith("v"))
+        vnum = 1 + (int(existing[-1][1:]) if existing else 0)
+        vdir = os.path.join(tdir, f"v{vnum:04d}")
+        shards_dir = os.path.join(vdir, "shards")
+        os.makedirs(shards_dir)
+
+        shard_metas, buf, total = [], [], 0
+        for rec in records:
+            buf.append(rec)
+            if len(buf) >= shard_size:
+                shard_metas.append(_write_shard(os.path.join(shards_dir, f"shard-{len(shard_metas):05d}.ddws"), buf))
+                total += len(buf)
+                buf = []
+        if buf:
+            shard_metas.append(_write_shard(os.path.join(shards_dir, f"shard-{len(shard_metas):05d}.ddws"), buf))
+            total += len(buf)
+
+        manifest = {
+            "name": name,
+            "version": vnum,
+            "schema": list(RecordSchema().fields),
+            "num_records": total,
+            "shards": shard_metas,
+            "created_unix": time.time(),
+            "meta": meta or {},
+        }
+        with open(os.path.join(vdir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+        # Atomic-enough latest pointer (single-writer discipline, rank 0 only).
+        with open(os.path.join(tdir, "latest.tmp"), "w") as f:
+            f.write(f"v{vnum:04d}")
+        os.replace(os.path.join(tdir, "latest.tmp"), os.path.join(tdir, "latest"))
+        return Table(vdir)
+
+    def table(self, name: str, version: int | None = None) -> Table:
+        """Open a table — ``spark.table(name)`` analog; latest version by default."""
+        tdir = self._table_dir(name)
+        if version is None:
+            with open(os.path.join(tdir, "latest")) as f:
+                vstr = f.read().strip()
+        else:
+            vstr = f"v{version:04d}"
+        return Table(os.path.join(tdir, vstr))
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self._table_dir(name), "latest"))
+
+    def list_tables(self) -> list[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(d for d in os.listdir(self.root) if os.path.isdir(self._table_dir(d)))

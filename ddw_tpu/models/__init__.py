@@ -1,0 +1,4 @@
+from ddw_tpu.models.registry import build_model, register_model, MODEL_REGISTRY  # noqa: F401
+from ddw_tpu.models.cnn import SmallCNN  # noqa: F401
+from ddw_tpu.models.mobilenet_v2 import MobileNetV2  # noqa: F401
+from ddw_tpu.models.vit import ViT  # noqa: F401

@@ -1,0 +1,61 @@
+"""Model registry: name -> flax module factory, driven by :class:`ModelCfg`.
+
+The build_model role of the reference notebooks (a shared factory kept identical
+across single-node and distributed variants — the equivalence-by-construction test
+idiom, reference ``03_model_training_distributed.py:153-155``, SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from ddw_tpu.utils.config import ModelCfg
+
+MODEL_REGISTRY: dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        MODEL_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def build_model(cfg: ModelCfg):
+    """Instantiate the flax module named by ``cfg.name``."""
+    if cfg.name not in MODEL_REGISTRY:
+        raise KeyError(f"unknown model {cfg.name!r}; have {sorted(MODEL_REGISTRY)}")
+    return MODEL_REGISTRY[cfg.name](cfg)
+
+
+def _dtype(cfg: ModelCfg):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[cfg.dtype]
+
+
+@register_model("mobilenet_v2")
+def _mobilenet_v2(cfg: ModelCfg):
+    from ddw_tpu.models.mobilenet_v2 import MobileNetV2
+
+    return MobileNetV2(
+        num_classes=cfg.num_classes,
+        width_mult=cfg.width_mult,
+        dropout=cfg.dropout,
+        freeze_base=cfg.freeze_base,
+        dtype=_dtype(cfg),
+    )
+
+
+@register_model("small_cnn")
+def _small_cnn(cfg: ModelCfg):
+    from ddw_tpu.models.cnn import SmallCNN
+
+    return SmallCNN(num_classes=cfg.num_classes, dropout=cfg.dropout, dtype=_dtype(cfg))
+
+
+@register_model("vit")
+def _vit(cfg: ModelCfg):
+    from ddw_tpu.models.vit import ViT
+
+    return ViT(num_classes=cfg.num_classes, dropout=cfg.dropout, dtype=_dtype(cfg))

@@ -1,0 +1,79 @@
+"""ViT — attention model family exercising tensor/sequence parallelism.
+
+The reference has no attention model (SURVEY.md §2d: TP/SP "not required for
+parity"), but long-context and model sharding are first-class axes of this
+framework: ViT is the in-tree model whose attention runs through
+``ddw_tpu.parallel.ring_attention`` when the mesh has a ``seq`` axis and whose
+MLP/attention projections shard over ``model``. Patch-embed -> pre-LN transformer
+blocks -> GAP head (same head contract as the CNNs, so trainer/serving are
+model-agnostic).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(x)
+        h = nn.gelu(h)
+        return nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype, name="attn"
+        )(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = MlpBlock(self.mlp_dim, dtype=self.dtype, name="mlp")(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    num_classes: int = 5
+    patch: int = 16
+    hidden: int = 192
+    depth: int = 6
+    num_heads: int = 3
+    mlp_dim: int = 768
+    dropout: float = 0.1
+    freeze_base: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.hidden, (self.patch, self.patch), strides=self.patch,
+                    name="backbone_patch_embed", dtype=self.dtype)(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02), (1, h * w, c), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(self.num_heads, self.mlp_dim, dtype=self.dtype,
+                             name=f"backbone_block{i}")(x, train)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        hfeat = jnp.mean(x.astype(jnp.float32), axis=1)
+        hfeat = nn.Dropout(self.dropout, deterministic=not train, name="head_dropout")(hfeat)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(hfeat)
+
+    @staticmethod
+    def frozen_prefixes(freeze_base: bool) -> tuple[str, ...]:
+        return ()

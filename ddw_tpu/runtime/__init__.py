@@ -1,0 +1,18 @@
+from ddw_tpu.runtime.mesh import (  # noqa: F401
+    MeshSpec,
+    make_mesh,
+    initialize_distributed,
+    process_index,
+    process_count,
+    is_coordinator,
+    local_device_count,
+    global_device_count,
+)
+from ddw_tpu.runtime.collectives import (  # noqa: F401
+    all_reduce_mean,
+    all_reduce_sum,
+    broadcast_from,
+    all_gather_axis,
+    ring_all_reduce,
+)
+from ddw_tpu.runtime.launcher import Launcher  # noqa: F401

@@ -1,0 +1,53 @@
+"""Worker entrypoint for :class:`ddw_tpu.runtime.launcher.Launcher` multi-process mode.
+
+Each spawned process: initialize the distributed runtime (the ``hvd.init()`` /
+mpirun-rendezvous analog), unpickle and run the train fn, and — rank 0 only — write
+the return value back for the driver (the HorovodRunner return contract,
+reference ``03_model_training_distributed.py:375``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+import traceback
+
+
+def main() -> int:
+    payload_path, result_path = sys.argv[1], sys.argv[2]
+    from ddw_tpu.runtime.mesh import initialize_distributed, is_coordinator
+
+    initialize_distributed()  # reads DDW_COORDINATOR / DDW_NUM_PROCESSES / DDW_PROCESS_ID
+    with open(payload_path, "rb") as f:
+        fn_spec, args, kwargs = pickle.load(f)
+    kind, blob, qualname = fn_spec
+    if kind == "pickled":
+        fn = pickle.loads(blob)
+    else:  # "by_file": re-import the driver script under a non-__main__ name
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("ddw_launched_main", blob)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["ddw_launched_main"] = mod
+        spec.loader.exec_module(mod)
+        fn = mod
+        for part in qualname.split("."):
+            fn = getattr(fn, part)
+    try:
+        value = fn(*args, **kwargs)
+        status = ("ok", value)
+    except Exception:
+        status = ("error", traceback.format_exc())
+    if is_coordinator():
+        try:
+            blob = pickle.dumps(status)
+        except Exception as e:  # unpicklable return value: report, don't mask
+            status = ("error", f"rank-0 return value is not picklable: {e!r}")
+            blob = pickle.dumps(status)
+        with open(result_path, "wb") as f:
+            f.write(blob)
+    return 0 if status[0] == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

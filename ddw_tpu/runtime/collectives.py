@@ -1,0 +1,111 @@
+"""Collective communication primitives — the Horovod-core role, compiled into the step.
+
+The reference's three collective uses (SURVEY.md §2b/§5 "Distributed communication
+backend") map 1:1 onto XLA collectives over ICI/DCN:
+
+- gradient averaging: ``hvd.DistributedOptimizer(optimizer)``
+  (``Part 1 - Distributed Training/03_model_training_distributed.py:302``)
+  -> :func:`all_reduce_mean` of the grad pytree inside the jitted step;
+- rank-0 weight broadcast: ``BroadcastGlobalVariablesCallback(0)`` (``:308``)
+  -> :func:`broadcast_from` (psum of a rank-masked tree) — though under SPMD,
+  identical-seed init usually makes it unnecessary;
+- metric averaging: ``MetricAverageCallback`` (``:313``) -> :func:`all_reduce_mean`
+  on the epoch metrics.
+
+There is no daemon, no tensor-fusion buffer, no background coordinator thread:
+everything here is traced into the XLA program, which fuses and schedules the
+collectives itself (Horovod's Tensor Fusion falls out of XLA fusion). An explicit
+Pallas/``ppermute`` ring reduction lives in :func:`ring_all_reduce` as the in-tree
+"native collective" — useful for overlap experiments and as the testable analog of
+Horovod's ring algorithm.
+
+All functions take an ``axis_name`` and must be called under ``shard_map``/``pmap``
+binding that name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, TypeVar
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+T = TypeVar("T")
+
+
+def all_reduce_sum(tree: T, axis_name: str) -> T:
+    """Sum a pytree across ``axis_name`` (allreduce-sum on every participant)."""
+    return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def all_reduce_mean(tree: T, axis_name: str) -> T:
+    """Mean a pytree across ``axis_name`` — gradient averaging / MetricAverage role."""
+    return jax.tree.map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def broadcast_from(tree: T, axis_name: str, root: int = 0) -> T:
+    """Broadcast ``root``'s values to every participant along ``axis_name``.
+
+    The ``BroadcastGlobalVariablesCallback(0)`` analog: mask all but ``root`` to zero
+    and psum. Under SPMD this is only needed when per-rank state may have diverged
+    (e.g. after independent host-side restores from different files).
+    """
+    idx = lax.axis_index(axis_name)
+
+    def _bcast(x):
+        mask = (idx == root).astype(x.dtype)
+        return lax.psum(x * mask, axis_name)
+
+    return jax.tree.map(_bcast, tree)
+
+
+def all_gather_axis(x: jax.Array, axis_name: str, tiled: bool = False) -> jax.Array:
+    """Gather shards from every participant along ``axis_name``."""
+    return lax.all_gather(x, axis_name, tiled=tiled)
+
+
+def ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
+    """Explicit ring allreduce via ``ppermute`` — Horovod's ring algorithm, in-tree.
+
+    Reduce-scatter phase then all-gather phase, each N-1 ``ppermute`` steps around
+    the ring; communication-optimal (2·(N-1)/N · bytes). XLA's native ``psum``
+    already lowers to this class of algorithm on TPU ICI, so this exists as the
+    first-class, testable "native collective" component (SURVEY.md §2c Horovod row),
+    and as the substrate for overlap experiments. Numerically identical to
+    ``lax.psum`` up to summation order.
+
+    Requires the leading dim of ``x`` to be divisible by the axis size (pad first if
+    not); returns the full reduced array on every participant.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    me = lax.axis_index(axis_name)
+    orig_shape = x.shape
+    chunks = jnp.reshape(x, (n, -1))  # chunk c will be reduced by rank (c-1) % n
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Reduce-scatter: n-1 ppermute steps around the ring (python loop — n is static
+    # at trace time, it's a mesh axis size). At step k each rank forwards its running
+    # partial sum and folds in its own copy of the chunk that just arrived.
+    acc = jnp.take(chunks, me, axis=0)
+    for k in range(n - 1):
+        acc = lax.ppermute(acc, axis_name, perm)
+        acc = acc + jnp.take(chunks, (me - k - 1) % n, axis=0)
+    # acc on rank r is now the full sum of chunk (r + 1) % n.
+
+    # All-gather phase: circulate each completed chunk n-1 hops so every rank ends
+    # with all chunks, then restore chunk order (chunk c completed on rank (c-1)%n).
+    gathered = [acc]
+    block = acc
+    for _ in range(n - 1):
+        block = lax.ppermute(block, axis_name, perm)
+        gathered.append(block)
+    # gathered[k] on rank r is the chunk completed by rank (r - k) % n, i.e. chunk
+    # (r - k + 1) % n. Scatter into chunk order.
+    out = jnp.zeros_like(chunks)
+    for k in range(n):
+        out = out.at[(me - k + 1) % n].set(gathered[k])
+    return jnp.reshape(out, orig_shape)

@@ -1,0 +1,126 @@
+"""Train-fn launcher — the HorovodRunner role.
+
+The reference launches distributed training by pickling a train function to Spark
+barrier-mode tasks which rendezvous via mpirun
+(``Part 1 - Distributed Training/03_model_training_distributed.py:255-263``), with two
+modes: ``np=-1`` runs the same function locally on the driver as a smoke test
+(``:391-397``) and ``np=N`` gang-schedules N workers (``:411-417``); the driver gets
+rank-0's return value (``:375``).
+
+TPU-native translation: a jitted SPMD step already spans all local devices of one
+process, so "distributed" has two regimes:
+
+- **in-process SPMD** (the common case): ``np=-1`` — just call the fn; the mesh gives
+  it every local device. This preserves the reference's key test idiom: the *exact*
+  distributed code path at world-size 1 / single process (SURVEY.md §4.1).
+- **multi-process**: N OS processes, each owning a slice of devices, rendezvoused by
+  ``jax.distributed.initialize`` (replacing the mpirun rendezvous). On a real pod this
+  is one process per host launched by the cluster manager; for testing (and
+  single-host multi-process), :class:`Launcher` spawns the N processes itself with a
+  local TCP coordinator and CPU devices, and returns rank-0's return value — the
+  HorovodRunner contract.
+
+The launched function must be picklable (module-level) and takes no required args
+(bind hyperparameters with ``functools.partial``, mirroring how the reference passes
+HPO params as function args, ``02_hyperopt_distributed_model.py:161``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Callable
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Launcher:
+    """Run a train function locally (``np=-1``) or across ``np`` processes.
+
+    ``np=-1``: call in-process (driver smoke mode; same code path, world size = this
+    process's devices). ``np>=1``: spawn ``np`` python processes on this machine,
+    each with ``devices_per_proc`` forced-host CPU devices, rendezvous via a local
+    coordinator, run ``fn`` everywhere, return rank-0's return value.
+    """
+
+    def __init__(self, np: int = -1, devices_per_proc: int = 1, timeout_s: float = 600.0):
+        self.np = np
+        self.devices_per_proc = devices_per_proc
+        self.timeout_s = timeout_s
+
+    def run(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+        if self.np == -1:
+            return fn(*args, **kwargs)
+        return self._run_multiproc(fn, args, kwargs)
+
+    def _run_multiproc(self, fn, args, kwargs) -> Any:
+        # Functions defined in a script's __main__ can't unpickle inside the worker
+        # (whose __main__ is the worker module) — the problem HorovodRunner solves
+        # with cloudpickle. We ship a (file, qualname) reference instead and the
+        # worker re-imports the script under a non-__main__ name.
+        if getattr(fn, "__module__", None) == "__main__":
+            import __main__ as main_mod
+
+            src = getattr(main_mod, "__file__", None)
+            if src is None:
+                raise ValueError("cannot ship a __main__ function from an interactive session; "
+                                 "define the train fn in an importable module")
+            fn_spec = ("by_file", os.path.abspath(src), fn.__qualname__)
+        else:
+            fn_spec = ("pickled", pickle.dumps(fn), None)
+        with tempfile.TemporaryDirectory(prefix="ddw_launch_") as tmp:
+            payload = os.path.join(tmp, "payload.pkl")
+            result = os.path.join(tmp, "result.pkl")
+            with open(payload, "wb") as f:
+                pickle.dump((fn_spec, args, kwargs), f)
+            port = _free_port()
+            procs = []
+            for rank in range(self.np):
+                env = dict(os.environ)
+                # Force an isolated CPU backend in workers: disable the axon/TPU
+                # plugin hook and give each process its own virtual device set.
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    env.get("DDW_WORKER_XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={self.devices_per_proc}"
+                ).strip()
+                env["DDW_COORDINATOR"] = f"127.0.0.1:{port}"
+                env["DDW_NUM_PROCESSES"] = str(self.np)
+                env["DDW_PROCESS_ID"] = str(rank)
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "ddw_tpu.runtime._launch_worker", payload, result],
+                    env=env,
+                    stdout=None if rank == 0 else subprocess.DEVNULL,
+                    stderr=None,
+                )
+                procs.append(p)
+            try:
+                # One shared deadline for the whole gang (not np * timeout), and
+                # kill every worker on any failure so a crashed rank can't leave
+                # the others orphaned in a collective.
+                deadline = time.monotonic() + self.timeout_s
+                codes = []
+                for p in procs:
+                    remaining = max(0.1, deadline - time.monotonic())
+                    codes.append(p.wait(timeout=remaining))
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+            if any(codes):
+                raise RuntimeError(f"launcher workers exited with codes {codes}")
+            with open(result, "rb") as f:
+                status, value = pickle.load(f)
+            if status == "error":
+                raise RuntimeError(f"rank-0 worker raised: {value}")
+            return value

@@ -1,0 +1,132 @@
+"""Device mesh + multi-process runtime bootstrap.
+
+Fills the reference's L0 cluster-runtime role (Databricks Spark driver/executors +
+barrier scheduling, SURVEY.md §1) and the rendezvous half of Horovod: where the
+reference gang-schedules ``np`` Python workers via Spark barrier mode and ``mpirun``
+(``Part 1 - Distributed Training/03_model_training_distributed.py:258-263``) and calls
+``hvd.init()`` (``:283``), a TPU-native job runs the *same script on every host* and
+calls :func:`initialize_distributed` once; gang semantics are inherent to SPMD/XLA.
+
+The mesh is the single source of truth for "who am I / what devices exist":
+``hvd.rank()`` -> :func:`process_index`, ``hvd.size()`` -> ``mesh size`` along the data
+axis, ``hvd.local_rank()`` -> device ordinal (device pinning,
+reference ``:290-295``, is automatic on TPU — each process owns its local chips).
+
+Axis conventions (ddw_tpu.parallel builds on these):
+  ``data``     — data parallelism (gradient psum). The only axis the reference uses.
+  ``model``    — tensor parallelism.
+  ``seq``      — sequence/context parallelism (ring attention).
+  ``pipe``     — pipeline stages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape by axis name. Size -1 means "absorb remaining devices"."""
+
+    axes: tuple[tuple[str, int], ...] = ((DATA_AXIS, -1),)
+
+    def resolve(self, n_devices: int) -> tuple[tuple[str, int], ...]:
+        fixed = [(a, s) for a, s in self.axes if s != -1]
+        wild = [a for a, s in self.axes if s == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one axis may be -1")
+        prod = int(np.prod([s for _, s in fixed])) if fixed else 1
+        if n_devices % prod:
+            raise ValueError(f"{n_devices} devices not divisible by fixed axes {fixed}")
+        out = []
+        for a, s in self.axes:
+            out.append((a, n_devices // prod if s == -1 else s))
+        return tuple(out)
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bootstrap: replaces Spark-barrier + mpirun + ``hvd.init()``.
+
+    No-op for single-process jobs (the common local/dev case — the ``np=-1`` smoke
+    mode of reference ``03_model_training_distributed.py:391-397`` needs no cluster).
+    On a TPU pod each host runs this with the same coordinator address; env vars
+    ``DDW_COORDINATOR`` / ``DDW_NUM_PROCESSES`` / ``DDW_PROCESS_ID`` are honored so
+    the same script works unmodified on every host (SPMD discipline).
+    """
+    coordinator_address = coordinator_address or os.environ.get("DDW_COORDINATOR")
+    if coordinator_address is None:
+        return  # single-process
+    num_processes = num_processes or int(os.environ.get("DDW_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(os.environ.get("DDW_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_index() -> int:
+    """This process's rank (``hvd.rank()`` analog at host granularity)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """World size in hosts (``hvd.size()`` analog at host granularity)."""
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the rank-0 process — the only writer of checkpoints/track logs
+    (rank-0 discipline, reference ``03_model_training_distributed.py:361-373``)."""
+    return jax.process_index() == 0
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    return jax.device_count()
+
+
+def make_mesh(
+    spec: MeshSpec | Sequence[tuple[str, int]] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named-axis :class:`jax.sharding.Mesh` over the visible devices.
+
+    Default: a 1-D ``data`` mesh over all devices — the reference's only strategy
+    (synchronous allreduce-DP, SURVEY.md §2d). ``jax.experimental.mesh_utils`` lays
+    devices out so collectives ride ICI within a slice.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec()
+    if not isinstance(spec, MeshSpec):
+        spec = MeshSpec(tuple(spec))
+    shape = spec.resolve(len(devices))
+    names = tuple(a for a, _ in shape)
+    dims = tuple(s for _, s in shape)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(dims, devices=list(devices))
+    except Exception:
+        dev_array = np.asarray(list(devices)).reshape(dims)
+    return Mesh(dev_array, names)

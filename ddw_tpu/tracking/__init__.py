@@ -1,0 +1,2 @@
+from ddw_tpu.tracking.tracker import Tracker, Run  # noqa: F401
+from ddw_tpu.tracking.registry import ModelRegistry  # noqa: F401

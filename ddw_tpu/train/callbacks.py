@@ -1,0 +1,85 @@
+"""Epoch-level callback suite — the Horovod/Keras callback stack, host-side.
+
+Reproduces the reference's callback semantics
+(``Part 1 - Distributed Training/03_model_training_distributed.py:304-322``):
+
+- :class:`LRWarmup` — ``hvd.callbacks.LearningRateWarmupCallback``: ramp the LR from
+  the base rate to ``base * world`` over the first ``warmup_epochs`` epochs (gradual
+  LR scaling per Goyal et al. 1706.02677; reference ``:314-318``).
+- :class:`ReduceLROnPlateau` — Keras semantics: multiply LR by ``factor`` when the
+  monitored metric hasn't improved for ``patience`` epochs (reference ``:321``).
+- :class:`EarlyStopping` — Keras semantics, used by the pyfunc training pipeline
+  (``Part 2 - Distributed Tuning & Inference/03_pyfunc_distributed_inference.py:397-401``).
+
+Ordering note preserved from the reference (``:310-313``): metric averaging must
+happen *before* LR callbacks consume metrics — in this framework metrics come out of
+the step already ``pmean``-ed, so callbacks always see world-consistent values.
+
+Callbacks are pure host-side logic mutating the *dynamic* LR hyperparameter
+(``ddw_tpu.train.step.set_lr``) — no recompilation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass
+class LRWarmup:
+    """Linear ramp base_lr -> base_lr * world_size over ``warmup_epochs``.
+
+    After warmup the LR stays at the scaled rate (the ``Adam(0.001 * hvd.size())``
+    target, reference ``:301``); with world_size 1 this is the identity.
+    """
+
+    base_lr: float
+    world_size: int
+    warmup_epochs: int = 5
+
+    def lr_for_epoch(self, epoch: int) -> float:
+        target = self.base_lr * self.world_size
+        if self.world_size == 1 or self.warmup_epochs <= 0 or epoch >= self.warmup_epochs:
+            return target
+        # epoch is 0-based; finish the ramp at epoch == warmup_epochs.
+        frac = (epoch + 1) / self.warmup_epochs
+        return self.base_lr + (target - self.base_lr) * frac
+
+
+@dataclasses.dataclass
+class ReduceLROnPlateau:
+    """Keras-style plateau scheduler on a minimized metric (val_loss)."""
+
+    patience: int = 10
+    factor: float = 0.5
+    min_lr: float = 1e-7
+    _best: float = math.inf
+    _wait: int = 0
+
+    def update(self, metric: float, lr: float) -> float:
+        if metric < self._best - 1e-12:
+            self._best = metric
+            self._wait = 0
+            return lr
+        self._wait += 1
+        if self._wait > self.patience:
+            self._wait = 0
+            return max(self.min_lr, lr * self.factor)
+        return lr
+
+
+@dataclasses.dataclass
+class EarlyStopping:
+    """Stop when the minimized metric hasn't improved for ``patience`` epochs."""
+
+    patience: int = 3
+    _best: float = math.inf
+    _wait: int = 0
+
+    def should_stop(self, metric: float) -> bool:
+        if metric < self._best - 1e-12:
+            self._best = metric
+            self._wait = 0
+            return False
+        self._wait += 1
+        return self._wait > self.patience

@@ -1,0 +1,231 @@
+"""Jitted SPMD train/eval steps — the TF/Keras fit inner loop + Horovod
+DistributedOptimizer, collapsed into one compiled program.
+
+The reference's per-batch hot loop is: forward/backward in TF, then Horovod's
+background C++ thread fuses gradient tensors and ring-allreduces them
+(``Part 1 - Distributed Training/03_model_training_distributed.py:302``; stack in
+SURVEY.md §3.3). Here the entire step — forward, backward, gradient ``pmean`` over
+the ``data`` mesh axis, optimizer update — is a single ``shard_map``-ped, jitted XLA
+program: the collective is compiled into the step (no daemon, no fusion buffer; XLA
+overlaps the allreduce with remaining backward compute on its own).
+
+Design choices, TPU-first:
+- per-device batch is the loader's per-worker batch; loss/metrics are computed
+  locally then ``pmean``-ed (MetricAverageCallback semantics, reference ``:313``);
+- params live replicated (the reference replicates them too — no ZeRO, SURVEY §2d);
+  gradient ``pmean`` keeps them in lockstep, and a debug-mode cross-host checksum
+  (``TrainCfg.debug_cross_host_checks``) asserts it — the SPMD race-detector analog
+  (SURVEY §5);
+- learning rate is a *dynamic* optax hyperparameter (``inject_hyperparams``), so the
+  Python-side callback suite (warmup / plateau — reference ``:318-321``) can set it
+  per epoch without recompiling;
+- frozen-base transfer mode masks optimizer updates on the ``backbone`` param
+  subtree (Keras ``trainable=False`` role, reference
+  ``02_model_training_single_node.py:169``) — frozen params get ``set_to_zero``;
+- dropout rng is folded with the data-axis index so replicas draw independent masks
+  over their distinct shards.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any          # {} for stateless-norm models
+    opt_state: Any
+    step: jnp.ndarray         # i32 scalar
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Sparse categorical cross-entropy from logits (reference
+    ``02_model_training_single_node.py:202`` — ``from_logits=True``)."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def _base_optimizer(name: str, learning_rate) -> optax.GradientTransformation:
+    if name == "adam":
+        return optax.adam(learning_rate)
+    if name == "adadelta":
+        return optax.adadelta(learning_rate)
+    if name == "sgd":
+        return optax.sgd(learning_rate, momentum=0.9)
+    raise KeyError(f"unknown optimizer {name!r} (have adam, adadelta, sgd)")
+
+
+def make_optimizer(
+    cfg: TrainCfg,
+    frozen_prefixes: tuple[str, ...] = (),
+) -> optax.GradientTransformation:
+    """Optimizer with dynamic LR + frozen-subtree masking.
+
+    The returned transformation exposes ``opt_state.hyperparams['learning_rate']``
+    for the callback suite. ``frozen_prefixes`` are top-level param-tree keys
+    excluded from updates (transfer-learning mode).
+    """
+    @functools.partial(optax.inject_hyperparams, static_args=())
+    def _make(learning_rate):
+        return _base_optimizer(cfg.optimizer, learning_rate)
+
+    tx = _make(learning_rate=cfg.learning_rate)
+    if frozen_prefixes:
+        def label_tree(params):
+            return {k: ("frozen" if k in frozen_prefixes else "train") for k in params}
+
+        tx = optax.multi_transform({"train": tx, "frozen": optax.set_to_zero()}, label_tree)
+    return tx
+
+
+def init_state(
+    model,
+    model_cfg: ModelCfg,
+    train_cfg: TrainCfg,
+    image_shape: tuple[int, int, int],
+    rng: jax.Array,
+) -> tuple[TrainState, optax.GradientTransformation]:
+    """Seeded init — identical on every host, which *is* the rank-0 weight broadcast
+    under SPMD (BroadcastGlobalVariablesCallback role, reference ``:305-308``;
+    SURVEY §5 checkpoint note)."""
+    dummy = jnp.zeros((1, *image_shape), jnp.float32)
+    variables = model.init({"params": rng}, dummy, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    frozen = type(model).frozen_prefixes(getattr(model, "freeze_base", False))
+    tx = make_optimizer(train_cfg, frozen)
+    opt_state = tx.init(params)
+    return TrainState(params, batch_stats, opt_state, jnp.zeros((), jnp.int32)), tx
+
+
+def get_lr(state: TrainState) -> float:
+    """Read the current dynamic LR out of (possibly masked) opt state."""
+    os_ = state.opt_state
+    if isinstance(os_, optax.MultiTransformState):
+        os_ = os_.inner_states["train"].inner_state
+    return float(os_.hyperparams["learning_rate"])
+
+
+def set_lr(state: TrainState, lr: float) -> TrainState:
+    """Set the dynamic LR (callback suite writes; no recompilation)."""
+    os_ = state.opt_state
+    if isinstance(os_, optax.MultiTransformState):
+        inner = os_.inner_states["train"]
+        new_hp = dict(inner.inner_state.hyperparams)
+        new_hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+        new_inner_state = inner.inner_state._replace(hyperparams=new_hp)
+        new_states = dict(os_.inner_states)
+        new_states["train"] = inner._replace(inner_state=new_inner_state)
+        return state.replace(opt_state=os_._replace(inner_states=new_states))
+    new_hp = dict(os_.hyperparams)
+    new_hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+    return state.replace(opt_state=os_._replace(hyperparams=new_hp))
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = "data",
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted SPMD train step over ``mesh``.
+
+    Returns ``step(state, images, labels, rng) -> (state, metrics)`` where images /
+    labels are globally-sharded arrays split along ``axis_name`` and metrics are
+    already world-averaged (loss, accuracy).
+    """
+    def _step(state: TrainState, images, labels, rng):
+        me = lax.axis_index(axis_name)
+        dropout_rng = jax.random.fold_in(jax.random.fold_in(rng, me), state.step)
+
+        def loss_fn(params):
+            variables = {"params": params}
+            mutable = False
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                mutable = ["batch_stats"]
+            out = model.apply(
+                variables, images, train=True,
+                rngs={"dropout": dropout_rng},
+                mutable=mutable,
+            )
+            logits, new_vars = out if mutable else (out, {})
+            loss = cross_entropy_loss(logits, labels)
+            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            return loss, (acc, new_vars.get("batch_stats", state.batch_stats))
+
+        (loss, (acc, new_bs)), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        # THE collective: gradient averaging across the data axis
+        # (hvd.DistributedOptimizer role, reference :302).
+        grads = lax.pmean(grads, axis_name)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        if state.batch_stats:
+            new_bs = lax.pmean(new_bs, axis_name)  # world-consistent BN statistics
+        metrics = {
+            "loss": lax.pmean(loss, axis_name),
+            "accuracy": lax.pmean(acc, axis_name),
+        }
+        new_state = TrainState(new_params, new_bs, new_opt, state.step + 1)
+        return new_state, metrics
+
+    n_data = mesh.shape[axis_name]
+    repl = P()
+    data_spec = P(axis_name)
+    smapped = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(repl, data_spec, data_spec, repl),
+        out_specs=(repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(model, mesh: Mesh, axis_name: str = "data") -> Callable:
+    """Jitted eval step: world-averaged (loss, accuracy) on a sharded batch."""
+
+    def _eval(state: TrainState, images, labels):
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, images, train=False)
+        loss = cross_entropy_loss(logits, labels)
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return {"loss": lax.pmean(loss, axis_name), "accuracy": lax.pmean(acc, axis_name)}
+
+    smapped = jax.shard_map(
+        _eval,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(smapped)
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
+    """Sharding for host batches: leading (batch) dim split over the data axis."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def params_checksum(state: TrainState) -> float:
+    """Debug-mode consistency checksum (SPMD sanitizer, SURVEY §5): identical across
+    hosts iff params are in lockstep."""
+    leaves = jax.tree.leaves(state.params)
+    return float(sum(jnp.sum(jnp.abs(x.astype(jnp.float32))) for x in leaves))

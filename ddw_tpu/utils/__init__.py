@@ -1,0 +1,1 @@
+from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg, TuneCfg, apply_overrides  # noqa: F401

@@ -1,0 +1,144 @@
+"""Typed config tree + CLI overrides.
+
+The reference's configuration story is three ad-hoc idioms (SURVEY.md §5 "Config / flag
+system"): module-level UPPERCASE globals per notebook
+(reference ``Part 1 - Distributed Training/02_model_training_single_node.py:41-46``),
+env bootstrap (``00_setup.py:3-17``), and exactly one typed dataclass, ``DataCfg``
+(``Part 2 - Distributed Tuning & Inference/03_pyfunc_distributed_inference.py:85-95``).
+We generalize the dataclass idiom into a small config tree with dotted-path CLI
+overrides (``train.batch_size=256``), which every example script and the trainer share.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class DataCfg:
+    """Dataset + preprocessing config.
+
+    Mirrors the reference ``DataCfg``
+    (``03_pyfunc_distributed_inference.py:85-95``: img height/width, batch sizes) and
+    the data-prep constants (``01_data_prep.py:61-66,162``: 50% sample, 90/10 split,
+    seed 42).
+    """
+
+    table_root: str = "/tmp/ddw_tpu/tables"
+    source_dir: str = ""                # raw JPEG class-dir tree (tf_flowers layout)
+    img_height: int = 224
+    img_width: int = 224
+    channels: int = 3
+    sample_fraction: float = 0.5        # reference samples 50% of the raw images
+    train_fraction: float = 0.9         # 90/10 split
+    split_seed: int = 42                # reference seed
+    shard_size: int = 256               # records per shard file in the table store
+    shuffle_buffer: int = 1024
+    prefetch: int = 2                   # host->device double buffering depth
+    loader_workers: int = 4             # decode thread pool (petastorm workers_count role)
+
+    @property
+    def image_shape(self) -> tuple[int, int, int]:
+        return (self.img_height, self.img_width, self.channels)
+
+
+@dataclass
+class ModelCfg:
+    """Model factory config.
+
+    The reference model: MobileNetV2 ImageNet-pretrained frozen base + GAP ->
+    Dropout(0.5) -> Dense(num_classes) head
+    (``02_model_training_single_node.py:159-178``).
+    """
+
+    name: str = "mobilenet_v2"          # key into ddw_tpu.models.registry
+    num_classes: int = 5
+    dropout: float = 0.5
+    freeze_base: bool = True            # transfer-learning mode: only the head trains
+    width_mult: float = 1.0
+    pretrained_path: str = ""           # optional converted-weights artifact
+    dtype: str = "bfloat16"             # compute dtype on the MXU; params stay f32
+
+
+@dataclass
+class TrainCfg:
+    """Training loop + distribution config.
+
+    Mirrors the single-node constants (batch 32, 3 epochs, Adam 1e-3,
+    ``02_model_training_single_node.py:45-46,201-203``) and the distributed contract
+    (batch 256/worker, LR x world, 5-epoch warmup, plateau patience 10,
+    ``03_model_training_distributed.py:81-82,301,318-321``).
+    """
+
+    batch_size: int = 32                # per-worker batch (reference semantics)
+    epochs: int = 3
+    optimizer: str = "adam"             # adam | adadelta | sgd (HPO space includes Adadelta)
+    learning_rate: float = 1e-3
+    scale_lr_by_world: bool = True      # Adam(0.001 * hvd.size()) semantics
+    warmup_epochs: int = 5              # LearningRateWarmupCallback(warmup_epochs=5)
+    plateau_patience: int = 10          # ReduceLROnPlateau(patience=10)
+    plateau_factor: float = 0.5
+    early_stop_patience: int = 0        # 0 = disabled; pyfunc notebook uses 3
+    seed: int = 0
+    data_axis: str = "data"             # mesh axis name for DP psum
+    num_devices: int = 0                # 0 = all visible devices
+    checkpoint_dir: str = ""            # "" = no per-epoch checkpoints
+    checkpoint_every_epochs: int = 1
+    log_every_steps: int = 10
+    trace_dir: str = ""                 # --trace flag role (jax.profiler), SURVEY §5
+    debug_cross_host_checks: bool = False  # SPMD consistency sanitizer, SURVEY §5
+
+
+@dataclass
+class TuneCfg:
+    """Hyperparameter-search config.
+
+    Mirrors fmin(max_evals=20, SparkTrials(parallelism=4))
+    (``01_hyperopt_single_machine_model.py:226-238``) and the sequential distributed
+    mode (``02_hyperopt_distributed_model.py:341-365``).
+    """
+
+    max_evals: int = 20
+    parallelism: int = 4                # >1 = parallel trial executor; 1 = sequential
+    seed: int = 0
+    algo: str = "tpe"                   # tpe | random
+    n_startup_trials: int = 5           # random trials before TPE kicks in
+    gamma: float = 0.25                 # TPE good/bad split quantile
+
+
+_TYPES = {"data": DataCfg, "model": ModelCfg, "train": TrainCfg, "tune": TuneCfg}
+
+
+def apply_overrides(cfgs: dict[str, Any], overrides: list[str]) -> dict[str, Any]:
+    """Apply ``section.key=value`` CLI overrides to a dict of config dataclasses.
+
+    Values parse as JSON when possible (``train.batch_size=256`` -> int), else string.
+    """
+    for ov in overrides:
+        if "=" not in ov or "." not in ov.split("=", 1)[0]:
+            raise ValueError(f"override must look like section.key=value, got {ov!r}")
+        path, raw = ov.split("=", 1)
+        section, key = path.split(".", 1)
+        if section not in cfgs:
+            raise KeyError(f"unknown config section {section!r} (have {sorted(cfgs)})")
+        cfg = cfgs[section]
+        if not hasattr(cfg, key):
+            raise KeyError(f"{type(cfg).__name__} has no field {key!r}")
+        try:
+            val = json.loads(raw)
+        except json.JSONDecodeError:
+            val = raw
+        setattr(cfg, key, val)
+    return cfgs
+
+
+def to_dict(cfg: Any) -> dict[str, Any]:
+    """Flatten a dataclass config to a JSON-able dict (for tracker param logging)."""
+    return dataclasses.asdict(cfg)
+
+
+def default_cfgs() -> dict[str, Any]:
+    return {name: typ() for name, typ in _TYPES.items()}

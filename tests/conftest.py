@@ -1,0 +1,34 @@
+"""Shared fixtures: synthetic flowers tree, prepared silver tables, small configs."""
+
+import pytest
+
+from ddw_tpu.data.prep import generate_synthetic_flowers, prepare_flowers
+from ddw_tpu.data.store import TableStore
+from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+
+@pytest.fixture(scope="session")
+def flowers_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("flowers_src")
+    return generate_synthetic_flowers(str(root), images_per_class=24, size=40)
+
+
+@pytest.fixture(scope="session")
+def store(tmp_path_factory):
+    return TableStore(str(tmp_path_factory.mktemp("tables")))
+
+
+@pytest.fixture(scope="session")
+def silver(flowers_dir, store):
+    """(train_table, val_table, label_to_idx) over the synthetic tree."""
+    return prepare_flowers(flowers_dir, store, sample_fraction=1.0, shard_size=16)
+
+
+@pytest.fixture()
+def small_cfgs(tmp_path):
+    data = DataCfg(img_height=32, img_width=32, shard_size=16, shuffle_buffer=64,
+                   loader_workers=2)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.1, dtype="float32")
+    train = TrainCfg(batch_size=8, epochs=2, learning_rate=1e-3, warmup_epochs=0,
+                     seed=0, checkpoint_dir=str(tmp_path / "ckpt"))
+    return data, model, train

@@ -1,0 +1,93 @@
+"""Collectives tests on the 8-device CPU mesh (Horovod-core role, SURVEY §2c)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ddw_tpu.runtime import collectives
+from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshSpec((("data", 8),)))
+
+
+def _smap(fn, mesh, n_out=1):
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                                 check_vma=False))
+
+
+def test_all_reduce_sum_mean(mesh):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+    def f(xs):
+        return collectives.all_reduce_sum(xs, "data"), collectives.all_reduce_mean(xs, "data")
+
+    s, m = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("data"),
+                                 out_specs=(P("data"), P("data")), check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(s), np.full((8, 1), 28.0))
+    np.testing.assert_allclose(np.asarray(m), np.full((8, 1), 3.5))
+
+
+def test_all_reduce_tree(mesh):
+    tree = {"a": np.ones((8, 2), np.float32), "b": np.arange(8, dtype=np.float32).reshape(8, 1)}
+
+    def f(t):
+        return collectives.all_reduce_mean(t, "data")
+
+    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                                check_vma=False))(tree)
+    np.testing.assert_allclose(np.asarray(out["a"]), np.ones((8, 2)))
+    np.testing.assert_allclose(np.asarray(out["b"]), np.full((8, 1), 3.5))
+
+
+def test_broadcast_from_root(mesh):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+    def f(xs):
+        return collectives.broadcast_from(xs, "data", root=3)
+
+    out = _smap(f, mesh)(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
+
+
+def test_ring_all_reduce_matches_psum(mesh):
+    rng = np.random.RandomState(0)
+    # per-device shard: 8 devices x 16 elements, leading dim divisible by 8
+    x = rng.randn(8, 16).astype(np.float32)
+
+    def ring(xs):
+        return collectives.ring_all_reduce(xs[0], "data")[None]
+
+    def psum(xs):
+        return jax.lax.psum(xs[0], "data")[None]
+
+    got = _smap(ring, mesh)(x)
+    want = _smap(psum, mesh)(x)
+    # identical up to float32 summation order
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_ring_all_reduce_single_axis_size():
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("data",))
+    x = np.ones((1, 8), np.float32)
+
+    def ring(xs):
+        return collectives.ring_all_reduce(xs[0], "data")[None]
+
+    out = jax.jit(jax.shard_map(ring, mesh=mesh1, in_specs=P("data"), out_specs=P("data"),
+                                check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(out), x)
+
+
+def test_all_gather(mesh):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+    def f(xs):
+        return collectives.all_gather_axis(xs[0], "data")[None]
+
+    out = _smap(f, mesh)(x)
+    assert np.asarray(out).shape == (8, 8, 1)

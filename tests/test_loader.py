@@ -1,0 +1,89 @@
+"""Loader contract tests: shard selection, infinite repeat, static shapes,
+prefetch-to-device (the Petastorm make_tf_dataset semantics, SURVEY §2b.8)."""
+
+import numpy as np
+
+from ddw_tpu.data.loader import ShardedLoader
+
+
+def _take(loader, n):
+    it = iter(loader)
+    return [next(it) for _ in range(n)]
+
+
+def test_batch_shapes_and_dtypes(silver):
+    train, _, _ = silver
+    ld = ShardedLoader(train, batch_size=8, image_size=(32, 32), shuffle=False,
+                       num_epochs=1, workers=2)
+    imgs, lbls = _take(ld, 1)[0]
+    assert imgs.shape == (8, 32, 32, 3) and imgs.dtype == np.float32
+    assert lbls.shape == (8,) and lbls.dtype == np.int32
+    assert 0 <= lbls.min() and lbls.max() < 5
+
+
+def test_drop_remainder_static_shapes(silver):
+    train, _, _ = silver
+    ld = ShardedLoader(train, batch_size=7, image_size=(16, 16), shuffle=False,
+                       num_epochs=1, workers=2)
+    batches = list(iter(ld))
+    assert len(batches) == train.num_records // 7
+    assert all(b[0].shape == (7, 16, 16, 3) for b in batches)
+
+
+def test_shard_disjoint_cover(silver):
+    """Workers' record sets are disjoint and cover the table (petastorm
+    cur_shard/shard_count role)."""
+    train, _, _ = silver
+    seen = []
+    for rank in range(3):
+        ld = ShardedLoader(train, batch_size=1, image_size=(8, 8), shuffle=False,
+                           num_epochs=1, cur_shard=rank, shard_count=3, workers=1)
+        # count labels as identity proxy: collect record count per worker
+        seen.append(sum(1 for _ in iter(ld)))
+    assert sum(seen) == train.num_records
+
+
+def test_infinite_repeat(silver):
+    """num_epochs=None yields more batches than one pass holds (identical-step-count
+    guarantee, reference 03_model_training_distributed.py:199-200)."""
+    _, val, _ = silver
+    one_pass = val.num_records // 4
+    ld = ShardedLoader(val, batch_size=4, image_size=(8, 8), shuffle=True,
+                       num_epochs=None, workers=2, shuffle_buffer=8)
+    batches = _take(ld, one_pass + 3)
+    assert len(batches) == one_pass + 3
+
+
+def test_shuffle_determinism_and_epoch_variation(silver):
+    train, _, _ = silver
+    def labels_of(seed, n=6):
+        ld = ShardedLoader(train, batch_size=8, image_size=(8, 8), shuffle=True,
+                           seed=seed, num_epochs=None, workers=2, shuffle_buffer=32)
+        return np.concatenate([b[1] for b in _take(ld, n)])
+
+    a, b = labels_of(3), labels_of(3)
+    c = labels_of(4)
+    assert np.array_equal(a, b)          # seeded determinism
+    assert not np.array_equal(a, c)      # seed changes order
+
+
+def test_prefetch_to_device(silver):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    train, _, _ = silver
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    ld = ShardedLoader(train, batch_size=8, image_size=(16, 16), shuffle=False,
+                       num_epochs=1, workers=2, prefetch_to=sharding)
+    imgs, lbls = _take(ld, 1)[0]
+    assert isinstance(imgs, jax.Array)
+    assert imgs.sharding == sharding
+    assert imgs.shape == (8, 16, 16, 3)
+
+
+def test_steps_per_epoch_accounting(silver):
+    """Global floor accounting (reference :350-351)."""
+    train, _, _ = silver
+    ld = ShardedLoader(train, batch_size=8, image_size=(8, 8), shard_count=2, cur_shard=0)
+    assert ld.steps_per_epoch() == train.num_records // (8 * 2)

@@ -1,0 +1,99 @@
+"""Store + data-prep contract tests (reference 01_data_prep behavior, SURVEY §3.1)."""
+
+import numpy as np
+import pytest
+
+from ddw_tpu.data.prep import (
+    build_label_index,
+    generate_synthetic_flowers,
+    label_from_path,
+    prepare_flowers,
+    scan_jpeg_tree,
+    FLOWER_CLASSES,
+)
+from ddw_tpu.data.store import Record, TableStore, read_shard
+
+
+def test_record_roundtrip(tmp_path):
+    store = TableStore(str(tmp_path))
+    recs = [Record(f"/x/{i}.jpg", bytes([i] * (i + 1)), "roses", 2) for i in range(10)]
+    tbl = store.write("t", recs, shard_size=4)
+    assert tbl.num_records == 10
+    assert len(tbl.shard_paths) == 3  # 4+4+2
+    got = list(tbl.iter_records())
+    assert [r.path for r in got] == [r.path for r in recs]
+    assert [r.content for r in got] == [r.content for r in recs]
+    assert all(r.label == "roses" and r.label_idx == 2 for r in got)
+
+
+def test_versioning_latest(tmp_path):
+    store = TableStore(str(tmp_path))
+    store.write("t", [Record("a", b"1")])
+    t2 = store.write("t", [Record("a", b"1"), Record("b", b"2")])
+    assert store.table("t").num_records == 2
+    assert store.table("t", version=1).num_records == 1
+    assert t2.manifest["version"] == 2
+
+
+def test_shard_checksum_manifest(tmp_path):
+    store = TableStore(str(tmp_path))
+    tbl = store.write("t", [Record("a", b"xyz", "daisy", 0)])
+    meta = tbl.manifest["shards"][0]
+    assert meta["num_records"] == 1 and len(meta["sha256"]) == 64
+    recs = list(read_shard(tbl.shard_paths[0]))
+    assert recs[0].content == b"xyz"
+
+
+def test_scan_deterministic_sample(flowers_dir):
+    a = scan_jpeg_tree(flowers_dir, 0.5, seed=7)
+    b = scan_jpeg_tree(flowers_dir, 0.5, seed=7)
+    full = scan_jpeg_tree(flowers_dir, 1.0)
+    assert a == b
+    assert 0 < len(a) < len(full)
+    assert len(full) == 5 * 24
+
+
+def test_label_extraction(flowers_dir):
+    paths = scan_jpeg_tree(flowers_dir, 1.0)
+    labels = {label_from_path(p) for p in paths}
+    assert labels == set(FLOWER_CLASSES)
+
+
+def test_label_index_sorted():
+    # sorted-distinct determinism (reference 01_data_prep.py:179-181)
+    idx = build_label_index(["tulips", "daisy", "roses", "daisy"])
+    assert idx == {"daisy": 0, "roses": 1, "tulips": 2}
+
+
+def test_prepare_split_and_index(flowers_dir, tmp_path):
+    store = TableStore(str(tmp_path))
+    train, val, label_to_idx = prepare_flowers(flowers_dir, store, sample_fraction=1.0,
+                                               shard_size=16)
+    n = train.num_records + val.num_records
+    assert n == 5 * 24
+    # 90/10 split
+    assert train.num_records == int(0.9 * n)
+    assert label_to_idx == {c: i for i, c in enumerate(sorted(FLOWER_CLASSES))}
+    # membership is disjoint and label_idx consistent with the sorted index
+    train_paths = {r.path for r in train.iter_records()}
+    val_paths = {r.path for r in val.iter_records()}
+    assert not (train_paths & val_paths)
+    for r in val.take(20):
+        assert r.label_idx == label_to_idx[r.label]
+    # split determinism: same seed => same membership
+    store2 = TableStore(str(tmp_path / "again"))
+    train2, _, _ = prepare_flowers(flowers_dir, store2, sample_fraction=1.0, shard_size=16)
+    assert {r.path for r in train2.iter_records()} == train_paths
+
+
+def test_synthetic_classes_distinct(tmp_path):
+    root = generate_synthetic_flowers(str(tmp_path / "f"), images_per_class=3, size=32)
+    paths = scan_jpeg_tree(root, 1.0)
+    assert len(paths) == 15
+    from ddw_tpu.data.loader import preprocess_image
+
+    with open(paths[0], "rb") as f:
+        arr = preprocess_image(f.read(), 32, 32)
+    assert arr.shape == (32, 32, 3)
+    assert arr.dtype == np.float32
+    assert arr.min() >= -1.0 and arr.max() <= 1.0
