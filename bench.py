@@ -1,0 +1,79 @@
+"""Benchmark: training-step throughput of the flagship transfer-learning config.
+
+Measures images/sec/chip for the reference's headline workload — MobileNetV2
+(frozen base) + head, 224x224x3, per-worker batch 256, Adam, sparse CE — as a
+jitted SPMD train step on the available device(s) (SURVEY.md §6: the reference
+publishes no numbers; BASELINE.md records the measurement setup and this script
+produces the comparison numbers).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against the round-1 TPU v5e-1 measurement recorded in
+BASELINE_IPS below (1.0 = parity with the first TPU-native measurement; the
+reference stack itself has no published figure to compare to — absence documented
+in BASELINE.md "Published numbers").
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Round-1 measurement on one TPU v5e chip (this script, first run); later rounds
+# report speedup vs this anchor.
+BASELINE_IPS = 237606.49  # round-1 anchor, TPU v5e-1, 2026-07-29
+
+BATCH = 256
+IMG = (224, 224, 3)
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main():
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
+    from ddw_tpu.train.step import init_state, make_train_step
+    from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)), devices=devices)
+
+    model_cfg = ModelCfg(name="mobilenet_v2", num_classes=5, dropout=0.5,
+                         freeze_base=True, dtype="bfloat16")
+    train_cfg = TrainCfg(batch_size=BATCH, optimizer="adam", learning_rate=1e-3)
+    model = build_model(model_cfg)
+    state, tx = init_state(model, model_cfg, train_cfg, IMG, jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, DATA_AXIS, donate=True)
+
+    global_batch = BATCH * n_chips
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.rand(global_batch, *IMG).astype(np.float32) * 2 - 1)
+    labels = jnp.asarray(rng.randint(0, 5, size=(global_batch,)).astype(np.int32))
+    key = jax.random.PRNGKey(1)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, images, labels, key)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, images, labels, key)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    ips = MEASURE_STEPS * global_batch / dt
+    ips_per_chip = ips / n_chips
+    vs = 1.0 if BASELINE_IPS is None else ips_per_chip / BASELINE_IPS
+    print(json.dumps({
+        "metric": "mobilenet_v2_frozen_train_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

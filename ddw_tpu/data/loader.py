@@ -36,6 +36,23 @@ import numpy as np
 from ddw_tpu.data.store import Table, read_shard
 
 
+def bounded_map(pool: ThreadPoolExecutor, fn, iterable, window: int):
+    """Ordered parallel map with a bounded in-flight window.
+
+    ``Executor.map`` eagerly submits the whole iterable (decoding an entire shard
+    set into memory); this keeps at most ``window`` items pending. Shared by the
+    training loader and the batch scorer."""
+    from collections import deque
+
+    pending: deque = deque()
+    for item in iterable:
+        pending.append(pool.submit(fn, item))
+        if len(pending) >= window:
+            yield pending.popleft().result()
+    while pending:
+        yield pending.popleft().result()
+
+
 def preprocess_image(content: bytes, height: int, width: int) -> np.ndarray:
     """JPEG bytes -> float32 [H, W, 3] in [-1, 1].
 
@@ -165,22 +182,7 @@ class ShardedLoader:
                         np.int32(rec.label_idx),
                     )
 
-                def bounded_decode_stream(window=self.workers * 4):
-                    # Bounded in-flight window: Executor.map would eagerly submit
-                    # the whole epoch (decoding the entire shard into memory);
-                    # this keeps at most `window` records pending.
-                    from collections import deque
-
-                    pending: deque = deque()
-                    it = records()
-                    for rec in it:
-                        pending.append(pool.submit(decode, rec))
-                        if len(pending) >= window:
-                            yield pending.popleft().result()
-                    while pending:
-                        yield pending.popleft().result()
-
-                stream = bounded_decode_stream()
+                stream = bounded_map(pool, decode, records(), self.workers * 4)
                 if not self.shuffle:
                     yield from stream
                 else:

@@ -103,20 +103,17 @@ def prepare_flowers(
     n_train = int(math.floor(train_fraction * len(paths)))
     train_ids = set(perm[:n_train].tolist())
 
-    def silver(ids):
-        def gen():
-            for i, rec in enumerate(bronze.iter_records()):
-                if i in ids:
-                    lbl = label_from_path(rec.path)
-                    yield Record(rec.path, rec.content, lbl, label_to_idx[lbl])
-        return gen
-
-    all_ids = set(range(len(paths)))
+    # Single pass over bronze, routing each record to its split writer (re-reading
+    # the bronze table once per destination would double prep IO at scale).
     t_meta = {"label_to_idx": label_to_idx, "split": "train", "split_seed": split_seed}
     v_meta = {"label_to_idx": label_to_idx, "split": "val", "split_seed": split_seed}
-    train_tbl = store.write(train_name, silver(train_ids)(), shard_size=shard_size, meta=t_meta)
-    val_tbl = store.write(val_name, silver(all_ids - train_ids)(), shard_size=shard_size, meta=v_meta)
-    return train_tbl, val_tbl, label_to_idx
+    with store.writer(train_name, shard_size, t_meta) as tw, \
+         store.writer(val_name, shard_size, v_meta) as vw:
+        for i, rec in enumerate(bronze.iter_records()):
+            lbl = label_from_path(rec.path)
+            silver_rec = Record(rec.path, rec.content, lbl, label_to_idx[lbl])
+            (tw if i in train_ids else vw).append(silver_rec)
+    return tw.close(), vw.close(), label_to_idx
 
 
 # ---------------------------------------------------------------------------

@@ -129,6 +129,81 @@ class Table:
         return out
 
 
+class TableWriter:
+    """Incremental single-writer handle for one new table version.
+
+    Lets callers stream records into several tables in one pass (e.g. routing a
+    bronze scan into silver_train/silver_val simultaneously) instead of
+    re-reading the source per destination. Finalize with :meth:`close` (or use as
+    a context manager); the version only becomes visible (manifest + ``latest``
+    pointer) at close."""
+
+    def __init__(self, store: "TableStore", name: str, shard_size: int = 256,
+                 meta: dict | None = None):
+        self.store = store
+        self.name = name
+        self.shard_size = shard_size
+        self.meta = meta or {}
+        tdir = store._table_dir(name)
+        os.makedirs(tdir, exist_ok=True)
+        existing = sorted(d for d in os.listdir(tdir) if d.startswith("v"))
+        self.vnum = 1 + (int(existing[-1][1:]) if existing else 0)
+        self.vdir = os.path.join(tdir, f"v{self.vnum:04d}")
+        self.shards_dir = os.path.join(self.vdir, "shards")
+        os.makedirs(self.shards_dir)
+        self._buf: list[Record] = []
+        self._shard_metas: list[dict] = []
+        self._total = 0
+        self._closed = False
+
+    def append(self, rec: Record) -> None:
+        self._buf.append(rec)
+        if len(self._buf) >= self.shard_size:
+            self._flush()
+
+    def extend(self, records: Iterable[Record]) -> None:
+        for rec in records:
+            self.append(rec)
+
+    def _flush(self) -> None:
+        if not self._buf:
+            return
+        path = os.path.join(self.shards_dir, f"shard-{len(self._shard_metas):05d}.ddws")
+        self._shard_metas.append(_write_shard(path, self._buf))
+        self._total += len(self._buf)
+        self._buf = []
+
+    def close(self) -> Table:
+        if self._closed:
+            return Table(self.vdir)
+        self._flush()
+        manifest = {
+            "name": self.name,
+            "version": self.vnum,
+            "schema": list(RecordSchema().fields),
+            "num_records": self._total,
+            "shards": self._shard_metas,
+            "created_unix": time.time(),
+            "meta": self.meta,
+        }
+        with open(os.path.join(self.vdir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+        tdir = self.store._table_dir(self.name)
+        # Atomic-enough latest pointer (single-writer discipline, rank 0 only).
+        with open(os.path.join(tdir, "latest.tmp"), "w") as f:
+            f.write(f"v{self.vnum:04d}")
+        os.replace(os.path.join(tdir, "latest.tmp"), os.path.join(tdir, "latest"))
+        self._closed = True
+        return Table(self.vdir)
+
+    def __enter__(self) -> "TableWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+
+
 class TableStore:
     """Versioned table namespace rooted at a directory (the database_name role,
     reference ``00_setup.py:3-9``)."""
@@ -140,6 +215,9 @@ class TableStore:
     def _table_dir(self, name: str) -> str:
         return os.path.join(self.root, name)
 
+    def writer(self, name: str, shard_size: int = 256, meta: dict | None = None) -> TableWriter:
+        return TableWriter(self, name, shard_size, meta)
+
     def write(
         self,
         name: str,
@@ -148,41 +226,9 @@ class TableStore:
         meta: dict | None = None,
     ) -> Table:
         """Write a new version of table ``name`` (append-only versioning)."""
-        tdir = self._table_dir(name)
-        os.makedirs(tdir, exist_ok=True)
-        existing = sorted(d for d in os.listdir(tdir) if d.startswith("v"))
-        vnum = 1 + (int(existing[-1][1:]) if existing else 0)
-        vdir = os.path.join(tdir, f"v{vnum:04d}")
-        shards_dir = os.path.join(vdir, "shards")
-        os.makedirs(shards_dir)
-
-        shard_metas, buf, total = [], [], 0
-        for rec in records:
-            buf.append(rec)
-            if len(buf) >= shard_size:
-                shard_metas.append(_write_shard(os.path.join(shards_dir, f"shard-{len(shard_metas):05d}.ddws"), buf))
-                total += len(buf)
-                buf = []
-        if buf:
-            shard_metas.append(_write_shard(os.path.join(shards_dir, f"shard-{len(shard_metas):05d}.ddws"), buf))
-            total += len(buf)
-
-        manifest = {
-            "name": name,
-            "version": vnum,
-            "schema": list(RecordSchema().fields),
-            "num_records": total,
-            "shards": shard_metas,
-            "created_unix": time.time(),
-            "meta": meta or {},
-        }
-        with open(os.path.join(vdir, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=2)
-        # Atomic-enough latest pointer (single-writer discipline, rank 0 only).
-        with open(os.path.join(tdir, "latest.tmp"), "w") as f:
-            f.write(f"v{vnum:04d}")
-        os.replace(os.path.join(tdir, "latest.tmp"), os.path.join(tdir, "latest"))
-        return Table(vdir)
+        w = TableWriter(self, name, shard_size, meta)
+        w.extend(records)
+        return w.close()
 
     def table(self, name: str, version: int | None = None) -> Table:
         """Open a table — ``spark.table(name)`` analog; latest version by default."""

@@ -1,0 +1,2 @@
+from ddw_tpu.serving.package import PackagedModel, save_packaged_model, load_packaged_model  # noqa: F401
+from ddw_tpu.serving.batch import BatchScorer  # noqa: F401

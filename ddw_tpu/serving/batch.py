@@ -1,0 +1,118 @@
+"""Distributed batch scorer — the ``mlflow.pyfunc.spark_udf`` role.
+
+The reference scores a table by wrapping the pyfunc in a Spark UDF applied to the
+``content`` column over table partitions; executors each load the model once and
+stream arrow batches through it
+(``Part 2 - Distributed Tuning & Inference/03_pyfunc_distributed_inference.py:
+466-472``; stack in SURVEY.md §3.5).
+
+TPU-native equivalent: shards of the input table are the unit of work. Across
+*hosts*, shards split by ``process_index`` (each host loads the packaged model
+once); within a host, records are decoded on the loader thread pool and scored in
+fixed-size device batches sharded across the host's **local** devices — model
+replicated, batch split (batch-inference parallelism, SURVEY.md §2d). Scoring is
+embarrassingly parallel, so no cross-host collectives are compiled in: each host's
+jitted apply spans only addressable devices (a global-mesh program would force
+every host to run the same number of batches — a deadlock when shard counts
+differ). Results are written as a predictions table (path, label=prediction) via
+the store: one table single-process, per-process table names multi-host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddw_tpu.data.loader import bounded_map, preprocess_image
+from ddw_tpu.data.store import Record, Table, TableStore, read_shard
+from ddw_tpu.runtime.mesh import DATA_AXIS, make_mesh, MeshSpec
+from ddw_tpu.serving.package import PackagedModel
+
+
+class BatchScorer:
+    """Score a table of JPEG-bytes records with a packaged model over the local
+    devices of each participating host."""
+
+    def __init__(self, model: PackagedModel | str, mesh: Mesh | None = None,
+                 batch_per_device: int = 128, workers: int = 4):
+        self.model = model if isinstance(model, PackagedModel) else PackagedModel(model)
+        if mesh is None:
+            mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)))
+        # Restrict to this process's addressable devices (see module docstring).
+        local = [d for d in np.asarray(mesh.devices).flat
+                 if d.process_index == jax.process_index()]
+        self.mesh = Mesh(np.asarray(local), (DATA_AXIS,))
+        self.n_devices = len(local)
+        self.batch = batch_per_device * self.n_devices
+        self.workers = workers
+        self._sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+
+        pm = self.model
+
+        def apply_fn(images):
+            variables = {"params": pm.params}
+            if pm.batch_stats:
+                variables["batch_stats"] = pm.batch_stats
+            return pm.model.apply(variables, images, train=False)
+
+        self._apply = jax.jit(apply_fn,
+                              in_shardings=self._sharding,
+                              out_shardings=NamedSharding(self.mesh, P()))
+
+    def _my_shards(self, table: Table) -> list[str]:
+        shards = table.shard_paths
+        n_proc = jax.process_count()
+        if len(shards) >= n_proc:
+            return shards[jax.process_index()::n_proc]
+        return shards if jax.process_index() == 0 else []
+
+    def score_table(self, table: Table, out_store: TableStore | None = None,
+                    out_name: str = "predictions") -> list[tuple[str, str]]:
+        """Returns [(path, predicted_class)] for this process's shard subset; when
+        ``out_store`` is given also writes them as a table (path, label=prediction)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        h, w = self.model.height, self.model.width
+        results: list[tuple[str, str]] = []
+
+        def decode(rec: Record):
+            return rec.path, preprocess_image(rec.content, h, w)
+
+        def records():
+            for sp in self._my_shards(table):
+                yield from read_shard(sp)
+
+        buf_paths: list[str] = []
+        buf_imgs: list[np.ndarray] = []
+
+        def flush():
+            n = len(buf_imgs)
+            imgs = np.stack(buf_imgs)
+            pad = self.batch - n
+            if pad:
+                imgs = np.concatenate([imgs, np.zeros((pad, h, w, 3), np.float32)])
+            dev = jax.device_put(imgs, self._sharding)  # local-mesh sharding
+            logits = np.asarray(self._apply(dev))[:n]
+            idx = np.argmax(logits, axis=-1)
+            results.extend((p, self.model.classes[i]) for p, i in zip(buf_paths, idx))
+            buf_paths.clear()
+            buf_imgs.clear()
+
+        with ThreadPoolExecutor(max_workers=self.workers) as pool:
+            for path, img in bounded_map(pool, decode, records(), self.workers * 4):
+                buf_paths.append(path)
+                buf_imgs.append(img)
+                if len(buf_imgs) == self.batch:
+                    flush()
+            if buf_imgs:
+                flush()
+
+        if out_store is not None:
+            name = out_name if jax.process_count() == 1 else f"{out_name}_p{jax.process_index()}"
+            out_store.write(name, (Record(path=p, content=b"", label=pred)
+                                   for p, pred in results),
+                            meta={"model_classes": self.model.classes,
+                                  "source_table": table.manifest["name"]})
+        return results

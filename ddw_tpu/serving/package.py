@@ -1,0 +1,147 @@
+"""Packaged-model format — the MLflow pyfunc role.
+
+The reference bundles preprocessing + weights + label decoding into an MLflow pyfunc
+(``Part 2 - Distributed Tuning & Inference/03_pyfunc_distributed_inference.py:
+157-234``): ``load_context`` restores image-size params and the keras model from
+artifacts (``:161-184``); ``predict`` decodes JPEG bytes, resizes, runs the model in
+sub-batches of 128, argmaxes and maps to class names (``:186-212``, batch size
+``:64,206``); ``preprocess`` coerces str->bytes for the UDF path (``:228-229``).
+
+In-tree equivalent: a self-contained directory —
+
+    package.json     model name/config, image size, sorted class list, versions
+    params.msgpack   flax params (+ batch_stats) serialized with flax.serialization
+
+:class:`PackagedModel` restores it anywhere (driver, batch-scorer worker) and
+predicts from raw JPEG bytes / file paths / pre-decoded arrays. Preprocessing is
+*shared with the training loader* (``ddw_tpu.data.loader.preprocess_image``) —
+deliberately fixing the reference's train/serve skew (PIL at serve vs tf.image at
+train, SURVEY.md §7 step 7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from ddw_tpu.data.loader import preprocess_image
+from ddw_tpu.models.registry import build_model
+from ddw_tpu.utils.config import ModelCfg
+
+_FORMAT_VERSION = 1
+_PREDICT_BATCH = 128  # reference :64
+
+
+def save_packaged_model(
+    out_dir: str,
+    model_cfg: ModelCfg,
+    classes: Sequence[str],
+    params,
+    batch_stats=None,
+    img_height: int = 224,
+    img_width: int = 224,
+    extra_meta: dict | None = None,
+) -> str:
+    """Write the packaged-model directory (the ``mlflow.pyfunc.log_model`` role,
+    reference ``:349-363``). ``classes`` must be index-ordered (label_to_idx order)."""
+    os.makedirs(out_dir, exist_ok=True)
+    meta = {
+        "format_version": _FORMAT_VERSION,
+        "model_cfg": dataclasses.asdict(model_cfg),
+        "classes": list(classes),
+        "img_height": img_height,
+        "img_width": img_width,
+        **(extra_meta or {}),
+    }
+    with open(os.path.join(out_dir, "package.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    blob = serialization.to_bytes(
+        {"params": jax.device_get(params),
+         "batch_stats": jax.device_get(batch_stats or {})})
+    with open(os.path.join(out_dir, "params.msgpack"), "wb") as f:
+        f.write(blob)
+    return out_dir
+
+
+def load_packaged_model(model_dir: str) -> "PackagedModel":
+    return PackagedModel(model_dir)
+
+
+class PackagedModel:
+    """Self-contained predictor (the ``FlowerPyFunc`` role).
+
+    ``predict`` accepts: list/array of JPEG byte strings, list of file paths, or a
+    pre-decoded float array [N, H, W, 3]; returns class-name strings (or indices
+    with ``return_indices=True``).
+    """
+
+    def __init__(self, model_dir: str):
+        with open(os.path.join(model_dir, "package.json")) as f:
+            self.meta = json.load(f)
+        if self.meta["format_version"] != _FORMAT_VERSION:
+            raise ValueError(f"unsupported package format {self.meta['format_version']}")
+        self.model_cfg = ModelCfg(**self.meta["model_cfg"])
+        self.classes: list[str] = self.meta["classes"]
+        self.height, self.width = self.meta["img_height"], self.meta["img_width"]
+        self.model = build_model(self.model_cfg)
+        with open(os.path.join(model_dir, "params.msgpack"), "rb") as f:
+            restored = serialization.msgpack_restore(f.read())
+        self.params = restored["params"]
+        self.batch_stats = restored.get("batch_stats") or {}
+        self._apply = jax.jit(self._apply_fn)
+
+    def _apply_fn(self, images):
+        variables = {"params": self.params}
+        if self.batch_stats:
+            variables["batch_stats"] = self.batch_stats
+        return self.model.apply(variables, images, train=False)
+
+    # -- input coercion (the reference's bytes-vs-str handling, :214-234) -------
+    def _decode_one(self, item) -> np.ndarray:
+        if isinstance(item, np.ndarray) and item.ndim == 3:
+            return item.astype(np.float32)
+        if isinstance(item, str):
+            if os.path.exists(item):
+                with open(item, "rb") as f:
+                    item = f.read()
+            else:
+                # stringified bytes from a text serialization boundary
+                # (reference :228-229 uses ast.literal_eval)
+                import ast
+
+                item = ast.literal_eval(item)
+        if isinstance(item, (bytes, bytearray)):
+            return preprocess_image(bytes(item), self.height, self.width)
+        raise TypeError(f"cannot decode input of type {type(item)}")
+
+    def predict_logits(self, inputs) -> np.ndarray:
+        if isinstance(inputs, np.ndarray) and inputs.ndim == 4:
+            imgs = inputs.astype(np.float32)
+        elif len(inputs) == 0:
+            return np.zeros((0, len(self.classes)), np.float32)
+        else:
+            imgs = np.stack([self._decode_one(x) for x in inputs])
+        outs = []
+        # fixed sub-batch with padding: one compiled shape regardless of N
+        for i in range(0, len(imgs), _PREDICT_BATCH):
+            chunk = imgs[i : i + _PREDICT_BATCH]
+            pad = _PREDICT_BATCH - len(chunk)
+            if pad:
+                chunk = np.concatenate([chunk, np.zeros((pad, *chunk.shape[1:]), np.float32)])
+            logits = np.asarray(self._apply(jnp.asarray(chunk)))
+            outs.append(logits[: _PREDICT_BATCH - pad])
+        return np.concatenate(outs) if outs else np.zeros((0, len(self.classes)))
+
+    def predict(self, inputs, return_indices: bool = False):
+        """argmax -> class name (reference ``:208-212``)."""
+        idx = np.argmax(self.predict_logits(inputs), axis=-1)
+        if return_indices:
+            return idx
+        return [self.classes[i] for i in idx]

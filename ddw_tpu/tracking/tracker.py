@@ -163,8 +163,17 @@ class Tracker:
         name: str = "",
         parent_run_id: str | None = None,
         tags: dict[str, str] | None = None,
+        run_id: str | None = None,
     ) -> Run:
-        run_id = uuid.uuid4().hex[:16]
+        """Create a run. Multi-host jobs MUST share one run id: the coordinator
+        creates the run and the id reaches other processes either explicitly
+        (pass ``run_id=``) or via the ``DDW_RUN_ID`` env var — the analog of the
+        reference's MLFLOW_PARENT_RUN_ID / host-token plumbing to workers
+        (``00_setup.py:15-17``, ``02_hyperopt_distributed_model.py:244-247``).
+        A fresh uuid per process would point non-coordinator Run handles at
+        directories that don't exist."""
+        if run_id is None:
+            run_id = os.environ.get("DDW_RUN_ID") or uuid.uuid4().hex[:16]
         run_dir = os.path.join(self.exp_dir, run_id)
         if _is_writer():
             os.makedirs(run_dir, exist_ok=True)

@@ -62,7 +62,9 @@ class ReduceLROnPlateau:
             self._wait = 0
             return lr
         self._wait += 1
-        if self._wait > self.patience:
+        # Keras triggers at wait >= patience (the semantics the reference's
+        # ReduceLROnPlateau(patience=10) run follows).
+        if self._wait >= self.patience:
             self._wait = 0
             return max(self.min_lr, lr * self.factor)
         return lr
@@ -82,4 +84,4 @@ class EarlyStopping:
             self._wait = 0
             return False
         self._wait += 1
-        return self._wait > self.patience
+        return self._wait >= self.patience  # Keras: stop at wait >= patience

@@ -163,10 +163,13 @@ class Trainer:
         val_loss = val_acc = float("nan")
         epochs_run = 0
         tracing = False
-        if start_epoch >= cfg.warmup_epochs:
+        resumed = ckpt is not None and resume and start_epoch > 0
+        if start_epoch >= cfg.warmup_epochs and not resumed:
             # Past warmup (incl. warmup_epochs=0): start at the scaled target once;
-            # afterwards only the plateau callback may change the LR. (Plateau
-            # state is not checkpointed — a resume restarts its patience counter.)
+            # afterwards only the plateau callback may change the LR. On resume the
+            # restored opt_state already carries the LR training left off at
+            # (including plateau reductions) — don't clobber it. (The plateau
+            # patience counter itself is not checkpointed and restarts.)
             state = set_lr(state, warmup.lr_for_epoch(cfg.warmup_epochs))
         for epoch in range(start_epoch, cfg.epochs):
             if epoch < cfg.warmup_epochs:

@@ -1,0 +1,2 @@
+from ddw_tpu.tune.space import uniform, loguniform, quniform, choice, sample_space  # noqa: F401
+from ddw_tpu.tune.tpe import fmin, Trials, STATUS_OK, STATUS_FAIL  # noqa: F401

@@ -1,0 +1,96 @@
+"""Search-space primitives — the hyperopt ``hp.*`` role.
+
+The reference's spaces (SURVEY.md §6):
+``{'optimizer': hp.choice(['Adadelta','Adam']), 'learning_rate':
+hp.loguniform(-5, 0), 'dropout': hp.uniform(0.1, 0.9)}``
+(``Part 2 - Distributed Tuning & Inference/01_hyperopt_single_machine_model.py:
+194-198``) and ``batch_size: hp.choice([32, 64, 128])``
+(``02_hyperopt_distributed_model.py:322-326``).
+
+Each primitive describes one dimension; internally every dimension maps to a
+continuous *unit space* where the TPE Parzen estimators operate:
+uniform -> affine, loguniform -> log-space, quniform -> rounded affine,
+choice -> categorical (handled discretely).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Dim:
+    """One search dimension. ``kind`` in {uniform, loguniform, quniform, choice}."""
+
+    label: str
+    kind: str
+    low: float = 0.0
+    high: float = 1.0
+    q: float = 1.0
+    options: tuple = ()
+
+    # -- transformed (internal) space: continuous dims become unbounded-ish reals --
+    def to_internal(self, value: Any) -> float:
+        if self.kind == "choice":
+            return float(self.options.index(value))
+        if self.kind == "loguniform":
+            return math.log(value)
+        return float(value)
+
+    def from_internal(self, x: float) -> Any:
+        if self.kind == "choice":
+            return self.options[int(np.clip(round(x), 0, len(self.options) - 1))]
+        if self.kind == "loguniform":
+            x = math.exp(x)
+        if self.kind == "quniform":
+            x = round(x / self.q) * self.q
+        return float(np.clip(x, *self.bounds_natural()))
+
+    def bounds_natural(self) -> tuple[float, float]:
+        if self.kind == "loguniform":
+            return (math.exp(self.low), math.exp(self.high))
+        if self.kind == "choice":
+            return (0, len(self.options) - 1)
+        return (self.low, self.high)
+
+    def bounds_internal(self) -> tuple[float, float]:
+        """Bounds in the internal space (log-space for loguniform)."""
+        if self.kind == "choice":
+            return (0.0, float(len(self.options) - 1))
+        return (self.low, self.high)
+
+    def sample(self, rng: np.random.RandomState) -> Any:
+        if self.kind == "choice":
+            return self.options[rng.randint(len(self.options))]
+        x = rng.uniform(self.low, self.high)
+        if self.kind == "loguniform":
+            return math.exp(x)
+        if self.kind == "quniform":
+            return round(x / self.q) * self.q
+        return x
+
+
+def uniform(label: str, low: float, high: float) -> Dim:
+    return Dim(label, "uniform", low=low, high=high)
+
+
+def loguniform(label: str, low: float, high: float) -> Dim:
+    """Bounds are in log space, hyperopt-style: value in [e^low, e^high]."""
+    return Dim(label, "loguniform", low=low, high=high)
+
+
+def quniform(label: str, low: float, high: float, q: float) -> Dim:
+    return Dim(label, "quniform", low=low, high=high, q=q)
+
+
+def choice(label: str, options: Sequence[Any]) -> Dim:
+    return Dim(label, "choice", options=tuple(options))
+
+
+def sample_space(space: dict[str, Dim], rng: np.random.RandomState) -> dict[str, Any]:
+    """One random draw from every dimension (startup / random-search mode)."""
+    return {name: dim.sample(rng) for name, dim in space.items()}

@@ -1,0 +1,35 @@
+"""Contract 1 — data prep: raw JPEG tree -> bronze -> silver train/val tables.
+
+Mirrors reference ``Part 1 - Distributed Training/01_data_prep.py``: recursive scan
+with seeded sample (``:61-66``), label from path (``:125-130``), seeded 90/10 split
+(``:162``), sorted-distinct label index (``:179-181``), silver tables (``:213-222``).
+
+    PYTHONPATH=. python examples/01_data_prep.py --quick
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from examples.common import parse_args, setup
+from ddw_tpu.data.prep import prepare_flowers
+
+
+def main():
+    args = parse_args(__doc__)
+    ws = setup(args)
+    data = ws["cfgs"]["data"]
+    train_tbl, val_tbl, label_to_idx = prepare_flowers(
+        data.source_dir, ws["store"],
+        sample_fraction=data.sample_fraction,
+        train_fraction=data.train_fraction,
+        split_seed=data.split_seed,
+        shard_size=data.shard_size,
+    )
+    print(f"bronze+silver written under {data.table_root}")
+    print(f"label_to_idx: {label_to_idx}")
+    print(f"silver_train: {train_tbl.num_records} records in {len(train_tbl.shard_paths)} shards")
+    print(f"silver_val:   {val_tbl.num_records} records in {len(val_tbl.shard_paths)} shards")
+
+
+if __name__ == "__main__":
+    main()

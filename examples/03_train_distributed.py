@@ -1,0 +1,47 @@
+"""Contract 3 — distributed data-parallel training over the device mesh.
+
+Mirrors reference ``Part 1 - Distributed Training/03_model_training_distributed.py``:
+the ``train_and_evaluate_hvd`` contract (SURVEY.md §2b) — LR x world + 5-epoch
+warmup, gradient allreduce in-step, shard-by-rank loading with infinite repeat,
+floor-divided step accounting, rank-0 logging, and the np=-1-then-distributed
+ladder (``:391-417``): ``--smoke`` first runs the same code path on ONE device.
+
+    PYTHONPATH=. python examples/03_train_distributed.py --quick            # all devices
+    PYTHONPATH=. python examples/03_train_distributed.py --quick --smoke    # np=-1 analog
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+from examples.common import parse_args, require_tables, setup
+from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
+from ddw_tpu.train.trainer import Trainer
+
+
+def main():
+    args = parse_args(__doc__, extra=lambda ap: ap.add_argument(
+        "--smoke", action="store_true", help="np=-1 analog: same path, one device"))
+    ws = setup(args)
+    cfgs = ws["cfgs"]
+    train_tbl, val_tbl = require_tables(ws["store"])
+
+    devices = jax.devices()[:1] if args.smoke else jax.devices()
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)), devices=devices)
+    world = mesh.shape[DATA_AXIS]
+    print(f"mesh: {dict(mesh.shape)} ({'smoke' if args.smoke else 'distributed'})")
+
+    run = ws["tracker"].start_run("distributed" if not args.smoke else "distributed_smoke")
+    trainer = Trainer(cfgs["data"], cfgs["model"], cfgs["train"], mesh=mesh, run=run)
+    res = trainer.fit(train_tbl, val_tbl)
+    run.end()
+    for row in res.history:
+        print({k: round(v, 4) if isinstance(v, float) else v for k, v in row.items()})
+    print(f"world={world} global_batch={cfgs['train'].batch_size * world} "
+          f"val_loss={res.val_loss:.4f} val_accuracy={res.val_accuracy:.4f} "
+          f"images/sec={res.history[-1]['images_per_sec']:.0f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Contract 4a — parallel hyperparameter tuning over single-node trials.
+
+Mirrors reference ``Part 2 - Distributed Tuning & Inference/
+01_hyperopt_single_machine_model.py``: TPE over {optimizer, loguniform LR,
+uniform dropout} (``:194-198``), parallel trials (SparkTrials(parallelism=4) role,
+``:226-238``), each trial a child run under one parent; best child found by metric
+query, registered and transitioned to Production (``:253-293``).
+
+Trials partition the visible devices (one device per concurrent trial) — the
+explicit device-ownership model SURVEY §7 hard-part 4 calls for.
+
+    PYTHONPATH=. python examples/04_hyperopt_parallel.py --quick tune.max_evals=6
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import copy
+import threading
+
+import jax
+
+from examples.common import parse_args, require_tables, setup
+from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
+from ddw_tpu.serving.package import save_packaged_model
+from ddw_tpu.train.trainer import Trainer
+from ddw_tpu.tune import STATUS_OK, Trials, choice, fmin, loguniform, uniform
+
+
+def main():
+    args = parse_args(__doc__)
+    ws = setup(args)
+    cfgs = ws["cfgs"]
+    tune_cfg = cfgs["tune"]
+    train_tbl, val_tbl = require_tables(ws["store"])
+
+    # hyperopt space of the reference (:194-198)
+    space = {
+        "optimizer": choice("optimizer", ["adadelta", "adam"]),
+        "learning_rate": loguniform("learning_rate", -5, 0),
+        "dropout": uniform("dropout", 0.1, 0.9),
+    }
+
+    devices = jax.devices()
+    parallelism = min(tune_cfg.parallelism, len(devices))
+    # Device-ownership: trial k runs on devices[k % parallelism] only.
+    slot_lock = threading.Lock()
+    free_slots = list(range(parallelism))
+
+    parent = ws["tracker"].start_run("hyperopt_parallel")
+
+    def objective(params):
+        with slot_lock:
+            slot = free_slots.pop()
+        try:
+            model_cfg = copy.deepcopy(cfgs["model"])
+            train_cfg = copy.deepcopy(cfgs["train"])
+            model_cfg.dropout = float(params["dropout"])
+            train_cfg.optimizer = params["optimizer"]
+            train_cfg.learning_rate = float(params["learning_rate"])
+            train_cfg.scale_lr_by_world = False
+            train_cfg.checkpoint_dir = ""
+            mesh = make_mesh(MeshSpec(((DATA_AXIS, 1),)), devices=[devices[slot]])
+            run = ws["tracker"].start_run("trial", parent_run_id=parent.run_id)
+            run.log_params(params)
+            trainer = Trainer(cfgs["data"], model_cfg, train_cfg, mesh=mesh, run=run)
+            res = trainer.fit(train_tbl, val_tbl)
+            run.log_metric("final_val_accuracy", res.val_accuracy)
+            run.end()
+            # the reference minimizes -accuracy (:178-181)
+            return {"loss": -res.val_accuracy, "status": STATUS_OK,
+                    "val_accuracy": res.val_accuracy, "run_id": run.run_id,
+                    "state": res.state}
+        finally:
+            with slot_lock:
+                free_slots.append(slot)
+
+    trials = Trials()
+    best = fmin(objective, space, max_evals=tune_cfg.max_evals, algo=tune_cfg.algo,
+                parallelism=parallelism, trials=trials, seed=tune_cfg.seed,
+                n_startup_trials=tune_cfg.n_startup_trials, gamma=tune_cfg.gamma)
+    parent.log_params({f"best.{k}": v for k, v in best.items()})
+    parent.end()
+    print(f"best params: {best}")
+
+    # best-child query by metric (reference :253-262)
+    children = ws["tracker"].search_runs(parent_run_id=parent.run_id,
+                                         order_by_metric="final_val_accuracy")
+    best_run = children[0]
+    print(f"best child run {best_run.run_id}: {best_run.final_metrics()['final_val_accuracy']:.4f}")
+
+    # registry flow (reference :279-293)
+    best_trial = trials.best
+    label_to_idx = train_tbl.meta["label_to_idx"]
+    classes = [c for c, _ in sorted(label_to_idx.items(), key=lambda kv: kv[1])]
+    pkg_dir = os.path.join(ws["workdir"], "best_model_pkg")
+    model_cfg = copy.deepcopy(cfgs["model"])
+    model_cfg.dropout = float(best["dropout"])
+    save_packaged_model(pkg_dir, model_cfg, classes, best_trial["state"].params,
+                        best_trial["state"].batch_stats,
+                        img_height=cfgs["data"].img_height,
+                        img_width=cfgs["data"].img_width)
+    v = ws["registry"].register("flowers_classifier", pkg_dir,
+                                run_id=best_trial["run_id"],
+                                metrics={"val_accuracy": best_trial["val_accuracy"]})
+    ws["registry"].transition("flowers_classifier", v, "Production")
+    print(f"registered flowers_classifier v{v} -> Production")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Contract 4b — sequential TPE where each trial is a whole-mesh distributed job.
+
+Mirrors reference ``Part 2 - Distributed Tuning & Inference/
+02_hyperopt_distributed_model.py``: hyperparameters as train-fn args (``:161``),
+space lr x dropout x batch_size{32,64,128} (``:322-326``), **sequential** trials
+because each trial owns the full device mesh (the documented SparkTrials
+incompatibility, ``:341-344``), per-trial rank-0 checkpoints under a shared root
+(``:65-67,206-211``), nested child runs under one parent (``:240-260``).
+
+    PYTHONPATH=. python examples/05_hyperopt_distributed.py --quick tune.max_evals=4
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import copy
+
+import jax
+
+from examples.common import parse_args, require_tables, setup
+from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
+from ddw_tpu.train.trainer import Trainer
+from ddw_tpu.tune import STATUS_OK, Trials, choice, fmin, loguniform, uniform
+
+
+def main():
+    args = parse_args(__doc__)
+    ws = setup(args)
+    cfgs = ws["cfgs"]
+    tune_cfg = cfgs["tune"]
+    train_tbl, val_tbl = require_tables(ws["store"])
+
+    space = {
+        "learning_rate": loguniform("learning_rate", -5, 0),
+        "dropout": uniform("dropout", 0.1, 0.9),
+        "batch_size": choice("batch_size", [32, 64, 128] if not args.quick else [4, 8, 16]),
+    }
+
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)))  # every trial owns the full mesh
+    ckpt_root = os.path.join(ws["workdir"], "tune_ckpts")
+    parent = ws["tracker"].start_run("hyperopt_distributed")
+    trial_no = {"n": 0}
+
+    def train_and_evaluate(params):
+        """The train_and_evaluate_hvd(lr, dropout, batch_size, checkpoint_dir)
+        analog (reference :161-262): whole-mesh DP training per trial."""
+        trial_no["n"] += 1
+        model_cfg = copy.deepcopy(cfgs["model"])
+        train_cfg = copy.deepcopy(cfgs["train"])
+        model_cfg.dropout = float(params["dropout"])
+        train_cfg.learning_rate = float(params["learning_rate"])
+        train_cfg.batch_size = int(params["batch_size"])
+        train_cfg.checkpoint_dir = os.path.join(ckpt_root, f"trial_{trial_no['n']:03d}")
+        run = ws["tracker"].start_run(f"trial_{trial_no['n']:03d}",
+                                      parent_run_id=parent.run_id)
+        run.log_params(params)
+        trainer = Trainer(cfgs["data"], model_cfg, train_cfg, mesh=mesh, run=run)
+        res = trainer.fit(train_tbl, val_tbl)
+        run.log_metric("final_val_accuracy", res.val_accuracy)
+        run.end()
+        return {"loss": -res.val_accuracy, "status": STATUS_OK,
+                "val_accuracy": res.val_accuracy}
+
+    trials = Trials()
+    best = fmin(train_and_evaluate, space, max_evals=tune_cfg.max_evals,
+                algo=tune_cfg.algo, parallelism=1,  # sequential: trials own the mesh
+                trials=trials, seed=tune_cfg.seed,
+                n_startup_trials=min(tune_cfg.n_startup_trials, tune_cfg.max_evals // 2 or 1))
+    parent.log_params({f"best.{k}": v for k, v in best.items()})
+    parent.end()
+    print(f"best params: {best}")
+    print(f"best val_accuracy: {trials.best['val_accuracy']:.4f}")
+    print(f"per-trial checkpoints under {ckpt_root}")
+
+
+if __name__ == "__main__":
+    main()

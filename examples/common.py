@@ -1,0 +1,76 @@
+"""Shared example-script plumbing — the ``00_setup.py`` role.
+
+The reference's setup notebook derives a per-user workspace and credentials
+(``Part 1 - Distributed Training/00_setup.py:3-17``). Here: a single ``--workdir``
+tree holds tables, runs, registry, checkpoints; ``--quick`` bootstraps the
+zero-egress synthetic flowers dataset; ``section.key=value`` overrides come last.
+
+Every example accepts:
+    --workdir DIR     (default /tmp/ddw_tpu_workshop)
+    --source DIR      raw JPEG class-dir tree (tf_flowers layout)
+    --quick           synthetic data + SmallCNN + small images (CPU-friendly)
+    overrides         e.g. train.batch_size=64 model.name=mobilenet_v2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ddw_tpu.data.prep import generate_synthetic_flowers
+from ddw_tpu.data.store import TableStore
+from ddw_tpu.tracking.registry import ModelRegistry
+from ddw_tpu.tracking.tracker import Tracker
+from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg, TuneCfg, apply_overrides
+
+
+def parse_args(description: str, extra=None):
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("--workdir", default="/tmp/ddw_tpu_workshop")
+    ap.add_argument("--source", default="", help="raw JPEG class-dir tree")
+    ap.add_argument("--quick", action="store_true",
+                    help="synthetic dataset + SmallCNN, small images")
+    ap.add_argument("overrides", nargs="*", help="section.key=value config overrides")
+    if extra:
+        extra(ap)
+    return ap.parse_args()
+
+
+def setup(args) -> dict:
+    """Build the config tree + workspace handles from CLI args."""
+    cfgs = {"data": DataCfg(), "model": ModelCfg(), "train": TrainCfg(), "tune": TuneCfg()}
+    if args.quick:
+        cfgs["data"].img_height = cfgs["data"].img_width = 32
+        cfgs["data"].sample_fraction = 1.0
+        cfgs["data"].shard_size = 32
+        cfgs["model"].name = "small_cnn"
+        cfgs["model"].dtype = "float32"
+        cfgs["train"].batch_size = 8
+        cfgs["train"].warmup_epochs = 0
+    apply_overrides(cfgs, args.overrides)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    source = args.source
+    if not source:
+        source = os.path.join(args.workdir, "raw_flowers")
+        if not os.path.isdir(source):
+            if not args.quick:
+                raise SystemExit("--source required (or pass --quick for synthetic data)")
+            print(f"[setup] generating synthetic flowers at {source}")
+            generate_synthetic_flowers(source, images_per_class=40, size=48)
+    cfgs["data"].source_dir = source
+    cfgs["data"].table_root = os.path.join(args.workdir, "tables")
+
+    return {
+        "cfgs": cfgs,
+        "store": TableStore(cfgs["data"].table_root),
+        "tracker": Tracker(os.path.join(args.workdir, "runs"), "workshop"),
+        "registry": ModelRegistry(os.path.join(args.workdir, "registry")),
+        "workdir": args.workdir,
+    }
+
+
+def require_tables(store: TableStore):
+    if not (store.exists("silver_train") and store.exists("silver_val")):
+        raise SystemExit("silver tables missing — run examples/01_data_prep.py first")
+    return store.table("silver_train"), store.table("silver_val")

@@ -1,0 +1,129 @@
+"""Serving tests: packaged-model round trip, input coercion (bytes/path/str/array),
+train/serve consistency, distributed batch scorer, registry integration."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ddw_tpu.data.loader import ShardedLoader
+from ddw_tpu.models.registry import build_model
+from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+from ddw_tpu.serving import BatchScorer, PackagedModel, save_packaged_model
+from ddw_tpu.tracking.registry import ModelRegistry
+from ddw_tpu.train.step import init_state
+from ddw_tpu.train.trainer import Trainer
+from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+CLASSES = ["daisy", "dandelion", "roses", "sunflowers", "tulips"]
+
+
+@pytest.fixture(scope="module")
+def trained_package(tmp_path_factory, silver):
+    """Train SmallCNN briefly, package it. Shared across serving tests."""
+    train_tbl, val_tbl, label_to_idx = silver
+    data = DataCfg(img_height=32, img_width=32)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.1, dtype="float32")
+    train = TrainCfg(batch_size=8, epochs=3, warmup_epochs=0, learning_rate=2e-3)
+    mesh = make_mesh(MeshSpec((("data", 8),)))
+    tr = Trainer(data, model, train, mesh=mesh)
+    res = tr.fit(train_tbl, val_tbl)
+    out = str(tmp_path_factory.mktemp("pkg") / "model")
+    classes = [c for c, _ in sorted(label_to_idx.items(), key=lambda kv: kv[1])]
+    save_packaged_model(out, model, classes, res.state.params,
+                        res.state.batch_stats, img_height=32, img_width=32)
+    return out, res.val_accuracy
+
+
+def test_package_roundtrip_and_predict_bytes(trained_package, silver):
+    out, val_acc = trained_package
+    pm = PackagedModel(out)
+    assert pm.classes == sorted(CLASSES)
+    _, val_tbl, _ = silver
+    recs = val_tbl.take(10)
+    preds = pm.predict([r.content for r in recs])
+    assert len(preds) == 10
+    assert all(p in CLASSES for p in preds)
+    # trained model should beat chance on val records
+    acc = np.mean([p == r.label for p, r in zip(preds, recs)])
+    assert acc > 0.2, (acc, val_acc)
+
+
+def test_predict_input_coercion(trained_package, silver, flowers_dir):
+    out, _ = trained_package
+    pm = PackagedModel(out)
+    _, val_tbl, _ = silver
+    rec = val_tbl.take(1)[0]
+    by_bytes = pm.predict([rec.content])
+    by_path = pm.predict([rec.path])            # file path input
+    by_str = pm.predict([str(rec.content)])     # stringified bytes (UDF boundary)
+    arr = pm.predict(np.stack([np.zeros((32, 32, 3), np.float32)]))  # decoded array
+    assert by_bytes == by_path == by_str
+    assert len(arr) == 1
+
+
+def test_predict_batch_padding(trained_package, silver):
+    """N not divisible by the 128 sub-batch: padding must not leak into results."""
+    out, _ = trained_package
+    pm = PackagedModel(out)
+    _, val_tbl, _ = silver
+    contents = [r.content for r in val_tbl.take(5)]
+    p5 = pm.predict(contents)
+    p1 = [pm.predict([c])[0] for c in contents]
+    assert p5 == p1
+    assert pm.predict([]) == []
+
+
+def test_train_serve_preprocessing_shared(trained_package, silver):
+    """The packaged model and the training loader must produce identical tensors
+    for the same record (the skew the reference had; SURVEY §7 step 7)."""
+    out, _ = trained_package
+    pm = PackagedModel(out)
+    _, val_tbl, _ = silver
+    rec = val_tbl.take(1)[0]
+    from ddw_tpu.data.loader import preprocess_image
+
+    train_side = preprocess_image(rec.content, 32, 32)
+    serve_side = pm._decode_one(rec.content)
+    np.testing.assert_array_equal(train_side, serve_side)
+
+
+def test_batch_scorer_distributed(trained_package, silver, tmp_path):
+    """Sharded batch scoring over the 8-device mesh scores every record exactly
+    once and matches single-process predictions."""
+    out, _ = trained_package
+    _, val_tbl, _ = silver
+    mesh = make_mesh(MeshSpec((("data", 8),)))
+    scorer = BatchScorer(out, mesh=mesh, batch_per_device=4)
+    from ddw_tpu.data.store import TableStore
+
+    store = TableStore(str(tmp_path / "preds"))
+    rows = scorer.score_table(val_tbl, out_store=store, out_name="predictions")
+    assert len(rows) == val_tbl.num_records
+    assert {p for p, _ in rows} == {r.path for r in val_tbl.iter_records()}
+    # written predictions table round-trips
+    ptbl = store.table("predictions")
+    assert ptbl.num_records == val_tbl.num_records
+    # consistency with the in-process pyfunc path
+    pm = PackagedModel(out)
+    recs = val_tbl.take(6)
+    direct = pm.predict([r.content for r in recs])
+    by_path = dict(rows)
+    assert [by_path[r.path] for r in recs] == direct
+
+
+def test_registry_stage_flow(trained_package, tmp_path):
+    """register -> Production -> load-by-stage; archiving previous Production
+    (reference 01_hyperopt_single_machine_model.py:282-293)."""
+    out, _ = trained_package
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v1 = reg.register("flowers", out, run_id="r1", metrics={"val_accuracy": 0.5})
+    v2 = reg.register("flowers", out, run_id="r2", metrics={"val_accuracy": 0.6})
+    reg.transition("flowers", v1, "Production")
+    reg.transition("flowers", v2, "Production")
+    metas = {m["version"]: m for m in reg.list_versions("flowers")}
+    assert metas[v1]["stage"] == "Archived"
+    assert metas[v2]["stage"] == "Production"
+    path = reg.model_path("flowers", stage="production")
+    pm = PackagedModel(path)
+    assert pm.classes == sorted(CLASSES)

@@ -1,0 +1,120 @@
+"""TPE tuner tests: space semantics, determinism, TPE > random on a known function
+(SURVEY §7 hard-part 5), failure tolerance, parallel executor (SparkTrials role)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from ddw_tpu.tune import STATUS_OK, Trials, choice, fmin, loguniform, quniform, uniform
+from ddw_tpu.tune.space import sample_space
+
+
+def test_space_bounds_and_kinds():
+    rng = np.random.RandomState(0)
+    space = {
+        "lr": loguniform("lr", -5, 0),
+        "dropout": uniform("dropout", 0.1, 0.9),
+        "bs": choice("bs", [32, 64, 128]),
+        "layers": quniform("layers", 1, 8, 1),
+    }
+    for _ in range(200):
+        s = sample_space(space, rng)
+        assert math.exp(-5) <= s["lr"] <= 1.0
+        assert 0.1 <= s["dropout"] <= 0.9
+        assert s["bs"] in (32, 64, 128)
+        assert s["layers"] == round(s["layers"]) and 1 <= s["layers"] <= 8
+
+
+def test_fmin_deterministic_with_seed():
+    def obj(p):
+        return (p["x"] - 0.3) ** 2
+
+    space = {"x": uniform("x", 0, 1)}
+    t1, t2 = Trials(), Trials()
+    b1 = fmin(obj, space, max_evals=15, trials=t1, seed=7)
+    b2 = fmin(obj, space, max_evals=15, trials=t2, seed=7)
+    assert b1 == b2
+    assert [t["loss"] for t in t1.results] == [t["loss"] for t in t2.results]
+
+
+def _hard_obj(p):
+    # narrow 2-D basin + categorical trap: best at x≈0.15, y≈e^-3, cat='b'
+    pen = {"a": 0.3, "b": 0.0, "c": 0.5}[p["cat"]]
+    return (p["x"] - 0.15) ** 2 * 8 + (math.log(p["y"]) + 3.0) ** 2 * 0.4 + pen
+
+
+def test_tpe_beats_random():
+    """Median best-loss over seeds: TPE must beat pure random at equal budget."""
+    space = {"x": uniform("x", 0, 1), "y": loguniform("y", -5, 0),
+             "cat": choice("cat", ["a", "b", "c"])}
+
+    def best_loss(algo, seed):
+        t = Trials()
+        fmin(_hard_obj, space, max_evals=40, algo=algo, trials=t, seed=seed,
+             n_startup_trials=10)
+        return t.best["loss"]
+
+    tpe = np.median([best_loss("tpe", s) for s in range(5)])
+    rnd = np.median([best_loss("random", s) for s in range(5)])
+    assert tpe < rnd, (tpe, rnd)
+
+
+def test_failed_trials_tolerated():
+    calls = {"n": 0}
+
+    def obj(p):
+        calls["n"] += 1
+        if calls["n"] % 3 == 0:
+            raise RuntimeError("boom")
+        return p["x"] ** 2
+
+    t = Trials()
+    best = fmin(obj, {"x": uniform("x", -1, 1)}, max_evals=12, trials=t, seed=0)
+    assert len(t.results) == 12
+    assert sum(1 for r in t.results if r["status"] == "fail") == 4
+    assert "x" in best
+
+
+def test_all_failed_raises():
+    def obj(p):
+        raise ValueError("nope")
+
+    with pytest.raises(RuntimeError, match="all .* trials failed"):
+        fmin(obj, {"x": uniform("x", 0, 1)}, max_evals=3, seed=0)
+
+
+def test_parallel_executor_runs_all(silver):
+    """parallelism=4 thread pool completes every trial and tracks concurrency."""
+    import threading
+
+    active, peak = [0], [0]
+    lock = threading.Lock()
+
+    def obj(p):
+        import time
+
+        with lock:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        time.sleep(0.05)
+        with lock:
+            active[0] -= 1
+        return (p["x"] - 0.5) ** 2
+
+    t = Trials()
+    best = fmin(obj, {"x": uniform("x", 0, 1)}, max_evals=16, parallelism=4,
+                trials=t, seed=1)
+    assert len(t.results) == 16
+    assert peak[0] > 1  # genuinely concurrent
+    assert 0 <= best["x"] <= 1
+
+
+def test_objective_dict_contract():
+    """hyperopt-style {'loss':..., 'status': STATUS_OK, extra...} is preserved."""
+    def obj(p):
+        return {"loss": p["x"], "status": STATUS_OK, "val_accuracy": 1 - p["x"]}
+
+    t = Trials()
+    fmin(obj, {"x": uniform("x", 0, 1)}, max_evals=6, trials=t, seed=0)
+    assert all("val_accuracy" in r for r in t.results)
