@@ -33,7 +33,7 @@ from typing import Iterator
 
 import numpy as np
 
-from ddw_tpu.data.store import Table, read_shard
+from ddw_tpu.data.store import Table, read_shard_contents
 
 
 def bounded_map(pool: ThreadPoolExecutor, fn, iterable, window: int):
@@ -169,17 +169,18 @@ class ShardedLoader:
                 def records():
                     for sp in shards:
                         if self._record_stride is None:
-                            yield from read_shard(sp)
+                            yield from read_shard_contents(sp)
                         else:
                             r, k = self._record_stride
-                            for i, rec in enumerate(read_shard(sp)):
+                            for i, entry in enumerate(read_shard_contents(sp)):
                                 if i % k == r:
-                                    yield rec
+                                    yield entry
 
-                def decode(rec):
+                def decode(entry):
+                    content, label_idx = entry
                     return (
-                        preprocess_image(rec.content, self.height, self.width),
-                        np.int32(rec.label_idx),
+                        preprocess_image(content, self.height, self.width),
+                        np.int32(label_idx),
                     )
 
                 stream = bounded_map(pool, decode, records(), self.workers * 4)

@@ -75,9 +75,32 @@ def _write_shard(path: str, records: list[Record]) -> dict:
     }
 
 
+def _native_reader():
+    """Resolve the native codec module, or None (unavailable / disabled via
+    ``DDW_NATIVE_CODEC=0``). Only resolution failures select the Python
+    fallback; parse errors from an available native codec propagate."""
+    if os.environ.get("DDW_NATIVE_CODEC", "1") == "0":
+        return None
+    try:
+        from ddw_tpu.native import codec as native_codec
+
+        return native_codec if native_codec.native_available() else None
+    except Exception:
+        return None
+
+
 def read_shard(path: str) -> Iterator[Record]:
-    """Stream records from one shard file (pure-Python codec; see ddw_tpu/native for
-    the C++ fast path used by the loader when built)."""
+    """Stream records from one shard file.
+
+    Prefers the C++ codec (``ddw_tpu/native``, one index pass over the buffer)
+    when it builds/loads; falls back to the pure-Python framing. Disable with
+    ``DDW_NATIVE_CODEC=0``."""
+    if _native_reader() is not None:
+        # Errors from an available native parser propagate: swallowing them
+        # would double-read corrupt shards through the Python path and mask
+        # codec divergence.
+        yield from _native_reader().read_shard_native(path)
+        return
     with open(path, "rb") as f:
         head = f.read(12)
         if head[:4] != _MAGIC:
@@ -94,6 +117,30 @@ def read_shard(path: str) -> Iterator[Record]:
             label = f.read(llen).decode()
             (idx,) = struct.unpack("<i", f.read(4))
             yield Record(p, content, label, idx)
+
+
+def read_shard_contents(path: str) -> Iterator[tuple[bytes, int]]:
+    """Loader hot path: yield (content, label_idx) only — no path/label string
+    decoding, no Record objects. Native C++ index pass when available."""
+    if _native_reader() is not None:
+        yield from _native_reader().read_shard_contents_native(path)
+        return
+    with open(path, "rb") as f:
+        head = f.read(12)
+        if head[:4] != _MAGIC:
+            raise ValueError(f"{path}: bad magic {head[:4]!r}")
+        fmt, n = struct.unpack("<II", head[4:])
+        if fmt != _FORMAT_VERSION:
+            raise ValueError(f"{path}: unsupported format version {fmt}")
+        for _ in range(n):
+            (plen,) = struct.unpack("<I", f.read(4))
+            f.seek(plen, 1)
+            (clen,) = struct.unpack("<I", f.read(4))
+            content = f.read(clen)
+            (llen,) = struct.unpack("<I", f.read(4))
+            f.seek(llen, 1)
+            (idx,) = struct.unpack("<i", f.read(4))
+            yield content, idx
 
 
 class Table:

@@ -51,7 +51,11 @@ class ViT(nn.Module):
     patch: int = 16
     hidden: int = 192
     depth: int = 6
-    num_heads: int = 3
+    # 4 heads (not 3): TP shards heads over the `model` axis, so the count must
+    # divide small axis sizes. Changing this default changes q/k/v param shapes
+    # — checkpoints/packages saved with another head count need num_heads set
+    # explicitly at restore.
+    num_heads: int = 4
     mlp_dim: int = 768
     dropout: float = 0.1
     freeze_base: bool = False

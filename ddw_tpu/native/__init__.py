@@ -1,0 +1,1 @@
+from ddw_tpu.native.codec import native_available, read_shard_native  # noqa: F401

@@ -1,0 +1,1 @@
+from ddw_tpu.ops.flash_attention import flash_attention, mha_reference  # noqa: F401

@@ -1,0 +1,184 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The reference stack has no attention anywhere (SURVEY.md §5 "Long-context ...
+Absent") — this op exists because long-context support is first-class in this
+framework: it is the local-block compute of :mod:`ddw_tpu.parallel.ring_attention`
+(sequence parallelism) and the attention path of the ViT model family.
+
+Design (Dao et al. flash attention, TPU-first):
+- grid over (batch*heads, Q blocks); K/V streamed block-by-block inside a
+  ``fori_loop`` with running max / normalizer / accumulator in VMEM scratch —
+  O(S) memory instead of the O(S^2) score matrix, scores never leave VMEM;
+- block sizes default to 128 (MXU/VPU native tile), f32 accumulation with inputs
+  in bf16 or f32;
+- causal masking by global position (supports the ring-attention case where this
+  rank's K block sits at a rotated global offset);
+- backward pass via ``jax.custom_vjp`` recompute from the O(S) residuals using the
+  reference einsum implementation — XLA fuses it well, and rematerialization is
+  the standard TPU trade (HBM bandwidth for FLOPs);
+- ``interpret=True`` automatically off-TPU so the same code runs in CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def mha_reference(q, k, v, causal: bool = False, q_offset: int = 0,
+                  k_offset: int = 0, sm_scale: float | None = None) -> jnp.ndarray:
+    """Plain einsum attention — numerics oracle for the kernel and the VJP
+    recompute path. Shapes: q [B,H,Sq,D], k/v [B,H,Sk,D]."""
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[2])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[2])[None, :]
+        logits = jnp.where(kpos <= qpos, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  block_k: int, causal: bool, q_offset: int, k_offset: int,
+                  sm_scale: float, block_q: int):
+    """One (batch*head, q-block, k-block) grid step of online-softmax attention.
+
+    The K loop is a GRID dimension (innermost), so Mosaic double-buffers the
+    K/V block DMAs across steps; the running (max, normalizer, accumulator)
+    lives in VMEM scratch that persists along the k dimension, initialized at
+    kb==0 and written to the output block at the last kb. QK^T and PV run in
+    the input dtype (bf16 -> full MXU rate) with f32 accumulation
+    (preferred_element_type); softmax bookkeeping is f32 on the VPU. Fully
+    -future K blocks under causal masking are skipped via pl.when."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_last = q_offset + qi * block_q + block_q - 1
+    k_first = k_offset + kb * block_k
+    visible = (k_first <= q_last) if causal else True
+
+    @pl.when(visible)
+    def _attend():
+        q = q_ref[0]                                     # [block_q, d]
+        k_blk = k_ref[0]                                 # [block_k, d]
+        v_blk = v_ref[0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_offset + kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p.astype(q.dtype), v_blk, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale, block_q,
+                   block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, q_offset=q_offset,
+        k_offset=k_offset, sm_scale=sm_scale, block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q, sk // block_k),  # k innermost: scratch carries
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def flash_attention(q, k, v, causal: bool = False, q_offset: int = 0,
+                    k_offset: int = 0, sm_scale: float | None = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """Flash attention: softmax(q k^T / sqrt(d)) v without materializing scores.
+
+    q [B,H,Sq,D], k/v [B,H,Sk,D] -> [B,H,Sq,D]. ``q_offset``/``k_offset`` are the
+    global positions of the local blocks (used by ring attention for causal
+    masking across rotated K/V shards).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / float(q.shape[-1]) ** 0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale,
+                          block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, q_offset, k_offset, sm_scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, q_offset, k_offset, sm_scale,
+                          block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, q_offset, k_offset, sm_scale, block_q, block_k, interpret,
+         residuals, g):
+    # Rematerialized backward through the reference computation: standard TPU
+    # FLOPs-for-HBM trade; O(S^2) scores exist only inside the fused backward.
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal, q_offset, k_offset,
+                                         sm_scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,7 @@
+from ddw_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from ddw_tpu.parallel.sharding import (  # noqa: F401
+    PartitionRules,
+    VIT_TP_RULES,
+    shardings_for_params,
+    make_sharded_train_step,
+)

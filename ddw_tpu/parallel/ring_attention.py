@@ -1,0 +1,81 @@
+"""Ring attention — sequence/context parallelism over a ``seq`` mesh axis.
+
+Not in the reference (its workload is a CNN; SURVEY.md §2d marks SP "not required
+for parity"), but long-context is first-class here: this is the component that
+lets attention scale past one device's memory by sharding the *sequence* axis.
+
+Algorithm (Liu et al. 2023, blockwise ring attention): each of the N devices on
+the ``seq`` axis holds Q/K/V shards of S/N tokens. Q stays put; K/V shards rotate
+around the ring N times via ``ppermute`` (ICI neighbor exchange). Each hop, every
+device attends its local Q against the visiting K/V block (blockwise XLA-fused
+attention; block = the shard) and folds the result into a running (max,
+normalizer, accumulator) — the same online softmax as the flash kernel, lifted to
+the ring level, so the full S×S score matrix never exists anywhere. Communication overlaps compute under XLA's
+scheduler; per-hop cost is the local block attention plus one neighbor exchange.
+
+Causal masking works on *global* positions: rank r's Q block has offset r*S/N and
+the visiting K block carries its own source offset — passed through to the local
+kernel (``q_offset``/``k_offset``), so blocks that are entirely in the future are
+fully masked and contribute exp(-inf)=0.
+
+Use under ``shard_map`` with in_specs splitting the sequence dim over ``seq``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   sm_scale: float | None = None) -> jnp.ndarray:
+    """Blockwise ring attention over ``axis_name``.
+
+    Per-device shapes: q/k/v [B, H, S_local, D] (the local sequence shard);
+    returns the local shard of the attention output. Must be called inside
+    ``shard_map``/``pmap`` binding ``axis_name``.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / float(d) ** 0.5
+
+    # Running online-softmax state over ring hops, in f32. The per-hop local
+    # attention is the blockwise jnp formulation (block = the S/N shard; XLA
+    # fuses it); the Pallas flash kernel is the single-device fast path and can
+    # slot in per-hop once it also returns (m, l) for the cross-hop combine.
+    m = jnp.full((b, h, s_local, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    acc = jnp.zeros((b, h, s_local, d), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = k, v
+    q32 = q.astype(jnp.float32)
+    q_off = me * s_local
+
+    for hop in range(n):
+        src = (me - hop) % n                 # which rank's K/V block is visiting
+        k_off = src * s_local
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32)) * sm_scale
+        if causal:
+            qpos = q_off + jnp.arange(s_local)[:, None]
+            kpos = k_off + jnp.arange(s_local)[None, :]
+            s = jnp.where((kpos <= qpos), s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                       v_cur.astype(jnp.float32))
+        m = m_new
+        if hop != n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)

@@ -1,0 +1,121 @@
+"""Rule-based parameter sharding + tensor-parallel train step (pjit path).
+
+The reference has no model sharding (sequential CNN, SURVEY.md §2d: TP/PP "not
+required for parity"), but the mesh design leaves the door open at zero cost
+(§2d note) — this module is that door. Param shardings are declared as
+(path-regex -> PartitionSpec) rules; the train step is compiled with
+``jax.jit(in_shardings=..., out_shardings=...)`` and XLA GSPMD inserts the
+tensor-parallel collectives (all-reduce of activations across ``model``) —
+the idiomatic TPU approach per the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA place collectives.
+
+``VIT_TP_RULES`` is the Megatron-style sharding for the in-tree ViT: MLP fc1
+column-parallel / fc2 row-parallel; attention QKV head-parallel / output
+projection row-parallel; embeddings and head replicated.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddw_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS
+from ddw_tpu.train.step import TrainState, cross_entropy_loss
+
+
+class PartitionRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins, default replicated."""
+
+    def __init__(self, rules: Sequence[tuple[str, P]]):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                if len(spec) > ndim:
+                    raise ValueError(f"rule {pat.pattern} spec {spec} rank > param rank {ndim} at {path}")
+                return spec
+        return P()
+
+
+# Megatron-style TP for ddw_tpu.models.vit.ViT (param shapes from flax linen):
+#   attn query/key/value kernel: [embed, heads, head_dim] -> shard heads
+#   attn out kernel:             [heads, head_dim, embed] -> shard heads (row-par)
+#   mlp fc1 kernel [embed, mlp] -> column-parallel; fc2 [mlp, embed] -> row-parallel
+VIT_TP_RULES = PartitionRules([
+    (r"attn/(query|key|value)/kernel", P(None, MODEL_AXIS, None)),
+    (r"attn/(query|key|value)/bias", P(MODEL_AXIS, None)),
+    (r"attn/out/kernel", P(MODEL_AXIS, None, None)),
+    (r"mlp/fc1/kernel", P(None, MODEL_AXIS)),
+    (r"mlp/fc1/bias", P(MODEL_AXIS)),
+    (r"mlp/fc2/kernel", P(MODEL_AXIS, None)),
+])
+
+
+def _path_key(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+                    for p in path)
+
+
+def shardings_for_params(tree, mesh: Mesh, rules: PartitionRules):
+    """Pytree of NamedShardings matching ``tree`` via the path rules.
+
+    Works on a param tree OR a whole TrainState (shape) tree: optimizer moments
+    (Adam mu/nu) mirror the param tree, so their paths end with the same
+    ``.../mlp/fc1/kernel`` suffixes the rules match on; scalars (step, counts,
+    hyperparams) match nothing and replicate."""
+    def to_sharding(path, leaf):
+        key = _path_key(path)
+        ndim = len(getattr(leaf, "shape", ())) if not hasattr(leaf, "ndim") else leaf.ndim
+        return NamedSharding(mesh, rules.spec_for(key, ndim))
+
+    return jax.tree_util.tree_map_with_path(to_sharding, tree)
+
+
+def make_sharded_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    rules: PartitionRules,
+    data_axis: str = DATA_AXIS,
+) -> Callable:
+    """Tensor+data-parallel train step via GSPMD.
+
+    Params/opt-state shard per ``rules`` over the ``model`` axis; the batch
+    shards over ``data``; gradients reduce over ``data`` automatically (XLA
+    derives the all-reduce from the shardings — no explicit psum needed in the
+    pjit formulation). Returns ``step(state, images, labels, rng) -> (state,
+    metrics)``; call :func:`place_state` first so inputs are laid out correctly.
+    """
+
+    def _step(state: TrainState, images, labels, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            variables = {"params": params}
+            logits = model.apply(variables, images, train=True,
+                                 rngs={"dropout": dropout_rng})
+            loss = cross_entropy_loss(logits, labels)
+            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(new_params, state.batch_stats, new_opt, state.step + 1)
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    def place_state(state: TrainState) -> TrainState:
+        state_sh = shardings_for_params(state, mesh, rules)
+        return jax.tree.map(lambda x, s: jax.device_put(x, s), state, state_sh)
+
+    step = jax.jit(_step, donate_argnums=(0,))
+    step.place_state = place_state  # type: ignore[attr-defined]
+    step.batch_sharding = NamedSharding(mesh, P(data_axis))  # type: ignore[attr-defined]
+    return step

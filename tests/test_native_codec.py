@@ -1,0 +1,101 @@
+"""C++ shard codec: build, parity with the Python codec, error paths, speed sanity."""
+
+import os
+import time
+
+import pytest
+
+from ddw_tpu.data.store import Record, TableStore
+from ddw_tpu.native.codec import native_available, read_shard_native
+
+
+@pytest.fixture(scope="module")
+def shard(tmp_path_factory):
+    store = TableStore(str(tmp_path_factory.mktemp("nat")))
+    recs = [Record(f"/img/{i:04d}.jpg", os.urandom(200 + i), "roses", i % 5)
+            for i in range(500)]
+    tbl = store.write("t", recs, shard_size=500)
+    return tbl.shard_paths[0], recs
+
+
+def test_native_builds():
+    assert native_available(), "g++ build of the codec failed"
+
+
+def test_native_matches_python(shard, monkeypatch):
+    path, recs = shard
+    native = read_shard_native(path)
+    # force the python path for comparison
+    monkeypatch.setenv("DDW_NATIVE_CODEC", "0")
+    from ddw_tpu.data.store import read_shard
+
+    python = list(read_shard(path))
+    assert len(native) == len(python) == 500
+    for a, b in zip(native, python):
+        assert (a.path, a.content, a.label, a.label_idx) == \
+               (b.path, b.content, b.label, b.label_idx)
+
+
+def test_store_uses_native_by_default(shard):
+    path, recs = shard
+    from ddw_tpu.data.store import read_shard
+
+    got = list(read_shard(path))
+    assert [r.content for r in got] == [r.content for r in recs]
+
+
+def test_native_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.ddws"
+    bad.write_bytes(b"NOPE" + b"\x00" * 100)
+    with pytest.raises(RuntimeError, match="header error"):
+        read_shard_native(str(bad))
+
+
+def test_native_rejects_truncated(shard, tmp_path):
+    path, _ = shard
+    data = open(path, "rb").read()
+    trunc = tmp_path / "trunc.ddws"
+    trunc.write_bytes(data[: len(data) // 2])
+    with pytest.raises(RuntimeError, match="parse error"):
+        read_shard_native(str(trunc))
+
+
+def test_contents_fast_path_matches(shard):
+    """(content, label_idx) hot path: native == python fallback == full records."""
+    path, recs = shard
+    from ddw_tpu.data.store import read_shard_contents
+    from ddw_tpu.native.codec import read_shard_contents_native
+
+    native = read_shard_contents_native(path)
+    os.environ["DDW_NATIVE_CODEC"] = "0"
+    try:
+        python = list(read_shard_contents(path))
+    finally:
+        os.environ.pop("DDW_NATIVE_CODEC", None)
+    assert native == python
+    assert [c for c, _ in native] == [r.content for r in recs]
+    assert [i for _, i in native] == [r.label_idx for r in recs]
+
+
+def test_contents_native_not_slower(shard):
+    """Non-regression: both paths are memory-bound on the content copy (measured
+    ~parity at 3KB records); the native path must at least not regress."""
+    path, _ = shard
+    from ddw_tpu.data.store import read_shard_contents
+    from ddw_tpu.native.codec import read_shard_contents_native
+
+    read_shard_contents_native(path)  # warm (build + page cache)
+    t0 = time.perf_counter()
+    for _ in range(30):
+        read_shard_contents_native(path)
+    t_native = time.perf_counter() - t0
+
+    os.environ["DDW_NATIVE_CODEC"] = "0"
+    try:
+        t0 = time.perf_counter()
+        for _ in range(30):
+            list(read_shard_contents(path))
+        t_python = time.perf_counter() - t0
+    finally:
+        os.environ.pop("DDW_NATIVE_CODEC", None)
+    assert t_native < t_python * 1.3, (t_native, t_python)
