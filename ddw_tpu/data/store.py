@@ -95,36 +95,32 @@ def read_shard(path: str) -> Iterator[Record]:
     Prefers the C++ codec (``ddw_tpu/native``, one index pass over the buffer)
     when it builds/loads; falls back to the pure-Python framing. Disable with
     ``DDW_NATIVE_CODEC=0``."""
-    if _native_reader() is not None:
+    native = _native_reader()
+    if native is not None:
         # Errors from an available native parser propagate: swallowing them
         # would double-read corrupt shards through the Python path and mask
         # codec divergence.
-        yield from _native_reader().read_shard_native(path)
+        yield from native.read_shard_native(path)
         return
-    with open(path, "rb") as f:
-        head = f.read(12)
-        if head[:4] != _MAGIC:
-            raise ValueError(f"{path}: bad magic {head[:4]!r}")
-        fmt, n = struct.unpack("<II", head[4:])
-        if fmt != _FORMAT_VERSION:
-            raise ValueError(f"{path}: unsupported format version {fmt}")
-        for _ in range(n):
-            (plen,) = struct.unpack("<I", f.read(4))
-            p = f.read(plen).decode()
-            (clen,) = struct.unpack("<I", f.read(4))
-            content = f.read(clen)
-            (llen,) = struct.unpack("<I", f.read(4))
-            label = f.read(llen).decode()
-            (idx,) = struct.unpack("<i", f.read(4))
-            yield Record(p, content, label, idx)
+    for rec in _walk_shard(path, full=True):
+        yield rec
 
 
 def read_shard_contents(path: str) -> Iterator[tuple[bytes, int]]:
     """Loader hot path: yield (content, label_idx) only — no path/label string
     decoding, no Record objects. Native C++ index pass when available."""
-    if _native_reader() is not None:
-        yield from _native_reader().read_shard_contents_native(path)
+    native = _native_reader()
+    if native is not None:
+        yield from native.read_shard_contents_native(path)
         return
+    for pair in _walk_shard(path, full=False):
+        yield pair
+
+
+def _walk_shard(path: str, full: bool):
+    """Single pure-Python walker over the DDWS record framing (the only other
+    framing implementation is the C++ codec). ``full=True`` yields ``Record``s;
+    ``full=False`` skips path/label decoding and yields ``(content, label_idx)``."""
     with open(path, "rb") as f:
         head = f.read(12)
         if head[:4] != _MAGIC:
@@ -134,13 +130,13 @@ def read_shard_contents(path: str) -> Iterator[tuple[bytes, int]]:
             raise ValueError(f"{path}: unsupported format version {fmt}")
         for _ in range(n):
             (plen,) = struct.unpack("<I", f.read(4))
-            f.seek(plen, 1)
+            p = f.read(plen).decode() if full else f.seek(plen, 1)
             (clen,) = struct.unpack("<I", f.read(4))
             content = f.read(clen)
             (llen,) = struct.unpack("<I", f.read(4))
-            f.seek(llen, 1)
+            label = f.read(llen).decode() if full else f.seek(llen, 1)
             (idx,) = struct.unpack("<i", f.read(4))
-            yield content, idx
+            yield Record(p, content, label, idx) if full else (content, idx)
 
 
 class Table:

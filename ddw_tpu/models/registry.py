@@ -58,4 +58,8 @@ def _small_cnn(cfg: ModelCfg):
 def _vit(cfg: ModelCfg):
     from ddw_tpu.models.vit import ViT
 
-    return ViT(num_classes=cfg.num_classes, dropout=cfg.dropout, dtype=_dtype(cfg))
+    kwargs = {}
+    if cfg.num_heads:
+        kwargs["num_heads"] = cfg.num_heads
+    return ViT(num_classes=cfg.num_classes, dropout=cfg.dropout, dtype=_dtype(cfg),
+               **kwargs)

@@ -64,7 +64,14 @@ def _load() -> ctypes.CDLL | None:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        try:
+            stale = (not os.path.exists(_LIB)
+                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        except OSError:
+            # codec.cpp missing (e.g. a deployment shipping only the built .so):
+            # use the existing library if present, else latch the failure.
+            stale = not os.path.exists(_LIB)
+        if stale:
             if not _build():
                 _load_failed = True
                 return None

@@ -96,6 +96,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
+        if causal:
+            # A row whose visible keys are all masked has m_new == _NEG_INF and
+            # exp(s - m_new) == 1 for every masked key; zero those explicitly so
+            # l stays 0 and _finalize emits zeros (not mean-of-masked-V).
+            p = jnp.where(s > _NEG_INF / 2, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(

@@ -17,12 +17,11 @@ projection row-parallel; embeddings and head replicated.
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Sequence
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddw_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS

@@ -59,6 +59,10 @@ class ModelCfg:
     dropout: float = 0.5
     freeze_base: bool = True            # transfer-learning mode: only the head trains
     width_mult: float = 1.0
+    num_heads: int = 0                  # attention heads (ViT); 0 = model default.
+                                        # Param shapes depend on it — set it when
+                                        # restoring a package saved with a
+                                        # non-default head count.
     pretrained_path: str = ""           # optional converted-weights artifact
     dtype: str = "bfloat16"             # compute dtype on the MXU; params stay f32
 

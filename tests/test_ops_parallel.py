@@ -80,6 +80,24 @@ def test_flash_offsets():
     assert np.isfinite(np.asarray(out_future)).all()
 
 
+def test_flash_misaligned_offset_masked_rows_zero():
+    """Rows fully masked by a NON-block-aligned offset must emit zeros.
+
+    With k_offset=64 and block_k=128, query rows 0-63 have every key masked but
+    the k block kb=0 still passes the block-level visibility check — the kernel
+    must not let exp(s - m_new) == 1 give masked keys weight (regression test)."""
+    q2, k2, v2 = _qkv(s=256, seed=3)
+    out = flash_attention(q2[:, :, :128, :], k2, v2, True, 0, 64)
+    arr = np.asarray(out)
+    # rows 0-63: zero visible keys -> zeros
+    np.testing.assert_array_equal(arr[:, :, :64, :], 0.0)
+    # rows 64-127: match reference on the visible prefix
+    ref = np.asarray(mha_reference(q2[:, :, :128, :], k2, v2, causal=True,
+                                   q_offset=0, k_offset=64))
+    np.testing.assert_allclose(arr[:, :, 64:, :], ref[:, :, 64:, :],
+                               rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_full(causal):
     n_seq = 4
