@@ -1,0 +1,162 @@
+"""Pretrained-weight conversion: torch MobileNetV2 state_dict -> flax variables.
+
+The reference's accuracy comes from a *frozen ImageNet-pretrained* MobileNetV2
+base (``Part 1 - Distributed Training/02_model_training_single_node.py:164-169``);
+SURVEY.md §7 hard-part 1 chooses option (a): convert pretrained weights into our
+JAX module once, as a data artifact. This module is that converter. It accepts a
+state_dict in torchvision's ``mobilenet_v2`` naming scheme (``features.N...``) —
+the de-facto public distribution format for these weights — and emits the flax
+param/batch_stats trees of :class:`ddw_tpu.models.mobilenet_v2.MobileNetV2Backbone`.
+
+Exactness notes:
+- conv kernels: torch ``[out, in, kh, kw]`` -> flax ``[kh, kw, in, out]``; the
+  same transpose handles depthwise convs (torch ``[C,1,kh,kw]`` -> flax
+  ``[kh,kw,1,C]`` with ``feature_group_count=C``);
+- our BatchNorm runs with the Keras epsilon (1e-3) while torch uses 1e-5; the
+  difference is folded *exactly* into the scale:
+  ``scale' = scale * sqrt((var + eps_ours) / (var + eps_src))``;
+- padding: our convs use TF/Keras "SAME" semantics. For stride-2 3x3 convs on
+  even inputs this pads (0,1) where torch pads (1,1) — a one-pixel spatial
+  shift identical to the Keras-vs-torch difference, irrelevant for transfer
+  learning (and zero for odd spatial sizes, which the equivalence test uses).
+
+Artifact format: ``.npz`` with flattened keys ``params/backbone/...`` and
+``batch_stats/backbone/...`` — loaded into a model's variables by
+:func:`load_pretrained` (wired into ``train.step.init_state`` via
+``ModelCfg.pretrained_path``).
+
+CLI: ``python -m ddw_tpu.models.convert weights.pt out.npz`` (``weights.pt`` is
+a ``torch.save``-d state_dict, e.g. ``torchvision.models.mobilenet_v2(
+weights='IMAGENET1K_V1').state_dict()`` exported on any machine).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ddw_tpu.models.mobilenet_v2 import _INVERTED_RESIDUAL_CFG
+
+_EPS_FLAX = 1e-3   # our BatchNorm epsilon (Keras convention)
+_EPS_TORCH = 1e-5  # torchvision BatchNorm epsilon
+
+
+def _np(x) -> np.ndarray:
+    # torch tensors expose .numpy(); plain arrays pass through.
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach") else x,
+                      dtype=np.float32)
+
+
+def _conv(sd: dict, prefix: str) -> np.ndarray:
+    return _np(sd[f"{prefix}.weight"]).transpose(2, 3, 1, 0)
+
+
+def _bn(sd: dict, prefix: str, eps_src: float) -> tuple[dict, dict]:
+    scale = _np(sd[f"{prefix}.weight"])
+    bias = _np(sd[f"{prefix}.bias"])
+    mean = _np(sd[f"{prefix}.running_mean"])
+    var = _np(sd[f"{prefix}.running_var"])
+    scale = scale * np.sqrt((var + _EPS_FLAX) / (var + eps_src))
+    return {"scale": scale, "bias": bias}, {"mean": mean, "var": var}
+
+
+def _convbn(sd: dict, conv_prefix: str, bn_prefix: str, eps_src: float):
+    bn_params, bn_stats = _bn(sd, bn_prefix, eps_src)
+    params = {"Conv_0": {"kernel": _conv(sd, conv_prefix)}, "BatchNorm_0": bn_params}
+    stats = {"BatchNorm_0": bn_stats}
+    return params, stats
+
+
+def convert_torch_mobilenet_v2(state_dict: dict, eps_src: float = _EPS_TORCH
+                               ) -> dict[str, dict]:
+    """torchvision-layout state_dict -> ``{"params": ..., "batch_stats": ...}``
+    trees of ``MobileNetV2Backbone`` (width_mult 1.0 — the only width torchvision
+    distributes)."""
+    params: dict = {}
+    stats: dict = {}
+
+    def put(name, sub):
+        params[name], stats[name] = sub
+
+    put("ConvBN_0", _convbn(state_dict, "features.0.0", "features.0.1", eps_src))
+    block = 0
+    for t, _c, n, _s in _INVERTED_RESIDUAL_CFG:
+        for _ in range(n):
+            f = f"features.{block + 1}"
+            sub_p: dict = {}
+            sub_s: dict = {}
+            if t == 1:
+                pairs = [(f"{f}.conv.0.0", f"{f}.conv.0.1"),   # depthwise
+                         (f"{f}.conv.1", f"{f}.conv.2")]       # projection
+            else:
+                pairs = [(f"{f}.conv.0.0", f"{f}.conv.0.1"),   # expand 1x1
+                         (f"{f}.conv.1.0", f"{f}.conv.1.1"),   # depthwise
+                         (f"{f}.conv.2", f"{f}.conv.3")]       # projection
+            for i, (cp, bp) in enumerate(pairs):
+                sub_p[f"ConvBN_{i}"], sub_s[f"ConvBN_{i}"] = _convbn(
+                    state_dict, cp, bp, eps_src)
+            params[f"InvertedResidual_{block}"] = sub_p
+            stats[f"InvertedResidual_{block}"] = sub_s
+            block += 1
+    put("ConvBN_1", _convbn(state_dict, "features.18.0", "features.18.1", eps_src))
+    return {"params": params, "batch_stats": stats}
+
+
+def _flatten(tree: dict, prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def save_pretrained(path: str, backbone_vars: dict, scope: str = "backbone") -> None:
+    """Write the converted backbone as the ``.npz`` artifact ``ModelCfg.
+    pretrained_path`` points at, keys fully qualified under ``scope``."""
+    flat = {}
+    flat.update(_flatten(backbone_vars["params"], f"params/{scope}"))
+    flat.update(_flatten(backbone_vars["batch_stats"], f"batch_stats/{scope}"))
+    np.savez(path, **flat)
+
+
+def load_pretrained(variables: dict, path: str) -> dict:
+    """Merge a pretrained ``.npz`` artifact into freshly-initialized model
+    variables. Every artifact entry must match an existing path and shape —
+    a mismatch means the architecture and the artifact diverged, which must
+    fail loudly, not train silently from partial garbage."""
+    import flax
+
+    flat_vars = dict(flax.traverse_util.flatten_dict(variables, sep="/"))
+    loaded = np.load(path)
+    for key in loaded.files:
+        if key not in flat_vars:
+            raise KeyError(f"{path}: artifact key {key!r} not in model variables "
+                           f"(architecture/artifact mismatch)")
+        have = flat_vars[key]
+        arr = loaded[key]
+        if tuple(have.shape) != tuple(arr.shape):
+            raise ValueError(f"{path}: shape mismatch at {key!r}: "
+                             f"model {tuple(have.shape)} vs artifact {arr.shape}")
+        flat_vars[key] = arr.astype(np.asarray(have).dtype)
+    return flax.traverse_util.unflatten_dict(flat_vars, sep="/")
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("state_dict", help="torch.save-d mobilenet_v2 state_dict (.pt)")
+    ap.add_argument("out", help="output .npz artifact path")
+    args = ap.parse_args(argv)
+
+    import torch
+
+    sd = torch.load(args.state_dict, map_location="cpu", weights_only=True)
+    save_pretrained(args.out, convert_torch_mobilenet_v2(sd))
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

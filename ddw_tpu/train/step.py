@@ -100,6 +100,13 @@ def init_state(
     SURVEY §5 checkpoint note)."""
     dummy = jnp.zeros((1, *image_shape), jnp.float32)
     variables = model.init({"params": rng}, dummy, train=False)
+    if model_cfg.pretrained_path:
+        # Transfer-learning mode (reference ``weights='imagenet'``, SURVEY §7
+        # hard-part 1a): merge the converted-backbone artifact over the fresh
+        # init; the head stays randomly initialized.
+        from ddw_tpu.models.convert import load_pretrained
+
+        variables = load_pretrained(variables, model_cfg.pretrained_path)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     frozen = type(model).frozen_prefixes(getattr(model, "freeze_base", False))

@@ -155,6 +155,26 @@ class Trainer:
                                  "steps_per_epoch": steps_per_epoch,
                                  "global_batch": cfg.batch_size * world})
 
+        monitor = None
+        if (cfg.monitor_interval_s > 0 and self.run is not None
+                and jax.process_index() == 0):
+            # Ganglia role (SURVEY §5): sys.* utilization series next to the
+            # training curves.
+            from ddw_tpu.utils.sysmon import SystemMonitor
+
+            monitor = SystemMonitor(self.run, cfg.monitor_interval_s).start()
+        try:
+            return self._fit_epochs(
+                cfg, world, state, train_step, eval_step, ckpt, start_epoch,
+                steps_per_epoch, val_steps, warmup, plateau, early,
+                train_table, val_table, resume)
+        finally:
+            if monitor is not None:
+                monitor.stop()
+
+    def _fit_epochs(self, cfg, world, state, train_step, eval_step, ckpt,
+                    start_epoch, steps_per_epoch, val_steps, warmup, plateau,
+                    early, train_table, val_table, resume) -> TrainResult:
         train_loader, val_loader_factory = self._loaders(train_table, val_table)
         train_iter = iter(train_loader)
         step_rng = jax.random.PRNGKey(cfg.seed + 1)

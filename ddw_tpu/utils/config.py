@@ -94,6 +94,8 @@ class TrainCfg:
     log_every_steps: int = 10
     trace_dir: str = ""                 # --trace flag role (jax.profiler), SURVEY §5
     debug_cross_host_checks: bool = False  # SPMD consistency sanitizer, SURVEY §5
+    monitor_interval_s: float = 0.0     # >0: sys.* utilization sampler into the
+                                        # tracker (Ganglia role, SURVEY §5)
 
 
 @dataclass

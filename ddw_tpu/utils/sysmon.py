@@ -1,0 +1,98 @@
+"""Host/device utilization sampler — the Ganglia role (SURVEY.md §2c/§5).
+
+The reference points users at Ganglia cluster dashboards to diagnose
+under-utilization (``Part 1 - Distributed Training/04_monitoring_and_optimization.py:25-29``).
+TPU-native equivalent: an in-process background sampler that records host CPU /
+RAM and device HBM usage as ``sys.*`` metric series into the tracker run, so
+utilization lives next to the training curves instead of on a separate platform
+dashboard.
+
+Samples are cheap (psutil counters + PJRT ``memory_stats``); the default 10 s
+cadence adds no measurable overhead to a training loop. Used by the Trainer when
+``TrainCfg.monitor_interval_s > 0`` (process 0 only — the rank-0-writer
+discipline, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+try:
+    import psutil
+except ImportError:  # pragma: no cover - psutil is in the base image
+    psutil = None
+
+
+def sample_system(device=None) -> dict[str, float]:
+    """One utilization snapshot. Keys are stable; device entries appear only
+    when the backend reports memory statistics (TPU does, CPU does not)."""
+    out: dict[str, float] = {}
+    if psutil is not None:
+        out["sys.host_cpu_percent"] = float(psutil.cpu_percent(interval=None))
+        vm = psutil.virtual_memory()
+        out["sys.host_mem_percent"] = float(vm.percent)
+        out["sys.host_mem_used_gb"] = vm.used / 2**30
+        out["sys.proc_rss_gb"] = psutil.Process().memory_info().rss / 2**30
+    if device is None:
+        import jax
+
+        device = jax.local_devices()[0]
+    stats: Any = None
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        if "bytes_in_use" in stats:
+            out["sys.device_hbm_used_gb"] = stats["bytes_in_use"] / 2**30
+        if "bytes_limit" in stats:
+            out["sys.device_hbm_limit_gb"] = stats["bytes_limit"] / 2**30
+            if stats["bytes_limit"]:
+                out["sys.device_hbm_percent"] = (
+                    100.0 * stats.get("bytes_in_use", 0) / stats["bytes_limit"])
+    return out
+
+
+class SystemMonitor:
+    """Background thread logging ``sample_system()`` into a tracker run every
+    ``interval_s`` seconds. Use as a context manager around the training loop."""
+
+    def __init__(self, run, interval_s: float = 10.0, device=None):
+        self.run = run
+        self.interval_s = interval_s
+        self.device = device
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._n = 0
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                metrics = sample_system(self.device)
+                if self.run is not None and metrics:
+                    self.run.log_metrics(metrics, step=self._n)
+                self._n += 1
+            except Exception:
+                pass  # sampling must never take down training
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "SystemMonitor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="ddw-sysmon", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "SystemMonitor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,38 @@
+"""Utilization sampler (Ganglia role, SURVEY §5): snapshot keys, background
+logging into a tracker run, and clean stop."""
+
+import time
+
+import pytest
+
+from ddw_tpu.tracking.tracker import Tracker
+from ddw_tpu.utils.sysmon import SystemMonitor, sample_system
+
+pytest.importorskip("psutil")
+
+
+def test_sample_has_host_metrics():
+    s = sample_system()
+    assert 0.0 <= s["sys.host_cpu_percent"] <= 100.0
+    assert 0.0 < s["sys.host_mem_percent"] <= 100.0
+    assert s["sys.proc_rss_gb"] > 0.0
+
+
+def test_monitor_logs_series_into_run(tmp_path):
+    tracker = Tracker(str(tmp_path), experiment="mon")
+    with tracker.start_run("utilization") as run:
+        with SystemMonitor(run, interval_s=0.05):
+            time.sleep(0.35)
+    hist = tracker.get_run(run.run_id).metric_history("sys.host_mem_percent")
+    assert len(hist) >= 2
+    steps = [s for s, _ in hist]
+    assert steps == sorted(steps)
+    assert all(0.0 < v <= 100.0 for _, v in hist)
+
+
+def test_monitor_stop_idempotent(tmp_path):
+    mon = SystemMonitor(run=None, interval_s=0.05).start()
+    time.sleep(0.12)
+    mon.stop()
+    mon.stop()
+    assert mon._thread is None
