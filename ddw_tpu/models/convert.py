@@ -101,23 +101,15 @@ def convert_torch_mobilenet_v2(state_dict: dict, eps_src: float = _EPS_TORCH
     return {"params": params, "batch_stats": stats}
 
 
-def _flatten(tree: dict, prefix: str = "") -> dict[str, np.ndarray]:
-    out: dict[str, np.ndarray] = {}
-    for k, v in tree.items():
-        key = f"{prefix}/{k}" if prefix else k
-        if isinstance(v, dict):
-            out.update(_flatten(v, key))
-        else:
-            out[key] = np.asarray(v)
-    return out
-
-
 def save_pretrained(path: str, backbone_vars: dict, scope: str = "backbone") -> None:
     """Write the converted backbone as the ``.npz`` artifact ``ModelCfg.
     pretrained_path`` points at, keys fully qualified under ``scope``."""
-    flat = {}
-    flat.update(_flatten(backbone_vars["params"], f"params/{scope}"))
-    flat.update(_flatten(backbone_vars["batch_stats"], f"batch_stats/{scope}"))
+    import flax
+
+    tree = {"params": {scope: backbone_vars["params"]},
+            "batch_stats": {scope: backbone_vars["batch_stats"]}}
+    flat = {k: np.asarray(v)
+            for k, v in flax.traverse_util.flatten_dict(tree, sep="/").items()}
     np.savez(path, **flat)
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 # (expansion t, out channels c, repeats n, stride s) — Sandler et al. Table 2.
@@ -127,6 +128,11 @@ class MobileNetV2(nn.Module):
     def __call__(self, x, train: bool = False):
         base_train = train and not self.freeze_base
         feats = MobileNetV2Backbone(self.width_mult, self.dtype, name="backbone")(x, base_train)
+        if self.freeze_base:
+            # Keras trainable=False computes no base gradients: the tape stops at
+            # the head input. stop_gradient guarantees XLA drops the backbone
+            # backward pass instead of relying on DCE of the masked updates.
+            feats = jax.lax.stop_gradient(feats)
         # GAP -> Dropout -> Dense logits (reference :171-178; logits, not softmax —
         # loss is SparseCategoricalCrossentropy(from_logits=True), :202)
         h = jnp.mean(feats.astype(jnp.float32), axis=(1, 2))

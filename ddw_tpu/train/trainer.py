@@ -23,6 +23,7 @@ axis of the mesh plays that role: global batch = ``batch_size * mesh.shape['data
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Any
@@ -162,95 +163,85 @@ class Trainer:
             # training curves.
             from ddw_tpu.utils.sysmon import SystemMonitor
 
-            monitor = SystemMonitor(self.run, cfg.monitor_interval_s).start()
-        try:
-            return self._fit_epochs(
-                cfg, world, state, train_step, eval_step, ckpt, start_epoch,
-                steps_per_epoch, val_steps, warmup, plateau, early,
-                train_table, val_table, resume)
-        finally:
-            if monitor is not None:
-                monitor.stop()
+            monitor = SystemMonitor(self.run, cfg.monitor_interval_s)
 
-    def _fit_epochs(self, cfg, world, state, train_step, eval_step, ckpt,
-                    start_epoch, steps_per_epoch, val_steps, warmup, plateau,
-                    early, train_table, val_table, resume) -> TrainResult:
-        train_loader, val_loader_factory = self._loaders(train_table, val_table)
-        train_iter = iter(train_loader)
-        step_rng = jax.random.PRNGKey(cfg.seed + 1)
+        with monitor if monitor is not None else contextlib.nullcontext():
+            train_loader, val_loader_factory = self._loaders(train_table, val_table)
+            train_iter = iter(train_loader)
+            step_rng = jax.random.PRNGKey(cfg.seed + 1)
 
-        history: list[dict[str, float]] = []
-        val_loss = val_acc = float("nan")
-        epochs_run = 0
-        tracing = False
-        resumed = ckpt is not None and resume and start_epoch > 0
-        if start_epoch >= cfg.warmup_epochs and not resumed:
-            # Past warmup (incl. warmup_epochs=0): start at the scaled target once;
-            # afterwards only the plateau callback may change the LR. On resume the
-            # restored opt_state already carries the LR training left off at
-            # (including plateau reductions) — don't clobber it. (The plateau
-            # patience counter itself is not checkpointed and restarts.)
-            state = set_lr(state, warmup.lr_for_epoch(cfg.warmup_epochs))
-        for epoch in range(start_epoch, cfg.epochs):
-            if epoch < cfg.warmup_epochs:
-                state = set_lr(state, warmup.lr_for_epoch(epoch))
-            if cfg.trace_dir and epoch == start_epoch and jax.process_index() == 0:
-                jax.profiler.start_trace(cfg.trace_dir)
-                tracing = True
-            t0 = time.time()
-            losses, accs = [], []
-            for _ in range(steps_per_epoch):
-                images, labels = next(train_iter)
-                state, metrics = train_step(state, images, labels, step_rng)
-                losses.append(metrics["loss"])
-                accs.append(metrics["accuracy"])
-            train_loss = float(np.mean(jax.device_get(losses)))
-            train_acc = float(np.mean(jax.device_get(accs)))
-            epoch_s = time.time() - t0
-            if tracing:
-                jax.profiler.stop_trace()
-                tracing = False
+            history: list[dict[str, float]] = []
+            val_loss = val_acc = float("nan")
+            epochs_run = 0
+            tracing = False
+            resumed = ckpt is not None and resume and start_epoch > 0
+            if start_epoch >= cfg.warmup_epochs and not resumed:
+                # Past warmup (incl. warmup_epochs=0): start at the scaled target once;
+                # afterwards only the plateau callback may change the LR. On resume the
+                # restored opt_state already carries the LR training left off at
+                # (including plateau reductions) — don't clobber it. (The plateau
+                # patience counter itself is not checkpointed and restarts.)
+                state = set_lr(state, warmup.lr_for_epoch(cfg.warmup_epochs))
+            for epoch in range(start_epoch, cfg.epochs):
+                if epoch < cfg.warmup_epochs:
+                    state = set_lr(state, warmup.lr_for_epoch(epoch))
+                if cfg.trace_dir and epoch == start_epoch and jax.process_index() == 0:
+                    jax.profiler.start_trace(cfg.trace_dir)
+                    tracing = True
+                t0 = time.time()
+                losses, accs = [], []
+                for _ in range(steps_per_epoch):
+                    images, labels = next(train_iter)
+                    state, metrics = train_step(state, images, labels, step_rng)
+                    losses.append(metrics["loss"])
+                    accs.append(metrics["accuracy"])
+                train_loss = float(np.mean(jax.device_get(losses)))
+                train_acc = float(np.mean(jax.device_get(accs)))
+                epoch_s = time.time() - t0
+                if tracing:
+                    jax.profiler.stop_trace()
+                    tracing = False
 
-            vlosses, vaccs = [], []
-            viter = iter(val_loader_factory())
-            for _ in range(val_steps):
-                images, labels = next(viter)
-                m = eval_step(state, images, labels)
-                vlosses.append(m["loss"])
-                vaccs.append(m["accuracy"])
-            val_loss = float(np.mean(jax.device_get(vlosses)))
-            val_acc = float(np.mean(jax.device_get(vaccs)))
+                vlosses, vaccs = [], []
+                viter = iter(val_loader_factory())
+                for _ in range(val_steps):
+                    images, labels = next(viter)
+                    m = eval_step(state, images, labels)
+                    vlosses.append(m["loss"])
+                    vaccs.append(m["accuracy"])
+                val_loss = float(np.mean(jax.device_get(vlosses)))
+                val_acc = float(np.mean(jax.device_get(vaccs)))
 
-            lr = get_lr(state)
-            row = {
-                "epoch": epoch, "loss": train_loss, "accuracy": train_acc,
-                "val_loss": val_loss, "val_accuracy": val_acc, "lr": lr,
-                "epoch_seconds": epoch_s,
-                "images_per_sec": steps_per_epoch * cfg.batch_size * world / epoch_s,
-            }
-            history.append(row)
-            epochs_run = epoch + 1
-            if self.run is not None:
-                self.run.log_metrics(
-                    {k: v for k, v in row.items() if k != "epoch"}, step=epoch)
+                lr = get_lr(state)
+                row = {
+                    "epoch": epoch, "loss": train_loss, "accuracy": train_acc,
+                    "val_loss": val_loss, "val_accuracy": val_acc, "lr": lr,
+                    "epoch_seconds": epoch_s,
+                    "images_per_sec": steps_per_epoch * cfg.batch_size * world / epoch_s,
+                }
+                history.append(row)
+                epochs_run = epoch + 1
+                if self.run is not None:
+                    self.run.log_metrics(
+                        {k: v for k, v in row.items() if k != "epoch"}, step=epoch)
 
-            if cfg.debug_cross_host_checks:
-                # SPMD consistency sanitizer (SURVEY §5): params must be identical
-                # across hosts; checksum computed locally, compared via tracker logs.
-                self.run and self.run.log_metric("params_checksum", params_checksum(state), epoch)
+                if cfg.debug_cross_host_checks:
+                    # SPMD consistency sanitizer (SURVEY §5): params must be identical
+                    # across hosts; checksum computed locally, compared via tracker logs.
+                    self.run and self.run.log_metric("params_checksum", params_checksum(state), epoch)
 
-            if ckpt and ((epoch + 1) % cfg.checkpoint_every_epochs == 0):
-                ckpt.save(state, int(jax.device_get(state.step)),
-                          metadata={"epoch": epoch, "val_loss": val_loss,
-                                    "val_accuracy": val_acc})
+                if ckpt and ((epoch + 1) % cfg.checkpoint_every_epochs == 0):
+                    ckpt.save(state, int(jax.device_get(state.step)),
+                              metadata={"epoch": epoch, "val_loss": val_loss,
+                                        "val_accuracy": val_acc})
 
-            # LR-plateau AFTER metrics are world-consistent (ordering contract,
-            # reference :310-313 — trivially satisfied: metrics are pmean-ed in-step)
-            if epoch + 1 >= cfg.warmup_epochs:
-                new_lr = plateau.update(val_loss, lr)
-                if new_lr != lr:
-                    state = set_lr(state, new_lr)
-            if early is not None and early.should_stop(val_loss):
-                break
+                # LR-plateau AFTER metrics are world-consistent (ordering contract,
+                # reference :310-313 — trivially satisfied: metrics are pmean-ed in-step)
+                if epoch + 1 >= cfg.warmup_epochs:
+                    new_lr = plateau.update(val_loss, lr)
+                    if new_lr != lr:
+                        state = set_lr(state, new_lr)
+                if early is not None and early.should_stop(val_loss):
+                    break
 
-        return TrainResult(val_loss, val_acc, history, state, epochs_run)
+            return TrainResult(val_loss, val_acc, history, state, epochs_run)

@@ -21,6 +21,11 @@ from typing import Any
 
 try:
     import psutil
+
+    # psutil.cpu_percent(interval=None) returns 0.0 on its first call in a
+    # process (no prior sample to diff against); prime it so real samples
+    # never report that placeholder.
+    psutil.cpu_percent(interval=None)
 except ImportError:  # pragma: no cover - psutil is in the base image
     psutil = None
 
@@ -80,6 +85,7 @@ class SystemMonitor:
 
     def start(self) -> "SystemMonitor":
         if self._thread is None:
+            self._stop.clear()
             self._thread = threading.Thread(
                 target=self._loop, name="ddw-sysmon", daemon=True)
             self._thread.start()
@@ -89,7 +95,10 @@ class SystemMonitor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
-            self._thread = None
+            if not self._thread.is_alive():
+                self._thread = None
+            # else: keep the handle so a restart can't spawn a second
+            # concurrent sampler double-logging into the run
 
     def __enter__(self) -> "SystemMonitor":
         return self.start()
