@@ -1,0 +1,138 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+The reference stack has no language model and no attention (SURVEY.md §5
+"Long-context ... Absent"); this family exists because long-context and model
+sharding are first-class axes of this framework, not parity items. The same
+module runs three ways off one definition:
+
+- single device: causal flash attention (:mod:`ddw_tpu.ops.flash_attention`);
+- sequence parallel: construct with ``seq_axis='seq'`` and call inside
+  ``shard_map`` with tokens sharded on the sequence dim — attention becomes
+  ring attention (K/V shards rotating by ``ppermute``,
+  :mod:`ddw_tpu.parallel.ring_attention`) and position embeddings are sliced at
+  the shard's global offset (``lax.axis_index * S_local``);
+- tensor parallel: submodule names (``attn/{query,key,value,out}``,
+  ``mlp/{fc1,fc2}``) match :data:`ddw_tpu.parallel.sharding.LM_TP_RULES`, so the
+  GSPMD path shards heads/MLP over the ``model`` axis with no model changes.
+
+Pre-LN blocks, learned positional embeddings, weight-untied vocab head.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ddw_tpu.ops.flash_attention import flash_attention
+from ddw_tpu.parallel.ring_attention import ring_attention
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        head_dim = d // self.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), dtype=self.dtype, name=name)
+        # [B, S, H, hd] -> [B, H, S, hd]
+        q = dense("query")(x).transpose(0, 2, 1, 3)
+        k = dense("key")(x).transpose(0, 2, 1, 3)
+        v = dense("value")(x).transpose(0, 2, 1, 3)
+        if self.seq_axis is not None:
+            out = ring_attention(q, k, v, self.seq_axis, causal=True)
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3)  # [B, S, H, hd]
+        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(out)
+
+
+class DecoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = CausalSelfAttention(self.num_heads, self.dtype, self.seq_axis,
+                                name="attn")(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        d = x.shape[-1]
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM over integer token ids.
+
+    ``__call__(tokens[B, S]) -> logits[B, S, vocab]``. With ``seq_axis`` set the
+    module must run inside ``shard_map`` with ``tokens`` sharded along the
+    sequence dim; S is then the local shard length and positions are offset by
+    the shard index. ``max_len`` bounds the *global* sequence length.
+    """
+
+    vocab_size: int = 256
+    max_len: int = 2048
+    hidden: int = 256
+    depth: int = 4
+    num_heads: int = 4
+    mlp_dim: int = 1024
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        b, s_local = tokens.shape
+        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype,
+                     name="tok_embed")(tokens)
+        pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
+                               (self.max_len, self.hidden), jnp.float32)
+        if self.seq_axis is not None:
+            # Global length = s_local * axis_size must fit the position table:
+            # dynamic_slice clamps out-of-range offsets, which would silently
+            # reuse the last positions on trailing shards instead of failing.
+            n_shards = lax.axis_size(self.seq_axis)
+            if s_local * n_shards > self.max_len:
+                raise ValueError(
+                    f"global sequence {s_local}*{n_shards} exceeds max_len "
+                    f"{self.max_len}")
+            offset = lax.axis_index(self.seq_axis) * s_local
+        else:
+            offset = 0
+        pos = lax.dynamic_slice_in_dim(pos_table, offset, s_local, axis=0)
+        x = x + pos.astype(self.dtype)[None]
+        for i in range(self.depth):
+            x = DecoderBlock(self.num_heads, self.mlp_dim, self.dropout,
+                             self.dtype, self.seq_axis,
+                             name=f"backbone_block{i}")(x, train)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        # vocab head in f32: logits feed a softmax CE, keep full precision
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)
+
+    @staticmethod
+    def frozen_prefixes(freeze_base: bool) -> tuple[str, ...]:
+        return ()
+
+
+def build_lm(cfg, seq_axis: str | None = None) -> TransformerLM:
+    """Construct from an :class:`ddw_tpu.utils.config.LMCfg`."""
+    return TransformerLM(
+        vocab_size=cfg.vocab_size, max_len=cfg.max_len, hidden=cfg.hidden,
+        depth=cfg.depth, num_heads=cfg.num_heads, mlp_dim=cfg.mlp_dim,
+        dropout=cfg.dropout, dtype=jnp.dtype(cfg.dtype), seq_axis=seq_axis)

@@ -56,6 +56,22 @@ VIT_TP_RULES = PartitionRules([
     (r"mlp/fc2/kernel", P(MODEL_AXIS, None)),
 ])
 
+# Same Megatron layout for ddw_tpu.models.lm.TransformerLM (its attn submodule
+# names match ViT's; its MLP lives directly in the block as fc1/fc2). Vocab
+# matrices are column/row-parallel over the embedding dim's partner axis:
+#   tok_embed [vocab, hidden] -> shard vocab; head kernel [hidden, vocab] -> shard vocab.
+LM_TP_RULES = PartitionRules([
+    (r"attn/(query|key|value)/kernel", P(None, MODEL_AXIS, None)),
+    (r"attn/(query|key|value)/bias", P(MODEL_AXIS, None)),
+    (r"attn/out/kernel", P(MODEL_AXIS, None, None)),
+    (r"fc1/kernel", P(None, MODEL_AXIS)),
+    (r"fc1/bias", P(MODEL_AXIS)),
+    (r"fc2/kernel", P(MODEL_AXIS, None)),
+    (r"tok_embed/embedding", P(MODEL_AXIS, None)),
+    (r"head/kernel", P(None, MODEL_AXIS)),
+    (r"head/bias", P(MODEL_AXIS)),
+])
+
 
 def _path_key(path) -> str:
     return "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))

@@ -1,0 +1,115 @@
+"""Jitted LM train/eval steps over a (data, seq) mesh — DP x sequence parallelism.
+
+The long-context analog of :mod:`ddw_tpu.train.step`: one ``shard_map``-ped XLA
+program computes forward, backward, gradient reduction, and the optimizer update.
+Tokens shard over *both* mesh axes — batch over ``data``, sequence over ``seq`` —
+so a sequence N_seq times longer than one device's memory allows still trains;
+attention runs as a ``ppermute`` ring (:mod:`ddw_tpu.parallel.ring_attention`)
+whose hops ride ICI neighbor links.
+
+Loss plumbing: callers pre-shift on the host (``inputs = tokens[:, :-1]``,
+``targets = tokens[:, 1:]``) so no cross-shard halo exchange is needed at shard
+boundaries; per-device mean CE is exact globally because every shard holds the
+same token count (identical-shape guarantee, SURVEY.md §7 hard-part 2). Gradients
+``pmean`` over data x seq in one collective.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddw_tpu.train.step import TrainState, cross_entropy_loss
+
+# next-token CE is the same sparse CE (it broadcasts over [B, S, V] vs [B, S])
+lm_loss = cross_entropy_loss
+
+
+def init_lm_state(model, tx: optax.GradientTransformation,
+                  rng: jax.Array, seq_len: int = 8) -> TrainState:
+    """Seeded replicated init (identical on every host == rank-0 broadcast)."""
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    # A seq-parallel model must init outside shard_map: build an axis-free twin.
+    init_model = model.clone(seq_axis=None) if model.seq_axis else model
+    params = init_model.init({"params": rng}, dummy, train=False)["params"]
+    return TrainState(params, {}, tx.init(params), jnp.zeros((), jnp.int32))
+
+
+def make_lm_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str | None = "seq",
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted DP(xSP) LM train step.
+
+    ``step(state, inputs, targets, rng) -> (state, metrics)`` with inputs/targets
+    ``[global_batch, global_seq]`` sharded ``P(data_axis, seq_axis)``. The model's
+    ``seq_axis`` must match ``seq_axis`` (or both be None for pure DP). Metrics
+    (loss, token accuracy) come back world-averaged.
+    """
+    axes = (data_axis,) if seq_axis is None else (data_axis, seq_axis)
+    if (model.seq_axis or None) != (seq_axis or None):
+        raise ValueError(f"model.seq_axis={model.seq_axis!r} but step "
+                         f"seq_axis={seq_axis!r} — construct the model with the "
+                         f"axis it will run under")
+
+    def _step(state: TrainState, inputs, targets, rng):
+        # independent dropout masks per (data shard, seq shard, step)
+        for ax in axes:
+            rng = jax.random.fold_in(rng, lax.axis_index(ax))
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            logits = model.apply({"params": params}, inputs, train=True,
+                                 rngs={"dropout": dropout_rng})
+            loss = lm_loss(logits, targets)
+            acc = jnp.mean((jnp.argmax(logits, -1) == targets).astype(jnp.float32))
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        grads = lax.pmean(grads, axes)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": lax.pmean(loss, axes),
+                   "accuracy": lax.pmean(acc, axes)}
+        return TrainState(new_params, {}, new_opt, state.step + 1), metrics
+
+    tok_spec = P(data_axis) if seq_axis is None else P(data_axis, seq_axis)
+    smapped = jax.shard_map(
+        _step, mesh=mesh,
+        in_specs=(P(), tok_spec, tok_spec, P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    step = jax.jit(smapped, donate_argnums=(0,) if donate else ())
+    step.batch_sharding = NamedSharding(mesh, tok_spec)  # type: ignore[attr-defined]
+    return step
+
+
+def make_lm_eval_step(model, mesh: Mesh, data_axis: str = "data",
+                      seq_axis: str | None = "seq") -> Callable:
+    """Jitted eval step: world-averaged (loss, token accuracy)."""
+    axes = (data_axis,) if seq_axis is None else (data_axis, seq_axis)
+
+    def _eval(state: TrainState, inputs, targets):
+        logits = model.apply({"params": state.params}, inputs, train=False)
+        loss = lm_loss(logits, targets)
+        acc = jnp.mean((jnp.argmax(logits, -1) == targets).astype(jnp.float32))
+        return {"loss": lax.pmean(loss, axes), "accuracy": lax.pmean(acc, axes)}
+
+    tok_spec = P(data_axis) if seq_axis is None else P(data_axis, seq_axis)
+    smapped = jax.shard_map(
+        _eval, mesh=mesh,
+        in_specs=(P(), tok_spec, tok_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(smapped)

@@ -99,6 +99,25 @@ class TrainCfg:
 
 
 @dataclass
+class LMCfg:
+    """Decoder-only LM config (:class:`ddw_tpu.models.lm.TransformerLM`).
+
+    Not a reference-parity item (the reference has no language model — SURVEY.md
+    §5 "Long-context ... Absent"); this is the long-context model family, trained
+    via the DPxSP step in :mod:`ddw_tpu.train.lm_step`.
+    """
+
+    vocab_size: int = 256
+    max_len: int = 2048                 # global sequence length bound
+    hidden: int = 256
+    depth: int = 4
+    num_heads: int = 4
+    mlp_dim: int = 1024
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+
+
+@dataclass
 class TuneCfg:
     """Hyperparameter-search config.
 
@@ -115,7 +134,8 @@ class TuneCfg:
     gamma: float = 0.25                 # TPE good/bad split quantile
 
 
-_TYPES = {"data": DataCfg, "model": ModelCfg, "train": TrainCfg, "tune": TuneCfg}
+_TYPES = {"data": DataCfg, "model": ModelCfg, "train": TrainCfg, "tune": TuneCfg,
+          "lm": LMCfg}
 
 
 def apply_overrides(cfgs: dict[str, Any], overrides: list[str]) -> dict[str, Any]:

@@ -1,0 +1,107 @@
+"""Long-context LM training — DP x sequence parallelism over a device mesh.
+
+Beyond-parity example (the reference workshop has no language model — SURVEY.md
+§5 "Long-context ... Absent"): trains a character-level TransformerLM on
+synthetic text with the sequence axis sharded across devices, so the context
+length scales with the mesh instead of one device's memory. Attention runs as a
+``ppermute`` ring (ddw_tpu.parallel.ring_attention); the full train step —
+forward, backward, gradient pmean over data x seq — is one jitted XLA program.
+
+Run (virtual 8-device CPU mesh):
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/07_lm_long_context.py --quick
+
+Args: lm.key=value overrides (e.g. lm.hidden=512), train.* for the loop,
+--seq-devices to size the seq axis (default: half the devices).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ddw_tpu.models.lm import build_lm
+from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS, SEQ_AXIS
+from ddw_tpu.train.lm_step import init_lm_state, make_lm_eval_step, make_lm_train_step
+from ddw_tpu.train.step import make_optimizer
+from ddw_tpu.utils.config import LMCfg, TrainCfg, apply_overrides
+
+
+def synthetic_text(rng: np.random.RandomState, n_seqs: int, seq_len: int,
+                   vocab: int) -> np.ndarray:
+    """Deterministic-ish token streams: a noisy affine successor process, so the
+    next token is predictable and the loss curve means something."""
+    step = rng.randint(1, vocab - 1)
+    start = rng.randint(0, vocab, size=(n_seqs, 1))
+    seq = (start + step * np.arange(seq_len + 1)[None, :]) % vocab
+    noise = rng.rand(n_seqs, seq_len + 1) < 0.05
+    seq = np.where(noise, rng.randint(0, vocab, size=seq.shape), seq)
+    return seq.astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true", help="tiny model + few steps")
+    ap.add_argument("--seq-devices", type=int, default=0,
+                    help="devices on the seq axis (0 = half the mesh)")
+    ap.add_argument("--steps", type=int, default=0)
+    ap.add_argument("overrides", nargs="*", help="lm.key=value / train.key=value")
+    args = ap.parse_args()
+
+    cfgs = {"lm": LMCfg(), "train": TrainCfg(warmup_epochs=0)}
+    if args.quick:
+        cfgs["lm"].hidden, cfgs["lm"].depth, cfgs["lm"].mlp_dim = 64, 2, 128
+        cfgs["lm"].vocab_size, cfgs["lm"].max_len = 64, 512
+        cfgs["lm"].dtype = "float32"
+    apply_overrides(cfgs, args.overrides)
+    lm_cfg, train_cfg = cfgs["lm"], cfgs["train"]
+
+    devices = jax.devices()
+    n = len(devices)
+    sp = args.seq_devices or max(1, n // 2)
+    dp = n // sp
+    assert dp * sp == n, f"seq devices {sp} must divide device count {n}"
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, dp), (SEQ_AXIS, sp))), devices=devices)
+    seq_axis = SEQ_AXIS if sp > 1 else None
+
+    model = build_lm(lm_cfg, seq_axis=seq_axis)
+    tx = make_optimizer(train_cfg)
+    state = init_lm_state(model, tx, jax.random.PRNGKey(train_cfg.seed))
+    step = make_lm_train_step(model, tx, mesh, seq_axis=seq_axis)
+    eval_step = make_lm_eval_step(model, mesh, seq_axis=seq_axis)
+
+    # global batch/seq: divisible by the mesh axes
+    batch = max(train_cfg.batch_size, dp) // dp * dp
+    seq_len = min(lm_cfg.max_len, 64 * sp) // sp * sp
+    steps = args.steps or (60 if args.quick else 300)
+
+    rng = np.random.RandomState(train_cfg.seed)
+    tokens = synthetic_text(rng, batch, seq_len, lm_cfg.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    print(f"mesh: {dict(mesh.shape)}  global_batch={batch}  seq_len={seq_len}  "
+          f"params={sum(x.size for x in jax.tree.leaves(state.params)):,}")
+    t0 = time.time()
+    for i in range(steps):
+        state, metrics = step(state, inputs, targets, jax.random.PRNGKey(i))
+        if i % max(1, steps // 6) == 0:
+            print(f"step {i:4d}  loss={float(metrics['loss']):.4f}  "
+                  f"acc={float(metrics['accuracy']):.3f}")
+    jax.block_until_ready(metrics["loss"])
+    dt = time.time() - t0
+    final = eval_step(state, inputs, targets)
+    tok_s = steps * batch * seq_len / dt
+    print(f"final: loss={float(final['loss']):.4f} acc={float(final['accuracy']):.3f} "
+          f"tokens/sec={tok_s:,.0f} ({dt:.1f}s for {steps} steps)")
+
+
+if __name__ == "__main__":
+    main()
