@@ -14,10 +14,11 @@ backend") map 1:1 onto XLA collectives over ICI/DCN:
 
 There is no daemon, no tensor-fusion buffer, no background coordinator thread:
 everything here is traced into the XLA program, which fuses and schedules the
-collectives itself (Horovod's Tensor Fusion falls out of XLA fusion). An explicit
-Pallas/``ppermute`` ring reduction lives in :func:`ring_all_reduce` as the in-tree
-"native collective" — useful for overlap experiments and as the testable analog of
-Horovod's ring algorithm.
+collectives itself (Horovod's Tensor Fusion falls out of XLA fusion). The in-tree
+"native collective" exists at two levels: :func:`ring_all_reduce` (``ppermute``
+ring — XLA emits the transfers) and :func:`ring_all_reduce_pallas`
+(:mod:`ddw_tpu.ops.ring_reduce` — hand-written RDMA hops, the Horovod-core
+analog all the way down to the semaphores).
 
 All functions take an ``axis_name`` and must be called under ``shard_map``/``pmap``
 binding that name.
@@ -34,9 +35,20 @@ from jax import lax
 T = TypeVar("T")
 
 
-def all_reduce_sum(tree: T, axis_name: str) -> T:
-    """Sum a pytree across ``axis_name`` (allreduce-sum on every participant)."""
-    return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+def all_reduce_sum(tree: T, axis_name: str, impl: str = "psum") -> T:
+    """Sum a pytree across ``axis_name`` (allreduce-sum on every participant).
+
+    ``impl``: ``psum`` (XLA collective, production default), ``ring`` (in-tree
+    ``ppermute`` ring), or ``pallas`` (RDMA ring kernel,
+    :func:`ring_all_reduce_pallas`).
+    """
+    if impl == "psum":
+        return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+    if impl == "ring":
+        return jax.tree.map(lambda x: ring_all_reduce(x, axis_name), tree)
+    if impl == "pallas":
+        return jax.tree.map(lambda x: ring_all_reduce_pallas(x, axis_name), tree)
+    raise KeyError(f"unknown allreduce impl {impl!r} (have psum, ring, pallas)")
 
 
 def all_reduce_mean(tree: T, axis_name: str) -> T:
@@ -75,15 +87,18 @@ def ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
     and as the substrate for overlap experiments. Numerically identical to
     ``lax.psum`` up to summation order.
 
-    Requires the leading dim of ``x`` to be divisible by the axis size (pad first if
-    not); returns the full reduced array on every participant.
+    Arrays whose size is not divisible by the axis size are zero-padded for the
+    ring and sliced back; returns the full reduced array on every participant.
     """
     n = lax.axis_size(axis_name)
     if n == 1:
         return x
     me = lax.axis_index(axis_name)
     orig_shape = x.shape
-    chunks = jnp.reshape(x, (n, -1))  # chunk c will be reduced by rank (c-1) % n
+    flat = jnp.reshape(x, (-1,))
+    chunk = -(-flat.size // n)
+    flat = jnp.pad(flat, (0, n * chunk - flat.size))
+    chunks = jnp.reshape(flat, (n, chunk))  # chunk c is reduced by rank (c-1) % n
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -108,4 +123,12 @@ def ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
     out = jnp.zeros_like(chunks)
     for k in range(n):
         out = out.at[(me - k + 1) % n].set(gathered[k])
-    return jnp.reshape(out, orig_shape)
+    return jnp.reshape(out, (-1,))[:x.size].reshape(orig_shape)
+
+
+def ring_all_reduce_pallas(x: jax.Array, axis_name: str, **kwargs) -> jax.Array:
+    """RDMA-level ring allreduce (Pallas kernel) — see
+    :func:`ddw_tpu.ops.ring_reduce.ring_all_reduce_pallas`."""
+    from ddw_tpu.ops.ring_reduce import ring_all_reduce_pallas as _impl
+
+    return _impl(x, axis_name, **kwargs)
