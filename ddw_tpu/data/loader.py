@@ -12,9 +12,11 @@ Two semantics are load-bearing (SURVEY.md §2b.8, §7 hard-part 2):
   guarantee that under SPMD becomes "fixed shapes, same batch count on every host".
 
 This loader reads ddw_tpu table shards directly (no intermediate cache: the store's
-codec *is* the cache format), decodes/resizes JPEGs on a host-side thread pool
-(tf.data/petastorm worker-pool role), and prefetches batches to device HBM on a
-background thread (double buffering), so the TPU never waits on host IO.
+codec *is* the cache format), decodes/resizes JPEGs per batch in the native C++
+pipeline (:mod:`ddw_tpu.native.decode` — libjpeg + std::thread pool, one GIL
+release per batch; PIL thread-pool fallback — the tf.data/petastorm worker-pool
+role), and prefetches batches to device HBM on a background thread (double
+buffering), so the TPU never waits on host IO.
 
 Preprocessing is THE shared implementation for training and serving —
 :func:`preprocess_image` is the single decode path ``ddw_tpu.serving`` packages with
@@ -53,14 +55,7 @@ def bounded_map(pool: ThreadPoolExecutor, fn, iterable, window: int):
         yield pending.popleft().result()
 
 
-def preprocess_image(content: bytes, height: int, width: int) -> np.ndarray:
-    """JPEG bytes -> float32 [H, W, 3] in [-1, 1].
-
-    decode -> resize (bilinear) -> MobileNetV2-style scaling ``x/127.5 - 1``
-    (the ``tf.image.decode_jpeg`` + ``resize`` + ``preprocess_input`` chain,
-    reference ``02_model_training_single_node.py:119-126``). Single implementation
-    shared by the training loader and the packaged model's predict path.
-    """
+def _preprocess_image_pil(content: bytes, height: int, width: int) -> np.ndarray:
     from PIL import Image
 
     img = Image.open(BytesIO(content))
@@ -69,6 +64,38 @@ def preprocess_image(content: bytes, height: int, width: int) -> np.ndarray:
     img = img.resize((width, height), Image.BILINEAR)
     arr = np.asarray(img, dtype=np.float32)
     return arr / 127.5 - 1.0
+
+
+def active_decoder() -> str:
+    """Which decode impl :func:`preprocess_image` dispatches to here: ``native``
+    (libjpeg pipeline) or ``pil``. Serving packages record this at save time and
+    warn when the serving environment resolves differently (decoder skew)."""
+    from ddw_tpu.native.decode import native_available
+
+    return "native" if native_available() else "pil"
+
+
+def preprocess_image(content: bytes, height: int, width: int) -> np.ndarray:
+    """JPEG bytes -> float32 [H, W, 3] in [-1, 1].
+
+    decode -> resize (bilinear) -> MobileNetV2-style scaling ``x/127.5 - 1``
+    (the ``tf.image.decode_jpeg`` + ``resize`` + ``preprocess_input`` chain,
+    reference ``02_model_training_single_node.py:119-126``). Single
+    implementation shared by the training loader and the packaged model's
+    predict path. Dispatches to the native libjpeg pipeline
+    (:mod:`ddw_tpu.native.decode` — point-sampled bilinear, the
+    ``tf.image.resize`` semantics of the reference) when built, else PIL
+    (area-filtered bilinear); both sides of train/serve go through this same
+    dispatch, so train and serve agree whenever both environments resolve the
+    same impl; :func:`active_decoder` + the serving package manifest surface
+    the case where they don't.
+    """
+    from ddw_tpu.native.decode import decode_one_native
+
+    out = decode_one_native(content, height, width)
+    if out is not None:
+        return out
+    return _preprocess_image_pil(content, height, width)
 
 
 class ShardedLoader:
@@ -153,64 +180,89 @@ class ShardedLoader:
         return max(1, self.table.num_records // (self.batch_size * self.shard_count))
 
     # -- host pipeline ---------------------------------------------------------
-    def _iter_decoded(self) -> Iterator[tuple[np.ndarray, np.int32]]:
-        """Infinite (or num_epochs-bounded) stream of decoded records for this
-        worker, with epoch-varying shard shuffle + record shuffle buffer, decoding
-        on a thread pool."""
+    def _iter_raw(self) -> Iterator[tuple[bytes, int]]:
+        """Infinite (or num_epochs-bounded) stream of raw (content, label_idx)
+        records for this worker, with epoch-varying shard shuffle + record-level
+        shuffle buffer. Shuffling raw bytes (not decoded arrays) keeps the
+        buffer ~KB/record instead of ~MB/record."""
         epoch = 0
-        pool = ThreadPoolExecutor(max_workers=self.workers)
-        try:
-            while self.num_epochs is None or epoch < self.num_epochs:
-                rng = np.random.RandomState((self.seed * 100003 + epoch * 7919 + self.cur_shard) & 0x7FFFFFFF)
-                shards = list(self._my_shards)
-                if self.shuffle:
-                    rng.shuffle(shards)
+        while self.num_epochs is None or epoch < self.num_epochs:
+            rng = np.random.RandomState((self.seed * 100003 + epoch * 7919 + self.cur_shard) & 0x7FFFFFFF)
+            shards = list(self._my_shards)
+            if self.shuffle:
+                rng.shuffle(shards)
 
-                def records():
-                    for sp in shards:
-                        if self._record_stride is None:
-                            yield from read_shard_contents(sp)
-                        else:
-                            r, k = self._record_stride
-                            for i, entry in enumerate(read_shard_contents(sp)):
-                                if i % k == r:
-                                    yield entry
+            def records():
+                for sp in shards:
+                    if self._record_stride is None:
+                        yield from read_shard_contents(sp)
+                    else:
+                        r, k = self._record_stride
+                        for i, entry in enumerate(read_shard_contents(sp)):
+                            if i % k == r:
+                                yield entry
 
-                def decode(entry):
-                    content, label_idx = entry
-                    return (
-                        preprocess_image(content, self.height, self.width),
-                        np.int32(label_idx),
-                    )
-
-                stream = bounded_map(pool, decode, records(), self.workers * 4)
-                if not self.shuffle:
-                    yield from stream
-                else:
-                    buf = []
-                    for item in stream:
-                        buf.append(item)
-                        if len(buf) >= self.shuffle_buffer:
-                            j = rng.randint(len(buf))
-                            buf[j], buf[-1] = buf[-1], buf[j]
-                            yield buf.pop()
-                    rng.shuffle(buf)
-                    yield from buf
-                epoch += 1
-        finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+            if not self.shuffle:
+                yield from records()
+            else:
+                buf = []
+                for item in records():
+                    buf.append(item)
+                    if len(buf) >= self.shuffle_buffer:
+                        j = rng.randint(len(buf))
+                        buf[j], buf[-1] = buf[-1], buf[j]
+                        yield buf.pop()
+                rng.shuffle(buf)
+                yield from buf
+            epoch += 1
 
     def _iter_batches(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        from ddw_tpu.native.decode import decode_batch_native, native_available
+
         imgs = np.empty((self.batch_size, self.height, self.width, 3), np.float32)
         lbls = np.empty((self.batch_size,), np.int32)
-        i = 0
-        for img, lbl in self._iter_decoded():
-            imgs[i], lbls[i] = img, lbl
-            i += 1
-            if i == self.batch_size:
-                yield imgs.copy(), lbls.copy()
-                i = 0
-        # drop remainder: static shapes for XLA
+
+        if native_available():
+            # Native batch path: one C++ thread-pool call per batch (one GIL
+            # release, real OS-thread decode parallelism); per-image failures
+            # fall back to PIL.
+            contents: list[bytes] = []
+            for content, label_idx in self._iter_raw():
+                lbls[len(contents)] = label_idx
+                contents.append(content)
+                if len(contents) == self.batch_size:
+                    _, ok = decode_batch_native(
+                        contents, self.height, self.width,
+                        threads=self.workers, out=imgs)
+                    for j in np.nonzero(~ok)[0]:
+                        imgs[j] = _preprocess_image_pil(
+                            contents[j], self.height, self.width)
+                    yield imgs.copy(), lbls.copy()
+                    contents = []
+            return  # drop remainder: static shapes for XLA
+
+        # PIL path: decode on a Python thread pool (PIL releases the GIL in its
+        # C decode, so threads still overlap).
+        pool = ThreadPoolExecutor(max_workers=self.workers)
+        try:
+            def decode(entry):
+                content, label_idx = entry
+                return (
+                    preprocess_image(content, self.height, self.width),
+                    np.int32(label_idx),
+                )
+
+            i = 0
+            for img, lbl in bounded_map(pool, decode, self._iter_raw(),
+                                        self.workers * 4):
+                imgs[i], lbls[i] = img, lbl
+                i += 1
+                if i == self.batch_size:
+                    yield imgs.copy(), lbls.copy()
+                    i = 0
+            # drop remainder: static shapes for XLA
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def __iter__(self):
         """Yield batches; when ``prefetch_to`` is set, a background thread runs the

@@ -1,0 +1,75 @@
+"""Shared lazy g++ build/load for the native components (codec, decode pipeline).
+
+The toolchain (g++) is part of the environment contract; pybind11 is not, so all
+native modules use a plain C ABI loaded via ctypes. Build failures latch and
+callers fall back to pure-Python paths — native is a performance tier, never a
+correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+
+class LazyLibrary:
+    """Builds ``src`` -> ``lib`` with g++ on first use (if stale), then loads it.
+
+    ``configure(cdll)`` sets restype/argtypes once after load. Thread-safe;
+    concurrent processes build to a per-pid temp path and ``os.replace`` so no
+    process ever dlopens a half-written .so.
+    """
+
+    def __init__(self, src: str, lib: str, extra_flags: tuple[str, ...] = (),
+                 configure=None):
+        self.src = src
+        self.lib_path = lib
+        self.extra_flags = tuple(extra_flags)
+        self.configure = configure
+        self._lock = threading.Lock()
+        self._lib: ctypes.CDLL | None = None
+        self._failed = False
+
+    def _build(self) -> bool:
+        tmp = f"{self.lib_path}.{os.getpid()}.tmp"
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", self.src,
+                 "-o", tmp, *self.extra_flags],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, self.lib_path)
+            return True
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+    def load(self) -> ctypes.CDLL | None:
+        with self._lock:
+            if self._lib is not None or self._failed:
+                return self._lib
+            try:
+                stale = (not os.path.exists(self.lib_path)
+                         or os.path.getmtime(self.lib_path) < os.path.getmtime(self.src))
+            except OSError:
+                # source missing (deployment shipping only the built .so): use
+                # the existing library if present, else latch the failure.
+                stale = not os.path.exists(self.lib_path)
+            if stale and not self._build():
+                self._failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(self.lib_path)
+                if self.configure is not None:
+                    self.configure(lib)
+                self._lib = lib
+            except Exception:
+                self._failed = True
+        return self._lib
+
+    def available(self) -> bool:
+        return self.load() is not None

@@ -17,18 +17,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 
 from ddw_tpu.data.store import Record
+from ddw_tpu.native.build import LazyLibrary
 
 _HERE = os.path.dirname(__file__)
-_SRC = os.path.join(_HERE, "codec.cpp")
-_LIB = os.path.join(_HERE, "libddwcodec.so")
-
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_load_failed = False
 
 
 class _RecordIndex(ctypes.Structure):
@@ -40,59 +33,30 @@ class _RecordIndex(ctypes.Structure):
     ]
 
 
-def _build() -> bool:
-    # Build to a per-pid temp path then rename: concurrent processes (the
-    # multi-process launcher, parallel tests) must never CDLL a half-written .so,
-    # and two g++ runs must not interleave writes into the final path.
-    tmp = f"{_LIB}.{os.getpid()}.tmp"
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
-            check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)
-        return True
-    except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.ddws_index_shard.restype = ctypes.c_int64
+    lib.ddws_index_shard.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(_RecordIndex), ctypes.c_int64]
+    lib.ddws_count_records.restype = ctypes.c_int64
+    lib.ddws_count_records.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.ddws_validate.restype = ctypes.c_int64
+    lib.ddws_validate.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+
+
+_library = LazyLibrary(
+    src=os.path.join(_HERE, "codec.cpp"),
+    lib=os.path.join(_HERE, "libddwcodec.so"),
+    configure=_configure,
+)
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib, _load_failed
-    with _lock:
-        if _lib is not None or _load_failed:
-            return _lib
-        try:
-            stale = (not os.path.exists(_LIB)
-                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
-        except OSError:
-            # codec.cpp missing (e.g. a deployment shipping only the built .so):
-            # use the existing library if present, else latch the failure.
-            stale = not os.path.exists(_LIB)
-        if stale:
-            if not _build():
-                _load_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-            lib.ddws_index_shard.restype = ctypes.c_int64
-            lib.ddws_index_shard.argtypes = [
-                ctypes.c_char_p, ctypes.c_int64,
-                ctypes.POINTER(_RecordIndex), ctypes.c_int64]
-            lib.ddws_count_records.restype = ctypes.c_int64
-            lib.ddws_count_records.argtypes = [ctypes.c_char_p, ctypes.c_int64]
-            lib.ddws_validate.restype = ctypes.c_int64
-            lib.ddws_validate.argtypes = [ctypes.c_char_p, ctypes.c_int64]
-            _lib = lib
-        except Exception:
-            _load_failed = True
-    return _lib
+    return _library.load()
 
 
 def native_available() -> bool:
-    return _load() is not None
+    return _library.available()
 
 
 def _index(path: str):

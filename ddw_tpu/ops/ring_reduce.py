@@ -39,6 +39,22 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _LANE = 128  # TPU lane tile; chunks are padded to this multiple
+_VMEM_BUDGET_BYTES = 8 * 2**20  # per-kernel budget for in + out + comm scratch
+
+
+def ring_chunks(x: jax.Array, n: int, lane: int = 1) -> jax.Array:
+    """Ring framing shared by the ppermute and RDMA rings: flatten and zero-pad
+    ``x`` into ``(n, chunk)`` with ``chunk`` a multiple of ``lane``."""
+    flat = x.reshape(-1)
+    chunk = -(-flat.size // n)           # ceil
+    chunk = -(-chunk // lane) * lane
+    flat = jnp.pad(flat, (0, n * chunk - flat.size))
+    return flat.reshape(n, chunk)
+
+
+def ring_unchunk(out: jax.Array, orig_shape: tuple[int, ...], size: int) -> jax.Array:
+    """Inverse of :func:`ring_chunks`: drop padding, restore the shape."""
+    return out.reshape(-1)[:size].reshape(orig_shape)
 
 
 def _kernel(x_ref, o_ref, snd_buf, rs_buf, ag_buf, rs_send, rs_recv, ag_send,
@@ -112,27 +128,44 @@ def ring_all_reduce_pallas(x: jax.Array, axis_name: str,
 
     orig_shape, orig_dtype = x.shape, x.dtype
     acc_dtype = jnp.float32 if orig_dtype in (jnp.bfloat16, jnp.float16) else orig_dtype
-    flat = x.astype(acc_dtype).reshape(-1)
-    chunk = -(-flat.size // n)           # ceil
-    chunk = -(-chunk // _LANE) * _LANE   # pad to lane multiple
-    flat = jnp.pad(flat, (0, n * chunk - flat.size))
-    x2d = flat.reshape(n, chunk)
+    x2d = ring_chunks(x.astype(acc_dtype), n, lane=_LANE)
+    chunk = x2d.shape[1]
 
-    scratch = [
-        pltpu.VMEM((1, chunk), acc_dtype),          # snd_buf
-        pltpu.VMEM((n - 1, 1, chunk), acc_dtype),   # rs_buf
-        pltpu.VMEM((n - 1, 1, chunk), acc_dtype),   # ag_buf
-        pltpu.SemaphoreType.DMA((n - 1,)),          # rs_send
-        pltpu.SemaphoreType.DMA((n - 1,)),          # rs_recv
-        pltpu.SemaphoreType.DMA((n - 1,)),          # ag_send
-        pltpu.SemaphoreType.DMA((n - 1,)),          # ag_recv
-    ]
-    out = pl.pallas_call(
-        functools.partial(_kernel, axis_name=axis_name, n=n),
-        out_shape=jax.ShapeDtypeStruct((n, chunk), acc_dtype),
-        scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
-            collective_id=collective_id, has_side_effects=True),
-        interpret=interpret,
-    )(x2d)
-    return out.reshape(-1)[:x.size].reshape(orig_shape).astype(orig_dtype)
+    def one_ring(seg):
+        seg_chunk = seg.shape[1]
+        scratch = [
+            pltpu.VMEM((1, seg_chunk), acc_dtype),          # snd_buf
+            pltpu.VMEM((n - 1, 1, seg_chunk), acc_dtype),   # rs_buf
+            pltpu.VMEM((n - 1, 1, seg_chunk), acc_dtype),   # ag_buf
+            pltpu.SemaphoreType.DMA((n - 1,)),              # rs_send
+            pltpu.SemaphoreType.DMA((n - 1,)),              # rs_recv
+            pltpu.SemaphoreType.DMA((n - 1,)),              # ag_send
+            pltpu.SemaphoreType.DMA((n - 1,)),              # ag_recv
+        ]
+        return pl.pallas_call(
+            functools.partial(_kernel, axis_name=axis_name, n=n),
+            out_shape=jax.ShapeDtypeStruct((n, seg_chunk), acc_dtype),
+            scratch_shapes=scratch,
+            compiler_params=pltpu.CompilerParams(
+                collective_id=collective_id, has_side_effects=True),
+            interpret=interpret,
+        )(seg)
+
+    # VMEM budget: in + out (n*chunk each) + comm scratch (~2n*chunk) live at
+    # once, so large arrays run as sequential chunk segments. Segments chain
+    # through a zero-valued data dependency so XLA cannot overlap two ring
+    # kernels sharing one collective_id/barrier semaphore.
+    elem = jnp.dtype(acc_dtype).itemsize
+    max_seg = max(_LANE, _VMEM_BUDGET_BYTES // (4 * n * elem) // _LANE * _LANE)
+    if chunk <= max_seg:
+        out = one_ring(x2d)
+    else:
+        parts = []
+        carry = jnp.zeros((), acc_dtype)
+        for s in range(0, chunk, max_seg):
+            seg = lax.dynamic_slice_in_dim(x2d, s, min(max_seg, chunk - s), axis=1)
+            part = one_ring(seg + carry)
+            carry = part[0, 0] * 0
+            parts.append(part)
+        out = jnp.concatenate(parts, axis=1)
+    return ring_unchunk(out, orig_shape, x.size).astype(orig_dtype)

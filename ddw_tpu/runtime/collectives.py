@@ -90,15 +90,14 @@ def ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
     Arrays whose size is not divisible by the axis size are zero-padded for the
     ring and sliced back; returns the full reduced array on every participant.
     """
+    from ddw_tpu.ops.ring_reduce import ring_chunks
+
     n = lax.axis_size(axis_name)
     if n == 1:
         return x
     me = lax.axis_index(axis_name)
     orig_shape = x.shape
-    flat = jnp.reshape(x, (-1,))
-    chunk = -(-flat.size // n)
-    flat = jnp.pad(flat, (0, n * chunk - flat.size))
-    chunks = jnp.reshape(flat, (n, chunk))  # chunk c is reduced by rank (c-1) % n
+    chunks = ring_chunks(x, n)  # chunk c is reduced by rank (c-1) % n
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -123,7 +122,9 @@ def ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
     out = jnp.zeros_like(chunks)
     for k in range(n):
         out = out.at[(me - k + 1) % n].set(gathered[k])
-    return jnp.reshape(out, (-1,))[:x.size].reshape(orig_shape)
+    from ddw_tpu.ops.ring_reduce import ring_unchunk
+
+    return ring_unchunk(out, orig_shape, x.size)
 
 
 def ring_all_reduce_pallas(x: jax.Array, axis_name: str, **kwargs) -> jax.Array:

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
-from ddw_tpu.data.loader import preprocess_image
+from ddw_tpu.data.loader import active_decoder, preprocess_image
 from ddw_tpu.models.registry import build_model
 from ddw_tpu.utils.config import ModelCfg
 
@@ -58,6 +58,9 @@ def save_packaged_model(
         "classes": list(classes),
         "img_height": img_height,
         "img_width": img_width,
+        # decode impl the training side used; load warns if serving resolves
+        # differently (native point-bilinear vs PIL filtered-bilinear skew)
+        "preprocess_impl": active_decoder(),
         **(extra_meta or {}),
     }
     with open(os.path.join(out_dir, "package.json"), "w") as f:
@@ -90,6 +93,15 @@ class PackagedModel:
         self.model_cfg = ModelCfg(**self.meta["model_cfg"])
         self.classes: list[str] = self.meta["classes"]
         self.height, self.width = self.meta["img_height"], self.meta["img_width"]
+        trained_with = self.meta.get("preprocess_impl")
+        if trained_with and trained_with != active_decoder():
+            import warnings
+
+            warnings.warn(
+                f"packaged model was trained with the {trained_with!r} image "
+                f"decoder but this environment resolves {active_decoder()!r}; "
+                f"decoded pixels differ slightly (train/serve preprocessing "
+                f"skew)", stacklevel=2)
         self.model = build_model(self.model_cfg)
         with open(os.path.join(model_dir, "params.msgpack"), "rb") as f:
             restored = serialization.msgpack_restore(f.read())

@@ -193,3 +193,24 @@ def test_pallas_ring_all_reduce_multi_axis_mesh():
         ref = x[d].sum(axis=0)
         for s in range(4):
             np.testing.assert_allclose(out[d, s], ref, rtol=1e-5)
+
+
+def test_pallas_ring_all_reduce_segments_large_arrays(monkeypatch):
+    """Arrays over the VMEM budget run as chained sequential ring segments."""
+    import ddw_tpu.ops.ring_reduce as rr
+
+    # shrink the budget so a modest array needs several segments:
+    # max_seg = max(128, budget // (4*n*4) // 128 * 128) -> 128 elems
+    monkeypatch.setattr(rr, "_VMEM_BUDGET_BYTES", 4 * 128 * 4 * 4)
+    n = 4
+    mesh = make_mesh(MeshSpec((("data", n),)), devices=jax.devices()[:n])
+    rng = np.random.RandomState(11)
+    x = rng.randn(n, 4 * 560).astype(np.float32)  # chunk 560 -> 5 segments
+
+    fn = jax.jit(jax.shard_map(
+        lambda xs: rr.ring_all_reduce_pallas(xs[0], "data")[None],
+        mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))
+    out = np.asarray(fn(x))
+    ref = x.sum(axis=0)
+    for i in range(n):
+        np.testing.assert_allclose(out[i], ref, rtol=1e-5, atol=1e-5)
