@@ -54,6 +54,22 @@ def _small_cnn(cfg: ModelCfg):
     return SmallCNN(num_classes=cfg.num_classes, dropout=cfg.dropout, dtype=_dtype(cfg))
 
 
+@register_model("resnet18")
+@register_model("resnet34")
+@register_model("resnet50")
+def _resnet(cfg: ModelCfg):
+    from ddw_tpu.models.resnet import ResNet
+
+    return ResNet(
+        num_classes=cfg.num_classes,
+        depth=int(cfg.name.removeprefix("resnet")),
+        width_mult=cfg.width_mult,
+        dropout=cfg.dropout,
+        freeze_base=cfg.freeze_base,
+        dtype=_dtype(cfg),
+    )
+
+
 @register_model("vit")
 def _vit(cfg: ModelCfg):
     from ddw_tpu.models.vit import ViT

@@ -1,0 +1,128 @@
+"""ResNet (v1.5) — the second CNN family of the model zoo.
+
+The reference's zoo is exactly one architecture (MobileNetV2 transfer,
+``02_model_training_single_node.py:159-178``); ResNet exists so the trainer /
+serving / HPO stack is demonstrably model-agnostic beyond that contract. Same
+head shape as the other families (features -> GAP -> Dropout -> Dense logits)
+and the same ``backbone_*`` naming + ``frozen_prefixes`` protocol, so transfer
+mode, checkpoints, and packaging work unchanged.
+
+v1.5 detail: the stride-2 downsample sits on the 3x3 conv (not the first 1x1)
+— the variant every modern benchmark suite ships. BN statistics are
+``batch_stats`` collections; the DP train step pmean's them across the mesh
+(world-consistent BN, ddw_tpu.train.step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# depth -> (block counts per stage, bottleneck?)
+_CONFIGS = {
+    18: ((2, 2, 2, 2), False),
+    34: ((3, 4, 6, 3), False),
+    50: ((3, 4, 6, 3), True),
+}
+
+
+class _ConvBN(nn.Module):
+    features: int
+    kernel: tuple[int, int] = (3, 3)
+    strides: int = 1
+    act: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32)(x)
+        return nn.relu(x) if self.act else x
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        h = _ConvBN(self.features, strides=self.strides, dtype=self.dtype)(x, train)
+        h = _ConvBN(self.features, act=False, dtype=self.dtype)(h, train)
+        if x.shape[-1] != self.features or self.strides != 1:
+            x = _ConvBN(self.features, (1, 1), strides=self.strides, act=False,
+                        dtype=self.dtype, name="proj")(x, train)
+        return nn.relu(x + h)
+
+
+class BottleneckBlock(nn.Module):
+    features: int  # bottleneck width; output is 4x
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        out_ch = self.features * 4
+        h = _ConvBN(self.features, (1, 1), dtype=self.dtype)(x, train)
+        # v1.5: stride on the 3x3
+        h = _ConvBN(self.features, strides=self.strides, dtype=self.dtype)(h, train)
+        h = _ConvBN(out_ch, (1, 1), act=False, dtype=self.dtype)(h, train)
+        if x.shape[-1] != out_ch or self.strides != 1:
+            x = _ConvBN(out_ch, (1, 1), strides=self.strides, act=False,
+                        dtype=self.dtype, name="proj")(x, train)
+        return nn.relu(x + h)
+
+
+class ResNetBackbone(nn.Module):
+    depth: int = 50
+    width_mult: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        counts, bottleneck = _CONFIGS[self.depth]
+        block = BottleneckBlock if bottleneck else BasicBlock
+        width = int(64 * self.width_mult)
+        x = _ConvBN(width, (7, 7), strides=2, dtype=self.dtype, name="stem")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(counts):
+            feats = width * (2 ** stage)
+            for i in range(n_blocks):
+                x = block(feats, strides=2 if (stage > 0 and i == 0) else 1,
+                          dtype=self.dtype,
+                          name=f"stage{stage}_block{i}")(x, train)
+        return x
+
+
+class ResNet(nn.Module):
+    """Backbone + the zoo-standard transfer head (GAP -> Dropout -> Dense)."""
+
+    num_classes: int = 5
+    depth: int = 50
+    width_mult: float = 1.0
+    dropout: float = 0.5
+    freeze_base: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        base_train = train and not self.freeze_base
+        feats = ResNetBackbone(self.depth, self.width_mult, self.dtype,
+                               name="backbone")(x, base_train)
+        if self.freeze_base:
+            # Keras trainable=False semantics: no gradients through the base
+            # (same contract as MobileNetV2; XLA drops the backbone backward).
+            feats = jax.lax.stop_gradient(feats)
+        h = jnp.mean(feats.astype(jnp.float32), axis=(1, 2))
+        h = nn.Dropout(self.dropout, deterministic=not train, name="head_dropout")(h)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(h)
+
+    @staticmethod
+    def frozen_prefixes(freeze_base: bool) -> tuple[str, ...]:
+        return ("backbone",) if freeze_base else ()

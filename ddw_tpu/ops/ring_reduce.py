@@ -153,19 +153,19 @@ def ring_all_reduce_pallas(x: jax.Array, axis_name: str,
 
     # VMEM budget: in + out (n*chunk each) + comm scratch (~2n*chunk) live at
     # once, so large arrays run as sequential chunk segments. Segments chain
-    # through a zero-valued data dependency so XLA cannot overlap two ring
-    # kernels sharing one collective_id/barrier semaphore.
+    # through lax.optimization_barrier (a data edge the simplifier cannot fold
+    # away, unlike mul-by-zero on integer dtypes) so XLA cannot overlap two
+    # ring kernels sharing one collective_id/barrier semaphore.
     elem = jnp.dtype(acc_dtype).itemsize
     max_seg = max(_LANE, _VMEM_BUDGET_BYTES // (4 * n * elem) // _LANE * _LANE)
     if chunk <= max_seg:
         out = one_ring(x2d)
     else:
         parts = []
-        carry = jnp.zeros((), acc_dtype)
         for s in range(0, chunk, max_seg):
             seg = lax.dynamic_slice_in_dim(x2d, s, min(max_seg, chunk - s), axis=1)
-            part = one_ring(seg + carry)
-            carry = part[0, 0] * 0
-            parts.append(part)
+            if parts:
+                seg, _ = lax.optimization_barrier((seg, parts[-1]))
+            parts.append(one_ring(seg))
         out = jnp.concatenate(parts, axis=1)
     return ring_unchunk(out, orig_shape, x.size).astype(orig_dtype)

@@ -1,0 +1,57 @@
+"""Smoke-run every contract example end-to-end in subprocess order.
+
+The reference's de-facto integration test is its notebook chain — downstream
+notebooks break if upstream contracts do (SURVEY.md §4.3). This formalizes it:
+each example runs --quick against one shared workdir, in dependency order, on
+the virtual 8-device CPU mesh, with tiny override configs.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (script, extra overrides, must-appear output fragment)
+_EXAMPLES = [
+    ("01_data_prep.py", [], "silver_train"),
+    ("02_train_single_node.py", ["train.epochs=1"], "val_accuracy"),
+    ("03_train_distributed.py", ["train.epochs=1"], "world=8"),
+    ("04_hyperopt_parallel.py",
+     ["tune.max_evals=2", "tune.parallelism=2", "train.epochs=1"], "best"),
+    ("05_hyperopt_distributed.py",
+     ["tune.max_evals=2", "train.epochs=1"], "best"),
+    ("06_packaged_inference.py", ["train.epochs=1"], "distributed scoring"),
+    ("07_lm_long_context.py", ["--steps", "3"], "final:"),
+]
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("workshop"))
+
+
+@pytest.mark.parametrize("script,extra,expect",
+                         _EXAMPLES, ids=[e[0] for e in _EXAMPLES])
+def test_example_runs(script, extra, expect, workdir):
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": REPO,
+    })
+    cmd = [sys.executable, os.path.join(REPO, "examples", script), "--quick"]
+    if script.startswith("07"):
+        cmd += extra  # LM example has no workdir/tables
+    else:
+        cmd += ["--workdir", workdir, *extra]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}")
+    assert expect in proc.stdout, (
+        f"{script}: expected {expect!r} in output\n{proc.stdout[-2000:]}")

@@ -120,3 +120,27 @@ def test_eval_step_deterministic():
     m1 = ev(state, imgs, lbls)
     m2 = ev(state, imgs, lbls)
     assert float(m1["loss"]) == float(m2["loss"])  # dropout off in eval
+
+
+@pytest.mark.parametrize("name", ["resnet18", "resnet50"])
+def test_resnet_family_trains(name):
+    """ResNet zoo entries: init, DP step with BN stats pmean, loss decreases,
+    frozen-base protocol present."""
+    from ddw_tpu.models.resnet import ResNet
+
+    mesh = make_mesh(MeshSpec((("data", 2),)), devices=jax.devices()[:2])
+    mcfg = ModelCfg(name=name, num_classes=5, dropout=0.0, width_mult=0.25,
+                    dtype="float32", freeze_base=False)
+    tcfg = TrainCfg(batch_size=4, learning_rate=1e-2, optimizer="adam")
+    m = build_model(mcfg)
+    assert isinstance(m, ResNet)
+    state, tx = init_state(m, mcfg, tcfg, IMG, jax.random.PRNGKey(0))
+    assert state.batch_stats, "resnet must carry BN batch_stats"
+    step = make_train_step(m, tx, mesh, donate=False)
+    imgs, lbls = _batch(8)
+    losses = []
+    for i in range(8):
+        state, metrics = step(state, imgs, lbls, jax.random.PRNGKey(i))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert ResNet.frozen_prefixes(True) == ("backbone",)
