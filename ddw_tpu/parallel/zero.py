@@ -1,0 +1,135 @@
+"""ZeRO-1 optimizer-state sharding over the data axis (GSPMD formulation).
+
+Not in the reference — its optimizer state is fully replicated (SURVEY.md §2d
+"ZeRO/FSDP-style optimizer sharding: NO") — but sharded optimizer state is a
+first-class capability of this framework: Adam moments are 2x the param bytes,
+and on a data-parallel mesh each replica only needs 1/N of them.
+
+TPU-idiomatic formulation (the scaling-book recipe): keep params and batch
+replicated-over-``data`` exactly as the plain DP step does, but annotate every
+optimizer-state leaf with a sharding that splits its largest divisible dimension
+over the data axis. XLA's GSPMD partitioner then derives the rest: the gradient
+all-reduce becomes reduce-scatter into the moment shards, each device updates
+only its slice, and the parameter update all-gathers back to replicated — the
+ZeRO-1 communication schedule, emitted by the compiler instead of hand-written.
+
+Leaves with no dimension divisible by the axis size (e.g. 3x3 conv kernels with
+leading dim 3) stay replicated — correctness is unaffected, only their memory
+saving is forfeited. ``zero_fraction_sharded`` reports the coverage.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddw_tpu.runtime.mesh import DATA_AXIS
+from ddw_tpu.train.step import TrainState, apply_gradients, forward_and_grads
+
+
+def _leaf_spec(shape: tuple[int, ...], n: int, axis: str) -> P:
+    """Shard the largest dimension divisible by ``n``; replicate if none."""
+    best = None
+    for d, s in enumerate(shape):
+        if s % n == 0 and s >= n and (best is None or s > shape[best]):
+            best = d
+    if best is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = axis
+    return P(*spec)
+
+
+def zero_state_shardings(state: TrainState, mesh: Mesh,
+                         axis: str = DATA_AXIS) -> TrainState:
+    """Shardings for a TrainState under ZeRO-1: params/batch_stats/step
+    replicated, optimizer-state leaves sharded over ``axis``."""
+    n = mesh.shape[axis]
+    repl = NamedSharding(mesh, P())
+
+    def opt_spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(mesh, _leaf_spec(tuple(shape), n, axis))
+
+    return TrainState(
+        params=jax.tree.map(lambda _: repl, state.params),
+        batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+        opt_state=jax.tree.map(opt_spec, state.opt_state),
+        step=repl,
+    )
+
+
+def zero_fraction_sharded(state: TrainState, mesh: Mesh,
+                          axis: str = DATA_AXIS) -> float:
+    """Fraction of optimizer-state elements whose leaves actually shard."""
+    n = mesh.shape[axis]
+    total = sharded = 0
+    for leaf in jax.tree.leaves(state.opt_state):
+        size = getattr(leaf, "size", 0)
+        if not size:
+            continue
+        total += size
+        if _leaf_spec(tuple(leaf.shape), n, axis) != P():
+            sharded += size
+    return sharded / total if total else 0.0
+
+
+def make_zero_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+) -> Callable:
+    """DP train step with ZeRO-1 sharded optimizer state.
+
+    Same call contract as :func:`ddw_tpu.train.step.make_train_step` (state,
+    images, labels, rng) -> (state, metrics) with the batch sharded over
+    ``axis`` — but optimizer moments live sharded; call
+    ``step.place_state(state)`` once before the first step.
+
+    Semantics difference for BatchNorm models: this global-view GSPMD program
+    normalizes over the **global** batch (sync-BN — XLA inserts per-layer
+    mean/var all-reduces), whereas the shard_map DP step normalizes per local
+    shard and only pmean's the running statistics. Sync-BN is the statistically
+    stronger choice but costs per-layer collectives; stateless-norm models
+    (GroupNorm/LayerNorm) match the DP step exactly.
+    """
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(axis))
+
+    def _step(state: TrainState, images, labels, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+        loss, acc, new_bs, grads = forward_and_grads(
+            model, state, images, labels, dropout_rng)
+        # No explicit psum: the batch is sharded and params are replicated, so
+        # GSPMD inserts the gradient reduction — reduce-scatter into the
+        # sharded moments, all-gather after the update (the ZeRO-1 schedule).
+        new_state = apply_gradients(state, tx, grads, new_bs)
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    def place_state(state: TrainState) -> TrainState:
+        sh = zero_state_shardings(state, mesh, axis)
+        return jax.tree.map(jax.device_put, state, sh)
+
+    _jit = None  # built on first call (shardings depend on the state structure)
+
+    def stepper(state, images, labels, rng):
+        nonlocal _jit
+        if _jit is None:
+            state_sh = zero_state_shardings(state, mesh, axis)
+            _jit = jax.jit(
+                _step,
+                in_shardings=(state_sh, batch_sh, batch_sh, repl),
+                out_shardings=(state_sh, repl),
+                donate_argnums=(0,) if donate else (),
+            )
+        return _jit(state, images, labels, rng)
+
+    stepper.place_state = place_state  # type: ignore[attr-defined]
+    stepper.batch_sharding = batch_sh  # type: ignore[attr-defined]
+    return stepper

@@ -139,6 +139,41 @@ def set_lr(state: TrainState, lr: float) -> TrainState:
     return state.replace(opt_state=os_._replace(hyperparams=new_hp))
 
 
+def forward_and_grads(model, state: TrainState, images, labels, dropout_rng):
+    """Shared step core: forward, loss/accuracy, backward.
+
+    Returns ``(loss, acc, new_batch_stats, grads)``. Used by the shard_map DP
+    step here and the GSPMD ZeRO step (``ddw_tpu.parallel.zero``) so the
+    training contract (loss fn, metric definitions, BN plumbing) lives once.
+    """
+    def loss_fn(params):
+        variables = {"params": params}
+        mutable = False
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+            mutable = ["batch_stats"]
+        out = model.apply(
+            variables, images, train=True,
+            rngs={"dropout": dropout_rng},
+            mutable=mutable,
+        )
+        logits, new_vars = out if mutable else (out, {})
+        loss = cross_entropy_loss(logits, labels)
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, (acc, new_vars.get("batch_stats", state.batch_stats))
+
+    (loss, (acc, new_bs)), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+    return loss, acc, new_bs, grads
+
+
+def apply_gradients(state: TrainState, tx: optax.GradientTransformation,
+                    grads, new_batch_stats) -> TrainState:
+    """Shared step core: optimizer update + state advance."""
+    updates, new_opt = tx.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    return TrainState(new_params, new_batch_stats, new_opt, state.step + 1)
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -155,37 +190,18 @@ def make_train_step(
     def _step(state: TrainState, images, labels, rng):
         me = lax.axis_index(axis_name)
         dropout_rng = jax.random.fold_in(jax.random.fold_in(rng, me), state.step)
-
-        def loss_fn(params):
-            variables = {"params": params}
-            mutable = False
-            if state.batch_stats:
-                variables["batch_stats"] = state.batch_stats
-                mutable = ["batch_stats"]
-            out = model.apply(
-                variables, images, train=True,
-                rngs={"dropout": dropout_rng},
-                mutable=mutable,
-            )
-            logits, new_vars = out if mutable else (out, {})
-            loss = cross_entropy_loss(logits, labels)
-            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
-            return loss, (acc, new_vars.get("batch_stats", state.batch_stats))
-
-        (loss, (acc, new_bs)), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        loss, acc, new_bs, grads = forward_and_grads(
+            model, state, images, labels, dropout_rng)
         # THE collective: gradient averaging across the data axis
         # (hvd.DistributedOptimizer role, reference :302).
         grads = lax.pmean(grads, axis_name)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
         if state.batch_stats:
             new_bs = lax.pmean(new_bs, axis_name)  # world-consistent BN statistics
         metrics = {
             "loss": lax.pmean(loss, axis_name),
             "accuracy": lax.pmean(acc, axis_name),
         }
-        new_state = TrainState(new_params, new_bs, new_opt, state.step + 1)
-        return new_state, metrics
+        return apply_gradients(state, tx, grads, new_bs), metrics
 
     n_data = mesh.shape[axis_name]
     repl = P()
