@@ -27,7 +27,19 @@ def build_model(cfg: ModelCfg):
     """Instantiate the flax module named by ``cfg.name``."""
     if cfg.name not in MODEL_REGISTRY:
         raise KeyError(f"unknown model {cfg.name!r}; have {sorted(MODEL_REGISTRY)}")
-    return MODEL_REGISTRY[cfg.name](cfg)
+    model = MODEL_REGISTRY[cfg.name](cfg)
+    if (cfg.freeze_base and not cfg.pretrained_path
+            and type(model).frozen_prefixes(True)):
+        # freeze_base defaults True for the reference's transfer contract; a
+        # frozen *random* backbone trains only the head over noise features.
+        import warnings
+
+        warnings.warn(
+            f"{cfg.name}: freeze_base=True with no pretrained_path freezes a "
+            f"randomly initialized backbone (accuracy will stay near chance); "
+            f"set model.freeze_base=false or provide pretrained weights",
+            stacklevel=2)
+    return model
 
 
 def _dtype(cfg: ModelCfg):
@@ -60,16 +72,6 @@ def _small_cnn(cfg: ModelCfg):
 def _resnet(cfg: ModelCfg):
     from ddw_tpu.models.resnet import ResNet
 
-    if cfg.freeze_base and not cfg.pretrained_path:
-        # freeze_base defaults True for the MobileNetV2 transfer contract; a
-        # frozen *random* backbone trains only the head over noise features.
-        import warnings
-
-        warnings.warn(
-            f"{cfg.name}: freeze_base=True with no pretrained_path freezes a "
-            f"randomly initialized backbone (accuracy will stay near chance); "
-            f"set model.freeze_base=false or provide pretrained weights",
-            stacklevel=2)
     return ResNet(
         num_classes=cfg.num_classes,
         depth=int(cfg.name.removeprefix("resnet")),

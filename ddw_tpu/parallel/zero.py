@@ -92,12 +92,13 @@ def make_zero_train_step(
     ``axis`` — but optimizer moments live sharded; call
     ``step.place_state(state)`` once before the first step.
 
-    Semantics difference for BatchNorm models: this global-view GSPMD program
-    normalizes over the **global** batch (sync-BN — XLA inserts per-layer
-    mean/var all-reduces), whereas the shard_map DP step normalizes per local
-    shard and only pmean's the running statistics. Sync-BN is the statistically
-    stronger choice but costs per-layer collectives; stateless-norm models
-    (GroupNorm/LayerNorm) match the DP step exactly.
+    Semantics differences vs the shard_map DP step: (1) BatchNorm models
+    normalize over the **global** batch here (sync-BN — XLA inserts per-layer
+    mean/var all-reduces), not per local shard; statistically stronger but
+    costs per-layer collectives. (2) Dropout masks are drawn from one stream
+    over the global batch, not per-replica folded streams. Both steps are
+    correct DP training; bit-exact equivalence with ``make_train_step`` holds
+    for stateless-norm models at dropout=0 (what the equivalence test pins).
     """
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(axis))

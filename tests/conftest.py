@@ -1,5 +1,7 @@
 """Shared fixtures: synthetic flowers tree, prepared silver tables, small configs."""
 
+import os
+
 import pytest
 
 from ddw_tpu.data.prep import generate_synthetic_flowers, prepare_flowers
@@ -22,6 +24,16 @@ def store(tmp_path_factory):
 def silver(flowers_dir, store):
     """(train_table, val_table, label_to_idx) over the synthetic tree."""
     return prepare_flowers(flowers_dir, store, sample_fraction=1.0, shard_size=16)
+
+
+@pytest.fixture()
+def worker_pythonpath(monkeypatch):
+    """Launcher workers import shipped fns by module name; put repo + tests on
+    their path (used by the multi-process launcher/trainer tests)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    parts = [repo, os.path.join(repo, "tests")] + ([existing] if existing else [])
+    monkeypatch.setenv("PYTHONPATH", os.pathsep.join(parts))
 
 
 @pytest.fixture()

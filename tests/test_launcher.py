@@ -7,7 +7,6 @@ collective, and the rank-0 return contract.
 """
 
 import functools
-import os
 
 import pytest
 
@@ -36,15 +35,6 @@ def test_local_mode_runs_in_process():
     assert out["process_index"] == 0
     # in-process: whatever backend the test session has
     assert out["psum"] == pytest.approx(2.0 * out["global_devices"])
-
-
-@pytest.fixture()
-def worker_pythonpath(monkeypatch):
-    """Workers import shipped fns by module name; put repo + tests on their path."""
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    existing = os.environ.get("PYTHONPATH", "")
-    parts = [repo, os.path.join(repo, "tests")] + ([existing] if existing else [])
-    monkeypatch.setenv("PYTHONPATH", os.pathsep.join(parts))
 
 
 def test_multiprocess_gang_and_rank0_return(worker_pythonpath):
