@@ -8,11 +8,13 @@ train/val split (seed 42, ``:162``) -> ``label_to_idx`` built from **sorted dist
 labels** (``:179-181``; sorting makes the index deterministic) -> silver_train /
 silver_val tables with a ``label_idx`` column (``:187-197,213-222``).
 
-The reference parallelizes the scan across Spark executors; here the hot loop is
-file IO batched across a process pool when the tree is large (ETL data-parallelism
-role, SURVEY.md §2d). Determinism contract: same source tree + seeds => identical
-split membership and identical label index, independent of worker count or
-filesystem enumeration order (we sort scanned paths before sampling).
+The reference parallelizes the scan across Spark executors; here the hot loop —
+per-file read IO — runs on a bounded thread pool (reads release the GIL; the
+ETL data-parallelism role, SURVEY.md §2d) with order-preserving windows.
+Determinism contract: same source tree + seeds => identical split membership
+and identical label index, independent of worker count or filesystem
+enumeration order (we sort scanned paths before sampling; parallel reads keep
+path order).
 
 Zero-egress testing: :func:`generate_synthetic_flowers` draws a 5-class synthetic
 "flowers" JPEG tree (tf_flowers layout: ``<root>/<class_name>/*.jpg``) with
@@ -76,21 +78,30 @@ def prepare_flowers(
     bronze_name: str = "flowers_bronze",
     train_name: str = "silver_train",
     val_name: str = "silver_val",
+    io_workers: int = 8,
 ) -> tuple[Table, Table, dict[str, int]]:
     """Full 01_data_prep pipeline: scan -> bronze -> label/split/index -> silver.
 
     Returns (silver_train, silver_val, label_to_idx). Split uses a seeded
     permutation of the bronze rows (the ``randomSplit([.9,.1], seed=42)`` role,
-    reference ``01_data_prep.py:162``).
+    reference ``01_data_prep.py:162``). ``io_workers`` parallelizes the raw
+    file reads (executor-scan role) without changing record order.
     """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ddw_tpu.data.loader import bounded_map
+
     paths = scan_jpeg_tree(source_dir, sample_fraction)
     if not paths:
         raise FileNotFoundError(f"no JPEGs under {source_dir}")
 
+    def read_one(p: str) -> Record:
+        with open(p, "rb") as f:
+            return Record(path=p, content=f.read())
+
     def bronze_records():
-        for p in paths:
-            with open(p, "rb") as f:
-                yield Record(path=p, content=f.read())
+        with ThreadPoolExecutor(max_workers=io_workers) as pool:
+            yield from bounded_map(pool, read_one, paths, io_workers * 4)
 
     bronze = store.write(bronze_name, bronze_records(), shard_size=shard_size,
                          meta={"source_dir": source_dir, "sample_fraction": sample_fraction})

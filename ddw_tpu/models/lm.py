@@ -35,22 +35,52 @@ class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
     seq_axis: str | None = None
+    decode: bool = False     # autoregressive mode: KV cache, one token per call
+    max_len: int = 2048      # cache capacity in decode mode
 
     @nn.compact
     def __call__(self, x):
-        d = x.shape[-1]
+        b, s, d = x.shape
         head_dim = d // self.num_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (self.num_heads, head_dim), dtype=self.dtype, name=name)
-        # [B, S, H, hd] -> [B, H, S, hd]
-        q = dense("query")(x).transpose(0, 2, 1, 3)
-        k = dense("key")(x).transpose(0, 2, 1, 3)
-        v = dense("value")(x).transpose(0, 2, 1, 3)
-        if self.seq_axis is not None:
-            out = ring_attention(q, k, v, self.seq_axis, causal=True)
+        q = dense("query")(x)   # [B, S, H, hd]
+        k = dense("key")(x)
+        v = dense("value")(x)
+
+        if self.decode:
+            # KV cache: accepts S tokens per call (S>1 = batched prefill, S=1 =
+            # per-token decode). Writes past max_len silently clamp
+            # (dynamic_update_slice semantics) — callers must bound total
+            # length, as generate() does.
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (b, self.max_len, self.num_heads, head_dim), k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (b, self.max_len, self.num_heads, head_dim), v.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            pos = idx.value
+            ck.value = lax.dynamic_update_slice(ck.value, k, (0, pos, 0, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, v, (0, pos, 0, 0))
+            idx.value = pos + s
+            # causally masked attention over the full (static-shape) cache
+            q32 = q.astype(jnp.float32) / float(head_dim) ** 0.5
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                                ck.value.astype(jnp.float32))
+            qpos = pos + jnp.arange(s)[None, None, :, None]
+            kpos = jnp.arange(self.max_len)[None, None, None, :]
+            scores = jnp.where(kpos <= qpos, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                             cv.value.astype(jnp.float32)).astype(x.dtype)
         else:
-            out = flash_attention(q, k, v, causal=True)
-        out = out.transpose(0, 2, 1, 3)  # [B, S, H, hd]
+            # [B, S, H, hd] -> [B, H, S, hd] for the batched kernels
+            qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            if self.seq_axis is not None:
+                out = ring_attention(qh, kh, vh, self.seq_axis, causal=True)
+            else:
+                out = flash_attention(qh, kh, vh, causal=True)
+            out = out.transpose(0, 2, 1, 3)  # [B, S, H, hd]
         return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(out)
 
 
@@ -60,12 +90,14 @@ class DecoderBlock(nn.Module):
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     seq_axis: str | None = None
+    decode: bool = False
+    max_len: int = 2048
 
     @nn.compact
     def __call__(self, x, train: bool):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         h = CausalSelfAttention(self.num_heads, self.dtype, self.seq_axis,
-                                name="attn")(h)
+                                self.decode, self.max_len, name="attn")(h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -95,6 +127,7 @@ class TransformerLM(nn.Module):
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     seq_axis: str | None = None
+    decode: bool = False     # KV-cached autoregressive mode (see generate())
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -103,7 +136,15 @@ class TransformerLM(nn.Module):
                      name="tok_embed")(tokens)
         pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
                                (self.max_len, self.hidden), jnp.float32)
-        if self.seq_axis is not None:
+        if self.decode:
+            # position = number of tokens already decoded (the attention layers
+            # keep per-layer indices; this top-level one feeds the pos embed).
+            # Past max_len the slice clamps silently — callers bound length.
+            pos_idx = self.variable("cache", "pos_index",
+                                    lambda: jnp.zeros((), jnp.int32))
+            offset = pos_idx.value
+            pos_idx.value = offset + s_local
+        elif self.seq_axis is not None:
             # Global length = s_local * axis_size must fit the position table:
             # dynamic_slice clamps out-of-range offsets, which would silently
             # reuse the last positions on trailing shards instead of failing.
@@ -119,7 +160,8 @@ class TransformerLM(nn.Module):
         x = x + pos.astype(self.dtype)[None]
         for i in range(self.depth):
             x = DecoderBlock(self.num_heads, self.mlp_dim, self.dropout,
-                             self.dtype, self.seq_axis,
+                             self.dtype, None if self.decode else self.seq_axis,
+                             self.decode, self.max_len,
                              name=f"backbone_block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # vocab head in f32: logits feed a softmax CE, keep full precision
@@ -136,3 +178,64 @@ def build_lm(cfg, seq_axis: str | None = None) -> TransformerLM:
         vocab_size=cfg.vocab_size, max_len=cfg.max_len, hidden=cfg.hidden,
         depth=cfg.depth, num_heads=cfg.num_heads, mlp_dim=cfg.mlp_dim,
         dropout=cfg.dropout, dtype=jnp.dtype(cfg.dtype), seq_axis=seq_axis)
+
+
+def init_cache(decode_model: TransformerLM, batch: int):
+    """Fresh zeroed KV cache for ``decode_model`` (constructed with
+    ``decode=True``). Shapes come from ``eval_shape`` (no param allocation or
+    forward run; ``init`` itself would *run* a decode step and leave the dummy
+    token in the returned cache)."""
+    shapes = jax.eval_shape(
+        lambda: decode_model.init({"params": jax.random.PRNGKey(0)},
+                                  jnp.zeros((batch, 1), jnp.int32)))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+
+def generate(model: TransformerLM, params, prompt, num_steps: int,
+             rng: jax.Array | None = None, temperature: float = 0.0):
+    """Autoregressive continuation via the KV-cached decode path.
+
+    ``prompt`` is int32 ``[B, P]``; returns ``[B, num_steps]`` continuation
+    tokens. Greedy when ``temperature == 0``, else categorical sampling with
+    ``rng``. Total length ``P + num_steps`` must fit ``model.max_len``.
+    Prefill is one batched causal forward (bulk K/V cache write); decode is a
+    ``lax.scan`` with O(1) per-token cost against the static-shape cache —
+    the whole thing jits to one XLA program.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, plen = prompt.shape
+    if plen + num_steps > model.max_len:
+        raise ValueError(f"prompt {plen} + steps {num_steps} exceeds "
+                         f"max_len {model.max_len}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature != 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    dm = model.clone(decode=True, seq_axis=None, dropout=0.0)
+    cache = init_cache(dm, b)
+
+    def run(cache, toks):
+        logits, vars_ = dm.apply({"params": params, "cache": cache},
+                                 toks, mutable=["cache"])
+        return vars_["cache"], logits
+
+    # Prefill: one batched causal forward writes the prompt's K/V in bulk.
+    cache, prefill_logits = run(cache, prompt)
+    last_logits = prefill_logits[:, -1]
+
+    def pick(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    keys = (jax.random.split(rng, num_steps) if rng is not None
+            else jnp.zeros((num_steps, 2), jnp.uint32))
+
+    def step(carry, key):
+        cache, logits = carry
+        tok = pick(logits, key)
+        cache, logits = run(cache, tok[:, None])
+        return (cache, logits[:, 0]), tok
+
+    (_, _), toks = lax.scan(step, (cache, last_logits), keys)
+    return toks.T  # [B, num_steps]

@@ -102,6 +102,15 @@ def main():
     print(f"final: loss={float(final['loss']):.4f} acc={float(final['accuracy']):.3f} "
           f"tokens/sec={tok_s:,.0f} ({dt:.1f}s for {steps} steps)")
 
+    # KV-cached greedy continuation (decode path; ddw_tpu.models.lm.generate)
+    from ddw_tpu.models.lm import generate
+
+    prompt = tokens[:1, :16]
+    cont = np.asarray(generate(model, state.params, prompt, num_steps=16))
+    match = float((cont[0] == tokens[0, 16:32]).mean())
+    print(f"generate: 16-token greedy continuation matches training stream "
+          f"{match:.0%}")
+
 
 if __name__ == "__main__":
     main()
