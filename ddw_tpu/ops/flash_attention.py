@@ -13,9 +13,10 @@ Design (Dao et al. flash attention, TPU-first):
   in bf16 or f32;
 - causal masking by global position (supports the ring-attention case where this
   rank's K block sits at a rotated global offset);
-- backward pass via ``jax.custom_vjp`` recompute from the O(S) residuals using the
-  reference einsum implementation — XLA fuses it well, and rematerialization is
-  the standard TPU trade (HBM bandwidth for FLOPs);
+- backward pass as two Pallas kernels (FA2 schedule): the forward saves the
+  per-row logsumexp; dQ streams K/V blocks, dK/dV streams Q/dO blocks, each
+  rematerializing p = exp(s - L) blockwise in VMEM — O(S) HBM for the whole
+  train step, the S x S matrices never exist in HBM;
 - ``interpret=True`` automatically off-TPU so the same code runs in CPU tests.
 """
 
@@ -38,6 +39,16 @@ def _on_tpu() -> bool:
         return False
 
 
+def _resolve_defaults(sm_scale, interpret, head_dim):
+    """Single place the primal, fwd-rule, and bwd-rule resolve their defaults —
+    a divergence here would silently scale/backend the two paths differently."""
+    if sm_scale is None:
+        sm_scale = 1.0 / float(head_dim) ** 0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+    return sm_scale, interpret
+
+
 def mha_reference(q, k, v, causal: bool = False, q_offset: int = 0,
                   k_offset: int = 0, sm_scale: float | None = None) -> jnp.ndarray:
     """Plain einsum attention — numerics oracle for the kernel and the VJP
@@ -54,7 +65,7 @@ def mha_reference(q, k, v, causal: bool = False, q_offset: int = 0,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                   block_k: int, causal: bool, q_offset: int, k_offset: int,
                   sm_scale: float, block_q: int):
     """One (batch*head, q-block, k-block) grid step of online-softmax attention.
@@ -110,10 +121,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(kb == num_kb - 1)
     def _finalize():
         o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        # logsumexp residual for the Pallas backward (FA2): L = m + log(l).
+        # Fully-masked rows keep L ~ _NEG_INF so backward p = exp(s - L) is
+        # re-zeroed there by the same s > _NEG_INF/2 guard.
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
 def _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale, block_q,
                    block_k, interpret):
+    """Returns (out, lse) with lse [B*H, Sq, 1] f32 (the backward residual)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
@@ -126,7 +142,7 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale, block_q,
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, q_offset=q_offset,
         k_offset=k_offset, sm_scale=sm_scale, block_q=block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q, sk // block_k),  # k innermost: scratch carries
         in_specs=[
@@ -137,9 +153,16 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale, block_q,
             pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -147,7 +170,7 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale, block_q,
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
@@ -161,29 +184,172 @@ def flash_attention(q, k, v, causal: bool = False, q_offset: int = 0,
     global positions of the local blocks (used by ring attention for causal
     masking across rotated K/V shards).
     """
-    if sm_scale is None:
-        sm_scale = 1.0 / float(q.shape[-1]) ** 0.5
-    if interpret is None:
-        interpret = not _on_tpu()
+    sm_scale, interpret = _resolve_defaults(sm_scale, interpret, q.shape[-1])
     return _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale,
-                          block_q, block_k, interpret)
+                          block_q, block_k, interpret)[0]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref, dq_scr,
+               *, block_q: int, block_k: int, causal: bool, q_offset: int,
+               k_offset: int, sm_scale: float):
+    """dQ pass (FA2 backward): grid (BH, q-blocks, k-blocks), K innermost.
+
+    p_ij = exp(s_ij - L_i) rematerialized per block from the saved logsumexp;
+    ds_ij = p_ij * (dO_i . v_j - D_i); dq_i += sm_scale * ds_ij k_j. The S x S
+    matrices exist only blockwise in VMEM.
+    """
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_last = q_offset + qi * block_q + block_q - 1
+    k_first = k_offset + kb * block_k
+    visible = (k_first <= q_last) if causal else True
+
+    @pl.when(visible)
+    def _accum():
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_offset + kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        if causal:
+            p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec_ref[0])
+        dq_scr[:] += sm_scale * jnp.dot(
+            ds.astype(q.dtype), k_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
+                dk_scr, dv_scr, *, block_q: int, block_k: int, causal: bool,
+                q_offset: int, k_offset: int, sm_scale: float):
+    """dK/dV pass: grid (BH, k-blocks, q-blocks), Q innermost.
+
+    dv_j += p_ij^T dO_i; dk_j += sm_scale * ds_ij^T q_i.
+    """
+    kj = pl.program_id(1)
+    qb = pl.program_id(2)
+    num_qb = pl.num_programs(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_last = q_offset + qb * block_q + block_q - 1
+    k_first = k_offset + kj * block_k
+    visible = (k_first <= q_last) if causal else True
+
+    @pl.when(visible)
+    def _accum():
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = q_offset + qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_offset + kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        if causal:
+            p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        dv_scr[:] += jnp.dot(p.astype(do.dtype).T, do,
+                             preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec_ref[0])
+        dk_scr[:] += sm_scale * jnp.dot(
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qb == num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _fwd(q, k, v, causal, q_offset, k_offset, sm_scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, q_offset, k_offset, sm_scale,
-                          block_q, block_k, interpret)
-    return out, (q, k, v)
+    sm_scale, interpret = _resolve_defaults(sm_scale, interpret, q.shape[-1])
+    out, lse = _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale,
+                              block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, q_offset, k_offset, sm_scale, block_q, block_k, interpret,
          residuals, g):
-    # Rematerialized backward through the reference computation: standard TPU
-    # FLOPs-for-HBM trade; O(S^2) scores exist only inside the fused backward.
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal, q_offset, k_offset,
-                                         sm_scale), q, k, v)
-    return vjp(g)
+    """Pallas FA2 backward: two block kernels (dQ; dK/dV) over the saved
+    logsumexp — O(S) memory, the S x S matrices never leave VMEM."""
+    q, k, v, out, lse = residuals
+    sm_scale, interpret = _resolve_defaults(sm_scale, interpret, q.shape[-1])
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    gr = g.reshape(b * h, sq, d)
+    # D_i = dO_i . O_i (the softmax-normalizer correction), cheap elementwise.
+    dvec = jnp.sum(gr.astype(jnp.float32) * out.reshape(b * h, sq, d).astype(jnp.float32),
+                   axis=-1, keepdims=True)
+
+    qspec = pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM)
+    qrow = pl.BlockSpec((1, bq, 1), lambda i, j, kb: (i, j, 0),
+                        memory_space=pltpu.VMEM)
+    kspec_stream = pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0),
+                                memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_q=bq, block_k=bk, causal=causal,
+                          q_offset=q_offset, k_offset=k_offset, sm_scale=sm_scale),
+        grid=(b * h, sq // bq, sk // bk),
+        in_specs=[qspec, kspec_stream, kspec_stream, qspec, qrow, qrow],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse, dvec)
+
+    kspec = pl.BlockSpec((1, bk, d), lambda i, j, qb: (i, j, 0),
+                         memory_space=pltpu.VMEM)
+    qspec_stream = pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0),
+                                memory_space=pltpu.VMEM)
+    qrow_stream = pl.BlockSpec((1, bq, 1), lambda i, j, qb: (i, qb, 0),
+                               memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=bq, block_k=bk, causal=causal,
+                          q_offset=q_offset, k_offset=k_offset, sm_scale=sm_scale),
+        grid=(b * h, sk // bk, sq // bq),
+        in_specs=[kspec, kspec, qspec_stream, qspec_stream, qrow_stream,
+                  qrow_stream],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(kr, vr, qr, gr, lse, dvec)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 flash_attention.defvjp(_fwd, _bwd)
