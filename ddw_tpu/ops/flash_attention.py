@@ -65,6 +65,29 @@ def mha_reference(q, k, v, causal: bool = False, q_offset: int = 0,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _masked_scores(q, k_blk, q_start, k_start, causal, sm_scale,
+                   block_q, block_k):
+    """QK^T with the causal mask applied at global positions — shared by the
+    forward and both backward kernels so the masking can never desynchronize."""
+    sc = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        sc = jnp.where(kpos <= qpos, sc, _NEG_INF)
+    return sc
+
+
+def _guarded_exp(sc, ref, causal):
+    """p = exp(s - ref) with the fully-masked-row guard: where s == _NEG_INF the
+    subtraction cancels in f32 (exp -> 1), so re-zero masked entries explicitly.
+    Load-bearing in all three kernels — keeps masked rows at zero output and
+    zero gradient."""
+    p = jnp.exp(sc - ref)
+    if causal:
+        p = jnp.where(sc > _NEG_INF / 2, p, 0.0)
+    return p
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                   block_k: int, causal: bool, q_offset: int, k_offset: int,
                   sm_scale: float, block_q: int):
@@ -96,22 +119,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         q = q_ref[0]                                     # [block_q, d]
         k_blk = k_ref[0]                                 # [block_k, d]
         v_blk = v_ref[0]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = k_offset + kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        s = _masked_scores(q, k_blk, q_offset + qi * block_q,
+                           k_offset + kb * block_k, causal, sm_scale,
+                           block_q, block_k)
         m_prev = m_scr[:]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        if causal:
-            # A row whose visible keys are all masked has m_new == _NEG_INF and
-            # exp(s - m_new) == 1 for every masked key; zero those explicitly so
-            # l stays 0 and _finalize emits zeros (not mean-of-masked-V).
-            p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        # guard keeps l at 0 on fully-masked rows so _finalize emits zeros
+        p = _guarded_exp(s, m_new, causal)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
@@ -216,16 +231,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref, dq_scr,
         k_blk = k_ref[0]
         v_blk = v_ref[0]
         do = do_ref[0]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = k_offset + kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0])
-        if causal:
-            p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        s = _masked_scores(q, k_blk, q_offset + qi * block_q,
+                           k_offset + kb * block_k, causal, sm_scale,
+                           block_q, block_k)
+        p = _guarded_exp(s, lse_ref[0], causal)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dvec_ref[0])
         dq_scr[:] += sm_scale * jnp.dot(
@@ -262,16 +271,10 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
         v_blk = v_ref[0]
         q = q_ref[0]
         do = do_ref[0]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            qpos = q_offset + qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = k_offset + kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0])
-        if causal:
-            p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        s = _masked_scores(q, k_blk, q_offset + qb * block_q,
+                           k_offset + kj * block_k, causal, sm_scale,
+                           block_q, block_k)
+        p = _guarded_exp(s, lse_ref[0], causal)
         dv_scr[:] += jnp.dot(p.astype(do.dtype).T, do,
                              preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)

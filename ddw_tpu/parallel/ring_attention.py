@@ -57,10 +57,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     q32 = q.astype(jnp.float32)
     q_off = me * s_local
 
-    for hop in range(n):
-        src = (me - hop) % n                 # which rank's K/V block is visiting
-        k_off = src * s_local
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32)) * sm_scale
+    @jax.checkpoint
+    def hop_update(m, l, acc, k_hop, v_hop, k_off):
+        """One hop's blockwise-softmax fold. ``jax.checkpoint`` drops the
+        S_local x S_local score/prob intermediates from the residuals —
+        without it autodiff saves them for every hop (O(S_local * S_global)
+        memory, exactly the blowup ring attention exists to avoid) and
+        rematerializes them during backward instead."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_hop.astype(jnp.float32)) * sm_scale
         if causal:
             qpos = q_off + jnp.arange(s_local)[:, None]
             kpos = k_off + jnp.arange(s_local)[None, :]
@@ -69,10 +73,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
-                                       v_cur.astype(jnp.float32))
-        m = m_new
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                           v_hop.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    for hop in range(n):
+        src = (me - hop) % n                 # which rank's K/V block is visiting
+        m, l, acc = hop_update(m, l, acc, k_cur, v_cur, src * s_local)
         if hop != n - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)

@@ -1,0 +1,130 @@
+"""Tracker/registry CLI — the text-mode ``mlflow ui`` role.
+
+The reference inspects experiments through the MLflow UI and
+``mlflow.search_runs`` (``01_hyperopt_single_machine_model.py:253-262``);
+in-tree equivalent:
+
+    python -m ddw_tpu.tracking <runs_root> experiments
+    python -m ddw_tpu.tracking <runs_root> runs [-e EXP] [--sort METRIC]
+    python -m ddw_tpu.tracking <runs_root> show RUN_ID [-e EXP]
+    python -m ddw_tpu.tracking <runs_root> series RUN_ID KEY [-e EXP]
+    python -m ddw_tpu.tracking <registry_root> models
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def _fmt_ts(unix) -> str:
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(float(unix)))
+    except (TypeError, ValueError):
+        return "-"
+
+
+def _fmt_val(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def cmd_experiments(args) -> None:
+    root = args.root
+    if not os.path.isdir(root):
+        raise SystemExit(f"no tracker root at {root}")
+    for exp in sorted(os.listdir(root)):
+        exp_dir = os.path.join(root, exp)
+        if not os.path.isdir(exp_dir):
+            continue
+        n = sum(1 for d in os.listdir(exp_dir)
+                if os.path.exists(os.path.join(exp_dir, d, "meta.json")))
+        print(f"{exp}  ({n} runs)")
+
+
+def cmd_runs(args) -> None:
+    from ddw_tpu.tracking.tracker import Tracker
+
+    tracker = Tracker(args.root, args.experiment)
+    rows = []
+    for run in tracker.iter_runs():
+        meta = run.meta()
+        finals = run.final_metrics()
+        rows.append((meta.get("start_unix", 0), run.run_id,
+                     meta.get("name", ""), meta.get("status", "?"),
+                     meta.get("parent_run_id") or "", finals))
+    if args.sort:
+        rows.sort(key=lambda r: r[5].get(args.sort, float("-inf")), reverse=True)
+    else:
+        rows.sort()
+    for start, rid, name, status, parent, finals in rows:
+        shown = {k: _fmt_val(v) for k, v in sorted(finals.items())
+                 if not k.startswith("sys.")}
+        nested = f" (child of {parent})" if parent else ""
+        print(f"{rid}  {_fmt_ts(start)}  {status:<9} {name}{nested}")
+        if shown:
+            print("    " + "  ".join(f"{k}={v}" for k, v in shown.items()))
+
+
+def cmd_show(args) -> None:
+    from ddw_tpu.tracking.tracker import Tracker
+
+    run = Tracker(args.root, args.experiment).get_run(args.run_id)
+    print(json.dumps({
+        "meta": run.meta(),
+        "params": run.params(),
+        "final_metrics": run.final_metrics(),
+        "artifacts": sorted(os.listdir(run.artifact_dir()))
+        if os.path.isdir(run.artifact_dir()) else [],
+    }, indent=2, default=str))
+
+
+def cmd_series(args) -> None:
+    from ddw_tpu.tracking.tracker import Tracker
+
+    run = Tracker(args.root, args.experiment).get_run(args.run_id)
+    for step, value in run.metric_history(args.key):
+        print(f"{step}\t{_fmt_val(value)}")
+
+
+def cmd_models(args) -> None:
+    from ddw_tpu.tracking.registry import ModelRegistry
+
+    reg = ModelRegistry(args.root)
+    for name in reg.list_models():
+        print(name)
+        for v in reg.list_versions(name):
+            print(f"    v{v.get('version')}  stage={v.get('stage', 'None'):<10} "
+                  f"run={v.get('source_run_id') or '-'}  "
+                  f"{_fmt_ts(v.get('created_unix'))}")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="python -m ddw_tpu.tracking",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("root", help="tracker root dir (or registry root for 'models')")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("experiments")
+    p_runs = sub.add_parser("runs")
+    p_runs.add_argument("-e", "--experiment", default="default")
+    p_runs.add_argument("--sort", default="", help="final metric to sort by, desc")
+    p_show = sub.add_parser("show")
+    p_show.add_argument("run_id")
+    p_show.add_argument("-e", "--experiment", default="default")
+    p_series = sub.add_parser("series")
+    p_series.add_argument("run_id")
+    p_series.add_argument("key")
+    p_series.add_argument("-e", "--experiment", default="default")
+    sub.add_parser("models")
+
+    args = ap.parse_args(argv)
+    {"experiments": cmd_experiments, "runs": cmd_runs, "show": cmd_show,
+     "series": cmd_series, "models": cmd_models}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

@@ -36,3 +36,39 @@ def test_monitor_stop_idempotent(tmp_path):
     mon.stop()
     mon.stop()
     assert mon._thread is None
+
+
+def test_tracking_cli(tmp_path, capsys):
+    """The mlflow-ui-role CLI lists experiments/runs/series and registry models."""
+    from ddw_tpu.tracking import __main__ as cli
+    from ddw_tpu.tracking.registry import ModelRegistry
+    from ddw_tpu.tracking.tracker import Tracker
+
+    root = str(tmp_path / "runs")
+    tracker = Tracker(root, "exp1")
+    with tracker.start_run("trial") as run:
+        run.log_params({"lr": 0.1})
+        run.log_metric("val_accuracy", 0.5, step=0)
+        run.log_metric("val_accuracy", 0.9, step=1)
+        rid = run.run_id
+
+    cli.main([root, "experiments"])
+    cli.main([root, "runs", "-e", "exp1", "--sort", "val_accuracy"])
+    cli.main([root, "show", rid, "-e", "exp1"])
+    cli.main([root, "series", rid, "val_accuracy", "-e", "exp1"])
+    out = capsys.readouterr().out
+    assert "exp1  (1 runs)" in out
+    assert rid in out and "val_accuracy=0.9" in out
+    assert '"lr": 0.1' in out
+    assert "1\t0.9" in out
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "package.json").write_text("{}")
+    reg_root = str(tmp_path / "registry")
+    reg = ModelRegistry(reg_root)
+    v = reg.register("flowers", str(pkg), run_id=rid)
+    reg.transition("flowers", v, "Production")
+    cli.main([reg_root, "models"])
+    out = capsys.readouterr().out
+    assert "flowers" in out and "Production" in out and rid in out
