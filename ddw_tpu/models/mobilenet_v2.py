@@ -66,7 +66,12 @@ class ConvBN(nn.Module):
             use_bias=False,
             dtype=self.dtype,
         )(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.99, epsilon=1e-3,
+        # momentum 0.9, not Keras's 0.99: the reference only ever runs BN with a
+        # pretrained FROZEN base (stats never update, momentum irrelevant); for
+        # from-scratch training 0.99 needs ~500 steps before running stats are
+        # usable, leaving eval broken for entire short runs. epsilon stays at
+        # Keras's 1e-3 so converted pretrained weights reproduce exactly.
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-3,
                          dtype=jnp.float32)(x)
         if self.act:
             x = jnp.minimum(nn.relu(x), 6.0).astype(self.dtype)  # ReLU6

@@ -45,12 +45,36 @@ def cmd_experiments(args) -> None:
         print(f"{exp}  ({n} runs)")
 
 
-def cmd_runs(args) -> None:
-    from ddw_tpu.tracking.tracker import Tracker
+def _exp_dir(args) -> str:
+    """Validated experiment dir — the CLI is read-only and must neither create
+    directories (a typoed -e would otherwise materialize an empty experiment)
+    nor traceback on missing ones."""
+    exp_dir = os.path.join(args.root, args.experiment)
+    if not os.path.isdir(exp_dir):
+        raise SystemExit(f"no experiment {args.experiment!r} under {args.root} "
+                         f"(try the 'experiments' subcommand)")
+    return exp_dir
 
-    tracker = Tracker(args.root, args.experiment)
+
+def _get_run(args):
+    from ddw_tpu.tracking.tracker import Run
+
+    run_dir = os.path.join(_exp_dir(args), args.run_id)
+    if not os.path.exists(os.path.join(run_dir, "meta.json")):
+        raise SystemExit(f"no run {args.run_id!r} in experiment "
+                         f"{args.experiment!r} under {args.root}")
+    return Run(run_dir, args.run_id, writable=False)
+
+
+def cmd_runs(args) -> None:
+    from ddw_tpu.tracking.tracker import Run
+
+    exp_dir = _exp_dir(args)
     rows = []
-    for run in tracker.iter_runs():
+    for d in sorted(os.listdir(exp_dir)):
+        if not os.path.exists(os.path.join(exp_dir, d, "meta.json")):
+            continue
+        run = Run(os.path.join(exp_dir, d), d, writable=False)
         meta = run.meta()
         finals = run.final_metrics()
         rows.append((meta.get("start_unix", 0), run.run_id,
@@ -70,23 +94,18 @@ def cmd_runs(args) -> None:
 
 
 def cmd_show(args) -> None:
-    from ddw_tpu.tracking.tracker import Tracker
-
-    run = Tracker(args.root, args.experiment).get_run(args.run_id)
+    run = _get_run(args)
+    art_dir = os.path.join(run.run_dir, "artifacts")  # path only: no mkdir
     print(json.dumps({
         "meta": run.meta(),
         "params": run.params(),
         "final_metrics": run.final_metrics(),
-        "artifacts": sorted(os.listdir(run.artifact_dir()))
-        if os.path.isdir(run.artifact_dir()) else [],
+        "artifacts": sorted(os.listdir(art_dir)) if os.path.isdir(art_dir) else [],
     }, indent=2, default=str))
 
 
 def cmd_series(args) -> None:
-    from ddw_tpu.tracking.tracker import Tracker
-
-    run = Tracker(args.root, args.experiment).get_run(args.run_id)
-    for step, value in run.metric_history(args.key):
+    for step, value in _get_run(args).metric_history(args.key):
         print(f"{step}\t{_fmt_val(value)}")
 
 

@@ -26,7 +26,8 @@ STAGES = ("None", "Staging", "Production", "Archived")
 class ModelRegistry:
     def __init__(self, root: str):
         self.root = root
-        os.makedirs(root, exist_ok=True)
+        # root is created on first write (register) — read-only consumers
+        # (the CLI) must not mutate the filesystem
 
     def _model_dir(self, name: str) -> str:
         return os.path.join(self.root, name)
@@ -95,6 +96,8 @@ class ModelRegistry:
         return os.path.join(self._model_dir(name), f"v{v}", "model")
 
     def list_models(self) -> list[str]:
+        if not os.path.isdir(self.root):
+            return []
         return sorted(d for d in os.listdir(self.root)
                       if os.path.isdir(self._model_dir(d)))
 

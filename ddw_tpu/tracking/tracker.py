@@ -30,10 +30,10 @@ import time
 import uuid
 from typing import Any, Iterator
 
-import jax
-
 
 def _is_writer() -> bool:
+    import jax  # deferred: read-only consumers (the CLI) stay jax-free
+
     return jax.process_index() == 0
 
 
