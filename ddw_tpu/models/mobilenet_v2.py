@@ -53,6 +53,7 @@ class ConvBN(nn.Module):
     strides: int = 1
     groups: int = 1
     act: bool = True
+    bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -66,12 +67,15 @@ class ConvBN(nn.Module):
             use_bias=False,
             dtype=self.dtype,
         )(x)
-        # momentum 0.9, not Keras's 0.99: the reference only ever runs BN with a
-        # pretrained FROZEN base (stats never update, momentum irrelevant); for
-        # from-scratch training 0.99 needs ~500 steps before running stats are
-        # usable, leaving eval broken for entire short runs. epsilon stays at
-        # Keras's 1e-3 so converted pretrained weights reproduce exactly.
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-3,
+        # Default momentum 0.9, not Keras's 0.99: the reference only ever runs
+        # BN with a pretrained FROZEN base (stats never update, momentum
+        # irrelevant); for from-scratch training 0.99 needs ~500 steps before
+        # running stats are usable, leaving eval broken for entire short runs.
+        # ModelCfg.bn_momentum=0.99 restores the Keras value for parity runs
+        # that finetune an unfrozen pretrained base. epsilon stays at Keras's
+        # 1e-3 so converted pretrained weights reproduce exactly.
+        x = nn.BatchNorm(use_running_average=not train,
+                         momentum=self.bn_momentum, epsilon=1e-3,
                          dtype=jnp.float32)(x)
         if self.act:
             x = jnp.minimum(nn.relu(x), 6.0).astype(self.dtype)  # ReLU6
@@ -82,19 +86,23 @@ class InvertedResidual(nn.Module):
     out_ch: int
     stride: int
     expand: int
+    bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool):
         in_ch = x.shape[-1]
+        bn = self.bn_momentum
         h = x
         if self.expand != 1:
-            h = ConvBN(in_ch * self.expand, (1, 1), dtype=self.dtype)(h, train)
+            h = ConvBN(in_ch * self.expand, (1, 1), bn_momentum=bn,
+                       dtype=self.dtype)(h, train)
         # depthwise
         h = ConvBN(h.shape[-1], (3, 3), strides=self.stride, groups=h.shape[-1],
-                   dtype=self.dtype)(h, train)
+                   bn_momentum=bn, dtype=self.dtype)(h, train)
         # linear bottleneck projection (no activation)
-        h = ConvBN(self.out_ch, (1, 1), act=False, dtype=self.dtype)(h, train)
+        h = ConvBN(self.out_ch, (1, 1), act=False, bn_momentum=bn,
+                   dtype=self.dtype)(h, train)
         if self.stride == 1 and in_ch == self.out_ch:
             h = h + x
         return h
@@ -102,19 +110,22 @@ class InvertedResidual(nn.Module):
 
 class MobileNetV2Backbone(nn.Module):
     width_mult: float = 1.0
+    bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool):
+        bn = self.bn_momentum
         x = x.astype(self.dtype)
         x = ConvBN(_make_divisible(32 * self.width_mult), (3, 3), strides=2,
-                   dtype=self.dtype)(x, train)
+                   bn_momentum=bn, dtype=self.dtype)(x, train)
         for t, c, n, s in _INVERTED_RESIDUAL_CFG:
             out_ch = _make_divisible(c * self.width_mult)
             for i in range(n):
-                x = InvertedResidual(out_ch, s if i == 0 else 1, t, dtype=self.dtype)(x, train)
+                x = InvertedResidual(out_ch, s if i == 0 else 1, t,
+                                     bn_momentum=bn, dtype=self.dtype)(x, train)
         last = _make_divisible(1280 * max(1.0, self.width_mult))
-        x = ConvBN(last, (1, 1), dtype=self.dtype)(x, train)
+        x = ConvBN(last, (1, 1), bn_momentum=bn, dtype=self.dtype)(x, train)
         return x
 
 
@@ -127,12 +138,14 @@ class MobileNetV2(nn.Module):
     width_mult: float = 1.0
     dropout: float = 0.5
     freeze_base: bool = True
+    bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         base_train = train and not self.freeze_base
-        feats = MobileNetV2Backbone(self.width_mult, self.dtype, name="backbone")(x, base_train)
+        feats = MobileNetV2Backbone(self.width_mult, self.bn_momentum,
+                                    self.dtype, name="backbone")(x, base_train)
         if self.freeze_base:
             # Keras trainable=False computes no base gradients: the tape stops at
             # the head input. stop_gradient guarantees XLA drops the backbone

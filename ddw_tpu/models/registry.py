@@ -55,6 +55,7 @@ def _mobilenet_v2(cfg: ModelCfg):
         width_mult=cfg.width_mult,
         dropout=cfg.dropout,
         freeze_base=cfg.freeze_base,
+        bn_momentum=cfg.bn_momentum,
         dtype=_dtype(cfg),
     )
 

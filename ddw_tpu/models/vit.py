@@ -16,6 +16,38 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ddw_tpu.ops.flash_attention import flash_mha
+
+
+class FlashMHA(nn.Module):
+    """Self-attention over the in-tree Pallas flash kernel.
+
+    Param layout matches ``nn.MultiHeadDotProductAttention`` —
+    ``{query,key,value}/kernel [embed, heads, head_dim]``, ``out/kernel
+    [heads, head_dim, embed]`` — so :data:`ddw_tpu.parallel.sharding
+    .VIT_TP_RULES` shards it unchanged and checkpoints stay layout-stable.
+    The kernel pads ViT's 196-patch sequences to a block multiple internally
+    (:func:`ddw_tpu.ops.flash_attention.flash_mha`)."""
+
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        if d % self.num_heads:
+            raise ValueError(f"hidden {d} not divisible by heads {self.num_heads}")
+        head_dim = d // self.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), dtype=self.dtype, name=name)
+        q = dense("query")(x)   # [B, S, H, hd]
+        k = dense("key")(x)
+        v = dense("value")(x)
+        qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        out = flash_mha(qh, kh, vh, causal=False)
+        out = out.transpose(0, 2, 1, 3)  # [B, S, H, hd]
+        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(out)
+
 
 class MlpBlock(nn.Module):
     mlp_dim: int
@@ -37,9 +69,7 @@ class EncoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, dtype=self.dtype, name="attn"
-        )(h, h)
+        h = FlashMHA(num_heads=self.num_heads, dtype=self.dtype, name="attn")(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         h = MlpBlock(self.mlp_dim, dtype=self.dtype, name="mlp")(h)

@@ -71,6 +71,18 @@ def decode_batch_native(
     n = len(contents)
     if out is None:
         out = np.empty((n, height, width, 3), np.float32)
+    else:
+        # The kernel writes n*h*w*3 f32 through the raw pointer — a wrong
+        # dtype/shape/layout here is silent memory corruption, not an error.
+        if out.dtype != np.float32:
+            raise ValueError(f"out must be float32, got {out.dtype}")
+        if out.shape != (n, height, width, 3):
+            raise ValueError(
+                f"out shape {out.shape} != {(n, height, width, 3)}")
+        if not out.flags.c_contiguous:
+            raise ValueError("out must be C-contiguous")
+        if not out.flags.writeable:
+            raise ValueError("out must be writeable")
     ok = np.zeros((n,), np.uint8)
     if n == 0:
         return out, ok.astype(bool)

@@ -27,9 +27,71 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.custom_partitioning import custom_partitioning
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+
+
+def _bh_sharding(sharding, ndim):
+    """A NamedSharding keeping the suggested (batch, heads) axes and
+    replicating everything after them — the partition layout the kernels
+    support (seq and head_dim must be device-local)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = tuple(sharding.spec)[:2]
+    spec = spec + (None,) * (ndim - len(spec))
+    return NamedSharding(sharding.mesh, P(*spec))
+
+
+def _def_bh_partition(fn, impl, rule, n_in, out_ndims):
+    """Register batch/head-sharded SPMD partitioning on ``fn``.
+
+    GSPMD cannot auto-partition a Mosaic custom call, so without this the
+    pjit TP/DP paths (VIT_TP_RULES, LM_TP_RULES shard attention heads over
+    ``model``; DP shards batch) would all-gather the operands and run the
+    kernel replicated — or fail to lower. The rule declares the leading two
+    dims (batch, heads) freely shardable and everything else
+    need-replication; the per-shard lowering is the kernel itself on local
+    shapes. Under shard_map (the ring path) the op is already per-device and
+    partitioning never engages."""
+
+    def partition(mesh, arg_shapes, result_shape):
+        bh = _bh_sharding(arg_shapes[0].sharding, 2)
+        args = tuple(_bh_sharding(bh, s.ndim) for s in arg_shapes)
+        outs = tuple(_bh_sharding(bh, n) for n in out_ndims)
+        return mesh, impl, outs, args
+
+    def infer(mesh, arg_shapes, result_shape):
+        bh = _bh_sharding(arg_shapes[0].sharding, 2)
+        return tuple(_bh_sharding(bh, n) for n in out_ndims)
+
+    # NB: shardy requires the special-factor indices sorted, i.e. listed in
+    # first-appearance order of the rule string (q before d before s).
+    fn.def_partition(partition=partition, infer_sharding_from_operands=infer,
+                     sharding_rule=rule,
+                     need_replication_factors=("q", "d", "s"))
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_fwd(causal, q_offset, k_offset, sm_scale, block_q, block_k,
+                     interpret, k_valid):
+    """(q, k, v) -> (out [B,H,Sq,D], lse [B,H,Sq]) with SPMD partitioning over
+    batch/heads. Cached per static config (the custom_partitioning object must
+    be built once per config, not per trace)."""
+
+    def impl(q, k, v):
+        out, lse = _flash_forward(q, k, v, causal, q_offset, k_offset,
+                                  sm_scale, block_q, block_k, interpret,
+                                  k_valid)
+        b, h, sq, _ = q.shape
+        return out, lse.reshape(b, h, sq)
+
+    fn = custom_partitioning(impl)
+    return _def_bh_partition(
+        fn, impl, "b h q d, b h s d, b h s d -> b h q d, b h q",
+        n_in=3, out_ndims=(4, 3))
 
 
 def _on_tpu() -> bool:
@@ -66,31 +128,38 @@ def mha_reference(q, k, v, causal: bool = False, q_offset: int = 0,
 
 
 def _masked_scores(q, k_blk, q_start, k_start, causal, sm_scale,
-                   block_q, block_k):
-    """QK^T with the causal mask applied at global positions — shared by the
-    forward and both backward kernels so the masking can never desynchronize."""
+                   block_q, block_k, k_valid=None):
+    """QK^T with the causal + key-padding masks applied at global positions —
+    shared by the forward and both backward kernels so the masking can never
+    desynchronize. ``k_valid`` (static) masks keys at global position >= it
+    (the padded tail when the sequence was padded up to a block multiple)."""
     sc = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-    if causal:
-        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    if causal or k_valid is not None:
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        sc = jnp.where(kpos <= qpos, sc, _NEG_INF)
+        keep = jnp.full((block_q, block_k), True)
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            keep = kpos <= qpos
+        if k_valid is not None:
+            keep = jnp.logical_and(keep, kpos < k_valid)
+        sc = jnp.where(keep, sc, _NEG_INF)
     return sc
 
 
-def _guarded_exp(sc, ref, causal):
+def _guarded_exp(sc, ref, masked):
     """p = exp(s - ref) with the fully-masked-row guard: where s == _NEG_INF the
     subtraction cancels in f32 (exp -> 1), so re-zero masked entries explicitly.
     Load-bearing in all three kernels — keeps masked rows at zero output and
     zero gradient."""
     p = jnp.exp(sc - ref)
-    if causal:
+    if masked:
         p = jnp.where(sc > _NEG_INF / 2, p, 0.0)
     return p
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                   block_k: int, causal: bool, q_offset: int, k_offset: int,
-                  sm_scale: float, block_q: int):
+                  sm_scale: float, block_q: int, k_valid: int | None):
     """One (batch*head, q-block, k-block) grid step of online-softmax attention.
 
     The K loop is a GRID dimension (innermost), so Mosaic double-buffers the
@@ -113,6 +182,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     q_last = q_offset + qi * block_q + block_q - 1
     k_first = k_offset + kb * block_k
     visible = (k_first <= q_last) if causal else True
+    if k_valid is not None:
+        visible = visible & (k_first < k_valid)
 
     @pl.when(visible)
     def _attend():
@@ -121,12 +192,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         v_blk = v_ref[0]
         s = _masked_scores(q, k_blk, q_offset + qi * block_q,
                            k_offset + kb * block_k, causal, sm_scale,
-                           block_q, block_k)
+                           block_q, block_k, k_valid)
         m_prev = m_scr[:]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         # guard keeps l at 0 on fully-masked rows so _finalize emits zeros
-        p = _guarded_exp(s, m_new, causal)
+        p = _guarded_exp(s, m_new, causal or k_valid is not None)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
@@ -143,7 +214,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
 
 def _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale, block_q,
-                   block_k, interpret):
+                   block_k, interpret, k_valid=None):
     """Returns (out, lse) with lse [B*H, Sq, 1] f32 (the backward residual)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -156,7 +227,7 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale, block_q,
     vr = v.reshape(b * h, sk, d)
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, q_offset=q_offset,
-        k_offset=k_offset, sm_scale=sm_scale, block_q=block_q)
+        k_offset=k_offset, sm_scale=sm_scale, block_q=block_q, k_valid=k_valid)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q, sk // block_k),  # k innermost: scratch carries
@@ -188,25 +259,47 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale, block_q,
     return out.reshape(b, h, sq, d), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def flash_attention(q, k, v, causal: bool = False, q_offset: int = 0,
                     k_offset: int = 0, sm_scale: float | None = None,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    k_valid: int | None = None):
     """Flash attention: softmax(q k^T / sqrt(d)) v without materializing scores.
 
     q [B,H,Sq,D], k/v [B,H,Sk,D] -> [B,H,Sq,D]. ``q_offset``/``k_offset`` are the
     global positions of the local blocks (used by ring attention for causal
-    masking across rotated K/V shards).
+    masking across rotated K/V shards). ``k_valid`` (static) masks keys at
+    global position >= it — the padded tail when Sk was padded to a block
+    multiple (see :func:`flash_mha`).
     """
     sm_scale, interpret = _resolve_defaults(sm_scale, interpret, q.shape[-1])
-    return _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale,
-                          block_q, block_k, interpret)[0]
+    return _partitioned_fwd(causal, q_offset, k_offset, sm_scale, block_q,
+                            block_k, interpret, k_valid)(q, k, v)[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def flash_attention_lse(q, k, v, causal: bool = False, q_offset: int = 0,
+                        k_offset: int = 0, sm_scale: float | None = None,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: bool | None = None,
+                        k_valid: int | None = None):
+    """Flash attention that also returns the per-row logsumexp.
+
+    Returns ``(out [B,H,Sq,D], lse [B,H,Sq] f32)`` with
+    ``lse = logsumexp_k(q.k * sm_scale)`` over this call's (masked) keys. The
+    residual a caller needs to softmax-combine partial attention over disjoint
+    key sets — :func:`ddw_tpu.parallel.ring_attention.ring_attention` folds one
+    of these per ring hop. Differentiable in both outputs (the lse cotangent
+    folds into the score gradient as ``ds += p * g_lse``)."""
+    sm_scale, interpret = _resolve_defaults(sm_scale, interpret, q.shape[-1])
+    return _partitioned_fwd(causal, q_offset, k_offset, sm_scale, block_q,
+                            block_k, interpret, k_valid)(q, k, v)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref, dq_scr,
                *, block_q: int, block_k: int, causal: bool, q_offset: int,
-               k_offset: int, sm_scale: float):
+               k_offset: int, sm_scale: float, k_valid: int | None):
     """dQ pass (FA2 backward): grid (BH, q-blocks, k-blocks), K innermost.
 
     p_ij = exp(s_ij - L_i) rematerialized per block from the saved logsumexp;
@@ -224,6 +317,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref, dq_scr,
     q_last = q_offset + qi * block_q + block_q - 1
     k_first = k_offset + kb * block_k
     visible = (k_first <= q_last) if causal else True
+    if k_valid is not None:
+        visible = visible & (k_first < k_valid)
 
     @pl.when(visible)
     def _accum():
@@ -233,8 +328,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref, dq_scr,
         do = do_ref[0]
         s = _masked_scores(q, k_blk, q_offset + qi * block_q,
                            k_offset + kb * block_k, causal, sm_scale,
-                           block_q, block_k)
-        p = _guarded_exp(s, lse_ref[0], causal)
+                           block_q, block_k, k_valid)
+        p = _guarded_exp(s, lse_ref[0], causal or k_valid is not None)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dvec_ref[0])
         dq_scr[:] += sm_scale * jnp.dot(
@@ -247,7 +342,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref, dq_scr,
 
 def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
                 dk_scr, dv_scr, *, block_q: int, block_k: int, causal: bool,
-                q_offset: int, k_offset: int, sm_scale: float):
+                q_offset: int, k_offset: int, sm_scale: float,
+                k_valid: int | None):
     """dK/dV pass: grid (BH, k-blocks, q-blocks), Q innermost.
 
     dv_j += p_ij^T dO_i; dk_j += sm_scale * ds_ij^T q_i.
@@ -264,6 +360,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
     q_last = q_offset + qb * block_q + block_q - 1
     k_first = k_offset + kj * block_k
     visible = (k_first <= q_last) if causal else True
+    if k_valid is not None:
+        visible = visible & (k_first < k_valid)
 
     @pl.when(visible)
     def _accum():
@@ -273,8 +371,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
         do = do_ref[0]
         s = _masked_scores(q, k_blk, q_offset + qb * block_q,
                            k_offset + kj * block_k, causal, sm_scale,
-                           block_q, block_k)
-        p = _guarded_exp(s, lse_ref[0], causal)
+                           block_q, block_k, k_valid)
+        p = _guarded_exp(s, lse_ref[0], causal or k_valid is not None)
         dv_scr[:] += jnp.dot(p.astype(do.dtype).T, do,
                              preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
@@ -288,71 +386,184 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _fwd(q, k, v, causal, q_offset, k_offset, sm_scale, block_q, block_k, interpret):
+@functools.lru_cache(maxsize=None)
+def _partitioned_bwd(causal, q_offset, k_offset, sm_scale, block_q, block_k,
+                     interpret, k_valid):
+    """(q, k, v, lse3, g, dvec3) -> (dq, dk, dv), batch/head-partitioned.
+
+    Pallas FA2 backward: two block kernels (dQ; dK/dV) over the saved
+    logsumexp — O(S) memory, the S x S matrices never leave VMEM. ``lse3`` and
+    ``dvec3`` arrive as [B,H,Sq] so every operand has the (b, h) leading dims
+    the partition rule shards."""
+
+    def impl(q, k, v, lse3, g, dvec3):
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+        bq = min(block_q, sq)
+        bk = min(block_k, sk)
+
+        qr = q.reshape(b * h, sq, d)
+        kr = k.reshape(b * h, sk, d)
+        vr = v.reshape(b * h, sk, d)
+        gr = g.reshape(b * h, sq, d)
+        lse = lse3.reshape(b * h, sq, 1)
+        dvec = dvec3.reshape(b * h, sq, 1)
+
+        qspec = pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0),
+                             memory_space=pltpu.VMEM)
+        qrow = pl.BlockSpec((1, bq, 1), lambda i, j, kb: (i, j, 0),
+                            memory_space=pltpu.VMEM)
+        kspec_stream = pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0),
+                                    memory_space=pltpu.VMEM)
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, block_q=bq, block_k=bk, causal=causal,
+                              q_offset=q_offset, k_offset=k_offset,
+                              sm_scale=sm_scale, k_valid=k_valid),
+            grid=(b * h, sq // bq, sk // bk),
+            in_specs=[qspec, kspec_stream, kspec_stream, qspec, qrow, qrow],
+            out_specs=qspec,
+            out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            interpret=interpret,
+        )(qr, kr, vr, gr, lse, dvec)
+
+        kspec = pl.BlockSpec((1, bk, d), lambda i, j, qb: (i, j, 0),
+                             memory_space=pltpu.VMEM)
+        qspec_stream = pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0),
+                                    memory_space=pltpu.VMEM)
+        qrow_stream = pl.BlockSpec((1, bq, 1), lambda i, j, qb: (i, qb, 0),
+                                   memory_space=pltpu.VMEM)
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, block_q=bq, block_k=bk, causal=causal,
+                              q_offset=q_offset, k_offset=k_offset,
+                              sm_scale=sm_scale, k_valid=k_valid),
+            grid=(b * h, sk // bk, sq // bq),
+            in_specs=[kspec, kspec, qspec_stream, qspec_stream, qrow_stream,
+                      qrow_stream],
+            out_specs=[kspec, kspec],
+            out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                       jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                            pltpu.VMEM((bk, d), jnp.float32)],
+            interpret=interpret,
+        )(kr, vr, qr, gr, lse, dvec)
+
+        return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+                dv.reshape(b, h, sk, d))
+
+    fn = custom_partitioning(impl)
+    return _def_bh_partition(
+        fn, impl,
+        "b h q d, b h s d, b h s d, b h q, b h q d, b h q -> "
+        "b h q d, b h s d, b h s d",
+        n_in=6, out_ndims=(4, 4, 4))
+
+
+def _bwd_impl(causal, q_offset, k_offset, sm_scale, block_q, block_k, interpret,
+              k_valid, residuals, g, g_lse=None):
+    """Shared VJP body. ``g_lse`` (the lse-output cotangent, [B,H,Sq] or None)
+    folds into the score gradient: d lse_i / d s_ij = p_ij, so
+    ds = p * (dp - D + g_lse) — carried by passing D' = D - g_lse through the
+    unchanged kernels."""
+    q, k, v, out, lse3 = residuals
     sm_scale, interpret = _resolve_defaults(sm_scale, interpret, q.shape[-1])
-    out, lse = _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale,
-                              block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    # D_i = dO_i . O_i (the softmax-normalizer correction), cheap elementwise
+    # — stays outside the partitioned call, GSPMD shards it fine.
+    dvec3 = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        dvec3 = dvec3 - g_lse.astype(jnp.float32)
+    return _partitioned_bwd(causal, q_offset, k_offset, sm_scale, block_q,
+                            block_k, interpret, k_valid)(q, k, v, lse3, g, dvec3)
+
+
+def _fwd(q, k, v, causal, q_offset, k_offset, sm_scale, block_q, block_k,
+         interpret, k_valid):
+    sm_scale, interpret = _resolve_defaults(sm_scale, interpret, q.shape[-1])
+    out, lse3 = _partitioned_fwd(causal, q_offset, k_offset, sm_scale, block_q,
+                                 block_k, interpret, k_valid)(q, k, v)
+    return out, (q, k, v, out, lse3)
 
 
 def _bwd(causal, q_offset, k_offset, sm_scale, block_q, block_k, interpret,
-         residuals, g):
-    """Pallas FA2 backward: two block kernels (dQ; dK/dV) over the saved
-    logsumexp — O(S) memory, the S x S matrices never leave VMEM."""
-    q, k, v, out, lse = residuals
-    sm_scale, interpret = _resolve_defaults(sm_scale, interpret, q.shape[-1])
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
-
-    qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
-    gr = g.reshape(b * h, sq, d)
-    # D_i = dO_i . O_i (the softmax-normalizer correction), cheap elementwise.
-    dvec = jnp.sum(gr.astype(jnp.float32) * out.reshape(b * h, sq, d).astype(jnp.float32),
-                   axis=-1, keepdims=True)
-
-    qspec = pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0),
-                         memory_space=pltpu.VMEM)
-    qrow = pl.BlockSpec((1, bq, 1), lambda i, j, kb: (i, j, 0),
-                        memory_space=pltpu.VMEM)
-    kspec_stream = pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0),
-                                memory_space=pltpu.VMEM)
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_q=bq, block_k=bk, causal=causal,
-                          q_offset=q_offset, k_offset=k_offset, sm_scale=sm_scale),
-        grid=(b * h, sq // bq, sk // bk),
-        in_specs=[qspec, kspec_stream, kspec_stream, qspec, qrow, qrow],
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        interpret=interpret,
-    )(qr, kr, vr, gr, lse, dvec)
-
-    kspec = pl.BlockSpec((1, bk, d), lambda i, j, qb: (i, j, 0),
-                         memory_space=pltpu.VMEM)
-    qspec_stream = pl.BlockSpec((1, bq, d), lambda i, j, qb: (i, qb, 0),
-                                memory_space=pltpu.VMEM)
-    qrow_stream = pl.BlockSpec((1, bq, 1), lambda i, j, qb: (i, qb, 0),
-                               memory_space=pltpu.VMEM)
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=bq, block_k=bk, causal=causal,
-                          q_offset=q_offset, k_offset=k_offset, sm_scale=sm_scale),
-        grid=(b * h, sk // bk, sq // bq),
-        in_specs=[kspec, kspec, qspec_stream, qspec_stream, qrow_stream,
-                  qrow_stream],
-        out_specs=[kspec, kspec],
-        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
-        interpret=interpret,
-    )(kr, vr, qr, gr, lse, dvec)
-
-    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+         k_valid, residuals, g):
+    return _bwd_impl(causal, q_offset, k_offset, sm_scale, block_q, block_k,
+                     interpret, k_valid, residuals, g)
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+def _fwd_lse(q, k, v, causal, q_offset, k_offset, sm_scale, block_q, block_k,
+             interpret, k_valid):
+    sm_scale, interpret = _resolve_defaults(sm_scale, interpret, q.shape[-1])
+    out, lse3 = _partitioned_fwd(causal, q_offset, k_offset, sm_scale, block_q,
+                                 block_k, interpret, k_valid)(q, k, v)
+    return (out, lse3), (q, k, v, out, lse3)
+
+
+def _bwd_lse(causal, q_offset, k_offset, sm_scale, block_q, block_k, interpret,
+             k_valid, residuals, gs):
+    g, g_lse = gs
+    return _bwd_impl(causal, q_offset, k_offset, sm_scale, block_q, block_k,
+                     interpret, k_valid, residuals, g, g_lse)
+
+
+flash_attention_lse.defvjp(_fwd_lse, _bwd_lse)
+
+
+def _pick_block(s: int, block: int, dtype) -> int:
+    """Choose a Mosaic-tile-aligned block size for a sequence of length ``s``.
+
+    The block is the second-minor dim of the kernel's VMEM tiles, so it must be
+    a multiple of the sublane tile (16 for bf16/f16, 8 otherwise); ``s`` is
+    then padded UP to a multiple of the block rather than the block shrunk to
+    ``s`` (a block of exactly s=100 lowers in interpret mode but fails Mosaic
+    tiling on real TPU)."""
+    tile = 16 if dtype in (jnp.bfloat16, jnp.float16) else 8
+    aligned = -(-max(s, 1) // tile) * tile
+    return max(tile, min(block, aligned) // tile * tile)
+
+
+def _pad_seq(x, mult):
+    """Zero-pad the sequence axis (dim 2 of [B,H,S,D]) up to a multiple."""
+    s = x.shape[2]
+    pad = (-s) % mult
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
+def flash_mha(q, k, v, causal: bool = False, sm_scale: float | None = None,
+              block_q: int = 128, block_k: int = 128,
+              interpret: bool | None = None) -> jnp.ndarray:
+    """Flash attention for arbitrary sequence lengths (the model-facing entry).
+
+    Pads Sq/Sk up to tile-aligned block multiples, masks the padded keys via
+    ``k_valid``, and slices the padded query rows back off — so ViT's
+    196-patch sequences (or any other length) run on the same Pallas kernel
+    the LM uses. Zero-copy when the lengths already divide the blocks."""
+    return flash_mha_lse(q, k, v, causal, sm_scale, block_q, block_k,
+                         interpret)[0]
+
+
+def flash_mha_lse(q, k, v, causal: bool = False, sm_scale: float | None = None,
+                  block_q: int = 128, block_k: int = 128,
+                  interpret: bool | None = None):
+    """Padded-length :func:`flash_attention_lse` — ``(out, lse [B,H,Sq])``.
+
+    Same padding contract as :func:`flash_mha`; the lse rows for padded
+    queries are sliced off with the outputs. Ring attention calls this per
+    hop so arbitrary local shard lengths work (the replaced einsum
+    formulation accepted any s_local; the kernel path must too)."""
+    sq, sk = q.shape[2], k.shape[2]
+    bq = _pick_block(sq, block_q, q.dtype)
+    bk = _pick_block(sk, block_k, k.dtype)
+    qp = _pad_seq(q, bq)
+    kp = _pad_seq(k, bk)
+    vp = _pad_seq(v, bk)
+    k_valid = sk if kp.shape[2] != sk else None
+    out, lse = flash_attention_lse(qp, kp, vp, causal, 0, 0, sm_scale, bq, bk,
+                                   interpret, k_valid)
+    if qp.shape[2] != sq:
+        out, lse = out[:, :, :sq], lse[:, :, :sq]
+    return out, lse

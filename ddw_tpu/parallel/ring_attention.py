@@ -7,16 +7,30 @@ lets attention scale past one device's memory by sharding the *sequence* axis.
 Algorithm (Liu et al. 2023, blockwise ring attention): each of the N devices on
 the ``seq`` axis holds Q/K/V shards of S/N tokens. Q stays put; K/V shards rotate
 around the ring N times via ``ppermute`` (ICI neighbor exchange). Each hop, every
-device attends its local Q against the visiting K/V block (blockwise XLA-fused
-attention; block = the shard) and folds the result into a running (max,
-normalizer, accumulator) — the same online softmax as the flash kernel, lifted to
-the ring level, so the full S×S score matrix never exists anywhere. Communication overlaps compute under XLA's
-scheduler; per-hop cost is the local block attention plus one neighbor exchange.
+device runs the Pallas flash kernel (:func:`ddw_tpu.ops.flash_attention
+.flash_attention_lse`) on its local Q against the visiting K/V block — O(S_local)
+VMEM, the S_local x S_local score matrix never exists even per hop — and folds
+the hop's (out, logsumexp) into a running softmax combine, the same online
+softmax as inside the kernel, lifted to the ring level. Communication overlaps
+compute under XLA's scheduler; per-hop cost is one flash call plus one neighbor
+exchange.
 
-Causal masking works on *global* positions: rank r's Q block has offset r*S/N and
-the visiting K block carries its own source offset — passed through to the local
-kernel (``q_offset``/``k_offset``), so blocks that are entirely in the future are
-fully masked and contribute exp(-inf)=0.
+Causal masking works on *global* positions, resolved per hop into one of three
+static cases (the visiting block's offset relative to ours is ``me - hop``):
+  - hop 0: the diagonal block -> causal flash with equal offsets;
+  - visiting block strictly in the past (``hop <= me``) -> full (non-causal)
+    flash, no mask;
+  - visiting block strictly in the future -> fully masked; the hop is SKIPPED
+    via ``lax.cond`` (the old einsum formulation paid full price to multiply
+    by an all -inf mask).
+This keeps the kernel's offsets static (Pallas grid masking needs Python ints)
+while the rank-dependent choice stays dynamic.
+
+Gradient path: ``flash_attention_lse``'s custom VJP carries cotangents for both
+the output and the logsumexp, so the cross-hop combine backpropagates exactly
+(the hop-vs-full equivalence test pins fwd AND grads). Residual memory is the
+per-hop K/V copies (O(S_global) across hops per device — same as the forward
+K/V rotation); the S^2 matrices never exist in any pass.
 
 Use under ``shard_map`` with in_specs splitting the sequence dim over ``seq``.
 """
@@ -27,11 +41,27 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ddw_tpu.ops.flash_attention import flash_mha_lse
+
 _NEG_INF = -1e30
 
 
+def _combine(o1, lse1, o2, lse2):
+    """Softmax-combine two partial attentions over disjoint key sets.
+
+    Each o_i is normalized over its own keys with logsumexp lse_i; the combined
+    result over the union is a convex combination weighted by exp(lse_i - lse).
+    Safe at lse = -inf sentinels: logaddexp keeps the max's scale, weights stay
+    finite, and an all-masked row yields the zero vector."""
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse)[..., None]
+    w2 = jnp.exp(lse2 - lse)[..., None]
+    return o1 * w1 + o2 * w2, lse
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   sm_scale: float | None = None) -> jnp.ndarray:
+                   sm_scale: float | None = None,
+                   block_q: int = 128, block_k: int = 128) -> jnp.ndarray:
     """Blockwise ring attention over ``axis_name``.
 
     Per-device shapes: q/k/v [B, H, S_local, D] (the local sequence shard);
@@ -44,46 +74,44 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     if sm_scale is None:
         sm_scale = 1.0 / float(d) ** 0.5
 
-    # Running online-softmax state over ring hops, in f32. The per-hop local
-    # attention is the blockwise jnp formulation (block = the S/N shard; XLA
-    # fuses it); the Pallas flash kernel is the single-device fast path and can
-    # slot in per-hop once it also returns (m, l) for the cross-hop combine.
-    m = jnp.full((b, h, s_local, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, s_local, 1), jnp.float32)
-    acc = jnp.zeros((b, h, s_local, d), jnp.float32)
-
     perm = [(i, (i + 1) % n) for i in range(n)]
     k_cur, v_cur = k, v
-    q32 = q.astype(jnp.float32)
-    q_off = me * s_local
 
-    @jax.checkpoint
-    def hop_update(m, l, acc, k_hop, v_hop, k_off):
-        """One hop's blockwise-softmax fold. ``jax.checkpoint`` drops the
-        S_local x S_local score/prob intermediates from the residuals —
-        without it autodiff saves them for every hop (O(S_local * S_global)
-        memory, exactly the blowup ring attention exists to avoid) and
-        rematerializes them during backward instead."""
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_hop.astype(jnp.float32)) * sm_scale
-        if causal:
-            qpos = q_off + jnp.arange(s_local)[:, None]
-            kpos = k_off + jnp.arange(s_local)[None, :]
-            s = jnp.where((kpos <= qpos), s, _NEG_INF)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
-                                           v_hop.astype(jnp.float32))
-        return m_new, l_new, acc_new
+    # Running combined (out f32, lse f32) over ring hops.
+    out = jnp.zeros((b, h, s_local, d), jnp.float32)
+    lse = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+
+    def flash(k_hop, v_hop, hop_causal):
+        # flash_mha_lse pads non-tile-multiple s_local internally, so any
+        # shard length works (parity with the einsum formulation it replaced).
+        o, l = flash_mha_lse(q, k_hop, v_hop, hop_causal, sm_scale,
+                             block_q, block_k)
+        return o.astype(jnp.float32), l
 
     for hop in range(n):
-        src = (me - hop) % n                 # which rank's K/V block is visiting
-        m, l, acc = hop_update(m, l, acc, k_cur, v_cur, src * s_local)
+        # Visiting block is rank (me - hop) % n's shard. Relative position in
+        # the global order: hop 0 = our own (diagonal), otherwise strictly past
+        # iff hop <= me, strictly future iff hop > me.
+        if causal and hop == 0:
+            o_h, lse_h = flash(k_cur, v_cur, True)
+            out, lse = _combine(out, lse, o_h, lse_h)
+        elif causal:
+            def _attend(args):
+                out, lse, k_hop, v_hop = args
+                o_h, lse_h = flash(k_hop, v_hop, False)
+                return _combine(out, lse, o_h, lse_h)
+
+            def _skip(args):
+                out, lse, _, _ = args
+                return out, lse
+
+            out, lse = lax.cond(hop <= me, _attend, _skip,
+                                (out, lse, k_cur, v_cur))
+        else:
+            o_h, lse_h = flash(k_cur, v_cur, False)
+            out, lse = _combine(out, lse, o_h, lse_h)
         if hop != n - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
 
-    out = acc / jnp.maximum(l, 1e-30)
     return out.astype(q.dtype)

@@ -117,19 +117,25 @@ def make_zero_train_step(
         sh = zero_state_shardings(state, mesh, axis)
         return jax.tree.map(jax.device_put, state, sh)
 
-    _jit = None  # built on first call (shardings depend on the state structure)
+    # Built per state structure+shapes: the in/out shardings are derived from
+    # the concrete TrainState, so a structurally different state (different
+    # optimizer/model, restored checkpoint with extra leaves) must get its own
+    # jit instead of hitting a stale-sharding pytree mismatch.
+    _jits: dict = {}
 
     def stepper(state, images, labels, rng):
-        nonlocal _jit
-        if _jit is None:
+        key = (jax.tree.structure(state),
+               tuple(tuple(l.shape) for l in jax.tree.leaves(state)))
+        fn = _jits.get(key)
+        if fn is None:
             state_sh = zero_state_shardings(state, mesh, axis)
-            _jit = jax.jit(
+            fn = _jits[key] = jax.jit(
                 _step,
                 in_shardings=(state_sh, batch_sh, batch_sh, repl),
                 out_shardings=(state_sh, repl),
                 donate_argnums=(0,) if donate else (),
             )
-        return _jit(state, images, labels, rng)
+        return fn(state, images, labels, rng)
 
     stepper.place_state = place_state  # type: ignore[attr-defined]
     stepper.batch_sharding = batch_sh  # type: ignore[attr-defined]

@@ -47,7 +47,21 @@ def all_reduce_sum(tree: T, axis_name: str, impl: str = "psum") -> T:
     if impl == "ring":
         return jax.tree.map(lambda x: ring_all_reduce(x, axis_name), tree)
     if impl == "pallas":
-        return jax.tree.map(lambda x: ring_all_reduce_pallas(x, axis_name), tree)
+        # All leaf kernels share one collective_id (hence one barrier
+        # semaphore), so two of them must never be in flight at once: chain
+        # each leaf's input on the previous leaf's output through
+        # lax.optimization_barrier — the same data-edge serialization the
+        # segmented path inside ring_all_reduce_pallas uses. Without it the
+        # leaves have no data dependency and XLA may overlap them on real TPU,
+        # cross-signaling barrier/DMA semaphores (interpret-mode CPU tests run
+        # kernels serially and cannot catch that).
+        leaves, treedef = jax.tree.flatten(tree)
+        reduced = []
+        for leaf in leaves:
+            if reduced:
+                leaf, _ = lax.optimization_barrier((leaf, reduced[-1]))
+            reduced.append(ring_all_reduce_pallas(leaf, axis_name))
+        return jax.tree.unflatten(treedef, reduced)
     raise KeyError(f"unknown allreduce impl {impl!r} (have psum, ring, pallas)")
 
 

@@ -64,6 +64,11 @@ class ModelCfg:
                                         # restoring a package saved with a
                                         # non-default head count.
     pretrained_path: str = ""           # optional converted-weights artifact
+    bn_momentum: float = 0.9            # BatchNorm running-stat momentum. Default
+                                        # 0.9 suits short from-scratch runs; set
+                                        # 0.99 (the Keras MobileNetV2 value) for
+                                        # parity runs finetuning an unfrozen
+                                        # pretrained base.
     dtype: str = "bfloat16"             # compute dtype on the MXU; params stay f32
 
 
