@@ -1,12 +1,21 @@
-"""Pretrained-weight conversion: torch MobileNetV2 state_dict -> flax variables.
+"""Pretrained-weight conversion: torch or Keras MobileNetV2 weights -> flax variables.
 
 The reference's accuracy comes from a *frozen ImageNet-pretrained* MobileNetV2
 base (``Part 1 - Distributed Training/02_model_training_single_node.py:164-169``);
 SURVEY.md §7 hard-part 1 chooses option (a): convert pretrained weights into our
-JAX module once, as a data artifact. This module is that converter. It accepts a
-state_dict in torchvision's ``mobilenet_v2`` naming scheme (``features.N...``) —
-the de-facto public distribution format for these weights — and emits the flax
-param/batch_stats trees of :class:`ddw_tpu.models.mobilenet_v2.MobileNetV2Backbone`.
+JAX module once, as a data artifact. This module is that converter. Two source
+layouts are accepted, covering both public distributions of these weights:
+
+- **torchvision** ``mobilenet_v2`` state_dict (``features.N...`` naming) —
+  :func:`convert_torch_mobilenet_v2`;
+- **Keras applications** ``MobileNetV2(include_top=False)`` weights (``Conv1`` /
+  ``block_N_expand`` / ``Conv_1`` layer naming — the exact format the reference
+  itself downloads at ``02_model_training_single_node.py:164``) —
+  :func:`convert_keras_mobilenet_v2`, fed from an ``.h5`` weights file or an
+  ``.npz`` of ``layer/weight`` arrays via :func:`load_keras_weights`.
+
+Both emit the flax param/batch_stats trees of
+:class:`ddw_tpu.models.mobilenet_v2.MobileNetV2Backbone`.
 
 Exactness notes:
 - conv kernels: torch ``[out, in, kh, kw]`` -> flax ``[kh, kw, in, out]``; the
@@ -25,9 +34,11 @@ Artifact format: ``.npz`` with flattened keys ``params/backbone/...`` and
 :func:`load_pretrained` (wired into ``train.step.init_state`` via
 ``ModelCfg.pretrained_path``).
 
-CLI: ``python -m ddw_tpu.models.convert weights.pt out.npz`` (``weights.pt`` is
-a ``torch.save``-d state_dict, e.g. ``torchvision.models.mobilenet_v2(
-weights='IMAGENET1K_V1').state_dict()`` exported on any machine).
+CLI: ``python -m ddw_tpu.models.convert weights.{pt,h5,npz} out.npz`` —
+``.pt`` is a ``torch.save``-d state_dict (e.g. ``torchvision.models.
+mobilenet_v2(weights='IMAGENET1K_V1').state_dict()``); ``.h5``/``.npz`` is a
+Keras weights file (e.g. ``tf.keras.applications.MobileNetV2(include_top=False,
+weights='imagenet').save_weights('w.h5')``), each exported on any machine.
 """
 
 from __future__ import annotations
@@ -101,6 +112,101 @@ def convert_torch_mobilenet_v2(state_dict: dict, eps_src: float = _EPS_TORCH
     return {"params": params, "batch_stats": stats}
 
 
+_EPS_KERAS = 1e-3  # Keras BatchNorm epsilon == ours: the eps fold is identity
+
+
+def _keras_bn(w: dict, layer: str, eps_src: float) -> tuple[dict, dict]:
+    scale = _np(w[f"{layer}/gamma"])
+    bias = _np(w[f"{layer}/beta"])
+    mean = _np(w[f"{layer}/moving_mean"])
+    var = _np(w[f"{layer}/moving_variance"])
+    scale = scale * np.sqrt((var + _EPS_FLAX) / (var + eps_src))
+    return {"scale": scale, "bias": bias}, {"mean": mean, "var": var}
+
+
+def _keras_convbn(w: dict, conv: str, bn: str, eps_src: float, depthwise: bool):
+    if depthwise:
+        # Keras depthwise_kernel [kh, kw, C, mult=1] -> flax grouped-conv
+        # kernel [kh, kw, 1, C] (feature_group_count=C).
+        kernel = _np(w[f"{conv}/depthwise_kernel"]).transpose(0, 1, 3, 2)
+    else:
+        kernel = _np(w[f"{conv}/kernel"])  # [kh, kw, in, out] — already flax layout
+    bn_params, bn_stats = _keras_bn(w, bn, eps_src)
+    return ({"Conv_0": {"kernel": kernel}, "BatchNorm_0": bn_params},
+            {"BatchNorm_0": bn_stats})
+
+
+def convert_keras_mobilenet_v2(weights: dict, eps_src: float = _EPS_KERAS
+                               ) -> dict[str, dict]:
+    """Keras-applications-layout weights -> ``{"params", "batch_stats"}`` trees
+    of ``MobileNetV2Backbone`` (width_mult 1.0).
+
+    ``weights`` maps ``"layer_name/weight_name"`` (``:0`` suffixes stripped —
+    see :func:`load_keras_weights`) to arrays. Keras MobileNetV2 layer naming:
+    stem ``Conv1``/``bn_Conv1``; block 0 (expansion 1, no expand conv)
+    ``expanded_conv_{depthwise,project}``; blocks 1-16
+    ``block_N_{expand,depthwise,project}`` each with a ``..._BN`` twin; top
+    ``Conv_1``/``Conv_1_bn``.
+    """
+    params: dict = {}
+    stats: dict = {}
+    params["ConvBN_0"], stats["ConvBN_0"] = _keras_convbn(
+        weights, "Conv1", "bn_Conv1", eps_src, depthwise=False)
+    block = 0
+    for t, _c, n, _s in _INVERTED_RESIDUAL_CFG:
+        for _ in range(n):
+            pfx = "expanded_conv" if block == 0 else f"block_{block}"
+            stages = []
+            if t != 1:
+                stages.append((f"{pfx}_expand", f"{pfx}_expand_BN", False))
+            stages += [(f"{pfx}_depthwise", f"{pfx}_depthwise_BN", True),
+                       (f"{pfx}_project", f"{pfx}_project_BN", False)]
+            sub_p: dict = {}
+            sub_s: dict = {}
+            for i, (conv, bn, dw) in enumerate(stages):
+                sub_p[f"ConvBN_{i}"], sub_s[f"ConvBN_{i}"] = _keras_convbn(
+                    weights, conv, bn, eps_src, depthwise=dw)
+            params[f"InvertedResidual_{block}"] = sub_p
+            stats[f"InvertedResidual_{block}"] = sub_s
+            block += 1
+    params["ConvBN_1"], stats["ConvBN_1"] = _keras_convbn(
+        weights, "Conv_1", "Conv_1_bn", eps_src, depthwise=False)
+    return {"params": params, "batch_stats": stats}
+
+
+def load_keras_weights(path: str) -> dict[str, np.ndarray]:
+    """Read a Keras weights file into a flat ``"layer/weight"`` dict.
+
+    ``.h5``: walks every dataset under the file (handles both
+    ``save_weights`` layout ``layer/layer/weight:0`` and full-model
+    ``model_weights/...``), keying by the last two non-duplicate path parts.
+    ``.npz``: keys pass through. ``:0`` tensor suffixes are stripped either way.
+    """
+    flat: dict[str, np.ndarray] = {}
+
+    def put(parts: list[str], arr: np.ndarray):
+        parts = [p for p in parts if p not in ("model_weights", "")]
+        # save_weights h5 nests layer/layer/weight — collapse the duplicate
+        dedup = [p for i, p in enumerate(parts) if i == 0 or p != parts[i - 1]]
+        name = "/".join(dedup[-2:]).removesuffix(":0")
+        flat[name] = np.asarray(arr, np.float32)
+
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            for k in z.files:
+                put(k.split("/"), z[k])
+        return flat
+
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        def visit(name, obj):
+            if isinstance(obj, h5py.Dataset):
+                put(name.split("/"), obj[()])
+        f.visititems(visit)
+    return flat
+
+
 def save_pretrained(path: str, backbone_vars: dict, scope: str = "backbone") -> None:
     """Write the converted backbone as the ``.npz`` artifact ``ModelCfg.
     pretrained_path`` points at, keys fully qualified under ``scope``."""
@@ -139,14 +245,25 @@ def main(argv=None) -> None:
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("state_dict", help="torch.save-d mobilenet_v2 state_dict (.pt)")
+    ap.add_argument("weights", help="torch state_dict (.pt) or Keras weights "
+                                    "(.h5 / .npz of layer/weight arrays)")
     ap.add_argument("out", help="output .npz artifact path")
     args = ap.parse_args(argv)
 
-    import torch
+    if args.weights.endswith((".h5", ".hdf5")):
+        converted = convert_keras_mobilenet_v2(load_keras_weights(args.weights))
+    elif args.weights.endswith(".npz"):
+        w = load_keras_weights(args.weights)
+        if not any(k.startswith("Conv1/") for k in w):
+            raise SystemExit(f"{args.weights}: no Conv1/* keys — not a Keras "
+                             f"MobileNetV2 weights archive")
+        converted = convert_keras_mobilenet_v2(w)
+    else:
+        import torch
 
-    sd = torch.load(args.state_dict, map_location="cpu", weights_only=True)
-    save_pretrained(args.out, convert_torch_mobilenet_v2(sd))
+        sd = torch.load(args.weights, map_location="cpu", weights_only=True)
+        converted = convert_torch_mobilenet_v2(sd)
+    save_pretrained(args.out, converted)
     print(f"wrote {args.out}")
 
 

@@ -30,15 +30,29 @@ def build_model(cfg: ModelCfg):
     model = MODEL_REGISTRY[cfg.name](cfg)
     if (cfg.freeze_base and not cfg.pretrained_path
             and type(model).frozen_prefixes(True)):
-        # freeze_base defaults True for the reference's transfer contract; a
-        # frozen *random* backbone trains only the head over noise features.
+        # freeze_base defaults True for the reference's transfer contract, but
+        # a frozen *random* backbone trains only the head over noise features —
+        # accuracy stays near chance. Unless the caller explicitly opts into
+        # that (allow_frozen_random: mechanism tests, throughput benchmarks),
+        # auto-unfreeze so the model actually trains.
+        import dataclasses
         import warnings
 
-        warnings.warn(
-            f"{cfg.name}: freeze_base=True with no pretrained_path freezes a "
-            f"randomly initialized backbone (accuracy will stay near chance); "
-            f"set model.freeze_base=false or provide pretrained weights",
-            stacklevel=2)
+        if cfg.allow_frozen_random:
+            warnings.warn(
+                f"{cfg.name}: freeze_base=True with no pretrained_path freezes "
+                f"a randomly initialized backbone (accuracy will stay near "
+                f"chance); allow_frozen_random=True keeps it frozen anyway",
+                stacklevel=2)
+        else:
+            warnings.warn(
+                f"{cfg.name}: freeze_base=True needs model.pretrained_path (a "
+                f"converted-weights artifact; see ddw_tpu.models.convert) — "
+                f"auto-unfreezing the randomly initialized backbone. Set "
+                f"model.allow_frozen_random=true to keep it frozen.",
+                stacklevel=2)
+            model = MODEL_REGISTRY[cfg.name](
+                dataclasses.replace(cfg, freeze_base=False))
     return model
 
 

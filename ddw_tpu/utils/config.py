@@ -64,6 +64,11 @@ class ModelCfg:
                                         # restoring a package saved with a
                                         # non-default head count.
     pretrained_path: str = ""           # optional converted-weights artifact
+    allow_frozen_random: bool = False   # opt-in: keep freeze_base=True even with
+                                        # no pretrained_path (build_model otherwise
+                                        # auto-unfreezes — a frozen random backbone
+                                        # trains the head over noise). For
+                                        # mechanism tests and throughput benches.
     bn_momentum: float = 0.9            # BatchNorm running-stat momentum. Default
                                         # 0.9 suits short from-scratch runs; set
                                         # 0.99 (the Keras MobileNetV2 value) for

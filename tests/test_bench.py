@@ -1,0 +1,67 @@
+"""bench.py contract: one JSON line, full matrix, MFU fields present.
+
+Runs the benchmark in DDW_BENCH_SMOKE mode (tiny shapes, 2 measured steps) on
+whatever backend the test session uses — the assertions check structure and
+positivity, not absolute performance.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench_json():
+    env = dict(os.environ, DDW_BENCH_SMOKE="1")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def test_headline_contract(bench_json):
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in bench_json
+    assert bench_json["value"] > 0
+    assert bench_json["unit"] == "images/sec/chip"
+
+
+def test_matrix_rows(bench_json):
+    configs = bench_json["configs"]
+    for name in ("mobilenet_v2_frozen", "mobilenet_v2_unfrozen", "resnet50",
+                 "vit", "lm_flash"):
+        row = configs[name]
+        assert "error" not in row, f"{name}: {row}"
+        assert row["rate_per_chip"] > 0
+        assert row["step_time_ms"] > 0
+        # XLA cost analysis may be unavailable on some backends; when present
+        # the derived fields must be populated.
+        if row["step_flops"]:
+            assert row["achieved_tflops_per_chip"] > 0
+    assert configs["lm_flash"]["unit"] == "tokens/sec/chip"
+
+
+def test_flops_ordering(bench_json):
+    """Unfrozen backward must cost more FLOPs than frozen (backbone skipped)."""
+    c = bench_json["configs"]
+    fro = c["mobilenet_v2_frozen"]["step_flops"]
+    unf = c["mobilenet_v2_unfrozen"]["step_flops"]
+    if fro and unf:
+        assert unf > fro * 1.5
+
+
+def test_host_pipeline(bench_json):
+    host = bench_json["host_pipeline"]
+    if "error" in host:
+        pytest.skip(host["error"])
+    assert host["pil_images_per_sec"] > 0
+    if host["native_images_per_sec"] is not None:
+        assert host["native_images_per_sec"] > 0
+        assert host["native_ok_fraction"] == 1.0

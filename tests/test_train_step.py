@@ -95,7 +95,8 @@ def test_frozen_base_masking():
     trainable=False semantics, reference 02_model_training_single_node.py:169)."""
     mesh = make_mesh(MeshSpec((("data", 2),)), devices=jax.devices()[:2])
     mcfg = ModelCfg(name="mobilenet_v2", num_classes=5, dropout=0.0,
-                    freeze_base=True, dtype="float32", width_mult=0.35)
+                    freeze_base=True, allow_frozen_random=True,
+                    dtype="float32", width_mult=0.35)
     tcfg = TrainCfg(batch_size=4, learning_rate=1e-2)
     m = build_model(mcfg)
     state, tx = init_state(m, mcfg, tcfg, (32, 32, 3), jax.random.PRNGKey(0))

@@ -88,7 +88,8 @@ def test_zero_step_batchnorm_model_runs_syncbn():
 
 
 def test_resnet_frozen_random_backbone_warns():
-    mcfg = ModelCfg(name="resnet18", num_classes=5, freeze_base=True)
+    mcfg = ModelCfg(name="resnet18", num_classes=5, freeze_base=True,
+                    allow_frozen_random=True)
     with pytest.warns(UserWarning, match="randomly initialized backbone"):
         build_model(mcfg)
 
