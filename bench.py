@@ -18,11 +18,14 @@ the whole train step, not the analytical 6ND convention — it is directly
 defensible because both numerator (XLA's own FLOP count) and denominator
 (published chip peak) are external to this code.
 
-Timing discipline (noise floor <2%): per config, ``REPEATS`` independent runs of
-``measure_steps`` chained donated steps, each corrected by subtracting a short
-run (dispatch/tunnel round-trip latency is large and variable on tunneled
-single-chip setups and would otherwise be charged to the steps); the reported
-rate is the median over repeats.
+Timing discipline (noise floor <2%): chained donated steps, with completion
+forced by a device-to-host fetch of the final step's scalar loss —
+``jax.block_until_ready`` alone can acknowledge before device work finishes on
+tunneled backends (measured here: it reported a 8192³ bf16 matmul at 50 µs ≈
+22 PF/s; the forced-fetch number is ~8 ms ≈ 140 TF/s, the sane v5e figure).
+The per-step time is ``(T(2N) - T(N)) / N`` — the difference cancels the fixed
+dispatch + fetch latency — with N grown adaptively until the differential is
+>= ~1 s of device work, then the median over ``REPEATS`` differentials.
 
 Also measures the host input pipeline (SURVEY.md §7 hard-part 3): native C++
 JPEG decode rate vs PIL vs the device step rate, answering "is the chip ever
@@ -46,13 +49,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# Round-1 measurement on one TPU v5e chip (this script, first run); later rounds
-# report speedup vs this anchor.
-BASELINE_IPS = 237606.49  # round-1 anchor, TPU v5e-1, 2026-07-29
+# Anchor for vs_baseline: the round-2 corrected measurement on one TPU v5e chip.
+# Round 1 recorded 237,606 img/s, but that number was a measurement artifact:
+# jax.block_until_ready acks before device work completes on this tunneled
+# backend, so the old timing loop mostly measured dispatch rate (it also rated
+# an 8192³ bf16 matmul at 22 PF/s on a 197 TF/s chip). The forced-fetch
+# differential timing below supersedes it; BASELINE.md "Measured" documents
+# both.
+BASELINE_IPS = 40030.89  # round-2 anchor (corrected timing), TPU v5e-1, 2026-07-29
 
 SMOKE = bool(int(os.environ.get("DDW_BENCH_SMOKE", "0") or "0"))
 REPEATS = 1 if SMOKE else 3
-SHORT_STEPS = 1 if SMOKE else 10
+# Adaptive sizing: grow N until one differential run holds >= this much device
+# work, so fixed dispatch/fetch latency stays inside the noise floor.
+MIN_MEASURE_S = 0.05 if SMOKE else 1.0
+MAX_STEPS = 8 if SMOKE else 1024
 
 # bf16 peak TFLOP/s per *jax device* (chip for v4+, core for v2/v3); public
 # spec-sheet numbers. Unknown kinds report mfu=null rather than guess.
@@ -89,17 +100,27 @@ def _compiled_flops(lowered_compiled) -> float | None:
         return None
 
 
-def _time_steps(run_n, measure_steps: int) -> float:
-    """Seconds of device work for ``measure_steps`` chained steps (median over
-    REPEATS, each short-run-corrected; falls back to the uncorrected long run —
-    an underestimate of rate, never an inflation)."""
-    times = []
-    for _ in range(REPEATS):
-        t_short = run_n(SHORT_STEPS)
-        t_long = run_n(measure_steps + SHORT_STEPS)
-        dt = t_long - t_short
-        times.append(dt if dt > 0 else t_long)
-    return statistics.median(times)
+def _time_steps(run_n) -> tuple[float, int]:
+    """True seconds-per-``N``-steps of device work, via differential timing.
+
+    ``run_n(n)`` must run ``n`` chained steps and FORCE completion with a
+    device-to-host fetch (``np.asarray`` of a scalar output) — block_until_ready
+    alone acks early on tunneled backends. The differential ``T(2N) - T(N)``
+    cancels the fixed dispatch+fetch latency; N doubles until the differential
+    holds >= MIN_MEASURE_S of device work. Returns (median differential seconds,
+    N) — i.e. the time N steps take.
+    """
+    n = 2 if SMOKE else 8
+    while True:
+        dt = run_n(2 * n) - run_n(n)
+        if dt >= MIN_MEASURE_S or n >= MAX_STEPS:
+            break
+        n *= 2
+    times = [dt]
+    for _ in range(REPEATS - 1):
+        times.append(run_n(2 * n) - run_n(n))
+    good = [t for t in times if t > 0]
+    return (statistics.median(good) if good else run_n(n)), n
 
 
 def _row(items_per_step: int, n_chips: int, dt: float, measure_steps: int,
@@ -123,7 +144,7 @@ def _row(items_per_step: int, n_chips: int, dt: float, measure_steps: int,
 
 
 def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
-                 img: tuple, measure_steps: int, peak: float | None) -> dict:
+                 img: tuple, peak: float | None) -> dict:
     from ddw_tpu.models.registry import build_model
     from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
     from ddw_tpu.train.step import (batch_sharding, init_state, make_train_step,
@@ -160,18 +181,18 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
     flops = _compiled_flops(compiled)
 
     state, metrics = compiled(state, images, labels, key)  # warmup
-    jax.block_until_ready(metrics["loss"])
+    np.asarray(metrics["loss"])
 
     def run_n(n):
         nonlocal state
         t0 = time.perf_counter()
         for _ in range(n):
             state, m = compiled(state, images, labels, key)
-        jax.block_until_ready(m["loss"])
+        np.asarray(m["loss"])  # forced D2H: true completion barrier
         return time.perf_counter() - t0
 
-    dt = _time_steps(run_n, measure_steps)
-    row = _row(global_batch, n_chips, dt, measure_steps, flops, peak,
+    dt, measured_steps = _time_steps(run_n)
+    row = _row(global_batch, n_chips, dt, measured_steps, flops, peak,
                "images/sec/chip")
     row["batch_per_chip"] = batch
     row["image"] = list(img)
@@ -179,7 +200,7 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
 
 
 def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
-             vocab: int, measure_steps: int, peak: float | None) -> dict:
+             vocab: int, peak: float | None) -> dict:
     import optax
 
     from ddw_tpu.models.lm import TransformerLM
@@ -210,18 +231,18 @@ def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
     compiled = step.lower(state, inputs, targets, key).compile()
     flops = _compiled_flops(compiled)
     state, metrics = compiled(state, inputs, targets, key)
-    jax.block_until_ready(metrics["loss"])
+    np.asarray(metrics["loss"])
 
     def run_n(n):
         nonlocal state
         t0 = time.perf_counter()
         for _ in range(n):
             state, m = compiled(state, inputs, targets, key)
-        jax.block_until_ready(m["loss"])
+        np.asarray(m["loss"])  # forced D2H: true completion barrier
         return time.perf_counter() - t0
 
-    dt = _time_steps(run_n, measure_steps)
-    row = _row(global_batch * seq, n_chips, dt, measure_steps, flops, peak,
+    dt, measured_steps = _time_steps(run_n)
+    row = _row(global_batch * seq, n_chips, dt, measured_steps, flops, peak,
                "tokens/sec/chip")
     row.update(batch_per_chip=batch, seq_len=seq, hidden=hidden, depth=depth)
     return row
@@ -258,10 +279,13 @@ def bench_host_pipeline(n_images: int, hw: int, device_ips: float | None) -> dic
     else:
         out["native_images_per_sec"] = None
 
+    # Same work as the native path (decode + resize + scale-to-[-1,1]) so the
+    # comparison is fair; single-threaded, like one PIL fallback worker.
+    from ddw_tpu.data.loader import _preprocess_image_pil
+
     t0 = time.perf_counter()
     for c in contents:
-        np.asarray(Image.open(io.BytesIO(c)).convert("RGB"),
-                   np.float32)  # noqa: B018 — timed decode
+        _preprocess_image_pil(c, hw, hw)
     out["pil_images_per_sec"] = round(n_images / (time.perf_counter() - t0), 1)
 
     if device_ips and out.get("native_images_per_sec"):
@@ -278,29 +302,25 @@ def main():
     n_chips = len(jax.devices())
 
     if SMOKE:
-        img, batch, vis_steps = (64, 64, 3), 8, 2
+        img, batch = (64, 64, 3), 8
         lm_kw = dict(batch=8, seq=128, hidden=64, depth=2, heads=4, vocab=256,
-                     measure_steps=2, peak=peak)
+                     peak=peak)
         host_n, host_hw = 16, 64
     else:
-        img, batch, vis_steps = (224, 224, 3), 256, 100
+        img, batch = (224, 224, 3), 256
         lm_kw = dict(batch=8, seq=2048, hidden=512, depth=6, heads=8,
-                     vocab=8192, measure_steps=20, peak=peak)
+                     vocab=8192, peak=peak)
         host_n, host_hw = 512, 224
 
     matrix = {
         "mobilenet_v2_frozen": lambda: bench_vision(
-            "mobilenet_v2", freeze_base=True, batch=batch, img=img,
-            measure_steps=vis_steps, peak=peak),
+            "mobilenet_v2", freeze_base=True, batch=batch, img=img, peak=peak),
         "mobilenet_v2_unfrozen": lambda: bench_vision(
-            "mobilenet_v2", freeze_base=False, batch=batch, img=img,
-            measure_steps=max(vis_steps // 2, 2), peak=peak),
+            "mobilenet_v2", freeze_base=False, batch=batch, img=img, peak=peak),
         "resnet50": lambda: bench_vision(
-            "resnet50", freeze_base=False, batch=batch, img=img,
-            measure_steps=max(vis_steps // 2, 2), peak=peak),
+            "resnet50", freeze_base=False, batch=batch, img=img, peak=peak),
         "vit": lambda: bench_vision(
-            "vit", freeze_base=False, batch=batch, img=img,
-            measure_steps=max(vis_steps // 2, 2), peak=peak),
+            "vit", freeze_base=False, batch=batch, img=img, peak=peak),
         "lm_flash": lambda: bench_lm(**lm_kw),
     }
     only = [s for s in os.environ.get("DDW_BENCH_ONLY", "").split(",") if s]

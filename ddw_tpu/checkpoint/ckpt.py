@@ -106,3 +106,14 @@ class CheckpointManager:
 
     def latest_step(self):
         return latest_step(self.ckpt_dir)
+
+    def read_metadata(self, step: int | None = None) -> dict | None:
+        """The JSON metadata sidecar saved with a checkpoint (epoch, metrics,
+        and the host-side callback counters a true resume needs)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        path = os.path.join(self.ckpt_dir, f"step_{step:010d}", "metadata.json")
+        with open(path) as f:
+            return json.load(f)

@@ -115,6 +115,11 @@ class ShardedLoader:
       workers: decode thread pool size (petastorm ``workers_count`` role, ``:200``).
       prefetch_to: optional ``jax.sharding.Sharding`` — batches are transferred to
         device(s) on a background thread, ``prefetch`` deep.
+      skip_records: fast-forward the (deterministic, seeded) record stream this
+        many records before the first batch — exact resume of a consumed-batch
+        position without decoding the skipped images. A trainer that consumed
+        ``k`` batches before checkpointing resumes the identical stream with
+        ``skip_records = k * batch_size``.
     """
 
     def __init__(
@@ -131,6 +136,7 @@ class ShardedLoader:
         workers: int = 4,
         prefetch: int = 2,
         prefetch_to=None,
+        skip_records: int = 0,
     ):
         if not 0 <= cur_shard < shard_count:
             raise ValueError(f"cur_shard {cur_shard} out of range for shard_count {shard_count}")
@@ -146,6 +152,7 @@ class ShardedLoader:
         self.workers = workers
         self.prefetch = prefetch
         self.prefetch_to = prefetch_to
+        self.skip_records = skip_records
 
         shards = list(table.shard_paths)
         if len(shards) >= shard_count:
@@ -216,6 +223,16 @@ class ShardedLoader:
                 yield from buf
             epoch += 1
 
+    def _iter_raw_resumed(self) -> Iterator[tuple[bytes, int]]:
+        """The raw stream, fast-forwarded ``skip_records`` records. Skipping
+        advances the shuffle RNG identically to consuming, so the resumed
+        stream is byte-for-byte the continuation of the original one; skipped
+        records are never decoded (raw-bytes cost only)."""
+        it = self._iter_raw()
+        for _ in range(self.skip_records):
+            next(it)
+        return it
+
     def _iter_batches(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         from ddw_tpu.native.decode import decode_batch_native, native_available
 
@@ -227,7 +244,7 @@ class ShardedLoader:
             # release, real OS-thread decode parallelism); per-image failures
             # fall back to PIL.
             contents: list[bytes] = []
-            for content, label_idx in self._iter_raw():
+            for content, label_idx in self._iter_raw_resumed():
                 lbls[len(contents)] = label_idx
                 contents.append(content)
                 if len(contents) == self.batch_size:
@@ -253,7 +270,7 @@ class ShardedLoader:
                 )
 
             i = 0
-            for img, lbl in bounded_map(pool, decode, self._iter_raw(),
+            for img, lbl in bounded_map(pool, decode, self._iter_raw_resumed(),
                                         self.workers * 4):
                 imgs[i], lbls[i] = img, lbl
                 i += 1

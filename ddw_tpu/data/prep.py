@@ -68,6 +68,26 @@ def build_label_index(labels: Sequence[str]) -> dict[str, int]:
     return {lbl: i for i, lbl in enumerate(sorted(set(labels)))}
 
 
+def _prep_plan(source_dir: str, sample_fraction: float, train_fraction: float,
+               split_seed: int):
+    """The deterministic global ETL plan — identical on every worker.
+
+    (sorted+sampled paths, label_to_idx, train-membership index set). Because
+    the plan depends only on the source tree and seeds, distributed workers
+    can each compute it locally and agree without communicating (the Spark
+    driver's query plan role, reference ``01_data_prep.py:61-66,162``).
+    """
+    paths = scan_jpeg_tree(source_dir, sample_fraction)
+    if not paths:
+        raise FileNotFoundError(f"no JPEGs under {source_dir}")
+    label_to_idx = build_label_index([label_from_path(p) for p in paths])
+    rng = np.random.RandomState(split_seed)
+    perm = rng.permutation(len(paths))
+    n_train = int(math.floor(train_fraction * len(paths)))
+    train_ids = set(perm[:n_train].tolist())
+    return paths, label_to_idx, train_ids
+
+
 def prepare_flowers(
     source_dir: str,
     store: TableStore,
@@ -85,15 +105,15 @@ def prepare_flowers(
     Returns (silver_train, silver_val, label_to_idx). Split uses a seeded
     permutation of the bronze rows (the ``randomSplit([.9,.1], seed=42)`` role,
     reference ``01_data_prep.py:162``). ``io_workers`` parallelizes the raw
-    file reads (executor-scan role) without changing record order.
+    file reads (executor-scan role) without changing record order. For
+    multi-process prep see :func:`prepare_flowers_distributed`.
     """
     from concurrent.futures import ThreadPoolExecutor
 
     from ddw_tpu.data.loader import bounded_map
 
-    paths = scan_jpeg_tree(source_dir, sample_fraction)
-    if not paths:
-        raise FileNotFoundError(f"no JPEGs under {source_dir}")
+    paths, label_to_idx, train_ids = _prep_plan(
+        source_dir, sample_fraction, train_fraction, split_seed)
 
     def read_one(p: str) -> Record:
         with open(p, "rb") as f:
@@ -106,14 +126,6 @@ def prepare_flowers(
     bronze = store.write(bronze_name, bronze_records(), shard_size=shard_size,
                          meta={"source_dir": source_dir, "sample_fraction": sample_fraction})
 
-    labels = [label_from_path(p) for p in paths]
-    label_to_idx = build_label_index(labels)
-
-    rng = np.random.RandomState(split_seed)
-    perm = rng.permutation(len(paths))
-    n_train = int(math.floor(train_fraction * len(paths)))
-    train_ids = set(perm[:n_train].tolist())
-
     # Single pass over bronze, routing each record to its split writer (re-reading
     # the bronze table once per destination would double prep IO at scale).
     t_meta = {"label_to_idx": label_to_idx, "split": "train", "split_seed": split_seed}
@@ -125,6 +137,103 @@ def prepare_flowers(
             silver_rec = Record(rec.path, rec.content, lbl, label_to_idx[lbl])
             (tw if i in train_ids else vw).append(silver_rec)
     return tw.close(), vw.close(), label_to_idx
+
+
+def prepare_flowers_distributed(
+    source_dir: str,
+    store: TableStore,
+    worker_index: int,
+    worker_count: int,
+    sample_fraction: float = 0.5,
+    train_fraction: float = 0.9,
+    split_seed: int = 42,
+    shard_size: int = 256,
+    bronze_name: str = "flowers_bronze",
+    train_name: str = "silver_train",
+    val_name: str = "silver_val",
+    io_workers: int = 8,
+    merge_timeout_s: float = 600.0,
+) -> tuple[Table, Table, dict[str, int]] | None:
+    """Multi-worker 01_data_prep: the Spark-executors ETL role, shared-nothing.
+
+    Every worker computes the identical deterministic plan (:func:`_prep_plan`),
+    takes the round-robin slice ``paths[worker_index::worker_count]``, reads its
+    files on a thread pool, and writes per-worker part tables
+    (``<name>_p<w>``). Worker 0 then waits for all parts and commits the final
+    tables via zero-copy manifest merge (:meth:`TableStore.merge_shards`) —
+    the executors-scan / driver-commits split of the reference
+    (``01_data_prep.py:61-95``). Same split membership and label index as
+    :func:`prepare_flowers` (the plan is shared); record order differs
+    (per-worker striping), which the shuffling loader never observes.
+
+    Returns (silver_train, silver_val, label_to_idx) on worker 0, None on
+    other workers. Workers must share ``store``'s filesystem.
+    """
+    import hashlib
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ddw_tpu.data.loader import bounded_map
+
+    if not 0 <= worker_index < worker_count:
+        raise ValueError(f"worker_index {worker_index} out of range "
+                         f"for worker_count {worker_count}")
+    paths, label_to_idx, train_ids = _prep_plan(
+        source_dir, sample_fraction, train_fraction, split_seed)
+    my = list(range(worker_index, len(paths), worker_count))
+
+    # Run token: every worker derives the identical id from the run's actual
+    # inputs (config + the sampled files' identity), with no communication.
+    # The coordinator only merges parts carrying this id, so a re-run against
+    # changed data can never silently mix a previous run's parts
+    # (TableStore.await_parts). Same data + config => same id, and then stale
+    # parts are byte-identical to fresh ones, so matching them is harmless.
+    h = hashlib.sha256(repr((worker_count, sample_fraction, train_fraction,
+                             split_seed, shard_size)).encode())
+    for p in paths:
+        st = os.stat(p)
+        h.update(f"{p}|{st.st_size}|{st.st_mtime_ns}\n".encode())
+    run_id = h.hexdigest()[:16]
+
+    def read_one(i: int) -> tuple[int, Record]:
+        with open(paths[i], "rb") as f:
+            return i, Record(path=paths[i], content=f.read())
+
+    part = f"_p{worker_index}"
+    b_meta = {"source_dir": source_dir, "sample_fraction": sample_fraction,
+              "worker": worker_index, "run_id": run_id}
+    t_meta = {"label_to_idx": label_to_idx, "split": "train",
+              "split_seed": split_seed, "worker": worker_index,
+              "run_id": run_id}
+    v_meta = {**t_meta, "split": "val"}
+    with store.writer(bronze_name + part, shard_size, b_meta) as bw, \
+         store.writer(train_name + part, shard_size, t_meta) as tw, \
+         store.writer(val_name + part, shard_size, v_meta) as vw, \
+         ThreadPoolExecutor(max_workers=io_workers) as pool:
+        for i, rec in bounded_map(pool, read_one, my, io_workers * 4):
+            bw.append(rec)
+            lbl = label_from_path(rec.path)
+            silver = Record(rec.path, rec.content, lbl, label_to_idx[lbl])
+            (tw if i in train_ids else vw).append(silver)
+
+    if worker_index != 0:
+        return None
+
+    # Coordinator: wait for every worker's current-run parts, then commit
+    # merged tables (zero-copy manifest concat).
+    def merge(name, meta):
+        parts = store.await_parts([f"{name}_p{w}" for w in range(worker_count)],
+                                  run_id, merge_timeout_s)
+        return store.merge_shards(name, parts,
+                                  meta={**meta, "worker_count": worker_count,
+                                        "run_id": run_id})
+
+    merge(bronze_name, {"source_dir": source_dir,
+                        "sample_fraction": sample_fraction})
+    train_tbl = merge(train_name, {"label_to_idx": label_to_idx,
+                                   "split": "train", "split_seed": split_seed})
+    val_tbl = merge(val_name, {"label_to_idx": label_to_idx,
+                               "split": "val", "split_seed": split_seed})
+    return train_tbl, val_tbl, label_to_idx
 
 
 # ---------------------------------------------------------------------------

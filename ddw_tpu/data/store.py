@@ -286,6 +286,66 @@ class TableStore:
     def exists(self, name: str) -> bool:
         return os.path.exists(os.path.join(self._table_dir(name), "latest"))
 
+    def await_parts(self, part_names: list[str], run_id: str,
+                    timeout_s: float = 300.0) -> list[Table]:
+        """Wait (bounded) for every part table's LATEST version to carry
+        ``meta.run_id == run_id``, then return them.
+
+        ``exists()`` alone is not enough: a previous run's version also
+        satisfies it, and a coordinator would silently merge stale parts while
+        slower workers are still writing the current run's (the classic
+        shared-filesystem rendezvous race). The run token — identical on every
+        worker by construction, caller-derived from the run's inputs — is the
+        fence.
+        """
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            pending = []
+            for n in part_names:
+                if not self.exists(n):
+                    pending.append(n)
+                    continue
+                if Table(os.path.join(self._table_dir(n),
+                                      open(os.path.join(self._table_dir(n),
+                                                        "latest")).read().strip())
+                         ).meta.get("run_id") != run_id:
+                    pending.append(f"{n} (stale run_id)")
+            if not pending:
+                return [self.table(n) for n in part_names]
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"parts never appeared for run {run_id!r}: {pending}")
+            _time.sleep(0.2)
+
+    def merge_shards(self, name: str, parts: list[Table],
+                     meta: dict | None = None) -> Table:
+        """Coordinator-side merge: a new version of ``name`` whose shards ARE the
+        parts' shard files (hardlinked when the filesystem allows, else copied)
+        — manifests concatenate, record bytes never re-encode. The multi-worker
+        ETL analog of Spark executors writing partition files and the driver
+        committing one table (reference ``01_data_prep.py:61-95``: the scan
+        parallelizes across executors, the table commit is single)."""
+        import shutil
+
+        w = TableWriter(self, name, meta=meta)
+        metas: list[dict] = []
+        total = 0
+        for t in parts:
+            for sm, sp in zip(t.manifest["shards"], t.shard_paths):
+                fn = f"shard-{len(metas):05d}.ddws"
+                dst = os.path.join(w.shards_dir, fn)
+                try:
+                    os.link(sp, dst)
+                except OSError:
+                    shutil.copy2(sp, dst)
+                metas.append({**sm, "file": fn})
+                total += sm["num_records"]
+        w._shard_metas = metas
+        w._total = total
+        return w.close()
+
     def list_tables(self) -> list[str]:
         if not os.path.isdir(self.root):
             return []

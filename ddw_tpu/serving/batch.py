@@ -69,50 +69,118 @@ class BatchScorer:
         return shards if jax.process_index() == 0 else []
 
     def score_table(self, table: Table, out_store: TableStore | None = None,
-                    out_name: str = "predictions") -> list[tuple[str, str]]:
+                    out_name: str = "predictions",
+                    merge: bool = True) -> list[tuple[str, str]]:
         """Returns [(path, predicted_class)] for this process's shard subset; when
-        ``out_store`` is given also writes them as a table (path, label=prediction)."""
-        from concurrent.futures import ThreadPoolExecutor
+        ``out_store`` is given also writes them as a table (path, label=prediction).
+
+        Decode runs the same hot path the training loader uses: one native C++
+        thread-pool call per device batch (``decode_batch_native``), per-image
+        PIL fallback — not one ctypes call per image. Multi-host with ``merge``:
+        each process writes ``{out_name}_pN`` stamped with a run token derived
+        from (input table version, packaged-model content digest); process 0
+        waits for every part carrying that token and merges them into one
+        ``out_name`` table (the reference's single spark_udf result table,
+        ``03_pyfunc_distributed_inference.py:466-472``). The token keeps a
+        re-score with a newer model or table from silently merging a previous
+        run's parts for slower processes.
+        """
+        from ddw_tpu.native.decode import decode_batch_native, native_available
 
         h, w = self.model.height, self.model.width
         results: list[tuple[str, str]] = []
-
-        def decode(rec: Record):
-            return rec.path, preprocess_image(rec.content, h, w)
 
         def records():
             for sp in self._my_shards(table):
                 yield from read_shard(sp)
 
-        buf_paths: list[str] = []
-        buf_imgs: list[np.ndarray] = []
-
-        def flush():
-            n = len(buf_imgs)
-            imgs = np.stack(buf_imgs)
+        def score(imgs: np.ndarray, n: int, paths: list[str]):
             pad = self.batch - n
             if pad:
-                imgs = np.concatenate([imgs, np.zeros((pad, h, w, 3), np.float32)])
+                imgs = np.concatenate(
+                    [imgs[:n], np.zeros((pad, h, w, 3), np.float32)])
             dev = jax.device_put(imgs, self._sharding)  # local-mesh sharding
             logits = np.asarray(self._apply(dev))[:n]
             idx = np.argmax(logits, axis=-1)
-            results.extend((p, self.model.classes[i]) for p, i in zip(buf_paths, idx))
-            buf_paths.clear()
-            buf_imgs.clear()
+            results.extend((p, self.model.classes[i]) for p, i in zip(paths, idx))
 
-        with ThreadPoolExecutor(max_workers=self.workers) as pool:
-            for path, img in bounded_map(pool, decode, records(), self.workers * 4):
-                buf_paths.append(path)
-                buf_imgs.append(img)
-                if len(buf_imgs) == self.batch:
-                    flush()
-            if buf_imgs:
-                flush()
+        if native_available():
+            imgs = np.empty((self.batch, h, w, 3), np.float32)
+            paths: list[str] = []
+            contents: list[bytes] = []
+
+            def flush_native():
+                n = len(contents)
+                _, ok = decode_batch_native(contents, h, w,
+                                            threads=self.workers, out=imgs[:n])
+                for j in np.nonzero(~ok)[0]:
+                    imgs[j] = preprocess_image(contents[j], h, w)
+                score(imgs, n, paths)
+                paths.clear()
+                contents.clear()
+
+            for rec in records():
+                paths.append(rec.path)
+                contents.append(rec.content)
+                if len(contents) == self.batch:
+                    flush_native()
+            if contents:
+                flush_native()
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            def decode(rec: Record):
+                return rec.path, preprocess_image(rec.content, h, w)
+
+            buf_paths: list[str] = []
+            buf_imgs: list[np.ndarray] = []
+            with ThreadPoolExecutor(max_workers=self.workers) as pool:
+                for path, img in bounded_map(pool, decode, records(),
+                                             self.workers * 4):
+                    buf_paths.append(path)
+                    buf_imgs.append(img)
+                    if len(buf_imgs) == self.batch:
+                        score(np.stack(buf_imgs), len(buf_imgs), buf_paths)
+                        buf_paths, buf_imgs = [], []
+                if buf_imgs:
+                    score(np.stack(buf_imgs), len(buf_imgs), buf_paths)
 
         if out_store is not None:
-            name = out_name if jax.process_count() == 1 else f"{out_name}_p{jax.process_index()}"
+            n_proc = jax.process_count()
+            run_id = self._run_id(table)
+            name = out_name if n_proc == 1 else f"{out_name}_p{jax.process_index()}"
             out_store.write(name, (Record(path=p, content=b"", label=pred)
                                    for p, pred in results),
                             meta={"model_classes": self.model.classes,
-                                  "source_table": table.manifest["name"]})
+                                  "source_table": table.manifest["name"],
+                                  "run_id": run_id})
+            if merge and n_proc > 1 and jax.process_index() == 0:
+                merge_predictions(out_store, out_name, n_proc, run_id)
         return results
+
+    def _run_id(self, table: Table) -> str:
+        """Deterministic scoring-run token — identical on every process for the
+        same (input table version, packaged model), without communication."""
+        import hashlib
+
+        return hashlib.sha256(
+            f"{table.manifest['name']}|v{table.manifest['version']}|"
+            f"{self.model.content_digest}".encode()).hexdigest()[:16]
+
+
+def merge_predictions(out_store: TableStore, out_name: str, n_parts: int,
+                      run_id: str, timeout_s: float = 300.0) -> Table:
+    """Merge per-process ``{out_name}_pN`` tables into one ``out_name`` table.
+
+    The spark_udf contract yields ONE result table (reference
+    ``03_pyfunc_distributed_inference.py:466-472``); per-part tables are an
+    implementation detail of shared-nothing scoring. Waits for every part
+    stamped with this run's token (:meth:`TableStore.await_parts` — a bare
+    existence check would match a previous run's parts), then commits the
+    merged table by zero-copy manifest concat.
+    """
+    part_names = [f"{out_name}_p{i}" for i in range(n_parts)]
+    parts = out_store.await_parts(part_names, run_id, timeout_s)
+    return out_store.merge_shards(
+        out_name, parts,
+        meta={**parts[0].meta, "merged_from": part_names, "run_id": run_id})

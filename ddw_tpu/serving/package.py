@@ -104,7 +104,15 @@ class PackagedModel:
                 f"skew)", stacklevel=2)
         self.model = build_model(self.model_cfg)
         with open(os.path.join(model_dir, "params.msgpack"), "rb") as f:
-            restored = serialization.msgpack_restore(f.read())
+            blob = f.read()
+        # Content identity of this packaged model (weights + meta): lets
+        # shared-nothing scorers agree on a run token without communicating.
+        import hashlib
+
+        h = hashlib.sha256(blob)
+        h.update(json.dumps(self.meta, sort_keys=True).encode())
+        self.content_digest = h.hexdigest()[:16]
+        restored = serialization.msgpack_restore(blob)
         self.params = restored["params"]
         self.batch_stats = restored.get("batch_stats") or {}
         self._apply = jax.jit(self._apply_fn)

@@ -88,7 +88,8 @@ class Trainer:
         ``hvd.size()`` analog."""
         return int(self.mesh.shape[self.train_cfg.data_axis])
 
-    def _loaders(self, train_table: Table, val_table: Table):
+    def _loaders(self, train_table: Table, val_table: Table,
+                 consumed_batches: int = 0):
         n_proc = jax.process_count()
         per_host_batch = self.train_cfg.batch_size * self.world_size // n_proc
         sharding = batch_sharding(self.mesh, self.train_cfg.data_axis)
@@ -105,6 +106,9 @@ class Trainer:
             workers=self.data_cfg.loader_workers,
             prefetch=self.data_cfg.prefetch,
             prefetch_to=sharding,
+            # True resume: fast-forward the deterministic stream to exactly
+            # where the interrupted run stopped consuming.
+            skip_records=consumed_batches * per_host_batch,
         )
         val_loader_factory = lambda: ShardedLoader(  # noqa: E731 — fresh pass per epoch
             val_table,
@@ -139,15 +143,24 @@ class Trainer:
         start_epoch = 0
         steps_per_epoch = max(1, train_table.num_records // (cfg.batch_size * world))
         val_steps = max(1, val_table.num_records // (cfg.batch_size * world))
+        restored_meta = None
         if ckpt and resume:
             state, at_step = ckpt.restore(state)
             if at_step is not None:
                 start_epoch = int(at_step) // steps_per_epoch
+                restored_meta = ckpt.read_metadata(at_step)
 
         warmup = LRWarmup(cfg.learning_rate, world if cfg.scale_lr_by_world else 1,
                           cfg.warmup_epochs)
         plateau = ReduceLROnPlateau(cfg.plateau_patience, cfg.plateau_factor)
         early = EarlyStopping(cfg.early_stop_patience) if cfg.early_stop_patience else None
+        if restored_meta and "callbacks" in restored_meta:
+            # Resumed patience counters: an interrupted-then-resumed run tracks
+            # the uninterrupted one metric-for-metric (test_resume pins it).
+            cb = restored_meta["callbacks"]
+            plateau.load_state_dict(cb["plateau"])
+            if early is not None and "early" in cb:
+                early.load_state_dict(cb["early"])
 
         if self.run is not None:
             self.run.log_params({f"train.{k}": v for k, v in to_dict(cfg).items()})
@@ -166,7 +179,9 @@ class Trainer:
             monitor = SystemMonitor(self.run, cfg.monitor_interval_s)
 
         with monitor if monitor is not None else contextlib.nullcontext():
-            train_loader, val_loader_factory = self._loaders(train_table, val_table)
+            train_loader, val_loader_factory = self._loaders(
+                train_table, val_table,
+                consumed_batches=start_epoch * steps_per_epoch)
             train_iter = iter(train_loader)
             step_rng = jax.random.PRNGKey(cfg.seed + 1)
 
@@ -179,8 +194,8 @@ class Trainer:
                 # Past warmup (incl. warmup_epochs=0): start at the scaled target once;
                 # afterwards only the plateau callback may change the LR. On resume the
                 # restored opt_state already carries the LR training left off at
-                # (including plateau reductions) — don't clobber it. (The plateau
-                # patience counter itself is not checkpointed and restarts.)
+                # (including plateau reductions) — don't clobber it; the plateau/
+                # early-stop counters were restored from checkpoint metadata above.
                 state = set_lr(state, warmup.lr_for_epoch(cfg.warmup_epochs))
             for epoch in range(start_epoch, cfg.epochs):
                 if epoch < cfg.warmup_epochs:
@@ -230,18 +245,26 @@ class Trainer:
                     # across hosts; checksum computed locally, compared via tracker logs.
                     self.run and self.run.log_metric("params_checksum", params_checksum(state), epoch)
 
-                if ckpt and ((epoch + 1) % cfg.checkpoint_every_epochs == 0):
-                    ckpt.save(state, int(jax.device_get(state.step)),
-                              metadata={"epoch": epoch, "val_loss": val_loss,
-                                        "val_accuracy": val_acc})
-
                 # LR-plateau AFTER metrics are world-consistent (ordering contract,
                 # reference :310-313 — trivially satisfied: metrics are pmean-ed in-step)
                 if epoch + 1 >= cfg.warmup_epochs:
                     new_lr = plateau.update(val_loss, lr)
                     if new_lr != lr:
                         state = set_lr(state, new_lr)
-                if early is not None and early.should_stop(val_loss):
+                stop = early is not None and early.should_stop(val_loss)
+
+                # Checkpoint AFTER the callbacks consumed this epoch's metrics,
+                # so the saved counters (and any plateau LR cut) are exactly the
+                # state the next epoch starts from — resume = continuation.
+                if ckpt and ((epoch + 1) % cfg.checkpoint_every_epochs == 0):
+                    callbacks = {"plateau": plateau.state_dict()}
+                    if early is not None:
+                        callbacks["early"] = early.state_dict()
+                    ckpt.save(state, int(jax.device_get(state.step)),
+                              metadata={"epoch": epoch, "val_loss": val_loss,
+                                        "val_accuracy": val_acc,
+                                        "callbacks": callbacks})
+                if stop:
                     break
 
             return TrainResult(val_loss, val_acc, history, state, epochs_run)

@@ -5,26 +5,59 @@ with seeded sample (``:61-66``), label from path (``:125-130``), seeded 90/10 sp
 (``:162``), sorted-distinct label index (``:179-181``), silver tables (``:213-222``).
 
     PYTHONPATH=. python examples/01_data_prep.py --quick
+    PYTHONPATH=. python examples/01_data_prep.py --quick --etl-procs 2
+
+``--etl-procs N`` runs the multi-worker shared-nothing ETL (the reference's
+Spark-executors parallelism, ``01_data_prep.py:61-95``): N OS processes each
+read a disjoint round-robin slice and write part tables; worker 0 commits the
+final tables by zero-copy manifest merge.
 """
 
 import sys, os
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from examples.common import parse_args, setup
-from ddw_tpu.data.prep import prepare_flowers
+from ddw_tpu.data.prep import prepare_flowers, prepare_flowers_distributed
+
+
+def _etl_worker(w, n, source_dir, table_root, kwargs):
+    from ddw_tpu.data.store import TableStore
+
+    prepare_flowers_distributed(source_dir, TableStore(table_root), w, n, **kwargs)
 
 
 def main():
-    args = parse_args(__doc__)
+    args = parse_args(__doc__, extra=lambda ap: ap.add_argument(
+        "--etl-procs", type=int, default=1,
+        help="shared-nothing ETL worker processes (1 = single-process prep)"))
     ws = setup(args)
     data = ws["cfgs"]["data"]
-    train_tbl, val_tbl, label_to_idx = prepare_flowers(
-        data.source_dir, ws["store"],
+    kwargs = dict(
         sample_fraction=data.sample_fraction,
         train_fraction=data.train_fraction,
         split_seed=data.split_seed,
         shard_size=data.shard_size,
     )
+    if args.etl_procs > 1:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        procs = [ctx.Process(target=_etl_worker,
+                             args=(w, args.etl_procs, data.source_dir,
+                                   ws["store"].root, kwargs))
+                 for w in range(1, args.etl_procs)]
+        for p in procs:
+            p.start()
+        out = prepare_flowers_distributed(
+            data.source_dir, ws["store"], 0, args.etl_procs, **kwargs)
+        for p in procs:
+            p.join()
+            if p.exitcode:
+                raise RuntimeError(f"ETL worker exited with {p.exitcode}")
+        train_tbl, val_tbl, label_to_idx = out
+    else:
+        train_tbl, val_tbl, label_to_idx = prepare_flowers(
+            data.source_dir, ws["store"], **kwargs)
     print(f"bronze+silver written under {data.table_root}")
     print(f"label_to_idx: {label_to_idx}")
     print(f"silver_train: {train_tbl.num_records} records in {len(train_tbl.shard_paths)} shards")

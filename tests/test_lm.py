@@ -223,3 +223,68 @@ def test_generate_rejects_overflow_and_sampling_without_rng():
     with pytest.raises(ValueError, match="requires rng"):
         generate(model, params, np.zeros((1, 4), np.int32), num_steps=2,
                  temperature=0.8)
+
+
+def test_decode_work_scales_with_position():
+    """Tiled decode attention must skip unfilled cache tiles: the per-call tile
+    count (cache['tiles_computed'] delta, summed over layers) grows with the
+    filled position instead of always paying O(max_len)."""
+    model = TransformerLM(vocab_size=16, max_len=1024, hidden=16, depth=2,
+                          num_heads=2, mlp_dim=32, dtype=jnp.float32,
+                          decode=True)
+    # tile=256, max_len=1024 -> 4 tiles per layer available
+    rng = np.random.RandomState(0)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 1), jnp.int32))["params"]
+    from ddw_tpu.models.lm import init_cache
+
+    cache = init_cache(model, 1)
+
+    def step_at(cache):
+        tok = jnp.asarray(rng.randint(0, 16, size=(1, 1)), jnp.int32)
+        _, vars_ = model.apply({"params": params, "cache": cache}, tok,
+                               mutable=["cache"])
+        return vars_["cache"]
+
+    def total_tiles(cache):
+        import jax as _jax
+        flat = _jax.tree_util.tree_flatten_with_path(cache)[0]
+        return sum(int(v) for k, v in flat if "tiles_computed" in str(k))
+
+    c = cache
+    before = total_tiles(c)
+    c = step_at(c)                      # pos 0: 1 active tile per layer
+    early = total_tiles(c) - before
+    assert early == 2                   # depth=2 layers x 1 tile
+
+    # fast-forward the index to tile 3 (simulate 800 generated tokens)
+    c = jax.tree_util.tree_map_with_path(
+        lambda k, v: jnp.asarray(800, jnp.int32)
+        if "cache_index" in str(k) or "pos_index" in str(k) else v, c)
+    before = total_tiles(c)
+    c = step_at(c)                      # pos 800 -> tiles 0..3 active
+    late = total_tiles(c) - before
+    assert late == 8                    # depth=2 layers x 4 tiles
+    assert late > early
+
+
+def test_decode_overflow_poisons_output():
+    """Driving the decode model past max_len must fail loudly (NaN logits),
+    not silently clamp-overwrite the cache."""
+    model = TransformerLM(vocab_size=16, max_len=8, hidden=16, depth=1,
+                          num_heads=2, mlp_dim=32, dtype=jnp.float32,
+                          decode=True)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 1), jnp.int32))["params"]
+    from ddw_tpu.models.lm import init_cache
+
+    cache = init_cache(model, 1)
+    tok = jnp.zeros((1, 1), jnp.int32)
+    for i in range(8):
+        logits, vars_ = model.apply({"params": params, "cache": cache}, tok,
+                                    mutable=["cache"])
+        cache = vars_["cache"]
+        assert np.isfinite(np.asarray(logits)).all(), f"step {i} not finite"
+    logits, _ = model.apply({"params": params, "cache": cache}, tok,
+                            mutable=["cache"])
+    assert np.isnan(np.asarray(logits)).all()

@@ -1,0 +1,93 @@
+"""True resume (VERDICT r1 next-round #4): interrupted + resumed == uninterrupted.
+
+Train 5 epochs straight vs train 3 + resume 2 from the checkpoint, and compare
+the epoch histories metric-for-metric. Everything that feeds the numbers must
+round-trip: TrainState (params/opt/BN/step), the dynamic LR including plateau
+cuts, the plateau/early-stop patience counters (JSON metadata sidecar), and the
+loader position (deterministic stream fast-forward via skip_records).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ddw_tpu.data.loader import ShardedLoader
+from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+from ddw_tpu.train.trainer import Trainer
+from ddw_tpu.utils.config import TrainCfg
+
+
+def _fit(small_cfgs, silver, ckpt_dir, epochs, resume=False, **overrides):
+    data, model, train = small_cfgs
+    train_table, val_table, _ = silver
+    cfg = TrainCfg(**{**train.__dict__, "epochs": epochs,
+                      "checkpoint_dir": str(ckpt_dir), **overrides})
+    mesh = make_mesh(MeshSpec((("data", 8),)))
+    t = Trainer(data, model, cfg, mesh=mesh)
+    return t.fit(train_table, val_table, resume=resume)
+
+
+def test_loader_skip_records_is_exact_fast_forward(silver):
+    """skip_records=k*batch resumes the identical batch stream."""
+    train_table, _, _ = silver
+    kw = dict(batch_size=4, image_size=(32, 32), shuffle=True, seed=3,
+              shuffle_buffer=32, workers=2)
+    full = iter(ShardedLoader(train_table, **kw))
+    skipped_batches = 5
+    want = None
+    for _ in range(skipped_batches + 2):
+        want = next(full)
+
+    resumed = iter(ShardedLoader(train_table, skip_records=4 * skipped_batches,
+                                 **kw))
+    got = None
+    for _ in range(2):
+        got = next(resumed)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_resume_matches_uninterrupted(small_cfgs, silver, tmp_path):
+    straight = _fit(small_cfgs, silver, tmp_path / "a", epochs=5)
+
+    part1 = _fit(small_cfgs, silver, tmp_path / "b", epochs=3)
+    part2 = _fit(small_cfgs, silver, tmp_path / "b", epochs=5, resume=True)
+
+    assert straight.epochs_run == 5
+    assert part1.epochs_run == 3 and part2.epochs_run == 5
+    assert len(part2.history) == 2  # epochs 3 and 4 only
+
+    combined = part1.history + part2.history
+    assert [h["epoch"] for h in combined] == [0, 1, 2, 3, 4]
+    for got, want in zip(combined, straight.history):
+        for key in ("loss", "accuracy", "val_loss", "val_accuracy", "lr"):
+            np.testing.assert_allclose(
+                got[key], want[key], rtol=1e-6, atol=1e-7,
+                err_msg=f"epoch {want['epoch']} {key}: resumed run diverged")
+
+    # the final states agree too (params round-tripped exactly)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), rtol=1e-6, atol=1e-7),
+        part2.state.params, straight.state.params)
+
+
+def test_resume_restores_plateau_counter(small_cfgs, silver, tmp_path):
+    """The patience counter survives the restart: with patience=2 and a stuck
+    metric, interrupting after epoch 1 must not reset the countdown (straight
+    and resumed runs cut the LR at the same epoch)."""
+    kw = dict(plateau_patience=2, plateau_factor=0.5, warmup_epochs=0,
+              learning_rate=0.0)  # LR=0: metrics exactly frozen => the plateau
+                                  # counter ticks every epoch after the first
+    straight = _fit(small_cfgs, silver, tmp_path / "a", epochs=4, **kw)
+
+    _fit(small_cfgs, silver, tmp_path / "b", epochs=2, **kw)
+    part2 = _fit(small_cfgs, silver, tmp_path / "b", epochs=4, resume=True, **kw)
+
+    want_lrs = [h["lr"] for h in straight.history[2:]]
+    got_lrs = [h["lr"] for h in part2.history]
+    np.testing.assert_allclose(got_lrs, want_lrs, rtol=1e-6)
+    # sanity: the plateau actually fired (LR=0 cut clamps up to min_lr=1e-7,
+    # visible in the last epoch's row) — and at the SAME epoch in both runs.
+    assert straight.history[-1]["lr"] != straight.history[0]["lr"]

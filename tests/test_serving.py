@@ -127,3 +127,61 @@ def test_registry_stage_flow(trained_package, tmp_path):
     path = reg.model_path("flowers", stage="production")
     pm = PackagedModel(path)
     assert pm.classes == sorted(CLASSES)
+
+
+def test_merge_predictions_combines_parts(tmp_path):
+    """Rank-0 merge of per-process prediction parts into the single result
+    table the spark_udf contract implies (reference 03_pyfunc:466-472)."""
+    from ddw_tpu.data.store import Record, TableStore
+    from ddw_tpu.serving.batch import merge_predictions
+
+    store = TableStore(str(tmp_path / "preds"))
+    store.write("predictions_p0",
+                [Record(path="a.jpg", content=b"", label="daisy"),
+                 Record(path="b.jpg", content=b"", label="roses")],
+                meta={"model_classes": CLASSES, "run_id": "r1"})
+    store.write("predictions_p1",
+                [Record(path="c.jpg", content=b"", label="tulips")],
+                meta={"model_classes": CLASSES, "run_id": "r1"})
+
+    merged = merge_predictions(store, "predictions", 2, "r1", timeout_s=5)
+    rows = [(r.path, r.label) for r in merged.iter_records()]
+    assert rows == [("a.jpg", "daisy"), ("b.jpg", "roses"), ("c.jpg", "tulips")]
+    assert merged.meta["merged_from"] == ["predictions_p0", "predictions_p1"]
+    assert merged.meta["run_id"] == "r1"
+
+
+def test_merge_predictions_times_out_on_missing_part(tmp_path):
+    from ddw_tpu.data.store import Record, TableStore
+    from ddw_tpu.serving.batch import merge_predictions
+
+    store = TableStore(str(tmp_path / "preds"))
+    store.write("predictions_p0",
+                [Record(path="a.jpg", content=b"", label="daisy")],
+                meta={"run_id": "r1"})
+    with pytest.raises(TimeoutError, match="predictions_p1"):
+        merge_predictions(store, "predictions", 2, "r1", timeout_s=0.5)
+
+
+def test_merge_predictions_rejects_stale_parts(tmp_path):
+    """A part left over from a previous run (different run token) must not be
+    merged — the coordinator keeps waiting for the current run's version."""
+    from ddw_tpu.data.store import Record, TableStore
+    from ddw_tpu.serving.batch import merge_predictions
+
+    store = TableStore(str(tmp_path / "preds"))
+    store.write("predictions_p0",
+                [Record(path="a.jpg", content=b"", label="daisy")],
+                meta={"run_id": "r2"})
+    store.write("predictions_p1",
+                [Record(path="c.jpg", content=b"", label="tulips")],
+                meta={"run_id": "r1"})  # stale: previous run
+    with pytest.raises(TimeoutError, match="stale run_id"):
+        merge_predictions(store, "predictions", 2, "r2", timeout_s=0.5)
+    # once the current run's part lands (new version), the merge goes through
+    store.write("predictions_p1",
+                [Record(path="c.jpg", content=b"", label="roses")],
+                meta={"run_id": "r2"})
+    merged = merge_predictions(store, "predictions", 2, "r2", timeout_s=5)
+    assert [(r.path, r.label) for r in merged.iter_records()] == \
+        [("a.jpg", "daisy"), ("c.jpg", "roses")]

@@ -97,3 +97,65 @@ def test_synthetic_classes_distinct(tmp_path):
     assert arr.shape == (32, 32, 3)
     assert arr.dtype == np.float32
     assert arr.min() >= -1.0 and arr.max() <= 1.0
+
+
+def test_distributed_prep_matches_single_process(flowers_dir, tmp_path):
+    """2-worker shared-nothing prep (run sequentially here; the workers only
+    communicate through the store's filesystem) produces the same split
+    membership, labels, and label index as single-process prep."""
+    from ddw_tpu.data.prep import prepare_flowers, prepare_flowers_distributed
+
+    single = TableStore(str(tmp_path / "single"))
+    s_train, s_val, s_idx = prepare_flowers(flowers_dir, single,
+                                            sample_fraction=1.0, shard_size=16)
+
+    dist = TableStore(str(tmp_path / "dist"))
+    assert prepare_flowers_distributed(
+        flowers_dir, dist, worker_index=1, worker_count=2,
+        sample_fraction=1.0, shard_size=16) is None
+    out = prepare_flowers_distributed(
+        flowers_dir, dist, worker_index=0, worker_count=2,
+        sample_fraction=1.0, shard_size=16)
+    d_train, d_val, d_idx = out
+
+    assert d_idx == s_idx
+    assert d_train.num_records == s_train.num_records
+    assert d_val.num_records == s_val.num_records
+
+    def rows(t):
+        return {r.path: (r.label, r.label_idx, r.content)
+                for r in t.iter_records()}
+
+    assert rows(d_train) == rows(s_train)  # same membership + bytes
+    assert rows(d_val) == rows(s_val)
+    # merged bronze covers every sampled file exactly once
+    bronze = dist.table("flowers_bronze")
+    assert bronze.num_records == s_train.num_records + s_val.num_records
+
+
+def test_distributed_prep_times_out_on_missing_worker(flowers_dir, tmp_path):
+    from ddw_tpu.data.prep import prepare_flowers_distributed
+
+    store = TableStore(str(tmp_path / "t"))
+    with pytest.raises(TimeoutError, match="_p1"):
+        prepare_flowers_distributed(
+            flowers_dir, store, worker_index=0, worker_count=2,
+            sample_fraction=1.0, merge_timeout_s=0.5)
+
+
+def test_merge_shards_zero_copy(tmp_path):
+    """merge_shards concatenates manifests without re-encoding records."""
+    store = TableStore(str(tmp_path / "t"))
+    a = store.write("part_a", [Record(path=f"a{i}", content=bytes([i]) * 10)
+                               for i in range(5)], shard_size=2)
+    b = store.write("part_b", [Record(path=f"b{i}", content=bytes([i]) * 10)
+                               for i in range(3)], shard_size=2)
+    merged = store.merge_shards("all", [a, b], meta={"k": "v"})
+    assert merged.num_records == 8
+    assert [r.path for r in merged.iter_records()] == \
+        [f"a{i}" for i in range(5)] + [f"b{i}" for i in range(3)]
+    assert merged.meta["k"] == "v"
+    # shard checksums carried over verbatim (no re-encode)
+    assert [s["sha256"] for s in merged.manifest["shards"]] == \
+        [s["sha256"] for s in a.manifest["shards"]] + \
+        [s["sha256"] for s in b.manifest["shards"]]
