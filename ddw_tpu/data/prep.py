@@ -153,6 +153,7 @@ def prepare_flowers_distributed(
     val_name: str = "silver_val",
     io_workers: int = 8,
     merge_timeout_s: float = 600.0,
+    abort=None,
 ) -> tuple[Table, Table, dict[str, int]] | None:
     """Multi-worker 01_data_prep: the Spark-executors ETL role, shared-nothing.
 
@@ -167,7 +168,10 @@ def prepare_flowers_distributed(
     (per-worker striping), which the shuffling loader never observes.
 
     Returns (silver_train, silver_val, label_to_idx) on worker 0, None on
-    other workers. Workers must share ``store``'s filesystem.
+    other workers. Workers must share ``store``'s filesystem. ``abort`` (an
+    optional zero-arg callable returning a reason string, polled while
+    waiting) lets the coordinator fail fast when a worker process dies
+    instead of sleeping out ``merge_timeout_s``.
     """
     import hashlib
     from concurrent.futures import ThreadPoolExecutor
@@ -222,7 +226,7 @@ def prepare_flowers_distributed(
     # merged tables (zero-copy manifest concat).
     def merge(name, meta):
         parts = store.await_parts([f"{name}_p{w}" for w in range(worker_count)],
-                                  run_id, merge_timeout_s)
+                                  run_id, merge_timeout_s, abort=abort)
         return store.merge_shards(name, parts,
                                   meta={**meta, "worker_count": worker_count,
                                         "run_id": run_id})

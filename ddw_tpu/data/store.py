@@ -216,6 +216,24 @@ class TableWriter:
         self._total += len(self._buf)
         self._buf = []
 
+    def add_shard_file(self, src_path: str, shard_meta: dict) -> None:
+        """Adopt an existing shard file verbatim (hardlink, copy fallback) —
+        the zero-copy building block of :meth:`TableStore.merge_shards`.
+        ``shard_meta`` is the source manifest entry; its checksum carries over
+        because the bytes do. Must not interleave with buffered ``append``s
+        (flushes them first to keep shard numbering in write order)."""
+        import shutil
+
+        self._flush()
+        fn = f"shard-{len(self._shard_metas):05d}.ddws"
+        dst = os.path.join(self.shards_dir, fn)
+        try:
+            os.link(src_path, dst)
+        except OSError:
+            shutil.copy2(src_path, dst)
+        self._shard_metas.append({**shard_meta, "file": fn})
+        self._total += shard_meta["num_records"]
+
     def close(self) -> Table:
         if self._closed:
             return Table(self.vdir)
@@ -287,33 +305,49 @@ class TableStore:
         return os.path.exists(os.path.join(self._table_dir(name), "latest"))
 
     def await_parts(self, part_names: list[str], run_id: str,
-                    timeout_s: float = 300.0) -> list[Table]:
+                    timeout_s: float = 300.0, abort=None) -> list[Table]:
         """Wait (bounded) for every part table's LATEST version to carry
-        ``meta.run_id == run_id``, then return them.
+        ``meta.run_id == run_id``, then return those validated versions.
 
         ``exists()`` alone is not enough: a previous run's version also
         satisfies it, and a coordinator would silently merge stale parts while
         slower workers are still writing the current run's (the classic
         shared-filesystem rendezvous race). The run token — identical on every
         worker by construction, caller-derived from the run's inputs — is the
-        fence.
+        fence. The returned ``Table`` objects are the very versions that passed
+        validation (re-opening ``latest`` afterwards would reintroduce the
+        race against an even newer commit).
+
+        ``abort``: optional zero-arg callable polled each round; a non-None
+        return value (a reason string) raises RuntimeError immediately — the
+        hook coordinators use to fail fast when a worker process dies instead
+        of burning the whole timeout.
         """
         import time as _time
 
         deadline = _time.monotonic() + timeout_s
+        good: dict[str, Table] = {}
         while True:
             pending = []
             for n in part_names:
+                if n in good:
+                    continue
                 if not self.exists(n):
                     pending.append(n)
                     continue
-                if Table(os.path.join(self._table_dir(n),
-                                      open(os.path.join(self._table_dir(n),
-                                                        "latest")).read().strip())
-                         ).meta.get("run_id") != run_id:
+                t = self.table(n)
+                if t.meta.get("run_id") == run_id:
+                    good[n] = t
+                else:
                     pending.append(f"{n} (stale run_id)")
             if not pending:
-                return [self.table(n) for n in part_names]
+                return [good[n] for n in part_names]
+            if abort is not None:
+                reason = abort()
+                if reason:
+                    raise RuntimeError(
+                        f"await_parts aborted for run {run_id!r}: {reason} "
+                        f"(still pending: {pending})")
             if _time.monotonic() > deadline:
                 raise TimeoutError(
                     f"parts never appeared for run {run_id!r}: {pending}")
@@ -327,23 +361,10 @@ class TableStore:
         ETL analog of Spark executors writing partition files and the driver
         committing one table (reference ``01_data_prep.py:61-95``: the scan
         parallelizes across executors, the table commit is single)."""
-        import shutil
-
         w = TableWriter(self, name, meta=meta)
-        metas: list[dict] = []
-        total = 0
         for t in parts:
             for sm, sp in zip(t.manifest["shards"], t.shard_paths):
-                fn = f"shard-{len(metas):05d}.ddws"
-                dst = os.path.join(w.shards_dir, fn)
-                try:
-                    os.link(sp, dst)
-                except OSError:
-                    shutil.copy2(sp, dst)
-                metas.append({**sm, "file": fn})
-                total += sm["num_records"]
-        w._shard_metas = metas
-        w._total = total
+                w.add_shard_file(sp, sm)
         return w.close()
 
     def list_tables(self) -> list[str]:

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ddw_tpu.ops.flash_attention import flash_attention
+from ddw_tpu.ops.flash_attention import flash_mha
 from ddw_tpu.parallel.ring_attention import ring_attention
 
 
@@ -122,7 +122,10 @@ class CausalSelfAttention(nn.Module):
             if self.seq_axis is not None:
                 out = ring_attention(qh, kh, vh, self.seq_axis, causal=True)
             else:
-                out = flash_attention(qh, kh, vh, causal=True)
+                # flash_mha auto-dispatches: fused XLA attention while the S²
+                # score matrix fits (faster on TPU at moderate S — measured),
+                # Pallas flash kernel for genuinely long context.
+                out = flash_mha(qh, kh, vh, causal=True)
             out = out.transpose(0, 2, 1, 3)  # [B, S, H, hd]
         return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(out)
 

@@ -48,12 +48,20 @@ def main():
                  for w in range(1, args.etl_procs)]
         for p in procs:
             p.start()
+
+        def dead_worker():
+            # Polled while the coordinator waits for parts: fail fast with the
+            # child's real exit status instead of sleeping out the timeout.
+            for i, p in enumerate(procs):
+                if p.exitcode not in (None, 0):
+                    return f"ETL worker {i + 1} exited with {p.exitcode}"
+            return None
+
         out = prepare_flowers_distributed(
-            data.source_dir, ws["store"], 0, args.etl_procs, **kwargs)
+            data.source_dir, ws["store"], 0, args.etl_procs,
+            abort=dead_worker, **kwargs)
         for p in procs:
             p.join()
-            if p.exitcode:
-                raise RuntimeError(f"ETL worker exited with {p.exitcode}")
         train_tbl, val_tbl, label_to_idx = out
     else:
         train_tbl, val_tbl, label_to_idx = prepare_flowers(

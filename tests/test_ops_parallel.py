@@ -309,20 +309,47 @@ def test_flash_lse_split_combine_gradients():
 
 
 def test_flash_mha_padded_seq():
-    """flash_mha pads non-block-multiple lengths (ViT's 196) and matches the
-    reference on the unpadded region, fwd and grad."""
+    """flash_mha(impl='pallas') pads non-block-multiple lengths (ViT's 196) and
+    matches the reference on the unpadded region, fwd and grad."""
     from ddw_tpu.ops.flash_attention import flash_mha
 
     q, k, v = _qkv(b=1, h=2, s=196, d=48, seed=6)
-    out = flash_mha(q, k, v)
+    out = flash_mha(q, k, v, impl="pallas")
     ref = mha_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
-    gq = jax.grad(lambda q: jnp.sum(flash_mha(q, k, v) ** 2))(q)
+    gq = jax.grad(lambda q: jnp.sum(flash_mha(q, k, v, impl="pallas") ** 2))(q)
     gr = jax.grad(lambda q: jnp.sum(mha_reference(q, k, v) ** 2))(q)
     np.testing.assert_allclose(np.asarray(gq), np.asarray(gr),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_attention_impl_dispatch_equivalence():
+    """Every dispatch arm (xla, xla_ckpt, pallas) computes the same attention
+    — out, lse, and grads — so the auto rule can never change results."""
+    from ddw_tpu.ops.flash_attention import _attn_impl, flash_mha_lse
+
+    q, k, v = _qkv(b=2, h=2, s=160, d=32, seed=8)
+    outs = {}
+    for impl in ("xla", "xla_ckpt", "pallas"):
+        o, lse = flash_mha_lse(q, k, v, causal=True, impl=impl)
+        g = jax.grad(lambda q: jnp.sum(
+            flash_mha_lse(q, k, v, causal=True, impl=impl)[0] ** 2))(q)
+        outs[impl] = (np.asarray(o), np.asarray(lse), np.asarray(g))
+    for impl in ("xla_ckpt", "pallas"):
+        for a, b, what in zip(outs["xla"], outs[impl], ("out", "lse", "gq")):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4,
+                                       err_msg=f"{impl} {what}")
+
+    # auto picks by score-matrix footprint
+    small = jnp.zeros((1, 1, 128, 16))      # 64 KiB of scores -> plain xla
+    big = jnp.zeros((8, 8, 2048, 16))       # 1 GiB -> checkpointed xla
+    huge = jnp.zeros((8, 8, 65536, 16))     # 1 TiB -> pallas flash
+    assert _attn_impl(small, small, "auto") == "xla"
+    assert _attn_impl(big, big, "auto") == "xla_ckpt"
+    assert _attn_impl(huge, huge, "auto") == "pallas"
+    assert _attn_impl(huge, huge, "xla") == "xla"
 
 
 def test_vit_flash_mha_matches_flax_attention():
