@@ -288,6 +288,20 @@ def bench_host_pipeline(n_images: int, hw: int, device_ips: float | None) -> dic
         _preprocess_image_pil(c, hw, hw)
     out["pil_images_per_sec"] = round(n_images / (time.perf_counter() - t0), 1)
 
+    # Materialized raw_u8 path (prep.materialize_decoded): memcpy + scale.
+    raws = [np.clip((_preprocess_image_pil(c, hw, hw) + 1) * 127.5,
+                    0, 255).astype(np.uint8).tobytes() for c in contents[:64]]
+    batch = np.empty((len(raws), hw, hw, 3), np.float32)
+    reps = max(1, n_images // len(raws))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        for j, r in enumerate(raws):
+            batch[j] = np.frombuffer(r, np.uint8).reshape(hw, hw, 3)
+        batch /= 127.5
+        batch -= 1.0
+    out["raw_u8_images_per_sec"] = round(
+        reps * len(raws) / (time.perf_counter() - t0), 1)
+
     if device_ips and out.get("native_images_per_sec"):
         # >1: one host's decode pool alone outruns the chip; <1: the chip
         # starves unless decode scales out (more threads/hosts) or data is

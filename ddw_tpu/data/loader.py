@@ -154,6 +154,24 @@ class ShardedLoader:
         self.prefetch_to = prefetch_to
         self.skip_records = skip_records
 
+        # Pre-decoded table (prep.materialize_decoded): content is raw uint8
+        # [H, W, 3] pixels; batches come from a memcpy + scale, no JPEG work.
+        self._raw_u8 = table.meta.get("encoding") == "raw_u8"
+        if self._raw_u8:
+            th, tw = table.meta["height"], table.meta["width"]
+            if (th, tw) != (self.height, self.width):
+                raise ValueError(
+                    f"loader image_size {(self.height, self.width)} != "
+                    f"materialized table size {(th, tw)} — re-materialize or "
+                    f"match DataCfg.img_height/img_width")
+            # The record-count shuffle buffer was sized for ~KB JPEG records;
+            # raw_u8 records are H*W*3 bytes (150 KB at 224²), so bound the
+            # buffer by bytes (64 MB) instead of pinning shuffle_buffer
+            # records of decoded pixels in host RAM.
+            record_bytes = th * tw * 3
+            self.shuffle_buffer = max(
+                2, min(self.shuffle_buffer, (64 << 20) // record_bytes))
+
         shards = list(table.shard_paths)
         if len(shards) >= shard_count:
             # Shard-level selection (petastorm semantics): disjoint round-robin.
@@ -238,6 +256,22 @@ class ShardedLoader:
 
         imgs = np.empty((self.batch_size, self.height, self.width, 3), np.float32)
         lbls = np.empty((self.batch_size,), np.int32)
+
+        if self._raw_u8:
+            # Materialized fast path: reinterpret + scale back to [-1, 1]
+            # (inverse of materialize_decoded's quantization).
+            shape = (self.height, self.width, 3)
+            i = 0
+            for content, label_idx in self._iter_raw_resumed():
+                imgs[i] = np.frombuffer(content, np.uint8).reshape(shape)
+                lbls[i] = label_idx
+                i += 1
+                if i == self.batch_size:
+                    imgs /= 127.5
+                    imgs -= 1.0
+                    yield imgs.copy(), lbls.copy()
+                    i = 0
+            return  # drop remainder: static shapes for XLA
 
         if native_available():
             # Native batch path: one C++ thread-pool call per batch (one GIL

@@ -90,6 +90,14 @@ class BatchScorer:
         h, w = self.model.height, self.model.width
         results: list[tuple[str, str]] = []
 
+        raw_u8 = table.meta.get("encoding") == "raw_u8"
+        if raw_u8 and (table.meta.get("height"), table.meta.get("width")) != (h, w):
+            raise ValueError(
+                f"materialized table is {table.meta.get('height')}x"
+                f"{table.meta.get('width')} but the packaged model expects "
+                f"{h}x{w} — re-materialize at the model's size or score the "
+                f"JPEG silver table")
+
         def records():
             for sp in self._my_shards(table):
                 yield from read_shard(sp)
@@ -104,7 +112,26 @@ class BatchScorer:
             idx = np.argmax(logits, axis=-1)
             results.extend((p, self.model.classes[i]) for p, i in zip(paths, idx))
 
-        if native_available():
+        if raw_u8:
+            # Pre-decoded pixels (prep.materialize_decoded): no JPEG work,
+            # just reinterpret + scale — the loader's fast path, serving-side.
+            imgs = np.empty((self.batch, h, w, 3), np.float32)
+            paths: list[str] = []
+            i = 0
+            for rec in records():
+                imgs[i] = np.frombuffer(rec.content, np.uint8).reshape(h, w, 3)
+                paths.append(rec.path)
+                i += 1
+                if i == self.batch:
+                    imgs /= 127.5
+                    imgs -= 1.0
+                    score(imgs, i, paths)
+                    paths, i = [], 0
+            if i:
+                imgs[:i] /= 127.5
+                imgs[:i] -= 1.0
+                score(imgs, i, paths)
+        elif native_available():
             # Double-buffered pipeline: one background thread decodes batch
             # N+1 (C++ pool, GIL released) while the device scores batch N —
             # per-batch wall time ~max(decode, score) instead of their sum,

@@ -27,9 +27,16 @@ def _etl_worker(w, n, source_dir, table_root, kwargs):
 
 
 def main():
-    args = parse_args(__doc__, extra=lambda ap: ap.add_argument(
-        "--etl-procs", type=int, default=1,
-        help="shared-nothing ETL worker processes (1 = single-process prep)"))
+    def extra(ap):
+        ap.add_argument(
+            "--etl-procs", type=int, default=1,
+            help="shared-nothing ETL worker processes (1 = single-process prep)")
+        ap.add_argument(
+            "--materialize", action="store_true",
+            help="also write pre-decoded raw_u8 tables (decode once at prep; "
+                 "the loader then skips JPEG work — Petastorm cache role)")
+
+    args = parse_args(__doc__, extra=extra)
     ws = setup(args)
     data = ws["cfgs"]["data"]
     kwargs = dict(
@@ -70,6 +77,17 @@ def main():
     print(f"label_to_idx: {label_to_idx}")
     print(f"silver_train: {train_tbl.num_records} records in {len(train_tbl.shard_paths)} shards")
     print(f"silver_val:   {val_tbl.num_records} records in {len(val_tbl.shard_paths)} shards")
+
+    if args.materialize:
+        from ddw_tpu.data.prep import materialize_decoded
+
+        for tbl, name in ((train_tbl, "silver_train_decoded"),
+                          (val_tbl, "silver_val_decoded")):
+            g = materialize_decoded(tbl, ws["store"], name,
+                                    data.img_height, data.img_width,
+                                    shard_size=data.shard_size)
+            print(f"{name}: {g.num_records} records pre-decoded at "
+                  f"{data.img_height}x{data.img_width}")
 
 
 if __name__ == "__main__":

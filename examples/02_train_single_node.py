@@ -21,7 +21,7 @@ def main():
     args = parse_args(__doc__)
     ws = setup(args)
     cfgs = ws["cfgs"]
-    train_tbl, val_tbl = require_tables(ws["store"])
+    train_tbl, val_tbl = require_tables(ws["store"], ws["cfgs"]["data"])
 
     mesh = make_mesh(MeshSpec(((DATA_AXIS, 1),)), devices=jax.devices()[:1])
     run = ws["tracker"].start_run("single_node")

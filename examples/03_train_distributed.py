@@ -25,7 +25,7 @@ def main():
         "--smoke", action="store_true", help="np=-1 analog: same path, one device"))
     ws = setup(args)
     cfgs = ws["cfgs"]
-    train_tbl, val_tbl = require_tables(ws["store"])
+    train_tbl, val_tbl = require_tables(ws["store"], ws["cfgs"]["data"])
 
     devices = jax.devices()[:1] if args.smoke else jax.devices()
     mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)), devices=devices)

@@ -32,7 +32,7 @@ def main():
     ws = setup(args)
     cfgs = ws["cfgs"]
     tune_cfg = cfgs["tune"]
-    train_tbl, val_tbl = require_tables(ws["store"])
+    train_tbl, val_tbl = require_tables(ws["store"], ws["cfgs"]["data"])
 
     # hyperopt space of the reference (:194-198)
     space = {

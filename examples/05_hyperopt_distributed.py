@@ -28,7 +28,7 @@ def main():
     ws = setup(args)
     cfgs = ws["cfgs"]
     tune_cfg = cfgs["tune"]
-    train_tbl, val_tbl = require_tables(ws["store"])
+    train_tbl, val_tbl = require_tables(ws["store"], ws["cfgs"]["data"])
 
     space = {
         "learning_rate": loguniform("learning_rate", -5, 0),

@@ -22,7 +22,7 @@ def main():
     args = parse_args(__doc__)
     ws = setup(args)
     cfgs = ws["cfgs"]
-    train_tbl, val_tbl = require_tables(ws["store"])
+    train_tbl, val_tbl = require_tables(ws["store"], ws["cfgs"]["data"])
 
     # train (full pipeline fn role, :253-377) with early stopping (:397-401)
     cfgs["train"].early_stop_patience = 3

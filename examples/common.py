@@ -70,7 +70,18 @@ def setup(args) -> dict:
     }
 
 
-def require_tables(store: TableStore):
+def require_tables(store: TableStore, data_cfg=None):
+    """Resolve the training tables. Prefers the pre-decoded ``*_decoded``
+    tables (``01_data_prep.py --materialize``) when they exist AND match the
+    configured image size — the decode-skip fast path — falling back to the
+    JPEG silver tables otherwise."""
     if not (store.exists("silver_train") and store.exists("silver_val")):
         raise SystemExit("silver tables missing — run examples/01_data_prep.py first")
+    if (data_cfg is not None and store.exists("silver_train_decoded")
+            and store.exists("silver_val_decoded")):
+        t = store.table("silver_train_decoded")
+        if (t.meta.get("height"), t.meta.get("width")) == (
+                data_cfg.img_height, data_cfg.img_width):
+            print("[tables] using pre-decoded raw_u8 tables (materialized cache)")
+            return t, store.table("silver_val_decoded")
     return store.table("silver_train"), store.table("silver_val")

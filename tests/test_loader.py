@@ -2,6 +2,7 @@
 prefetch-to-device (the Petastorm make_tf_dataset semantics, SURVEY §2b.8)."""
 
 import numpy as np
+import pytest
 
 from ddw_tpu.data.loader import ShardedLoader
 
@@ -87,3 +88,60 @@ def test_steps_per_epoch_accounting(silver):
     train, _, _ = silver
     ld = ShardedLoader(train, batch_size=8, image_size=(8, 8), shard_count=2, cur_shard=0)
     assert ld.steps_per_epoch() == train.num_records // (8 * 2)
+
+
+def test_materialized_table_matches_silver(silver, store):
+    """Loader batches from a pre-decoded raw_u8 table equal the silver-table
+    batches up to the uint8 quantization step (half-ULP of 2/255)."""
+    from ddw_tpu.data.prep import materialize_decoded
+
+    train_tbl, _, _ = silver
+    gold = materialize_decoded(train_tbl, store, "gold_train", 32, 32,
+                               shard_size=16)
+    assert gold.meta["encoding"] == "raw_u8"
+    assert gold.num_records == train_tbl.num_records
+
+    kw = dict(batch_size=8, image_size=(32, 32), shuffle=False, workers=2)
+    silver_batches = list(ShardedLoader(train_tbl, num_epochs=1, **kw))
+    gold_batches = list(ShardedLoader(gold, num_epochs=1, **kw))
+    assert len(gold_batches) == len(silver_batches) > 0
+    for (gi, gl), (si, sl) in zip(gold_batches, silver_batches):
+        np.testing.assert_array_equal(gl, sl)
+        np.testing.assert_allclose(gi, si, atol=1.01 / 255)
+
+
+def test_materialized_table_size_mismatch_raises(silver, store):
+    from ddw_tpu.data.prep import materialize_decoded
+
+    train_tbl, _, _ = silver
+    gold = materialize_decoded(train_tbl, store, "gold_mismatch", 32, 32,
+                               shard_size=16)
+    with pytest.raises(ValueError, match="materialized table size"):
+        ShardedLoader(gold, batch_size=8, image_size=(64, 64))
+
+
+def test_materialized_training_is_drop_in(silver, store):
+    """Trainer.fit on the materialized table tracks silver-table training
+    epoch-for-epoch (the cache is a drop-in: same stream order, pixels within
+    uint8 quantization)."""
+    from ddw_tpu.data.prep import materialize_decoded
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+    from ddw_tpu.train.trainer import Trainer
+    from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+    train_tbl, val_tbl, _ = silver
+    gtrain = materialize_decoded(train_tbl, store, "gold_t2", 32, 32, 16)
+    gval = materialize_decoded(val_tbl, store, "gold_v2", 32, 32, 16)
+    data = DataCfg(img_height=32, img_width=32, shard_size=16)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.1,
+                     dtype="float32")
+    train = TrainCfg(batch_size=8, epochs=4, learning_rate=1e-3,
+                     warmup_epochs=0)
+    mesh = make_mesh(MeshSpec((("data", 8),)))
+    silver_res = Trainer(data, model, train, mesh=mesh).fit(train_tbl, val_tbl)
+    gold_res = Trainer(data, model, train, mesh=mesh).fit(gtrain, gval)
+    assert gold_res.epochs_run == silver_res.epochs_run
+    for g, s in zip(gold_res.history, silver_res.history):
+        np.testing.assert_allclose(g["loss"], s["loss"], atol=0.05)
+        np.testing.assert_allclose(g["val_loss"], s["val_loss"], atol=0.05)
+    assert abs(gold_res.val_accuracy - silver_res.val_accuracy) <= 0.1

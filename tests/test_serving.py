@@ -185,3 +185,38 @@ def test_merge_predictions_rejects_stale_parts(tmp_path):
     merged = merge_predictions(store, "predictions", 2, "r2", timeout_s=5)
     assert [(r.path, r.label) for r in merged.iter_records()] == \
         [("a.jpg", "daisy"), ("c.jpg", "roses")]
+
+
+def test_batch_scorer_on_materialized_table(trained_package, silver, tmp_path):
+    """Scoring a pre-decoded raw_u8 table skips JPEG work and agrees with
+    scoring the JPEG silver table (pixels differ only by uint8 quantization)."""
+    from ddw_tpu.data.prep import materialize_decoded
+    from ddw_tpu.data.store import TableStore
+
+    out, _ = trained_package
+    _, val_tbl, _ = silver
+    store = TableStore(str(tmp_path / "gold"))
+    gold = materialize_decoded(val_tbl, store, "gold_val", 32, 32, 16)
+
+    mesh = make_mesh(MeshSpec((("data", 8),)))
+    scorer = BatchScorer(out, mesh=mesh, batch_per_device=4)
+    silver_rows = dict(scorer.score_table(val_tbl))
+    gold_rows = dict(scorer.score_table(gold))
+    assert set(gold_rows) == set(silver_rows)
+    agree = np.mean([gold_rows[p] == silver_rows[p] for p in silver_rows])
+    assert agree >= 0.9, f"only {agree:.0%} prediction agreement"
+
+
+def test_batch_scorer_materialized_size_mismatch_raises(trained_package, silver,
+                                                        tmp_path):
+    from ddw_tpu.data.prep import materialize_decoded
+    from ddw_tpu.data.store import TableStore
+
+    out, _ = trained_package
+    _, val_tbl, _ = silver
+    store = TableStore(str(tmp_path / "gold"))
+    gold = materialize_decoded(val_tbl, store, "gold_val64", 64, 64, 16)
+    scorer = BatchScorer(out, mesh=make_mesh(MeshSpec((("data", 8),))),
+                         batch_per_device=4)
+    with pytest.raises(ValueError, match="re-materialize"):
+        scorer.score_table(gold)
