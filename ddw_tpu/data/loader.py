@@ -59,11 +59,31 @@ def _preprocess_image_pil(content: bytes, height: int, width: int) -> np.ndarray
     from PIL import Image
 
     img = Image.open(BytesIO(content))
+    # JPEG DCT-scaled decode (decode directly at 1/2, 1/4, 1/8 scale when the
+    # source is larger than the target) — the same trick the native pipeline's
+    # libjpeg scale_denom uses; no-op for non-JPEG or already-small images.
+    img.draft("RGB", (width, height))
     if img.mode != "RGB":
         img = img.convert("RGB")
     img = img.resize((width, height), Image.BILINEAR)
     arr = np.asarray(img, dtype=np.float32)
     return arr / 127.5 - 1.0
+
+
+def raw_u8_view(content: bytes, height: int, width: int) -> np.ndarray:
+    """Reinterpret a ``raw_u8`` record (prep.materialize_decoded) as a
+    [H, W, 3] uint8 array — zero-copy view over the record bytes."""
+    return np.frombuffer(content, np.uint8).reshape(height, width, 3)
+
+
+def dequantize_raw_u8(batch: np.ndarray) -> None:
+    """In-place inverse of materialize_decoded's quantization: a float batch
+    holding uint8 pixel values becomes [-1, 1]. THE single definition of the
+    raw_u8 scheme — loader, batch scorer, and bench all call this, so a
+    change to the quantization can never reintroduce train/serve skew (the
+    bug class ``preprocess_image`` exists to prevent on the JPEG path)."""
+    batch /= 127.5
+    batch -= 1.0
 
 
 def active_decoder() -> str:
@@ -258,17 +278,14 @@ class ShardedLoader:
         lbls = np.empty((self.batch_size,), np.int32)
 
         if self._raw_u8:
-            # Materialized fast path: reinterpret + scale back to [-1, 1]
-            # (inverse of materialize_decoded's quantization).
-            shape = (self.height, self.width, 3)
+            # Materialized fast path: reinterpret + dequantize, no JPEG work.
             i = 0
             for content, label_idx in self._iter_raw_resumed():
-                imgs[i] = np.frombuffer(content, np.uint8).reshape(shape)
+                imgs[i] = raw_u8_view(content, self.height, self.width)
                 lbls[i] = label_idx
                 i += 1
                 if i == self.batch_size:
-                    imgs /= 127.5
-                    imgs -= 1.0
+                    dequantize_raw_u8(imgs)
                     yield imgs.copy(), lbls.copy()
                     i = 0
             return  # drop remainder: static shapes for XLA

@@ -136,6 +136,16 @@ bool decode_resize(const unsigned char* data, long len, int out_h, int out_w,
   if (!decode_rgb(data, len, out_h, out_w, pixels, &h, &w) || h <= 0 || w <= 0) {
     return false;
   }
+  if (h == out_h && w == out_w) {
+    // DCT scaling landed exactly on the target (e.g. 448 -> 224 via
+    // scale_denom=2, or same-size sources): skip interpolation entirely,
+    // just normalize. A tight auto-vectorizable loop.
+    const size_t n = static_cast<size_t>(h) * w * 3;
+    const unsigned char* p = pixels.data();
+    constexpr float kScale = 1.0f / 127.5f;
+    for (size_t i = 0; i < n; ++i) out[i] = p[i] * kScale - 1.0f;
+    return true;
+  }
   resize_normalize(pixels, h, w, out_h, out_w, out);
   return true;
 }

@@ -114,22 +114,23 @@ class BatchScorer:
 
         if raw_u8:
             # Pre-decoded pixels (prep.materialize_decoded): no JPEG work,
-            # just reinterpret + scale — the loader's fast path, serving-side.
+            # just reinterpret + dequantize — the loader's fast path,
+            # serving-side, through the same shared scheme definition.
+            from ddw_tpu.data.loader import dequantize_raw_u8, raw_u8_view
+
             imgs = np.empty((self.batch, h, w, 3), np.float32)
             paths: list[str] = []
             i = 0
             for rec in records():
-                imgs[i] = np.frombuffer(rec.content, np.uint8).reshape(h, w, 3)
+                imgs[i] = raw_u8_view(rec.content, h, w)
                 paths.append(rec.path)
                 i += 1
                 if i == self.batch:
-                    imgs /= 127.5
-                    imgs -= 1.0
+                    dequantize_raw_u8(imgs)
                     score(imgs, i, paths)
                     paths, i = [], 0
             if i:
-                imgs[:i] /= 127.5
-                imgs[:i] -= 1.0
+                dequantize_raw_u8(imgs[:i])
                 score(imgs, i, paths)
         elif native_available():
             # Double-buffered pipeline: one background thread decodes batch

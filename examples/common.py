@@ -77,11 +77,23 @@ def require_tables(store: TableStore, data_cfg=None):
     JPEG silver tables otherwise."""
     if not (store.exists("silver_train") and store.exists("silver_val")):
         raise SystemExit("silver tables missing — run examples/01_data_prep.py first")
+    train = store.table("silver_train")
+    val = store.table("silver_val")
     if (data_cfg is not None and store.exists("silver_train_decoded")
             and store.exists("silver_val_decoded")):
         t = store.table("silver_train_decoded")
-        if (t.meta.get("height"), t.meta.get("width")) == (
-                data_cfg.img_height, data_cfg.img_width):
+        v = store.table("silver_val_decoded")
+        size_ok = (t.meta.get("height"), t.meta.get("width")) == (
+            data_cfg.img_height, data_cfg.img_width)
+        # Freshness fence: the cache records which silver version it was
+        # decoded from; after a re-prep (new silver version) a stale cache
+        # must not silently win.
+        fresh = (t.meta.get("source_version") == train.manifest["version"]
+                 and v.meta.get("source_version") == val.manifest["version"])
+        if size_ok and fresh:
             print("[tables] using pre-decoded raw_u8 tables (materialized cache)")
-            return t, store.table("silver_val_decoded")
-    return store.table("silver_train"), store.table("silver_val")
+            return t, v
+        if size_ok and not fresh:
+            print("[tables] ignoring stale materialized cache (silver tables "
+                  "are newer — re-run 01_data_prep.py --materialize)")
+    return train, val
