@@ -48,3 +48,56 @@ def test_two_process_trainer_fit(silver, store, worker_pythonpath):
     assert out["world"] == 4  # 2 procs x 2 devices on the data axis
     assert out["epochs"] == 1
     assert np.isfinite(out["val_loss"]) and np.isfinite(out["val_accuracy"])
+
+
+def _score_worker(table_root: str, pkg_dir: str, out_root: str) -> dict:
+    import jax
+
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.serving.batch import BatchScorer
+
+    store = TableStore(table_root)
+    out_store = TableStore(out_root)
+    scorer = BatchScorer(pkg_dir, batch_per_device=4, workers=2)
+    rows = scorer.score_table(store.table("silver_val"), out_store=out_store,
+                              out_name="predictions")
+    result = {"processes": jax.process_count(), "local_rows": len(rows)}
+    if jax.process_index() == 0:
+        merged = out_store.table("predictions")
+        result["merged_rows"] = merged.num_records
+        result["merged_from"] = merged.meta.get("merged_from")
+        result["paths"] = sorted(r.path for r in merged.iter_records())
+    return result
+
+
+def test_two_process_batch_scorer_merges(silver, store, worker_pythonpath,
+                                         tmp_path):
+    """Real 2-process scoring: per-process part tables, run-token rendezvous,
+    rank-0 merge into ONE predictions table covering every record exactly once
+    (the spark_udf single-result contract)."""
+    import functools
+
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+    from ddw_tpu.serving import save_packaged_model
+    from ddw_tpu.train.trainer import Trainer
+    from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+    train_tbl, val_tbl, label_to_idx = silver
+    data = DataCfg(img_height=24, img_width=24)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                     dtype="float32")
+    train = TrainCfg(batch_size=4, epochs=1, warmup_epochs=0)
+    res = Trainer(data, model, train,
+                  mesh=make_mesh(MeshSpec((("data", 8),)))).fit(train_tbl, val_tbl)
+    pkg = str(tmp_path / "pkg")
+    classes = [c for c, _ in sorted(label_to_idx.items(), key=lambda kv: kv[1])]
+    save_packaged_model(pkg, model, classes, res.state.params,
+                        res.state.batch_stats, img_height=24, img_width=24)
+
+    out = Launcher(np=2, devices_per_proc=2, timeout_s=540).run(
+        functools.partial(_score_worker, store.root, pkg,
+                          str(tmp_path / "preds")))
+    assert out["processes"] == 2
+    assert out["merged_rows"] == val_tbl.num_records
+    assert out["merged_from"] == ["predictions_p0", "predictions_p1"]
+    assert out["paths"] == sorted(r.path for r in val_tbl.iter_records())
