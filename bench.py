@@ -137,9 +137,9 @@ def _row(items_per_step: int, n_chips: int, dt: float, measure_steps: int,
     }
     if flops:
         tf = flops / dt * measure_steps / n_chips / 1e12
-        out["achieved_tflops_per_chip"] = round(tf, 2)
+        out["achieved_tflops_per_chip"] = round(tf, 4)
         if peak:
-            out["mfu"] = round(tf / peak, 4)
+            out["mfu"] = round(tf / peak, 6)
     return out
 
 

@@ -138,6 +138,9 @@ class DecoderBlock(nn.Module):
     seq_axis: str | None = None
     decode: bool = False
     max_len: int = 2048
+    num_experts: int = 0          # >0: MoE MLP (Switch top-1) instead of dense
+    expert_axis: str | None = None
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -147,10 +150,17 @@ class DecoderBlock(nn.Module):
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
-        d = x.shape[-1]
-        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+        if self.num_experts:
+            from ddw_tpu.models.moe import MoEMlp
+
+            h = MoEMlp(self.num_experts, self.mlp_dim,
+                       capacity_factor=self.capacity_factor, dtype=self.dtype,
+                       expert_axis=self.expert_axis, name="moe")(h)
+        else:
+            d = x.shape[-1]
+            h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(d, dtype=self.dtype, name="fc2")(h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return x + h
 
@@ -174,6 +184,9 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     seq_axis: str | None = None
     decode: bool = False     # KV-cached autoregressive mode (see generate())
+    num_experts: int = 0     # >0: MoE MLP blocks (expert parallelism via
+    expert_axis: str | None = None  # expert_axis inside shard_map)
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -209,6 +222,9 @@ class TransformerLM(nn.Module):
             x = DecoderBlock(self.num_heads, self.mlp_dim, self.dropout,
                              self.dtype, None if self.decode else self.seq_axis,
                              self.decode, self.max_len,
+                             num_experts=self.num_experts,
+                             expert_axis=None if self.decode else self.expert_axis,
+                             capacity_factor=self.capacity_factor,
                              name=f"backbone_block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # vocab head in f32: logits feed a softmax CE, keep full precision
@@ -219,12 +235,15 @@ class TransformerLM(nn.Module):
         return ()
 
 
-def build_lm(cfg, seq_axis: str | None = None) -> TransformerLM:
+def build_lm(cfg, seq_axis: str | None = None,
+             expert_axis: str | None = None) -> TransformerLM:
     """Construct from an :class:`ddw_tpu.utils.config.LMCfg`."""
     return TransformerLM(
         vocab_size=cfg.vocab_size, max_len=cfg.max_len, hidden=cfg.hidden,
         depth=cfg.depth, num_heads=cfg.num_heads, mlp_dim=cfg.mlp_dim,
-        dropout=cfg.dropout, dtype=jnp.dtype(cfg.dtype), seq_axis=seq_axis)
+        dropout=cfg.dropout, dtype=jnp.dtype(cfg.dtype), seq_axis=seq_axis,
+        num_experts=cfg.num_experts, expert_axis=expert_axis,
+        capacity_factor=cfg.capacity_factor)
 
 
 def init_cache(decode_model: TransformerLM, batch: int):

@@ -1,0 +1,304 @@
+"""Pipeline parallelism for the LM — GPipe microbatch schedule over a mesh axis.
+
+Not a reference-parity item (the reference's parallelism inventory is
+DP/trial/HPO/batch-inference, SURVEY.md §2d); this is the pipeline axis of the
+framework, closing the tp/pp/dp/sp/ep set.
+
+TPU-first formulation:
+
+- the transformer's blocks are **stacked per stage**: block params become
+  leaves ``[n_stages, blocks_per_stage, ...]`` sharded ``P('pipe')`` on the
+  stage dim, so each device holds exactly its stage's weights (true model
+  partitioning, not replication). Embed/head stay replicated (they are tiny).
+- inside one ``shard_map``, a ``lax.scan`` runs the GPipe schedule: at tick
+  ``t`` stage ``r`` processes microbatch ``t - r``; activations hop to the
+  next stage over ICI via ``lax.ppermute``; ticks before/after a stage's
+  window compute on masked garbage whose loss contribution is zeroed (SPMD
+  ranks must run the same program — masking, not control flow, encodes the
+  schedule).
+- each stage applies its ``blocks_per_stage`` blocks with an inner
+  ``lax.scan`` over the stacked block params, wrapped in ``jax.checkpoint``
+  (per-tick rematerialization — GPipe's memory model).
+- backward is plain ``jax.grad`` through the scan: XLA transposes the
+  ``ppermute`` hops into the reverse-direction cotangent hops automatically.
+  Stage grads stay stage-local (``P('pipe')`` out-spec); embed/head grads are
+  ``psum``-ed (only the stages that actually use them contribute non-zeros).
+- the optimizer update runs OUTSIDE the shard_map under ``jit``: stage
+  params/moments arrive sharded, so GSPMD keeps the update sharded — the same
+  split this framework uses for ZeRO (``parallel/zero.py``).
+
+Scope: training/eval steps for :class:`ddw_tpu.models.lm.TransformerLM` with
+``dropout == 0`` and ``seq_axis is None`` (PP composes with DP by adding a
+data axis to the mesh; the batch dim shards over it transparently).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddw_tpu.models.lm import DecoderBlock, TransformerLM
+from ddw_tpu.train.lm_step import lm_loss
+from ddw_tpu.train.step import TrainState
+
+PIPE_AXIS = "pipe"
+
+
+def pp_params_from_lm(params: dict, n_stages: int, depth: int) -> dict:
+    """Restructure TransformerLM params for the pipeline step.
+
+    ``backbone_block{i}`` subtrees stack into ``stages`` leaves
+    ``[n_stages, depth/n_stages, ...]``; everything else splits into the
+    replicated ``embed`` (token + position tables) and ``head`` (final LN +
+    vocab projection) groups. Inverse: :func:`lm_params_from_pp`.
+    """
+    if depth % n_stages:
+        raise ValueError(f"depth {depth} not divisible by {n_stages} stages")
+    bps = depth // n_stages
+    blocks = [params[f"backbone_block{i}"] for i in range(depth)]
+    stage_trees = [
+        jax.tree.map(lambda *xs: jnp.stack(xs), *blocks[r * bps:(r + 1) * bps])
+        for r in range(n_stages)
+    ]
+    stages = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_trees)
+    return {
+        "embed": {"tok_embed": params["tok_embed"],
+                  "pos_embed": params["pos_embed"]},
+        "stages": stages,
+        "head": {"LayerNorm_0": params["LayerNorm_0"],
+                 "head": params["head"]},
+    }
+
+
+def lm_params_from_pp(pp: dict, n_stages: int, depth: int) -> dict:
+    """Inverse of :func:`pp_params_from_lm` (checkpoints/serving interop)."""
+    bps = depth // n_stages
+    out = {"tok_embed": pp["embed"]["tok_embed"],
+           "pos_embed": pp["embed"]["pos_embed"],
+           "LayerNorm_0": pp["head"]["LayerNorm_0"],
+           "head": pp["head"]["head"]}
+    for r in range(n_stages):
+        for b in range(bps):
+            out[f"backbone_block{r * bps + b}"] = jax.tree.map(
+                lambda x, r=r, b=b: x[r, b], pp["stages"])
+    return out
+
+
+def _spec_tree(pp_params, pipe_axis: str):
+    """P('pipe') on the stage dim of stacked blocks, replicated elsewhere."""
+    return {
+        "embed": jax.tree.map(lambda _: P(), pp_params["embed"]),
+        "stages": jax.tree.map(lambda _: P(pipe_axis), pp_params["stages"]),
+        "head": jax.tree.map(lambda _: P(), pp_params["head"]),
+    }
+
+
+def make_pp_lm_train_step(
+    model: TransformerLM,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    pipe_axis: str = PIPE_AXIS,
+    data_axis: str | None = None,
+    num_microbatches: int = 4,
+    donate: bool = False,
+) -> Callable:
+    """Build the pipelined LM train step.
+
+    ``step(state, inputs, targets) -> (state, metrics)`` where ``state.params``
+    is the :func:`pp_params_from_lm` layout placed via ``step.place_state``.
+    ``num_microbatches`` must divide the per-data-shard batch (checked at call
+    time). With ``data_axis`` set (DPxPP mesh) the batch dim additionally
+    shards over it: each data-parallel pipeline replica runs the schedule on
+    its shard and gradients ``pmean`` across replicas. MoE models are
+    supported with all-local (dense) experts — their Switch aux loss is
+    accumulated across stages/microbatches like the non-PP step's; an
+    ``expert_axis`` is rejected (PPxEP routing across a second axis is not
+    implemented).
+    """
+    if model.dropout:
+        raise ValueError("pipeline step supports dropout=0 models only")
+    if model.seq_axis:
+        raise ValueError("pipeline step composes with DP, not SP — build the "
+                         "model with seq_axis=None")
+    if getattr(model, "expert_axis", None):
+        raise ValueError("pipeline step does not implement expert parallelism "
+                         "— build the MoE model with expert_axis=None (dense "
+                         "experts) or use make_lm_train_step for EP")
+    n = mesh.shape[pipe_axis]
+    if model.depth % n:
+        raise ValueError(f"depth {model.depth} not divisible by pipe axis {n}")
+    m = num_microbatches
+    moe = getattr(model, "num_experts", 0) > 0
+    aux_w = 0.01  # Switch aux coefficient, matching make_lm_train_step
+
+    block_mod = DecoderBlock(model.num_heads, model.mlp_dim, 0.0, model.dtype,
+                             None, False, model.max_len,
+                             num_experts=model.num_experts,
+                             capacity_factor=model.capacity_factor)
+    embed_mod = nn.Embed(model.vocab_size, model.hidden, dtype=model.dtype)
+    ln_mod = nn.LayerNorm(dtype=jnp.float32)
+    head_mod = nn.Dense(model.vocab_size, dtype=jnp.float32)
+
+    @jax.checkpoint
+    def stage_apply(stage_params, x):
+        """Apply this stage's stacked blocks (inner scan over the block dim).
+        Returns (out, aux_sum) — the stage's summed Switch aux loss (0 for
+        dense models)."""
+        def body(h, block_params):
+            if moe:
+                out, mods = block_mod.apply({"params": block_params}, h, False,
+                                            mutable=["intermediates"])
+                sown = jax.tree.leaves(mods["intermediates"])
+                return out, sum(sown)
+            return block_mod.apply({"params": block_params}, h, False), 0.0
+
+        out, aux = lax.scan(body, x, stage_params)
+        return out, jnp.sum(aux)
+
+    def grad_fn(pp_params, inputs, targets):
+        """Per-rank pipeline forward+backward. inputs/targets [B, S] replicated
+        over the pipe axis (shard them over a data axis for DPxPP)."""
+        r = lax.axis_index(pipe_axis)
+        b, s = inputs.shape
+        if b % m:
+            raise ValueError(f"per-shard batch {b} not divisible by "
+                             f"num_microbatches {m}")
+        mb = b // m
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def loss_fn(p):
+            emb = embed_mod.apply({"params": p["embed"]["tok_embed"]}, inputs)
+            pos = p["embed"]["pos_embed"][:s].astype(model.dtype)[None]
+            emb = (emb + pos).reshape(m, mb, s, model.hidden)
+            targ = targets.reshape(m, mb, s)
+            stage_params = jax.tree.map(lambda x: x[0], p["stages"])
+
+            def tick(carry, t):
+                recv, ce_sum, acc_sum, aux_sum = carry
+                j = t - r
+                valid = (j >= 0) & (j < m)
+                j_c = jnp.clip(j, 0, m - 1)
+                x0 = lax.dynamic_index_in_dim(emb, j_c, keepdims=False)
+                x_in = jnp.where(r == 0, x0.astype(model.dtype),
+                                 recv.astype(model.dtype))
+                y, aux = stage_apply(stage_params, x_in)
+                # last stage: head + CE for its current microbatch
+                logits = head_mod.apply(
+                    {"params": p["head"]["head"]},
+                    ln_mod.apply({"params": p["head"]["LayerNorm_0"]},
+                                 y.astype(jnp.float32)))
+                tgt = lax.dynamic_index_in_dim(targ, j_c, keepdims=False)
+                ce = lm_loss(logits, tgt)
+                acc = jnp.mean(
+                    (jnp.argmax(logits, -1) == tgt).astype(jnp.float32))
+                use = (valid & (r == n - 1)).astype(jnp.float32)
+                # every stage contributes its own aux for its valid ticks
+                aux_use = valid.astype(jnp.float32)
+                recv_next = lax.ppermute(y, pipe_axis, perm)
+                return (recv_next, ce_sum + use * ce, acc_sum + use * acc,
+                        aux_sum + aux_use * aux), None
+
+            z = jnp.zeros((mb, s, model.hidden), model.dtype)
+            (_, ce_sum, acc_sum, aux_sum), _ = lax.scan(
+                tick, (z, jnp.zeros(()), jnp.zeros(()), jnp.zeros(())),
+                jnp.arange(m + n - 1))
+            # only the last stage accumulated CE; psum broadcasts the global
+            # mean. Aux: every stage's blocks contributed once per microbatch
+            # — mean over (microbatches x blocks) matches make_lm_train_step.
+            loss = lax.psum(ce_sum, pipe_axis) / m
+            acc = lax.psum(acc_sum, pipe_axis) / m
+            aux = lax.psum(aux_sum, pipe_axis) / (m * model.depth)
+            return loss + aux_w * aux, (loss, acc, aux)
+
+        (_, (loss, acc, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(pp_params)
+        # The loss comes out of a psum, replicated on every rank; under
+        # shard_map AD each rank's unit cotangent flows through the psum
+        # transpose, so raw grads are n_stages x the true gradient (verified
+        # empirically: every leaf exactly n x). Scale back.
+        grads = jax.tree.map(lambda g: g / n, grads)
+        # embed/head params are replicated but only some stages produce
+        # non-zero grads — psum makes every rank's grad the true global one.
+        grads["embed"] = lax.psum(grads["embed"], pipe_axis)
+        grads["head"] = lax.psum(grads["head"], pipe_axis)
+        metrics = {"loss": loss, "accuracy": acc}
+        if moe:
+            metrics["aux_loss"] = aux
+        if data_axis is not None:
+            # DPxPP: average gradients and metrics across pipeline replicas.
+            grads = lax.pmean(grads, data_axis)
+            metrics = lax.pmean(metrics, data_axis)
+        return grads, metrics
+
+    def _build(template_params):
+        specs = _spec_tree(template_params, pipe_axis)
+        tok_spec = P() if data_axis is None else P(data_axis)
+        smapped = jax.shard_map(
+            grad_fn, mesh=mesh,
+            in_specs=(specs, tok_spec, tok_spec),
+            out_specs=(specs, P()),
+            check_vma=False)
+
+        def _step(state: TrainState, inputs, targets):
+            grads, metrics = smapped(state.params, inputs, targets)
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            return TrainState(new_params, {}, new_opt, state.step + 1), metrics
+
+        return jax.jit(_step, donate_argnums=(0,) if donate else ())
+
+    _jits: dict = {}
+
+    def stepper(state: TrainState, inputs, targets):
+        key = jax.tree.structure(state)
+        fn = _jits.get(key)
+        if fn is None:
+            fn = _jits[key] = _build(state.params)
+        return fn(state, inputs, targets)
+
+    def place_state(state: TrainState) -> TrainState:
+        specs = _spec_tree(state.params, pipe_axis)
+        psh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+        repl = NamedSharding(mesh, P())
+        bps = model.depth // n
+
+        def opt_sharding(leaf):
+            # Optimizer moments mirror the params tree; stacked stage leaves
+            # are exactly the ones whose leading dims are (n_stages, bps) —
+            # shard those with the stages, replicate everything else
+            # (including adam's count scalar).
+            shape = getattr(leaf, "shape", ())
+            if len(shape) >= 2 and tuple(shape[:2]) == (n, bps):
+                return NamedSharding(mesh, P(pipe_axis))
+            return repl
+
+        return TrainState(
+            params=jax.tree.map(jax.device_put, state.params, psh),
+            batch_stats={},
+            opt_state=jax.tree.map(
+                lambda leaf: jax.device_put(leaf, opt_sharding(leaf)),
+                state.opt_state),
+            step=jax.device_put(state.step, repl),
+        )
+
+    stepper.place_state = place_state  # type: ignore[attr-defined]
+    return stepper
+
+
+def init_pp_state(model: TransformerLM, tx: optax.GradientTransformation,
+                  mesh: Mesh, rng: jax.Array,
+                  pipe_axis: str = PIPE_AXIS) -> TrainState:
+    """Init a TransformerLM and restructure into placed pipeline TrainState."""
+    from ddw_tpu.train.lm_step import init_lm_state
+
+    base = init_lm_state(model, tx, rng)
+    n = mesh.shape[pipe_axis]
+    pp = pp_params_from_lm(base.params, n, model.depth)
+    state = TrainState(pp, {}, tx.init(pp), jnp.zeros((), jnp.int32))
+    return state

@@ -34,8 +34,16 @@ def init_lm_state(model, tx: optax.GradientTransformation,
                   rng: jax.Array, seq_len: int = 8) -> TrainState:
     """Seeded replicated init (identical on every host == rank-0 broadcast)."""
     dummy = jnp.zeros((1, seq_len), jnp.int32)
-    # A seq-parallel model must init outside shard_map: build an axis-free twin.
-    init_model = model.clone(seq_axis=None) if model.seq_axis else model
+    # An axis-bound (seq/expert-parallel) model must init outside shard_map:
+    # build an axis-free twin — parameter shapes are axis-independent by
+    # construction (stacked expert weights, global-position embeds).
+    if model.seq_axis or getattr(model, "expert_axis", None):
+        unbind = {"seq_axis": None}
+        if hasattr(model, "expert_axis"):
+            unbind["expert_axis"] = None
+        init_model = model.clone(**unbind)
+    else:
+        init_model = model
     params = init_model.init({"params": rng}, dummy, train=False)["params"]
     return TrainState(params, {}, tx.init(params), jnp.zeros((), jnp.int32))
 
@@ -47,19 +55,28 @@ def make_lm_train_step(
     data_axis: str = "data",
     seq_axis: str | None = "seq",
     donate: bool = True,
+    aux_loss_weight: float = 0.01,
 ) -> Callable:
-    """Build the jitted DP(xSP) LM train step.
+    """Build the jitted DP(xSP)(xEP) LM train step.
 
     ``step(state, inputs, targets, rng) -> (state, metrics)`` with inputs/targets
     ``[global_batch, global_seq]`` sharded ``P(data_axis, seq_axis)``. The model's
-    ``seq_axis`` must match ``seq_axis`` (or both be None for pure DP). Metrics
-    (loss, token accuracy) come back world-averaged.
+    ``seq_axis`` must match ``seq_axis`` (or both be None for pure DP); a routing
+    model's ``expert_axis`` must be one of the step's mesh axes (its all_to_alls
+    then ride that axis). Metrics (loss, token accuracy) come back
+    world-averaged; for MoE models the Switch load-balance aux loss is added
+    with ``aux_loss_weight`` and reported as ``metrics['aux_loss']``.
     """
     axes = (data_axis,) if seq_axis is None else (data_axis, seq_axis)
     if (model.seq_axis or None) != (seq_axis or None):
         raise ValueError(f"model.seq_axis={model.seq_axis!r} but step "
                          f"seq_axis={seq_axis!r} — construct the model with the "
                          f"axis it will run under")
+    moe = getattr(model, "num_experts", 0) > 0
+    expert_axis = getattr(model, "expert_axis", None)
+    if expert_axis and expert_axis not in axes:
+        raise ValueError(f"model.expert_axis={expert_axis!r} is not a step "
+                         f"mesh axis {axes}")
 
     def _step(state: TrainState, inputs, targets, rng):
         # independent dropout masks per (data shard, seq shard, step)
@@ -68,18 +85,30 @@ def make_lm_train_step(
         dropout_rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(params):
-            logits = model.apply({"params": params}, inputs, train=True,
-                                 rngs={"dropout": dropout_rng})
-            loss = lm_loss(logits, targets)
+            if moe:
+                logits, mods = model.apply(
+                    {"params": params}, inputs, train=True,
+                    rngs={"dropout": dropout_rng}, mutable=["intermediates"])
+                # one sown scalar per MoE block; mean over blocks
+                sown = jax.tree.leaves(mods["intermediates"])
+                aux = sum(sown) / len(sown)
+            else:
+                logits = model.apply({"params": params}, inputs, train=True,
+                                     rngs={"dropout": dropout_rng})
+                aux = jnp.zeros((), jnp.float32)
+            ce = lm_loss(logits, targets)
             acc = jnp.mean((jnp.argmax(logits, -1) == targets).astype(jnp.float32))
-            return loss, acc
+            return ce + aux_loss_weight * aux, (ce, acc, aux)
 
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        (_, (loss, acc, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
         grads = lax.pmean(grads, axes)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": lax.pmean(loss, axes),
                    "accuracy": lax.pmean(acc, axes)}
+        if moe:
+            metrics["aux_loss"] = lax.pmean(aux, axes)
         return TrainState(new_params, {}, new_opt, state.step + 1), metrics
 
     tok_spec = P(data_axis) if seq_axis is None else P(data_axis, seq_axis)

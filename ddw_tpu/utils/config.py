@@ -125,6 +125,8 @@ class LMCfg:
     mlp_dim: int = 1024
     dropout: float = 0.0
     dtype: str = "bfloat16"
+    num_experts: int = 0                # >0: Switch-style MoE MLP blocks
+    capacity_factor: float = 1.25       # static expert capacity = cf*T/E
 
 
 @dataclass

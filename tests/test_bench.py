@@ -17,7 +17,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture(scope="module")
 def bench_json():
-    env = dict(os.environ, DDW_BENCH_SMOKE="1")
+    # Pin the virtual-CPU backend: the structural assertions below must not
+    # depend on the TPU tunnel being reachable (PALLAS_AXON_POOL_IPS="" skips
+    # the axon sitecustomize; same recipe as the root conftest).
+    env = dict(os.environ, DDW_BENCH_SMOKE="1", PALLAS_AXON_POOL_IPS="",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=900)

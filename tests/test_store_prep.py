@@ -159,3 +159,49 @@ def test_merge_shards_zero_copy(tmp_path):
     assert [s["sha256"] for s in merged.manifest["shards"]] == \
         [s["sha256"] for s in a.manifest["shards"]] + \
         [s["sha256"] for s in b.manifest["shards"]]
+
+
+def _etl_child(flowers_dir, store_root, delay_s):
+    import time
+
+    time.sleep(delay_s)  # coordinator must actually WAIT on this worker
+    from ddw_tpu.data.prep import prepare_flowers_distributed
+    from ddw_tpu.data.store import TableStore
+
+    prepare_flowers_distributed(flowers_dir, TableStore(store_root),
+                                worker_index=1, worker_count=2,
+                                sample_fraction=1.0, shard_size=16)
+
+
+def test_distributed_prep_concurrent_processes(flowers_dir, tmp_path):
+    """Two real OS processes prep concurrently: worker 1 (child, delayed) and
+    the coordinator (inline), which must block in the rendezvous until the
+    child's parts land, then merge."""
+    import multiprocessing as mp
+
+    from ddw_tpu.data.prep import prepare_flowers, prepare_flowers_distributed
+
+    dist = TableStore(str(tmp_path / "dist"))
+    ctx = mp.get_context("spawn")
+    child = ctx.Process(target=_etl_child,
+                        args=(flowers_dir, dist.root, 1.0))
+    child.start()
+    try:
+        out = prepare_flowers_distributed(
+            flowers_dir, dist, worker_index=0, worker_count=2,
+            sample_fraction=1.0, shard_size=16, merge_timeout_s=120,
+            abort=lambda: (f"child died ({child.exitcode})"
+                           if child.exitcode not in (None, 0) else None))
+    finally:
+        child.join(timeout=60)
+    assert out is not None
+    d_train, d_val, d_idx = out
+
+    single = TableStore(str(tmp_path / "single"))
+    s_train, s_val, s_idx = prepare_flowers(flowers_dir, single,
+                                            sample_fraction=1.0, shard_size=16)
+    assert d_idx == s_idx
+    assert {r.path for r in d_train.iter_records()} == \
+        {r.path for r in s_train.iter_records()}
+    assert {r.path for r in d_val.iter_records()} == \
+        {r.path for r in s_val.iter_records()}
