@@ -155,7 +155,8 @@ class DecoderBlock(nn.Module):
 
             h = MoEMlp(self.num_experts, self.mlp_dim,
                        capacity_factor=self.capacity_factor, dtype=self.dtype,
-                       expert_axis=self.expert_axis, name="moe")(h)
+                       expert_axis=self.expert_axis, no_drop=self.decode,
+                       name="moe")(h)
         else:
             d = x.shape[-1]
             h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(h)

@@ -82,6 +82,9 @@ class MoEMlp(nn.Module):
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
     expert_axis: str | None = None
+    no_drop: bool = False    # inference/decode: capacity = T, never drop — a
+                             # generated continuation must not depend on which
+                             # other batch entries route to the same expert
 
     @nn.compact
     def __call__(self, x):
@@ -92,7 +95,8 @@ class MoEMlp(nn.Module):
 
         gate_logits = nn.Dense(e, dtype=jnp.float32, name="gate")(
             xt.astype(jnp.float32))
-        capacity = max(1, int(-(-self.capacity_factor * t // e)))
+        capacity = (t if self.no_drop
+                    else max(1, int(-(-self.capacity_factor * t // e))))
         dispatch, combine, aux = top1_routing(gate_logits, capacity)
         self.sow("intermediates", "moe_aux_loss", aux)
 

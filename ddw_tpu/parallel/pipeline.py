@@ -107,6 +107,7 @@ def make_pp_lm_train_step(
     data_axis: str | None = None,
     num_microbatches: int = 4,
     donate: bool = False,
+    aux_loss_weight: float = 0.01,
 ) -> Callable:
     """Build the pipelined LM train step.
 
@@ -135,7 +136,7 @@ def make_pp_lm_train_step(
         raise ValueError(f"depth {model.depth} not divisible by pipe axis {n}")
     m = num_microbatches
     moe = getattr(model, "num_experts", 0) > 0
-    aux_w = 0.01  # Switch aux coefficient, matching make_lm_train_step
+    aux_w = aux_loss_weight
 
     block_mod = DecoderBlock(model.num_heads, model.mlp_dim, 0.0, model.dtype,
                              None, False, model.max_len,
@@ -188,15 +189,23 @@ def make_pp_lm_train_step(
                 x_in = jnp.where(r == 0, x0.astype(model.dtype),
                                  recv.astype(model.dtype))
                 y, aux = stage_apply(stage_params, x_in)
-                # last stage: head + CE for its current microbatch
-                logits = head_mod.apply(
-                    {"params": p["head"]["head"]},
-                    ln_mod.apply({"params": p["head"]["LayerNorm_0"]},
-                                 y.astype(jnp.float32)))
                 tgt = lax.dynamic_index_in_dim(targ, j_c, keepdims=False)
-                ce = lm_loss(logits, tgt)
-                acc = jnp.mean(
-                    (jnp.argmax(logits, -1) == tgt).astype(jnp.float32))
+
+                # Head + CE only materialize on the last stage: the head
+                # projection has no collectives, so lax.cond is legal inside
+                # shard_map and skips (n-1)/n of the vocab-matmul work.
+                def head_ce(y):
+                    logits = head_mod.apply(
+                        {"params": p["head"]["head"]},
+                        ln_mod.apply({"params": p["head"]["LayerNorm_0"]},
+                                     y.astype(jnp.float32)))
+                    ce = lm_loss(logits, tgt)
+                    acc = jnp.mean(
+                        (jnp.argmax(logits, -1) == tgt).astype(jnp.float32))
+                    return ce, acc
+
+                ce, acc = lax.cond(r == n - 1, head_ce,
+                                   lambda _: (jnp.zeros(()), jnp.zeros(())), y)
                 use = (valid & (r == n - 1)).astype(jnp.float32)
                 # every stage contributes its own aux for its valid ticks
                 aux_use = valid.astype(jnp.float32)

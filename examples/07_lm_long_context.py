@@ -13,7 +13,10 @@ Run (virtual 8-device CPU mesh):
     python examples/07_lm_long_context.py --quick
 
 Args: lm.key=value overrides (e.g. lm.hidden=512), train.* for the loop,
---seq-devices to size the seq axis (default: half the devices).
+--seq-devices to size the seq axis (default: half the devices),
+--moe to route the MLPs through Switch experts partitioned over the data axis
+(expert parallelism: lax.all_to_all token exchange), --pipeline to train the
+same model under the GPipe pipeline schedule instead (stages over the mesh).
 """
 
 from __future__ import annotations
@@ -52,6 +55,12 @@ def main():
     ap.add_argument("--quick", action="store_true", help="tiny model + few steps")
     ap.add_argument("--seq-devices", type=int, default=0,
                     help="devices on the seq axis (0 = half the mesh)")
+    ap.add_argument("--moe", type=int, default=0, metavar="E",
+                    help="route MLPs through E Switch experts, partitioned "
+                         "over the data axis (expert parallelism)")
+    ap.add_argument("--pipeline", type=int, default=0, metavar="STAGES",
+                    help="train under the GPipe pipeline schedule with this "
+                         "many stages instead of DPxSP")
     ap.add_argument("--steps", type=int, default=0)
     ap.add_argument("overrides", nargs="*", help="lm.key=value / train.key=value")
     args = ap.parse_args()
@@ -69,17 +78,53 @@ def main():
     sp = args.seq_devices or max(1, n // 2)
     dp = n // sp
     assert dp * sp == n, f"seq devices {sp} must divide device count {n}"
-    mesh = make_mesh(MeshSpec(((DATA_AXIS, dp), (SEQ_AXIS, sp))), devices=devices)
-    seq_axis = SEQ_AXIS if sp > 1 else None
+    if args.pipeline:
+        # GPipe pipeline schedule: stages over a 'pipe' axis (x DP when the
+        # mesh is bigger), stage-sharded stacked block params.
+        from ddw_tpu.parallel.pipeline import init_pp_state, make_pp_lm_train_step
 
-    model = build_lm(lm_cfg, seq_axis=seq_axis)
-    tx = make_optimizer(train_cfg)
-    state = init_lm_state(model, tx, jax.random.PRNGKey(train_cfg.seed))
-    step = make_lm_train_step(model, tx, mesh, seq_axis=seq_axis)
-    eval_step = make_lm_eval_step(model, mesh, seq_axis=seq_axis)
+        stages = args.pipeline
+        dp = n // stages
+        assert dp * stages == n, f"stages {stages} must divide devices {n}"
+        if lm_cfg.depth % stages:
+            adjusted = max(stages, lm_cfg.depth // stages * stages)
+            print(f"[pipeline] adjusting lm.depth {lm_cfg.depth} -> {adjusted} "
+                  f"(must divide {stages} stages)")
+            lm_cfg.depth = adjusted
+        axes = ((DATA_AXIS, dp), ("pipe", stages)) if dp > 1 else (("pipe", stages),)
+        mesh = make_mesh(MeshSpec(axes), devices=devices)
+        lm_cfg.dropout = 0.0
+        if args.moe:
+            lm_cfg.num_experts = args.moe  # dense experts under PP (EP is
+            # make_lm_train_step territory; the PP step rejects expert_axis)
+        model = build_lm(lm_cfg)
+        tx = make_optimizer(train_cfg)
+        state = init_pp_state(model, tx, mesh, jax.random.PRNGKey(train_cfg.seed))
+        step_pp = make_pp_lm_train_step(
+            model, tx, mesh, data_axis=DATA_AXIS if dp > 1 else None,
+            num_microbatches=2)
+        state = step_pp.place_state(state)
+        step = lambda st, i, t, _rng: step_pp(st, i, t)  # noqa: E731
+        eval_step = None
+        sp = 1
+    else:
+        mesh = make_mesh(MeshSpec(((DATA_AXIS, dp), (SEQ_AXIS, sp))), devices=devices)
+        seq_axis = SEQ_AXIS if sp > 1 else None
+        expert_axis = DATA_AXIS if args.moe else None
+        if args.moe:
+            lm_cfg.num_experts = args.moe
+
+        model = build_lm(lm_cfg, seq_axis=seq_axis, expert_axis=expert_axis)
+        tx = make_optimizer(train_cfg)
+        state = init_lm_state(model, tx, jax.random.PRNGKey(train_cfg.seed))
+        step = make_lm_train_step(model, tx, mesh, seq_axis=seq_axis)
+        eval_step = make_lm_eval_step(model, mesh, seq_axis=seq_axis)
 
     # global batch/seq: divisible by the mesh axes
     batch = max(train_cfg.batch_size, dp) // dp * dp
+    if args.pipeline:
+        # num_microbatches=2 must divide each data shard: round UP to 2*dp
+        batch = -(-batch // (2 * dp)) * (2 * dp)
     seq_len = min(lm_cfg.max_len, 64 * sp) // sp * sp
     steps = args.steps or (60 if args.quick else 300)
 
@@ -97,16 +142,24 @@ def main():
                   f"acc={float(metrics['accuracy']):.3f}")
     jax.block_until_ready(metrics["loss"])
     dt = time.time() - t0
-    final = eval_step(state, inputs, targets)
+    final = eval_step(state, inputs, targets) if eval_step else metrics
     tok_s = steps * batch * seq_len / dt
+    aux = (f" aux={float(metrics['aux_loss']):.3f}"
+           if "aux_loss" in metrics else "")
     print(f"final: loss={float(final['loss']):.4f} acc={float(final['accuracy']):.3f} "
-          f"tokens/sec={tok_s:,.0f} ({dt:.1f}s for {steps} steps)")
+          f"tokens/sec={tok_s:,.0f} ({dt:.1f}s for {steps} steps){aux}")
 
     # KV-cached greedy continuation (decode path; ddw_tpu.models.lm.generate)
-    from ddw_tpu.models.lm import generate
+    from ddw_tpu.models.lm import generate, TransformerLM  # noqa: F401
 
+    params = state.params
+    if args.pipeline:
+        from ddw_tpu.parallel.pipeline import lm_params_from_pp
+
+        params = lm_params_from_pp(jax.device_get(params), args.pipeline,
+                                   model.depth)
     prompt = tokens[:1, :16]
-    cont = np.asarray(generate(model, state.params, prompt, num_steps=16))
+    cont = np.asarray(generate(model, params, prompt, num_steps=16))
     match = float((cont[0] == tokens[0, 16:32]).mean())
     print(f"generate: 16-token greedy continuation matches training stream "
           f"{match:.0%}")

@@ -146,3 +146,35 @@ def test_moe_step_rejects_foreign_expert_axis():
     with pytest.raises(ValueError, match="expert_axis"):
         make_lm_train_step(model, optax.adam(1e-3), mesh, DATA_AXIS,
                            seq_axis=None)
+
+
+def test_moe_decode_path_matches_full_forward():
+    """KV-cached decode of an MoE LM (dense experts, per-call routing) ==
+    full-sequence forward at no-drop capacity — prefill and per-token both."""
+    from ddw_tpu.models.lm import init_cache
+
+    model = TransformerLM(vocab_size=VOCAB, max_len=64, hidden=32, depth=2,
+                          num_heads=2, mlp_dim=64, dropout=0.0,
+                          dtype=jnp.float32, num_experts=4,
+                          capacity_factor=8.0)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, VOCAB, size=(2, 12)).astype(np.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, tokens)["params"]
+    full = model.apply({"params": params}, tokens)
+
+    dm = model.clone(decode=True, seq_axis=None)
+    cache = init_cache(dm, 2)
+    prefill, vars_ = dm.apply({"params": params, "cache": cache}, tokens,
+                              mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(prefill), np.asarray(full),
+                               rtol=1e-5, atol=1e-5)
+
+    cache = init_cache(dm, 2)
+    outs = []
+    for t in range(12):
+        lg, vars_ = dm.apply({"params": params, "cache": cache},
+                             tokens[:, t:t + 1], mutable=["cache"])
+        cache = vars_["cache"]
+        outs.append(lg[:, 0])
+    np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
+                               np.asarray(full), rtol=1e-5, atol=1e-5)
