@@ -61,12 +61,17 @@ def _combine(o1, lse1, o2, lse2):
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    sm_scale: float | None = None,
-                   block_q: int = 128, block_k: int = 128) -> jnp.ndarray:
+                   block_q: int = 128, block_k: int = 128,
+                   impl: str = "auto") -> jnp.ndarray:
     """Blockwise ring attention over ``axis_name``.
 
     Per-device shapes: q/k/v [B, H, S_local, D] (the local sequence shard);
     returns the local shard of the attention output. Must be called inside
-    ``shard_map``/``pmap`` binding ``axis_name``.
+    ``shard_map``/``pmap`` binding ``axis_name``. ``impl`` selects the per-hop
+    attention arm (``auto``/``xla``/``xla_ckpt``/``pallas`` — see
+    :func:`ddw_tpu.ops.flash_attention.flash_mha_lse`): auto picks by the
+    LOCAL S_local x S_local score footprint, so moderate shards get the fused
+    XLA arm and long-context shards the Pallas flash kernel.
     """
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
@@ -85,7 +90,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         # flash_mha_lse pads non-tile-multiple s_local internally, so any
         # shard length works (parity with the einsum formulation it replaced).
         o, l = flash_mha_lse(q, k_hop, v_hop, hop_causal, sm_scale,
-                             block_q, block_k)
+                             block_q, block_k, impl=impl)
         return o.astype(jnp.float32), l
 
     for hop in range(n):
