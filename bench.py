@@ -43,6 +43,7 @@ Env: ``DDW_BENCH_SMOKE=1`` shrinks every shape/step count for CPU CI;
 import json
 import os
 import statistics
+import sys
 import time
 
 import jax
@@ -319,7 +320,52 @@ def bench_host_pipeline(n_images: int, hw: int, device_ips: float | None) -> dic
     return out
 
 
+def _device_problem(timeout_s: float = 240.0) -> str | None:
+    """None if the backend executes a trivial op within the timeout, else a
+    one-line diagnosis (hang vs init error).
+
+    The tunneled TPU backend can be unreachable (observed mid-round: every op
+    hangs indefinitely, including jax.devices()); a bench that hangs records
+    nothing. Probe on a daemon thread so an unresponsive runtime can't wedge
+    the process."""
+    import threading
+
+    done: list = []
+    failed: list = []
+
+    def probe():
+        try:
+            done.append(float(jnp.ones((8, 8)).sum()))
+        except Exception as e:  # init error is a different diagnosis than a hang
+            failed.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if done:
+        return None
+    if failed:
+        return f"device backend errored: {failed[0]}"
+    return ("device backend unresponsive (tunnel down?) — no measurement "
+            "possible; see BASELINE.md for the last recorded matrix")
+
+
 def main():
+    problem = _device_problem()
+    if problem:
+        print(json.dumps({
+            "metric": "mobilenet_v2_frozen_train_images_per_sec_per_chip",
+            "value": None,
+            "unit": "images/sec/chip",
+            "vs_baseline": None,
+            "error": problem,
+        }))
+        sys.stdout.flush()
+        # Nonzero: automation gating on the exit code must not record this as
+        # a successful measurement. _exit because the wedged backend thread
+        # would block a normal interpreter shutdown.
+        os._exit(1)
+
     kind, peak = _device_peak_tflops()
     n_chips = len(jax.devices())
 
