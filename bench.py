@@ -8,7 +8,9 @@ numbers — absence documented in BASELINE.md "Published numbers"):
 - ``mobilenet_v2_unfrozen`` — same model, full backward;
 - ``resnet50``             — the heavy conv family, full backward;
 - ``vit``                  — in-tree Pallas flash-MHA path (``models/vit.py``);
-- ``lm_flash``             — decoder LM, causal Pallas flash attention, seq 2048.
+- ``lm_flash``             — decoder LM, causal auto-dispatch attention, seq 2048;
+- ``lm_moe``               — same LM with Switch top-1 MoE MLPs (8 experts,
+  dense on one chip; EP's all_to_alls need a mesh — see dryrun).
 
 Each row reports images(or tokens)/sec/chip, median step time, the XLA-counted
 FLOPs of the compiled step (``Compiled.cost_analysis()['flops']`` — the actual
@@ -138,7 +140,7 @@ def _row(items_per_step: int, n_chips: int, dt: float, measure_steps: int,
     }
     if flops:
         tf = flops / dt * measure_steps / n_chips / 1e12
-        out["achieved_tflops_per_chip"] = round(tf, 4)
+        out["achieved_tflops_per_chip"] = round(tf, 6)
         if peak:
             out["mfu"] = round(tf / peak, 6)
     return out
@@ -201,7 +203,7 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
 
 
 def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
-             vocab: int, peak: float | None) -> dict:
+             vocab: int, peak: float | None, num_experts: int = 0) -> dict:
     import optax
 
     from ddw_tpu.models.lm import TransformerLM
@@ -215,7 +217,8 @@ def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
 
     model = TransformerLM(vocab_size=vocab, max_len=seq, hidden=hidden,
                           depth=depth, num_heads=heads, mlp_dim=hidden * 4,
-                          dropout=0.0, dtype=jnp.bfloat16, seq_axis=None)
+                          dropout=0.0, dtype=jnp.bfloat16, seq_axis=None,
+                          num_experts=num_experts)
     tx = optax.adam(3e-4)
     state = init_lm_state(model, tx, jax.random.PRNGKey(0), seq_len=8)
     step = make_lm_train_step(model, tx, mesh, DATA_AXIS, seq_axis=None,
@@ -246,6 +249,8 @@ def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
     row = _row(global_batch * seq, n_chips, dt, measured_steps, flops, peak,
                "tokens/sec/chip")
     row.update(batch_per_chip=batch, seq_len=seq, hidden=hidden, depth=depth)
+    if num_experts:
+        row["num_experts"] = num_experts
     return row
 
 
@@ -390,6 +395,7 @@ def main():
         "vit": lambda: bench_vision(
             "vit", freeze_base=False, batch=batch, img=img, peak=peak),
         "lm_flash": lambda: bench_lm(**lm_kw),
+        "lm_moe": lambda: bench_lm(**lm_kw, num_experts=8),
     }
     only = [s for s in os.environ.get("DDW_BENCH_ONLY", "").split(",") if s]
     if only:

@@ -41,7 +41,7 @@ def test_headline_contract(bench_json):
 def test_matrix_rows(bench_json):
     configs = bench_json["configs"]
     for name in ("mobilenet_v2_frozen", "mobilenet_v2_unfrozen", "resnet50",
-                 "vit", "lm_flash"):
+                 "vit", "lm_flash", "lm_moe"):
         row = configs[name]
         assert "error" not in row, f"{name}: {row}"
         assert row["rate_per_chip"] > 0
