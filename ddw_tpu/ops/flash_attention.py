@@ -555,12 +555,14 @@ def _xla_attention_lse(q, k, v, causal: bool, q_offset, k_offset,
                        sm_scale: float, k_valid: int | None):
     """Reference-semantics attention via one fused XLA einsum chain.
 
-    Matches the Pallas kernels' contract exactly: f32 accumulation, global
-    causal offsets, ``k_valid`` key masking, and an lse output for ring
-    combination. Autodiff gives the backward; XLA fuses mask+softmax into the
-    matmuls."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
+    Matches the Pallas kernels' contract exactly: matmuls run in the input
+    dtype (bf16 -> full MXU rate) with f32 accumulation
+    (``preferred_element_type``, same as the kernels' ``jnp.dot``), softmax
+    bookkeeping in f32, global causal offsets, ``k_valid`` key masking, and an
+    lse output for ring combination. Autodiff gives the backward; XLA fuses
+    mask+softmax into the matmuls."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
     sq, sk = q.shape[2], k.shape[2]
     kpos = k_offset + jnp.arange(sk)
     mask = None
@@ -576,7 +578,8 @@ def _xla_attention_lse(q, k, v, causal: bool, q_offset, k_offset,
     m = jnp.maximum(m, -1e30)  # fully-masked rows: keep exp finite
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    out = (jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    out = (jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+                      preferred_element_type=jnp.float32)
            / jnp.maximum(l, 1e-30)).astype(q.dtype)
     lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
     return out, lse
