@@ -8,6 +8,7 @@ in-tree equivalent:
     python -m ddw_tpu.tracking <runs_root> runs [-e EXP] [--sort METRIC]
     python -m ddw_tpu.tracking <runs_root> show RUN_ID [-e EXP]
     python -m ddw_tpu.tracking <runs_root> series RUN_ID KEY [-e EXP]
+    python -m ddw_tpu.tracking <runs_root> report [-e EXP] [-o OUT.html]
     python -m ddw_tpu.tracking <registry_root> models
 """
 
@@ -109,6 +110,15 @@ def cmd_series(args) -> None:
         print(f"{step}\t{_fmt_val(value)}")
 
 
+def cmd_report(args) -> None:
+    from ddw_tpu.tracking.report import write_report
+
+    _exp_dir(args)  # validate before writing anything
+    out = write_report(args.root, args.experiment, args.out or None,
+                       include_sys=args.sys)
+    print(out)
+
+
 def cmd_models(args) -> None:
     from ddw_tpu.tracking.registry import ModelRegistry
 
@@ -138,11 +148,17 @@ def main(argv=None) -> None:
     p_series.add_argument("run_id")
     p_series.add_argument("key")
     p_series.add_argument("-e", "--experiment", default="default")
+    p_report = sub.add_parser("report")
+    p_report.add_argument("-e", "--experiment", default="default")
+    p_report.add_argument("-o", "--out", default="",
+                          help="output path (default <root>/<exp>_report.html)")
+    p_report.add_argument("--sys", action="store_true",
+                          help="include sys.* utilization charts")
     sub.add_parser("models")
 
     args = ap.parse_args(argv)
     {"experiments": cmd_experiments, "runs": cmd_runs, "show": cmd_show,
-     "series": cmd_series, "models": cmd_models}[args.cmd](args)
+     "series": cmd_series, "report": cmd_report, "models": cmd_models}[args.cmd](args)
 
 
 if __name__ == "__main__":

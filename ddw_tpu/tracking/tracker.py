@@ -141,6 +141,20 @@ class Run:
                     out[rec["key"]] = rec["value"]
         return out
 
+    def metric_series(self) -> dict[str, list[tuple[int, float]]]:
+        """Every logged series in ONE pass over metrics.jsonl
+        (``{key: [(step, value), ...]}``). Bulk consumers (the HTML report)
+        use this instead of per-key :meth:`metric_history` calls, which would
+        re-parse the file once per key."""
+        out: dict[str, list[tuple[int, float]]] = {}
+        path = os.path.join(self.run_dir, "metrics.jsonl")
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    out.setdefault(rec["key"], []).append((rec["step"], rec["value"]))
+        return out
+
     def __enter__(self) -> "Run":
         return self
 

@@ -72,3 +72,50 @@ def test_tracking_cli(tmp_path, capsys):
     cli.main([reg_root, "models"])
     out = capsys.readouterr().out
     assert "flowers" in out and "Production" in out and rid in out
+
+
+def test_html_report(tmp_path, capsys):
+    """Report renderer: runs table with nested children, one SVG chart per
+    metric, sys.* excluded by default; CLI subcommand writes the file."""
+    from ddw_tpu.tracking import __main__ as cli
+    from ddw_tpu.tracking.report import render_report
+    from ddw_tpu.tracking.tracker import Tracker
+
+    root = str(tmp_path / "runs")
+    tracker = Tracker(root, "exp1")
+    with tracker.start_run("parent") as parent:
+        parent.log_params({"evals": 2})
+        for rid in range(2):
+            with tracker.start_run(f"trial{rid}",
+                                   parent_run_id=parent.run_id) as child:
+                child.log_params({"lr": 0.1 * (rid + 1)})
+                for step in range(3):
+                    child.log_metric("val_loss", 1.0 / (step + rid + 1), step)
+                child.log_metric("sys.cpu", 50.0, 0)
+        # grandchild: a sub-run started under a trial (retry / nested HPO)
+        with tracker.start_run("retry", parent_run_id=child.run_id) as grand:
+            grand.log_metric("val_loss", 0.125, 0)
+            grand.log_metric("val_loss", float("nan"), 1)  # diverged tail
+        parent.log_metric("best_loss", 0.25, 0)
+
+    html_text = render_report(root, "exp1")
+    assert parent.run_id in html_text
+    assert grand.run_id in html_text             # depth-2 runs are not dropped
+    assert "class='child'" in html_text          # nested rows indented
+    assert html_text.count("<polyline") == 2     # one val_loss line per child
+    # grandchild's NaN point is dropped -> single finite point renders as a
+    # circle (plus parent's lone best_loss point); no 'nan' leaks into coords
+    assert html_text.count("<circle") == 2
+    assert "nan" not in html_text.split("<svg", 1)[1].lower()
+    assert "val_loss" in html_text and "best_loss" in html_text
+    assert "sys.cpu" not in html_text            # excluded by default
+    assert render_report(root, "exp1", include_sys=True).count("sys.cpu") > 0
+
+    out_file = str(tmp_path / "r.html")
+    cli.main([root, "report", "-e", "exp1", "-o", out_file])
+    assert capsys.readouterr().out.strip() == out_file
+    assert "<svg" in open(out_file).read()
+
+    import pytest as _pytest
+    with _pytest.raises(FileNotFoundError):
+        render_report(root, "nope")
