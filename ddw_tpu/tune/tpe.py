@@ -137,11 +137,27 @@ def _suggest_dim(rng: np.random.RandomState, dim: Dim, good: list, bad: list,
 
 def suggest(space: dict[str, Dim], trials: Trials, rng: np.random.RandomState,
             n_startup_trials: int = 5, gamma: float = 0.25,
-            n_ei_candidates: int = 24) -> dict[str, Any]:
-    """One TPE suggestion given completed history."""
+            n_ei_candidates: int = 24,
+            pending: list[dict] | None = None) -> dict[str, Any]:
+    """One TPE suggestion given completed history.
+
+    ``pending`` are the param dicts of trials currently in flight (async mode).
+    They join the *bad* Parzen set — the "constant liar" strategy — so the EI
+    ratio is depressed around points already being evaluated and concurrent
+    workers don't pile onto the same proposal (round-1 advisor note on
+    duplicate concurrent proposals).
+    """
     done = trials.completed()
+    pending = pending or []
     if len(done) < n_startup_trials:
-        return sample_space(space, rng)
+        draw = sample_space(space, rng)
+        # Startup draws are uniform; only all-categorical spaces can collide
+        # with an in-flight draw with non-zero probability — reroll a few times.
+        for _ in range(8):
+            if draw not in pending:
+                break
+            draw = sample_space(space, rng)
+        return draw
     losses = np.array([t["loss"] for t in done])
     # Elitist split: ceil(gamma * sqrt(n)) capped at 25 — hyperopt's split, which
     # keeps the good set small; a linear gamma*n fraction lets mediocre trials
@@ -153,6 +169,7 @@ def suggest(space: dict[str, Dim], trials: Trials, rng: np.random.RandomState,
     for name, dim in space.items():
         good = [done[i]["params"][name] for i in good_idx if name in done[i]["params"]]
         bad = [done[i]["params"][name] for i in bad_idx if name in done[i]["params"]]
+        bad += [p[name] for p in pending if name in p]
         out[name] = _suggest_dim(rng, dim, good, bad, n_ei_candidates)
     return out
 
@@ -181,10 +198,11 @@ def fmin(
     trials = trials if trials is not None else Trials()
     rng = np.random.RandomState(seed)
 
-    def propose() -> dict:
+    def propose(pending: list[dict] | None = None) -> dict:
         if algo == "random":
             return sample_space(space, rng)
-        return suggest(space, trials, rng, n_startup_trials, gamma)
+        return suggest(space, trials, rng, n_startup_trials, gamma,
+                       pending=pending)
 
     def run_one(params: dict) -> None:
         try:
@@ -207,12 +225,15 @@ def fmin(
         # proposal sees the trials completed so far (async TPE).
         submitted = 0
         with ThreadPoolExecutor(max_workers=parallelism) as pool:
-            inflight = set()
+            inflight: dict = {}  # future -> its proposed params (the pending set)
             while submitted < max_evals or inflight:
                 while submitted < max_evals and len(inflight) < parallelism:
-                    inflight.add(pool.submit(run_one, propose()))
+                    params = propose(pending=list(inflight.values()))
+                    inflight[pool.submit(run_one, params)] = params
                     submitted += 1
-                done, inflight = wait(inflight, return_when=FIRST_COMPLETED)
+                done, _ = wait(set(inflight), return_when=FIRST_COMPLETED)
+                for f in done:
+                    del inflight[f]
     best = trials.best
     if best is None:
         raise RuntimeError(f"all {max_evals} trials failed; last errors: "
