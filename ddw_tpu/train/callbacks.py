@@ -45,6 +45,21 @@ class LRWarmup:
         frac = (epoch + 1) / self.warmup_epochs
         return self.base_lr + (target - self.base_lr) * frac
 
+    def lr_for_step(self, epoch: int, step_in_epoch: int, steps_per_epoch: int) -> float:
+        """Per-batch ramp — the Horovod ``LearningRateWarmupCallback`` granularity
+        (reference ``:314-318`` ramps every *batch* across the warmup epochs, not
+        every epoch). Linear from ``base_lr`` at batch 0 to ``base_lr * world`` at
+        the last warmup batch, then constant at the scaled target.
+        """
+        target = self.base_lr * self.world_size
+        total = self.warmup_epochs * max(1, steps_per_epoch)
+        if self.world_size == 1 or total <= 0:
+            return target
+        k = epoch * steps_per_epoch + step_in_epoch + 1  # batches completed after this one
+        if k >= total:
+            return target
+        return self.base_lr + (target - self.base_lr) * (k / total)
+
 
 class _Resumable:
     """Checkpointable host-side counters (VERDICT r1: a resumed run must not

@@ -56,6 +56,7 @@ def make_lm_train_step(
     seq_axis: str | None = "seq",
     donate: bool = True,
     aux_loss_weight: float = 0.01,
+    grad_accum_steps: int = 1,
 ) -> Callable:
     """Build the jitted DP(xSP)(xEP) LM train step.
 
@@ -84,24 +85,56 @@ def make_lm_train_step(
             rng = jax.random.fold_in(rng, lax.axis_index(ax))
         dropout_rng = jax.random.fold_in(rng, state.step)
 
-        def loss_fn(params):
+        def loss_fn(params, in_mb, tg_mb, rng_mb):
             if moe:
                 logits, mods = model.apply(
-                    {"params": params}, inputs, train=True,
-                    rngs={"dropout": dropout_rng}, mutable=["intermediates"])
+                    {"params": params}, in_mb, train=True,
+                    rngs={"dropout": rng_mb}, mutable=["intermediates"])
                 # one sown scalar per MoE block; mean over blocks
                 sown = jax.tree.leaves(mods["intermediates"])
                 aux = sum(sown) / len(sown)
             else:
-                logits = model.apply({"params": params}, inputs, train=True,
-                                     rngs={"dropout": dropout_rng})
+                logits = model.apply({"params": params}, in_mb, train=True,
+                                     rngs={"dropout": rng_mb})
                 aux = jnp.zeros((), jnp.float32)
-            ce = lm_loss(logits, targets)
-            acc = jnp.mean((jnp.argmax(logits, -1) == targets).astype(jnp.float32))
+            ce = lm_loss(logits, tg_mb)
+            acc = jnp.mean((jnp.argmax(logits, -1) == tg_mb).astype(jnp.float32))
             return ce + aux_loss_weight * aux, (ce, acc, aux)
 
-        (_, (loss, acc, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        if grad_accum_steps > 1:
+            # Microbatch accumulation over the local batch dim (lax.scan) —
+            # same semantics as ddw_tpu.train.step.accumulate_grads; the
+            # sequence dim stays whole so SP ring hops see full local shards.
+            b = inputs.shape[0]
+            if b % grad_accum_steps:
+                raise ValueError(f"local batch {b} not divisible by "
+                                 f"grad_accum_steps {grad_accum_steps}")
+            mb = b // grad_accum_steps
+            s = inputs.shape[1]
+
+            def body(carry, xs):
+                gsum, lsum, asum, xsum = carry
+                in_i, tg_i, idx = xs
+                (_, (l, a, x)), g = grad_fn(
+                    state.params, in_i, tg_i,
+                    jax.random.fold_in(dropout_rng, idx))
+                return (jax.tree.map(jnp.add, gsum, g), lsum + l, asum + a,
+                        xsum + x), None
+
+            zero = jnp.zeros((), jnp.float32)
+            (gsum, lsum, asum, xsum), _ = lax.scan(
+                body,
+                (jax.tree.map(jnp.zeros_like, state.params), zero, zero, zero),
+                (inputs.reshape(grad_accum_steps, mb, s),
+                 targets.reshape(grad_accum_steps, mb, s),
+                 jnp.arange(grad_accum_steps)))
+            inv = 1.0 / grad_accum_steps
+            grads = jax.tree.map(lambda g: g * inv, gsum)
+            loss, acc, aux = lsum * inv, asum * inv, xsum * inv
+        else:
+            (_, (loss, acc, aux)), grads = grad_fn(
+                state.params, inputs, targets, dropout_rng)
         grads = lax.pmean(grads, axes)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

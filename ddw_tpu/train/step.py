@@ -174,24 +174,70 @@ def apply_gradients(state: TrainState, tx: optax.GradientTransformation,
     return TrainState(new_params, new_batch_stats, new_opt, state.step + 1)
 
 
+def accumulate_grads(model, state: TrainState, images, labels, base_rng,
+                     accum: int):
+    """Microbatch gradient accumulation (``lax.scan`` over ``accum`` slices of
+    the per-device batch). Same optimizer math as one big batch — mean CE over
+    equal microbatches equals the full-batch mean — at 1/accum the activation
+    memory; XLA compiles ONE microbatch program iterated sequentially.
+
+    BatchNorm running stats thread through the scan carry (each microbatch
+    updates them in turn, the usual framework semantics). Dropout draws an
+    independent mask per microbatch (rng folded with the slice index).
+    Returns ``(loss, acc, new_batch_stats, grads)`` like
+    :func:`forward_and_grads`.
+    """
+    b = images.shape[0]
+    if b % accum:
+        raise ValueError(f"per-device batch {b} not divisible by "
+                         f"grad_accum_steps {accum}")
+    mb = b // accum
+    im = images.reshape(accum, mb, *images.shape[1:])
+    lb = labels.reshape(accum, mb, *labels.shape[1:])
+
+    def body(carry, xs):
+        bs, gsum, lsum, asum = carry
+        im_i, lb_i, idx = xs
+        loss, acc, nbs, grads = forward_and_grads(
+            model, state.replace(batch_stats=bs), im_i, lb_i,
+            jax.random.fold_in(base_rng, idx))
+        gsum = jax.tree.map(jnp.add, gsum, grads)
+        return (nbs, gsum, lsum + loss, asum + acc), None
+
+    zero_g = jax.tree.map(jnp.zeros_like, state.params)
+    zero = jnp.zeros((), jnp.float32)
+    (new_bs, gsum, lsum, asum), _ = lax.scan(
+        body, (state.batch_stats, zero_g, zero, zero),
+        (im, lb, jnp.arange(accum)))
+    inv = 1.0 / accum
+    return lsum * inv, asum * inv, new_bs, jax.tree.map(lambda g: g * inv, gsum)
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
     mesh: Mesh,
     axis_name: str = "data",
     donate: bool = True,
+    grad_accum_steps: int = 1,
 ) -> Callable:
     """Build the jitted SPMD train step over ``mesh``.
 
     Returns ``step(state, images, labels, rng) -> (state, metrics)`` where images /
     labels are globally-sharded arrays split along ``axis_name`` and metrics are
-    already world-averaged (loss, accuracy).
+    already world-averaged (loss, accuracy). ``grad_accum_steps > 1`` runs each
+    device's batch as that many sequential microbatches (see
+    :func:`accumulate_grads`).
     """
     def _step(state: TrainState, images, labels, rng):
         me = lax.axis_index(axis_name)
         dropout_rng = jax.random.fold_in(jax.random.fold_in(rng, me), state.step)
-        loss, acc, new_bs, grads = forward_and_grads(
-            model, state, images, labels, dropout_rng)
+        if grad_accum_steps > 1:
+            loss, acc, new_bs, grads = accumulate_grads(
+                model, state, images, labels, dropout_rng, grad_accum_steps)
+        else:
+            loss, acc, new_bs, grads = forward_and_grads(
+                model, state, images, labels, dropout_rng)
         # THE collective: gradient averaging across the data axis
         # (hvd.DistributedOptimizer role, reference :302).
         grads = lax.pmean(grads, axis_name)

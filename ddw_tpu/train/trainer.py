@@ -136,7 +136,8 @@ class Trainer:
             (self.data_cfg.img_height, self.data_cfg.img_width, self.data_cfg.channels),
             rng,
         )
-        train_step = make_train_step(self.model, tx, self.mesh, cfg.data_axis)
+        train_step = make_train_step(self.model, tx, self.mesh, cfg.data_axis,
+                                     grad_accum_steps=cfg.grad_accum_steps)
         eval_step = make_eval_step(self.model, self.mesh, cfg.data_axis)
 
         ckpt = CheckpointManager(cfg.checkpoint_dir) if cfg.checkpoint_dir else None
@@ -197,15 +198,20 @@ class Trainer:
                 # (including plateau reductions) — don't clobber it; the plateau/
                 # early-stop counters were restored from checkpoint metadata above.
                 state = set_lr(state, warmup.lr_for_epoch(cfg.warmup_epochs))
+            in_warmup = lambda e: e < cfg.warmup_epochs and warmup.world_size > 1  # noqa: E731
             for epoch in range(start_epoch, cfg.epochs):
-                if epoch < cfg.warmup_epochs:
-                    state = set_lr(state, warmup.lr_for_epoch(epoch))
                 if cfg.trace_dir and epoch == start_epoch and jax.process_index() == 0:
                     jax.profiler.start_trace(cfg.trace_dir)
                     tracing = True
                 t0 = time.time()
                 losses, accs = [], []
-                for _ in range(steps_per_epoch):
+                for step_i in range(steps_per_epoch):
+                    if in_warmup(epoch):
+                        # Per-batch gradual LR scaling (Goyal et al.), the Horovod
+                        # warmup-callback granularity (reference :314-318). set_lr is
+                        # a dynamic-hyperparameter write — no recompilation.
+                        state = set_lr(
+                            state, warmup.lr_for_step(epoch, step_i, steps_per_epoch))
                     images, labels = next(train_iter)
                     state, metrics = train_step(state, images, labels, step_rng)
                     losses.append(metrics["loss"])

@@ -97,6 +97,11 @@ class TrainCfg:
     plateau_factor: float = 0.5
     early_stop_patience: int = 0        # 0 = disabled; pyfunc notebook uses 3
     seed: int = 0
+    grad_accum_steps: int = 1           # >1: split each per-worker batch into N
+                                        # sequential microbatches inside the jitted
+                                        # step (lax.scan), accumulating gradients —
+                                        # same optimizer math, 1/N activation
+                                        # memory; batches far beyond HBM fit.
     data_axis: str = "data"             # mesh axis name for DP psum
     num_devices: int = 0                # 0 = all visible devices
     checkpoint_dir: str = ""            # "" = no per-epoch checkpoints

@@ -288,3 +288,22 @@ def test_decode_overflow_poisons_output():
     logits, _ = model.apply({"params": params, "cache": cache}, tok,
                             mutable=["cache"])
     assert np.isnan(np.asarray(logits)).all()
+
+
+def test_lm_grad_accum_equivalence():
+    """grad_accum_steps=2 == one full-batch LM step (dropout off, SGD so the
+    update is linear in the gradients)."""
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, 2),)), devices=jax.devices()[:2])
+    model = tiny_lm()
+    tx = optax.sgd(1e-1)
+    state0 = init_lm_state(model, tx, jax.random.PRNGKey(2))
+    step1 = make_lm_train_step(model, tx, mesh, seq_axis=None, donate=False)
+    step2 = make_lm_train_step(model, tx, mesh, seq_axis=None, donate=False,
+                               grad_accum_steps=2)
+    rng = np.random.RandomState(4)
+    inputs, targets = make_batch(rng, batch=8, seq=32)
+    s1, m1 = step1(state0, inputs, targets, jax.random.PRNGKey(5))
+    s2, m2 = step2(state0, inputs, targets, jax.random.PRNGKey(5))
+    assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -77,3 +77,24 @@ def test_early_stopping(small_cfgs, silver, tmp_path):
                      early_stop_patience=1, learning_rate=0.0)  # no learning => stop
     res = tr.fit(train_tbl, val_tbl)
     assert res.epochs_run < 10
+
+
+def test_warmup_ramps_per_batch():
+    """Horovod's LearningRateWarmupCallback ramps per *batch* (reference
+    03_model_training_distributed.py:314-318); lr_for_step must be strictly
+    increasing across batches inside the warmup window and hit base*world at
+    the last warmup batch."""
+    from ddw_tpu.train.callbacks import LRWarmup
+
+    w = LRWarmup(base_lr=1e-3, world_size=8, warmup_epochs=2)
+    steps = 5
+    seq = [w.lr_for_step(e, s, steps) for e in range(3) for s in range(steps)]
+    ramp, after = seq[: 2 * steps], seq[2 * steps:]
+    assert all(b > a for a, b in zip(ramp, ramp[1:]))  # strictly increasing
+    assert ramp[-1] == pytest.approx(8e-3)
+    assert all(v == pytest.approx(8e-3) for v in after)
+    # epoch-boundary values match the coarse schedule the history rows record
+    assert w.lr_for_step(0, steps - 1, steps) == pytest.approx(w.lr_for_epoch(0))
+    # world 1: no ramp, constant base
+    w1 = LRWarmup(base_lr=1e-3, world_size=1, warmup_epochs=2)
+    assert w1.lr_for_step(0, 0, steps) == pytest.approx(1e-3)
