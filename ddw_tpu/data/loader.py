@@ -174,6 +174,13 @@ class ShardedLoader:
         self.prefetch_to = prefetch_to
         self.skip_records = skip_records
 
+        # Cached-feature table (train.transfer.materialize_features): content is
+        # the frozen backbone's pooled feature vector (f32 bytes); batches are
+        # (B, feature_dim) — the loader feeds a head-only model.
+        self._feature_dim = (table.meta.get("feature_dim")
+                             if table.meta.get("encoding") == "features_f32"
+                             else None)
+
         # Pre-decoded table (prep.materialize_decoded): content is raw uint8
         # [H, W, 3] pixels; batches come from a memcpy + scale, no JPEG work.
         self._raw_u8 = table.meta.get("encoding") == "raw_u8"
@@ -273,6 +280,22 @@ class ShardedLoader:
 
     def _iter_batches(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         from ddw_tpu.native.decode import decode_batch_native, native_available
+
+        if self._feature_dim:
+            # Cached-feature fast path: batches are (B, D) f32 vectors — a
+            # memcpy per record, no image work at all.
+            d = self._feature_dim
+            feats = np.empty((self.batch_size, d), np.float32)
+            flbls = np.empty((self.batch_size,), np.int32)
+            i = 0
+            for content, label_idx in self._iter_raw_resumed():
+                feats[i] = np.frombuffer(content, np.float32, count=d)
+                flbls[i] = label_idx
+                i += 1
+                if i == self.batch_size:
+                    yield feats.copy(), flbls.copy()
+                    i = 0
+            return  # drop remainder: static shapes for XLA
 
         imgs = np.empty((self.batch_size, self.height, self.width, 3), np.float32)
         lbls = np.empty((self.batch_size,), np.int32)

@@ -1,3 +1,8 @@
 from ddw_tpu.train.step import TrainState, make_optimizer, make_train_step, make_eval_step, init_state  # noqa: F401
 from ddw_tpu.train.trainer import Trainer, TrainResult  # noqa: F401
 from ddw_tpu.train.callbacks import LRWarmup, ReduceLROnPlateau, EarlyStopping  # noqa: F401
+from ddw_tpu.train.transfer import (  # noqa: F401
+    TransferHead,
+    materialize_features,
+    train_frozen_via_features,
+)

@@ -68,7 +68,19 @@ class Trainer:
         train_cfg: TrainCfg,
         mesh=None,
         run: Run | None = None,
+        model=None,
+        initial=None,
+        on_epoch=None,
     ):
+        """``model`` overrides the registry module (e.g. a
+        :class:`ddw_tpu.train.transfer.TransferHead` trained on a cached-feature
+        table); ``initial=(state, tx)`` supplies a pre-built TrainState +
+        optimizer instead of ``init_state`` (the override pair the
+        cached-feature path uses — the head starts from the full model's init).
+        ``on_epoch(row)`` is called after each epoch's metrics/callbacks with
+        the history row; returning True stops training, and exceptions
+        propagate out of ``fit`` (how HPO pruners abort a trial —
+        ``ddw_tpu.tune.pruner``)."""
         self.data_cfg = data_cfg
         self.model_cfg = model_cfg
         self.train_cfg = train_cfg
@@ -79,7 +91,9 @@ class Trainer:
             mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)), devices=devices)
         self.mesh = mesh
         self.run = run
-        self.model = build_model(model_cfg)
+        self.model = model if model is not None else build_model(model_cfg)
+        self._initial = initial
+        self._on_epoch = on_epoch
 
     # -- sizing ---------------------------------------------------------------
     @property
@@ -130,12 +144,15 @@ class Trainer:
         cfg = self.train_cfg
         world = self.world_size
 
-        rng = jax.random.PRNGKey(cfg.seed)
-        state, tx = init_state(
-            self.model, self.model_cfg, cfg,
-            (self.data_cfg.img_height, self.data_cfg.img_width, self.data_cfg.channels),
-            rng,
-        )
+        if self._initial is not None:
+            state, tx = self._initial
+        else:
+            rng = jax.random.PRNGKey(cfg.seed)
+            state, tx = init_state(
+                self.model, self.model_cfg, cfg,
+                (self.data_cfg.img_height, self.data_cfg.img_width, self.data_cfg.channels),
+                rng,
+            )
         train_step = make_train_step(self.model, tx, self.mesh, cfg.data_axis,
                                      grad_accum_steps=cfg.grad_accum_steps)
         eval_step = make_eval_step(self.model, self.mesh, cfg.data_axis)
@@ -258,6 +275,8 @@ class Trainer:
                     if new_lr != lr:
                         state = set_lr(state, new_lr)
                 stop = early is not None and early.should_stop(val_loss)
+                if self._on_epoch is not None and self._on_epoch(row):
+                    stop = True
 
                 # Checkpoint AFTER the callbacks consumed this epoch's metrics,
                 # so the saved counters (and any plateau LR cut) are exactly the

@@ -18,15 +18,33 @@ from ddw_tpu.train.trainer import Trainer
 
 
 def main():
-    args = parse_args(__doc__)
+    args = parse_args(__doc__, extra=lambda ap: ap.add_argument(
+        "--cache-features", action="store_true",
+        help="frozen-transfer fast path: run the frozen backbone ONCE over the "
+             "dataset (features cached in the table store, fingerprint-fenced), "
+             "then train only the head — epochs cost head-FLOPs instead of "
+             "backbone-FLOPs (ddw_tpu.train.transfer)"))
     ws = setup(args)
     cfgs = ws["cfgs"]
     train_tbl, val_tbl = require_tables(ws["store"], ws["cfgs"]["data"])
 
     mesh = make_mesh(MeshSpec(((DATA_AXIS, 1),)), devices=jax.devices()[:1])
     run = ws["tracker"].start_run("single_node")
-    trainer = Trainer(cfgs["data"], cfgs["model"], cfgs["train"], mesh=mesh, run=run)
-    res = trainer.fit(train_tbl, val_tbl)
+    if args.cache_features:
+        from ddw_tpu.train.transfer import train_frozen_via_features
+
+        mcfg = cfgs["model"]
+        if mcfg.name == "small_cnn":  # --quick default has no backbone/head split
+            mcfg.name, mcfg.width_mult = "mobilenet_v2", 0.35
+        mcfg.freeze_base = True
+        if not mcfg.pretrained_path:
+            mcfg.allow_frozen_random = True  # demo without the ImageNet artifact
+        res = train_frozen_via_features(cfgs["data"], mcfg, cfgs["train"],
+                                        train_tbl, val_tbl, ws["store"],
+                                        mesh=mesh, run=run)
+    else:
+        trainer = Trainer(cfgs["data"], cfgs["model"], cfgs["train"], mesh=mesh, run=run)
+        res = trainer.fit(train_tbl, val_tbl)
     run.end()
     for row in res.history:
         print({k: round(v, 4) if isinstance(v, float) else v for k, v in row.items()})

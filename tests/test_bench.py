@@ -40,7 +40,8 @@ def test_headline_contract(bench_json):
 
 def test_matrix_rows(bench_json):
     configs = bench_json["configs"]
-    for name in ("mobilenet_v2_frozen", "mobilenet_v2_unfrozen", "resnet50",
+    for name in ("mobilenet_v2_frozen", "mobilenet_v2_frozen_feature_cache",
+                 "mobilenet_v2_unfrozen", "resnet50",
                  "vit", "lm_flash", "lm_moe"):
         row = configs[name]
         assert "error" not in row, f"{name}: {row}"
@@ -54,12 +55,17 @@ def test_matrix_rows(bench_json):
 
 
 def test_flops_ordering(bench_json):
-    """Unfrozen backward must cost more FLOPs than frozen (backbone skipped)."""
+    """Unfrozen backward must cost more FLOPs than frozen (backbone skipped),
+    and the cached-feature head step must cost far less than either (the whole
+    backbone forward is gone)."""
     c = bench_json["configs"]
     fro = c["mobilenet_v2_frozen"]["step_flops"]
     unf = c["mobilenet_v2_unfrozen"]["step_flops"]
+    head = c["mobilenet_v2_frozen_feature_cache"]["step_flops"]
     if fro and unf:
         assert unf > fro * 1.5
+    if fro and head:
+        assert head < fro / 50
 
 
 def test_host_pipeline(bench_json):

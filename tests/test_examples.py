@@ -18,6 +18,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXAMPLES = [
     ("01_data_prep.py", [], "silver_train"),
     ("02_train_single_node.py", ["train.epochs=1"], "val_accuracy"),
+    ("02_train_single_node.py",
+     ["--cache-features", "train.epochs=1"], "val_accuracy"),
     ("03_train_distributed.py", ["train.epochs=1"], "world=8"),
     ("04_hyperopt_parallel.py",
      ["tune.max_evals=2", "tune.parallelism=2", "train.epochs=1"], "best"),

@@ -104,3 +104,190 @@ def test_frozen_pretrained_beats_frozen_random(tmp_path):
         f"frozen-pretrained {tuned_acc:.3f} must beat frozen-random "
         f"{random_acc:.3f} decisively")
     assert tuned_acc > 0.6
+
+
+# ---------------------------------------------------------------------------
+# Cached-feature transfer (train.transfer): featurize once, train the head
+# ---------------------------------------------------------------------------
+
+def _jpeg_table(store, name: str, n: int, seed: int = 0):
+    import io
+
+    from PIL import Image
+
+    from ddw_tpu.data.store import Record
+
+    rng = np.random.RandomState(seed)
+    recs = []
+    for i in range(n):
+        cls = i % N_CLASSES
+        arr = np.clip(rng.randint(0, 100, (HW, HW, 3)) + cls * 30,
+                      0, 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, "JPEG")
+        recs.append(Record(f"{name}/{i}.jpg", buf.getvalue(), str(cls), cls))
+    return store.write(
+        name, iter(recs),
+        meta={"label_to_idx": {str(c): c for c in range(N_CLASSES)}})
+
+
+def _frozen_cfg(**kw):
+    base = dict(name="mobilenet_v2", num_classes=N_CLASSES, dropout=0.5,
+                width_mult=0.35, dtype="float32", freeze_base=True,
+                allow_frozen_random=True)
+    base.update(kw)
+    return ModelCfg(**base)
+
+
+def test_feature_cache_roundtrip_reuse_and_stale_rejection(tmp_path):
+    """materialize_features: every record featurized (no drop-remainder), the
+    cache is reused on identical backbone+source, and recomputed when the
+    backbone weights change (fingerprint fence)."""
+    import warnings
+
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.train.transfer import materialize_features
+
+    store = TableStore(str(tmp_path / "tables"))
+    tbl = _jpeg_table(store, "silver", n=21)  # 21: forces a padded final batch
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = build_model(_frozen_cfg())
+    tcfg = TrainCfg(batch_size=4)
+    state, _ = init_state(model, _frozen_cfg(), tcfg, (HW, HW, 3),
+                          jax.random.PRNGKey(0))
+
+    ft = materialize_features(model, state.params, state.batch_stats, tbl,
+                              store, "feat", (HW, HW), batch_size=8)
+    assert ft.num_records == 21
+    assert ft.meta["encoding"] == "features_f32"
+    dim = ft.meta["feature_dim"]
+
+    # cached features match a direct backbone+GAP forward
+    from ddw_tpu.data.loader import preprocess_image
+    from ddw_tpu.train.transfer import _pooled_feature_fn
+
+    rec = next(tbl.iter_records())
+    direct = _pooled_feature_fn(model)(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.asarray(preprocess_image(rec.content, HW, HW)[None]))
+    cached = np.frombuffer(next(ft.iter_records()).content, np.float32)
+    np.testing.assert_allclose(np.asarray(direct)[0], cached,
+                               rtol=1e-5, atol=1e-7)
+
+    # identical backbone + source -> reuse, no new version
+    ft2 = materialize_features(model, state.params, state.batch_stats, tbl,
+                               store, "feat", (HW, HW), batch_size=8)
+    assert ft2.manifest["version"] == ft.manifest["version"]
+
+    # perturbed backbone -> fingerprint mismatch -> recompute
+    bumped = jax.tree.map(lambda x: x + 1e-3, state.params)
+    ft3 = materialize_features(model, bumped, state.batch_stats, tbl,
+                               store, "feat", (HW, HW), batch_size=8)
+    assert ft3.manifest["version"] != ft.manifest["version"]
+    assert dim == ft3.meta["feature_dim"]
+
+    # changed input resolution -> stale (same weights, same source!)
+    ft4 = materialize_features(model, bumped, state.batch_stats, tbl,
+                               store, "feat", (HW * 2, HW * 2), batch_size=8)
+    assert ft4.manifest["version"] != ft3.manifest["version"]
+    assert ft4.meta["image_height"] == HW * 2
+
+    # feature loader: (B, D) batches, deterministic unshuffled order
+    from ddw_tpu.data.loader import ShardedLoader
+
+    ld = ShardedLoader(ft, batch_size=7, image_size=(HW, HW), shuffle=False,
+                       num_epochs=1)
+    batches = list(ld)
+    assert len(batches) == 3 and batches[0][0].shape == (7, dim)
+    np.testing.assert_array_equal(batches[0][0][0], cached)
+
+
+def test_head_on_features_matches_frozen_full_step(tmp_path):
+    """One head-only train step on cached features == one frozen full-model
+    step: same loss, same updated head params (dropout ACTIVE — both paths
+    fold the same rng stream; SGD so updates are linear in grads)."""
+    import warnings
+
+    import optax
+
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.train.step import TrainState
+    from ddw_tpu.train.transfer import TransferHead, materialize_features
+    from ddw_tpu.data.loader import ShardedLoader
+
+    store = TableStore(str(tmp_path / "tables"))
+    tbl = _jpeg_table(store, "silver", n=16)
+    cfg = _frozen_cfg()
+    tcfg = TrainCfg(batch_size=8, optimizer="sgd", learning_rate=1e-2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        full = build_model(cfg)
+    full_state, full_tx = init_state(full, cfg, tcfg, (HW, HW, 3),
+                                     jax.random.PRNGKey(3))
+    ft = materialize_features(full, full_state.params, full_state.batch_stats,
+                              tbl, store, "feat", (HW, HW), batch_size=8)
+
+    mesh = make_mesh(MeshSpec((("data", 1),)), devices=jax.devices()[:1])
+    # full-model step on the first 8 images
+    img_loader = ShardedLoader(tbl, batch_size=8, image_size=(HW, HW),
+                               shuffle=False, num_epochs=1)
+    images, labels = next(iter(img_loader))
+    full_step = make_train_step(full, full_tx, mesh, donate=False)
+    key = jax.random.PRNGKey(9)
+    s_full, m_full = full_step(full_state, jnp.asarray(images),
+                               jnp.asarray(labels), key)
+
+    # head step on the same batch's cached features
+    head = TransferHead(N_CLASSES, cfg.dropout)
+    from ddw_tpu.train.step import make_optimizer
+
+    head_params = {"head": full_state.params["head"]}
+    head_tx = make_optimizer(tcfg)
+    head_state = TrainState(head_params, {}, head_tx.init(head_params),
+                            jnp.zeros((), jnp.int32))
+    feat_loader = ShardedLoader(ft, batch_size=8, image_size=(HW, HW),
+                                shuffle=False, num_epochs=1)
+    feats, flabels = next(iter(feat_loader))
+    np.testing.assert_array_equal(labels, flabels)
+    head_step = make_train_step(head, head_tx, mesh, donate=False)
+    s_head, m_head = head_step(head_state, jnp.asarray(feats),
+                               jnp.asarray(flabels), key)
+
+    assert abs(float(m_full["loss"]) - float(m_head["loss"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(s_full.params["head"]),
+                    jax.tree.leaves(s_head.params["head"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_train_frozen_via_features_end_to_end(tmp_path):
+    """The high-level flow: full param tree comes back (packaging-ready), the
+    cache is reused across calls, and unfrozen configs are rejected."""
+    import warnings
+
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.train.transfer import train_frozen_via_features
+    from ddw_tpu.utils.config import DataCfg
+
+    store = TableStore(str(tmp_path / "tables"))
+    tbl_t = _jpeg_table(store, "silver_train", n=32)
+    tbl_v = _jpeg_table(store, "silver_val", n=16, seed=5)
+    dcfg = DataCfg(img_height=HW, img_width=HW)
+    tcfg = TrainCfg(batch_size=8, epochs=2, warmup_epochs=0, num_devices=1,
+                    learning_rate=1e-2)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        res = train_frozen_via_features(dcfg, _frozen_cfg(), tcfg,
+                                        tbl_t, tbl_v, store)
+        assert set(res.state.params) == {"backbone", "head"}
+        assert res.epochs_run == 2
+
+        v_before = store.table("silver_train_feat_train").manifest["version"]
+        train_frozen_via_features(dcfg, _frozen_cfg(), tcfg, tbl_t, tbl_v, store)
+        assert store.table("silver_train_feat_train").manifest["version"] == v_before
+
+    with pytest.raises(ValueError, match="freeze_base=True"):
+        train_frozen_via_features(dcfg, _frozen_cfg(freeze_base=False), tcfg,
+                                  tbl_t, tbl_v, store)
