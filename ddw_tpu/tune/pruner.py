@@ -1,0 +1,97 @@
+"""Trial pruning — early-stop hopeless HPO trials on intermediate metrics.
+
+Beyond the Hyperopt parity contract (hyperopt has no pruning; every trial runs
+its full budget — the reference's 20-eval search at
+``01_hyperopt_single_machine_model.py:226-238`` pays full training cost for
+every config, good or bad). The median rule here is the standard one
+(popularized by Google Vizier and Optuna's ``MedianPruner``): at each
+reporting step, a trial whose intermediate objective is worse than the median
+of what other trials reported at the same step is stopped.
+
+Protocol: pruning-aware objectives accept ``(params, trial)`` and call
+``trial.report(step, value)`` once per epoch (typically via
+``Trainer(..., on_epoch=...)``); ``report`` raises :class:`Pruned` when the
+rule fires, ``fmin`` records the trial with ``STATUS_PRUNED`` and moves on.
+Pruned trials never enter the TPE good/bad split (``Trials.completed`` filters
+on ``STATUS_OK``) — a half-trained loss is not comparable to a final one.
+
+Thread-safe: parallel ``fmin`` reports from worker threads concurrently.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+STATUS_PRUNED = "pruned"
+
+
+class Pruned(Exception):
+    """A pruner decided this trial is not worth finishing."""
+
+    def __init__(self, step: int, value: float):
+        super().__init__(f"pruned at step {step} (value {value:g})")
+        self.step = step
+        self.value = value
+
+
+class Trial:
+    """Per-trial reporting handle handed to pruning-aware objectives."""
+
+    def __init__(self, pruner: "MedianPruner", trial_id: int, params: dict):
+        self._pruner = pruner
+        self.trial_id = trial_id
+        self.params = params
+
+    def report(self, step: int, value: float) -> None:
+        """Record an intermediate objective value (lower is better, same
+        orientation as the trial loss). Raises :class:`Pruned` when the rule
+        says stop."""
+        if self._pruner.should_prune(self.trial_id, step, float(value)):
+            raise Pruned(step, float(value))
+
+
+class MedianPruner:
+    """Median rule with warmup: at reporting step ``s``, prune when the
+    trial's value is strictly worse than the median of all OTHER trials'
+    values at the same step.
+
+    ``warmup_steps``: never prune at steps below this (early epochs are noisy).
+    ``min_trials``: need at least this many other trials reporting at the step
+    before the median is trusted.
+    """
+
+    def __init__(self, warmup_steps: int = 1, min_trials: int = 3):
+        self.warmup_steps = warmup_steps
+        self.min_trials = min_trials
+        self._lock = threading.Lock()
+        self._history: dict[int, dict[int, float]] = {}
+        self._next_id = 0
+
+    def make_trial(self, params: dict) -> Trial:
+        with self._lock:
+            tid = self._next_id
+            self._next_id += 1
+            self._history[tid] = {}
+        return Trial(self, tid, params)
+
+    def should_prune(self, trial_id: int, step: int, value: float) -> bool:
+        if not math.isfinite(value):
+            # A NaN/inf objective never recovers — prune unconditionally
+            # (warmup/min-trial guards exist for noisy-but-finite curves).
+            # NaN must also never enter the history: `nan > median` is False
+            # and a NaN at the median index would disable pruning for peers.
+            return True
+        with self._lock:
+            self._history[trial_id][step] = value
+            if step < self.warmup_steps:
+                return False
+            others = [h[step] for tid, h in self._history.items()
+                      if tid != trial_id and step in h]
+            if len(others) < self.min_trials:
+                return False
+            others.sort()
+            n = len(others)
+            median = (others[n // 2] if n % 2
+                      else 0.5 * (others[n // 2 - 1] + others[n // 2]))
+            return value > median

@@ -179,7 +179,7 @@ def suggest(space: dict[str, Dim], trials: Trials, rng: np.random.RandomState,
 # ---------------------------------------------------------------------------
 
 def fmin(
-    objective: Callable[[dict], dict | float],
+    objective: Callable[..., dict | float],
     space: dict[str, Dim],
     max_evals: int = 20,
     algo: str = "tpe",
@@ -188,12 +188,20 @@ def fmin(
     seed: int = 0,
     n_startup_trials: int = 5,
     gamma: float = 0.25,
+    pruner=None,
 ) -> dict[str, Any]:
     """Minimize ``objective`` over ``space``; returns the best param dict.
 
     ``objective`` returns ``{'loss': float, 'status': STATUS_OK, ...}`` (hyperopt
     contract; a bare float is accepted too). A raised exception records a failed
     trial (STATUS_FAIL) and the search continues.
+
+    ``pruner`` (e.g. :class:`ddw_tpu.tune.pruner.MedianPruner`) enables
+    early-stopping of hopeless trials — beyond the hyperopt contract. With a
+    pruner set, the objective is called as ``objective(params, trial)`` and
+    should call ``trial.report(step, value)`` per epoch; a fired rule raises
+    ``Pruned``, the trial records as ``STATUS_PRUNED``, and the search
+    continues (pruned trials never enter the TPE good/bad split).
     """
     trials = trials if trials is not None else Trials()
     rng = np.random.RandomState(seed)
@@ -205,8 +213,13 @@ def fmin(
                        pending=pending)
 
     def run_one(params: dict) -> None:
+        from ddw_tpu.tune.pruner import Pruned, STATUS_PRUNED
+
         try:
-            res = objective(params)
+            if pruner is not None:
+                res = objective(params, pruner.make_trial(params))
+            else:
+                res = objective(params)
             if isinstance(res, (int, float)):
                 res = {"loss": float(res), "status": STATUS_OK}
             if res.get("status", STATUS_OK) == STATUS_OK:
@@ -214,6 +227,9 @@ def fmin(
                               {k: v for k, v in res.items() if k not in ("loss", "status")})
             else:
                 trials.record(params, None, res.get("status", STATUS_FAIL))
+        except Pruned as p:
+            trials.record(params, None, STATUS_PRUNED,
+                          {"pruned_at": p.step, "last_value": p.value})
         except Exception as e:  # failed trial, keep searching
             trials.record(params, None, STATUS_FAIL, {"error": repr(e)})
 

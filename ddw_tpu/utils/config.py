@@ -149,6 +149,12 @@ class TuneCfg:
     algo: str = "tpe"                   # tpe | random
     n_startup_trials: int = 5           # random trials before TPE kicks in
     gamma: float = 0.25                 # TPE good/bad split quantile
+    prune: bool = False                 # median-rule trial pruning (beyond
+                                        # hyperopt): stop trials whose per-epoch
+                                        # val_loss is worse than the median of
+                                        # other trials at the same epoch
+    prune_warmup_epochs: int = 1        # never prune below this epoch
+    prune_min_trials: int = 3           # peers needed before the median is trusted
 
 
 _TYPES = {"data": DataCfg, "model": ModelCfg, "train": TrainCfg, "tune": TuneCfg,

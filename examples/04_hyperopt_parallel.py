@@ -49,7 +49,16 @@ def main():
 
     parent = ws["tracker"].start_run("hyperopt_parallel")
 
-    def objective(params):
+    pruner = None
+    if tune_cfg.prune:
+        # Median-rule pruning (beyond hyperopt): per-epoch val_loss reported
+        # through Trainer's on_epoch hook; hopeless trials stop early.
+        from ddw_tpu.tune import MedianPruner
+
+        pruner = MedianPruner(tune_cfg.prune_warmup_epochs,
+                              tune_cfg.prune_min_trials)
+
+    def objective(params, trial=None):
         with slot_lock:
             slot = free_slots.pop()
         try:
@@ -63,8 +72,17 @@ def main():
             mesh = make_mesh(MeshSpec(((DATA_AXIS, 1),)), devices=[devices[slot]])
             run = ws["tracker"].start_run("trial", parent_run_id=parent.run_id)
             run.log_params(params)
-            trainer = Trainer(cfgs["data"], model_cfg, train_cfg, mesh=mesh, run=run)
-            res = trainer.fit(train_tbl, val_tbl)
+            on_epoch = (None if trial is None else
+                        lambda row: trial.report(row["epoch"], row["val_loss"]))
+            try:
+                trainer = Trainer(cfgs["data"], model_cfg, train_cfg, mesh=mesh,
+                                  run=run, on_epoch=on_epoch)
+                res = trainer.fit(train_tbl, val_tbl)
+            except Exception as e:
+                from ddw_tpu.tune import Pruned
+
+                run.end(status="PRUNED" if isinstance(e, Pruned) else "FAILED")
+                raise  # fmin records STATUS_PRUNED / STATUS_FAIL
             run.log_metric("final_val_accuracy", res.val_accuracy)
             run.end()
             # the reference minimizes -accuracy (:178-181)
@@ -78,7 +96,8 @@ def main():
     trials = Trials()
     best = fmin(objective, space, max_evals=tune_cfg.max_evals, algo=tune_cfg.algo,
                 parallelism=parallelism, trials=trials, seed=tune_cfg.seed,
-                n_startup_trials=tune_cfg.n_startup_trials, gamma=tune_cfg.gamma)
+                n_startup_trials=tune_cfg.n_startup_trials, gamma=tune_cfg.gamma,
+                pruner=pruner)
     parent.log_params({f"best.{k}": v for k, v in best.items()})
     parent.end()
     print(f"best params: {best}")
@@ -105,6 +124,12 @@ def main():
                                 metrics={"val_accuracy": best_trial["val_accuracy"]})
     ws["registry"].transition("flowers_classifier", v, "Production")
     print(f"registered flowers_classifier v{v} -> Production")
+
+    # static HTML report of the whole search (the MLflow-UI role):
+    # runs table with trials nested under the parent + per-metric charts
+    from ddw_tpu.tracking.report import write_report
+
+    print(f"report: {write_report(ws['tracker'].root, ws['tracker'].experiment)}")
 
 
 if __name__ == "__main__":

@@ -41,7 +41,17 @@ def main():
     parent = ws["tracker"].start_run("hyperopt_distributed")
     trial_no = {"n": 0}
 
-    def train_and_evaluate(params):
+    pruner = None
+    if tune_cfg.prune:
+        # Pruning pays off most here: every pruned epoch frees the WHOLE mesh.
+        # Sequential trials still benefit — the median compares against the
+        # curves of already-finished trials at the same epoch.
+        from ddw_tpu.tune import MedianPruner
+
+        pruner = MedianPruner(tune_cfg.prune_warmup_epochs,
+                              tune_cfg.prune_min_trials)
+
+    def train_and_evaluate(params, trial=None):
         """The train_and_evaluate_hvd(lr, dropout, batch_size, checkpoint_dir)
         analog (reference :161-262): whole-mesh DP training per trial."""
         trial_no["n"] += 1
@@ -54,8 +64,17 @@ def main():
         run = ws["tracker"].start_run(f"trial_{trial_no['n']:03d}",
                                       parent_run_id=parent.run_id)
         run.log_params(params)
-        trainer = Trainer(cfgs["data"], model_cfg, train_cfg, mesh=mesh, run=run)
-        res = trainer.fit(train_tbl, val_tbl)
+        on_epoch = (None if trial is None else
+                    lambda row: trial.report(row["epoch"], row["val_loss"]))
+        try:
+            trainer = Trainer(cfgs["data"], model_cfg, train_cfg, mesh=mesh,
+                              run=run, on_epoch=on_epoch)
+            res = trainer.fit(train_tbl, val_tbl)
+        except Exception as e:
+            from ddw_tpu.tune import Pruned
+
+            run.end(status="PRUNED" if isinstance(e, Pruned) else "FAILED")
+            raise  # fmin records STATUS_PRUNED / STATUS_FAIL
         run.log_metric("final_val_accuracy", res.val_accuracy)
         run.end()
         return {"loss": -res.val_accuracy, "status": STATUS_OK,
@@ -65,12 +84,17 @@ def main():
     best = fmin(train_and_evaluate, space, max_evals=tune_cfg.max_evals,
                 algo=tune_cfg.algo, parallelism=1,  # sequential: trials own the mesh
                 trials=trials, seed=tune_cfg.seed,
-                n_startup_trials=min(tune_cfg.n_startup_trials, tune_cfg.max_evals // 2 or 1))
+                n_startup_trials=min(tune_cfg.n_startup_trials, tune_cfg.max_evals // 2 or 1),
+                pruner=pruner)
     parent.log_params({f"best.{k}": v for k, v in best.items()})
     parent.end()
     print(f"best params: {best}")
     print(f"best val_accuracy: {trials.best['val_accuracy']:.4f}")
     print(f"per-trial checkpoints under {ckpt_root}")
+
+    from ddw_tpu.tracking.report import write_report
+
+    print(f"report: {write_report(ws['tracker'].root, ws['tracker'].experiment)}")
 
 
 if __name__ == "__main__":

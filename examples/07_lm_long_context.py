@@ -117,7 +117,8 @@ def main():
         model = build_lm(lm_cfg, seq_axis=seq_axis, expert_axis=expert_axis)
         tx = make_optimizer(train_cfg)
         state = init_lm_state(model, tx, jax.random.PRNGKey(train_cfg.seed))
-        step = make_lm_train_step(model, tx, mesh, seq_axis=seq_axis)
+        step = make_lm_train_step(model, tx, mesh, seq_axis=seq_axis,
+                                  grad_accum_steps=train_cfg.grad_accum_steps)
         eval_step = make_lm_eval_step(model, mesh, seq_axis=seq_axis)
 
     # global batch/seq: divisible by the mesh axes

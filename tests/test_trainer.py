@@ -71,6 +71,34 @@ def test_tracker_records_run(small_cfgs, silver, tmp_path):
     assert "images_per_sec" in got.final_metrics()
 
 
+def test_on_epoch_hook(small_cfgs, silver, tmp_path):
+    """on_epoch sees each history row; returning True stops training — the
+    HPO-pruner integration point (ddw_tpu.tune.pruner reports through it)."""
+    train_tbl, val_tbl, _ = silver
+    data, model, train = small_cfgs
+    train.epochs = 5
+    mesh = make_mesh(MeshSpec((("data", 8),)))
+
+    seen = []
+
+    def hook(row):
+        seen.append(row["epoch"])
+        assert "val_loss" in row
+        return row["epoch"] >= 1
+
+    res = Trainer(data, model, train, mesh=mesh, on_epoch=hook).fit(
+        train_tbl, val_tbl)
+    assert res.epochs_run == 2 and seen == [0, 1]
+
+    # exceptions propagate out of fit (how Pruned aborts a trial)
+    def bomb(row):
+        raise RuntimeError("prune this trial")
+
+    with pytest.raises(RuntimeError, match="prune this trial"):
+        Trainer(data, model, train, mesh=mesh, on_epoch=bomb).fit(
+            train_tbl, val_tbl)
+
+
 def test_early_stopping(small_cfgs, silver, tmp_path):
     train_tbl, val_tbl, _ = silver
     tr = _mk_trainer(small_cfgs, silver, tmp_path, epochs=10,

@@ -145,6 +145,60 @@ def test_pending_aware_suggest_avoids_inflight_point():
     assert near(liar) <= near(free) - 10, (near(liar), near(free))
 
 
+def test_median_pruner_stops_bad_trials():
+    """Trials whose learning curve sits above the median at a shared step get
+    STATUS_PRUNED and never reach full budget; good trials finish and the best
+    result is unaffected. Pruned trials stay out of the TPE completed() set."""
+    from ddw_tpu.tune.pruner import MedianPruner, STATUS_PRUNED
+
+    epochs_run = {"total": 0}
+
+    def objective(params, trial):
+        # curve: converges toward params["x"]; bad x => visibly worse curve
+        for epoch in range(10):
+            value = params["x"] + 1.0 / (epoch + 1)
+            trial.report(epoch, value)
+            epochs_run["total"] += 1
+        return {"loss": params["x"], "status": STATUS_OK}
+
+    t = Trials()
+    fmin(objective, {"x": uniform("x", 0.0, 1.0)}, max_evals=12, algo="random",
+         trials=t, seed=3, pruner=MedianPruner(warmup_steps=2, min_trials=3))
+    statuses = [r["status"] for r in t.results]
+    n_pruned = statuses.count(STATUS_PRUNED)
+    assert n_pruned >= 3, statuses                    # bad trials were stopped
+    assert statuses.count(STATUS_OK) >= 3
+    assert epochs_run["total"] < 12 * 10              # budget actually saved
+    pruned = [r for r in t.results if r["status"] == STATUS_PRUNED]
+    assert all("pruned_at" in r for r in pruned)
+    assert t.best is not None and t.best["status"] == STATUS_OK
+    assert all(r["status"] == STATUS_OK for r in t.completed())
+
+
+def test_median_pruner_warmup_and_min_trials_guards():
+    from ddw_tpu.tune.pruner import MedianPruner
+
+    p = MedianPruner(warmup_steps=2, min_trials=2)
+    t1, t2, t3 = (p.make_trial({}) for _ in range(3))
+    # below warmup: never prunes, however bad
+    assert not p.should_prune(t1.trial_id, 0, 0.1)
+    assert not p.should_prune(t2.trial_id, 0, 0.2)
+    assert not p.should_prune(t3.trial_id, 1, 99.0)
+    # at step 2 with only one OTHER reporter: min_trials=2 not met
+    assert not p.should_prune(t1.trial_id, 2, 0.1)
+    assert not p.should_prune(t3.trial_id, 2, 99.0)
+    # two others reported at step 2 -> median armed; worse-than-median prunes
+    assert not p.should_prune(t2.trial_id, 2, 0.2)   # t2 is fine (<= median)
+    t4 = p.make_trial({})
+    assert p.should_prune(t4.trial_id, 2, 50.0)      # above median(0.1, 0.2, 99)
+    # non-finite values prune unconditionally (even in warmup) and never
+    # enter the history to poison peers' medians
+    t5 = p.make_trial({})
+    assert p.should_prune(t5.trial_id, 0, float("nan"))
+    assert p.should_prune(t5.trial_id, 2, float("inf"))
+    assert not p.should_prune(t2.trial_id, 2, 0.2)   # median still finite
+
+
 def test_startup_rerolls_categorical_collision():
     from ddw_tpu.tune.tpe import suggest
 
