@@ -28,19 +28,17 @@ def _is_writer() -> bool:
     return jax.process_index() == 0
 
 
-def save_checkpoint(ckpt_dir: str, state, step: int, metadata: dict | None = None, keep: int = 3) -> str | None:
-    """Write ``state`` at ``step``; rank-0 only (no-op elsewhere). Atomic via
-    tmp-dir + rename. Returns the checkpoint path on the writer, None elsewhere."""
-    if not _is_writer():
-        return None
+def _write_host_state(ckpt_dir: str, host_state, step: int,
+                      metadata: dict | None, keep: int) -> str:
+    """The pure host-side write: serialize + atomic rename + retention.
+    Runs on the caller's thread (sync mode) or the manager's writer thread
+    (async mode) — takes only host arrays, never device handles."""
     os.makedirs(ckpt_dir, exist_ok=True)
     final = os.path.join(ckpt_dir, f"step_{step:010d}")
     tmp = final + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    # Device arrays -> host before serializing.
-    host_state = jax.device_get(state)
     with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
         f.write(serialization.to_bytes(host_state))
     meta = {"step": step, "created_unix": time.time(), **(metadata or {})}
@@ -51,6 +49,15 @@ def save_checkpoint(ckpt_dir: str, state, step: int, metadata: dict | None = Non
     os.replace(tmp, final)
     _apply_retention(ckpt_dir, keep)
     return final
+
+
+def save_checkpoint(ckpt_dir: str, state, step: int, metadata: dict | None = None, keep: int = 3) -> str | None:
+    """Write ``state`` at ``step``; rank-0 only (no-op elsewhere). Atomic via
+    tmp-dir + rename. Returns the checkpoint path on the writer, None elsewhere."""
+    if not _is_writer():
+        return None
+    # Device arrays -> host before serializing.
+    return _write_host_state(ckpt_dir, jax.device_get(state), step, metadata, keep)
 
 
 def _apply_retention(ckpt_dir: str, keep: int) -> None:
@@ -92,24 +99,71 @@ def restore_checkpoint(ckpt_dir: str, target, step: int | None = None):
 
 
 class CheckpointManager:
-    """Convenience wrapper binding a directory + retention policy."""
+    """Convenience wrapper binding a directory + retention policy.
 
-    def __init__(self, ckpt_dir: str, keep: int = 3):
+    ``async_write=True`` (orbax-style): ``save`` fetches the state to host
+    synchronously (a consistent snapshot — training may donate/overwrite the
+    device buffers immediately after), then serializes + writes on a single
+    background thread, so msgpack encoding and disk IO overlap the next
+    epoch's compute instead of stalling the train loop. One write in flight
+    at a time — a new ``save`` first joins the previous one; every read-side
+    method joins too, and :meth:`wait` makes the last write durable (the
+    trainer calls it before returning). Background errors surface on the
+    next ``save``/``wait``.
+    """
+
+    def __init__(self, ckpt_dir: str, keep: int = 3, async_write: bool = False):
         self.ckpt_dir = ckpt_dir
         self.keep = keep
+        self._executor = None
+        self._pending = None
+        if async_write and _is_writer():
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-writer")
 
     def save(self, state, step: int, metadata: dict | None = None):
-        return save_checkpoint(self.ckpt_dir, state, step, metadata, self.keep)
+        if self._executor is None:
+            return save_checkpoint(self.ckpt_dir, state, step, metadata, self.keep)
+        self.wait()  # join (and surface errors from) the previous write
+        host_state = jax.device_get(state)  # snapshot before buffers mutate
+        # Deep-copy metadata too: the caller may reuse/mutate its dict before
+        # the writer thread serializes it.
+        import copy
+
+        self._pending = self._executor.submit(
+            _write_host_state, self.ckpt_dir, host_state, step,
+            copy.deepcopy(metadata), self.keep)
+        return os.path.join(self.ckpt_dir, f"step_{step:010d}")
+
+    def wait(self) -> None:
+        """Block until the in-flight async write (if any) is durable on disk;
+        re-raises any background write error."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def close(self) -> None:
+        """Join the in-flight write and release the writer thread. The manager
+        stays usable — subsequent saves fall back to synchronous writes."""
+        self.wait()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
 
     def restore(self, target, step: int | None = None):
+        self.wait()
         return restore_checkpoint(self.ckpt_dir, target, step)
 
     def latest_step(self):
+        self.wait()
         return latest_step(self.ckpt_dir)
 
     def read_metadata(self, step: int | None = None) -> dict | None:
         """The JSON metadata sidecar saved with a checkpoint (epoch, metrics,
         and the host-side callback counters a true resume needs)."""
+        self.wait()
         if step is None:
             step = self.latest_step()
             if step is None:

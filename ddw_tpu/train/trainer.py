@@ -157,7 +157,9 @@ class Trainer:
                                      grad_accum_steps=cfg.grad_accum_steps)
         eval_step = make_eval_step(self.model, self.mesh, cfg.data_axis)
 
-        ckpt = CheckpointManager(cfg.checkpoint_dir) if cfg.checkpoint_dir else None
+        ckpt = (CheckpointManager(cfg.checkpoint_dir,
+                                  async_write=cfg.async_checkpoint)
+                if cfg.checkpoint_dir else None)
         start_epoch = 0
         steps_per_epoch = max(1, train_table.num_records // (cfg.batch_size * world))
         val_steps = max(1, val_table.num_records // (cfg.batch_size * world))
@@ -292,4 +294,7 @@ class Trainer:
                 if stop:
                     break
 
+            if ckpt is not None:
+                # async mode: last checkpoint durable + writer thread released
+                ckpt.close()
             return TrainResult(val_loss, val_acc, history, state, epochs_run)

@@ -105,6 +105,10 @@ class TrainCfg:
     data_axis: str = "data"             # mesh axis name for DP psum
     num_devices: int = 0                # 0 = all visible devices
     checkpoint_dir: str = ""            # "" = no per-epoch checkpoints
+    async_checkpoint: bool = False      # serialize+write checkpoints on a
+                                        # background thread (device snapshot is
+                                        # still synchronous) so IO overlaps the
+                                        # next epoch's compute
     checkpoint_every_epochs: int = 1
     log_every_steps: int = 10
     trace_dir: str = ""                 # --trace flag role (jax.profiler), SURVEY §5

@@ -1,0 +1,83 @@
+"""CheckpointManager unit tests — sync/async write equivalence, durability
+barrier, error surfacing, retention. (The trainer-level resume contract lives
+in test_resume.py / test_trainer.py; this file pins the manager itself.)"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddw_tpu.checkpoint.ckpt import CheckpointManager
+from ddw_tpu.train.step import TrainState
+
+
+def _state(x: float) -> TrainState:
+    return TrainState({"w": jnp.full((4, 4), x)}, {}, (), jnp.asarray(7, jnp.int32))
+
+
+def test_async_save_matches_sync(tmp_path):
+    s = _state(1.5)
+    sync = CheckpointManager(str(tmp_path / "sync"))
+    asyn = CheckpointManager(str(tmp_path / "async"), async_write=True)
+    sync.save(s, 10, metadata={"epoch": 1})
+    asyn.save(s, 10, metadata={"epoch": 1})
+    asyn.wait()
+
+    assert sync.latest_step() == asyn.latest_step() == 10
+    a, astep = asyn.restore(_state(0.0))
+    b, bstep = sync.restore(_state(0.0))
+    assert astep == bstep == 10
+    np.testing.assert_array_equal(np.asarray(a.params["w"]),
+                                  np.asarray(b.params["w"]))
+    assert asyn.read_metadata(10)["epoch"] == 1
+    with open(os.path.join(str(tmp_path / "sync"), "step_0000000010",
+                           "state.msgpack"), "rb") as f1, \
+         open(os.path.join(str(tmp_path / "async"), "step_0000000010",
+                           "state.msgpack"), "rb") as f2:
+        assert f1.read() == f2.read()  # byte-identical serialization
+
+
+def test_async_snapshot_is_consistent(tmp_path):
+    """The device->host fetch happens inside save(); mutating (donating) the
+    state afterwards must not corrupt the written checkpoint."""
+    mgr = CheckpointManager(str(tmp_path), async_write=True)
+    s = _state(2.0)
+    mgr.save(s, 1)
+    del s  # buffers may be reused immediately in donated steps
+    mgr.save(_state(-1.0), 2)  # joins write 1 first, then snapshots
+    mgr.wait()
+    restored, step = mgr.restore(_state(0.0), step=1)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                  np.full((4, 4), 2.0, np.float32))
+
+
+def test_async_write_error_surfaces(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "f"), async_write=True)
+    mgr.save(_state(1.0), 1)
+    mgr.wait()
+    # unserializable leaf -> background write fails -> wait() re-raises
+    bad = TrainState({"w": object()}, {}, (), jnp.asarray(0, jnp.int32))
+    mgr.save(bad, 2)
+    with pytest.raises(Exception):
+        mgr.wait()
+    # manager still usable afterwards
+    mgr.save(_state(3.0), 3)
+    mgr.wait()
+    assert mgr.latest_step() == 3
+    # close releases the writer thread; saves fall back to sync and still work
+    mgr.close()
+    assert mgr._executor is None
+    mgr.save(_state(4.0), 4)
+    assert mgr.latest_step() == 4
+
+
+def test_retention_keeps_newest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2, async_write=True)
+    for i in range(1, 5):
+        mgr.save(_state(float(i)), i)
+    mgr.wait()
+    steps = sorted(int(d[len("step_"):]) for d in os.listdir(tmp_path)
+                   if d.startswith("step_") and not d.endswith(".tmp"))
+    assert steps == [3, 4]

@@ -1,6 +1,8 @@
 """Loader contract tests: shard selection, infinite repeat, static shapes,
 prefetch-to-device (the Petastorm make_tf_dataset semantics, SURVEY §2b.8)."""
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -108,6 +110,31 @@ def test_materialized_table_matches_silver(silver, store):
     for (gi, gl), (si, sl) in zip(gold_batches, silver_batches):
         np.testing.assert_array_equal(gl, sl)
         np.testing.assert_allclose(gi, si, atol=1.01 / 255)
+
+
+def test_raw_u8_device_dequant_matches_host(silver, store):
+    """Prefetching loader transfers uint8 + dequantizes ON DEVICE (4x smaller
+    host->HBM transfer); output must match the host-dequantized f32 batches to
+    1 ULP (XLA lowers /127.5 to multiply-by-reciprocal; numpy divides)."""
+    from ddw_tpu.data.prep import materialize_decoded
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+    from ddw_tpu.train.step import batch_sharding
+
+    train_tbl, _, _ = silver
+    gold = materialize_decoded(train_tbl, store, "gold_dev", 32, 32,
+                               shard_size=16)
+    mesh = make_mesh(MeshSpec((("data", 8),)))
+    sharding = batch_sharding(mesh, "data")
+    kw = dict(batch_size=8, image_size=(32, 32), shuffle=False)
+    host_batches = list(ShardedLoader(gold, num_epochs=1, **kw))
+    dev_batches = list(ShardedLoader(gold, num_epochs=1, prefetch_to=sharding,
+                                     **kw))
+    assert len(dev_batches) == len(host_batches) > 0
+    for (di, dl), (hi, hl) in zip(dev_batches, host_batches):
+        assert isinstance(di, jax.Array) and di.dtype == jnp.float32
+        assert di.sharding == sharding
+        np.testing.assert_array_equal(np.asarray(dl), hl)
+        np.testing.assert_allclose(np.asarray(di), hi, rtol=0, atol=2.4e-7)
 
 
 def test_materialized_table_size_mismatch_raises(silver, store):

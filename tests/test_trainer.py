@@ -55,6 +55,25 @@ def test_checkpoint_resume(small_cfgs, silver, tmp_path):
     assert int(jax.device_get(res2.state.step)) == 2 * steps_after_2
 
 
+def test_async_checkpoint_resume(small_cfgs, silver, tmp_path):
+    """async_checkpoint=True: background writes are durable by fit()'s return
+    (ckpt.wait barrier), and a resumed run continues from them."""
+    train_tbl, val_tbl, _ = silver
+    data, model, train = small_cfgs
+    train.checkpoint_dir = str(tmp_path / "ackpt")
+    tr = _mk_trainer((data, model, train), silver, tmp_path, epochs=2,
+                     async_checkpoint=True)
+    res = tr.fit(train_tbl, val_tbl)
+    steps_after_2 = int(jax.device_get(res.state.step))
+    from ddw_tpu.checkpoint.ckpt import latest_step
+
+    assert latest_step(train.checkpoint_dir) == steps_after_2
+    tr2 = _mk_trainer((data, model, train), silver, tmp_path, epochs=3,
+                      async_checkpoint=True)
+    res2 = tr2.fit(train_tbl, val_tbl, resume=True)
+    assert int(jax.device_get(res2.state.step)) == steps_after_2 * 3 // 2
+
+
 def test_tracker_records_run(small_cfgs, silver, tmp_path):
     train_tbl, val_tbl, _ = silver
     tracker = Tracker(str(tmp_path / "mlruns"), "exp")
