@@ -105,22 +105,53 @@ class Launcher:
                 )
                 procs.append(p)
             try:
-                # One shared deadline for the whole gang (not np * timeout), and
-                # kill every worker on any failure so a crashed rank can't leave
-                # the others orphaned in a collective.
+                # Failure detection (SURVEY §5): poll the whole gang and kill
+                # everyone the moment ANY rank dies abnormally — a crashed rank
+                # must not leave the others hanging in a collective until the
+                # deadline (the Spark-barrier all-or-nothing semantics the
+                # reference relies on, 03_model_training_distributed.py:256).
+                # One shared deadline for the whole gang (not np * timeout).
                 deadline = time.monotonic() + self.timeout_s
-                codes = []
-                for p in procs:
-                    remaining = max(0.1, deadline - time.monotonic())
-                    codes.append(p.wait(timeout=remaining))
+                codes: list[int | None] = [None] * self.np
+                while any(c is None for c in codes):
+                    for i, p in enumerate(procs):
+                        if codes[i] is None:
+                            codes[i] = p.poll()
+                    if any(c not in (None, 0) for c in codes):
+                        for p in procs:
+                            if p.poll() is None:
+                                p.kill()
+                        codes = [p.wait() for p in procs]
+                        raise RuntimeError(
+                            f"worker crashed (exit codes {codes}); gang killed"
+                            + self._rank0_error(result))
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"gang deadline ({self.timeout_s}s) exceeded; "
+                            f"exit codes so far {codes}; killing all workers")
+                    if any(c is None for c in codes):
+                        time.sleep(0.05)
             finally:
                 for p in procs:
                     if p.poll() is None:
                         p.kill()
-            if any(codes):
-                raise RuntimeError(f"launcher workers exited with codes {codes}")
+            # Reaching here means every worker exited 0.
             with open(result, "rb") as f:
                 status, value = pickle.load(f)
             if status == "error":
                 raise RuntimeError(f"rank-0 worker raised: {value}")
             return value
+
+    @staticmethod
+    def _rank0_error(result_path: str) -> str:
+        """Root cause for the crash message: if rank 0 got far enough to write
+        an error result before exiting nonzero, surface its traceback instead
+        of leaving only exit codes."""
+        try:
+            with open(result_path, "rb") as f:
+                status, value = pickle.load(f)
+            if status == "error":
+                return f"; rank-0 worker raised: {value}"
+        except Exception:
+            pass
+        return ""

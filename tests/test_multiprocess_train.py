@@ -50,6 +50,87 @@ def test_two_process_trainer_fit(silver, store, worker_pythonpath):
     assert np.isfinite(out["val_loss"]) and np.isfinite(out["val_accuracy"])
 
 
+def _crashing_fit_worker(table_root: str, ckpt_dir: str,
+                         crash_epoch: int, epochs: int,
+                         resume: bool = False) -> dict:
+    """Trains with per-epoch checkpoints; the NON-writer rank hard-exits at
+    ``crash_epoch`` (after a grace period so rank 0's checkpoint for that
+    epoch lands) — simulating a worker dying mid-job."""
+    import os
+    import time
+
+    import jax
+
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.train.trainer import Trainer
+    from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+    store = TableStore(table_root)
+    data = DataCfg(img_height=24, img_width=24, loader_workers=2,
+                   shuffle_buffer=32)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                     dtype="float32")
+    train = TrainCfg(batch_size=4, epochs=epochs, warmup_epochs=0, seed=0,
+                     learning_rate=1e-2, checkpoint_dir=ckpt_dir,
+                     checkpoint_every_epochs=1)
+
+    def crash_hook(row):
+        if (crash_epoch >= 0 and row["epoch"] == crash_epoch
+                and jax.process_index() == 1):
+            # Deterministic: rank 0 writes this epoch's checkpoint AFTER the
+            # on_epoch hook — wait (shared filesystem) until it lands, so the
+            # resume point is exactly the crash epoch regardless of load.
+            from ddw_tpu.checkpoint.ckpt import latest_step
+
+            before = latest_step(ckpt_dir)
+            deadline = time.monotonic() + 120
+            while latest_step(ckpt_dir) == before and time.monotonic() < deadline:
+                time.sleep(0.1)
+            os._exit(17)
+        return False
+
+    trainer = Trainer(data, model, train, on_epoch=crash_hook)
+    result = trainer.fit(store.table("silver_train"), store.table("silver_val"),
+                         resume=resume)
+    return {"epochs_run": result.epochs_run,
+            "step": int(jax.device_get(result.state.step)),
+            "val_loss": result.val_loss}
+
+
+def test_worker_crash_gang_kills_then_resume(silver, store, worker_pythonpath,
+                                             tmp_path):
+    """Failure recovery end-to-end (SURVEY §5): a rank dies mid-job, the
+    launcher detects it and kills the gang promptly (no deadline hang), and a
+    fresh gang resumes from the last checkpoint to completion."""
+    import time
+
+    import pytest
+
+    from ddw_tpu.checkpoint.ckpt import latest_step
+
+    del silver
+    ckpt_dir = str(tmp_path / "gang_ckpt")
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="crashed .* gang killed"):
+        Launcher(np=2, devices_per_proc=2, timeout_s=540).run(
+            functools.partial(_crashing_fit_worker, store.root, ckpt_dir,
+                              crash_epoch=1, epochs=4))
+    crash_wall = time.monotonic() - t0
+    assert crash_wall < 400, "gang kill must not wait for the full deadline"
+
+    # rank 0 checkpointed through the crash epoch before the gang died
+    ck = latest_step(ckpt_dir)
+    assert ck is not None and ck > 0
+
+    out = Launcher(np=2, devices_per_proc=2, timeout_s=540).run(
+        functools.partial(_crashing_fit_worker, store.root, ckpt_dir,
+                          crash_epoch=-1, epochs=4, resume=True))
+    assert out["epochs_run"] == 4
+    steps_per_epoch = ck // 2  # crash run completed epochs 0..1 = 2 epochs
+    assert out["step"] == 4 * steps_per_epoch
+    assert np.isfinite(out["val_loss"])
+
+
 def _score_worker(table_root: str, pkg_dir: str, out_root: str) -> dict:
     import jax
 
