@@ -259,15 +259,18 @@ def init_cache(decode_model: TransformerLM, batch: int):
 
 
 def generate(model: TransformerLM, params, prompt, num_steps: int,
-             rng: jax.Array | None = None, temperature: float = 0.0):
+             rng: jax.Array | None = None, temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 0.0):
     """Autoregressive continuation via the KV-cached decode path.
 
     ``prompt`` is int32 ``[B, P]``; returns ``[B, num_steps]`` continuation
     tokens. Greedy when ``temperature == 0``, else categorical sampling with
-    ``rng``. Total length ``P + num_steps`` must fit ``model.max_len``.
-    Prefill is one batched causal forward (bulk K/V cache write); decode is a
-    ``lax.scan`` with O(1) per-token cost against the static-shape cache —
-    the whole thing jits to one XLA program.
+    ``rng``; ``top_k > 0`` restricts sampling to the k highest logits and
+    ``top_p > 0`` to the smallest nucleus whose probability mass reaches p
+    (both masks compose: k first, then p). Total length ``P + num_steps``
+    must fit ``model.max_len``. Prefill is one batched causal forward (bulk
+    K/V cache write); decode is a ``lax.scan`` with O(1) per-token cost
+    against the static-shape cache — the whole thing jits to one XLA program.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, plen = prompt.shape
@@ -278,6 +281,13 @@ def generate(model: TransformerLM, params, prompt, num_steps: int,
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature != 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+    if (top_k or top_p) and temperature == 0.0:
+        raise ValueError("top_k/top_p require temperature > 0 (greedy decode "
+                         "ignores them silently otherwise)")
     dm = model.clone(decode=True, seq_axis=None, dropout=0.0)
     cache = init_cache(dm, b)
 
@@ -293,7 +303,22 @@ def generate(model: TransformerLM, params, prompt, num_steps: int,
     def pick(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k:
+            # keep the k highest logits per row; everything else -> -inf
+            kth = lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p:
+            # nucleus: smallest prefix of the sorted distribution with
+            # cumulative probability >= top_p stays; rest -> -inf
+            srt = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # number of kept entries: first index where cum >= p, inclusive
+            keep = jnp.sum((cum - probs) < top_p, axis=-1, keepdims=True)
+            cutoff = jnp.take_along_axis(srt, keep - 1, axis=-1)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     keys = (jax.random.split(rng, num_steps) if rng is not None
             else jnp.zeros((num_steps, 2), jnp.uint32))

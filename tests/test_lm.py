@@ -290,6 +290,49 @@ def test_decode_overflow_poisons_output():
     assert np.isnan(np.asarray(logits)).all()
 
 
+def test_generate_top_k_top_p():
+    """top_k=1 (or a vanishing nucleus) at ANY temperature must reproduce the
+    greedy continuation; top_k/top_p compose with sampling and error-check."""
+    from ddw_tpu.models.lm import generate
+
+    model = tiny_lm()
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 8), jnp.int32), train=False)["params"]
+    prompt = np.arange(8, dtype=np.int32)[None] % model.vocab_size
+    rng = jax.random.PRNGKey(7)
+
+    greedy = np.asarray(generate(model, params, prompt, num_steps=12))
+    k1 = np.asarray(generate(model, params, prompt, num_steps=12, rng=rng,
+                             temperature=5.0, top_k=1))
+    np.testing.assert_array_equal(k1, greedy)
+    p_tiny = np.asarray(generate(model, params, prompt, num_steps=12, rng=rng,
+                                 temperature=5.0, top_p=1e-9))
+    np.testing.assert_array_equal(p_tiny, greedy)
+
+    # full nucleus == plain categorical at the same key
+    plain = np.asarray(generate(model, params, prompt, num_steps=12, rng=rng,
+                                temperature=1.0))
+    p_full = np.asarray(generate(model, params, prompt, num_steps=12, rng=rng,
+                                 temperature=1.0, top_p=1.0))
+    np.testing.assert_array_equal(p_full, plain)
+
+    # composed sampling stays in-vocab and actually varies with the key
+    s1 = np.asarray(generate(model, params, prompt, num_steps=24, rng=rng,
+                             temperature=2.0, top_k=8, top_p=0.9))
+    s2 = np.asarray(generate(model, params, prompt, num_steps=24,
+                             rng=jax.random.PRNGKey(8),
+                             temperature=2.0, top_k=8, top_p=0.9))
+    assert s1.min() >= 0 and s1.max() < model.vocab_size
+    assert (s1 != s2).any()
+
+    with pytest.raises(ValueError, match="top_p must be in"):
+        generate(model, params, prompt, 4, rng=rng, temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError, match="top_k must be"):
+        generate(model, params, prompt, 4, rng=rng, temperature=1.0, top_k=-3)
+    with pytest.raises(ValueError, match="require temperature"):
+        generate(model, params, prompt, 4, top_k=5)
+
+
 def test_lm_grad_accum_equivalence():
     """grad_accum_steps=2 == one full-batch LM step (dropout off, SGD so the
     update is linear in the gradients)."""
