@@ -1,4 +1,4 @@
-"""Pretrained-weight conversion: torch or Keras MobileNetV2 weights -> flax variables.
+"""Pretrained-weight conversion: torch/Keras MobileNetV2 or torch ResNet -> flax variables.
 
 The reference's accuracy comes from a *frozen ImageNet-pretrained* MobileNetV2
 base (``Part 1 - Distributed Training/02_model_training_single_node.py:164-169``);
@@ -15,7 +15,10 @@ layouts are accepted, covering both public distributions of these weights:
   ``.npz`` of ``layer/weight`` arrays via :func:`load_keras_weights`.
 
 Both emit the flax param/batch_stats trees of
-:class:`ddw_tpu.models.mobilenet_v2.MobileNetV2Backbone`.
+:class:`ddw_tpu.models.mobilenet_v2.MobileNetV2Backbone`. For the second CNN
+family, :func:`convert_torch_resnet` maps torchvision ``resnet18/34/50``
+state_dicts onto :class:`ddw_tpu.models.resnet.ResNetBackbone` (the CLI
+auto-detects the depth from the block counts).
 
 Exactness notes:
 - conv kernels: torch ``[out, in, kh, kw]`` -> flax ``[kh, kw, in, out]``; the
@@ -61,17 +64,19 @@ def _conv(sd: dict, prefix: str) -> np.ndarray:
     return _np(sd[f"{prefix}.weight"]).transpose(2, 3, 1, 0)
 
 
-def _bn(sd: dict, prefix: str, eps_src: float) -> tuple[dict, dict]:
+def _bn(sd: dict, prefix: str, eps_src: float,
+        eps_dst: float = _EPS_FLAX) -> tuple[dict, dict]:
     scale = _np(sd[f"{prefix}.weight"])
     bias = _np(sd[f"{prefix}.bias"])
     mean = _np(sd[f"{prefix}.running_mean"])
     var = _np(sd[f"{prefix}.running_var"])
-    scale = scale * np.sqrt((var + _EPS_FLAX) / (var + eps_src))
+    scale = scale * np.sqrt((var + eps_dst) / (var + eps_src))
     return {"scale": scale, "bias": bias}, {"mean": mean, "var": var}
 
 
-def _convbn(sd: dict, conv_prefix: str, bn_prefix: str, eps_src: float):
-    bn_params, bn_stats = _bn(sd, bn_prefix, eps_src)
+def _convbn(sd: dict, conv_prefix: str, bn_prefix: str, eps_src: float,
+            eps_dst: float = _EPS_FLAX):
+    bn_params, bn_stats = _bn(sd, bn_prefix, eps_src, eps_dst)
     params = {"Conv_0": {"kernel": _conv(sd, conv_prefix)}, "BatchNorm_0": bn_params}
     stats = {"BatchNorm_0": bn_stats}
     return params, stats
@@ -110,6 +115,67 @@ def convert_torch_mobilenet_v2(state_dict: dict, eps_src: float = _EPS_TORCH
             block += 1
     put("ConvBN_1", _convbn(state_dict, "features.18.0", "features.18.1", eps_src))
     return {"params": params, "batch_stats": stats}
+
+
+_EPS_RESNET = 1e-5  # our ResNet BatchNorm epsilon == torch's: the fold is identity
+
+
+def convert_torch_resnet(state_dict: dict, depth: int = 50,
+                         eps_src: float = _EPS_TORCH) -> dict[str, dict]:
+    """torchvision-layout ResNet state_dict -> ``{"params", "batch_stats"}``
+    trees of :class:`ddw_tpu.models.resnet.ResNetBackbone` (width_mult 1.0).
+
+    torchvision layout (``resnet18/34/50().state_dict()``): stem ``conv1`` /
+    ``bn1``; stage blocks ``layer{1..4}.{i}.conv{1..3}`` + ``bn{1..3}``
+    (``conv3`` only for Bottleneck); optional ``downsample.0/.1`` projection.
+    torchvision's Bottleneck strides the 3x3 (``conv2``) — the same v1.5
+    placement this tree's :class:`BottleneckBlock` uses, so the mapping is
+    positional. BN epsilons agree (1e-5) so the scale fold is the identity.
+    The ``fc`` head is ignored (transfer mode re-heads)."""
+    from ddw_tpu.models.resnet import _CONFIGS
+
+    if depth not in _CONFIGS:
+        raise KeyError(f"unsupported resnet depth {depth} (have {sorted(_CONFIGS)})")
+    counts, bottleneck = _CONFIGS[depth]
+
+    def cb(conv_prefix, bn_prefix):
+        return _convbn(state_dict, conv_prefix, bn_prefix, eps_src,
+                       eps_dst=_EPS_RESNET)
+
+    params: dict = {}
+    stats: dict = {}
+    params["stem"], stats["stem"] = cb("conv1", "bn1")
+    n_convs = 3 if bottleneck else 2
+    for stage, n_blocks in enumerate(counts):
+        for i in range(n_blocks):
+            t = f"layer{stage + 1}.{i}"
+            sub_p: dict = {}
+            sub_s: dict = {}
+            for j in range(n_convs):
+                sub_p[f"_ConvBN_{j}"], sub_s[f"_ConvBN_{j}"] = cb(
+                    f"{t}.conv{j + 1}", f"{t}.bn{j + 1}")
+            if f"{t}.downsample.0.weight" in state_dict:
+                sub_p["proj"], sub_s["proj"] = cb(
+                    f"{t}.downsample.0", f"{t}.downsample.1")
+            params[f"stage{stage}_block{i}"] = sub_p
+            stats[f"stage{stage}_block{i}"] = sub_s
+    return {"params": params, "batch_stats": stats}
+
+
+def infer_torch_resnet_depth(state_dict: dict) -> int:
+    """Depth from block counts + block type — lets the CLI auto-detect which
+    torchvision resnet a ``.pt`` holds."""
+    from ddw_tpu.models.resnet import _CONFIGS
+
+    counts = tuple(
+        len({k.split(".")[1] for k in state_dict
+             if k.startswith(f"layer{s}.")}) for s in range(1, 5))
+    bottleneck = any(".conv3." in k for k in state_dict)
+    for depth, (c, b) in _CONFIGS.items():
+        if c == counts and b == bottleneck:
+            return depth
+    raise ValueError(f"unrecognized resnet layout: blocks {counts}, "
+                     f"bottleneck={bottleneck}")
 
 
 _EPS_KERAS = 1e-3  # Keras BatchNorm epsilon == ours: the eps fold is identity
@@ -262,7 +328,18 @@ def main(argv=None) -> None:
         import torch
 
         sd = torch.load(args.weights, map_location="cpu", weights_only=True)
-        converted = convert_torch_mobilenet_v2(sd)
+        if "features.0.0.weight" in sd and "features.18.0.weight" in sd:
+            # 18 feature stages with the Conv/BN/ReLU6 stem+top: mobilenet_v2
+            # specifically (e.g. efficientnet also has features.0.0 but a
+            # different stage count -> falls to the friendly error below)
+            converted = convert_torch_mobilenet_v2(sd)
+        elif "conv1.weight" in sd and any(k.startswith("layer1.") for k in sd):
+            depth = infer_torch_resnet_depth(sd)
+            print(f"detected torchvision resnet{depth}")
+            converted = convert_torch_resnet(sd, depth)
+        else:
+            raise SystemExit(f"{args.weights}: unrecognized state_dict layout "
+                             f"(expected torchvision mobilenet_v2 or resnet)")
     save_pretrained(args.out, converted)
     print(f"wrote {args.out}")
 

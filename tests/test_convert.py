@@ -72,17 +72,23 @@ class _TorchMNv2Features(nn.Module):
         return self.features(x)
 
 
-@pytest.fixture(scope="module")
-def torch_model():
-    torch.manual_seed(0)
-    m = _TorchMNv2Features()
-    with torch.no_grad():  # nontrivial BN statistics, positive variance
+def _randomize_bn(m):
+    """Nontrivial BN statistics, positive variance — shared by every converter
+    test family so they all exercise the same eps-fold regime."""
+    with torch.no_grad():
         for mod in m.modules():
             if isinstance(mod, nn.BatchNorm2d):
                 mod.running_mean.normal_(0, 0.5)
                 mod.running_var.uniform_(0.5, 2.0)
                 mod.weight.uniform_(0.5, 1.5)
                 mod.bias.normal_(0, 0.5)
+
+
+@pytest.fixture(scope="module")
+def torch_model():
+    torch.manual_seed(0)
+    m = _TorchMNv2Features()
+    _randomize_bn(m)
     m.eval()
     return m
 
@@ -144,6 +150,140 @@ def test_load_pretrained_rejects_mismatch(torch_model, tmp_path):
 # ---------------------------------------------------------------------------
 
 _KERAS_EPS, _TORCH_EPS = 1e-3, 1e-5
+
+
+# ---------------------------------------------------------------------------
+# torchvision-layout ResNet -> ResNetBackbone
+# ---------------------------------------------------------------------------
+
+class _TorchBasic(nn.Module):
+    """torchvision BasicBlock, naming-compatible (conv1/bn1/conv2/bn2/downsample)."""
+
+    def __init__(self, inp, out, stride):
+        super().__init__()
+        self.conv1 = nn.Conv2d(inp, out, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(out)
+        self.conv2 = nn.Conv2d(out, out, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(out)
+        self.downsample = (nn.Sequential(
+            nn.Conv2d(inp, out, 1, stride, bias=False), nn.BatchNorm2d(out))
+            if stride != 1 or inp != out else None)
+
+    def forward(self, x):
+        h = torch.relu(self.bn1(self.conv1(x)))
+        h = self.bn2(self.conv2(h))
+        sc = x if self.downsample is None else self.downsample(x)
+        return torch.relu(h + sc)
+
+
+class _TorchBottleneck(nn.Module):
+    """torchvision Bottleneck (v1.5: stride on conv2), naming-compatible."""
+
+    def __init__(self, inp, width, stride):
+        super().__init__()
+        out = width * 4
+        self.conv1 = nn.Conv2d(inp, width, 1, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, out, 1, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(out)
+        self.downsample = (nn.Sequential(
+            nn.Conv2d(inp, out, 1, stride, bias=False), nn.BatchNorm2d(out))
+            if stride != 1 or inp != out else None)
+
+    def forward(self, x):
+        h = torch.relu(self.bn1(self.conv1(x)))
+        h = torch.relu(self.bn2(self.conv2(h)))
+        h = self.bn3(self.conv3(h))
+        sc = x if self.downsample is None else self.downsample(x)
+        return torch.relu(h + sc)
+
+
+class _TorchResNetFeatures(nn.Module):
+    """torchvision resnet feature extractor (conv1/bn1/layer1..4 naming)."""
+
+    def __init__(self, depth):
+        super().__init__()
+        from ddw_tpu.models.resnet import _CONFIGS
+
+        counts, bottleneck = _CONFIGS[depth]
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, padding=1)
+        inp = 64
+        for stage, n in enumerate(counts):
+            blocks = []
+            feats = 64 * (2 ** stage)
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                if bottleneck:
+                    blocks.append(_TorchBottleneck(inp, feats, stride))
+                    inp = feats * 4
+                else:
+                    blocks.append(_TorchBasic(inp, feats, stride))
+                    inp = feats
+            setattr(self, f"layer{stage + 1}", nn.Sequential(*blocks))
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        for s in range(1, 5):
+            x = getattr(self, f"layer{s}")(x)
+        return x
+
+
+@pytest.mark.parametrize("depth", [18, 50])
+def test_resnet_backbone_forward_matches_torch(depth):
+    from ddw_tpu.models.convert import convert_torch_resnet, infer_torch_resnet_depth
+    from ddw_tpu.models.resnet import ResNetBackbone
+
+    torch.manual_seed(depth)
+    tm = _TorchResNetFeatures(depth)
+    _randomize_bn(tm)
+    tm.eval()
+    sd = tm.state_dict()
+    assert infer_torch_resnet_depth(sd) == depth
+
+    # odd spatial size keeps TF-"SAME" padding symmetric == torch padding
+    x = np.random.RandomState(1).rand(2, 65, 65, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy().transpose(0, 2, 3, 1)
+
+    conv = convert_torch_resnet(sd, depth)
+    backbone = ResNetBackbone(depth=depth, dtype=jnp.float32)
+    out = backbone.apply(
+        {"params": conv["params"], "batch_stats": conv["batch_stats"]},
+        jnp.asarray(x), train=False)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-3)
+
+
+def test_resnet_artifact_loads_into_model(tmp_path):
+    """save_pretrained -> ModelCfg.pretrained_path -> init_state merges the
+    converted ResNet backbone; frozen transfer then works unchanged."""
+    from ddw_tpu.models.convert import convert_torch_resnet
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.train.step import init_state
+    from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+    torch.manual_seed(0)
+    tm = _TorchResNetFeatures(18)
+    _randomize_bn(tm)
+    conv = convert_torch_resnet(tm.state_dict(), 18)
+    art = str(tmp_path / "resnet18.npz")
+    save_pretrained(art, conv)
+
+    cfg = ModelCfg(name="resnet18", num_classes=5, freeze_base=True,
+                   pretrained_path=art, dtype="float32")
+    model = build_model(cfg)
+    assert model.freeze_base is True  # pretrained: no auto-unfreeze
+    state, _ = init_state(model, cfg, TrainCfg(batch_size=4), (33, 33, 3),
+                          jax.random.PRNGKey(0))
+    got = state.params["backbone"]["stem"]["Conv_0"]["kernel"]
+    np.testing.assert_allclose(np.asarray(got),
+                               conv["params"]["stem"]["Conv_0"]["kernel"],
+                               rtol=1e-6)
 
 
 def _keras_weights_from_torch(sd) -> dict:
