@@ -118,6 +118,21 @@ def test_on_epoch_hook(small_cfgs, silver, tmp_path):
             train_tbl, val_tbl)
 
 
+def test_profiler_trace_writes_files(small_cfgs, silver, tmp_path):
+    """TrainCfg.trace_dir (Horovod-Timeline role): the first epoch runs under
+    jax.profiler and a trace lands on disk, openable in TensorBoard/Perfetto."""
+    import os
+
+    train_tbl, val_tbl, _ = silver
+    trace_dir = str(tmp_path / "trace")
+    tr = _mk_trainer(small_cfgs, silver, tmp_path, epochs=1,
+                     trace_dir=trace_dir)
+    tr.fit(train_tbl, val_tbl)
+    found = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs]
+    assert any(f.endswith((".trace.json.gz", ".xplane.pb"))
+               for f in found), found
+
+
 def test_early_stopping(small_cfgs, silver, tmp_path):
     train_tbl, val_tbl, _ = silver
     tr = _mk_trainer(small_cfgs, silver, tmp_path, epochs=10,
