@@ -202,30 +202,26 @@ def materialize_features(
     return store.write(out_name, stream(), meta=meta)
 
 
-def train_frozen_via_features(
+def prepare_feature_tables(
     data_cfg: DataCfg,
     model_cfg: ModelCfg,
     train_cfg: TrainCfg,
     train_table: Table,
     val_table: Table,
     store: TableStore,
-    mesh=None,
-    run=None,
     feature_batch: int = 64,
 ):
-    """The frozen-transfer contract, restructured TPU-first: featurize once,
-    train the head from the cache, return a :class:`TrainResult` whose state
-    holds the FULL model params + batch_stats (pretrained backbone + trained
-    head) — ready for packaging/serving/eval and weight checkpointing like
-    ``Trainer.fit``'s result. The optimizer state is a FRESH full-model init
-    (head Adam moments live in the head-shaped opt tree and don't transplant);
-    the dynamic LR carries over, so further full-model training warm-starts
-    with the schedule where the head run left it but zeroed moments.
+    """Featurize (or reuse cached) train/val tables for a frozen model.
 
-    Requires ``model_cfg.freeze_base`` (the cache is only valid when the
-    backbone never updates)."""
+    Returns ``(feat_train, feat_val, full_model, full_state)`` — the pieces a
+    caller composes head-only training from. Because dropout and the Dense
+    head sit ABOVE the pooled features, one feature cache is valid across any
+    head hyperparameters: HPO over {dropout, lr, optimizer, batch} re-uses
+    the same tables for every trial (``examples/04 --cache-features``).
+
+    Raises when the model would not actually be frozen (same guard as
+    :func:`train_frozen_via_features`)."""
     from ddw_tpu.models.registry import build_model
-    from ddw_tpu.train.trainer import Trainer
 
     if not model_cfg.freeze_base:
         raise ValueError("cached-feature training requires freeze_base=True "
@@ -249,24 +245,74 @@ def train_frozen_via_features(
         full_model, full_state.params, full_state.batch_stats, val_table,
         store, f"{prefix}_feat_val", (data_cfg.img_height, data_cfg.img_width),
         batch_size=feature_batch, io_workers=data_cfg.loader_workers)
+    return feat_train, feat_val, full_model, full_state
+
+
+def make_head_trainer(
+    data_cfg: DataCfg,
+    model_cfg: ModelCfg,
+    train_cfg: TrainCfg,
+    full_state,
+    mesh=None,
+    run=None,
+    on_epoch=None,
+):
+    """A :class:`Trainer` wired to train ONLY the head on feature tables.
+
+    ``model_cfg.dropout`` may differ from the config the features were built
+    with (dropout sits above the cache); the head starts from ``full_state``'s
+    head init so single-trial runs stay step-equivalent to frozen full-model
+    training."""
+    from ddw_tpu.train.trainer import Trainer
 
     head = TransferHead(model_cfg.num_classes, model_cfg.dropout)
-    # Head starts from the SAME init the full model drew, so cached-feature
-    # training is step-equivalent to frozen full-model training.
     head_params = {"head": full_state.params["head"]}
     tx = make_optimizer(train_cfg)
     head_state = TrainState(head_params, {}, tx.init(head_params),
                             jnp.zeros((), jnp.int32))
+    return Trainer(data_cfg, model_cfg, train_cfg, mesh=mesh, run=run,
+                   model=head, initial=(head_state, tx), on_epoch=on_epoch)
 
-    trainer = Trainer(data_cfg, model_cfg, train_cfg, mesh=mesh, run=run,
-                      model=head, initial=(head_state, tx))
-    res = trainer.fit(feat_train, feat_val)
 
+def merge_head_params(full_state, head_state):
+    """Full-model TrainState with ``head_state``'s trained head folded in —
+    packaging/serving-ready (see :func:`train_frozen_via_features` for the
+    optimizer-state caveat)."""
     from ddw_tpu.train.step import get_lr, set_lr
 
     merged = dict(full_state.params)
-    merged["head"] = res.state.params["head"]
-    full_out = TrainState(merged, full_state.batch_stats,
-                          full_state.opt_state, res.state.step)
-    full_out = set_lr(full_out, get_lr(res.state))
-    return dataclasses.replace(res, state=full_out)
+    merged["head"] = head_state.params["head"]
+    out = TrainState(merged, full_state.batch_stats,
+                     full_state.opt_state, head_state.step)
+    return set_lr(out, get_lr(head_state))
+
+
+def train_frozen_via_features(
+    data_cfg: DataCfg,
+    model_cfg: ModelCfg,
+    train_cfg: TrainCfg,
+    train_table: Table,
+    val_table: Table,
+    store: TableStore,
+    mesh=None,
+    run=None,
+    feature_batch: int = 64,
+):
+    """The frozen-transfer contract, restructured TPU-first: featurize once,
+    train the head from the cache, return a :class:`TrainResult` whose state
+    holds the FULL model params + batch_stats (pretrained backbone + trained
+    head) — ready for packaging/serving/eval and weight checkpointing like
+    ``Trainer.fit``'s result. The optimizer state is a FRESH full-model init
+    (head Adam moments live in the head-shaped opt tree and don't transplant);
+    the dynamic LR carries over, so further full-model training warm-starts
+    with the schedule where the head run left it but zeroed moments.
+
+    Requires ``model_cfg.freeze_base`` (the cache is only valid when the
+    backbone never updates)."""
+    feat_train, feat_val, _, full_state = prepare_feature_tables(
+        data_cfg, model_cfg, train_cfg, train_table, val_table, store,
+        feature_batch=feature_batch)
+    trainer = make_head_trainer(data_cfg, model_cfg, train_cfg, full_state,
+                                mesh=mesh, run=run)
+    res = trainer.fit(feat_train, feat_val)
+    return dataclasses.replace(res, state=merge_head_params(full_state, res.state))

@@ -32,13 +32,10 @@ def main():
     run = ws["tracker"].start_run("single_node")
     if args.cache_features:
         from ddw_tpu.train.transfer import train_frozen_via_features
+        from examples.common import ensure_frozen_backbone_cfg
 
         mcfg = cfgs["model"]
-        if mcfg.name == "small_cnn":  # --quick default has no backbone/head split
-            mcfg.name, mcfg.width_mult = "mobilenet_v2", 0.35
-        mcfg.freeze_base = True
-        if not mcfg.pretrained_path:
-            mcfg.allow_frozen_random = True  # demo without the ImageNet artifact
+        ensure_frozen_backbone_cfg(mcfg)
         res = train_frozen_via_features(cfgs["data"], mcfg, cfgs["train"],
                                         train_tbl, val_tbl, ws["store"],
                                         mesh=mesh, run=run)

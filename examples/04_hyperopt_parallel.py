@@ -28,11 +28,31 @@ from ddw_tpu.tune import STATUS_OK, Trials, choice, fmin, loguniform, uniform
 
 
 def main():
-    args = parse_args(__doc__)
+    args = parse_args(__doc__, extra=lambda ap: ap.add_argument(
+        "--cache-features", action="store_true",
+        help="frozen-transfer HPO fast path: featurize ONCE, then every "
+             "trial trains only the head from the shared cache — valid "
+             "because all searched hyperparameters (dropout/lr/optimizer) "
+             "sit above the pooled features (ddw_tpu.train.transfer)"))
     ws = setup(args)
     cfgs = ws["cfgs"]
     tune_cfg = cfgs["tune"]
     train_tbl, val_tbl = require_tables(ws["store"], ws["cfgs"]["data"])
+
+    feat_ctx = None
+    if args.cache_features:
+        from ddw_tpu.train.transfer import prepare_feature_tables
+        from examples.common import ensure_frozen_backbone_cfg
+
+        base_mcfg = cfgs["model"]
+        ensure_frozen_backbone_cfg(base_mcfg)
+        feat_train, feat_val, _, full_state = prepare_feature_tables(
+            cfgs["data"], base_mcfg, cfgs["train"], train_tbl, val_tbl,
+            ws["store"])
+        feat_ctx = (feat_train, feat_val, full_state)
+        print(f"[features] cached {feat_train.num_records}+"
+              f"{feat_val.num_records} records "
+              f"(dim {feat_train.meta['feature_dim']}) — trials train heads only")
 
     # hyperopt space of the reference (:194-198)
     space = {
@@ -75,9 +95,22 @@ def main():
             on_epoch = (None if trial is None else
                         lambda row: trial.report(row["epoch"], row["val_loss"]))
             try:
-                trainer = Trainer(cfgs["data"], model_cfg, train_cfg, mesh=mesh,
-                                  run=run, on_epoch=on_epoch)
-                res = trainer.fit(train_tbl, val_tbl)
+                if feat_ctx is not None:
+                    # head-only trial over the shared feature cache
+                    from ddw_tpu.train.transfer import (make_head_trainer,
+                                                        merge_head_params)
+
+                    f_train, f_val, full_state = feat_ctx
+                    trainer = make_head_trainer(cfgs["data"], model_cfg,
+                                                train_cfg, full_state,
+                                                mesh=mesh, run=run,
+                                                on_epoch=on_epoch)
+                    res = trainer.fit(f_train, f_val)
+                    res.state = merge_head_params(full_state, res.state)
+                else:
+                    trainer = Trainer(cfgs["data"], model_cfg, train_cfg,
+                                      mesh=mesh, run=run, on_epoch=on_epoch)
+                    res = trainer.fit(train_tbl, val_tbl)
             except Exception as e:
                 from ddw_tpu.tune import Pruned
 

@@ -79,6 +79,22 @@ def require_tables(store: TableStore, data_cfg=None):
         raise SystemExit("silver tables missing — run examples/01_data_prep.py first")
     train = store.table("silver_train")
     val = store.table("silver_val")
+    return _prefer_materialized(store, data_cfg, train, val)
+
+
+def ensure_frozen_backbone_cfg(model_cfg) -> None:
+    """Demo-mode policy for the ``--cache-features`` examples: swap the
+    backbone-less ``--quick`` default for a small frozen MobileNetV2 and opt
+    into the frozen-random escape hatch when no pretrained artifact is set
+    (one definition — examples 02 and 04 must not diverge)."""
+    if model_cfg.name == "small_cnn":  # --quick default has no backbone/head split
+        model_cfg.name, model_cfg.width_mult = "mobilenet_v2", 0.35
+    model_cfg.freeze_base = True
+    if not model_cfg.pretrained_path:
+        model_cfg.allow_frozen_random = True  # demo without the ImageNet artifact
+
+
+def _prefer_materialized(store, data_cfg, train, val):
     if (data_cfg is not None and store.exists("silver_train_decoded")
             and store.exists("silver_val_decoded")):
         t = store.table("silver_train_decoded")

@@ -23,6 +23,9 @@ _EXAMPLES = [
     ("03_train_distributed.py", ["train.epochs=1"], "world=8"),
     ("04_hyperopt_parallel.py",
      ["tune.max_evals=2", "tune.parallelism=2", "train.epochs=1"], "best"),
+    ("04_hyperopt_parallel.py",
+     ["--cache-features", "tune.max_evals=2", "tune.parallelism=2",
+      "train.epochs=1"], "trials train heads only"),
     ("05_hyperopt_distributed.py",
      ["tune.max_evals=2", "train.epochs=1"], "best"),
     ("06_packaged_inference.py", ["train.epochs=1"], "distributed scoring"),
