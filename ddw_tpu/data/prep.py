@@ -173,7 +173,6 @@ def prepare_flowers_distributed(
     waiting) lets the coordinator fail fast when a worker process dies
     instead of sleeping out ``merge_timeout_s``.
     """
-    import hashlib
     from concurrent.futures import ThreadPoolExecutor
 
     from ddw_tpu.data.loader import bounded_map
@@ -191,12 +190,13 @@ def prepare_flowers_distributed(
     # changed data can never silently mix a previous run's parts
     # (TableStore.await_parts). Same data + config => same id, and then stale
     # parts are byte-identical to fresh ones, so matching them is harmless.
-    h = hashlib.sha256(repr((worker_count, sample_fraction, train_fraction,
-                             split_seed, shard_size)).encode())
-    for p in paths:
+    def _stat(p):
         st = os.stat(p)
-        h.update(f"{p}|{st.st_size}|{st.st_mtime_ns}\n".encode())
-    run_id = h.hexdigest()[:16]
+        return f"{p}|{st.st_size}|{st.st_mtime_ns}"
+
+    run_id = TableStore.run_token(
+        (worker_count, sample_fraction, train_fraction, split_seed, shard_size),
+        [_stat(p) for p in paths])
 
     def read_one(i: int) -> tuple[int, Record]:
         with open(paths[i], "rb") as f:

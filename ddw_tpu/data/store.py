@@ -304,6 +304,20 @@ class TableStore:
     def exists(self, name: str) -> bool:
         return os.path.exists(os.path.join(self._table_dir(name), "latest"))
 
+    @staticmethod
+    def run_token(*components) -> str:
+        """Deterministic 16-hex run token from the run's actual inputs — THE
+        derivation every shared-nothing part/merge flow uses (distributed
+        prep, batch scorer, distributed featurization), so coordinator and
+        workers always agree on the fence :meth:`await_parts` checks."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for c in components:
+            h.update(repr(c).encode())
+            h.update(b"\x00")
+        return h.hexdigest()[:16]
+
     def await_parts(self, part_names: list[str], run_id: str,
                     timeout_s: float = 300.0, abort=None) -> list[Table]:
         """Wait (bounded) for every part table's LATEST version to carry

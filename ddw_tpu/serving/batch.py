@@ -210,11 +210,9 @@ class BatchScorer:
     def _run_id(self, table: Table) -> str:
         """Deterministic scoring-run token — identical on every process for the
         same (input table version, packaged model), without communication."""
-        import hashlib
-
-        return hashlib.sha256(
-            f"{table.manifest['name']}|v{table.manifest['version']}|"
-            f"{self.model.content_digest}".encode()).hexdigest()[:16]
+        return TableStore.run_token(table.manifest["name"],
+                                    table.manifest["version"],
+                                    self.model.content_digest)
 
 
 def merge_predictions(out_store: TableStore, out_name: str, n_parts: int,

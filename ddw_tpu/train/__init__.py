@@ -3,6 +3,10 @@ from ddw_tpu.train.trainer import Trainer, TrainResult  # noqa: F401
 from ddw_tpu.train.callbacks import LRWarmup, ReduceLROnPlateau, EarlyStopping  # noqa: F401
 from ddw_tpu.train.transfer import (  # noqa: F401
     TransferHead,
+    make_head_trainer,
     materialize_features,
+    materialize_features_distributed,
+    merge_head_params,
+    prepare_feature_tables,
     train_frozen_via_features,
 )

@@ -117,6 +117,74 @@ def _decode_record(rec, table_meta, height: int, width: int) -> np.ndarray:
     return preprocess_image(rec.content, height, width)
 
 
+def _cache_fresh(cached: Table, fp: str, table: Table,
+                 height: int, width: int) -> bool:
+    """The feature cache's freshness fence: backbone fingerprint AND source
+    table version AND input resolution must all match (same discipline as the
+    raw_u8 cache; features can't be size-checked downstream, so the
+    resolution is part of the key)."""
+    return (cached.meta.get("backbone_fingerprint") == fp
+            and cached.meta.get("source_version") == table.manifest["version"]
+            and cached.meta.get("source_table") == table.manifest["name"]
+            and (cached.meta.get("image_height"),
+                 cached.meta.get("image_width")) == (height, width))
+
+
+def _featurize_stream(model, variables, table: Table, worker_slice,
+                      height: int, width: int, batch_size: int,
+                      io_workers: int):
+    """Yield ``(feature Record, dim)`` for this worker's records —
+    ``worker_slice`` is ``(worker_index, worker_count)`` selecting the
+    round-robin stripe, or None for every record — decoding on a thread pool
+    and featurizing in padded device batches (no drop-remainder — every
+    selected record is featurized)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ddw_tpu.data.loader import bounded_map
+
+    feat_fn = jax.jit(_pooled_feature_fn(model))
+    buf_recs: list = []
+    buf = np.empty((batch_size, height, width, 3), np.float32)
+
+    def flush():
+        n = len(buf_recs)
+        feats = np.asarray(feat_fn(variables, jnp.asarray(buf)))[:n]
+        dim = feats.shape[1]
+        for rec, f in zip(buf_recs, feats):
+            yield Record(rec.path, np.ascontiguousarray(f).tobytes(),
+                         rec.label, rec.label_idx), dim
+        buf_recs.clear()
+
+    def selected():
+        if worker_slice is None:
+            yield from table.iter_records()
+        else:
+            w, k = worker_slice
+            for i, rec in enumerate(table.iter_records()):
+                if i % k == w:
+                    yield rec
+
+    with ThreadPoolExecutor(max_workers=io_workers) as pool:
+        decode = lambda r: (r, _decode_record(r, table.meta, height, width))  # noqa: E731
+        for rec, arr in bounded_map(pool, decode, selected(), io_workers * 4):
+            buf[len(buf_recs)] = arr
+            buf_recs.append(rec)
+            if len(buf_recs) == batch_size:
+                yield from flush()
+        if buf_recs:
+            buf[len(buf_recs):] = 0.0  # pad: static shape for the jit
+            yield from flush()
+
+
+def _feature_meta(table: Table, fp: str, height: int, width: int,
+                  feature_dim: int) -> dict:
+    return {**table.meta, "encoding": "features_f32", "feature_dim": feature_dim,
+            "backbone_fingerprint": fp,
+            "image_height": height, "image_width": width,
+            "source_table": table.manifest["name"],
+            "source_version": table.manifest["version"]}
+
+
 def materialize_features(
     model,
     params,
@@ -131,68 +199,26 @@ def materialize_features(
     """Run the frozen backbone once over ``table``; write/reuse a
     ``features_f32`` table of pooled feature vectors.
 
-    Returns an existing cached table when its backbone fingerprint AND source
-    table version match; otherwise recomputes. Every record is featurized
-    (the final partial batch is padded on device and trimmed on write — no
-    drop-remainder, unlike the training loader)."""
+    Returns an existing cached table when its backbone fingerprint, source
+    table version, and input resolution match; otherwise recomputes. Every
+    record is featurized (the final partial batch is padded on device and
+    trimmed on write — no drop-remainder, unlike the training loader)."""
     height, width = image_size
     fp = backbone_fingerprint(params, batch_stats)
     if store.exists(out_name):
         cached = store.table(out_name)
-        if (cached.meta.get("backbone_fingerprint") == fp
-                and cached.meta.get("source_version") == table.manifest["version"]
-                and cached.meta.get("source_table") == table.manifest["name"]
-                # same fence the raw_u8 cache enforces (loader raises on size
-                # mismatch there; features can't be size-checked downstream, so
-                # the resolution must be part of the freshness key)
-                and (cached.meta.get("image_height"),
-                     cached.meta.get("image_width")) == (height, width)):
+        if _cache_fresh(cached, fp, table, height, width):
             return cached
 
-    from concurrent.futures import ThreadPoolExecutor
-
-    from ddw_tpu.data.loader import bounded_map
-
-    feat_fn = jax.jit(_pooled_feature_fn(model))
     variables = {"params": params}
     if batch_stats:
         variables["batch_stats"] = batch_stats
-
-    def records():
-        buf_recs: list = []
-        buf = np.empty((batch_size, height, width, 3), np.float32)
-
-        def flush():
-            n = len(buf_recs)
-            feats = np.asarray(feat_fn(variables, jnp.asarray(buf)))[:n]
-            dim = feats.shape[1]
-            for rec, f in zip(buf_recs, feats):
-                yield Record(rec.path, np.ascontiguousarray(f).tobytes(),
-                             rec.label, rec.label_idx), dim
-            buf_recs.clear()
-
-        with ThreadPoolExecutor(max_workers=io_workers) as pool:
-            decode = lambda r: (r, _decode_record(r, table.meta, height, width))  # noqa: E731
-            for rec, arr in bounded_map(pool, decode, table.iter_records(),
-                                        io_workers * 4):
-                buf[len(buf_recs)] = arr
-                buf_recs.append(rec)
-                if len(buf_recs) == batch_size:
-                    yield from flush()
-            if buf_recs:
-                buf[len(buf_recs):] = 0.0  # pad: static shape for the jit
-                yield from flush()
-
-    gen = records()
+    gen = _featurize_stream(model, variables, table, None, height, width,
+                            batch_size, io_workers)
     first = next(gen, None)
     if first is None:
         raise ValueError(f"table {table.manifest['name']} has no records")
-    feature_dim = first[1]
-    meta = {**table.meta, "encoding": "features_f32", "feature_dim": feature_dim,
-            "backbone_fingerprint": fp,
-            "image_height": height, "image_width": width,
-            "source_table": table.manifest["name"],
-            "source_version": table.manifest["version"]}
+    meta = _feature_meta(table, fp, height, width, feature_dim=first[1])
 
     def stream():
         yield first[0]
@@ -200,6 +226,79 @@ def materialize_features(
             yield rec
 
     return store.write(out_name, stream(), meta=meta)
+
+
+def materialize_features_distributed(
+    model,
+    params,
+    batch_stats,
+    table: Table,
+    store: TableStore,
+    out_name: str,
+    image_size: tuple[int, int],
+    worker_index: int,
+    worker_count: int,
+    batch_size: int = 64,
+    io_workers: int = 4,
+    merge_timeout_s: float = 600.0,
+    abort=None,
+) -> Table | None:
+    """Multi-worker :func:`materialize_features` — the same shared-nothing
+    plan/part/merge shape as ``prep.prepare_flowers_distributed``: every
+    worker featurizes the round-robin record slice ``[worker_index::
+    worker_count]`` into a part table; worker 0 awaits all parts (run-token
+    fenced) and commits the final table via zero-copy manifest merge.
+
+    The run token derives deterministically from the backbone fingerprint +
+    source version + resolution + worker count (no communication), so a merge
+    can never mix parts from different weights or data. Returns the merged
+    Table on worker 0, None elsewhere; a fresh cache short-circuits every
+    worker."""
+    if not 0 <= worker_index < worker_count:
+        raise ValueError(f"worker_index {worker_index} out of range "
+                         f"for worker_count {worker_count}")
+    if table.num_records == 0:
+        raise ValueError(f"table {table.manifest['name']} has no records")
+    height, width = image_size
+    fp = backbone_fingerprint(params, batch_stats)
+    if store.exists(out_name):
+        cached = store.table(out_name)
+        if _cache_fresh(cached, fp, table, height, width):
+            return cached if worker_index == 0 else None
+
+    run_id = TableStore.run_token(fp, table.manifest["name"],
+                                  table.manifest["version"],
+                                  height, width, worker_count)
+
+    variables = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+    gen = _featurize_stream(model, variables, table,
+                            (worker_index, worker_count), height, width,
+                            batch_size, io_workers)
+    first = next(gen, None)
+    dim = first[1] if first is not None else 0  # small tables: empty slice ok
+    part_meta = {**_feature_meta(table, fp, height, width, feature_dim=dim),
+                 "worker": worker_index, "run_id": run_id}
+
+    def stream():
+        if first is not None:
+            yield first[0]
+            for rec, _ in gen:
+                yield rec
+
+    store.write(f"{out_name}_p{worker_index}", stream(), meta=part_meta)
+    if worker_index != 0:
+        return None
+
+    parts = store.await_parts([f"{out_name}_p{w}" for w in range(worker_count)],
+                              run_id, merge_timeout_s, abort=abort)
+    dims = {p.meta["feature_dim"] for p in parts if p.meta["feature_dim"]}
+    if len(dims) != 1:
+        raise RuntimeError(f"feature-dim mismatch across parts: {dims}")
+    meta = {**_feature_meta(table, fp, height, width, feature_dim=dims.pop()),
+            "worker_count": worker_count, "run_id": run_id}
+    return store.merge_shards(out_name, parts, meta=meta)
 
 
 def prepare_feature_tables(

@@ -203,6 +203,56 @@ def test_feature_cache_roundtrip_reuse_and_stale_rejection(tmp_path):
     np.testing.assert_array_equal(batches[0][0][0], cached)
 
 
+def test_distributed_featurization_matches_single(tmp_path):
+    """2-worker materialize_features_distributed == single-process features:
+    same record set, same vectors per path, one merged table (the
+    prepare_flowers_distributed part/merge shape)."""
+    import warnings
+
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.train.transfer import (materialize_features,
+                                        materialize_features_distributed)
+
+    store = TableStore(str(tmp_path / "tables"))
+    tbl = _jpeg_table(store, "silver", n=13)  # odd: uneven worker slices
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = build_model(_frozen_cfg())
+    state, _ = init_state(model, _frozen_cfg(), TrainCfg(batch_size=4),
+                          (HW, HW, 3), jax.random.PRNGKey(0))
+
+    single = materialize_features(model, state.params, state.batch_stats, tbl,
+                                  store, "feat_s", (HW, HW), batch_size=4)
+
+    # worker 1 writes its part first; worker 0 then featurizes + merges
+    assert materialize_features_distributed(
+        model, state.params, state.batch_stats, tbl, store, "feat_d",
+        (HW, HW), worker_index=1, worker_count=2, batch_size=4) is None
+    merged = materialize_features_distributed(
+        model, state.params, state.batch_stats, tbl, store, "feat_d",
+        (HW, HW), worker_index=0, worker_count=2, batch_size=4)
+
+    assert merged.num_records == single.num_records == 13
+    assert merged.meta["feature_dim"] == single.meta["feature_dim"]
+    assert merged.meta["worker_count"] == 2
+    by_path = {r.path: r.content for r in single.iter_records()}
+    for rec in merged.iter_records():
+        np.testing.assert_allclose(
+            np.frombuffer(rec.content, np.float32),
+            np.frombuffer(by_path.pop(rec.path), np.float32),
+            rtol=1e-5, atol=1e-7)
+    assert not by_path  # exact same record membership
+
+    # fresh-cache short-circuit on BOTH workers
+    again = materialize_features_distributed(
+        model, state.params, state.batch_stats, tbl, store, "feat_d",
+        (HW, HW), worker_index=0, worker_count=2, batch_size=4)
+    assert again.manifest["version"] == merged.manifest["version"]
+    assert materialize_features_distributed(
+        model, state.params, state.batch_stats, tbl, store, "feat_d",
+        (HW, HW), worker_index=1, worker_count=2, batch_size=4) is None
+
+
 def test_head_on_features_matches_frozen_full_step(tmp_path):
     """One head-only train step on cached features == one frozen full-model
     step: same loss, same updated head params (dropout ACTIVE — both paths
