@@ -115,7 +115,7 @@ def cmd_report(args) -> None:
 
     _exp_dir(args)  # validate before writing anything
     out = write_report(args.root, args.experiment, args.out or None,
-                       include_sys=args.sys)
+                       include_sys=not args.no_sys)
     print(out)
 
 
@@ -152,8 +152,8 @@ def main(argv=None) -> None:
     p_report.add_argument("-e", "--experiment", default="default")
     p_report.add_argument("-o", "--out", default="",
                           help="output path (default <root>/<exp>_report.html)")
-    p_report.add_argument("--sys", action="store_true",
-                          help="include sys.* utilization charts")
+    p_report.add_argument("--no-sys", action="store_true",
+                          help="omit the sys.* utilization section")
     sub.add_parser("models")
 
     args = ap.parse_args(argv)

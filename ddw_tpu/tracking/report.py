@@ -21,6 +21,7 @@ import html
 import math
 import os
 import time
+from pathlib import PurePath
 
 from ddw_tpu.tracking.tracker import Run
 
@@ -143,11 +144,20 @@ def _runs_in_tree_order(exp_dir: str) -> list[tuple[Run, dict, int]]:
 
 def render_report(root: str, experiment: str = "default",
                   metrics: list[str] | None = None,
-                  include_sys: bool = False) -> str:
+                  include_sys: bool = True,
+                  max_metric_cols: int = 8) -> str:
     """Render one experiment to an HTML string.
 
-    ``metrics`` restricts the chart set (default: every logged key; ``sys.*``
-    utilization series — the Ganglia role — only when ``include_sys``).
+    ``metrics`` restricts the training-metric chart set (default: every
+    logged key). ``sys.*`` utilization series — the Ganglia role — render in
+    their own "System utilization" section so the cluster-health story lives
+    in the same artifact as the training curves (reference keeps them in a
+    separate Ganglia tab, ``04_monitoring_and_optimization.py:25-29``);
+    ``include_sys=False`` suppresses that section. Runs that recorded a
+    profiler trace (``TrainCfg.trace_dir`` → the ``trace_dir`` param) get a
+    link to it in the runs table — the Horovod-Timeline artifact, one click
+    from the run row. The runs table shows at most ``max_metric_cols`` metric
+    columns and says how many were cut.
     """
     exp_dir = os.path.join(root, experiment)
     if not os.path.isdir(exp_dir):
@@ -167,7 +177,9 @@ def render_report(root: str, experiment: str = "default",
             if k not in all_keys:
                 all_keys.append(k)
     chart_keys = [k for k in (metrics if metrics is not None else all_keys)
-                  if include_sys or not k.startswith("sys.")]
+                  if not k.startswith("sys.")]
+    sys_keys = ([k for k in all_keys if k.startswith("sys.")]
+                if include_sys else [])
 
     parts = ["<!doctype html><html><head><meta charset='utf-8'>",
              f"<title>{html.escape(experiment)} — ddw_tpu report</title>",
@@ -178,17 +190,39 @@ def render_report(root: str, experiment: str = "default",
              f"<code>{html.escape(os.path.abspath(root))}</code></p>"]
 
     # ---- runs table
-    metric_cols = [k for k in all_keys if not k.startswith("sys.")][:8]
+    all_metric_keys = [k for k in all_keys if not k.startswith("sys.")]
+    metric_cols = all_metric_keys[:max_metric_cols]
+    n_cut = len(all_metric_keys) - len(metric_cols)
+    # trace column only when some run recorded one (param logged by the
+    # trainer when TrainCfg.trace_dir is set)
+    params_of = {r.run_id: r.params() for r, _, _ in rows}
+    has_trace = any("trace_dir" in p for p in params_of.values())
     parts.append("<h2>Runs</h2><table><tr><th>run</th><th>name</th>"
-                 "<th>status</th><th>params</th>"
+                 "<th>status</th>" + ("<th>trace</th>" if has_trace else "")
+                 + "<th>params</th>"
                  + "".join(f"<th>{html.escape(k)}</th>" for k in metric_cols)
+                 + (f"<th>+{n_cut} more</th>" if n_cut else "")
                  + "</tr>")
     color_of: dict[str, str] = {}
     for i, (r, meta, depth) in enumerate(rows):
         color_of[r.run_id] = _COLORS[i % len(_COLORS)]
         status = meta.get("status", "?")
+        run_params = params_of[r.run_id]
         params = " ".join(f"{html.escape(str(k))}={html.escape(_fmt(v))}"
-                          for k, v in sorted(r.params().items()))
+                          for k, v in sorted(run_params.items())
+                          # the dedicated trace column shows these
+                          if k != "trace_dir" and not k.endswith(".trace_dir"))
+        trace_cell = ""
+        if has_trace:
+            td = run_params.get("trace_dir")
+            if td:
+                # percent-encoded file:// URI — raw paths with '#'/space would
+                # truncate or 404 in the browser
+                href = (PurePath(str(td)).as_uri()
+                        if os.path.isabs(str(td)) else str(td))
+                trace_cell = f"<td><a href='{html.escape(href)}'>profile</a></td>"
+            else:
+                trace_cell = "<td></td>"
         cells = "".join(
             f"<td>{_fmt(finals[r.run_id][k]) if k in finals[r.run_id] else ''}</td>"
             for k in metric_cols)
@@ -201,29 +235,41 @@ def render_report(root: str, experiment: str = "default",
             f"</span><code>{html.escape(r.run_id)}</code></td>"
             f"<td>{html.escape(meta.get('name', ''))}</td>"
             f"<td class='status-{html.escape(status)}'>{html.escape(status)}</td>"
-            f"<td>{params}</td>{cells}</tr>")
+            f"{trace_cell}<td>{params}</td>{cells}"
+            + ("<td></td>" if n_cut else "") + "</tr>")
     parts.append("</table>")
 
     # ---- charts: one per metric, overlaying all runs that logged it
-    charts = []
-    for key in chart_keys:
-        series = []
-        for r, _, _ in rows:
-            hist = series_of[r.run_id].get(key)
-            if hist:
-                series.append((r.run_id, color_of[r.run_id], hist))
-        if series:
-            charts.append(
-                f"<figure>{_svg_chart(series)}"
-                f"<figcaption>{html.escape(key)}</figcaption></figure>")
+    def chart_set(keys: list[str]) -> list[str]:
+        charts = []
+        for key in keys:
+            series = []
+            for r, _, _ in rows:
+                hist = series_of[r.run_id].get(key)
+                if hist:
+                    series.append((r.run_id, color_of[r.run_id], hist))
+            if series:
+                charts.append(
+                    f"<figure>{_svg_chart(series)}"
+                    f"<figcaption>{html.escape(key)}</figcaption></figure>")
+        return charts
+
+    legend = "".join(
+        f"<span><span class='swatch' style='background:{color_of[r.run_id]}'>"
+        f"</span><code>{html.escape(r.run_id)}</code></span>"
+        for r, _, _ in rows)
+    charts = chart_set(chart_keys)
     if charts:
         parts.append("<h2>Metrics</h2>")
-        legend = "".join(
-            f"<span><span class='swatch' style='background:{color_of[r.run_id]}'>"
-            f"</span><code>{html.escape(r.run_id)}</code></span>"
-            for r, _, _ in rows)
         parts.append(f"<div class='legend'>{legend}</div>")
         parts.append(f"<div class='charts'>{''.join(charts)}</div>")
+
+    # ---- utilization: the Ganglia dashboards next to the training curves
+    sys_charts = chart_set(sys_keys)
+    if sys_charts:
+        parts.append("<h2>System utilization</h2>")
+        parts.append(f"<div class='legend'>{legend}</div>")
+        parts.append(f"<div class='charts'>{''.join(sys_charts)}</div>")
 
     parts.append("</body></html>")
     return "".join(parts)
@@ -232,7 +278,7 @@ def render_report(root: str, experiment: str = "default",
 def write_report(root: str, experiment: str = "default",
                  out_path: str | None = None,
                  metrics: list[str] | None = None,
-                 include_sys: bool = False) -> str:
+                 include_sys: bool = True) -> str:
     """Render and write the report; returns the output path."""
     out_path = out_path or os.path.join(root, f"{experiment}_report.html")
     html_text = render_report(root, experiment, metrics, include_sys)

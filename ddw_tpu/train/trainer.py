@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import time
 from typing import Any
 
@@ -218,83 +219,100 @@ class Trainer:
                 # early-stop counters were restored from checkpoint metadata above.
                 state = set_lr(state, warmup.lr_for_epoch(cfg.warmup_epochs))
             in_warmup = lambda e: e < cfg.warmup_epochs and warmup.world_size > 1  # noqa: E731
-            for epoch in range(start_epoch, cfg.epochs):
-                if cfg.trace_dir and epoch == start_epoch and jax.process_index() == 0:
-                    jax.profiler.start_trace(cfg.trace_dir)
-                    tracing = True
-                t0 = time.time()
-                losses, accs = [], []
-                for step_i in range(steps_per_epoch):
-                    if in_warmup(epoch):
-                        # Per-batch gradual LR scaling (Goyal et al.), the Horovod
-                        # warmup-callback granularity (reference :314-318). set_lr is
-                        # a dynamic-hyperparameter write — no recompilation.
-                        state = set_lr(
-                            state, warmup.lr_for_step(epoch, step_i, steps_per_epoch))
-                    images, labels = next(train_iter)
-                    state, metrics = train_step(state, images, labels, step_rng)
-                    losses.append(metrics["loss"])
-                    accs.append(metrics["accuracy"])
-                train_loss = float(np.mean(jax.device_get(losses)))
-                train_acc = float(np.mean(jax.device_get(accs)))
-                epoch_s = time.time() - t0
-                if tracing:
-                    jax.profiler.stop_trace()
-                    tracing = False
+            try:
+                for epoch in range(start_epoch, cfg.epochs):
+                    if cfg.trace_dir and epoch == start_epoch and jax.process_index() == 0:
+                        jax.profiler.start_trace(cfg.trace_dir)
+                        tracing = True
+                        if self.run is not None:
+                            # The report links this param as the per-run
+                            # profiler-trace artifact (Horovod-Timeline role).
+                            self.run.log_params(
+                                {"trace_dir": os.path.abspath(cfg.trace_dir)})
+                    t0 = time.time()
+                    losses, accs = [], []
+                    for step_i in range(steps_per_epoch):
+                        if in_warmup(epoch):
+                            # Per-batch gradual LR scaling (Goyal et al.), the Horovod
+                            # warmup-callback granularity (reference :314-318). set_lr is
+                            # a dynamic-hyperparameter write — no recompilation.
+                            state = set_lr(
+                                state, warmup.lr_for_step(epoch, step_i, steps_per_epoch))
+                        images, labels = next(train_iter)
+                        state, metrics = train_step(state, images, labels, step_rng)
+                        losses.append(metrics["loss"])
+                        accs.append(metrics["accuracy"])
+                    train_loss = float(np.mean(jax.device_get(losses)))
+                    train_acc = float(np.mean(jax.device_get(accs)))
+                    epoch_s = time.time() - t0
+                    if tracing:
+                        jax.profiler.stop_trace()
+                        tracing = False
 
-                vlosses, vaccs = [], []
-                viter = iter(val_loader_factory())
-                for _ in range(val_steps):
-                    images, labels = next(viter)
-                    m = eval_step(state, images, labels)
-                    vlosses.append(m["loss"])
-                    vaccs.append(m["accuracy"])
-                val_loss = float(np.mean(jax.device_get(vlosses)))
-                val_acc = float(np.mean(jax.device_get(vaccs)))
+                    vlosses, vaccs = [], []
+                    viter = iter(val_loader_factory())
+                    for _ in range(val_steps):
+                        images, labels = next(viter)
+                        m = eval_step(state, images, labels)
+                        vlosses.append(m["loss"])
+                        vaccs.append(m["accuracy"])
+                    val_loss = float(np.mean(jax.device_get(vlosses)))
+                    val_acc = float(np.mean(jax.device_get(vaccs)))
 
-                lr = get_lr(state)
-                row = {
-                    "epoch": epoch, "loss": train_loss, "accuracy": train_acc,
-                    "val_loss": val_loss, "val_accuracy": val_acc, "lr": lr,
-                    "epoch_seconds": epoch_s,
-                    "images_per_sec": steps_per_epoch * cfg.batch_size * world / epoch_s,
-                }
-                history.append(row)
-                epochs_run = epoch + 1
-                if self.run is not None:
-                    self.run.log_metrics(
-                        {k: v for k, v in row.items() if k != "epoch"}, step=epoch)
+                    lr = get_lr(state)
+                    row = {
+                        "epoch": epoch, "loss": train_loss, "accuracy": train_acc,
+                        "val_loss": val_loss, "val_accuracy": val_acc, "lr": lr,
+                        "epoch_seconds": epoch_s,
+                        "images_per_sec": steps_per_epoch * cfg.batch_size * world / epoch_s,
+                    }
+                    history.append(row)
+                    epochs_run = epoch + 1
+                    if self.run is not None:
+                        self.run.log_metrics(
+                            {k: v for k, v in row.items() if k != "epoch"}, step=epoch)
 
-                if cfg.debug_cross_host_checks:
-                    # SPMD consistency sanitizer (SURVEY §5): params must be identical
-                    # across hosts; checksum computed locally, compared via tracker logs.
-                    self.run and self.run.log_metric("params_checksum", params_checksum(state), epoch)
+                    if cfg.debug_cross_host_checks:
+                        # SPMD consistency sanitizer (SURVEY §5): params must be identical
+                        # across hosts; checksum computed locally, compared via tracker logs.
+                        self.run and self.run.log_metric("params_checksum", params_checksum(state), epoch)
 
-                # LR-plateau AFTER metrics are world-consistent (ordering contract,
-                # reference :310-313 — trivially satisfied: metrics are pmean-ed in-step)
-                if epoch + 1 >= cfg.warmup_epochs:
-                    new_lr = plateau.update(val_loss, lr)
-                    if new_lr != lr:
-                        state = set_lr(state, new_lr)
-                stop = early is not None and early.should_stop(val_loss)
-                if self._on_epoch is not None and self._on_epoch(row):
-                    stop = True
+                    # LR-plateau AFTER metrics are world-consistent (ordering contract,
+                    # reference :310-313 — trivially satisfied: metrics are pmean-ed in-step)
+                    if epoch + 1 >= cfg.warmup_epochs:
+                        new_lr = plateau.update(val_loss, lr)
+                        if new_lr != lr:
+                            state = set_lr(state, new_lr)
+                    stop = early is not None and early.should_stop(val_loss)
+                    if self._on_epoch is not None and self._on_epoch(row):
+                        stop = True
 
-                # Checkpoint AFTER the callbacks consumed this epoch's metrics,
-                # so the saved counters (and any plateau LR cut) are exactly the
-                # state the next epoch starts from — resume = continuation.
-                if ckpt and ((epoch + 1) % cfg.checkpoint_every_epochs == 0):
-                    callbacks = {"plateau": plateau.state_dict()}
-                    if early is not None:
-                        callbacks["early"] = early.state_dict()
-                    ckpt.save(state, int(jax.device_get(state.step)),
-                              metadata={"epoch": epoch, "val_loss": val_loss,
-                                        "val_accuracy": val_acc,
-                                        "callbacks": callbacks})
-                if stop:
-                    break
+                    # Checkpoint AFTER the callbacks consumed this epoch's metrics,
+                    # so the saved counters (and any plateau LR cut) are exactly the
+                    # state the next epoch starts from — resume = continuation.
+                    if ckpt and ((epoch + 1) % cfg.checkpoint_every_epochs == 0):
+                        callbacks = {"plateau": plateau.state_dict()}
+                        if early is not None:
+                            callbacks["early"] = early.state_dict()
+                        ckpt.save(state, int(jax.device_get(state.step)),
+                                  metadata={"epoch": epoch, "val_loss": val_loss,
+                                            "val_accuracy": val_acc,
+                                            "callbacks": callbacks})
+                    if stop:
+                        break
 
-            if ckpt is not None:
-                # async mode: last checkpoint durable + writer thread released
-                ckpt.close()
+            finally:
+                # Always runs — including the documented abort path where
+                # on_epoch / a pruner raises out of fit (examples 04/05):
+                # the async ckpt writer thread is joined and released, and
+                # any in-flight background write error surfaces here rather
+                # than being dropped; a dangling profiler trace is closed.
+                try:
+                    if tracing:
+                        jax.profiler.stop_trace()
+                finally:
+                    # unconditional even if stop_trace raises: the writer
+                    # thread must be joined either way
+                    if ckpt is not None:
+                        ckpt.close()
             return TrainResult(val_loss, val_acc, history, state, epochs_run)

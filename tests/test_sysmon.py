@@ -76,7 +76,8 @@ def test_tracking_cli(tmp_path, capsys):
 
 def test_html_report(tmp_path, capsys):
     """Report renderer: runs table with nested children, one SVG chart per
-    metric, sys.* excluded by default; CLI subcommand writes the file."""
+    metric, sys.* in their own utilization section; runs with a recorded
+    profiler trace get a link; CLI subcommand writes the file."""
     from ddw_tpu.tracking import __main__ as cli
     from ddw_tpu.tracking.report import render_report
     from ddw_tpu.tracking.tracker import Tracker
@@ -97,19 +98,32 @@ def test_html_report(tmp_path, capsys):
             grand.log_metric("val_loss", 0.125, 0)
             grand.log_metric("val_loss", float("nan"), 1)  # diverged tail
         parent.log_metric("best_loss", 0.25, 0)
+        parent.log_params({"trace_dir": "/tmp/trace"})  # traced run
 
     html_text = render_report(root, "exp1")
     assert parent.run_id in html_text
     assert grand.run_id in html_text             # depth-2 runs are not dropped
     assert "class='child'" in html_text          # nested rows indented
-    assert html_text.count("<polyline") == 2     # one val_loss line per child
+    training_charts = html_text.split("System utilization")[0]
+    assert training_charts.count("<polyline") == 2  # one val_loss line per child
     # grandchild's NaN point is dropped -> single finite point renders as a
     # circle (plus parent's lone best_loss point); no 'nan' leaks into coords
-    assert html_text.count("<circle") == 2
+    assert training_charts.count("<circle") == 2
     assert "nan" not in html_text.split("<svg", 1)[1].lower()
     assert "val_loss" in html_text and "best_loss" in html_text
-    assert "sys.cpu" not in html_text            # excluded by default
-    assert render_report(root, "exp1", include_sys=True).count("sys.cpu") > 0
+    # sys.* series render in their own section, not among training metrics
+    assert "System utilization" in html_text
+    metrics_section = html_text.split("System utilization")[0]
+    assert "sys.cpu" not in metrics_section
+    assert "sys.cpu" in html_text
+    assert "sys.cpu" not in render_report(root, "exp1", include_sys=False)
+    # traced run links its profile; untraced rows get an empty cell
+    assert "<a href='file:///tmp/trace'>profile</a>" in html_text
+    assert "trace_dir=" not in html_text          # not duplicated in params
+
+    # metric-column truncation is indicated, not silent
+    cap = render_report(root, "exp1", max_metric_cols=1)
+    assert "+1 more" in cap
 
     out_file = str(tmp_path / "r.html")
     cli.main([root, "report", "-e", "exp1", "-o", out_file])
