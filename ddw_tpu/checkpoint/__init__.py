@@ -1,1 +1,2 @@
 from ddw_tpu.checkpoint.ckpt import save_checkpoint, restore_checkpoint, latest_step, CheckpointManager  # noqa: F401
+from ddw_tpu.checkpoint.sharded import save_sharded, restore_sharded, ShardedCheckpointManager  # noqa: F401

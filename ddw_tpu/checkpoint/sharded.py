@@ -1,0 +1,297 @@
+"""Sharded (per-process) checkpointing — the Orbax-layout role.
+
+The rank-0 checkpoint (:mod:`ddw_tpu.checkpoint.ckpt`) matches the reference's
+Keras ``ModelCheckpoint`` contract (rank-0 writes the whole state,
+``Part 2 - Distributed Tuning & Inference/02_hyperopt_distributed_model.py:206-211``)
+— correct for replicated states, but a ZeRO/TP/PP-sharded state would be
+all-gathered into one host's RAM on every save. This module writes a
+distributed checkpoint instead: every process serializes exactly the array
+shards its local devices own (replica 0 only, so replicated leaves are written
+once), plus a global index; restore rebuilds a sharded state via
+``jax.make_array_from_callback``, reading only the slices each host's devices
+need. No host ever materializes a full sharded leaf, on save or restore.
+
+Layout of one checkpoint::
+
+    <dir>/step_<N>/
+      index.json     # step, metadata, n_processes, leaf path -> shape/dtype
+      proc_<i>.bin   # concatenated raw shard bytes written by process i
+      proc_<i>.json  # shard table: leaf path, global offsets, local shape, byte range
+      commit_<i>     # per-process commit marker
+
+Commit protocol (shared filesystem, no collective): process 0 creates
+``step_<N>.tmp``; every process writes its shard file + commit marker into it;
+process 0 waits for all markers, writes ``index.json``, and atomically renames
+to ``step_<N>``. Readers treat only renamed directories as checkpoints, so a
+partially written save is never restorable.
+
+Resharding restore: a requested device slice is assembled from every saved
+shard that overlaps it, so a state saved on one mesh (say ``{'data': 8}``)
+restores onto a different one (``{'data': 4}``, or different axis splits)
+without any intermediate full array.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+
+import jax
+import numpy as np
+
+from ddw_tpu.checkpoint.ckpt import _apply_retention, _list_steps
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """numpy dtype from its string name, including ml_dtypes extension types
+    (bfloat16, float8_*) that ``np.dtype`` alone does not resolve."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _wait_for(pred, timeout_s: float, what: str) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"sharded checkpoint: timed out waiting for {what}")
+        time.sleep(0.05)
+
+
+def _flat_with_paths(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(p), leaf) for p, leaf in flat]
+
+
+def _start_offsets(index, shape) -> list[int]:
+    """Global start offset per dim of a shard's index (tuple of slices)."""
+    return [int(sl.indices(dim)[0]) for sl, dim in zip(index, shape)]
+
+
+def save_sharded(ckpt_dir: str, state, step: int, metadata: dict | None = None,
+                 keep: int = 3, timeout_s: float = 300.0) -> str:
+    """Collective save: every process must call this with the same ``step``.
+    Returns the final checkpoint path (once it is committed)."""
+    pid = jax.process_index()
+    nproc = jax.process_count()
+    final = os.path.join(ckpt_dir, f"step_{step:010d}")
+    tmp = final + ".tmp"
+    if pid == 0:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+    else:
+        _wait_for(lambda: os.path.isdir(tmp), timeout_s, f"writer to create {tmp}")
+
+    entries: list[dict] = []
+    leaves_meta: dict[str, dict] = {}
+    bin_partial = os.path.join(tmp, f"proc_{pid}.bin.partial")
+    offset = 0
+    with open(bin_partial, "wb") as f:
+        for path_str, leaf in _flat_with_paths(state):
+            if isinstance(leaf, jax.Array):
+                leaves_meta[path_str] = {"shape": list(leaf.shape),
+                                         "dtype": str(leaf.dtype)}
+                for sh in leaf.addressable_shards:
+                    if sh.replica_id != 0:
+                        continue  # exactly one replica writes each slice
+                    data = np.asarray(sh.data)
+                    raw = data.tobytes()
+                    entries.append({
+                        "leaf": path_str,
+                        "start": _start_offsets(sh.index, leaf.shape),
+                        "shape": list(data.shape),
+                        "offset": offset,
+                        "nbytes": len(raw),
+                    })
+                    f.write(raw)
+                    offset += len(raw)
+            else:
+                # host-side leaf (plain scalar / numpy): process 0 owns it
+                data = np.asarray(leaf)
+                leaves_meta[path_str] = {"shape": list(data.shape),
+                                         "dtype": str(data.dtype),
+                                         "host": True}
+                if pid == 0:
+                    raw = data.tobytes()
+                    entries.append({"leaf": path_str,
+                                    "start": [0] * data.ndim,
+                                    "shape": list(data.shape),
+                                    "offset": offset, "nbytes": len(raw)})
+                    f.write(raw)
+                    offset += len(raw)
+    os.replace(bin_partial, os.path.join(tmp, f"proc_{pid}.bin"))
+    with open(os.path.join(tmp, f"proc_{pid}.json.partial"), "w") as f:
+        json.dump({"entries": entries}, f)
+    os.replace(os.path.join(tmp, f"proc_{pid}.json.partial"),
+               os.path.join(tmp, f"proc_{pid}.json"))
+    with open(os.path.join(tmp, f"commit_{pid}"), "w") as f:
+        f.write("ok")
+
+    if pid == 0:
+        _wait_for(
+            lambda: all(os.path.exists(os.path.join(tmp, f"commit_{i}"))
+                        for i in range(nproc)),
+            timeout_s, f"all {nproc} commit markers in {tmp}")
+        with open(os.path.join(tmp, "index.json"), "w") as f:
+            json.dump({"step": step, "created_unix": time.time(),
+                       "n_processes": nproc, "metadata": metadata or {},
+                       "leaves": leaves_meta}, f, indent=2)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        _apply_retention(ckpt_dir, keep)
+    else:
+        _wait_for(lambda: os.path.isdir(final), timeout_s,
+                  f"writer to commit {final}")
+    return final
+
+
+class _ShardReader:
+    """Assembles arbitrary slices of one leaf from its saved shards, reading
+    only the byte ranges that overlap the request."""
+
+    def __init__(self, dirp: str, shards: list[dict], shape, dtype: np.dtype):
+        self.dirp = dirp
+        self.shards = shards
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self._files: dict[str, object] = {}
+
+    def _file(self, name: str):
+        f = self._files.get(name)
+        if f is None:
+            f = self._files[name] = open(os.path.join(self.dirp, name), "rb")
+        return f
+
+    def read(self, index) -> np.ndarray:
+        # normalize the requested index to per-dim (start, stop)
+        req = [sl.indices(d)[:2] for sl, d in zip(index, self.shape)]
+        out_shape = [stop - start for start, stop in req]
+        out = np.empty(out_shape, self.dtype)
+        filled = 0
+        for e in self.shards:
+            inter = []
+            for (rs, re_), ss, sdim in zip(req, e["start"], e["shape"]):
+                lo, hi = max(rs, ss), min(re_, ss + sdim)
+                if lo >= hi:
+                    inter = None
+                    break
+                inter.append((lo, hi, ss, rs))
+            if inter is None and self.shape:  # no overlap on some dim
+                continue
+            f = self._file(e["file"])
+            f.seek(e["offset"])
+            raw = f.read(e["nbytes"])
+            src = np.frombuffer(raw, self.dtype).reshape(e["shape"])
+            if not self.shape:  # scalar leaf
+                return src.reshape(())
+            src_sl = tuple(slice(lo - ss, hi - ss) for lo, hi, ss, _ in inter)
+            dst_sl = tuple(slice(lo - rs, hi - rs) for lo, hi, _, rs in inter)
+            out[dst_sl] = src[src_sl]
+            filled += int(np.prod([hi - lo for lo, hi, _, _ in inter]))
+        if filled != int(np.prod(out_shape)):
+            raise ValueError(
+                f"saved shards cover only {filled}/{int(np.prod(out_shape))} "
+                f"elements of the requested slice — incomplete checkpoint?")
+        return out
+
+    def close(self) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+def restore_sharded(ckpt_dir: str, target, shardings, step: int | None = None):
+    """Restore into ``target``'s structure with the given per-leaf shardings.
+
+    ``target`` is a template pytree (TrainState of arrays or ShapeDtypeStructs)
+    and ``shardings`` a matching pytree of ``jax.sharding.Sharding`` — e.g.
+    :func:`ddw_tpu.parallel.zero.zero_state_shardings` output. Each process
+    reads only the slices its devices need. Returns ``(state, step)`` or
+    ``(target, None)`` when no checkpoint exists.
+    """
+    if step is None:
+        steps = _list_steps(ckpt_dir)
+        if not steps:
+            return target, None
+        step = max(steps)
+    dirp = os.path.join(ckpt_dir, f"step_{step:010d}")
+    with open(os.path.join(dirp, "index.json")) as f:
+        index = json.load(f)
+    by_leaf: dict[str, list[dict]] = {}
+    for i in range(index["n_processes"]):
+        with open(os.path.join(dirp, f"proc_{i}.json")) as f:
+            for e in json.load(f)["entries"]:
+                e["file"] = f"proc_{i}.bin"
+                by_leaf.setdefault(e["leaf"], []).append(e)
+
+    flat_t = _flat_with_paths(target)
+    flat_s = _flat_with_paths(shardings)
+    if [p for p, _ in flat_t] != [p for p, _ in flat_s]:
+        raise ValueError("target and shardings pytrees differ in structure")
+    out_leaves = []
+    readers = []
+    for (path_str, tgt), (_, sharding) in zip(flat_t, flat_s):
+        meta = index["leaves"].get(path_str)
+        if meta is None:
+            raise KeyError(f"checkpoint has no leaf {path_str!r}")
+        shape = tuple(meta["shape"])
+        dtype = _np_dtype(meta["dtype"])
+        tshape = tuple(getattr(tgt, "shape", shape))
+        if tshape != shape:
+            raise ValueError(f"{path_str}: target shape {tshape} != saved {shape}")
+        reader = _ShardReader(dirp, by_leaf.get(path_str, []), shape, dtype)
+        readers.append(reader)
+        if hasattr(sharding, "device_set"):
+            arr = jax.make_array_from_callback(shape, sharding, reader.read)
+        else:  # host-side leaf: keep it a host value
+            arr = reader.read(tuple(slice(0, d) for d in shape))
+        out_leaves.append(arr)
+    structure = jax.tree_util.tree_structure(target)
+    state = jax.tree_util.tree_unflatten(structure, out_leaves)
+    # make_array_from_callback is lazy per-device; force the reads before
+    # closing the files
+    jax.block_until_ready([x for x in out_leaves if isinstance(x, jax.Array)])
+    for r in readers:
+        r.close()
+    return state, step
+
+
+def read_metadata(ckpt_dir: str, step: int | None = None) -> dict | None:
+    if step is None:
+        steps = _list_steps(ckpt_dir)
+        if not steps:
+            return None
+        step = max(steps)
+    with open(os.path.join(ckpt_dir, f"step_{step:010d}", "index.json")) as f:
+        return json.load(f)
+
+
+class ShardedCheckpointManager:
+    """Directory + retention binding for the sharded format, mirroring
+    :class:`ddw_tpu.checkpoint.ckpt.CheckpointManager`'s surface. Save is
+    collective (every process calls it); restore reads only local slices."""
+
+    def __init__(self, ckpt_dir: str, keep: int = 3):
+        self.ckpt_dir = ckpt_dir
+        self.keep = keep
+
+    def save(self, state, step: int, metadata: dict | None = None) -> str:
+        return save_sharded(self.ckpt_dir, state, step, metadata, self.keep)
+
+    def restore(self, target, shardings, step: int | None = None):
+        return restore_sharded(self.ckpt_dir, target, shardings, step)
+
+    def latest_step(self) -> int | None:
+        steps = _list_steps(self.ckpt_dir)
+        return max(steps) if steps else None
+
+    def read_metadata(self, step: int | None = None) -> dict | None:
+        meta = read_metadata(self.ckpt_dir, step)
+        return meta["metadata"] if meta else None

@@ -131,6 +131,73 @@ def test_worker_crash_gang_kills_then_resume(silver, store, worker_pythonpath,
     assert np.isfinite(out["val_loss"])
 
 
+def _sharded_ckpt_worker(ckpt_root: str) -> dict:
+    """Each process saves only its local ZeRO-1 shards; restore reads only
+    local slices. Returns byte accounting for rank-0 assertions."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from ddw_tpu.checkpoint.sharded import restore_sharded, save_sharded
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.parallel.zero import zero_state_shardings
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+    from ddw_tpu.train.step import init_state
+    from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+    mesh = make_mesh(MeshSpec((("data", -1),)))
+    mcfg = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                    dtype="float32")
+    tcfg = TrainCfg(batch_size=8, learning_rate=1e-2)
+    state, _ = init_state(build_model(mcfg), mcfg, tcfg, (16, 16, 3),
+                          jax.random.PRNGKey(0))
+    sh = zero_state_shardings(state, mesh)
+    host = jax.tree.map(np.asarray, state)  # identical on every host (seed)
+    gstate = jax.tree.map(
+        lambda x, s: jax.make_array_from_callback(x.shape, s,
+                                                  lambda idx: x[idx]),
+        host, sh)
+
+    path = save_sharded(ckpt_root, gstate, step=5, metadata={"who": "gang"})
+    restored, at = restore_sharded(ckpt_root, host, sh)
+
+    shards_equal = True
+    for a, b in zip(jax.tree.leaves(gstate), jax.tree.leaves(restored)):
+        for sa, sb in zip(a.addressable_shards, b.addressable_shards):
+            shards_equal &= bool(np.array_equal(np.asarray(sa.data),
+                                                np.asarray(sb.data)))
+    nbytes = lambda t: sum(  # noqa: E731
+        l.size * l.dtype.itemsize for l in jax.tree.leaves(t))
+    return {
+        "at": at,
+        "shards_equal": shards_equal,
+        "bin_sizes": [os.path.getsize(os.path.join(path, f"proc_{i}.bin"))
+                      for i in range(jax.process_count())],
+        "opt_bytes": nbytes(state.opt_state),
+        "total_bytes": nbytes(state),
+    }
+
+
+def test_two_process_sharded_checkpoint(worker_pythonpath, tmp_path):
+    """ZeRO-1 state checkpointed across a real 2-process gang with no host
+    holding the full optimizer state (VERDICT r2 item 4): each process's
+    shard file holds its slices exactly once, and together they hold every
+    element exactly once."""
+    out = Launcher(np=2, devices_per_proc=2, timeout_s=540).run(
+        functools.partial(_sharded_ckpt_worker, str(tmp_path / "shck")))
+    assert out["at"] == 5
+    assert out["shards_equal"]
+    size0, size1 = out["bin_sizes"]
+    # exactly-once: the two shard files together are the state, byte for byte
+    assert size0 + size1 == out["total_bytes"]
+    # process 1 wrote its half of the sharded optimizer moments — and only
+    # that (params/batch_stats replicas all have replica_id 0 on process 0)
+    assert 0.25 * out["opt_bytes"] <= size1 <= 0.5 * out["opt_bytes"]
+    # so neither host serialized the full state
+    assert size0 < out["total_bytes"]
+
+
 def _score_worker(table_root: str, pkg_dir: str, out_root: str) -> dict:
     import jax
 

@@ -1,0 +1,127 @@
+"""Sharded checkpointing: per-process shard files, exactly-once bytes,
+reshard-on-restore, and the ZeRO-1 integration (VERDICT r2 item 4)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddw_tpu.checkpoint.sharded import (
+    ShardedCheckpointManager,
+    restore_sharded,
+    save_sharded,
+)
+from ddw_tpu.models.registry import build_model
+from ddw_tpu.parallel.zero import make_zero_train_step, zero_state_shardings
+from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
+from ddw_tpu.train.step import init_state
+from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+IMG = (16, 16, 3)
+
+
+def _zero_state(n_dev, seed=0):
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, n_dev),)),
+                     devices=jax.devices()[:n_dev])
+    mcfg = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                    dtype="float32")
+    tcfg = TrainCfg(batch_size=8, learning_rate=1e-2)
+    m = build_model(mcfg)
+    state, tx = init_state(m, mcfg, tcfg, IMG, jax.random.PRNGKey(seed))
+    step = make_zero_train_step(m, tx, mesh, donate=False)
+    state = step.place_state(state)
+    rng = np.random.RandomState(seed)
+    imgs = rng.randn(16, *IMG).astype(np.float32)
+    lbls = rng.randint(0, 5, size=(16,)).astype(np.int32)
+    state, _ = step(state, imgs, lbls, jax.random.PRNGKey(1))
+    return mesh, state
+
+
+def _state_bytes(state) -> int:
+    return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(state)
+               if hasattr(l, "size"))
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_save_restore_roundtrip_zero_sharded(tmp_path):
+    mesh, state = _zero_state(8)
+    d = str(tmp_path / "ck")
+    path = save_sharded(d, state, step=7, metadata={"epoch": 1})
+    assert os.path.isdir(path) and path.endswith("step_0000000007")
+
+    # exactly-once bytes: shard files together hold each element once,
+    # replicated leaves included (no per-device duplication)
+    bin_bytes = sum(os.path.getsize(os.path.join(path, f))
+                    for f in os.listdir(path) if f.endswith(".bin"))
+    assert bin_bytes == _state_bytes(state)
+
+    sh = zero_state_shardings(state, mesh)
+    restored, at = restore_sharded(d, jax.tree.map(np.asarray, state), sh)
+    assert at == 7
+    _assert_trees_equal(state, restored)
+    # restored optimizer state actually lives sharded
+    specs = [l.sharding.spec for l in jax.tree.leaves(restored.opt_state)]
+    assert any(DATA_AXIS in (ax for ax in spec if ax) for spec in specs)
+
+
+def test_restore_onto_different_mesh_reshards(tmp_path):
+    """Saved on {'data': 8}, restored onto {'data': 4}: slices are assembled
+    from overlapping shards, values identical."""
+    _, state = _zero_state(8)
+    d = str(tmp_path / "ck")
+    save_sharded(d, state, step=1)
+
+    mesh4 = make_mesh(MeshSpec(((DATA_AXIS, 4),)), devices=jax.devices()[:4])
+    sh4 = zero_state_shardings(state, mesh4)
+    restored, at = restore_sharded(d, jax.tree.map(np.asarray, state), sh4)
+    assert at == 1
+    _assert_trees_equal(state, restored)
+    assert all(l.sharding.mesh.shape[DATA_AXIS] == 4
+               for l in jax.tree.leaves(restored.opt_state))
+
+
+def test_manager_latest_metadata_retention(tmp_path):
+    _, state = _zero_state(4)
+    mgr = ShardedCheckpointManager(str(tmp_path / "ck"), keep=2)
+    for s in (3, 6, 9):
+        mgr.save(state, s, metadata={"s": s})
+    assert mgr.latest_step() == 9
+    assert mgr.read_metadata() == {"s": 9}
+    # retention kept the newest two only
+    dirs = sorted(os.listdir(tmp_path / "ck"))
+    assert dirs == ["step_0000000006", "step_0000000009"]
+
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, 4),)), devices=jax.devices()[:4])
+    sh = zero_state_shardings(state, mesh)
+    _, at = mgr.restore(jax.tree.map(np.asarray, state), sh, step=6)
+    assert at == 6
+
+
+def test_missing_checkpoint_returns_none(tmp_path):
+    _, state = _zero_state(2)
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, 2),)), devices=jax.devices()[:2])
+    sh = zero_state_shardings(state, mesh)
+    out, at = restore_sharded(str(tmp_path / "nope"), state, sh)
+    assert at is None and out is state
+
+
+def test_structure_mismatch_raises(tmp_path):
+    mesh, state = _zero_state(2)
+    d = str(tmp_path / "ck")
+    save_sharded(d, state, step=1)
+    sh = zero_state_shardings(state, mesh)
+    with pytest.raises(ValueError, match="structure"):
+        restore_sharded(d, state, sh.params)  # wrong pytree
+
+    repl = NamedSharding(mesh, P())
+    bad_target = jax.tree.map(
+        lambda l: np.zeros((3,) + tuple(l.shape), l.dtype), state)
+    bad_sh = jax.tree.map(lambda _: repl, state)
+    with pytest.raises(ValueError, match="shape"):
+        restore_sharded(d, bad_target, bad_sh)
