@@ -33,7 +33,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from ddw_tpu.tune.space import Dim, sample_space
+from ddw_tpu.tune.space import ChoiceOf, Dim, sample_space, validate_space
 
 STATUS_OK = "ok"
 STATUS_FAIL = "fail"
@@ -147,6 +147,7 @@ def suggest(space: dict[str, Dim], trials: Trials, rng: np.random.RandomState,
     workers don't pile onto the same proposal (round-1 advisor note on
     duplicate concurrent proposals).
     """
+    validate_space(space)
     done = trials.completed()
     pending = pending or []
     if len(done) < n_startup_trials:
@@ -165,12 +166,29 @@ def suggest(space: dict[str, Dim], trials: Trials, rng: np.random.RandomState,
     n_good = max(1, min(int(np.ceil(gamma * np.sqrt(len(done)))), 25))
     order = np.argsort(losses)
     good_idx, bad_idx = set(order[:n_good].tolist()), set(order[n_good:].tolist())
-    out = {}
-    for name, dim in space.items():
+    def histories(name: str) -> tuple[list, list]:
+        """(good, bad) observed values for one dim; trials without the dim
+        (other branches of a ChoiceOf) simply don't contribute — which is how
+        conditional dims condition on their branch."""
         good = [done[i]["params"][name] for i in good_idx if name in done[i]["params"]]
         bad = [done[i]["params"][name] for i in bad_idx if name in done[i]["params"]]
         bad += [p[name] for p in pending if name in p]
-        out[name] = _suggest_dim(rng, dim, good, bad, n_ei_candidates)
+        return good, bad
+
+    out = {}
+    for name, dim in space.items():
+        if isinstance(dim, ChoiceOf):
+            # two-stage TPE on the tree: pick the branch by EI over branch
+            # values, then suggest the selected branch's sub-dims from the
+            # sub-histories (only trials that took this branch have them)
+            val = _suggest_dim(rng, dim.branch_dim(), *histories(name),
+                               n_ei_candidates)
+            out[name] = val
+            for sub_name, sub_dim in dim.subspace(val).items():
+                out[sub_name] = _suggest_dim(rng, sub_dim, *histories(sub_name),
+                                             n_ei_candidates)
+        else:
+            out[name] = _suggest_dim(rng, dim, *histories(name), n_ei_candidates)
     return out
 
 
@@ -203,6 +221,7 @@ def fmin(
     ``Pruned``, the trial records as ``STATUS_PRUNED``, and the search
     continues (pruned trials never enter the TPE good/bad split).
     """
+    validate_space(space)
     trials = trials if trials is not None else Trials()
     rng = np.random.RandomState(seed)
 

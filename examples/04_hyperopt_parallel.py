@@ -24,16 +24,26 @@ from examples.common import parse_args, require_tables, setup
 from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
 from ddw_tpu.serving.package import save_packaged_model
 from ddw_tpu.train.trainer import Trainer
-from ddw_tpu.tune import STATUS_OK, Trials, choice, fmin, loguniform, uniform
+from ddw_tpu.tune import STATUS_OK, Trials, choice, choice_of, fmin, loguniform, uniform
 
 
-def main():
-    args = parse_args(__doc__, extra=lambda ap: ap.add_argument(
+def _extra_flags(ap):
+    ap.add_argument(
         "--cache-features", action="store_true",
         help="frozen-transfer HPO fast path: featurize ONCE, then every "
              "trial trains only the head from the shared cache — valid "
              "because all searched hyperparameters (dropout/lr/optimizer) "
-             "sit above the pooled features (ddw_tpu.train.transfer)"))
+             "sit above the pooled features (ddw_tpu.train.transfer)")
+    ap.add_argument(
+        "--nested-space", action="store_true",
+        help="conditional search space (hp.choice over sub-spaces): each "
+             "optimizer carries its OWN learning-rate range — Adam wants "
+             "~1e-4..1e-2 while Adadelta works near 1.0, so a shared "
+             "loguniform wastes half its mass per branch")
+
+
+def main():
+    args = parse_args(__doc__, extra=_extra_flags)
     ws = setup(args)
     cfgs = ws["cfgs"]
     tune_cfg = cfgs["tune"]
@@ -54,12 +64,24 @@ def main():
               f"{feat_val.num_records} records "
               f"(dim {feat_train.meta['feature_dim']}) — trials train heads only")
 
-    # hyperopt space of the reference (:194-198)
-    space = {
-        "optimizer": choice("optimizer", ["adadelta", "adam"]),
-        "learning_rate": loguniform("learning_rate", -5, 0),
-        "dropout": uniform("dropout", 0.1, 0.9),
-    }
+    if args.nested_space:
+        # conditional space: the optimizer choice gates optimizer-specific LR
+        # ranges (the reference's flat space at :194-198, tree-structured the
+        # way hyperopt's hp.choice-over-subspaces idiom allows)
+        space = {
+            "optimizer": choice_of("optimizer", {
+                "adam": {"adam_lr": loguniform("adam_lr", -9, -2)},
+                "adadelta": {"adadelta_lr": loguniform("adadelta_lr", -4, 1)},
+            }),
+            "dropout": uniform("dropout", 0.1, 0.9),
+        }
+    else:
+        # hyperopt space of the reference (:194-198)
+        space = {
+            "optimizer": choice("optimizer", ["adadelta", "adam"]),
+            "learning_rate": loguniform("learning_rate", -5, 0),
+            "dropout": uniform("dropout", 0.1, 0.9),
+        }
 
     devices = jax.devices()
     parallelism = min(tune_cfg.parallelism, len(devices))
@@ -86,7 +108,11 @@ def main():
             train_cfg = copy.deepcopy(cfgs["train"])
             model_cfg.dropout = float(params["dropout"])
             train_cfg.optimizer = params["optimizer"]
-            train_cfg.learning_rate = float(params["learning_rate"])
+            # flat space logs 'learning_rate'; the nested space carries the
+            # selected branch's dim only
+            lr = params.get("learning_rate",
+                            params.get("adam_lr", params.get("adadelta_lr")))
+            train_cfg.learning_rate = float(lr)
             train_cfg.scale_lr_by_world = False
             train_cfg.checkpoint_dir = ""
             mesh = make_mesh(MeshSpec(((DATA_AXIS, 1),)), devices=[devices[slot]])
