@@ -38,13 +38,30 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def collect_sown(mods: dict, name: str) -> list:
+    """Every value sown under ``name`` anywhere in an ``intermediates``
+    collection (flax stores sows as tuples). MoE blocks sow several keys
+    (aux loss, routing telemetry, raw gate logits) — consumers MUST select by
+    name rather than summing all leaves, or telemetry leaks into the loss."""
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(mods.get("intermediates", mods))
+    return [x for path, leaf in flat.items() if name in path
+            for x in (leaf if isinstance(leaf, (tuple, list)) else (leaf,))]
+
+
 def top1_routing(gate_logits: jnp.ndarray, capacity: int):
     """Switch top-1 routing with static capacity.
 
     ``gate_logits`` [T, E] (f32) -> (dispatch [T, E, C] one-hot, combine
-    [T, E, C] gate-weighted, aux_loss scalar). Tokens beyond an expert's
-    capacity get an all-zero dispatch row (they skip the expert; the caller's
-    residual carries them).
+    [T, E, C] gate-weighted, aux_loss scalar, stats dict). Tokens beyond an
+    expert's capacity get an all-zero dispatch row (they skip the expert; the
+    caller's residual carries them).
+
+    ``stats`` telemetry (all scalars except ``expert_frac`` [E]):
+    ``drop_rate`` — fraction of tokens past capacity; ``balance_entropy`` —
+    entropy of the expert-assignment distribution normalized by ``log E``
+    (1.0 = perfectly balanced, 0.0 = collapsed onto one expert).
     """
     t, e = gate_logits.shape
     probs = jax.nn.softmax(gate_logits, axis=-1)              # [T, E]
@@ -65,7 +82,13 @@ def top1_routing(gate_logits: jnp.ndarray, capacity: int):
     frac = jnp.mean(onehot, axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(frac * mean_prob)
-    return dispatch, combine, aux
+    stats = {
+        "drop_rate": 1.0 - jnp.mean(keep.astype(probs.dtype)),
+        "balance_entropy": (-jnp.sum(frac * jnp.log(frac + 1e-9))
+                            / jnp.log(float(e))),
+        "expert_frac": frac,
+    }
+    return dispatch, combine, aux, stats
 
 
 class MoEMlp(nn.Module):
@@ -97,8 +120,16 @@ class MoEMlp(nn.Module):
             xt.astype(jnp.float32))
         capacity = (t if self.no_drop
                     else max(1, int(-(-self.capacity_factor * t // e))))
-        dispatch, combine, aux = top1_routing(gate_logits, capacity)
+        dispatch, combine, aux, stats = top1_routing(gate_logits, capacity)
         self.sow("intermediates", "moe_aux_loss", aux)
+        # Routing telemetry for characterization (tools/moe_capacity_sweep.py)
+        # and observability; reductions over these are cheap next to the FFNs.
+        self.sow("intermediates", "moe_drop_rate", stats["drop_rate"])
+        self.sow("intermediates", "moe_balance_entropy",
+                 stats["balance_entropy"])
+        # Raw router scores for offline capacity sweeps; unused sows are
+        # dead-code-eliminated by XLA in training steps.
+        self.sow("intermediates", "gate_logits", gate_logits)
 
         # Stacked expert weights: one batched einsum per matmul (MXU-friendly),
         # identical param layout with and without EP.

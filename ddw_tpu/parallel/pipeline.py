@@ -153,9 +153,13 @@ def make_pp_lm_train_step(
         dense models)."""
         def body(h, block_params):
             if moe:
+                from ddw_tpu.models.moe import collect_sown
+
                 out, mods = block_mod.apply({"params": block_params}, h, False,
                                             mutable=["intermediates"])
-                sown = jax.tree.leaves(mods["intermediates"])
+                # select the aux loss by name: blocks also sow routing
+                # telemetry that must not enter the loss
+                sown = collect_sown(mods, "moe_aux_loss")
                 return out, sum(sown)
             return block_mod.apply({"params": block_params}, h, False), 0.0
 

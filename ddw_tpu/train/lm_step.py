@@ -90,8 +90,12 @@ def make_lm_train_step(
                 logits, mods = model.apply(
                     {"params": params}, in_mb, train=True,
                     rngs={"dropout": rng_mb}, mutable=["intermediates"])
-                # one sown scalar per MoE block; mean over blocks
-                sown = jax.tree.leaves(mods["intermediates"])
+                # one sown scalar per MoE block; mean over blocks. Selected by
+                # name — blocks also sow routing telemetry (drop rate,
+                # balance entropy, gate logits) that must not leak in.
+                from ddw_tpu.models.moe import collect_sown
+
+                sown = collect_sown(mods, "moe_aux_loss")
                 aux = sum(sown) / len(sown)
             else:
                 logits = model.apply({"params": params}, in_mb, train=True,

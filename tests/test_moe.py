@@ -31,7 +31,7 @@ def moe_lm(expert_axis=None, num_experts=4, cf=8.0):
 def test_top1_routing_invariants():
     rng = np.random.RandomState(0)
     logits = jnp.asarray(rng.randn(64, 4).astype(np.float32))
-    dispatch, combine, aux = top1_routing(logits, capacity=64)
+    dispatch, combine, aux, stats = top1_routing(logits, capacity=64)
     # no drops at full capacity: every token dispatched exactly once
     np.testing.assert_allclose(np.asarray(dispatch.sum((1, 2))), 1.0)
     # combine = gate prob of the chosen expert
@@ -41,12 +41,35 @@ def test_top1_routing_invariants():
     # each (expert, slot) holds at most one token
     assert float(np.asarray(dispatch.sum(0)).max()) <= 1.0 + 1e-6
     assert np.isfinite(float(aux)) and float(aux) >= 1.0 - 1e-6
+    assert float(stats["drop_rate"]) == 0.0
 
     # tight capacity: overflow tokens get empty dispatch rows, never doubled
-    dispatch2, _, _ = top1_routing(logits, capacity=2)
+    dispatch2, _, _, stats2 = top1_routing(logits, capacity=2)
     per_tok = np.asarray(dispatch2.sum((1, 2)))
     assert set(np.round(per_tok, 6)) <= {0.0, 1.0}
     assert float(np.asarray(dispatch2.sum((0, 2))).max()) <= 2.0 + 1e-6
+    # telemetry agrees with the dispatch tensor
+    np.testing.assert_allclose(float(stats2["drop_rate"]),
+                               1.0 - per_tok.mean(), rtol=1e-6)
+
+
+def test_no_drop_at_capacity_one_with_balanced_routing():
+    """The Switch contract pinned (VERDICT r2 item 7): with perfectly balanced
+    routing, capacity factor 1.0 (C = T/E exactly) drops nothing; entropy
+    telemetry reads 1.0. A fully collapsed router at cf=1 drops 1 - C/T."""
+    t, e = 64, 4
+    balanced = jax.nn.one_hot(jnp.arange(t) % e, e) * 10.0  # T/E tokens each
+    cap = t // e  # ceil(1.0 * T / E)
+    dispatch, _, _, stats = top1_routing(balanced, capacity=cap)
+    np.testing.assert_allclose(np.asarray(dispatch.sum((1, 2))), 1.0)
+    assert float(stats["drop_rate"]) == 0.0
+    np.testing.assert_allclose(float(stats["balance_entropy"]), 1.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats["expert_frac"]), 1.0 / e)
+
+    collapsed = jnp.zeros((t, e)).at[:, 0].set(10.0)  # everyone -> expert 0
+    _, _, _, s2 = top1_routing(collapsed, capacity=cap)
+    np.testing.assert_allclose(float(s2["drop_rate"]), 1.0 - cap / t, rtol=1e-6)
+    assert float(s2["balance_entropy"]) < 0.01
 
 
 def test_moe_layer_ep_matches_dense():
