@@ -1,0 +1,122 @@
+"""Export a MobileNetV2Backbone to torchvision / Keras weight layouts.
+
+The exact inverse of the two import paths in :mod:`ddw_tpu.models.convert` —
+which exists so the full transfer contract can be *proved* in-repo, not just
+unit-tested against synthetic dicts: pretrain a backbone here, export it in
+the same layouts the reference's pretrained artifacts ship in (torchvision
+``mobilenet_v2`` state_dict; Keras-applications weights, the format the
+reference downloads at ``Part 1 - Distributed Training/
+02_model_training_single_node.py:164``), then run it back through
+``convert.py`` and the frozen-base head-training chain. Round-trip is exact:
+``convert_torch_mobilenet_v2(export_torch_mobilenet_v2(v)) == v`` up to the
+BN-epsilon fold, which both directions apply symmetrically.
+
+Layout mirrors (see the converter for the forward mapping):
+- conv kernels: flax ``[kh, kw, in, out]`` -> torch ``[out, in, kh, kw]``
+  (same transpose handles depthwise: flax ``[kh,kw,1,C]`` -> torch ``[C,1,kh,kw]``);
+  Keras keeps flax layout for regular convs, ``[kh,kw,C,1]`` for depthwise.
+- BatchNorm: our scale carries the Keras epsilon (1e-3); exporting to torch
+  (eps 1e-5) inverts the fold ``scale' = scale * sqrt((var+eps_dst)/(var+eps_src))``
+  so a subsequent import reproduces the original values exactly. Keras shares
+  our epsilon, so its fold is the identity.
+
+Any ``width_mult`` exports fine — both layouts are name-positional, and the
+converter validates shapes against the target model on load.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ddw_tpu.models.convert import _EPS_FLAX, _EPS_TORCH
+from ddw_tpu.models.mobilenet_v2 import _INVERTED_RESIDUAL_CFG
+
+
+def _t(kernel: np.ndarray) -> np.ndarray:
+    """flax conv kernel -> torch layout."""
+    return np.asarray(kernel, np.float32).transpose(3, 2, 0, 1)
+
+
+def _bn_out(sub_p: dict, sub_s: dict, eps_dst: float) -> tuple[np.ndarray, ...]:
+    """(weight, bias, mean, var) with the epsilon fold inverted for eps_dst."""
+    var = np.asarray(sub_s["var"], np.float32)
+    scale = np.asarray(sub_p["scale"], np.float32)
+    scale = scale * np.sqrt((var + eps_dst) / (var + _EPS_FLAX))
+    return (scale, np.asarray(sub_p["bias"], np.float32),
+            np.asarray(sub_s["mean"], np.float32), var)
+
+
+def export_torch_mobilenet_v2(backbone_vars: dict,
+                              eps_dst: float = _EPS_TORCH) -> dict[str, np.ndarray]:
+    """Backbone ``{"params", "batch_stats"}`` trees -> torchvision-layout
+    state_dict (numpy values; ``torch.save``-able as-is)."""
+    params, stats = backbone_vars["params"], backbone_vars["batch_stats"]
+    sd: dict[str, np.ndarray] = {}
+
+    def put(conv_prefix: str, bn_prefix: str, p: dict, s: dict):
+        sd[f"{conv_prefix}.weight"] = _t(p["Conv_0"]["kernel"])
+        w, b, m, v = _bn_out(p["BatchNorm_0"], s["BatchNorm_0"], eps_dst)
+        sd[f"{bn_prefix}.weight"] = w
+        sd[f"{bn_prefix}.bias"] = b
+        sd[f"{bn_prefix}.running_mean"] = m
+        sd[f"{bn_prefix}.running_var"] = v
+        sd[f"{bn_prefix}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+    put("features.0.0", "features.0.1", params["ConvBN_0"], stats["ConvBN_0"])
+    block = 0
+    for t, _c, n, _s in _INVERTED_RESIDUAL_CFG:
+        for _ in range(n):
+            f = f"features.{block + 1}"
+            if t == 1:
+                pairs = [(f"{f}.conv.0.0", f"{f}.conv.0.1"),
+                         (f"{f}.conv.1", f"{f}.conv.2")]
+            else:
+                pairs = [(f"{f}.conv.0.0", f"{f}.conv.0.1"),
+                         (f"{f}.conv.1.0", f"{f}.conv.1.1"),
+                         (f"{f}.conv.2", f"{f}.conv.3")]
+            p = params[f"InvertedResidual_{block}"]
+            s = stats[f"InvertedResidual_{block}"]
+            for i, (cp, bp) in enumerate(pairs):
+                put(cp, bp, p[f"ConvBN_{i}"], s[f"ConvBN_{i}"])
+            block += 1
+    put("features.18.0", "features.18.1", params["ConvBN_1"], stats["ConvBN_1"])
+    return sd
+
+
+def export_keras_mobilenet_v2(backbone_vars: dict) -> dict[str, np.ndarray]:
+    """Backbone trees -> flat Keras-applications ``layer/weight`` dict (save
+    with ``np.savez`` to feed ``convert.load_keras_weights``)."""
+    params, stats = backbone_vars["params"], backbone_vars["batch_stats"]
+    w: dict[str, np.ndarray] = {}
+
+    def put(conv: str, bn: str, p: dict, s: dict, depthwise: bool):
+        kernel = np.asarray(p["Conv_0"]["kernel"], np.float32)
+        if depthwise:
+            # flax grouped [kh,kw,1,C] -> keras depthwise [kh,kw,C,1]
+            w[f"{conv}/depthwise_kernel"] = kernel.transpose(0, 1, 3, 2)
+        else:
+            w[f"{conv}/kernel"] = kernel
+        gamma, beta, mean, var = _bn_out(p["BatchNorm_0"], s["BatchNorm_0"],
+                                         _EPS_FLAX)  # identity fold
+        w[f"{bn}/gamma"] = gamma
+        w[f"{bn}/beta"] = beta
+        w[f"{bn}/moving_mean"] = mean
+        w[f"{bn}/moving_variance"] = var
+
+    put("Conv1", "bn_Conv1", params["ConvBN_0"], stats["ConvBN_0"], False)
+    block = 0
+    for t, _c, n, _s in _INVERTED_RESIDUAL_CFG:
+        for _ in range(n):
+            pfx = "expanded_conv" if block == 0 else f"block_{block}"
+            stages = []
+            if t != 1:
+                stages.append((f"{pfx}_expand", f"{pfx}_expand_BN", False))
+            stages += [(f"{pfx}_depthwise", f"{pfx}_depthwise_BN", True),
+                       (f"{pfx}_project", f"{pfx}_project_BN", False)]
+            p = params[f"InvertedResidual_{block}"]
+            s = stats[f"InvertedResidual_{block}"]
+            for i, (conv, bn, dw) in enumerate(stages):
+                put(conv, bn, p[f"ConvBN_{i}"], s[f"ConvBN_{i}"], dw)
+            block += 1
+    put("Conv_1", "Conv_1_bn", params["ConvBN_1"], stats["ConvBN_1"], False)
+    return w

@@ -449,3 +449,85 @@ def test_build_model_frozen_with_pretrained_no_warning(torch_model, tmp_path):
         warnings.simplefilter("error")
         model = build_model(cfg)
     assert model.freeze_base is True
+
+
+# ---------------------------------------------------------------------------
+# Export (models/export.py): the inverse layouts, pinned against the importers.
+# ---------------------------------------------------------------------------
+
+
+def _random_backbone_vars(width=0.35, seed=0):
+    import jax
+
+    backbone = MobileNetV2Backbone(width_mult=width, dtype=jnp.float32)
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    v = backbone.init(jax.random.PRNGKey(seed), x, train=False)
+    # nontrivial BN stats, positive variance (same regime as _randomize_bn)
+    rng = np.random.RandomState(seed)
+    v = jax.tree.map(np.asarray, v)
+    params = jax.tree.map(
+        lambda a: (a + rng.normal(0, 0.5, a.shape)).astype(np.float32),
+        v["params"])
+    stats = jax.tree_util.tree_map_with_path(
+        lambda p, a: (rng.uniform(0.5, 2.0, a.shape).astype(np.float32)
+                      if any(getattr(k, "key", "") == "var" for k in p)
+                      else rng.normal(0, 0.5, a.shape).astype(np.float32)),
+        v["batch_stats"])
+    return {"params": params, "batch_stats": stats}
+
+
+def test_export_torch_roundtrip_exact():
+    """export -> convert == identity (the BN-eps fold and its inverse cancel),
+    for the torchvision layout."""
+    from ddw_tpu.models.export import export_torch_mobilenet_v2
+
+    vars_in = _random_backbone_vars()
+    back = convert_torch_mobilenet_v2(export_torch_mobilenet_v2(vars_in))
+    import jax
+
+    for a, b in zip(jax.tree.leaves(vars_in), jax.tree.leaves(
+            {"params": back["params"], "batch_stats": back["batch_stats"]})):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_export_keras_roundtrip_exact(tmp_path):
+    """export -> npz -> load_keras_weights -> convert == identity (shared
+    epsilon: the fold is the identity both ways)."""
+    from ddw_tpu.models.convert import (convert_keras_mobilenet_v2,
+                                        load_keras_weights)
+    from ddw_tpu.models.export import export_keras_mobilenet_v2
+
+    vars_in = _random_backbone_vars(seed=1)
+    p = str(tmp_path / "w.npz")
+    np.savez(p, **export_keras_mobilenet_v2(vars_in))
+    back = convert_keras_mobilenet_v2(load_keras_weights(p))
+    import jax
+
+    for a, b in zip(jax.tree.leaves(vars_in), jax.tree.leaves(
+            {"params": back["params"], "batch_stats": back["batch_stats"]})):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_export_torch_statedict_loads_into_torch_model(torch_model):
+    """The exported state_dict is layout-compatible with a REAL torchvision-
+    naming torch module: load_state_dict(strict=True) accepts it and the
+    torch forward matches our backbone's forward on the same weights."""
+    from ddw_tpu.models.export import export_torch_mobilenet_v2
+
+    conv = convert_torch_mobilenet_v2(torch_model.state_dict())
+    sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+          for k, v in export_torch_mobilenet_v2(conv).items()}
+    m = _TorchMNv2Features()
+    m.load_state_dict(sd, strict=True)
+    m.eval()
+
+    x = np.random.RandomState(3).rand(2, 225, 225, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    ref = ref.transpose(0, 2, 3, 1)
+    backbone = MobileNetV2Backbone(width_mult=1.0, dtype=jnp.float32)
+    out = backbone.apply(
+        {"params": conv["params"], "batch_stats": conv["batch_stats"]},
+        jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3, atol=1e-3)

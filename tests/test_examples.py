@@ -26,9 +26,14 @@ _EXAMPLES = [
     ("04_hyperopt_parallel.py",
      ["--cache-features", "tune.max_evals=2", "tune.parallelism=2",
       "train.epochs=1"], "trials train heads only"),
+    ("04_hyperopt_parallel.py",
+     ["--nested-space", "tune.max_evals=2", "tune.parallelism=2",
+      "train.epochs=1"], "best"),
     ("05_hyperopt_distributed.py",
      ["tune.max_evals=2", "train.epochs=1"], "best"),
     ("06_packaged_inference.py", ["train.epochs=1"], "distributed scoring"),
+    ("08_pretrained_transfer.py",
+     ["--pretrain-epochs", "1", "train.epochs=1"], "[score]"),
     ("07_lm_long_context.py", ["--steps", "3"], "final:"),
 ]
 

@@ -1,0 +1,109 @@
+"""The transfer contract on a REAL weights artifact (VERDICT r2 item 3).
+
+Pretrains a MobileNetV2 on a generated corpus whose classes are disjoint from
+flowers, exports the backbone in BOTH public layouts (torchvision state_dict,
+Keras weights archive), converts each through the real import paths in
+``models/convert.py``, then trains a frozen-base head on flowers from the
+artifact — which must beat a frozen-RANDOM backbone by a wide margin AND clear
+a pinned accuracy bar, then package+score end-to-end. This is the
+reference's headline chain (``02_model_training_single_node.py:164-169``)
+exercised from a weights file, not a synthetic dict.
+
+Calibration (single run, 8-dev CPU mesh, width 0.35 @ 32px): pretrained-frozen
+0.61 vs random-frozen 0.20 — the bars below leave ~2x margin on the gap.
+"""
+
+import numpy as np
+
+from ddw_tpu.data.prep import generate_synthetic_flowers, prepare_flowers
+from ddw_tpu.data.store import TableStore
+from ddw_tpu.models.convert import (
+    convert_keras_mobilenet_v2,
+    convert_torch_mobilenet_v2,
+    load_keras_weights,
+    save_pretrained,
+)
+from ddw_tpu.models.export import (
+    export_keras_mobilenet_v2,
+    export_torch_mobilenet_v2,
+)
+from ddw_tpu.train.trainer import Trainer
+from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+WIDTH = 0.35
+DATA = DataCfg(img_height=32, img_width=32)
+
+
+def _fit(mcfg, tcfg, train_tbl, val_tbl):
+    return Trainer(DATA, mcfg, tcfg).fit(train_tbl, val_tbl)
+
+
+def test_pretrain_export_convert_transfer_package(tmp_path, silver):
+    import jax
+
+    from ddw_tpu.serving.batch import BatchScorer
+    from ddw_tpu.serving.package import save_packaged_model
+
+    store = TableStore(str(tmp_path / "tables"))
+    pre_src = generate_synthetic_flowers(
+        str(tmp_path / "pre_raw"), images_per_class=40, size=40,
+        classes=[f"shape_{i}" for i in range(8)], seed=123)
+    pre_train, pre_val, _ = prepare_flowers(
+        pre_src, store, sample_fraction=1.0, shard_size=64,
+        bronze_name="pre_bronze", train_name="pre_train", val_name="pre_val")
+
+    # -- pretrain the backbone on the disjoint corpus
+    pre_m = ModelCfg(name="mobilenet_v2", num_classes=8, dropout=0.1,
+                     width_mult=WIDTH, freeze_base=False, dtype="float32")
+    pre_t = TrainCfg(batch_size=8, epochs=6, warmup_epochs=0,
+                     learning_rate=2e-3)
+    pre_res = _fit(pre_m, pre_t, pre_train, pre_val)
+
+    params = jax.device_get(pre_res.state.params)
+    stats = jax.device_get(pre_res.state.batch_stats)
+    backbone = {"params": params["backbone"], "batch_stats": stats["backbone"]}
+
+    # -- export both public layouts, convert back through the real importers
+    art_torch = str(tmp_path / "art_torch.npz")
+    art_keras = str(tmp_path / "art_keras.npz")
+    save_pretrained(art_torch,
+                    convert_torch_mobilenet_v2(export_torch_mobilenet_v2(backbone)))
+    keras_npz = str(tmp_path / "keras_w.npz")
+    np.savez(keras_npz, **export_keras_mobilenet_v2(backbone))
+    save_pretrained(art_keras,
+                    convert_keras_mobilenet_v2(load_keras_weights(keras_npz)))
+    with np.load(art_torch) as a, np.load(art_keras) as b:
+        assert set(a.files) == set(b.files)
+        for k in a.files:
+            np.testing.assert_allclose(a[k], b[k], atol=1e-6,
+                                       err_msg=f"layouts disagree at {k}")
+
+    # -- frozen transfer on flowers: artifact vs random
+    train_tbl, val_tbl, label_to_idx = silver
+    tcfg = TrainCfg(batch_size=8, epochs=4, warmup_epochs=0,
+                    learning_rate=5e-3)
+    m_pre = ModelCfg(name="mobilenet_v2", num_classes=5, dropout=0.1,
+                     width_mult=WIDTH, freeze_base=True, dtype="float32",
+                     pretrained_path=art_torch)
+    m_rnd = ModelCfg(name="mobilenet_v2", num_classes=5, dropout=0.1,
+                     width_mult=WIDTH, freeze_base=True, dtype="float32",
+                     allow_frozen_random=True)
+    res_pre = _fit(m_pre, tcfg, train_tbl, val_tbl)
+    acc_pre = res_pre.val_accuracy
+    acc_rnd = _fit(m_rnd, tcfg, train_tbl, val_tbl).val_accuracy
+
+    # the transfer contract: pretrained frozen >> random frozen, above a bar
+    assert acc_pre >= 0.45, (acc_pre, acc_rnd)
+    assert acc_pre >= acc_rnd + 0.10, (acc_pre, acc_rnd)
+
+    # -- package + batch-score the pretrained model end-to-end
+    classes = [c for c, _ in sorted(label_to_idx.items(), key=lambda kv: kv[1])]
+    pkg = str(tmp_path / "pkg")
+    save_packaged_model(pkg, m_pre, classes, res_pre.state.params,
+                        res_pre.state.batch_stats,
+                        img_height=DATA.img_height, img_width=DATA.img_width)
+    rows = BatchScorer(pkg, batch_per_device=8).score_table(val_tbl)
+    assert len(rows) == val_tbl.num_records
+    truth = {r.path: r.label for r in val_tbl.iter_records()}
+    agree = sum(truth[p] == pred for p, pred in rows) / len(rows)
+    assert agree >= 0.45, agree
