@@ -255,6 +255,12 @@ def _flash_forward(q, k, v, causal, q_offset, k_offset, sm_scale, block_q,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        # bh and q-block steps are independent (scratch re-inits at kb==0);
+        # only the innermost k dim carries state. Declaring that lets Mosaic
+        # overlap DMA and compute across grid steps instead of serializing
+        # the whole grid.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(b, h, sq, d), lse
@@ -425,6 +431,8 @@ def _partitioned_bwd(causal, q_offset, k_offset, sm_scale, block_q, block_k,
             out_specs=qspec,
             out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interpret,
         )(qr, kr, vr, gr, lse, dvec)
 
@@ -446,6 +454,8 @@ def _partitioned_bwd(causal, q_offset, k_offset, sm_scale, block_q, block_k,
                        jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
             scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                             pltpu.VMEM((bk, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interpret,
         )(kr, vr, qr, gr, lse, dvec)
 

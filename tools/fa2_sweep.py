@@ -1,0 +1,135 @@
+"""Pallas FA2 block-size sweep vs fused-XLA attention (VERDICT r2 item 5).
+
+Times causal attention fwd+bwd (the LM training shape family) for:
+- the Pallas flash kernels over a (block_q, block_k) grid,
+- plain fused XLA attention,
+- jax.checkpoint'd XLA (the O(S)-residual middle arm),
+
+at several sequence lengths, with bench.py's differential forced-fetch timing.
+The table feeds BASELINE.md and the `flash_mha` dispatch thresholds
+(DDW_ATTN_XLA_PLAIN_MAX / DDW_ATTN_XLA_CKPT_MAX).
+
+Run on the TPU:  PYTHONPATH=. python tools/fa2_sweep.py
+(options: --seqs 2048,4096,8192  --batch 8 --heads 8 --dim 64)
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddw_tpu.ops.flash_attention import (
+    _xla_attention_lse,
+    flash_attention,
+)
+
+BLOCKS = (128, 256, 512, 1024)
+
+
+def _time_fn(fn, *args) -> float:
+    out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+
+    def run_n(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+        return time.perf_counter() - t0
+
+    n = 2
+    while True:
+        dt = run_n(2 * n) - run_n(n)
+        if dt >= 0.5 or n >= 256:
+            break
+        n *= 2
+    dts = sorted(run_n(2 * n) - run_n(n) for _ in range(3))
+    return max(dts[1], 1e-9) / n
+
+
+def make_arm(kind: str, bq: int = 128, bk: int = 128):
+    scale = None
+
+    if kind == "pallas":
+        def attn(q, k, v):
+            return flash_attention(q, k, v, True, 0, 0, scale, bq, bk)
+    else:
+        def xla(q, k, v):
+            return _xla_attention_lse(q, k, v, causal=True, q_offset=0,
+                                      k_offset=0,
+                                      sm_scale=1.0 / q.shape[-1] ** 0.5,
+                                      k_valid=None)[0]
+        attn = jax.checkpoint(xla) if kind == "xla_ckpt" else xla
+
+    @jax.jit
+    def fwd_bwd(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2)
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l
+
+    return fwd_bwd
+
+
+def attn_flops(b, h, s, d) -> float:
+    """Causal fwd+bwd matmul flops: fwd 2*(QK + PV)*0.5 causal; bwd ~2.5x fwd
+    (dP, dV, dS·K, dS^T·Q)."""
+    fwd = 2 * b * h * s * s * d * 2 * 0.5
+    return fwd * 3.5
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="2048,4096,8192")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--blocks", default=",".join(map(str, BLOCKS)))
+    args = ap.parse_args()
+    b, h, d = args.batch, args.heads, args.dim
+    blocks = [int(x) for x in args.blocks.split(",")]
+    print(f"device: {jax.devices()[0].device_kind}  shape B{b} H{h} D{d} "
+          f"causal fwd+bwd")
+
+    for s in (int(x) for x in args.seqs.split(",")):
+        rng = np.random.RandomState(0)
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.randn(b, h, s, d).astype(np.float32) * 0.1, jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+        fl = attn_flops(b, h, s, d)
+        rows = []
+        for kind in ("xla", "xla_ckpt"):
+            try:
+                dt = _time_fn(make_arm(kind), q, k, v)
+                rows.append((kind, dt))
+            except Exception as e:
+                rows.append((f"{kind} [{type(e).__name__}]", None))
+        for bq in blocks:
+            for bk in blocks:
+                if bq > s or bk > s:
+                    continue
+                try:
+                    dt = _time_fn(make_arm("pallas", bq, bk), q, k, v)
+                    rows.append((f"pallas q{bq} k{bk}", dt))
+                except Exception as e:
+                    rows.append((f"pallas q{bq} k{bk} [{type(e).__name__}]",
+                                 None))
+        best_xla = min((dt for kind, dt in rows[:2] if dt), default=None)
+        print(f"\nS={s}  ({fl / 1e9:.1f} GFLOP/step)")
+        for kind, dt in sorted(rows, key=lambda r: r[1] or 1e9):
+            if dt is None:
+                print(f"  {kind:<24} FAILED")
+                continue
+            ratio = f"  {dt / best_xla:5.2f}x vs XLA" if best_xla else ""
+            print(f"  {kind:<24}{dt * 1e3:9.2f} ms  "
+                  f"{fl / dt / 1e12:6.1f} TF/s{ratio}")
+
+
+if __name__ == "__main__":
+    main()
