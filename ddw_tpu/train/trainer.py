@@ -52,6 +52,42 @@ from ddw_tpu.train.step import (
 from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg, to_dict
 
 
+class _ZeroCheckpointAdapter:
+    """CheckpointManager-shaped facade over the sharded per-process format
+    (:mod:`ddw_tpu.checkpoint.sharded`) for ``TrainCfg.zero`` fits: saving a
+    ZeRO-sharded TrainState through the classic manager would all-gather the
+    moment shards into one host — the exact thing ZeRO exists to avoid. Save
+    is collective (every process writes its shards), matching how the trainer
+    already calls it on every rank."""
+
+    def __init__(self, ckpt_dir: str, mesh, axis: str):
+        from ddw_tpu.checkpoint.sharded import ShardedCheckpointManager
+
+        self._mgr = ShardedCheckpointManager(ckpt_dir)
+        self._mesh, self._axis = mesh, axis
+
+    def save(self, state, step: int, metadata: dict | None = None):
+        return self._mgr.save(state, step, metadata)
+
+    def restore(self, target, step: int | None = None):
+        from ddw_tpu.parallel.zero import zero_state_shardings
+
+        sh = zero_state_shardings(target, self._mesh, self._axis)
+        return self._mgr.restore(target, sh, step)
+
+    def read_metadata(self, step: int | None = None):
+        return self._mgr.read_metadata(step)
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:  # writes are synchronous in the sharded format
+        pass
+
+    def close(self) -> None:
+        pass
+
+
 @dataclasses.dataclass
 class TrainResult:
     val_loss: float
@@ -154,13 +190,34 @@ class Trainer:
                 (self.data_cfg.img_height, self.data_cfg.img_width, self.data_cfg.channels),
                 rng,
             )
-        train_step = make_train_step(self.model, tx, self.mesh, cfg.data_axis,
-                                     grad_accum_steps=cfg.grad_accum_steps)
+        if cfg.zero:
+            if cfg.grad_accum_steps > 1:
+                raise ValueError("train.zero with grad_accum_steps>1 is not "
+                                 "supported yet — pick one")
+            if cfg.async_checkpoint:
+                raise ValueError(
+                    "train.zero with async_checkpoint=true is not supported: "
+                    "sharded saves are collective and synchronous (every "
+                    "process writes its shards) — drop one of the flags")
+            from ddw_tpu.parallel.zero import make_zero_train_step
+
+            train_step = make_zero_train_step(self.model, tx, self.mesh,
+                                              cfg.data_axis)
+        else:
+            train_step = make_train_step(self.model, tx, self.mesh, cfg.data_axis,
+                                         grad_accum_steps=cfg.grad_accum_steps)
         eval_step = make_eval_step(self.model, self.mesh, cfg.data_axis)
 
-        ckpt = (CheckpointManager(cfg.checkpoint_dir,
-                                  async_write=cfg.async_checkpoint)
-                if cfg.checkpoint_dir else None)
+        if not cfg.checkpoint_dir:
+            ckpt = None
+        elif cfg.zero:
+            # sharded per-process format: saving must NOT all-gather the
+            # ZeRO-sharded moments into one host (checkpoint/sharded.py)
+            ckpt = _ZeroCheckpointAdapter(cfg.checkpoint_dir, self.mesh,
+                                          cfg.data_axis)
+        else:
+            ckpt = CheckpointManager(cfg.checkpoint_dir,
+                                     async_write=cfg.async_checkpoint)
         start_epoch = 0
         steps_per_epoch = max(1, train_table.num_records // (cfg.batch_size * world))
         val_steps = max(1, val_table.num_records // (cfg.batch_size * world))
@@ -170,6 +227,10 @@ class Trainer:
             if at_step is not None:
                 start_epoch = int(at_step) // steps_per_epoch
                 restored_meta = ckpt.read_metadata(at_step)
+        if cfg.zero:
+            # moments onto their data-axis shards (no-op on a restored
+            # already-sharded state)
+            state = train_step.place_state(state)
 
         warmup = LRWarmup(cfg.learning_rate, world if cfg.scale_lr_by_world else 1,
                           cfg.warmup_epochs)
@@ -251,9 +312,14 @@ class Trainer:
 
                     vlosses, vaccs = [], []
                     viter = iter(val_loader_factory())
+                    # ZeRO: eval reads only params/batch_stats — pass the state
+                    # without the sharded moments or the eval jit would
+                    # all-gather them to match its replicated in_spec
+                    eval_state = (state.replace(opt_state=()) if cfg.zero
+                                  else state)
                     for _ in range(val_steps):
                         images, labels = next(viter)
-                        m = eval_step(state, images, labels)
+                        m = eval_step(eval_state, images, labels)
                         vlosses.append(m["loss"])
                         vaccs.append(m["accuracy"])
                     val_loss = float(np.mean(jax.device_get(vlosses)))

@@ -104,6 +104,13 @@ class TrainCfg:
                                         # memory; batches far beyond HBM fit.
     data_axis: str = "data"             # mesh axis name for DP psum
     num_devices: int = 0                # 0 = all visible devices
+    zero: bool = False                  # ZeRO-1: shard optimizer moments over
+                                        # the data axis (parallel/zero.py);
+                                        # checkpoints switch to the sharded
+                                        # per-process format (no full gather).
+                                        # Incompatible with grad_accum_steps>1
+                                        # and async_checkpoint (saves are
+                                        # collective+synchronous) — both raise.
     checkpoint_dir: str = ""            # "" = no per-epoch checkpoints
     async_checkpoint: bool = False      # serialize+write checkpoints on a
                                         # background thread (device snapshot is

@@ -111,26 +111,24 @@ def resnet50_convs(img: int) -> list[ConvSpec]:
     return specs
 
 
+from bench import _time_steps  # bench.py's differential forced-fetch timing
+
+
 def _time_fn(fn, *args) -> float:
-    """Median seconds per call, differential forced-fetch timing (bench.py)."""
-    out = fn(*args)
+    """Median seconds per call via bench.py's ``_time_steps`` (one timing
+    methodology across bench.py and both perf tools)."""
+    out = fn(*args)  # warmup/compile
     np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
 
     def run_n(n):
         t0 = time.perf_counter()
         for _ in range(n):
             out = fn(*args)
-        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]  # forced D2H
         return time.perf_counter() - t0
 
-    n = 4
-    while True:
-        dt = run_n(2 * n) - run_n(n)
-        if dt >= 0.25 or n >= 512:
-            break
-        n *= 2
-    dts = sorted(run_n(2 * n) - run_n(n) for _ in range(3))
-    return max(dts[1], 1e-9) / n
+    dt, n = _time_steps(run_n)
+    return max(dt, 1e-9) / n
 
 
 def bench_conv(spec: ConvSpec, batch: int) -> dict:
@@ -179,9 +177,16 @@ def bench_conv(spec: ConvSpec, batch: int) -> dict:
     }
 
 
+_MODELS = {
+    "mobilenet_v2": mobilenet_v2_convs,
+    "resnet50": resnet50_convs,
+}
+
+
 def profile_model(name: str, batch: int, img: int):
-    specs = (mobilenet_v2_convs(img) if name == "mobilenet_v2"
-             else resnet50_convs(img))
+    if name not in _MODELS:
+        raise KeyError(f"unknown model {name!r} (have {sorted(_MODELS)})")
+    specs = _MODELS[name](img)
     # collapse identical shapes (repeat blocks) and weight by count
     from collections import Counter
 

@@ -32,25 +32,24 @@ from ddw_tpu.ops.flash_attention import (
 BLOCKS = (128, 256, 512, 1024)
 
 
+from bench import _time_steps  # bench.py's differential forced-fetch timing
+
+
 def _time_fn(fn, *args) -> float:
-    out = fn(*args)
+    """Median seconds per call via bench.py's ``_time_steps`` (one timing
+    methodology across bench.py and both perf tools)."""
+    out = fn(*args)  # warmup/compile
     np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
 
     def run_n(n):
         t0 = time.perf_counter()
         for _ in range(n):
             out = fn(*args)
-        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]  # forced D2H
         return time.perf_counter() - t0
 
-    n = 2
-    while True:
-        dt = run_n(2 * n) - run_n(n)
-        if dt >= 0.5 or n >= 256:
-            break
-        n *= 2
-    dts = sorted(run_n(2 * n) - run_n(n) for _ in range(3))
-    return max(dts[1], 1e-9) / n
+    dt, n = _time_steps(run_n)
+    return max(dt, 1e-9) / n
 
 
 def make_arm(kind: str, bq: int = 128, bk: int = 128):
@@ -72,7 +71,9 @@ def make_arm(kind: str, bq: int = 128, bk: int = 128):
         def loss(q, k, v):
             return jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2)
         l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
-        return l
+        # fold the grads into the returned scalar: returning only `l` would
+        # let XLA dead-code-eliminate the whole backward pass
+        return l + sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
 
     return fwd_bwd
 
