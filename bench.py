@@ -46,6 +46,7 @@ import json
 import os
 import statistics
 import sys
+import threading
 import time
 
 import jax
@@ -103,6 +104,24 @@ def _compiled_flops(lowered_compiled) -> float | None:
         return None
 
 
+# Mid-run stall guard. The tunneled backend has been observed to wedge
+# *mid-run* (round 3: compiles succeeded, then one remote call never
+# returned, 0 bytes of output after 40 min). Every completed unit of work
+# beats this heartbeat; a daemon watchdog (started in main) emits the final
+# JSON with whatever configs already finished and exits nonzero when the
+# heartbeat goes stale. Threshold must exceed the longest legitimate gap —
+# a cold compile (~40-90 s on this backend) or one differential run
+# (~2-8 s of device work + fetch latency).
+STALL_S = float(os.environ.get("DDW_BENCH_STALL_S", "420") or "420")
+_progress_t = [time.time()]
+
+
+def _beat(note: str = "") -> None:
+    _progress_t[0] = time.time()
+    if note:
+        print(f"[bench] {note}", file=sys.stderr, flush=True)
+
+
 def _time_steps(run_n) -> tuple[float, int]:
     """True seconds-per-``N``-steps of device work, via differential timing.
 
@@ -116,12 +135,14 @@ def _time_steps(run_n) -> tuple[float, int]:
     n = 2 if SMOKE else 8
     while True:
         dt = run_n(2 * n) - run_n(n)
+        _beat()
         if dt >= MIN_MEASURE_S or n >= MAX_STEPS:
             break
         n *= 2
     times = [dt]
     for _ in range(REPEATS - 1):
         times.append(run_n(2 * n) - run_n(n))
+        _beat()
     good = [t for t in times if t > 0]
     return (statistics.median(good) if good else run_n(n)), n
 
@@ -389,8 +410,6 @@ def _device_problem(timeout_s: float = 240.0) -> str | None:
     hangs indefinitely, including jax.devices()); a bench that hangs records
     nothing. Probe on a daemon thread so an unresponsive runtime can't wedge
     the process."""
-    import threading
-
     done: list = []
     failed: list = []
 
@@ -459,27 +478,80 @@ def main():
     if only:
         matrix = {k: v for k, v in matrix.items() if k in only}
 
-    configs = {}
+    configs: dict = {}
+    host: dict = {}
+    # "Prints ONE JSON line": exactly one thread may ever emit. A Lock's
+    # non-blocking acquire is the atomic claim an Event's is_set()/set()
+    # check-then-act cannot express.
+    emit_claim = threading.Lock()
+
+    def emit(error: str | None = None) -> bool:
+        if not emit_claim.acquire(blocking=False):
+            return False
+        # Snapshots: the watchdog emits while the main thread may still be
+        # inserting a just-completed config; dumping the live dicts would
+        # race ("dict changed size during iteration").
+        cfg_snap, host_snap = dict(configs), dict(host)
+        headline = cfg_snap.get("mobilenet_v2_frozen", {})
+        ips = headline.get("rate_per_chip")
+        payload = {
+            "metric": "mobilenet_v2_frozen_train_images_per_sec_per_chip",
+            "value": ips,
+            "unit": "images/sec/chip",
+            "vs_baseline": round(ips / BASELINE_IPS, 3) if ips else None,
+            "device": {"kind": kind, "n": n_chips, "peak_bf16_tflops": peak},
+            "configs": cfg_snap,
+            "host_pipeline": host_snap,
+        }
+        if error:
+            payload["error"] = error
+        print(json.dumps(payload))
+        sys.stdout.flush()
+        return True
+
+    def watchdog() -> None:
+        while True:
+            time.sleep(15)
+            if emit_claim.locked():
+                return  # main finished; nothing left to guard
+            stale = time.time() - _progress_t[0]
+            if stale > STALL_S:
+                # Nothing here may raise without the guard dying silently —
+                # that would disable the very hang protection it provides.
+                try:
+                    won = emit(error=(
+                        f"stalled mid-run: no completed device work for "
+                        f"{int(stale)}s (tunnel down?) — configs below are "
+                        f"the partial matrix"))
+                except BaseException:
+                    won = True  # claimed but failed mid-print: still dying
+                if won:
+                    os._exit(3)
+                return  # main won the claim: a full result is on stdout
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
     for name, fn in matrix.items():
+        _beat(f"{name}: compile + measure")
         try:
             configs[name] = fn()
+            _beat(f"{name}: done ({configs[name].get('rate_per_chip')} "
+                  f"{configs[name].get('unit')})")
         except Exception as e:  # one broken config must not hide the others
             configs[name] = {"error": f"{type(e).__name__}: {e}"}
+            _beat(f"{name}: ERROR {e}")
 
-    headline = configs.get("mobilenet_v2_frozen", {})
-    ips = headline.get("rate_per_chip")
-    host = bench_host_pipeline(host_n, host_hw, ips)
-
-    vs = round(ips / BASELINE_IPS, 3) if ips else None
-    print(json.dumps({
-        "metric": "mobilenet_v2_frozen_train_images_per_sec_per_chip",
-        "value": ips,
-        "unit": "images/sec/chip",
-        "vs_baseline": vs,
-        "device": {"kind": kind, "n": n_chips, "peak_bf16_tflops": peak},
-        "configs": configs,
-        "host_pipeline": host,
-    }))
+    _beat("host pipeline")
+    try:  # a host-side failure must not discard the measured device matrix
+        host.update(bench_host_pipeline(
+            host_n, host_hw,
+            configs.get("mobilenet_v2_frozen", {}).get("rate_per_chip")))
+    except Exception as e:
+        host["error"] = f"{type(e).__name__}: {e}"
+    if not emit():
+        # The watchdog won the claim in the same instant: stdout carries its
+        # stalled-error payload, so the exit code must agree with it.
+        os._exit(3)
 
 
 if __name__ == "__main__":
