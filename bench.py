@@ -182,9 +182,15 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
     import warnings
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")  # frozen-random warning: bench measures speed
+        # A/B knob for the space-to-depth stem (identical math; see
+        # ddw_tpu/ops/s2d_conv.py). CNN families only — ViT has no stem conv
+        # in this sense and its builder ignores the flag.
+        s2d = (os.environ.get("DDW_BENCH_S2D", "0").lower()
+               not in ("0", "", "false", "no")
+               and model_name.startswith(("mobilenet", "resnet")))
         model_cfg = ModelCfg(name=model_name, num_classes=5, dropout=0.5,
                              freeze_base=freeze_base, dtype="bfloat16",
-                             allow_frozen_random=freeze_base)
+                             allow_frozen_random=freeze_base, stem_s2d=s2d)
         model = build_model(model_cfg)
     train_cfg = TrainCfg(batch_size=batch, optimizer="adam", learning_rate=1e-3)
     state, tx = init_state(model, model_cfg, train_cfg, img, jax.random.PRNGKey(0))

@@ -55,18 +55,31 @@ class ConvBN(nn.Module):
     act: bool = True
     bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
+    s2d: bool = False  # stem trick: identical math, MXU-friendly channel depth
 
     @nn.compact
     def __call__(self, x, train: bool):
-        x = nn.Conv(
-            self.features,
-            self.kernel,
-            strides=self.strides,
-            padding="SAME",
-            feature_group_count=self.groups,
-            use_bias=False,
-            dtype=self.dtype,
-        )(x)
+        if self.s2d:
+            if self.strides != 2 or self.groups != 1:
+                raise ValueError(
+                    f"s2d=True expresses exactly a stride-2 ungrouped conv; "
+                    f"got strides={self.strides}, groups={self.groups}")
+            from ddw_tpu.ops.s2d_conv import S2DConv
+
+            # Explicit name: same param path ("Conv_0/kernel", same shape) as
+            # the nn.Conv branch, so the flag never forks checkpoint formats.
+            x = S2DConv(self.features, self.kernel, dtype=self.dtype,
+                        name="Conv_0")(x)
+        else:
+            x = nn.Conv(
+                self.features,
+                self.kernel,
+                strides=self.strides,
+                padding="SAME",
+                feature_group_count=self.groups,
+                use_bias=False,
+                dtype=self.dtype,
+            )(x)
         # Default momentum 0.9, not Keras's 0.99: the reference only ever runs
         # BN with a pretrained FROZEN base (stats never update, momentum
         # irrelevant); for from-scratch training 0.99 needs ~500 steps before
@@ -112,13 +125,14 @@ class MobileNetV2Backbone(nn.Module):
     width_mult: float = 1.0
     bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
         bn = self.bn_momentum
         x = x.astype(self.dtype)
         x = ConvBN(_make_divisible(32 * self.width_mult), (3, 3), strides=2,
-                   bn_momentum=bn, dtype=self.dtype)(x, train)
+                   bn_momentum=bn, dtype=self.dtype, s2d=self.stem_s2d)(x, train)
         for t, c, n, s in _INVERTED_RESIDUAL_CFG:
             out_ch = _make_divisible(c * self.width_mult)
             for i in range(n):
@@ -140,12 +154,14 @@ class MobileNetV2(nn.Module):
     freeze_base: bool = True
     bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         base_train = train and not self.freeze_base
         feats = MobileNetV2Backbone(self.width_mult, self.bn_momentum,
-                                    self.dtype, name="backbone")(x, base_train)
+                                    self.dtype, stem_s2d=self.stem_s2d,
+                                    name="backbone")(x, base_train)
         if self.freeze_base:
             # Keras trainable=False computes no base gradients: the tape stops at
             # the head input. stop_gradient guarantees XLA drops the backbone

@@ -71,6 +71,7 @@ def _mobilenet_v2(cfg: ModelCfg):
         freeze_base=cfg.freeze_base,
         bn_momentum=cfg.bn_momentum,
         dtype=_dtype(cfg),
+        stem_s2d=cfg.stem_s2d,
     )
 
 
@@ -94,6 +95,7 @@ def _resnet(cfg: ModelCfg):
         dropout=cfg.dropout,
         freeze_base=cfg.freeze_base,
         dtype=_dtype(cfg),
+        stem_s2d=cfg.stem_s2d,
     )
 
 

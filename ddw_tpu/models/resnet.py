@@ -35,11 +35,24 @@ class _ConvBN(nn.Module):
     strides: int = 1
     act: bool = True
     dtype: Any = jnp.bfloat16
+    s2d: bool = False  # stem trick: identical math, MXU-friendly channel depth
 
     @nn.compact
     def __call__(self, x, train: bool):
-        x = nn.Conv(self.features, self.kernel, strides=self.strides,
-                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        if self.s2d:
+            if self.strides != 2:
+                raise ValueError(
+                    f"s2d=True expresses exactly a stride-2 conv; "
+                    f"got strides={self.strides}")
+            from ddw_tpu.ops.s2d_conv import S2DConv
+
+            # Explicit name: same param path ("Conv_0/kernel", same shape) as
+            # the nn.Conv branch, so the flag never forks checkpoint formats.
+            x = S2DConv(self.features, self.kernel, dtype=self.dtype,
+                        name="Conv_0")(x)
+        else:
+            x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                        padding="SAME", use_bias=False, dtype=self.dtype)(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=jnp.float32)(x)
         return nn.relu(x) if self.act else x
@@ -82,13 +95,15 @@ class ResNetBackbone(nn.Module):
     depth: int = 50
     width_mult: float = 1.0
     dtype: Any = jnp.bfloat16
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
         counts, bottleneck = _CONFIGS[self.depth]
         block = BottleneckBlock if bottleneck else BasicBlock
         width = int(64 * self.width_mult)
-        x = _ConvBN(width, (7, 7), strides=2, dtype=self.dtype, name="stem")(x, train)
+        x = _ConvBN(width, (7, 7), strides=2, dtype=self.dtype,
+                    s2d=self.stem_s2d, name="stem")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(counts):
             feats = width * (2 ** stage)
@@ -108,12 +123,14 @@ class ResNet(nn.Module):
     dropout: float = 0.5
     freeze_base: bool = False
     dtype: Any = jnp.bfloat16
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         base_train = train and not self.freeze_base
         feats = ResNetBackbone(self.depth, self.width_mult, self.dtype,
+                               stem_s2d=self.stem_s2d,
                                name="backbone")(x, base_train)
         if self.freeze_base:
             # Keras trainable=False semantics: no gradients through the base

@@ -1,0 +1,104 @@
+"""Space-to-depth stem convolution — the MLPerf-era TPU trick, exactly.
+
+Problem: a CNN stem convolves the raw image, whose channel dim is 3. The MXU
+contracts over ``kh*kw*cin``; with ``cin=3`` most of the systolic array's
+contraction lanes idle, so the stem runs far below peak (the reference's cuDNN
+stack has the same pathology and solves it with dedicated small-channel conv
+kernels; here the fix is algebraic, which XLA then compiles like any other
+conv). This matters because the stem touches the largest spatial grid of the
+whole network (224x224 for the reference's input contract,
+``02_model_training_single_node.py:35-36``).
+
+Fix: a stride-2 SAME conv is *identical arithmetic* to a stride-1 conv over
+the 2x2 space-to-depth rearrangement of the input, with the kernel's spatial
+taps folded the same way:
+
+    y[o] = sum_t  K[t] * x[2o + t - before]        (stride-2, taps t)
+         = sum_{m,d} K[2m+d ...] * x_s2d[o+m, phase d]   (stride-1 over phases)
+
+The kernel is zero-padded to an even size aligned so every tap lands on a
+whole (phase, offset) pair, then reshaped ``[K,K,C,F] -> [K/2,K/2,4C,F]``
+matching the input's ``[B,H,W,C] -> [B,H/2,W/2,4C]`` rearrangement. Same
+parameters, same sums — checkpoints, converters, and exports are untouched;
+only the compute graph changes. Contraction depth grows 4x (e.g. the ResNet50
+stem's 7*7*3=147 becomes 4*4*12=192 against the MXU's 128-lane tiles; the 3x3
+stems' 27 becomes 2*2*12=48).
+
+Equivalence is pinned to the ``lax`` SAME-padding convention in
+``tests/test_s2d_conv.py`` for every odd kernel size used in the zoo.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+
+def space_to_depth_conv(x: jnp.ndarray, kernel: jnp.ndarray, *,
+                        precision=None) -> jnp.ndarray:
+    """Stride-2 SAME conv of NHWC ``x`` with HWIO ``kernel``, computed via a
+    2x2 space-to-depth rearrangement. Bit-for-bit the same contraction set as
+    ``lax.conv_general_dilated(..., window_strides=(2,2), padding='SAME')``
+    (summation order inside the contraction may differ — float results agree
+    to accumulation rounding).
+
+    Requires odd square kernels and even input spatial dims (the stem shapes;
+    anything else should use a plain conv).
+    """
+    b, h, w, c = x.shape
+    kh, kw, cin, cout = kernel.shape
+    if kh != kw or kh % 2 == 0:
+        raise ValueError(f"space_to_depth_conv needs an odd square kernel, got {kh}x{kw}")
+    if h % 2 or w % 2:
+        raise ValueError(f"space_to_depth_conv needs even spatial dims, got {h}x{w}")
+    if cin != c:
+        raise ValueError(f"kernel expects {cin} input channels, input has {c}")
+
+    k = kh
+    # lax SAME for stride 2 on even input: total pad = k-2, split low-first.
+    before = (k - 2) // 2
+    # Align so every tap index t' = i - before decomposes as 2m + d with a
+    # phase-independent m-range: pad the kernel top-left when `before` is odd,
+    # then bottom-right to the next even size.
+    tl = before % 2
+    br = (k + tl) % 2
+    kpad = jnp.pad(kernel, ((tl, br), (tl, br), (0, 0), (0, 0)))
+    ke = k + tl + br  # even
+    # [ke, ke, C, F] -> [ke/2, ke/2, (di, dj, C), F]
+    kfold = kpad.reshape(ke // 2, 2, ke // 2, 2, cin, cout)
+    kfold = kfold.transpose(0, 2, 1, 3, 4, 5).reshape(ke // 2, ke // 2, 4 * cin, cout)
+    # [B, H, W, C] -> [B, H/2, W/2, (di, dj, C)] — same (di, dj, C) order.
+    xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+
+    pad_lo = (before + 1) // 2
+    pad_hi = (k - 1 - before) // 2
+    return lax.conv_general_dilated(
+        xs, kfold, window_strides=(1, 1),
+        padding=((pad_lo, pad_hi), (pad_lo, pad_hi)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=precision)
+
+
+class S2DConv(nn.Module):
+    """Drop-in for the stem's ``nn.Conv(features, (k,k), strides=2,
+    padding='SAME', use_bias=False)``: same parameter name ("kernel"), shape
+    ``[k, k, cin, features]``, init, and dtype promotion — so a module can
+    switch implementations (give it the explicit name the ``nn.Conv`` would
+    have gotten) without changing its checkpoint format.
+    """
+
+    features: int
+    kernel_size: tuple[int, int]
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (*self.kernel_size, x.shape[-1], self.features), jnp.float32)
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        return space_to_depth_conv(x, kernel)

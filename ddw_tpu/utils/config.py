@@ -75,6 +75,11 @@ class ModelCfg:
                                         # parity runs finetuning an unfrozen
                                         # pretrained base.
     dtype: str = "bfloat16"             # compute dtype on the MXU; params stay f32
+    stem_s2d: bool = False              # compute the stride-2 stem conv via 2x2
+                                        # space-to-depth (identical math, same
+                                        # params; deepens the MXU contraction
+                                        # over the 3-channel image input).
+                                        # CNN families only (mobilenet/resnet).
 
 
 @dataclass
