@@ -37,13 +37,21 @@ class CausalSelfAttention(nn.Module):
     seq_axis: str | None = None
     decode: bool = False     # autoregressive mode: KV cache, one token per call
     max_len: int = 2048      # cache capacity in decode mode
+    lora_rank: int = 0       # >0: rank-r adapters on lora_targets projections
+    lora_alpha: float = 16.0
+    lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
     def __call__(self, x):
+        from ddw_tpu.models.lora import maybe_lora_dense
+
         b, s, d = x.shape
         head_dim = d // self.num_heads
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (self.num_heads, head_dim), dtype=self.dtype, name=name)
+
+        def dense(name):
+            return maybe_lora_dense((self.num_heads, head_dim), name,
+                                    rank=self.lora_rank, alpha=self.lora_alpha,
+                                    targets=self.lora_targets, dtype=self.dtype)
         q = dense("query")(x)   # [B, S, H, hd]
         k = dense("key")(x)
         v = dense("value")(x)
@@ -127,7 +135,10 @@ class CausalSelfAttention(nn.Module):
                 # Pallas flash kernel for genuinely long context.
                 out = flash_mha(qh, kh, vh, causal=True)
             out = out.transpose(0, 2, 1, 3)  # [B, S, H, hd]
-        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(out)
+        return maybe_lora_dense(d, "out", rank=self.lora_rank,
+                                alpha=self.lora_alpha,
+                                targets=self.lora_targets, dtype=self.dtype,
+                                contract_ndim=2)(out)
 
 
 class DecoderBlock(nn.Module):
@@ -141,12 +152,19 @@ class DecoderBlock(nn.Module):
     num_experts: int = 0          # >0: MoE MLP (Switch top-1) instead of dense
     expert_axis: str | None = None
     capacity_factor: float = 1.25
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
     def __call__(self, x, train: bool):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         h = CausalSelfAttention(self.num_heads, self.dtype, self.seq_axis,
-                                self.decode, self.max_len, name="attn")(h)
+                                self.decode, self.max_len,
+                                lora_rank=self.lora_rank,
+                                lora_alpha=self.lora_alpha,
+                                lora_targets=self.lora_targets,
+                                name="attn")(h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -158,10 +176,19 @@ class DecoderBlock(nn.Module):
                        expert_axis=self.expert_axis, no_drop=self.decode,
                        name="moe")(h)
         else:
+            from ddw_tpu.models.lora import maybe_lora_dense
+
             d = x.shape[-1]
-            h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(h)
+
+            def mlp_dense(feats, name):
+                return maybe_lora_dense(feats, name, rank=self.lora_rank,
+                                        alpha=self.lora_alpha,
+                                        targets=self.lora_targets,
+                                        dtype=self.dtype)
+
+            h = mlp_dense(self.mlp_dim, "fc1")(h)
             h = nn.gelu(h)
-            h = nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+            h = mlp_dense(d, "fc2")(h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return x + h
 
@@ -188,9 +215,20 @@ class TransformerLM(nn.Module):
     num_experts: int = 0     # >0: MoE MLP blocks (expert parallelism via
     expert_axis: str | None = None  # expert_axis inside shard_map)
     capacity_factor: float = 1.25
+    lora_rank: int = 0       # >0: rank-r LoRA adapters (ddw_tpu.models.lora)
+    lora_alpha: float = 16.0
+    lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
+        if self.lora_rank:
+            from ddw_tpu.models.lora import LM_LORA_TARGETS
+
+            bad = set(self.lora_targets) - set(LM_LORA_TARGETS)
+            if bad:  # a typo here would otherwise silently adapt nothing
+                raise ValueError(
+                    f"unknown lora_targets {sorted(bad)}; this model can "
+                    f"adapt {list(LM_LORA_TARGETS)}")
         b, s_local = tokens.shape
         x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype,
                      name="tok_embed")(tokens)
@@ -226,6 +264,9 @@ class TransformerLM(nn.Module):
                              num_experts=self.num_experts,
                              expert_axis=None if self.decode else self.expert_axis,
                              capacity_factor=self.capacity_factor,
+                             lora_rank=self.lora_rank,
+                             lora_alpha=self.lora_alpha,
+                             lora_targets=self.lora_targets,
                              name=f"backbone_block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # vocab head in f32: logits feed a softmax CE, keep full precision
@@ -244,7 +285,10 @@ def build_lm(cfg, seq_axis: str | None = None,
         depth=cfg.depth, num_heads=cfg.num_heads, mlp_dim=cfg.mlp_dim,
         dropout=cfg.dropout, dtype=jnp.dtype(cfg.dtype), seq_axis=seq_axis,
         num_experts=cfg.num_experts, expert_axis=expert_axis,
-        capacity_factor=cfg.capacity_factor)
+        capacity_factor=cfg.capacity_factor,
+        lora_rank=getattr(cfg, "lora_rank", 0),
+        lora_alpha=getattr(cfg, "lora_alpha", 16.0),
+        lora_targets=tuple(getattr(cfg, "lora_targets", ("query", "value"))))
 
 
 def init_cache(decode_model: TransformerLM, batch: int):

@@ -59,27 +59,10 @@ class ConvBN(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool):
-        if self.s2d:
-            if self.strides != 2 or self.groups != 1:
-                raise ValueError(
-                    f"s2d=True expresses exactly a stride-2 ungrouped conv; "
-                    f"got strides={self.strides}, groups={self.groups}")
-            from ddw_tpu.ops.s2d_conv import S2DConv
+        from ddw_tpu.ops.s2d_conv import conv_or_s2d
 
-            # Explicit name: same param path ("Conv_0/kernel", same shape) as
-            # the nn.Conv branch, so the flag never forks checkpoint formats.
-            x = S2DConv(self.features, self.kernel, dtype=self.dtype,
-                        name="Conv_0")(x)
-        else:
-            x = nn.Conv(
-                self.features,
-                self.kernel,
-                strides=self.strides,
-                padding="SAME",
-                feature_group_count=self.groups,
-                use_bias=False,
-                dtype=self.dtype,
-            )(x)
+        x = conv_or_s2d(self.features, self.kernel, strides=self.strides,
+                        groups=self.groups, dtype=self.dtype, s2d=self.s2d)(x)
         # Default momentum 0.9, not Keras's 0.99: the reference only ever runs
         # BN with a pretrained FROZEN base (stats never update, momentum
         # irrelevant); for from-scratch training 0.99 needs ~500 steps before

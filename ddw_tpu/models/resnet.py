@@ -39,20 +39,10 @@ class _ConvBN(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool):
-        if self.s2d:
-            if self.strides != 2:
-                raise ValueError(
-                    f"s2d=True expresses exactly a stride-2 conv; "
-                    f"got strides={self.strides}")
-            from ddw_tpu.ops.s2d_conv import S2DConv
+        from ddw_tpu.ops.s2d_conv import conv_or_s2d
 
-            # Explicit name: same param path ("Conv_0/kernel", same shape) as
-            # the nn.Conv branch, so the flag never forks checkpoint formats.
-            x = S2DConv(self.features, self.kernel, dtype=self.dtype,
-                        name="Conv_0")(x)
-        else:
-            x = nn.Conv(self.features, self.kernel, strides=self.strides,
-                        padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = conv_or_s2d(self.features, self.kernel, strides=self.strides,
+                        dtype=self.dtype, s2d=self.s2d)(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=jnp.float32)(x)
         return nn.relu(x) if self.act else x

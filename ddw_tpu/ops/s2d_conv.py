@@ -83,6 +83,25 @@ def space_to_depth_conv(x: jnp.ndarray, kernel: jnp.ndarray, *,
         precision=precision)
 
 
+def conv_or_s2d(features: int, kernel: tuple[int, int], *, strides: int = 1,
+                groups: int = 1, dtype=jnp.bfloat16, s2d: bool = False,
+                name: str = "Conv_0"):
+    """The stem-conv dispatch shared by the CNN families: a plain
+    ``nn.Conv(..., padding='SAME', use_bias=False)`` or its space-to-depth
+    reformulation. One place owns the contract — identical param path
+    (``name``/"kernel", same shape) on both branches, and ``s2d=True`` is
+    only legal for the stride-2 ungrouped conv it can express."""
+    if s2d:
+        if strides != 2 or groups != 1:
+            raise ValueError(
+                f"s2d=True expresses exactly a stride-2 ungrouped conv; got "
+                f"strides={strides}, groups={groups}")
+        return S2DConv(features, kernel, dtype=dtype, name=name)
+    return nn.Conv(features, kernel, strides=strides, padding="SAME",
+                   feature_group_count=groups, use_bias=False, dtype=dtype,
+                   name=name)
+
+
 class S2DConv(nn.Module):
     """Drop-in for the stem's ``nn.Conv(features, (k,k), strides=2,
     padding='SAME', use_bias=False)``: same parameter name ("kernel"), shape

@@ -30,9 +30,24 @@ from ddw_tpu.train.step import TrainState, cross_entropy_loss
 lm_loss = cross_entropy_loss
 
 
+def _maybe_lora_tx(model, tx: optax.GradientTransformation):
+    """A model built with ``lora_rank > 0`` gets the LoRA freezing mask
+    applied HERE, in the shared optimizer layer — the same altitude where the
+    CNN families' ``frozen_prefixes`` masking lives — so callers pass a plain
+    optax transform and cannot accidentally full-fine-tune the frozen base
+    alongside its adapters. Applied identically by :func:`init_lm_state` and
+    :func:`make_lm_train_step` (the two places the transform is consumed)."""
+    if getattr(model, "lora_rank", 0):
+        from ddw_tpu.models.lora import lora_optimizer
+
+        return lora_optimizer(tx)
+    return tx
+
+
 def init_lm_state(model, tx: optax.GradientTransformation,
                   rng: jax.Array, seq_len: int = 8) -> TrainState:
     """Seeded replicated init (identical on every host == rank-0 broadcast)."""
+    tx = _maybe_lora_tx(model, tx)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     # An axis-bound (seq/expert-parallel) model must init outside shard_map:
     # build an axis-free twin — parameter shapes are axis-independent by
@@ -68,6 +83,7 @@ def make_lm_train_step(
     world-averaged; for MoE models the Switch load-balance aux loss is added
     with ``aux_loss_weight`` and reported as ``metrics['aux_loss']``.
     """
+    tx = _maybe_lora_tx(model, tx)
     axes = (data_axis,) if seq_axis is None else (data_axis, seq_axis)
     if (model.seq_axis or None) != (seq_axis or None):
         raise ValueError(f"model.seq_axis={model.seq_axis!r} but step "

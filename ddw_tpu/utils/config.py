@@ -148,6 +148,12 @@ class LMCfg:
     dtype: str = "bfloat16"
     num_experts: int = 0                # >0: Switch-style MoE MLP blocks
     capacity_factor: float = 1.25       # static expert capacity = cf*T/E
+    lora_rank: int = 0                  # >0: rank-r LoRA adapters on
+                                        # lora_targets (ddw_tpu.models.lora);
+                                        # train with lora_optimizer so only
+                                        # adapters (+head) update
+    lora_alpha: float = 16.0
+    lora_targets: tuple[str, ...] = ("query", "value")
 
 
 @dataclass

@@ -1,0 +1,187 @@
+"""LoRA adapters (ddw_tpu.models.lora): init identity, grafting, masking,
+and an end-to-end parameter-efficient fine-tune of the LM family."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddw_tpu.models.lm import TransformerLM, generate
+from ddw_tpu.models.lora import (LoRADenseGeneral, count_trainable,
+                                 lora_mask, lora_optimizer, merge_base_params)
+
+
+def test_init_equals_base_dense():
+    """lora_b starts at zero, so the adapted projection IS the base one."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    base = nn.DenseGeneral((2, 3), dtype=jnp.float32)
+    lora = LoRADenseGeneral((2, 3), rank=2, dtype=jnp.float32)
+    vb = base.init(jax.random.PRNGKey(0), x)
+    vl = lora.init(jax.random.PRNGKey(0), x)
+    assert vl["params"]["kernel"].shape == vb["params"]["kernel"].shape
+    assert vl["params"]["bias"].shape == vb["params"]["bias"].shape
+    # same kernel/bias values -> same output at init
+    grafted = merge_base_params(vl["params"], vb["params"])
+    np.testing.assert_allclose(
+        np.asarray(lora.apply({"params": grafted}, x)),
+        np.asarray(base.apply(vb, x)), rtol=1e-6, atol=1e-6)
+    # moving lora_b changes the function (the adapter is actually wired in)
+    moved = dict(grafted)
+    moved["lora_b"] = jnp.ones_like(grafted["lora_b"])
+    assert not np.allclose(np.asarray(lora.apply({"params": moved}, x)),
+                           np.asarray(base.apply(vb, x)))
+
+
+def test_int_features_matches_dense():
+    x = jnp.asarray(np.random.RandomState(1).randn(4, 8).astype(np.float32))
+    dense = nn.Dense(5, dtype=jnp.float32)
+    lora = LoRADenseGeneral(5, rank=2, dtype=jnp.float32)
+    vd = dense.init(jax.random.PRNGKey(0), x)
+    vl = lora.init(jax.random.PRNGKey(0), x)
+    grafted = merge_base_params(vl["params"], vd["params"])
+    np.testing.assert_allclose(
+        np.asarray(lora.apply({"params": grafted}, x)),
+        np.asarray(dense.apply(vd, x)), rtol=1e-6, atol=1e-6)
+
+
+def test_mask_and_merge_errors():
+    params = {
+        "backbone": {"attn": {"kernel": jnp.zeros((2, 2)),
+                              "lora_a": jnp.zeros((2, 1)),
+                              "lora_b": jnp.zeros((1, 2))}},
+        "head": {"kernel": jnp.zeros((2, 2))},
+    }
+    mask = lora_mask(params)
+    assert mask["backbone"]["attn"] == {"kernel": False, "lora_a": True,
+                                        "lora_b": True}
+    assert mask["head"]["kernel"] is True
+    with pytest.raises(ValueError, match="absent"):
+        merge_base_params(params, {"nonexistent": jnp.zeros(1)})
+    with pytest.raises(ValueError, match="shape mismatch"):
+        merge_base_params(params, {"head": {"kernel": jnp.zeros((3, 3))}})
+
+
+def _tiny_lm(**kw):
+    return TransformerLM(vocab_size=32, max_len=32, hidden=16, depth=2,
+                         num_heads=2, mlp_dim=32, dtype=jnp.float32, **kw)
+
+
+def test_lm_lora_graft_preserves_function():
+    """Base LM params graft into the LoRA LM; logits agree at init."""
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 32, (2, 8)))
+    base = _tiny_lm()
+    lora = _tiny_lm(lora_rank=4, lora_targets=("query", "value", "fc1"))
+    vb = base.init({"params": jax.random.PRNGKey(0)}, toks)["params"]
+    vl = lora.init({"params": jax.random.PRNGKey(1)}, toks)["params"]
+    grafted = merge_base_params(vl, vb)
+    np.testing.assert_allclose(
+        np.asarray(lora.apply({"params": grafted}, toks)),
+        np.asarray(base.apply({"params": vb}, toks)), rtol=1e-5, atol=1e-5)
+    # economy: adapters (+head) are a small fraction of the model
+    trainable, total = count_trainable(grafted)
+    assert trainable < total / 2
+    assert trainable > 0
+
+
+def test_lm_lora_finetune_moves_only_adapters_and_head():
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 32, (4, 9)))
+    inputs, targets = toks[:, :-1], toks[:, 1:]
+    model = _tiny_lm(lora_rank=4)
+    params = model.init({"params": jax.random.PRNGKey(0)}, inputs)["params"]
+    tx = lora_optimizer(optax.adam(1e-2), params)
+    opt_state = tx.init(params)
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, inputs, train=True)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    p, losses = params, []
+    for _ in range(20):
+        p, opt_state, loss = step(p, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    mask = lora_mask(params)
+    changed = jax.tree.map(lambda a, b: bool(jnp.any(a != b)), params, p)
+    for path, ch in jax.tree_util.tree_flatten_with_path(changed)[0]:
+        m = mask
+        for k in path:
+            m = m[k.key] if isinstance(m, dict) else m
+        keys = "/".join(k.key for k in path)
+        if m:
+            assert ch, f"trainable leaf {keys} never moved"
+        else:
+            assert not ch, f"frozen leaf {keys} moved"
+
+
+def test_out_projection_target_and_validation():
+    """'out' adapts through the 2-dim contraction; unknown targets are loud."""
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 32, (2, 8)))
+    base = _tiny_lm()
+    lora = _tiny_lm(lora_rank=4, lora_targets=("out",))
+    vb = base.init({"params": jax.random.PRNGKey(0)}, toks)["params"]
+    vl = lora.init({"params": jax.random.PRNGKey(1)}, toks)["params"]
+    attn0 = vl["backbone_block0"]["attn"]["out"]
+    assert attn0["lora_a"].shape == (2, 8, 4)   # (heads, head_dim, rank)
+    assert attn0["lora_b"].shape == (4, 16)     # (rank, hidden)
+    grafted = merge_base_params(vl, vb)
+    np.testing.assert_allclose(
+        np.asarray(lora.apply({"params": grafted}, toks)),
+        np.asarray(base.apply({"params": vb}, toks)), rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="unknown lora_targets"):
+        _tiny_lm(lora_rank=4, lora_targets=("querry",)).init(
+            {"params": jax.random.PRNGKey(0)}, toks)
+
+
+def test_lm_step_applies_lora_mask_automatically():
+    """The shared LM training layer freezes the base when the model carries
+    lora_rank — a plain optax transform must not full-fine-tune it."""
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+    from ddw_tpu.train.lm_step import init_lm_state, make_lm_train_step
+
+    model = _tiny_lm(lora_rank=2)
+    mesh = make_mesh(MeshSpec((("data", -1),)))
+    state = init_lm_state(model, optax.adam(1e-2), jax.random.PRNGKey(0))
+    step = make_lm_train_step(model, optax.adam(1e-2), mesh, "data",
+                              seq_axis=None, donate=False)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 32, (8, 9)))
+    new_state, metrics = step(state, toks[:, :-1], toks[:, 1:],
+                              jax.random.PRNGKey(1))
+    mask = lora_mask(state.params)
+    changed = jax.tree.map(lambda a, b: bool(jnp.any(a != b)),
+                           state.params, new_state.params)
+    flat = jax.tree_util.tree_flatten_with_path(changed)[0]
+    moved_frozen = []
+    moved_trainable = 0
+    for path, ch in flat:
+        m = mask
+        for k in path:
+            m = m[k.key]
+        if ch and not m:
+            moved_frozen.append("/".join(k.key for k in path))
+        if ch and m:
+            moved_trainable += 1
+    assert not moved_frozen, moved_frozen
+    assert moved_trainable > 0
+
+
+def test_lora_decode_generate_runs():
+    """The KV-cached decode path works unchanged with adapters present."""
+    model = _tiny_lm(lora_rank=2)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 32, (2, 4)))
+    params = model.init({"params": jax.random.PRNGKey(0)}, toks)["params"]
+    out = generate(model, params, toks, num_steps=3)
+    assert out.shape == (2, 3)
+    assert not np.any(np.isnan(np.asarray(out)))
