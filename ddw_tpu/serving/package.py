@@ -36,6 +36,11 @@ from ddw_tpu.models.registry import build_model
 from ddw_tpu.utils.config import ModelCfg
 
 _FORMAT_VERSION = 1
+# Version 2 == version 1 + int8-quantized params blob. Quantized packages
+# write 2 so readers that predate quantization reject them cleanly at the
+# version gate instead of half-loading marker dicts as params.
+_FORMAT_VERSION_QUANT = 2
+_SUPPORTED_VERSIONS = (1, 2)
 _PREDICT_BATCH = 128  # reference :64
 
 
@@ -48,9 +53,15 @@ def save_packaged_model(
     img_height: int = 224,
     img_width: int = 224,
     extra_meta: dict | None = None,
+    quantize: str | None = None,
 ) -> str:
     """Write the packaged-model directory (the ``mlflow.pyfunc.log_model`` role,
-    reference ``:349-363``). ``classes`` must be index-ordered (label_to_idx order)."""
+    reference ``:349-363``). ``classes`` must be index-ordered (label_to_idx
+    order). ``quantize="int8"`` stores kernels as per-channel int8 (~4x
+    smaller artifact; see :mod:`ddw_tpu.serving.quantize`) — loading
+    dequantizes transparently."""
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unknown quantize mode {quantize!r}; use 'int8'")
     os.makedirs(out_dir, exist_ok=True)
     meta = {
         "format_version": _FORMAT_VERSION,
@@ -63,11 +74,17 @@ def save_packaged_model(
         "preprocess_impl": active_decoder(),
         **(extra_meta or {}),
     }
+    tree = {"params": jax.device_get(params),
+            "batch_stats": jax.device_get(batch_stats or {})}
+    if quantize == "int8":
+        from ddw_tpu.serving.quantize import MODE_INT8, quantize_tree
+
+        meta["quantization"] = MODE_INT8
+        meta["format_version"] = _FORMAT_VERSION_QUANT
+        tree = quantize_tree(tree)
     with open(os.path.join(out_dir, "package.json"), "w") as f:
         json.dump(meta, f, indent=2)
-    blob = serialization.to_bytes(
-        {"params": jax.device_get(params),
-         "batch_stats": jax.device_get(batch_stats or {})})
+    blob = serialization.to_bytes(tree)
     with open(os.path.join(out_dir, "params.msgpack"), "wb") as f:
         f.write(blob)
     return out_dir
@@ -88,7 +105,7 @@ class PackagedModel:
     def __init__(self, model_dir: str):
         with open(os.path.join(model_dir, "package.json")) as f:
             self.meta = json.load(f)
-        if self.meta["format_version"] != _FORMAT_VERSION:
+        if self.meta["format_version"] not in _SUPPORTED_VERSIONS:
             raise ValueError(f"unsupported package format {self.meta['format_version']}")
         self.model_cfg = ModelCfg(**self.meta["model_cfg"])
         self.classes: list[str] = self.meta["classes"]
@@ -113,6 +130,13 @@ class PackagedModel:
         h.update(json.dumps(self.meta, sort_keys=True).encode())
         self.content_digest = h.hexdigest()[:16]
         restored = serialization.msgpack_restore(blob)
+        quant = self.meta.get("quantization")
+        if quant is not None:
+            from ddw_tpu.serving.quantize import MODE_INT8, dequantize_tree
+
+            if quant != MODE_INT8:
+                raise ValueError(f"unsupported quantization mode {quant!r}")
+            restored = dequantize_tree(restored)
         self.params = restored["params"]
         self.batch_stats = restored.get("batch_stats") or {}
         self._apply = jax.jit(self._apply_fn)

@@ -1,0 +1,116 @@
+"""int8 weight-only quantization (ddw_tpu.serving.quantize): round-trip
+error bounds, artifact-size economy, and transparent PackagedModel loading."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ddw_tpu.serving.quantize import (MODE_INT8, dequantize_tree,
+                                      is_quantized_tree, quantize_tree)
+
+CLASSES = ["daisy", "dandelion", "roses", "sunflowers", "tulips"]
+
+
+@pytest.fixture(scope="module")
+def trained_package(tmp_path_factory):
+    """A packaged SmallCNN (deterministic init — the quantization contract is
+    about the weights artifact, not accuracy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.serving import save_packaged_model
+    from ddw_tpu.utils.config import ModelCfg
+
+    cfg = ModelCfg(name="small_cnn", num_classes=5, dropout=0.1,
+                   dtype="float32")
+    model = build_model(cfg)
+    variables = model.init({"params": jax.random.PRNGKey(0)},
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    out = str(tmp_path_factory.mktemp("pkg") / "model")
+    save_packaged_model(out, cfg, CLASSES, variables["params"],
+                        variables.get("batch_stats"), img_height=32,
+                        img_width=32)
+    return out
+
+
+def test_roundtrip_error_bound():
+    """Per-channel symmetric int8: |w - deq(q(w))| <= scale/2 per channel
+    (= absmax/254), including negative values and a zero channel."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(7, 33).astype(np.float32) * np.logspace(-2, 1, 33)
+    w[:, 5] = 0.0  # all-zero channel must not divide by zero
+    tree = {"layer": {"kernel": w, "bias": np.ones(33, np.float32)}}
+    q = quantize_tree(tree)
+    assert is_quantized_tree(q)
+    deq = dequantize_tree(q)
+    absmax = np.abs(w).max(axis=0)
+    bound = np.maximum(absmax / 254.0, 1e-8)
+    assert np.all(np.abs(deq["layer"]["kernel"] - w) <= bound + 1e-7)
+    # 1-D leaves pass through untouched
+    np.testing.assert_array_equal(deq["layer"]["bias"], tree["layer"]["bias"])
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_tree(q["layer"]["kernel"])
+
+
+def test_quantized_package_loads_and_agrees(trained_package, tmp_path):
+    """quantize='int8' at save time: ~4x smaller params blob, transparent
+    load, predictions agree with the full-precision package."""
+    from ddw_tpu.serving import PackagedModel, save_packaged_model
+    from ddw_tpu.utils.config import ModelCfg
+
+    model_dir = trained_package
+    full = PackagedModel(model_dir)
+    qdir = str(tmp_path / "quant")
+    save_packaged_model(
+        qdir, ModelCfg(**full.meta["model_cfg"]), full.classes,
+        full.params, full.batch_stats, img_height=full.height,
+        img_width=full.width, quantize="int8")
+    with open(os.path.join(qdir, "package.json")) as f:
+        qmeta = json.load(f)
+    assert qmeta["quantization"] == MODE_INT8
+    # readers that predate quantization gate on format_version — a quantized
+    # package must fail their version check, not half-load marker dicts
+    assert qmeta["format_version"] == 2
+    size_full = os.path.getsize(os.path.join(model_dir, "params.msgpack"))
+    size_q = os.path.getsize(os.path.join(qdir, "params.msgpack"))
+    assert size_q < size_full / 2.5, (size_full, size_q)
+
+    quant = PackagedModel(qdir)
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(32, full.height, full.width, 3).astype(np.float32) * 2 - 1
+    lg_full = full.predict_logits(imgs)
+    lg_q = quant.predict_logits(imgs)
+    # logits within ~1% of the full-precision dynamic range
+    scale = np.abs(lg_full).max()
+    assert np.abs(lg_q - lg_full).max() <= 0.05 * scale
+    # and the decisions agree on (nearly) every input
+    agree = np.mean(np.argmax(lg_q, -1) == np.argmax(lg_full, -1))
+    assert agree >= 0.95, agree
+
+
+def test_unknown_modes_raise(trained_package, tmp_path):
+    from ddw_tpu.serving import PackagedModel, save_packaged_model
+    from ddw_tpu.utils.config import ModelCfg
+
+    model_dir = trained_package
+    full = PackagedModel(model_dir)
+    with pytest.raises(ValueError, match="unknown quantize mode"):
+        save_packaged_model(str(tmp_path / "x"),
+                            ModelCfg(**full.meta["model_cfg"]), full.classes,
+                            full.params, quantize="int4")
+    # a package claiming a mode this build doesn't know must not half-load
+    qdir = str(tmp_path / "q")
+    save_packaged_model(qdir, ModelCfg(**full.meta["model_cfg"]), full.classes,
+                        full.params, full.batch_stats, img_height=full.height,
+                        img_width=full.width, quantize="int8")
+    meta_path = os.path.join(qdir, "package.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["quantization"] = "int3_experimental"
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match="unsupported quantization"):
+        PackagedModel(qdir)
