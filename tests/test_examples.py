@@ -35,6 +35,7 @@ _EXAMPLES = [
     ("08_pretrained_transfer.py",
      ["--pretrain-epochs", "1", "train.epochs=1"], "[score]"),
     ("07_lm_long_context.py", ["--steps", "3"], "final:"),
+    ("09_lora_finetune.py", [], "base_frozen=True"),
 ]
 
 
@@ -54,8 +55,8 @@ def test_example_runs(script, extra, expect, workdir):
         "PYTHONPATH": REPO,
     })
     cmd = [sys.executable, os.path.join(REPO, "examples", script), "--quick"]
-    if script.startswith("07"):
-        cmd += extra  # LM example has no workdir/tables
+    if script.startswith(("07", "09")):
+        cmd += extra  # LM examples have no workdir/tables
     else:
         cmd += ["--workdir", workdir, *extra]
     proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
