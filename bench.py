@@ -188,9 +188,17 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
         s2d = (os.environ.get("DDW_BENCH_S2D", "0").lower()
                not in ("0", "", "false", "no")
                and model_name.startswith(("mobilenet", "resnet")))
+        # DDW_BENCH_DW=pallas routes MobileNet's stride-1 depthwise layers
+        # through the in-tree Pallas kernel (ddw_tpu/ops/depthwise_conv.py).
+        dw = os.environ.get("DDW_BENCH_DW", "xla")
+        if dw not in ("xla", "pallas"):  # a typo must not silently bench XLA
+            raise ValueError(f"DDW_BENCH_DW must be 'xla' or 'pallas', got {dw!r}")
+        if not model_name.startswith("mobilenet"):
+            dw = "xla"
         model_cfg = ModelCfg(name=model_name, num_classes=5, dropout=0.5,
                              freeze_base=freeze_base, dtype="bfloat16",
-                             allow_frozen_random=freeze_base, stem_s2d=s2d)
+                             allow_frozen_random=freeze_base, stem_s2d=s2d,
+                             dw_impl=dw)
         model = build_model(model_cfg)
     train_cfg = TrainCfg(batch_size=batch, optimizer="adam", learning_rate=1e-3)
     state, tx = init_state(model, model_cfg, train_cfg, img, jax.random.PRNGKey(0))

@@ -56,13 +56,32 @@ class ConvBN(nn.Module):
     bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
     s2d: bool = False  # stem trick: identical math, MXU-friendly channel depth
+    dw_impl: str = "xla"  # depthwise layers: "xla" grouped conv, "pallas"
+                          # (ddw_tpu.ops.depthwise_conv — auto-dispatch: Pallas
+                          # for stride-1 on TPU, XLA elsewhere), or
+                          # "pallas_interpret" (test-only CPU interpreter)
 
     @nn.compact
     def __call__(self, x, train: bool):
-        from ddw_tpu.ops.s2d_conv import conv_or_s2d
+        if self.dw_impl not in ("xla", "pallas", "pallas_interpret"):
+            raise ValueError(f"unknown dw_impl {self.dw_impl!r}")
+        depthwise = self.groups > 1 and self.groups == x.shape[-1]
+        if (depthwise and self.dw_impl != "xla" and self.kernel == (3, 3)):
+            from ddw_tpu.ops.depthwise_conv import DepthwiseConv3x3
 
-        x = conv_or_s2d(self.features, self.kernel, strides=self.strides,
-                        groups=self.groups, dtype=self.dtype, s2d=self.s2d)(x)
+            interp = self.dw_impl == "pallas_interpret" and self.strides == 1
+            # Same param path/shape as the nn.Conv branch (see module doc).
+            x = DepthwiseConv3x3(self.features, strides=self.strides,
+                                 dtype=self.dtype,
+                                 impl="pallas" if interp else "auto",
+                                 interpret=interp,
+                                 name="Conv_0")(x)
+        else:
+            from ddw_tpu.ops.s2d_conv import conv_or_s2d
+
+            x = conv_or_s2d(self.features, self.kernel, strides=self.strides,
+                            groups=self.groups, dtype=self.dtype,
+                            s2d=self.s2d)(x)
         # Default momentum 0.9, not Keras's 0.99: the reference only ever runs
         # BN with a pretrained FROZEN base (stats never update, momentum
         # irrelevant); for from-scratch training 0.99 needs ~500 steps before
@@ -84,6 +103,7 @@ class InvertedResidual(nn.Module):
     expand: int
     bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
+    dw_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -95,7 +115,8 @@ class InvertedResidual(nn.Module):
                        dtype=self.dtype)(h, train)
         # depthwise
         h = ConvBN(h.shape[-1], (3, 3), strides=self.stride, groups=h.shape[-1],
-                   bn_momentum=bn, dtype=self.dtype)(h, train)
+                   bn_momentum=bn, dtype=self.dtype,
+                   dw_impl=self.dw_impl)(h, train)
         # linear bottleneck projection (no activation)
         h = ConvBN(self.out_ch, (1, 1), act=False, bn_momentum=bn,
                    dtype=self.dtype)(h, train)
@@ -109,6 +130,7 @@ class MobileNetV2Backbone(nn.Module):
     bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
     stem_s2d: bool = False
+    dw_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -120,7 +142,8 @@ class MobileNetV2Backbone(nn.Module):
             out_ch = _make_divisible(c * self.width_mult)
             for i in range(n):
                 x = InvertedResidual(out_ch, s if i == 0 else 1, t,
-                                     bn_momentum=bn, dtype=self.dtype)(x, train)
+                                     bn_momentum=bn, dtype=self.dtype,
+                                     dw_impl=self.dw_impl)(x, train)
         last = _make_divisible(1280 * max(1.0, self.width_mult))
         x = ConvBN(last, (1, 1), bn_momentum=bn, dtype=self.dtype)(x, train)
         return x
@@ -138,12 +161,14 @@ class MobileNetV2(nn.Module):
     bn_momentum: float = 0.9
     dtype: Any = jnp.bfloat16
     stem_s2d: bool = False
+    dw_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         base_train = train and not self.freeze_base
         feats = MobileNetV2Backbone(self.width_mult, self.bn_momentum,
                                     self.dtype, stem_s2d=self.stem_s2d,
+                                    dw_impl=self.dw_impl,
                                     name="backbone")(x, base_train)
         if self.freeze_base:
             # Keras trainable=False computes no base gradients: the tape stops at

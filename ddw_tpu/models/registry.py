@@ -72,6 +72,7 @@ def _mobilenet_v2(cfg: ModelCfg):
         bn_momentum=cfg.bn_momentum,
         dtype=_dtype(cfg),
         stem_s2d=cfg.stem_s2d,
+        dw_impl=cfg.dw_impl,
     )
 
 

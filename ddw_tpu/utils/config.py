@@ -80,6 +80,11 @@ class ModelCfg:
                                         # params; deepens the MXU contraction
                                         # over the 3-channel image input).
                                         # CNN families only (mobilenet/resnet).
+    dw_impl: str = "xla"                # depthwise-conv implementation for the
+                                        # MobileNet family: "xla" grouped conv
+                                        # or "pallas" (in-tree VMEM-resident
+                                        # kernel, ddw_tpu.ops.depthwise_conv;
+                                        # stride-2 layers stay on XLA)
 
 
 @dataclass

@@ -53,7 +53,10 @@ def fit(step_fn, state, data, steps, batch_size, rngkey):
     computed before any update applies, i.e. the zero-shot loss."""
     first = last = float("nan")
     for i in range(steps):
-        batch = data[(i * batch_size) % len(data):][:batch_size]
+        # modular gather: constant [batch_size, seq] shape even when
+        # batch_size does not divide len(data) (no mid-run recompile)
+        idx = (np.arange(batch_size) + i * batch_size) % len(data)
+        batch = data[idx]
         state, metrics = step_fn(state, batch[:, :-1], batch[:, 1:],
                                  jax.random.fold_in(rngkey, i))
         last = float(metrics["loss"])
@@ -80,6 +83,10 @@ def main():
     pre_steps, ft_steps = (30, 40) if args.quick else (200, 200)
 
     mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)))
+    dp = len(jax.devices())
+    # shard_map shards the batch P(data): round up to a mesh multiple (the
+    # example-07 guard)
+    train_cfg.batch_size = max(train_cfg.batch_size, dp) // dp * dp
     rng = np.random.RandomState(train_cfg.seed)
 
     # -- 1. pretrain the base LM on the step-1 successor process --------------
