@@ -110,9 +110,12 @@ def _compiled_flops(lowered_compiled) -> float | None:
 # beats this heartbeat; a daemon watchdog (started in main) emits the final
 # JSON with whatever configs already finished and exits nonzero when the
 # heartbeat goes stale. Threshold must exceed the longest legitimate gap —
-# a cold compile (~40-90 s on this backend) or one differential run
-# (~2-8 s of device work + fetch latency).
-STALL_S = float(os.environ.get("DDW_BENCH_STALL_S", "420") or "420")
+# a cold compile (~40-90 s on the tunneled chip) or one differential run
+# (~2-8 s of device work + fetch latency). SMOKE (CPU CI) gets a much laxer
+# default: a loaded 1-core host can legitimately take minutes per compile,
+# and the guard's target failure mode is the tunnel, not CI contention.
+STALL_S = float(os.environ.get("DDW_BENCH_STALL_S", "")
+                or ("1800" if SMOKE else "420"))
 _progress_t = [time.time()]
 
 
