@@ -159,12 +159,45 @@ def bench_conv(spec: ConvSpec, batch: int) -> dict:
                               spec.cout).astype(np.float32) * 0.05,
                     jnp.bfloat16)
     dt = _time_fn(fwd_bwd, x, w)
+
+    # In-place A/B arms: the round-3 alternative implementations, timed with
+    # the identical fwd+bwd harness so the columns are directly comparable.
+    variants = {}
+    if (spec.groups > 1 and spec.groups == spec.cin == spec.cout
+            and spec.k == 3 and spec.stride == 1
+            and jax.default_backend() == "tpu"):
+        from ddw_tpu.ops.depthwise_conv import depthwise_conv3x3
+
+        @jax.jit
+        def dw_fwd_bwd(x, w3):
+            def loss(x, w3):
+                y = depthwise_conv3x3(x, w3, impl="pallas")
+                return jnp.sum(y.astype(jnp.float32) ** 2)
+
+            return jax.value_and_grad(loss, argnums=(0, 1))(x, w3)
+
+        variants["pallas_dw"] = _time_fn(dw_fwd_bwd, x, w[:, :, 0, :]) * 1e3
+    if (spec.groups == 1 and spec.stride == 2 and spec.k % 2 == 1
+            and spec.cin <= 4 and spec.in_hw % 2 == 0):
+        from ddw_tpu.ops.s2d_conv import space_to_depth_conv
+
+        @jax.jit
+        def s2d_fwd_bwd(x, w):
+            def loss(x, w):
+                y = space_to_depth_conv(x, w)
+                return jnp.sum(y.astype(jnp.float32) ** 2)
+
+            return jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+
+        variants["s2d_stem"] = _time_fn(s2d_fwd_bwd, x, w) * 1e3
+
     flops = spec.flops(batch)
     bts = spec.bytes_moved(batch)
     t_compute = flops / (PEAK_TFLOPS * 1e12)
     t_memory = bts / (HBM_GBPS * 1e9)
     bound = max(t_compute, t_memory)
     return {
+        "variants": variants,
         "spec": spec,
         "ms": dt * 1e3,
         "tflops": flops / dt / 1e12,
@@ -213,9 +246,11 @@ def profile_model(name: str, batch: int, img: int):
         s = r["spec"]
         shape = f"{s.in_hw}²x{s.cin}->{s.cout}" + (
             f"/dw" if s.groups > 1 else f"/k{s.k}s{s.stride}")
+        alt = "".join(f"  {k}={v:.3f}ms({r['ms'] / max(v, 1e-9):.2f}x)"
+                      for k, v in r.get("variants", {}).items())
         print(f"{s.name:<16}{r['count']:>4}{shape:>22}{r['ms']:>8.3f}"
               f"{r['tflops']:>7.1f}{r['gbps']:>7.0f}{r['ai']:>6.0f}"
-              f"{r['bound_kind']:>6}{r['vs_bound']:>7.2f}")
+              f"{r['bound_kind']:>6}{r['vs_bound']:>7.2f}{alt}")
     print(f"{'TOTAL(convs)':<16}{'':>4}{'':>22}{total:>8.2f}  "
           f"roofline-bound total {total_bound:.2f} ms "
           f"(x{total / max(total_bound, 1e-9):.2f} over)")
