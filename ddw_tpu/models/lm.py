@@ -222,13 +222,9 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         if self.lora_rank:
-            from ddw_tpu.models.lora import LM_LORA_TARGETS
+            from ddw_tpu.models.lora import validate_lora_targets
 
-            bad = set(self.lora_targets) - set(LM_LORA_TARGETS)
-            if bad:  # a typo here would otherwise silently adapt nothing
-                raise ValueError(
-                    f"unknown lora_targets {sorted(bad)}; this model can "
-                    f"adapt {list(LM_LORA_TARGETS)}")
+            validate_lora_targets(self.lora_targets)
         b, s_local = tokens.shape
         x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype,
                      name="tok_embed")(tokens)

@@ -85,9 +85,20 @@ class LoRADenseGeneral(nn.Module):
         return y
 
 
-# Projections the LM family can adapt; ddw_tpu.models.lm routes these names
-# through maybe_lora_dense. Anything else in lora_targets is a config error.
+# Projections the attention families (LM, ViT) route through
+# maybe_lora_dense. Anything else in lora_targets is a config error.
 LM_LORA_TARGETS = ("query", "key", "value", "out", "fc1", "fc2")
+
+
+def validate_lora_targets(targets: Sequence[str],
+                          known: Sequence[str] = LM_LORA_TARGETS) -> None:
+    """Raise on a target name the model does not route through
+    :func:`maybe_lora_dense` — a typo would otherwise silently adapt
+    nothing."""
+    bad = set(targets) - set(known)
+    if bad:
+        raise ValueError(f"unknown lora_targets {sorted(bad)}; this model "
+                         f"can adapt {list(known)}")
 
 
 def maybe_lora_dense(features, name: str, *, rank: int, alpha: float,

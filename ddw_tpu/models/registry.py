@@ -28,6 +28,20 @@ def build_model(cfg: ModelCfg):
     if cfg.name not in MODEL_REGISTRY:
         raise KeyError(f"unknown model {cfg.name!r}; have {sorted(MODEL_REGISTRY)}")
     model = MODEL_REGISTRY[cfg.name](cfg)
+    if getattr(cfg, "lora_rank", 0) and not hasattr(model, "lora_rank"):
+        # Only the attention families route lora_targets through
+        # maybe_lora_dense; silently ignoring the field would full-fine-tune
+        # while the user believes adapters are training.
+        raise ValueError(f"{cfg.name!r} does not support LoRA "
+                         f"(model.lora_rank); use the vit or LM families")
+    if (getattr(cfg, "lora_rank", 0) and not cfg.pretrained_path):
+        import warnings
+
+        warnings.warn(
+            f"{cfg.name}: lora_rank={cfg.lora_rank} with no pretrained_path "
+            f"freezes a randomly initialized backbone under the adapters "
+            f"(accuracy will stay near chance unless params are grafted "
+            f"before training)", stacklevel=2)
     if (cfg.freeze_base and not cfg.pretrained_path
             and type(model).frozen_prefixes(True)):
         # freeze_base defaults True for the reference's transfer contract, but
@@ -108,4 +122,5 @@ def _vit(cfg: ModelCfg):
     if cfg.num_heads:
         kwargs["num_heads"] = cfg.num_heads
     return ViT(num_classes=cfg.num_classes, dropout=cfg.dropout, dtype=_dtype(cfg),
-               **kwargs)
+               lora_rank=cfg.lora_rank, lora_alpha=cfg.lora_alpha,
+               lora_targets=tuple(cfg.lora_targets), **kwargs)

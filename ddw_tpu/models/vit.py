@@ -27,52 +27,85 @@ class FlashMHA(nn.Module):
     [heads, head_dim, embed]`` — so :data:`ddw_tpu.parallel.sharding
     .VIT_TP_RULES` shards it unchanged and checkpoints stay layout-stable.
     The kernel pads ViT's 196-patch sequences to a block multiple internally
-    (:func:`ddw_tpu.ops.flash_attention.flash_mha`)."""
+    (:func:`ddw_tpu.ops.flash_attention.flash_mha`). ``lora_rank > 0`` puts
+    adapters on the targeted projections (ddw_tpu.models.lora — base param
+    paths unchanged)."""
 
     num_heads: int
     dtype: Any = jnp.bfloat16
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
     def __call__(self, x):
+        from ddw_tpu.models.lora import maybe_lora_dense
+
         d = x.shape[-1]
         if d % self.num_heads:
             raise ValueError(f"hidden {d} not divisible by heads {self.num_heads}")
         head_dim = d // self.num_heads
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (self.num_heads, head_dim), dtype=self.dtype, name=name)
+
+        def dense(name):
+            return maybe_lora_dense((self.num_heads, head_dim), name,
+                                    rank=self.lora_rank, alpha=self.lora_alpha,
+                                    targets=self.lora_targets, dtype=self.dtype)
+
         q = dense("query")(x)   # [B, S, H, hd]
         k = dense("key")(x)
         v = dense("value")(x)
         qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         out = flash_mha(qh, kh, vh, causal=False)
         out = out.transpose(0, 2, 1, 3)  # [B, S, H, hd]
-        return nn.DenseGeneral(d, axis=(-2, -1), dtype=self.dtype, name="out")(out)
+        return maybe_lora_dense(d, "out", rank=self.lora_rank,
+                                alpha=self.lora_alpha,
+                                targets=self.lora_targets, dtype=self.dtype,
+                                contract_ndim=2)(out)
 
 
 class MlpBlock(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.bfloat16
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
     def __call__(self, x):
+        from ddw_tpu.models.lora import maybe_lora_dense
+
         d = x.shape[-1]
-        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(x)
+
+        def dense(feats, name):
+            return maybe_lora_dense(feats, name, rank=self.lora_rank,
+                                    alpha=self.lora_alpha,
+                                    targets=self.lora_targets,
+                                    dtype=self.dtype)
+
+        h = dense(self.mlp_dim, "fc1")(x)
         h = nn.gelu(h)
-        return nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+        return dense(d, "fc2")(h)
 
 
 class EncoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dtype: Any = jnp.bfloat16
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
     def __call__(self, x, train: bool):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = FlashMHA(num_heads=self.num_heads, dtype=self.dtype, name="attn")(h)
+        h = FlashMHA(num_heads=self.num_heads, dtype=self.dtype,
+                     lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                     lora_targets=self.lora_targets, name="attn")(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = MlpBlock(self.mlp_dim, dtype=self.dtype, name="mlp")(h)
+        h = MlpBlock(self.mlp_dim, dtype=self.dtype,
+                     lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                     lora_targets=self.lora_targets, name="mlp")(h)
         return x + h
 
 
@@ -90,9 +123,16 @@ class ViT(nn.Module):
     dropout: float = 0.1
     freeze_base: bool = False
     dtype: Any = jnp.bfloat16
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.lora_rank:
+            from ddw_tpu.models.lora import validate_lora_targets
+
+            validate_lora_targets(self.lora_targets)
         x = x.astype(self.dtype)
         x = nn.Conv(self.hidden, (self.patch, self.patch), strides=self.patch,
                     name="backbone_patch_embed", dtype=self.dtype)(x)
@@ -102,6 +142,9 @@ class ViT(nn.Module):
         x = x + pos.astype(self.dtype)
         for i in range(self.depth):
             x = EncoderBlock(self.num_heads, self.mlp_dim, dtype=self.dtype,
+                             lora_rank=self.lora_rank,
+                             lora_alpha=self.lora_alpha,
+                             lora_targets=self.lora_targets,
                              name=f"backbone_block{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         hfeat = jnp.mean(x.astype(jnp.float32), axis=1)

@@ -110,7 +110,20 @@ def init_state(
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     frozen = type(model).frozen_prefixes(getattr(model, "freeze_base", False))
-    tx = make_optimizer(train_cfg, frozen)
+    if getattr(model, "lora_rank", 0):
+        # LoRA is its own freezing discipline (adapters + head train, base
+        # frozen at leaf granularity) — same altitude as frozen_prefixes, and
+        # mutually exclusive with it: stacking both would freeze the adapters
+        # too and nest MultiTransformStates under the LR callbacks.
+        if frozen:
+            raise ValueError(
+                "freeze_base and lora_rank are mutually exclusive — LoRA "
+                "already freezes the base; set model.freeze_base=false")
+        from ddw_tpu.models.lora import lora_optimizer
+
+        tx = lora_optimizer(make_optimizer(train_cfg))
+    else:
+        tx = make_optimizer(train_cfg, frozen)
     opt_state = tx.init(params)
     return TrainState(params, batch_stats, opt_state, jnp.zeros((), jnp.int32)), tx
 

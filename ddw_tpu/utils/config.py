@@ -85,6 +85,12 @@ class ModelCfg:
                                         # or "pallas" (in-tree VMEM-resident
                                         # kernel, ddw_tpu.ops.depthwise_conv;
                                         # stride-2 layers stay on XLA)
+    lora_rank: int = 0                  # >0 (ViT): rank-r LoRA adapters on
+                                        # lora_targets; the trainer freezes
+                                        # everything but adapters+head
+                                        # (mutually exclusive w/ freeze_base)
+    lora_alpha: float = 16.0
+    lora_targets: tuple[str, ...] = ("query", "value")
 
 
 @dataclass

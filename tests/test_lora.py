@@ -177,6 +177,73 @@ def test_lm_step_applies_lora_mask_automatically():
     assert moved_trainable > 0
 
 
+def test_vit_lora_through_trainer_path():
+    """ViT LoRA rides the standard vision stack: build_model + init_state
+    apply the mask (plain TrainCfg optimizer), only adapters+head move."""
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+    from ddw_tpu.train.step import init_state, make_train_step
+    from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+    cfg = ModelCfg(name="vit", num_classes=5, dropout=0.0, freeze_base=False,
+                   dtype="float32", lora_rank=2,
+                   lora_targets=("query", "value", "out", "fc1"))
+    model = build_model(cfg)
+    train_cfg = TrainCfg(batch_size=8, optimizer="adam", learning_rate=1e-2,
+                         warmup_epochs=0)
+    mesh = make_mesh(MeshSpec((("data", 8),)))
+    state, tx = init_state(model, cfg, train_cfg, (32, 32, 3),
+                           jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, "data", donate=False)
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.rand(8, 32, 32, 3).astype(np.float32) * 2 - 1)
+    labels = jnp.asarray(rng.randint(0, 5, 8).astype(np.int32))
+    new_state, metrics = step(state, imgs, labels, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+    mask = lora_mask(state.params)
+    moved = jax.tree.map(lambda a, b: bool(jnp.any(a != b)),
+                         state.params, new_state.params)
+    frozen_moved, trainable_moved = [], 0
+    for path, ch in jax.tree_util.tree_flatten_with_path(moved)[0]:
+        m = mask
+        for k in path:
+            m = m[k.key]
+        if ch and not m:
+            frozen_moved.append("/".join(k.key for k in path))
+        if ch and m:
+            trainable_moved += 1
+    assert not frozen_moved, frozen_moved
+    assert trainable_moved > 0
+
+
+def test_registry_lora_guards():
+    """Families without LoRA support refuse the flag; LoRA over a random
+    backbone warns (same footgun class as frozen-random freeze_base)."""
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.utils.config import ModelCfg
+
+    with pytest.raises(ValueError, match="does not support LoRA"):
+        build_model(ModelCfg(name="resnet50", freeze_base=False, lora_rank=4))
+    with pytest.warns(UserWarning, match="randomly initialized backbone"):
+        build_model(ModelCfg(name="vit", freeze_base=False, lora_rank=4))
+
+
+def test_vit_lora_freeze_base_conflict_raises():
+    from ddw_tpu.models.mobilenet_v2 import MobileNetV2
+    from ddw_tpu.train.step import init_state
+    from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+    # a model whose frozen_prefixes is non-empty AND lora_rank set must refuse
+    class _FakeLoRACNN(MobileNetV2):
+        lora_rank: int = 4
+
+    model = _FakeLoRACNN(num_classes=5, freeze_base=True)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        init_state(model,
+                   ModelCfg(name="mobilenet_v2", allow_frozen_random=True),
+                   TrainCfg(batch_size=4), (32, 32, 3), jax.random.PRNGKey(0))
+
+
 def test_lora_decode_generate_runs():
     """The KV-cached decode path works unchanged with adapters present."""
     model = _tiny_lm(lora_rank=2)
