@@ -42,7 +42,7 @@ class CausalSelfAttention(nn.Module):
     lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         from ddw_tpu.models.lora import maybe_lora_dense
 
         b, s, d = x.shape
@@ -55,6 +55,14 @@ class CausalSelfAttention(nn.Module):
         q = dense("query")(x)   # [B, S, H, hd]
         k = dense("key")(x)
         v = dense("value")(x)
+        if positions is not None:
+            # RoPE: rotate q/k by ABSOLUTE position before any cache write or
+            # ring hop — scores then depend only on relative distance, so the
+            # cached/ring-shipped K needs no further position plumbing.
+            from ddw_tpu.ops.rope import apply_rope
+
+            q = apply_rope(q, positions, seq_axis=1)
+            k = apply_rope(k, positions, seq_axis=1)
 
         if self.decode:
             # KV cache: accepts S tokens per call (S>1 = batched prefill, S=1 =
@@ -157,14 +165,14 @@ class DecoderBlock(nn.Module):
     lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, positions=None):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         h = CausalSelfAttention(self.num_heads, self.dtype, self.seq_axis,
                                 self.decode, self.max_len,
                                 lora_rank=self.lora_rank,
                                 lora_alpha=self.lora_alpha,
                                 lora_targets=self.lora_targets,
-                                name="attn")(h)
+                                name="attn")(h, positions=positions)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -218,6 +226,9 @@ class TransformerLM(nn.Module):
     lora_rank: int = 0       # >0: rank-r LoRA adapters (ddw_tpu.models.lora)
     lora_alpha: float = 16.0
     lora_targets: tuple[str, ...] = ("query", "value")
+    pos_encoding: str = "learned"  # "learned" absolute table (bounded by
+                                   # max_len) or "rope" rotary relative
+                                   # positions (ddw_tpu.ops.rope)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -225,11 +236,17 @@ class TransformerLM(nn.Module):
             from ddw_tpu.models.lora import validate_lora_targets
 
             validate_lora_targets(self.lora_targets)
+        if self.pos_encoding not in ("learned", "rope"):
+            raise ValueError(f"unknown pos_encoding {self.pos_encoding!r}; "
+                             f"use 'learned' or 'rope'")
+        if self.pos_encoding == "rope" and (self.hidden // self.num_heads) % 2:
+            raise ValueError("RoPE needs an even head_dim")
         b, s_local = tokens.shape
         x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype,
                      name="tok_embed")(tokens)
-        pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
-                               (self.max_len, self.hidden), jnp.float32)
+        if self.pos_encoding == "learned":
+            pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
+                                   (self.max_len, self.hidden), jnp.float32)
         if self.decode:
             # position = number of tokens already decoded (the attention layers
             # keep per-layer indices; this top-level one feeds the pos embed).
@@ -243,16 +260,27 @@ class TransformerLM(nn.Module):
             # Global length = s_local * axis_size must fit the position table:
             # dynamic_slice clamps out-of-range offsets, which would silently
             # reuse the last positions on trailing shards instead of failing.
+            # (RoPE has no table — positions extrapolate, so SP sequences may
+            # exceed max_len; only the decode cache stays bounded by it.)
             n_shards = lax.axis_size(self.seq_axis)
-            if s_local * n_shards > self.max_len:
+            if (self.pos_encoding == "learned"
+                    and s_local * n_shards > self.max_len):
                 raise ValueError(
                     f"global sequence {s_local}*{n_shards} exceeds max_len "
                     f"{self.max_len}")
             offset = lax.axis_index(self.seq_axis) * s_local
         else:
             offset = 0
-        pos = lax.dynamic_slice_in_dim(pos_table, offset, s_local, axis=0)
-        x = x + pos.astype(self.dtype)[None]
+        if self.pos_encoding == "learned":
+            pos = lax.dynamic_slice_in_dim(pos_table, offset, s_local, axis=0)
+            x = x + pos.astype(self.dtype)[None]
+            positions = None
+        else:
+            # RoPE: absolute positions feed the per-layer q/k rotation; no
+            # table, no additive embedding. Works unchanged under SP (offset
+            # = shard_index * s_local, K rotated before the ring) and decode
+            # (offset = tokens already written to the cache).
+            positions = offset + jnp.arange(s_local)
         for i in range(self.depth):
             x = DecoderBlock(self.num_heads, self.mlp_dim, self.dropout,
                              self.dtype, None if self.decode else self.seq_axis,
@@ -263,7 +291,8 @@ class TransformerLM(nn.Module):
                              lora_rank=self.lora_rank,
                              lora_alpha=self.lora_alpha,
                              lora_targets=self.lora_targets,
-                             name=f"backbone_block{i}")(x, train)
+                             name=f"backbone_block{i}")(x, train,
+                                                        positions=positions)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # vocab head in f32: logits feed a softmax CE, keep full precision
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)
@@ -284,7 +313,8 @@ def build_lm(cfg, seq_axis: str | None = None,
         capacity_factor=cfg.capacity_factor,
         lora_rank=getattr(cfg, "lora_rank", 0),
         lora_alpha=getattr(cfg, "lora_alpha", 16.0),
-        lora_targets=tuple(getattr(cfg, "lora_targets", ("query", "value"))))
+        lora_targets=tuple(getattr(cfg, "lora_targets", ("query", "value"))),
+        pos_encoding=getattr(cfg, "pos_encoding", "learned"))
 
 
 def init_cache(decode_model: TransformerLM, batch: int):

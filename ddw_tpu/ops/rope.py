@@ -1,0 +1,67 @@
+"""Rotary position embeddings (RoPE, Su et al. 2021) — relative positions
+for the long-context LM family.
+
+The learned absolute table (``TransformerLM.pos_embed``) caps context at
+``max_len`` and carries O(max_len * hidden) params; RoPE instead rotates each
+(query, key) head-dim pair by an angle proportional to the token's absolute
+position, which makes attention scores a function of *relative* distance
+only (pinned by ``test_rope.py::test_scores_depend_on_relative_position``).
+That is the property long-context training wants: positions extrapolate, and
+sequence parallelism composes trivially — each shard rotates its OWN q/k by
+its global positions (``offset = shard_index * s_local``) before the ring
+hops, so K arrives at every peer already rotated and the ring kernel
+(:mod:`ddw_tpu.parallel.ring_attention`) needs no position plumbing at all.
+The KV-cached decode path rotates by the cache write position the same way.
+
+Applied per head over ``[B, H, S, hd]`` with pair-split rotation:
+``(x_even, x_odd) -> (x_even cosθ - x_odd sinθ, x_even sinθ + x_odd cosθ)``,
+``θ(pos, 2i) = pos / theta^(2i/hd)``. Angles compute in f32 regardless of
+activation dtype (bf16 cos/sin at position 10^5 would lose the low bits that
+distinguish neighboring positions).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jnp.ndarray, head_dim: int,
+                theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(cos, sin) tables for integer ``positions [S]`` -> ``[S, hd/2]``."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, *, seq_axis: int = -2,
+               theta: float = 10000.0) -> jnp.ndarray:
+    """Rotate ``x`` by its positions. The last axis is the head dim;
+    ``seq_axis`` is where S lives (``-2`` for ``[B, H, S, hd]``, ``1`` for
+    the pre-transpose ``[B, S, H, hd]`` projection layout). Returns the same
+    dtype as ``x``."""
+    hd = x.shape[-1]
+    axis = seq_axis % x.ndim
+    if axis == x.ndim - 1:
+        raise ValueError("seq_axis cannot be the head dim")
+    s = x.shape[axis]
+    if positions.shape != (s,):
+        raise ValueError(f"positions {positions.shape} must match seq dim "
+                         f"{s} (axis {seq_axis})")
+    cos, sin = rope_angles(positions, hd, theta)
+    # broadcast cos/sin to x's layout: S at `axis`, hd/2 at the last axis
+    bshape = [1] * x.ndim
+    bshape[axis] = s
+    bshape[-1] = hd // 2
+    cos = cos.reshape(bshape)
+    sin = sin.reshape(bshape)
+    x32 = x.astype(jnp.float32)
+    x_even = x32[..., 0::2]
+    x_odd = x32[..., 1::2]
+    out_even = x_even * cos - x_odd * sin
+    out_odd = x_even * sin + x_odd * cos
+    # re-interleave: [..., hd/2, 2] -> [..., hd]
+    out = jnp.stack([out_even, out_odd], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)

@@ -67,9 +67,11 @@ def pp_params_from_lm(params: dict, n_stages: int, depth: int) -> dict:
         for r in range(n_stages)
     ]
     stages = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_trees)
+    embed = {"tok_embed": params["tok_embed"]}
+    if "pos_embed" in params:  # absent for pos_encoding='rope' models
+        embed["pos_embed"] = params["pos_embed"]
     return {
-        "embed": {"tok_embed": params["tok_embed"],
-                  "pos_embed": params["pos_embed"]},
+        "embed": embed,
         "stages": stages,
         "head": {"LayerNorm_0": params["LayerNorm_0"],
                  "head": params["head"]},
@@ -80,9 +82,10 @@ def lm_params_from_pp(pp: dict, n_stages: int, depth: int) -> dict:
     """Inverse of :func:`pp_params_from_lm` (checkpoints/serving interop)."""
     bps = depth // n_stages
     out = {"tok_embed": pp["embed"]["tok_embed"],
-           "pos_embed": pp["embed"]["pos_embed"],
            "LayerNorm_0": pp["head"]["LayerNorm_0"],
            "head": pp["head"]["head"]}
+    if "pos_embed" in pp["embed"]:  # absent for pos_encoding='rope' models
+        out["pos_embed"] = pp["embed"]["pos_embed"]
     for r in range(n_stages):
         for b in range(bps):
             out[f"backbone_block{r * bps + b}"] = jax.tree.map(
@@ -131,6 +134,10 @@ def make_pp_lm_train_step(
         raise ValueError("pipeline step does not implement expert parallelism "
                          "— build the MoE model with expert_axis=None (dense "
                          "experts) or use make_lm_train_step for EP")
+    if getattr(model, "lora_rank", 0):
+        raise ValueError("pipeline step does not support LoRA adapters — use "
+                         "make_lm_train_step")
+    rope = getattr(model, "pos_encoding", "learned") == "rope"
     n = mesh.shape[pipe_axis]
     if model.depth % n:
         raise ValueError(f"depth {model.depth} not divisible by pipe axis {n}")
@@ -152,16 +159,21 @@ def make_pp_lm_train_step(
         Returns (out, aux_sum) — the stage's summed Switch aux loss (0 for
         dense models)."""
         def body(h, block_params):
+            # RoPE: positions are global arange(S) — PP shards depth, not
+            # sequence, so every stage sees the full sequence
+            positions = jnp.arange(h.shape[-2]) if rope else None
             if moe:
                 from ddw_tpu.models.moe import collect_sown
 
                 out, mods = block_mod.apply({"params": block_params}, h, False,
+                                            positions=positions,
                                             mutable=["intermediates"])
                 # select the aux loss by name: blocks also sow routing
                 # telemetry that must not enter the loss
                 sown = collect_sown(mods, "moe_aux_loss")
                 return out, sum(sown)
-            return block_mod.apply({"params": block_params}, h, False), 0.0
+            return block_mod.apply({"params": block_params}, h, False,
+                                   positions=positions), 0.0
 
         out, aux = lax.scan(body, x, stage_params)
         return out, jnp.sum(aux)
@@ -179,8 +191,10 @@ def make_pp_lm_train_step(
 
         def loss_fn(p):
             emb = embed_mod.apply({"params": p["embed"]["tok_embed"]}, inputs)
-            pos = p["embed"]["pos_embed"][:s].astype(model.dtype)[None]
-            emb = (emb + pos).reshape(m, mb, s, model.hidden)
+            if not rope:
+                pos = p["embed"]["pos_embed"][:s].astype(model.dtype)[None]
+                emb = emb + pos
+            emb = emb.reshape(m, mb, s, model.hidden)
             targ = targets.reshape(m, mb, s)
             stage_params = jax.tree.map(lambda x: x[0], p["stages"])
 

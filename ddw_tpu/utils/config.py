@@ -165,6 +165,10 @@ class LMCfg:
                                         # adapters (+head) update
     lora_alpha: float = 16.0
     lora_targets: tuple[str, ...] = ("query", "value")
+    pos_encoding: str = "learned"       # "learned" absolute table or "rope"
+                                        # rotary relative positions
+                                        # (ddw_tpu.ops.rope — extrapolates
+                                        # past max_len, SP/decode-composable)
 
 
 @dataclass
