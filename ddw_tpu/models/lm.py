@@ -157,9 +157,10 @@ class DecoderBlock(nn.Module):
     seq_axis: str | None = None
     decode: bool = False
     max_len: int = 2048
-    num_experts: int = 0          # >0: MoE MLP (Switch top-1) instead of dense
+    num_experts: int = 0          # >0: MoE MLP (top-1/top-2) instead of dense
     expert_axis: str | None = None
     capacity_factor: float = 1.25
+    moe_router: str = "top1"
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: tuple[str, ...] = ("query", "value")
@@ -182,7 +183,7 @@ class DecoderBlock(nn.Module):
             h = MoEMlp(self.num_experts, self.mlp_dim,
                        capacity_factor=self.capacity_factor, dtype=self.dtype,
                        expert_axis=self.expert_axis, no_drop=self.decode,
-                       name="moe")(h)
+                       router=self.moe_router, name="moe")(h)
         else:
             from ddw_tpu.models.lora import maybe_lora_dense
 
@@ -223,6 +224,7 @@ class TransformerLM(nn.Module):
     num_experts: int = 0     # >0: MoE MLP blocks (expert parallelism via
     expert_axis: str | None = None  # expert_axis inside shard_map)
     capacity_factor: float = 1.25
+    moe_router: str = "top1"  # "top1" (Switch) or "top2" (GShard)
     lora_rank: int = 0       # >0: rank-r LoRA adapters (ddw_tpu.models.lora)
     lora_alpha: float = 16.0
     lora_targets: tuple[str, ...] = ("query", "value")
@@ -288,6 +290,7 @@ class TransformerLM(nn.Module):
                              num_experts=self.num_experts,
                              expert_axis=None if self.decode else self.expert_axis,
                              capacity_factor=self.capacity_factor,
+                             moe_router=self.moe_router,
                              lora_rank=self.lora_rank,
                              lora_alpha=self.lora_alpha,
                              lora_targets=self.lora_targets,
@@ -311,6 +314,7 @@ def build_lm(cfg, seq_axis: str | None = None,
         dropout=cfg.dropout, dtype=jnp.dtype(cfg.dtype), seq_axis=seq_axis,
         num_experts=cfg.num_experts, expert_axis=expert_axis,
         capacity_factor=cfg.capacity_factor,
+        moe_router=getattr(cfg, "moe_router", "top1"),
         lora_rank=getattr(cfg, "lora_rank", 0),
         lora_alpha=getattr(cfg, "lora_alpha", 16.0),
         lora_targets=tuple(getattr(cfg, "lora_targets", ("query", "value"))),

@@ -1,17 +1,21 @@
-"""Mixture-of-Experts MLP with expert parallelism — Switch-style top-1 routing.
+"""Mixture-of-Experts MLP with expert parallelism — Switch top-1 and GShard
+top-2 routing.
 
 Not a reference-parity item (the reference has no MoE — SURVEY.md §2d covers
 DP/trial/HPO/batch-inference parallelism only); this is the expert-parallel
 axis of the framework, same tier as TP (``parallel/sharding.py``) and SP
 (``parallel/ring_attention.py``).
 
-TPU-first formulation (Switch Transformer, Fedus et al. 2101.03961):
+TPU-first formulation (Switch Transformer, Fedus et al. 2101.03961; GShard,
+Lepikhin et al. 2006.16668):
 
-- **top-1 token-choice routing** with a *static* per-expert capacity
-  ``C = ceil(cf * T / E)`` — XLA needs fixed shapes, so routing builds dense
-  dispatch/combine tensors ``[T, E, C]`` instead of data-dependent gathers;
-  tokens past capacity fall through the residual connection (standard Switch
-  semantics).
+- **token-choice routing** (``router="top1"`` Switch, ``router="top2"``
+  GShard with renormalized pair gates) with a *static* per-expert capacity
+  ``C = ceil(cf * k * T / E)`` — XLA needs fixed shapes, so routing builds
+  dense dispatch/combine tensors ``[T, E, C]`` instead of data-dependent
+  gathers; tokens past capacity fall through the residual connection
+  (standard Switch/GShard semantics, first choices claiming capacity before
+  second).
 - **expert parallelism** over a named mesh axis: tokens stay sharded by the
   enclosing data/seq axes; each rank routes its local tokens against ALL ``E``
   experts, one ``lax.all_to_all`` ships the per-expert token blocks to the
@@ -91,6 +95,56 @@ def top1_routing(gate_logits: jnp.ndarray, capacity: int):
     return dispatch, combine, aux, stats
 
 
+def top2_routing(gate_logits: jnp.ndarray, capacity: int):
+    """GShard-style top-2 routing with static capacity (Lepikhin et al.
+    2006.16668): each token dispatches to its two highest-probability experts
+    with gates renormalized over the pair; first choices claim expert
+    capacity before second choices (arrival order within each choice).
+    Same ``[T, E, C]`` dispatch/combine contract as :func:`top1_routing`, so
+    the expert-parallel all_to_all path is identical.
+
+    Aux loss is the GShard/Switch form over FIRST-choice assignments
+    (``E * Σ_e f_e · p_e``). ``drop_rate`` counts dropped (token, choice)
+    slots over ``2T``; ``balance_entropy`` is over the combined assignment
+    distribution of both choices.
+    """
+    t, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)              # [T, E]
+    top_p, top_i = lax.top_k(probs, 2)                        # [T, 2]
+    gates = top_p / jnp.sum(top_p, axis=-1, keepdims=True)    # renormalized
+
+    dispatch = jnp.zeros((t, e, capacity), probs.dtype)
+    combine = jnp.zeros((t, e, capacity), probs.dtype)
+    counts = jnp.zeros((e,), probs.dtype)   # capacity already claimed
+    kept_slots = 0.0
+    assign_frac = jnp.zeros((e,), probs.dtype)
+    for choice in range(2):
+        onehot = jax.nn.one_hot(top_i[:, choice], e, dtype=probs.dtype)
+        # queue position among THIS choice's tokens, offset by earlier choices
+        pos = (jnp.sum((jnp.cumsum(onehot, axis=0) - 1.0) * onehot, axis=-1)
+               + onehot @ counts)                             # [T]
+        keep = pos < capacity
+        cap_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=probs.dtype)
+        d_c = (onehot * keep[:, None])[:, :, None] * cap_oh[:, None, :]
+        dispatch = dispatch + d_c
+        combine = combine + d_c * gates[:, choice][:, None, None]
+        counts = counts + jnp.sum(onehot, axis=0)
+        kept_slots = kept_slots + jnp.sum(keep.astype(probs.dtype))
+        assign_frac = assign_frac + jnp.mean(onehot, axis=0) / 2.0
+
+    first_frac = jnp.mean(jax.nn.one_hot(top_i[:, 0], e, dtype=probs.dtype),
+                          axis=0)
+    aux = e * jnp.sum(first_frac * jnp.mean(probs, axis=0))
+    stats = {
+        "drop_rate": 1.0 - kept_slots / (2.0 * t),
+        "balance_entropy": (-jnp.sum(assign_frac * jnp.log(assign_frac + 1e-9))
+                            / jnp.log(float(e))),
+        "expert_frac": assign_frac,
+    }
+    return dispatch, combine, aux, stats
+
+
 class MoEMlp(nn.Module):
     """Drop-in MoE replacement for a transformer's dense MLP block.
 
@@ -108,19 +162,29 @@ class MoEMlp(nn.Module):
     no_drop: bool = False    # inference/decode: capacity = T, never drop — a
                              # generated continuation must not depend on which
                              # other batch entries route to the same expert
+    router: str = "top1"     # "top1" (Switch) or "top2" (GShard, renormalized
+                             # pair gates; cf is per-choice, so effective
+                             # capacity doubles relative to top1 at equal cf)
 
     @nn.compact
     def __call__(self, x):
+        if self.router not in ("top1", "top2"):
+            raise ValueError(f"unknown router {self.router!r}; "
+                             f"use 'top1' or 'top2'")
         b, s, d = x.shape
         t = b * s
         e = self.num_experts
+        if self.router == "top2" and e < 2:
+            raise ValueError("top2 routing needs at least 2 experts")
         xt = x.reshape(t, d)
 
         gate_logits = nn.Dense(e, dtype=jnp.float32, name="gate")(
             xt.astype(jnp.float32))
+        k = 2 if self.router == "top2" else 1
         capacity = (t if self.no_drop
-                    else max(1, int(-(-self.capacity_factor * t // e))))
-        dispatch, combine, aux, stats = top1_routing(gate_logits, capacity)
+                    else max(1, int(-(-self.capacity_factor * k * t // e))))
+        route = top2_routing if self.router == "top2" else top1_routing
+        dispatch, combine, aux, stats = route(gate_logits, capacity)
         self.sow("intermediates", "moe_aux_loss", aux)
         # Routing telemetry for characterization (tools/moe_capacity_sweep.py)
         # and observability; reductions over these are cheap next to the FFNs.

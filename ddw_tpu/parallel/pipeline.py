@@ -148,7 +148,8 @@ def make_pp_lm_train_step(
     block_mod = DecoderBlock(model.num_heads, model.mlp_dim, 0.0, model.dtype,
                              None, False, model.max_len,
                              num_experts=model.num_experts,
-                             capacity_factor=model.capacity_factor)
+                             capacity_factor=model.capacity_factor,
+                             moe_router=model.moe_router)
     embed_mod = nn.Embed(model.vocab_size, model.hidden, dtype=model.dtype)
     ln_mod = nn.LayerNorm(dtype=jnp.float32)
     head_mod = nn.Dense(model.vocab_size, dtype=jnp.float32)

@@ -157,8 +157,11 @@ class LMCfg:
     mlp_dim: int = 1024
     dropout: float = 0.0
     dtype: str = "bfloat16"
-    num_experts: int = 0                # >0: Switch-style MoE MLP blocks
-    capacity_factor: float = 1.25       # static expert capacity = cf*T/E
+    num_experts: int = 0                # >0: MoE MLP blocks
+    capacity_factor: float = 1.25       # static expert capacity = cf*k*T/E
+    moe_router: str = "top1"            # "top1" (Switch) or "top2" (GShard:
+                                        # two experts/token, renormalized
+                                        # pair gates)
     lora_rank: int = 0                  # >0: rank-r LoRA adapters on
                                         # lora_targets (ddw_tpu.models.lora);
                                         # train with lora_optimizer so only

@@ -201,3 +201,83 @@ def test_moe_decode_path_matches_full_forward():
         outs.append(lg[:, 0])
     np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
                                np.asarray(full), rtol=1e-5, atol=1e-5)
+
+
+def test_top2_routing_invariants():
+    """Ample capacity: every token reaches exactly its two top experts with
+    renormalized gates summing to 1; capacity pressure drops second choices
+    after first choices claimed their slots."""
+    from ddw_tpu.models.moe import top2_routing
+
+    rng = np.random.RandomState(0)
+    t, e, cap = 12, 4, 12
+    logits = jnp.asarray(rng.randn(t, e).astype(np.float32) * 2)
+    dispatch, combine, aux, stats = top2_routing(logits, cap)
+    assert dispatch.shape == combine.shape == (t, e, cap)
+    # two dispatch slots per token, combine mass 1 per token
+    np.testing.assert_allclose(np.asarray(dispatch.sum((1, 2))),
+                               np.full(t, 2.0), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(combine.sum((1, 2))),
+                               np.ones(t), atol=1e-6)
+    assert float(stats["drop_rate"]) == 0.0
+    # the two chosen experts match top_k of the softmax
+    probs = jax.nn.softmax(logits, -1)
+    top2 = np.asarray(jax.lax.top_k(probs, 2)[1])
+    got = np.asarray(dispatch.sum(-1))  # [T, E] 0/1
+    for i in range(t):
+        assert set(np.nonzero(got[i])[0]) == set(top2[i])
+    # no expert queue exceeds its claimed count; per-slot uniqueness
+    assert np.all(np.asarray(dispatch.sum((0, 2))) <= cap + 1e-6)
+    assert np.all(np.asarray(dispatch.sum(0)) <= 1.0 + 1e-6)
+
+    # capacity 1: each expert serves one slot; first choices outrank second
+    d1, c1, _, s1 = top2_routing(logits, 1)
+    assert float(s1["drop_rate"]) > 0
+    assert np.all(np.asarray(d1.sum((0, 2))) <= 1.0 + 1e-6)
+
+
+def test_top2_moe_lm_ep_matches_dense():
+    """The EP all_to_all path is router-agnostic: top2 EP == top2 dense."""
+    n = 4
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, n),)), devices=jax.devices()[:n])
+    dense = MoEMlp(num_experts=4, mlp_dim=32, capacity_factor=16.0,
+                   dtype=jnp.float32, expert_axis=None, router="top2")
+    ep = MoEMlp(num_experts=4, mlp_dim=32, capacity_factor=16.0,
+                dtype=jnp.float32, expert_axis=DATA_AXIS, router="top2")
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, 6, 16).astype(np.float32))
+    params = dense.init(jax.random.PRNGKey(0), x)["params"]
+    ref = dense.apply({"params": params}, x)
+    ep_fwd = jax.jit(jax.shard_map(
+        lambda p, x: ep.apply({"params": p}, x),
+        mesh=mesh, in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS), check_vma=False))
+    np.testing.assert_allclose(np.asarray(ep_fwd(params, x)),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_top2_lm_trains_and_validates():
+    model = TransformerLM(vocab_size=VOCAB, max_len=64, hidden=32, depth=2,
+                          num_heads=2, mlp_dim=64, dropout=0.0,
+                          dtype=jnp.float32, num_experts=4,
+                          capacity_factor=2.0, moe_router="top2")
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)))
+    state = init_lm_state(model, optax.adam(3e-3), jax.random.PRNGKey(0))
+    step = make_lm_train_step(model, optax.adam(3e-3), mesh, DATA_AXIS,
+                              seq_axis=None, donate=False)
+    rng = np.random.RandomState(3)
+    start = rng.randint(0, VOCAB, (8, 1))
+    toks = jnp.asarray((start + np.arange(17)) % VOCAB)
+    first = last = None
+    for i in range(40):
+        state, m = step(state, toks[:, :-1], toks[:, 1:], jax.random.PRNGKey(i))
+        first = first or float(m["loss"])
+        last = float(m["loss"])
+    assert last < 0.7 * first, (first, last)
+
+    with pytest.raises(ValueError, match="unknown router"):
+        MoEMlp(num_experts=4, mlp_dim=8, router="top3").init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 2, 8)))
+    with pytest.raises(ValueError, match="at least 2 experts"):
+        MoEMlp(num_experts=1, mlp_dim=8, router="top2").init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 2, 8)))
