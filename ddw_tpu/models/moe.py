@@ -145,6 +145,22 @@ def top2_routing(gate_logits: jnp.ndarray, capacity: int):
     return dispatch, combine, aux, stats
 
 
+def router_fn(router: str):
+    """(routing fn, choices-per-token k) for a router name — the one place
+    that maps names to semantics (MoEMlp and the characterization sweep both
+    resolve through it, so they cannot diverge)."""
+    if router == "top1":
+        return top1_routing, 1
+    if router == "top2":
+        return top2_routing, 2
+    raise ValueError(f"unknown router {router!r}; use 'top1' or 'top2'")
+
+
+def expert_capacity(cf: float, k: int, tokens: int, experts: int) -> int:
+    """Static per-expert capacity ``ceil(cf * k * T / E)`` (>= 1)."""
+    return max(1, int(-(-cf * k * tokens // experts)))
+
+
 class MoEMlp(nn.Module):
     """Drop-in MoE replacement for a transformer's dense MLP block.
 
@@ -168,22 +184,19 @@ class MoEMlp(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        if self.router not in ("top1", "top2"):
-            raise ValueError(f"unknown router {self.router!r}; "
-                             f"use 'top1' or 'top2'")
+        route, k = router_fn(self.router)
         b, s, d = x.shape
         t = b * s
         e = self.num_experts
-        if self.router == "top2" and e < 2:
-            raise ValueError("top2 routing needs at least 2 experts")
+        if k > e:
+            raise ValueError(f"{self.router} routing needs at least {k} "
+                             f"experts, got {e}")
         xt = x.reshape(t, d)
 
         gate_logits = nn.Dense(e, dtype=jnp.float32, name="gate")(
             xt.astype(jnp.float32))
-        k = 2 if self.router == "top2" else 1
         capacity = (t if self.no_drop
-                    else max(1, int(-(-self.capacity_factor * k * t // e))))
-        route = top2_routing if self.router == "top2" else top1_routing
+                    else expert_capacity(self.capacity_factor, k, t, e))
         dispatch, combine, aux, stats = route(gate_logits, capacity)
         self.sow("intermediates", "moe_aux_loss", aux)
         # Routing telemetry for characterization (tools/moe_capacity_sweep.py)

@@ -1,12 +1,15 @@
 """MoE routing characterization: capacity factor vs token drop rate, and the
 aux-loss effect on balance entropy (VERDICT r2 item 7).
 
-Trains the small Switch-MoE LM twice on the virtual 8-device EP mesh — once
-with the load-balance auxiliary loss (Fedus et al. 2101.03961 weight 0.01) and
-once without — then sweeps the trained router over capacity factors, measuring
-token drop rate (fraction of tokens past their expert's static capacity
-``C = ceil(cf * T / E)``) and normalized assignment entropy (1.0 = balanced,
-0.0 = collapsed). The numbers land in BASELINE.md's MoE table.
+Trains the small MoE LM in four arms on the virtual 8-device EP mesh —
+router in {top1 (Switch), top2 (GShard)} x load-balance aux loss {on (0.01,
+Fedus et al. 2101.03961), off} — then sweeps each trained router over
+capacity factors, measuring dropped dispatch-slot rate (slots past the
+static capacity ``C = ceil(cf * k * T / E)``, k = choices per token, out of
+``k*T`` slots) and normalized assignment entropy (1.0 = balanced, 0.0 =
+collapsed). Routing semantics and the capacity formula come from
+``ddw_tpu.models.moe.router_fn`` / ``expert_capacity`` — the exact code the
+model runs. The numbers land in BASELINE.md's MoE tables.
 
 Run:
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -23,7 +26,6 @@ import numpy as np
 import optax
 
 from ddw_tpu.models.lm import TransformerLM
-from ddw_tpu.models.moe import top1_routing
 from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
 from ddw_tpu.train.lm_step import init_lm_state, make_lm_train_step
 
@@ -35,15 +37,16 @@ STEPS = 120
 CFS = (0.5, 0.75, 1.0, 1.25, 1.5, 2.0)
 
 
-def build(expert_axis):
+def build(expert_axis, router="top1"):
     return TransformerLM(vocab_size=VOCAB, max_len=SEQ, hidden=32, depth=2,
                          num_heads=2, mlp_dim=64, dropout=0.0,
                          dtype=jnp.float32, num_experts=EXPERTS,
-                         expert_axis=expert_axis, capacity_factor=1.25)
+                         expert_axis=expert_axis, capacity_factor=1.25,
+                         moe_router=router)
 
 
-def train(aux_weight: float, mesh):
-    model = build(DATA_AXIS)
+def train(aux_weight: float, mesh, router="top1"):
+    model = build(DATA_AXIS, router)
     tx = optax.adam(3e-3)
     state = init_lm_state(model, tx, jax.random.PRNGKey(0))
     step = make_lm_train_step(model, tx, mesh, DATA_AXIS, seq_axis=None,
@@ -55,11 +58,11 @@ def train(aux_weight: float, mesh):
     return state, float(m["loss"]), float(m["aux_loss"])
 
 
-def router_stats(state, cf: float) -> tuple[float, float]:
+def router_stats(state, cf: float, router="top1") -> tuple[float, float]:
     """Mean (drop_rate, balance_entropy) over the model's MoE blocks for a
     fresh token batch at capacity factor ``cf`` (dense apply — the routing
     decision is mesh-independent)."""
-    model = build(None)
+    model = build(None, router)
     rng = np.random.RandomState(1)
     toks = rng.randint(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
     # the blocks sow their raw gate logits; re-run routing over them at the
@@ -69,11 +72,14 @@ def router_stats(state, cf: float) -> tuple[float, float]:
     from ddw_tpu.models.moe import collect_sown
 
     gate_logits = collect_sown(mods, "gate_logits")
+    from ddw_tpu.models.moe import expert_capacity, router_fn
+
+    route, k = router_fn(router)
     drops, ents = [], []
     for gl in gate_logits:
         t = gl.shape[0]
-        cap = max(1, int(-(-cf * t // EXPERTS)))
-        _, _, _, stats = top1_routing(gl, cap)
+        cap = expert_capacity(cf, k, t, EXPERTS)
+        _, _, _, stats = route(gl, cap)
         drops.append(float(stats["drop_rate"]))
         ents.append(float(stats["balance_entropy"]))
     return float(np.mean(drops)), float(np.mean(ents))
@@ -84,16 +90,17 @@ def main():
     print(f"mesh: {dict(mesh.shape)}  experts={EXPERTS}  "
           f"tokens/shard={BATCH * SEQ // mesh.shape[DATA_AXIS]}")
     rows = []
-    for aux_w in (0.01, 0.0):
-        state, loss, aux = train(aux_w, mesh)
-        for cf in CFS:
-            drop, ent = router_stats(state, cf)
-            rows.append((aux_w, cf, drop, ent, loss, aux))
-    print(f"\n{'aux_w':>6} {'cf':>5} {'drop%':>7} {'entropy':>8} "
-          f"{'final_loss':>11} {'final_aux':>10}")
-    for aux_w, cf, drop, ent, loss, aux in rows:
-        print(f"{aux_w:>6} {cf:>5} {100 * drop:>6.1f}% {ent:>8.3f} "
-              f"{loss:>11.3f} {aux:>10.3f}")
+    for router in ("top1", "top2"):
+        for aux_w in (0.01, 0.0):
+            state, loss, aux = train(aux_w, mesh, router)
+            for cf in CFS:
+                drop, ent = router_stats(state, cf, router)
+                rows.append((router, aux_w, cf, drop, ent, loss, aux))
+    print(f"\n{'router':>6} {'aux_w':>6} {'cf':>5} {'drop%':>7} "
+          f"{'entropy':>8} {'final_loss':>11} {'final_aux':>10}")
+    for router, aux_w, cf, drop, ent, loss, aux in rows:
+        print(f"{router:>6} {aux_w:>6} {cf:>5} {100 * drop:>6.1f}% "
+              f"{ent:>8.3f} {loss:>11.3f} {aux:>10.3f}")
 
 
 if __name__ == "__main__":
