@@ -113,3 +113,35 @@ class EarlyStopping(_Resumable):
             return False
         self._wait += 1
         return self._wait >= self.patience  # Keras: stop at wait >= patience
+
+
+@dataclasses.dataclass
+class CosineDecay:
+    """Per-batch cosine LR decay after warmup (Loshchilov & Hutter 1608.03983
+    half-cycle; the modern fixed-budget alternative to plateau scheduling —
+    beyond parity, the reference only uses warmup + ReduceLROnPlateau).
+
+    Warmup batches ramp ``base_lr -> base_lr * world`` exactly like
+    :class:`LRWarmup`; the remaining batches decay the scaled target to
+    ``target * final_frac`` along a half cosine. Stateless — resume recomputes
+    the LR from (epoch, step) alone.
+    """
+
+    base_lr: float
+    world_size: int
+    warmup_epochs: int
+    total_epochs: int
+    final_frac: float = 0.0
+
+    def lr_for_step(self, epoch: int, step_in_epoch: int,
+                    steps_per_epoch: int) -> float:
+        warm = LRWarmup(self.base_lr, self.world_size, self.warmup_epochs)
+        if epoch < self.warmup_epochs and self.world_size > 1:
+            return warm.lr_for_step(epoch, step_in_epoch, steps_per_epoch)
+        target = self.base_lr * self.world_size
+        final = target * self.final_frac
+        spe = max(1, steps_per_epoch)
+        decay_total = max(1, (self.total_epochs - self.warmup_epochs) * spe)
+        k = (epoch - self.warmup_epochs) * spe + step_in_epoch
+        prog = min(1.0, max(0.0, k / decay_total))
+        return final + 0.5 * (target - final) * (1.0 + math.cos(math.pi * prog))

@@ -29,7 +29,7 @@ Design choices, TPU-first:
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import flax.struct
 import jax
@@ -124,13 +124,67 @@ def init_state(
         tx = lora_optimizer(make_optimizer(train_cfg))
     else:
         tx = make_optimizer(train_cfg, frozen)
+    if getattr(train_cfg, "ema_decay", 0.0):
+        # outermost wrap: the shadow tracks the FINAL post-mask updates
+        tx = with_param_ema(tx, train_cfg.ema_decay)
     opt_state = tx.init(params)
     return TrainState(params, batch_stats, opt_state, jnp.zeros((), jnp.int32)), tx
 
 
-def get_lr(state: TrainState) -> float:
-    """Read the current dynamic LR out of (possibly masked) opt state."""
+class EmaState(NamedTuple):
+    """Opt-state wrapper carrying a Polyak shadow of the parameters.
+
+    Living inside ``opt_state`` keeps ``TrainState``'s pytree structure (and
+    therefore checkpoints, donation signatures, and ZeRO sharding rules)
+    unchanged whether EMA is on or off."""
+
+    inner: Any
+    shadow: Any
+
+
+def with_param_ema(tx: optax.GradientTransformation,
+                   decay: float) -> optax.GradientTransformation:
+    """Wrap ``tx`` so every update also advances an exponential moving
+    average of the post-update parameters: ``shadow = d*shadow + (1-d)*p``.
+    Evaluation/serving read the shadow via :func:`ema_params` — train/eval
+    weight averaging (Polyak; the Keras ``ExponentialMovingAverage``
+    role) without a second params copy in ``TrainState``."""
+    if not 0.0 < decay < 1.0:
+        raise ValueError(f"ema decay must be in (0, 1), got {decay}")
+
+    def init(params):
+        # copy=True: astype is a no-op for f32 params and would ALIAS the
+        # param buffers — a donating train step then donates the same buffer
+        # twice (params and shadow) and XLA rejects the execution.
+        return EmaState(tx.init(params),
+                        jax.tree.map(
+                            lambda x: jnp.array(x, jnp.float32, copy=True),
+                            params))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("with_param_ema needs params at update time")
+        updates, inner = tx.update(updates, state.inner, params)
+        new_p = optax.apply_updates(params, updates)
+        shadow = jax.tree.map(
+            lambda s, p: decay * s + (1.0 - decay) * p.astype(jnp.float32),
+            state.shadow, new_p)
+        return updates, EmaState(inner, shadow)
+
+    return optax.GradientTransformation(init, update)
+
+
+def ema_params(state: TrainState):
+    """The Polyak shadow params, or ``None`` when EMA is off."""
     os_ = state.opt_state
+    return os_.shadow if isinstance(os_, EmaState) else None
+
+
+def get_lr(state: TrainState) -> float:
+    """Read the current dynamic LR out of (possibly masked/EMA) opt state."""
+    os_ = state.opt_state
+    if isinstance(os_, EmaState):
+        os_ = os_.inner
     if isinstance(os_, optax.MultiTransformState):
         os_ = os_.inner_states["train"].inner_state
     return float(os_.hyperparams["learning_rate"])
@@ -139,6 +193,9 @@ def get_lr(state: TrainState) -> float:
 def set_lr(state: TrainState, lr: float) -> TrainState:
     """Set the dynamic LR (callback suite writes; no recompilation)."""
     os_ = state.opt_state
+    ema = None
+    if isinstance(os_, EmaState):
+        ema, os_ = os_, os_.inner
     if isinstance(os_, optax.MultiTransformState):
         inner = os_.inner_states["train"]
         new_hp = dict(inner.inner_state.hyperparams)
@@ -146,10 +203,14 @@ def set_lr(state: TrainState, lr: float) -> TrainState:
         new_inner_state = inner.inner_state._replace(hyperparams=new_hp)
         new_states = dict(os_.inner_states)
         new_states["train"] = inner._replace(inner_state=new_inner_state)
-        return state.replace(opt_state=os_._replace(inner_states=new_states))
-    new_hp = dict(os_.hyperparams)
-    new_hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
-    return state.replace(opt_state=os_._replace(hyperparams=new_hp))
+        new_os = os_._replace(inner_states=new_states)
+    else:
+        new_hp = dict(os_.hyperparams)
+        new_hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+        new_os = os_._replace(hyperparams=new_hp)
+    if ema is not None:
+        new_os = ema._replace(inner=new_os)
+    return state.replace(opt_state=new_os)
 
 
 def forward_and_grads(model, state: TrainState, images, labels, dropout_rng):

@@ -38,10 +38,12 @@ from ddw_tpu.data.store import Table
 from ddw_tpu.models.registry import build_model
 from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
 from ddw_tpu.tracking.tracker import Run
-from ddw_tpu.train.callbacks import EarlyStopping, LRWarmup, ReduceLROnPlateau
+from ddw_tpu.train.callbacks import (CosineDecay, EarlyStopping, LRWarmup,
+                                     ReduceLROnPlateau)
 from ddw_tpu.train.step import (
     TrainState,
     batch_sharding,
+    ema_params,
     get_lr,
     init_state,
     make_eval_step,
@@ -183,6 +185,14 @@ class Trainer:
 
         if self._initial is not None:
             state, tx = self._initial
+            if cfg.ema_decay and ema_params(state) is None:
+                # the pre-built optimizer was not EMA-wrapped; silently
+                # evaluating raw params while the user asked for EMA (or
+                # crashing later with params=None) are both worse than this
+                raise ValueError(
+                    "train.ema_decay is set but the provided initial "
+                    "optimizer state carries no EMA shadow — build the tx "
+                    "with ddw_tpu.train.step.with_param_ema or drop the flag")
         else:
             rng = jax.random.PRNGKey(cfg.seed)
             state, tx = init_state(
@@ -194,6 +204,10 @@ class Trainer:
             if cfg.grad_accum_steps > 1:
                 raise ValueError("train.zero with grad_accum_steps>1 is not "
                                  "supported yet — pick one")
+            if cfg.ema_decay:
+                raise ValueError("train.zero with ema_decay is not supported "
+                                 "yet — the Polyak shadow would need its own "
+                                 "sharding rules; pick one")
             if cfg.async_checkpoint:
                 raise ValueError(
                     "train.zero with async_checkpoint=true is not supported: "
@@ -232,8 +246,17 @@ class Trainer:
             # already-sharded state)
             state = train_step.place_state(state)
 
+        if cfg.lr_schedule not in ("plateau", "cosine"):
+            raise ValueError(f"unknown train.lr_schedule {cfg.lr_schedule!r}; "
+                             f"use 'plateau' or 'cosine'")
         warmup = LRWarmup(cfg.learning_rate, world if cfg.scale_lr_by_world else 1,
                           cfg.warmup_epochs)
+        cosine = None
+        if cfg.lr_schedule == "cosine":
+            cosine = CosineDecay(cfg.learning_rate,
+                                 world if cfg.scale_lr_by_world else 1,
+                                 cfg.warmup_epochs, cfg.epochs,
+                                 cfg.cosine_final_lr_frac)
         plateau = ReduceLROnPlateau(cfg.plateau_patience, cfg.plateau_factor)
         early = EarlyStopping(cfg.early_stop_patience) if cfg.early_stop_patience else None
         if restored_meta and "callbacks" in restored_meta:
@@ -272,7 +295,7 @@ class Trainer:
             epochs_run = 0
             tracing = False
             resumed = ckpt is not None and resume and start_epoch > 0
-            if start_epoch >= cfg.warmup_epochs and not resumed:
+            if cosine is None and start_epoch >= cfg.warmup_epochs and not resumed:
                 # Past warmup (incl. warmup_epochs=0): start at the scaled target once;
                 # afterwards only the plateau callback may change the LR. On resume the
                 # restored opt_state already carries the LR training left off at
@@ -293,7 +316,14 @@ class Trainer:
                     t0 = time.time()
                     losses, accs = [], []
                     for step_i in range(steps_per_epoch):
-                        if in_warmup(epoch):
+                        if cosine is not None:
+                            # Stateless per-batch schedule: warmup ramp then
+                            # half-cycle decay; resume recomputes from
+                            # (epoch, step) alone.
+                            state = set_lr(
+                                state,
+                                cosine.lr_for_step(epoch, step_i, steps_per_epoch))
+                        elif in_warmup(epoch):
                             # Per-batch gradual LR scaling (Goyal et al.), the Horovod
                             # warmup-callback granularity (reference :314-318). set_lr is
                             # a dynamic-hyperparameter write — no recompilation.
@@ -317,6 +347,10 @@ class Trainer:
                     # all-gather them to match its replicated in_spec
                     eval_state = (state.replace(opt_state=()) if cfg.zero
                                   else state)
+                    if cfg.ema_decay:
+                        # evaluate the Polyak shadow (what serving should ship)
+                        eval_state = eval_state.replace(
+                            params=ema_params(state), opt_state=())
                     for _ in range(val_steps):
                         images, labels = next(viter)
                         m = eval_step(eval_state, images, labels)
@@ -345,7 +379,7 @@ class Trainer:
 
                     # LR-plateau AFTER metrics are world-consistent (ordering contract,
                     # reference :310-313 — trivially satisfied: metrics are pmean-ed in-step)
-                    if epoch + 1 >= cfg.warmup_epochs:
+                    if cosine is None and epoch + 1 >= cfg.warmup_epochs:
                         new_lr = plateau.update(val_loss, lr)
                         if new_lr != lr:
                             state = set_lr(state, new_lr)

@@ -111,6 +111,16 @@ class TrainCfg:
     warmup_epochs: int = 5              # LearningRateWarmupCallback(warmup_epochs=5)
     plateau_patience: int = 10          # ReduceLROnPlateau(patience=10)
     plateau_factor: float = 0.5
+    lr_schedule: str = "plateau"        # "plateau" (reference semantics) or
+                                        # "cosine" (per-batch half-cycle decay
+                                        # after warmup; plateau callback off)
+    cosine_final_lr_frac: float = 0.0   # cosine floor as a fraction of the
+                                        # scaled target LR
+    ema_decay: float = 0.0              # >0: Polyak shadow of the params in
+                                        # the opt state (train/step.EmaState);
+                                        # the trainer evaluates with the
+                                        # shadow; read it via
+                                        # ddw_tpu.train.step.ema_params
     early_stop_patience: int = 0        # 0 = disabled; pyfunc notebook uses 3
     seed: int = 0
     grad_accum_steps: int = 1           # >1: split each per-worker batch into N

@@ -69,11 +69,9 @@ def router_stats(state, cf: float, router="top1") -> tuple[float, float]:
     # probe cf (intermediates' own drop/entropy reflect the *trained* cf)
     _, mods = model.apply({"params": state.params}, jnp.asarray(toks),
                           train=False, mutable=["intermediates"])
-    from ddw_tpu.models.moe import collect_sown
+    from ddw_tpu.models.moe import collect_sown, expert_capacity, router_fn
 
     gate_logits = collect_sown(mods, "gate_logits")
-    from ddw_tpu.models.moe import expert_capacity, router_fn
-
     route, k = router_fn(router)
     drops, ents = [], []
     for gl in gate_logits:
