@@ -55,14 +55,23 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
-def _base_optimizer(name: str, learning_rate) -> optax.GradientTransformation:
+def _base_optimizer(name: str, learning_rate,
+                    weight_decay: float = 0.0) -> optax.GradientTransformation:
+    if weight_decay and name != "adamw":
+        # refuse-loudly: silently training without the requested
+        # regularization is only discoverable by comparing results
+        raise ValueError(f"weight_decay is only implemented for "
+                         f"optimizer='adamw', got {name!r}")
     if name == "adam":
         return optax.adam(learning_rate)
+    if name == "adamw":
+        return optax.adamw(learning_rate, weight_decay=weight_decay)
     if name == "adadelta":
         return optax.adadelta(learning_rate)
     if name == "sgd":
         return optax.sgd(learning_rate, momentum=0.9)
-    raise KeyError(f"unknown optimizer {name!r} (have adam, adadelta, sgd)")
+    raise KeyError(f"unknown optimizer {name!r} "
+                   f"(have adam, adamw, adadelta, sgd)")
 
 
 def make_optimizer(
@@ -77,7 +86,14 @@ def make_optimizer(
     """
     @functools.partial(optax.inject_hyperparams, static_args=())
     def _make(learning_rate):
-        return _base_optimizer(cfg.optimizer, learning_rate)
+        base = _base_optimizer(cfg.optimizer, learning_rate,
+                               getattr(cfg, "weight_decay", 0.0))
+        clip = getattr(cfg, "grad_clip_norm", 0.0)
+        if clip:
+            # clip BEFORE the optimizer (standard order): the global norm is
+            # taken over whatever gradient tree reaches this transform
+            base = optax.chain(optax.clip_by_global_norm(clip), base)
+        return base
 
     tx = _make(learning_rate=cfg.learning_rate)
     if frozen_prefixes:

@@ -105,8 +105,12 @@ class TrainCfg:
 
     batch_size: int = 32                # per-worker batch (reference semantics)
     epochs: int = 3
-    optimizer: str = "adam"             # adam | adadelta | sgd (HPO space includes Adadelta)
+    optimizer: str = "adam"             # adam | adamw | adadelta | sgd
+                                        # (HPO space includes Adadelta)
     learning_rate: float = 1e-3
+    weight_decay: float = 0.0           # adamw decoupled weight decay
+    grad_clip_norm: float = 0.0         # >0: clip grads by global norm before
+                                        # the optimizer update
     scale_lr_by_world: bool = True      # Adam(0.001 * hvd.size()) semantics
     warmup_epochs: int = 5              # LearningRateWarmupCallback(warmup_epochs=5)
     plateau_patience: int = 10          # ReduceLROnPlateau(patience=10)
