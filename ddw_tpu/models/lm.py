@@ -37,6 +37,10 @@ class CausalSelfAttention(nn.Module):
     seq_axis: str | None = None
     decode: bool = False     # autoregressive mode: KV cache, one token per call
     max_len: int = 2048      # cache capacity in decode mode
+    num_kv_heads: int = 0    # GQA (Ainslie et al. 2305.13245): 0 = num_heads
+                             # (MHA); fewer KV heads shrink the k/v params and
+                             # the decode cache by H/KV; K/V broadcast to the
+                             # full head count at compute time
     lora_rank: int = 0       # >0: rank-r adapters on lora_targets projections
     lora_alpha: float = 16.0
     lora_targets: tuple[str, ...] = ("query", "value")
@@ -47,14 +51,19 @@ class CausalSelfAttention(nn.Module):
 
         b, s, d = x.shape
         head_dim = d // self.num_heads
+        kv_heads = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv_heads:
+            raise ValueError(f"num_heads {self.num_heads} not divisible by "
+                             f"num_kv_heads {kv_heads}")
+        groups = self.num_heads // kv_heads
 
-        def dense(name):
-            return maybe_lora_dense((self.num_heads, head_dim), name,
+        def dense(name, heads=self.num_heads):
+            return maybe_lora_dense((heads, head_dim), name,
                                     rank=self.lora_rank, alpha=self.lora_alpha,
                                     targets=self.lora_targets, dtype=self.dtype)
-        q = dense("query")(x)   # [B, S, H, hd]
-        k = dense("key")(x)
-        v = dense("value")(x)
+        q = dense("query")(x)             # [B, S, H, hd]
+        k = dense("key", kv_heads)(x)     # [B, S, KV, hd]
+        v = dense("value", kv_heads)(x)
         if positions is not None:
             # RoPE: rotate q/k by ABSOLUTE position before any cache write or
             # ring hop — scores then depend only on relative distance, so the
@@ -74,10 +83,12 @@ class CausalSelfAttention(nn.Module):
             # output with NaN (loud failure) instead of silently clamping.
             tile = min(256, self.max_len)
             cap = -(-self.max_len // tile) * tile  # capacity, tile multiple
+            # GQA: the cache holds KV heads only — the H/KV memory saving is
+            # exactly what grouped queries exist for at generation time
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, cap, self.num_heads, head_dim), k.dtype)
+                               (b, cap, kv_heads, head_dim), k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, cap, self.num_heads, head_dim), v.dtype)
+                               (b, cap, kv_heads, head_dim), v.dtype)
             idx = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
             # cumulative count of KV tiles actually computed — observability
@@ -104,6 +115,9 @@ class CausalSelfAttention(nn.Module):
                         ck.value, start, tile, axis=1).astype(jnp.float32)
                     v_t = lax.dynamic_slice_in_dim(
                         cv.value, start, tile, axis=1).astype(jnp.float32)
+                    if groups > 1:  # broadcast KV heads over their query group
+                        k_t = jnp.repeat(k_t, groups, axis=2)
+                        v_t = jnp.repeat(v_t, groups, axis=2)
                     s_t = jnp.einsum("bhqd,bkhd->bhqk", q32, k_t)  # [B,H,S,T]
                     kpos = start + jnp.arange(tile)
                     mask = kpos[None, None, None, :] <= qpos[None, None, :, None]
@@ -133,6 +147,12 @@ class CausalSelfAttention(nn.Module):
             overflow = (pos + s) > self.max_len
             out = jnp.where(overflow, jnp.nan, out).astype(x.dtype)
         else:
+            if groups > 1:
+                # broadcast KV heads to the full head count: the flash/ring
+                # kernels stay head-symmetric (the GQA win here is params,
+                # not compute)
+                k = jnp.repeat(k, groups, axis=2)
+                v = jnp.repeat(v, groups, axis=2)
             # [B, S, H, hd] -> [B, H, S, hd] for the batched kernels
             qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             if self.seq_axis is not None:
@@ -161,6 +181,7 @@ class DecoderBlock(nn.Module):
     expert_axis: str | None = None
     capacity_factor: float = 1.25
     moe_router: str = "top1"
+    num_kv_heads: int = 0
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: tuple[str, ...] = ("query", "value")
@@ -170,6 +191,7 @@ class DecoderBlock(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         h = CausalSelfAttention(self.num_heads, self.dtype, self.seq_axis,
                                 self.decode, self.max_len,
+                                num_kv_heads=self.num_kv_heads,
                                 lora_rank=self.lora_rank,
                                 lora_alpha=self.lora_alpha,
                                 lora_targets=self.lora_targets,
@@ -225,6 +247,8 @@ class TransformerLM(nn.Module):
     expert_axis: str | None = None  # expert_axis inside shard_map)
     capacity_factor: float = 1.25
     moe_router: str = "top1"  # "top1" (Switch) or "top2" (GShard)
+    num_kv_heads: int = 0    # GQA: KV heads (0 = num_heads); decode cache and
+                             # k/v params shrink by num_heads/num_kv_heads
     lora_rank: int = 0       # >0: rank-r LoRA adapters (ddw_tpu.models.lora)
     lora_alpha: float = 16.0
     lora_targets: tuple[str, ...] = ("query", "value")
@@ -291,6 +315,7 @@ class TransformerLM(nn.Module):
                              expert_axis=None if self.decode else self.expert_axis,
                              capacity_factor=self.capacity_factor,
                              moe_router=self.moe_router,
+                             num_kv_heads=self.num_kv_heads,
                              lora_rank=self.lora_rank,
                              lora_alpha=self.lora_alpha,
                              lora_targets=self.lora_targets,
@@ -315,6 +340,7 @@ def build_lm(cfg, seq_axis: str | None = None,
         num_experts=cfg.num_experts, expert_axis=expert_axis,
         capacity_factor=cfg.capacity_factor,
         moe_router=getattr(cfg, "moe_router", "top1"),
+        num_kv_heads=getattr(cfg, "num_kv_heads", 0),
         lora_rank=getattr(cfg, "lora_rank", 0),
         lora_alpha=getattr(cfg, "lora_alpha", 16.0),
         lora_targets=tuple(getattr(cfg, "lora_targets", ("query", "value"))),

@@ -87,8 +87,23 @@ def shardings_for_params(tree, mesh: Mesh, rules: PartitionRules):
     hyperparams) match nothing and replicate."""
     def to_sharding(path, leaf):
         key = _path_key(path)
-        ndim = len(getattr(leaf, "shape", ())) if not hasattr(leaf, "ndim") else leaf.ndim
-        return NamedSharding(mesh, rules.spec_for(key, ndim))
+        shape = tuple(getattr(leaf, "shape", ()))
+        spec = rules.spec_for(key, len(shape))
+        # Refuse loudly where GSPMD would fail opaquely at compile time: every
+        # sharded dim must divide by its mesh-axis size. The common trip-wire
+        # is GQA/MQA (num_kv_heads < model-axis size shrinks the k/v head dim
+        # the TP rules shard).
+        for d, axis in enumerate(spec):
+            if axis is None or d >= len(shape):
+                continue
+            n = mesh.shape[axis]
+            if shape[d] % n:
+                raise ValueError(
+                    f"cannot shard {key} dim {d} (size {shape[d]}) over mesh "
+                    f"axis {axis!r} (size {n}): not divisible. For GQA/MQA "
+                    f"models either keep num_kv_heads a multiple of the "
+                    f"model-axis size or override the k/v rules to replicate.")
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(to_sharding, tree)
 

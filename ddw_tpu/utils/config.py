@@ -176,6 +176,11 @@ class LMCfg:
     moe_router: str = "top1"            # "top1" (Switch) or "top2" (GShard:
                                         # two experts/token, renormalized
                                         # pair gates)
+    num_kv_heads: int = 0               # GQA: KV heads (0 = num_heads / MHA).
+                                        # Shrinks k/v params and the decode
+                                        # KV cache by num_heads/num_kv_heads;
+                                        # K/V broadcast per query group at
+                                        # compute
     lora_rank: int = 0                  # >0: rank-r LoRA adapters on
                                         # lora_targets (ddw_tpu.models.lora);
                                         # train with lora_optimizer so only
