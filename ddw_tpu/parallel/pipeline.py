@@ -149,7 +149,8 @@ def make_pp_lm_train_step(
                              None, False, model.max_len,
                              num_experts=model.num_experts,
                              capacity_factor=model.capacity_factor,
-                             moe_router=model.moe_router)
+                             moe_router=model.moe_router,
+                             num_kv_heads=getattr(model, "num_kv_heads", 0))
     embed_mod = nn.Embed(model.vocab_size, model.hidden, dtype=model.dtype)
     ln_mod = nn.LayerNorm(dtype=jnp.float32)
     head_mod = nn.Dense(model.vocab_size, dtype=jnp.float32)

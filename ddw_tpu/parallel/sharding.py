@@ -96,7 +96,11 @@ def shardings_for_params(tree, mesh: Mesh, rules: PartitionRules):
         for d, axis in enumerate(spec):
             if axis is None or d >= len(shape):
                 continue
-            n = mesh.shape[axis]
+            # a spec entry may name several mesh axes (P(("data","model"),...))
+            names = axis if isinstance(axis, tuple) else (axis,)
+            n = 1
+            for a in names:
+                n *= mesh.shape[a]
             if shape[d] % n:
                 raise ValueError(
                     f"cannot shard {key} dim {d} (size {shape[d]}) over mesh "

@@ -35,6 +35,8 @@ _EXAMPLES = [
     ("08_pretrained_transfer.py",
      ["--pretrain-epochs", "1", "train.epochs=1"], "[score]"),
     ("07_lm_long_context.py", ["--steps", "3"], "final:"),
+    ("07_lm_long_context.py",
+     ["--steps", "3", "lm.pos_encoding=rope", "lm.num_kv_heads=2"], "final:"),
     ("09_lora_finetune.py", [], "base_frozen=True"),
 ]
 

@@ -9,8 +9,8 @@ import pytest
 from ddw_tpu.models.lm import TransformerLM, generate, init_cache
 
 
-def _lm(**kw):
-    return TransformerLM(vocab_size=32, max_len=64, hidden=32, depth=2,
+def _lm(depth=2, **kw):
+    return TransformerLM(vocab_size=32, max_len=64, hidden=32, depth=depth,
                          num_heads=4, dtype=jnp.float32, mlp_dim=64, **kw)
 
 
@@ -106,6 +106,41 @@ def test_gqa_tp_rules_refuse_loudly():
     sh = shardings_for_params(params_ok, mesh, LM_TP_RULES)
     q = sh["backbone_block0"]["attn"]["query"]["kernel"]
     assert q.spec == jax.sharding.PartitionSpec(None, MODEL_AXIS, None)
+
+
+def test_gqa_pp_step_matches_single_device():
+    """The pipeline step forwards num_kv_heads to its stage blocks: one
+    4-stage PP step == one plain step on a GQA model."""
+    import optax
+
+    from ddw_tpu.parallel.pipeline import (init_pp_state, lm_params_from_pp,
+                                           make_pp_lm_train_step)
+    from ddw_tpu.runtime.mesh import DATA_AXIS, MeshSpec, make_mesh
+    from ddw_tpu.train.lm_step import init_lm_state, make_lm_train_step
+
+    n = 4
+    mesh_pp = make_mesh(MeshSpec((("pipe", n),)), devices=jax.devices()[:n])
+    mesh_1 = make_mesh(MeshSpec(((DATA_AXIS, 1),)), devices=jax.devices()[:1])
+    model = _lm(depth=4, dropout=0.0, num_kv_heads=2)
+    tx = optax.sgd(1e-1)
+    rng = np.random.RandomState(5)
+    toks = jnp.asarray(rng.randint(0, 32, (8, 17)))
+    ref_state = init_lm_state(model, tx, jax.random.PRNGKey(1))
+    ref_step = make_lm_train_step(model, tx, mesh_1, DATA_AXIS, seq_axis=None,
+                                  donate=False)
+    ref_new, ref_m = ref_step(ref_state, toks[:, :-1], toks[:, 1:],
+                              jax.random.PRNGKey(2))
+    pp_state = init_pp_state(model, tx, mesh_pp, jax.random.PRNGKey(1))
+    step = make_pp_lm_train_step(model, tx, mesh_pp, num_microbatches=4,
+                                 donate=False)
+    pp_state = step.place_state(pp_state)
+    pp_new, pp_m = step(pp_state, toks[:, :-1], toks[:, 1:])
+    assert abs(float(pp_m["loss"]) - float(ref_m["loss"])) < 1e-5
+    got = lm_params_from_pp(jax.device_get(pp_new.params), n, model.depth)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        got, jax.device_get(ref_new.params))
 
 
 def test_gqa_validation():
