@@ -1,3 +1,4 @@
 from ddw_tpu.tune.space import uniform, loguniform, quniform, choice, choice_of, ChoiceOf, sample_space  # noqa: F401
 from ddw_tpu.tune.tpe import fmin, Trials, STATUS_OK, STATUS_FAIL  # noqa: F401
-from ddw_tpu.tune.pruner import MedianPruner, Pruned, STATUS_PRUNED, Trial  # noqa: F401
+from ddw_tpu.tune.pruner import (ASHAPruner, MedianPruner, Pruned,  # noqa: F401
+                                 STATUS_PRUNED, Trial, make_pruner)

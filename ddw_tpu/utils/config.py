@@ -208,12 +208,15 @@ class TuneCfg:
     algo: str = "tpe"                   # tpe | random
     n_startup_trials: int = 5           # random trials before TPE kicks in
     gamma: float = 0.25                 # TPE good/bad split quantile
-    prune: bool = False                 # median-rule trial pruning (beyond
-                                        # hyperopt): stop trials whose per-epoch
-                                        # val_loss is worse than the median of
-                                        # other trials at the same epoch
-    prune_warmup_epochs: int = 1        # never prune below this epoch
-    prune_min_trials: int = 3           # peers needed before the median is trusted
+    prune: bool = False                 # trial pruning (beyond hyperopt):
+                                        # stop hopeless trials early on their
+                                        # per-epoch val_loss
+    pruner: str = "median"              # "median" (Vizier/Optuna rule) or
+                                        # "asha" (async successive halving)
+    prune_warmup_epochs: int = 1        # median: never prune below this epoch
+    prune_min_trials: int = 3           # median: peers needed before trusted
+    asha_min_resource: int = 1          # asha: first rung (epochs)
+    asha_reduction_factor: int = 3      # asha: eta — top 1/eta survive a rung
 
 
 _TYPES = {"data": DataCfg, "model": ModelCfg, "train": TrainCfg, "tune": TuneCfg,

@@ -91,14 +91,11 @@ def main():
 
     parent = ws["tracker"].start_run("hyperopt_parallel")
 
-    pruner = None
-    if tune_cfg.prune:
-        # Median-rule pruning (beyond hyperopt): per-epoch val_loss reported
-        # through Trainer's on_epoch hook; hopeless trials stop early.
-        from ddw_tpu.tune import MedianPruner
+    # Trial pruning (beyond hyperopt): per-epoch val_loss reported through
+    # Trainer's on_epoch hook; tune.pruner selects the rule (median | asha).
+    from ddw_tpu.tune import make_pruner
 
-        pruner = MedianPruner(tune_cfg.prune_warmup_epochs,
-                              tune_cfg.prune_min_trials)
+    pruner = make_pruner(tune_cfg)
 
     def objective(params, trial=None):
         with slot_lock:

@@ -41,15 +41,12 @@ def main():
     parent = ws["tracker"].start_run("hyperopt_distributed")
     trial_no = {"n": 0}
 
-    pruner = None
-    if tune_cfg.prune:
-        # Pruning pays off most here: every pruned epoch frees the WHOLE mesh.
-        # Sequential trials still benefit — the median compares against the
-        # curves of already-finished trials at the same epoch.
-        from ddw_tpu.tune import MedianPruner
+    # Pruning pays off most here: every pruned epoch frees the WHOLE mesh.
+    # Sequential trials still benefit — the rule compares against the curves
+    # of already-finished trials; tune.pruner selects median | asha.
+    from ddw_tpu.tune import make_pruner
 
-        pruner = MedianPruner(tune_cfg.prune_warmup_epochs,
-                              tune_cfg.prune_min_trials)
+    pruner = make_pruner(tune_cfg)
 
     def train_and_evaluate(params, trial=None):
         """The train_and_evaluate_hvd(lr, dropout, batch_size, checkpoint_dir)

@@ -305,3 +305,81 @@ def test_startup_rerolls_categorical_collision():
         suggest(space, t, rng, n_startup_trials=5, pending=[{"c": "a"}])["c"] == "a"
         for _ in range(50))
     assert hits < 10, hits  # unbiased sampling would give ~25
+
+
+def test_asha_pruner_rungs_and_cuts():
+    from ddw_tpu.tune.pruner import ASHAPruner, Pruned
+
+    p = ASHAPruner(min_resource=1, reduction_factor=3)
+    # steps are 0-indexed epochs: rungs fire when step+1 epochs are consumed
+    # (resource 1, 3, 9 -> steps 0, 2, 8); step 1 is between rungs
+    assert p._rung_of(0) == 0 and p._rung_of(2) == 1 and p._rung_of(8) == 2
+    assert p._rung_of(1) is None
+    t_good = p.make_trial({})
+    t_mid = p.make_trial({})
+    t_bad = p.make_trial({})
+    # first two at rung 0: too few recorded to cut
+    t_good.report(0, 0.1)
+    t_mid.report(0, 0.5)
+    # third is worst of three with eta=3 -> only top-1 survives the rung
+    with pytest.raises(Pruned):
+        t_bad.report(0, 0.9)
+    # the good trial sails through between-rung steps and later rungs
+    t_good.report(1, 0.09)
+    t_good.report(2, 0.08)
+    # NaN prunes unconditionally
+    with pytest.raises(Pruned):
+        p.make_trial({}).report(1, float("nan"))
+    with pytest.raises(ValueError, match="reduction_factor"):
+        ASHAPruner(min_resource=1, reduction_factor=1)
+
+
+def test_asha_beats_full_budget_on_trial_cost():
+    """fmin with ASHA: bad configs stop at rung 0 instead of running the full
+    budget; the best config still completes and wins."""
+    from ddw_tpu.tune.pruner import ASHAPruner, STATUS_PRUNED
+    from ddw_tpu.tune.space import uniform
+    from ddw_tpu.tune.tpe import Trials, fmin
+
+    FULL = 9
+    epochs_run: dict[float, int] = {}
+
+    def objective(params, trial):
+        # deterministic curve: final quality == x; early signal proportional.
+        # steps are 0-indexed epochs, like Trainer(on_epoch=...) reports.
+        x = params["x"]
+        for step in range(FULL):
+            trial.report(step, x + 1.0 / (step + 1))
+            epochs_run[x] = step + 1
+        return x
+
+    t = Trials()
+    fmin(objective, {"x": uniform("x", 0.0, 1.0)}, max_evals=12,
+         trials=t, seed=5, pruner=ASHAPruner(min_resource=1,
+                                             reduction_factor=3))
+    statuses = [r["status"] for r in t.results]
+    assert statuses.count(STATUS_PRUNED) >= 3  # bad draws stopped early
+    completed = [r for r in t.results if r["status"] == "ok"]
+    assert completed, "at least one trial must finish"
+    # pruned trials did NOT pay the full budget
+    pruned_epochs = [e for x, e in epochs_run.items()
+                     if x not in [r["loss"] for r in completed]]
+    assert pruned_epochs and max(pruned_epochs) < FULL
+
+
+def test_asha_rereport_is_idempotent_and_factory_dispatch():
+    from ddw_tpu.tune.pruner import ASHAPruner, make_pruner
+    from ddw_tpu.utils.config import TuneCfg
+
+    p = ASHAPruner(min_resource=1, reduction_factor=3)
+    t = p.make_trial({})
+    # same trial re-reporting a rung (resume) must not inflate the population
+    t.report(0, 0.5)
+    t.report(0, 0.5)
+    assert len(p._rungs[0]) == 1
+
+    assert make_pruner(TuneCfg(prune=False)) is None
+    assert isinstance(make_pruner(TuneCfg(prune=True, pruner="asha")),
+                      ASHAPruner)
+    with pytest.raises(ValueError, match="unknown tune.pruner"):
+        make_pruner(TuneCfg(prune=True, pruner="hyperband"))
