@@ -19,7 +19,10 @@ from ddw_tpu.train.trainer import Trainer
 
 
 def main():
-    args = parse_args(__doc__)
+    args = parse_args(__doc__, extra=lambda ap: ap.add_argument(
+        "--int8", action="store_true",
+        help="store kernels as per-channel int8 (~4x smaller artifact; "
+             "loads transparently — ddw_tpu.serving.quantize)"))
     ws = setup(args)
     cfgs = ws["cfgs"]
     train_tbl, val_tbl = require_tables(ws["store"], ws["cfgs"]["data"])
@@ -39,9 +42,13 @@ def main():
                         res.state.batch_stats,
                         img_height=cfgs["data"].img_height,
                         img_width=cfgs["data"].img_width,
-                        extra_meta={"val_accuracy": res.val_accuracy})
+                        extra_meta={"val_accuracy": res.val_accuracy},
+                        quantize="int8" if args.int8 else None)
     run.end()
-    print(f"packaged model at {pkg_dir} (val_accuracy={res.val_accuracy:.4f})")
+    blob = os.path.getsize(os.path.join(pkg_dir, "params.msgpack"))
+    print(f"packaged model at {pkg_dir} (val_accuracy={res.val_accuracy:.4f}, "
+          f"params blob {blob / 1024:.0f} KiB"
+          + (", int8 weight-only" if args.int8 else "") + ")")
 
     # single-node scoring of an in-memory batch (:446-450)
     pm = PackagedModel(pkg_dir)

@@ -32,6 +32,8 @@ _EXAMPLES = [
     ("05_hyperopt_distributed.py",
      ["tune.max_evals=2", "train.epochs=1"], "best"),
     ("06_packaged_inference.py", ["train.epochs=1"], "distributed scoring"),
+    ("06_packaged_inference.py", ["--int8", "train.epochs=1"],
+     "int8 weight-only"),
     ("08_pretrained_transfer.py",
      ["--pretrain-epochs", "1", "train.epochs=1"], "[score]"),
     ("07_lm_long_context.py", ["--steps", "3"], "final:"),
