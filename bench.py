@@ -440,6 +440,14 @@ def _device_problem(timeout_s: float = 240.0) -> str | None:
     t.start()
     t.join(timeout_s)
     if done:
+        # A down-at-connect tunnel makes the axon plugin fall back to CPU,
+        # which would record CPU timings as chip results. Opt-in guard so CPU
+        # smoke runs (DDW_BENCH_SMOKE) keep working.
+        if (os.environ.get("DDW_REQUIRE_TPU")
+                and "TPU" not in jax.devices()[0].device_kind):
+            return (f"DDW_REQUIRE_TPU set but backend is "
+                    f"{jax.devices()[0].device_kind!r} (tunnel down at "
+                    f"connect — axon fell back); refusing to measure")
         return None
     if failed:
         return f"device backend errored: {failed[0]}"

@@ -234,6 +234,15 @@ def profile_model(name: str, batch: int, img: int):
         r = bench_conv(rep[key], batch)
         r["count"] = count
         rows.append(r)
+        # Incremental record on stderr: the tunnel can wedge mid-profile and
+        # an outer timeout kill would otherwise lose every row of the model.
+        s = r["spec"]
+        alt = "".join(f" {k}={v:.3f}ms" for k, v in r["variants"].items())
+        print(f"[prof] {name} {s.name} x{count} {s.in_hw}²x{s.cin}->{s.cout}"
+              f" k{s.k}s{s.stride}g{s.groups}: {r['ms']:.3f}ms"
+              f" {r['tflops']:.1f}TF/s {r['gbps']:.0f}GB/s"
+              f" bound={r['bound_kind']} x{r['vs_bound']:.2f}{alt}",
+              file=sys.stderr, flush=True)
     rows.sort(key=lambda r: -r["ms"] * r["count"])
 
     total = sum(r["ms"] * r["count"] for r in rows)
@@ -263,7 +272,13 @@ def main():
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--img", type=int, default=224)
     args = ap.parse_args()
-    print(f"device: {jax.devices()[0].device_kind} "
+    kind = jax.devices()[0].device_kind
+    if os.environ.get("DDW_REQUIRE_TPU") and "TPU" not in kind:
+        print(f"DDW_REQUIRE_TPU set but backend is {kind!r} (axon fell back "
+              f"to CPU — tunnel down at connect); refusing to profile",
+              file=sys.stderr)
+        sys.exit(4)
+    print(f"device: {kind} "
           f"(assumed {PEAK_TFLOPS} TF/s bf16, {HBM_GBPS} GB/s)")
     for m in (args.models or ["mobilenet_v2", "resnet50"]):
         profile_model(m, args.batch, args.img)

@@ -95,7 +95,13 @@ def main():
     args = ap.parse_args()
     b, h, d = args.batch, args.heads, args.dim
     blocks = [int(x) for x in args.blocks.split(",")]
-    print(f"device: {jax.devices()[0].device_kind}  shape B{b} H{h} D{d} "
+    kind = jax.devices()[0].device_kind
+    if os.environ.get("DDW_REQUIRE_TPU") and "TPU" not in kind:
+        print(f"DDW_REQUIRE_TPU set but backend is {kind!r} (axon fell back "
+              f"to CPU — tunnel down at connect); refusing to sweep",
+              file=sys.stderr)
+        sys.exit(4)
+    print(f"device: {kind}  shape B{b} H{h} D{d} "
           f"causal fwd+bwd")
 
     for s in (int(x) for x in args.seqs.split(",")):
