@@ -62,7 +62,12 @@ import numpy as np
 # both.
 BASELINE_IPS = 40030.89  # round-2 anchor (corrected timing), TPU v5e-1, 2026-07-29
 
-SMOKE = bool(int(os.environ.get("DDW_BENCH_SMOKE", "0") or "0"))
+def env_flag(name: str) -> bool:
+    """Shared DDW_* boolean env parsing: '', '0' off; '1' on."""
+    return bool(int(os.environ.get(name, "0") or "0"))
+
+
+SMOKE = env_flag("DDW_BENCH_SMOKE")
 REPEATS = 1 if SMOKE else 3
 # Adaptive sizing: grow N until one differential run holds >= this much device
 # work, so fixed dispatch/fetch latency stays inside the noise floor.
@@ -443,7 +448,7 @@ def _device_problem(timeout_s: float = 240.0) -> str | None:
         # A down-at-connect tunnel makes the axon plugin fall back to CPU,
         # which would record CPU timings as chip results. Opt-in guard so CPU
         # smoke runs (DDW_BENCH_SMOKE) keep working.
-        if (os.environ.get("DDW_REQUIRE_TPU")
+        if (env_flag("DDW_REQUIRE_TPU")
                 and "TPU" not in jax.devices()[0].device_kind):
             return (f"DDW_REQUIRE_TPU set but backend is "
                     f"{jax.devices()[0].device_kind!r} (tunnel down at "

@@ -51,20 +51,33 @@ class ConvSpec:
     def out_hw(self) -> int:
         return -(-self.in_hw // self.stride)  # SAME padding
 
-    def flops(self, batch: int) -> float:
-        """fwd MACs*2; bwd ~2x fwd (dx + dw) => 3x fwd total."""
-        fwd = (2 * batch * self.out_hw ** 2 * self.k ** 2
-               * (self.cin // self.groups) * self.cout)
-        return 3.0 * fwd
+    def fwd_flops(self, batch: int) -> float:
+        """Forward MACs * 2."""
+        return (2 * batch * self.out_hw ** 2 * self.k ** 2
+                * (self.cin // self.groups) * self.cout)
 
-    def bytes_moved(self, batch: int) -> float:
-        """Minimal HBM traffic for fwd+bwd in bf16: activations in/out read+
-        written once each direction, kernel read twice + grad written."""
+    def flops(self, batch: int) -> float:
+        """fwd + bwd; bwd ~2x fwd (dx + dw) => 3x fwd total."""
+        return 3.0 * self.fwd_flops(batch)
+
+    def bytes_fwd(self, batch: int) -> float:
+        """Minimal fwd HBM traffic in bf16: read in + w, write out."""
         act_in = batch * self.in_hw ** 2 * self.cin * 2
         act_out = batch * self.out_hw ** 2 * self.cout * 2
         w = self.k ** 2 * (self.cin // self.groups) * self.cout * 2
-        # fwd: read in + w, write out. bwd: read dout + w + in, write din + dw.
-        return 2 * act_in + 2 * act_out + 3 * w + act_in + act_out
+        return act_in + act_out + w
+
+    def bytes_moved(self, batch: int) -> float:
+        """Minimal HBM traffic for fwd+bwd in bf16.
+
+        fwd: read in + w, write out.
+        bwd: read dout + w + saved-in, write din + dw.
+        => act_in 3x (2 reads + din write), act_out 2x (out write + dout
+        read), w 3x (2 reads + dw write)."""
+        act_in = batch * self.in_hw ** 2 * self.cin * 2
+        act_out = batch * self.out_hw ** 2 * self.cout * 2
+        w = self.k ** 2 * (self.cin // self.groups) * self.cout * 2
+        return 3 * act_in + 2 * act_out + 3 * w
 
 
 def mobilenet_v2_convs(img: int, width: float = 1.0) -> list[ConvSpec]:
@@ -273,7 +286,8 @@ def main():
     ap.add_argument("--img", type=int, default=224)
     args = ap.parse_args()
     kind = jax.devices()[0].device_kind
-    if os.environ.get("DDW_REQUIRE_TPU") and "TPU" not in kind:
+    from bench import env_flag
+    if env_flag("DDW_REQUIRE_TPU") and "TPU" not in kind:
         print(f"DDW_REQUIRE_TPU set but backend is {kind!r} (axon fell back "
               f"to CPU — tunnel down at connect); refusing to profile",
               file=sys.stderr)
