@@ -62,10 +62,7 @@ import numpy as np
 # both.
 BASELINE_IPS = 40030.89  # round-2 anchor (corrected timing), TPU v5e-1, 2026-07-29
 
-def env_flag(name: str) -> bool:
-    """Shared DDW_* boolean env parsing: '', '0' off; '1' on."""
-    return bool(int(os.environ.get(name, "0") or "0"))
-
+from ddw_tpu.utils.config import env_flag
 
 SMOKE = env_flag("DDW_BENCH_SMOKE")
 REPEATS = 1 if SMOKE else 3

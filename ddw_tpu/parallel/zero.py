@@ -1,21 +1,31 @@
-"""ZeRO-1 optimizer-state sharding over the data axis (GSPMD formulation).
+"""ZeRO-family sharding over the data axis (GSPMD formulation).
 
 Not in the reference — its optimizer state is fully replicated (SURVEY.md §2d
-"ZeRO/FSDP-style optimizer sharding: NO") — but sharded optimizer state is a
+"ZeRO/FSDP-style optimizer sharding: NO") — but sharded training state is a
 first-class capability of this framework: Adam moments are 2x the param bytes,
 and on a data-parallel mesh each replica only needs 1/N of them.
 
-TPU-idiomatic formulation (the scaling-book recipe): keep params and batch
-replicated-over-``data`` exactly as the plain DP step does, but annotate every
-optimizer-state leaf with a sharding that splits its largest divisible dimension
-over the data axis. XLA's GSPMD partitioner then derives the rest: the gradient
-all-reduce becomes reduce-scatter into the moment shards, each device updates
-only its slice, and the parameter update all-gathers back to replicated — the
-ZeRO-1 communication schedule, emitted by the compiler instead of hand-written.
+TPU-idiomatic formulation (the scaling-book recipe): annotate the state leaves
+with shardings that split their largest divisible dimension over the data
+axis, and let XLA's GSPMD partitioner derive the communication schedule
+instead of hand-writing it:
 
-Leaves with no dimension divisible by the axis size (e.g. 3x3 conv kernels with
-leading dim 3) stay replicated — correctness is unaffected, only their memory
-saving is forfeited. ``zero_fraction_sharded`` reports the coverage.
+- **ZeRO-1** (``make_zero_train_step``): params and batch replicated,
+  optimizer-state leaves sharded. The gradient all-reduce becomes
+  reduce-scatter into the moment shards, each device updates only its slice,
+  and the parameter update all-gathers back to replicated. Because the
+  reduce-scatter happens as gradients feed the sharded moments *inside* the
+  compiled step, full gradients never persist per-device — the formulation
+  also delivers ZeRO-2's gradient-memory behavior for free.
+- **ZeRO-3 / FSDP** (``make_fsdp_train_step``): params AND optimizer state
+  sharded; each device holds 1/N of the model. GSPMD inserts per-layer
+  all-gathers where the forward/backward consume full weights (weights are
+  transient, not resident) and reduce-scatters gradients into the param/
+  moment shards — the FSDP schedule, compiler-emitted.
+
+Leaves with no dimension divisible by the axis size (e.g. 3x3 conv kernels
+with leading dim 3) stay replicated — correctness is unaffected, only their
+memory saving is forfeited. ``zero_fraction_sharded`` reports the coverage.
 """
 
 from __future__ import annotations
@@ -63,12 +73,31 @@ def zero_state_shardings(state: TrainState, mesh: Mesh,
     )
 
 
-def zero_fraction_sharded(state: TrainState, mesh: Mesh,
-                          axis: str = DATA_AXIS) -> float:
-    """Fraction of optimizer-state elements whose leaves actually shard."""
+def fsdp_state_shardings(state: TrainState, mesh: Mesh,
+                         axis: str = DATA_AXIS) -> TrainState:
+    """Shardings for a TrainState under ZeRO-3/FSDP: params and optimizer
+    state sharded over ``axis`` (moments land on the same spec as their param
+    because they share its shape), batch_stats/step replicated (they are tiny
+    and BN stats are all-reduced anyway)."""
+    n = mesh.shape[axis]
+    repl = NamedSharding(mesh, P())
+
+    def spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(mesh, _leaf_spec(tuple(shape), n, axis))
+
+    return TrainState(
+        params=jax.tree.map(spec, state.params),
+        batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+        opt_state=jax.tree.map(spec, state.opt_state),
+        step=repl,
+    )
+
+
+def _fraction_sharded(tree, mesh: Mesh, axis: str) -> float:
     n = mesh.shape[axis]
     total = sharded = 0
-    for leaf in jax.tree.leaves(state.opt_state):
+    for leaf in jax.tree.leaves(tree):
         size = getattr(leaf, "size", 0)
         if not size:
             continue
@@ -76,6 +105,74 @@ def zero_fraction_sharded(state: TrainState, mesh: Mesh,
         if _leaf_spec(tuple(leaf.shape), n, axis) != P():
             sharded += size
     return sharded / total if total else 0.0
+
+
+def zero_fraction_sharded(state: TrainState, mesh: Mesh,
+                          axis: str = DATA_AXIS) -> float:
+    """Fraction of optimizer-state elements whose leaves actually shard."""
+    return _fraction_sharded(state.opt_state, mesh, axis)
+
+
+def fsdp_fraction_sharded(state: TrainState, mesh: Mesh,
+                          axis: str = DATA_AXIS) -> float:
+    """Fraction of parameter elements whose leaves actually shard."""
+    return _fraction_sharded(state.params, mesh, axis)
+
+
+def _make_sharded_state_step(
+    shardings_fn,
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+) -> Callable:
+    """Shared factory behind the ZeRO-1 and FSDP steps: a jit'd DP step whose
+    TrainState in/out shardings come from ``shardings_fn(state, mesh, axis)``;
+    GSPMD derives the collective schedule from those annotations."""
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(axis))
+
+    def _step(state: TrainState, images, labels, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+        loss, acc, new_bs, grads = forward_and_grads(
+            model, state, images, labels, dropout_rng)
+        # No explicit psum: GSPMD derives the collective schedule from the
+        # state shardings. ZeRO-1 (params replicated, moments sharded):
+        # gradients reduce-scatter into the moment shards, the param update
+        # all-gathers back to replicated. FSDP (params sharded too): per-layer
+        # all-gathers where fwd/bwd consume full weights, reduce-scatter of
+        # gradients into the param/moment shards.
+        new_state = apply_gradients(state, tx, grads, new_bs)
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    def place_state(state: TrainState) -> TrainState:
+        sh = shardings_fn(state, mesh, axis)
+        return jax.tree.map(jax.device_put, state, sh)
+
+    # Built per state structure+shapes: the in/out shardings are derived from
+    # the concrete TrainState, so a structurally different state (different
+    # optimizer/model, restored checkpoint with extra leaves) must get its own
+    # jit instead of hitting a stale-sharding pytree mismatch.
+    _jits: dict = {}
+
+    def stepper(state, images, labels, rng):
+        key = (jax.tree.structure(state),
+               tuple(tuple(l.shape) for l in jax.tree.leaves(state)))
+        fn = _jits.get(key)
+        if fn is None:
+            state_sh = shardings_fn(state, mesh, axis)
+            fn = _jits[key] = jax.jit(
+                _step,
+                in_shardings=(state_sh, batch_sh, batch_sh, repl),
+                out_shardings=(state_sh, repl),
+                donate_argnums=(0,) if donate else (),
+            )
+        return fn(state, images, labels, rng)
+
+    stepper.place_state = place_state  # type: ignore[attr-defined]
+    stepper.batch_sharding = batch_sh  # type: ignore[attr-defined]
+    return stepper
 
 
 def make_zero_train_step(
@@ -100,43 +197,26 @@ def make_zero_train_step(
     correct DP training; bit-exact equivalence with ``make_train_step`` holds
     for stateless-norm models at dropout=0 (what the equivalence test pins).
     """
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(axis))
+    return _make_sharded_state_step(zero_state_shardings, model, tx, mesh,
+                                    axis, donate)
 
-    def _step(state: TrainState, images, labels, rng):
-        dropout_rng = jax.random.fold_in(rng, state.step)
-        loss, acc, new_bs, grads = forward_and_grads(
-            model, state, images, labels, dropout_rng)
-        # No explicit psum: the batch is sharded and params are replicated, so
-        # GSPMD inserts the gradient reduction — reduce-scatter into the
-        # sharded moments, all-gather after the update (the ZeRO-1 schedule).
-        new_state = apply_gradients(state, tx, grads, new_bs)
-        return new_state, {"loss": loss, "accuracy": acc}
 
-    def place_state(state: TrainState) -> TrainState:
-        sh = zero_state_shardings(state, mesh, axis)
-        return jax.tree.map(jax.device_put, state, sh)
+def make_fsdp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+) -> Callable:
+    """DP train step with ZeRO-3/FSDP fully-sharded params + optimizer state.
 
-    # Built per state structure+shapes: the in/out shardings are derived from
-    # the concrete TrainState, so a structurally different state (different
-    # optimizer/model, restored checkpoint with extra leaves) must get its own
-    # jit instead of hitting a stale-sharding pytree mismatch.
-    _jits: dict = {}
-
-    def stepper(state, images, labels, rng):
-        key = (jax.tree.structure(state),
-               tuple(tuple(l.shape) for l in jax.tree.leaves(state)))
-        fn = _jits.get(key)
-        if fn is None:
-            state_sh = zero_state_shardings(state, mesh, axis)
-            fn = _jits[key] = jax.jit(
-                _step,
-                in_shardings=(state_sh, batch_sh, batch_sh, repl),
-                out_shardings=(state_sh, repl),
-                donate_argnums=(0,) if donate else (),
-            )
-        return fn(state, images, labels, rng)
-
-    stepper.place_state = place_state  # type: ignore[attr-defined]
-    stepper.batch_sharding = batch_sh  # type: ignore[attr-defined]
-    return stepper
+    Same call contract and sync-BN/dropout semantics as
+    :func:`make_zero_train_step`; additionally every divisible parameter leaf
+    lives sharded over ``axis``, so per-device residency is ~1/N of the model
+    plus transient all-gathered weights during the step (GSPMD inserts the
+    per-layer all-gather/reduce-scatter pairs). Numerically identical to the
+    ZeRO-1 and plain-DP steps for stateless-norm models at dropout=0 (pinned
+    by the equivalence tests) — sharding placement does not change the math.
+    """
+    return _make_sharded_state_step(fsdp_state_shardings, model, tx, mesh,
+                                    axis, donate)

@@ -62,19 +62,23 @@ class _ZeroCheckpointAdapter:
     is collective (every process writes its shards), matching how the trainer
     already calls it on every rank."""
 
-    def __init__(self, ckpt_dir: str, mesh, axis: str):
+    def __init__(self, ckpt_dir: str, mesh, axis: str, fsdp: bool = False):
         from ddw_tpu.checkpoint.sharded import ShardedCheckpointManager
 
         self._mgr = ShardedCheckpointManager(ckpt_dir)
-        self._mesh, self._axis = mesh, axis
+        self._mesh, self._axis, self._fsdp = mesh, axis, fsdp
 
     def save(self, state, step: int, metadata: dict | None = None):
         return self._mgr.save(state, step, metadata)
 
     def restore(self, target, step: int | None = None):
-        from ddw_tpu.parallel.zero import zero_state_shardings
+        from ddw_tpu.parallel.zero import (
+            fsdp_state_shardings,
+            zero_state_shardings,
+        )
 
-        sh = zero_state_shardings(target, self._mesh, self._axis)
+        fn = fsdp_state_shardings if self._fsdp else zero_state_shardings
+        sh = fn(target, self._mesh, self._axis)
         return self._mgr.restore(target, sh, step)
 
     def read_metadata(self, step: int | None = None):
@@ -200,23 +204,34 @@ class Trainer:
                 (self.data_cfg.img_height, self.data_cfg.img_width, self.data_cfg.channels),
                 rng,
             )
-        if cfg.zero:
+        sharded_state = cfg.zero or cfg.fsdp
+        if sharded_state:
+            flag = "train.fsdp" if cfg.fsdp else "train.zero"
+            if cfg.zero and cfg.fsdp:
+                raise ValueError("train.zero and train.fsdp are mutually "
+                                 "exclusive (fsdp already shards the "
+                                 "optimizer state) — pick one")
             if cfg.grad_accum_steps > 1:
-                raise ValueError("train.zero with grad_accum_steps>1 is not "
+                raise ValueError(f"{flag} with grad_accum_steps>1 is not "
                                  "supported yet — pick one")
             if cfg.ema_decay:
-                raise ValueError("train.zero with ema_decay is not supported "
+                raise ValueError(f"{flag} with ema_decay is not supported "
                                  "yet — the Polyak shadow would need its own "
                                  "sharding rules; pick one")
             if cfg.async_checkpoint:
                 raise ValueError(
-                    "train.zero with async_checkpoint=true is not supported: "
+                    f"{flag} with async_checkpoint=true is not supported: "
                     "sharded saves are collective and synchronous (every "
                     "process writes its shards) — drop one of the flags")
-            from ddw_tpu.parallel.zero import make_zero_train_step
+            from ddw_tpu.parallel.zero import (
+                make_fsdp_train_step,
+                make_zero_train_step,
+            )
 
-            train_step = make_zero_train_step(self.model, tx, self.mesh,
-                                              cfg.data_axis)
+            make_sharded = (make_fsdp_train_step if cfg.fsdp
+                            else make_zero_train_step)
+            train_step = make_sharded(self.model, tx, self.mesh,
+                                      cfg.data_axis)
         else:
             train_step = make_train_step(self.model, tx, self.mesh, cfg.data_axis,
                                          grad_accum_steps=cfg.grad_accum_steps)
@@ -224,11 +239,11 @@ class Trainer:
 
         if not cfg.checkpoint_dir:
             ckpt = None
-        elif cfg.zero:
+        elif sharded_state:
             # sharded per-process format: saving must NOT all-gather the
-            # ZeRO-sharded moments into one host (checkpoint/sharded.py)
+            # ZeRO/FSDP-sharded leaves into one host (checkpoint/sharded.py)
             ckpt = _ZeroCheckpointAdapter(cfg.checkpoint_dir, self.mesh,
-                                          cfg.data_axis)
+                                          cfg.data_axis, fsdp=cfg.fsdp)
         else:
             ckpt = CheckpointManager(cfg.checkpoint_dir,
                                      async_write=cfg.async_checkpoint)
@@ -241,8 +256,8 @@ class Trainer:
             if at_step is not None:
                 start_epoch = int(at_step) // steps_per_epoch
                 restored_meta = ckpt.read_metadata(at_step)
-        if cfg.zero:
-            # moments onto their data-axis shards (no-op on a restored
+        if sharded_state:
+            # leaves onto their data-axis shards (no-op on a restored
             # already-sharded state)
             state = train_step.place_state(state)
 
@@ -342,10 +357,11 @@ class Trainer:
 
                     vlosses, vaccs = [], []
                     viter = iter(val_loader_factory())
-                    # ZeRO: eval reads only params/batch_stats — pass the state
-                    # without the sharded moments or the eval jit would
-                    # all-gather them to match its replicated in_spec
-                    eval_state = (state.replace(opt_state=()) if cfg.zero
+                    # ZeRO/FSDP: eval reads only params/batch_stats — pass the
+                    # state without the sharded moments or the eval jit would
+                    # all-gather them to match its replicated in_spec (FSDP
+                    # params do get gathered — eval wants full weights)
+                    eval_state = (state.replace(opt_state=()) if sharded_state
                                   else state)
                     if cfg.ema_decay:
                         # evaluate the Polyak shadow (what serving should ship)

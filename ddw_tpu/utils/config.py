@@ -141,6 +141,13 @@ class TrainCfg:
                                         # Incompatible with grad_accum_steps>1
                                         # and async_checkpoint (saves are
                                         # collective+synchronous) — both raise.
+    fsdp: bool = False                  # ZeRO-3/FSDP: shard params AND
+                                        # optimizer state over the data axis
+                                        # (~1/N model residency per device;
+                                        # GSPMD inserts per-layer all-gathers).
+                                        # Same checkpoint format and flag
+                                        # incompatibilities as zero; zero and
+                                        # fsdp are mutually exclusive.
     checkpoint_dir: str = ""            # "" = no per-epoch checkpoints
     async_checkpoint: bool = False      # serialize+write checkpoints on a
                                         # background thread (device snapshot is
@@ -221,6 +228,19 @@ class TuneCfg:
 
 _TYPES = {"data": DataCfg, "model": ModelCfg, "train": TrainCfg, "tune": TuneCfg,
           "lm": LMCfg}
+
+
+def env_flag(name: str) -> bool:
+    """Boolean environment flag shared by bench.py and the perf tools.
+
+    Tolerant parsing, fail-safe for guards: '', '0', 'false', 'no', 'off'
+    (case-insensitive) are off; ANY other value (including '1', 'true',
+    'yes') is on — so a typo'd value enables a safety guard rather than
+    silently disabling it or crashing."""
+    import os
+
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "no", "off")
 
 
 def apply_overrides(cfgs: dict[str, Any], overrides: list[str]) -> dict[str, Any]:

@@ -286,7 +286,7 @@ def main():
     ap.add_argument("--img", type=int, default=224)
     args = ap.parse_args()
     kind = jax.devices()[0].device_kind
-    from bench import env_flag
+    from ddw_tpu.utils.config import env_flag
     if env_flag("DDW_REQUIRE_TPU") and "TPU" not in kind:
         print(f"DDW_REQUIRE_TPU set but backend is {kind!r} (axon fell back "
               f"to CPU — tunnel down at connect); refusing to profile",

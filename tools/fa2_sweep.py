@@ -96,7 +96,7 @@ def main():
     b, h, d = args.batch, args.heads, args.dim
     blocks = [int(x) for x in args.blocks.split(",")]
     kind = jax.devices()[0].device_kind
-    from bench import env_flag
+    from ddw_tpu.utils.config import env_flag
     if env_flag("DDW_REQUIRE_TPU") and "TPU" not in kind:
         print(f"DDW_REQUIRE_TPU set but backend is {kind!r} (axon fell back "
               f"to CPU — tunnel down at connect); refusing to sweep",
