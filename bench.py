@@ -127,6 +127,67 @@ def _beat(note: str = "") -> None:
         print(f"[bench] {note}", file=sys.stderr, flush=True)
 
 
+CHAIN = os.environ.get("DDW_BENCH_CHAIN", "loop")
+if CHAIN not in ("loop", "scan"):
+    raise ValueError(f"DDW_BENCH_CHAIN must be 'loop' or 'scan', got {CHAIN!r}")
+SCAN_CHUNK = 2 if SMOKE else 8
+
+
+def _chained_runner(step, compiled, state, args):
+    """Build ``run_n`` for :func:`_time_steps` over a train step.
+
+    ``DDW_BENCH_CHAIN=loop`` (default) dispatches every step from the host —
+    steps pipeline asynchronously, so on a healthy backend the device never
+    starves. ``=scan`` compiles a ``lax.scan`` over ``SCAN_CHUNK`` steps so
+    ONE dispatch covers CHUNK steps of device work: on a degraded tunnel
+    whose dispatch rate drops below the device's step rate, short-step rows
+    (frozen MobileNetV2 ~6 ms, feature-cache ~2 ms) become dispatch-bound
+    under 'loop' while 'scan' still measures true device throughput —
+    running both disambiguates device regression from transport regression
+    (window-1 2026-07-31 frozen row: 9.6 ms/step on identical FLOPs).
+
+    ``step`` must be the traceable (jitted) step — the AOT ``compiled`` one
+    cannot be called under tracing and serves the 'loop' arm + FLOP count.
+    """
+    holder = {"state": state}
+    if CHAIN == "loop":
+        def run_n(n):
+            st = holder["state"]
+            t0 = time.perf_counter()
+            for _ in range(n):
+                st, m = compiled(st, *args)
+            np.asarray(m["loss"])  # forced D2H: true completion barrier
+            holder["state"] = st
+            return time.perf_counter() - t0
+
+        return run_n
+
+    def mega(st, *a):
+        def body(c, _):
+            c2, m = step(c, *a)
+            return c2, m["loss"]
+
+        st2, losses = jax.lax.scan(body, st, None, length=SCAN_CHUNK)
+        return st2, losses[-1]
+
+    mega_c = jax.jit(mega, donate_argnums=(0,))
+    st, last = mega_c(holder["state"], *args)  # warmup/compile
+    np.asarray(last)
+    holder["state"] = st
+
+    def run_n(n):
+        assert n % SCAN_CHUNK == 0, (n, SCAN_CHUNK)
+        st = holder["state"]
+        t0 = time.perf_counter()
+        for _ in range(n // SCAN_CHUNK):
+            st, last = mega_c(st, *args)
+        np.asarray(last)  # forced D2H: true completion barrier
+        holder["state"] = st
+        return time.perf_counter() - t0
+
+    return run_n
+
+
 def _time_steps(run_n) -> tuple[float, int]:
     """True seconds-per-``N``-steps of device work, via differential timing.
 
@@ -169,6 +230,8 @@ def _row(items_per_step: int, n_chips: int, dt: float, measure_steps: int,
         out["achieved_tflops_per_chip"] = round(tf, 6)
         if peak:
             out["mfu"] = round(tf / peak, 6)
+    if CHAIN != "loop":
+        out["chain"] = CHAIN  # scan-chained timing (see _chained_runner)
     return out
 
 
@@ -226,13 +289,7 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
     state, metrics = compiled(state, images, labels, key)  # warmup
     np.asarray(metrics["loss"])
 
-    def run_n(n):
-        nonlocal state
-        t0 = time.perf_counter()
-        for _ in range(n):
-            state, m = compiled(state, images, labels, key)
-        np.asarray(m["loss"])  # forced D2H: true completion barrier
-        return time.perf_counter() - t0
+    run_n = _chained_runner(step, compiled, state, (images, labels, key))
 
     dt, measured_steps = _time_steps(run_n)
     row = _row(global_batch, n_chips, dt, measured_steps, flops, peak,
@@ -283,13 +340,7 @@ def bench_head_features(*, batch: int, feature_dim: int,
     state, metrics = compiled(state, feats, labels, key)
     np.asarray(metrics["loss"])
 
-    def run_n(n):
-        nonlocal state
-        t0 = time.perf_counter()
-        for _ in range(n):
-            state, m = compiled(state, feats, labels, key)
-        np.asarray(m["loss"])  # forced D2H: true completion barrier
-        return time.perf_counter() - t0
+    run_n = _chained_runner(step, compiled, state, (feats, labels, key))
 
     dt, measured_steps = _time_steps(run_n)
     row = _row(global_batch, n_chips, dt, measured_steps, flops, peak,
@@ -311,10 +362,13 @@ def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
     n_chips = len(devices)
     mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)), devices=devices)
 
+    # A/B knob: DDW_BENCH_LM_REMAT=full|dots measures the remat FLOP/HBM
+    # trade on the chip (default none — the headline row).
     model = TransformerLM(vocab_size=vocab, max_len=seq, hidden=hidden,
                           depth=depth, num_heads=heads, mlp_dim=hidden * 4,
                           dropout=0.0, dtype=jnp.bfloat16, seq_axis=None,
-                          num_experts=num_experts)
+                          num_experts=num_experts,
+                          remat=os.environ.get("DDW_BENCH_LM_REMAT", "none"))
     tx = optax.adam(3e-4)
     state = init_lm_state(model, tx, jax.random.PRNGKey(0), seq_len=8)
     step = make_lm_train_step(model, tx, mesh, DATA_AXIS, seq_axis=None,
@@ -333,13 +387,7 @@ def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
     state, metrics = compiled(state, inputs, targets, key)
     np.asarray(metrics["loss"])
 
-    def run_n(n):
-        nonlocal state
-        t0 = time.perf_counter()
-        for _ in range(n):
-            state, m = compiled(state, inputs, targets, key)
-        np.asarray(m["loss"])  # forced D2H: true completion barrier
-        return time.perf_counter() - t0
+    run_n = _chained_runner(step, compiled, state, (inputs, targets, key))
 
     dt, measured_steps = _time_steps(run_n)
     row = _row(global_batch * seq, n_chips, dt, measured_steps, flops, peak,

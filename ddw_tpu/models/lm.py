@@ -255,6 +255,11 @@ class TransformerLM(nn.Module):
     pos_encoding: str = "learned"  # "learned" absolute table (bounded by
                                    # max_len) or "rope" rotary relative
                                    # positions (ddw_tpu.ops.rope)
+    remat: str = "none"      # activation rematerialization per block:
+                             # "none" | "full" (nothing saved — recompute the
+                             # block in backward) | "dots" (save matmul
+                             # outputs, recompute elementwise). Ignored in
+                             # decode mode (no backward there).
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -307,20 +312,34 @@ class TransformerLM(nn.Module):
             # = shard_index * s_local, K rotated before the ring) and decode
             # (offset = tokens already written to the cache).
             positions = offset + jnp.arange(s_local)
+        if self.remat not in ("none", "full", "dots"):
+            raise ValueError(f"unknown remat {self.remat!r}; use 'none', "
+                             f"'full' or 'dots'")
+        if self.remat != "none" and not self.decode:
+            # Rematerialized blocks: backward recomputes the block forward
+            # instead of keeping its activations resident — O(depth) fewer
+            # live activations for ~1/3 more FLOPs ('full' keeps nothing;
+            # 'dots' keeps matmul outputs, recomputing only elementwise ops).
+            # The decode path never differentiates, so it stays un-wrapped.
+            policy = (jax.checkpoint_policies.nothing_saveable
+                      if self.remat == "full"
+                      else jax.checkpoint_policies.checkpoint_dots)
+            Block = nn.remat(DecoderBlock, static_argnums=(2,), policy=policy)
+        else:
+            Block = DecoderBlock
         for i in range(self.depth):
-            x = DecoderBlock(self.num_heads, self.mlp_dim, self.dropout,
-                             self.dtype, None if self.decode else self.seq_axis,
-                             self.decode, self.max_len,
-                             num_experts=self.num_experts,
-                             expert_axis=None if self.decode else self.expert_axis,
-                             capacity_factor=self.capacity_factor,
-                             moe_router=self.moe_router,
-                             num_kv_heads=self.num_kv_heads,
-                             lora_rank=self.lora_rank,
-                             lora_alpha=self.lora_alpha,
-                             lora_targets=self.lora_targets,
-                             name=f"backbone_block{i}")(x, train,
-                                                        positions=positions)
+            x = Block(self.num_heads, self.mlp_dim, self.dropout,
+                      self.dtype, None if self.decode else self.seq_axis,
+                      self.decode, self.max_len,
+                      num_experts=self.num_experts,
+                      expert_axis=None if self.decode else self.expert_axis,
+                      capacity_factor=self.capacity_factor,
+                      moe_router=self.moe_router,
+                      num_kv_heads=self.num_kv_heads,
+                      lora_rank=self.lora_rank,
+                      lora_alpha=self.lora_alpha,
+                      lora_targets=self.lora_targets,
+                      name=f"backbone_block{i}")(x, train, positions)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # vocab head in f32: logits feed a softmax CE, keep full precision
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)
@@ -344,7 +363,8 @@ def build_lm(cfg, seq_axis: str | None = None,
         lora_rank=getattr(cfg, "lora_rank", 0),
         lora_alpha=getattr(cfg, "lora_alpha", 16.0),
         lora_targets=tuple(getattr(cfg, "lora_targets", ("query", "value"))),
-        pos_encoding=getattr(cfg, "pos_encoding", "learned"))
+        pos_encoding=getattr(cfg, "pos_encoding", "learned"),
+        remat=getattr(cfg, "remat", "none"))
 
 
 def init_cache(decode_model: TransformerLM, batch: int):

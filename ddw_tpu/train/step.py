@@ -56,20 +56,35 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 
 
 def _base_optimizer(name: str, learning_rate,
-                    weight_decay: float = 0.0) -> optax.GradientTransformation:
+                    weight_decay: float = 0.0,
+                    moment_dtype: str = "float32") -> optax.GradientTransformation:
     if weight_decay and name != "adamw":
         # refuse-loudly: silently training without the requested
         # regularization is only discoverable by comparing results
         raise ValueError(f"weight_decay is only implemented for "
                          f"optimizer='adamw', got {name!r}")
+    if moment_dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"unknown moment_dtype {moment_dtype!r}; "
+                         f"use 'float32' or 'bfloat16'")
+    # bf16 first moments halve Adam's mu bytes (mu tracks the gradient scale,
+    # where bf16's 8 mantissa bits suffice; nu feeds a rsqrt and stays f32 —
+    # optax's mu_dtype draws exactly this line). sgd momentum is a mu too.
+    mu = None if moment_dtype == "float32" else jnp.bfloat16
     if name == "adam":
-        return optax.adam(learning_rate)
+        return optax.adam(learning_rate, mu_dtype=mu)
     if name == "adamw":
-        return optax.adamw(learning_rate, weight_decay=weight_decay)
+        return optax.adamw(learning_rate, weight_decay=weight_decay,
+                           mu_dtype=mu)
     if name == "adadelta":
+        if mu is not None:
+            raise ValueError("moment_dtype='bfloat16' is not supported for "
+                             "adadelta (its accumulators feed rsqrt like "
+                             "Adam's nu) — use adam/adamw/sgd or drop the "
+                             "flag")
         return optax.adadelta(learning_rate)
     if name == "sgd":
-        return optax.sgd(learning_rate, momentum=0.9)
+        return optax.sgd(learning_rate, momentum=0.9,
+                         accumulator_dtype=mu)
     raise KeyError(f"unknown optimizer {name!r} "
                    f"(have adam, adamw, adadelta, sgd)")
 
@@ -84,10 +99,16 @@ def make_optimizer(
     for the callback suite. ``frozen_prefixes`` are top-level param-tree keys
     excluded from updates (transfer-learning mode).
     """
+    # Validate eagerly: inject_hyperparams defers the inner factory to
+    # tx.init, which would move these refusals from config time to the first
+    # step — after the user already believes the run is configured.
+    _base_optimizer(cfg.optimizer, 0.0, getattr(cfg, "weight_decay", 0.0),
+                    getattr(cfg, "moment_dtype", "float32"))
     @functools.partial(optax.inject_hyperparams, static_args=())
     def _make(learning_rate):
         base = _base_optimizer(cfg.optimizer, learning_rate,
-                               getattr(cfg, "weight_decay", 0.0))
+                               getattr(cfg, "weight_decay", 0.0),
+                               getattr(cfg, "moment_dtype", "float32"))
         clip = getattr(cfg, "grad_clip_norm", 0.0)
         if clip:
             # clip BEFORE the optimizer (standard order): the global norm is

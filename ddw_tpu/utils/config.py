@@ -132,6 +132,11 @@ class TrainCfg:
                                         # step (lax.scan), accumulating gradients —
                                         # same optimizer math, 1/N activation
                                         # memory; batches far beyond HBM fit.
+    moment_dtype: str = "float32"       # "bfloat16": store Adam/SGD first
+                                        # moments (mu) in bf16 — halves mu
+                                        # bytes; nu stays f32 (feeds rsqrt).
+                                        # adadelta refuses (both its
+                                        # accumulators are nu-like)
     data_axis: str = "data"             # mesh axis name for DP psum
     num_devices: int = 0                # 0 = all visible devices
     zero: bool = False                  # ZeRO-1: shard optimizer moments over
@@ -198,6 +203,11 @@ class LMCfg:
                                         # rotary relative positions
                                         # (ddw_tpu.ops.rope — extrapolates
                                         # past max_len, SP/decode-composable)
+    remat: str = "none"                 # per-block activation remat: "full"
+                                        # (keep nothing; recompute block in
+                                        # bwd) or "dots" (keep matmul outputs)
+                                        # — long contexts past HBM at ~1/3
+                                        # more FLOPs; decode unaffected
 
 
 @dataclass
@@ -233,14 +243,19 @@ _TYPES = {"data": DataCfg, "model": ModelCfg, "train": TrainCfg, "tune": TuneCfg
 def env_flag(name: str) -> bool:
     """Boolean environment flag shared by bench.py and the perf tools.
 
-    Tolerant parsing, fail-safe for guards: '', '0', 'false', 'no', 'off'
-    (case-insensitive) are off; ANY other value (including '1', 'true',
-    'yes') is on — so a typo'd value enables a safety guard rather than
-    silently disabling it or crashing."""
+    Accepts the common spellings both ways; anything else raises — a typo
+    must not silently flip a flag in either direction (enabling
+    DDW_BENCH_SMOKE degrades measurements; disabling DDW_REQUIRE_TPU records
+    CPU timings as chip results)."""
     import os
 
-    return os.environ.get(name, "").strip().lower() not in (
-        "", "0", "false", "no", "off")
+    val = os.environ.get(name, "").strip().lower()
+    if val in ("", "0", "false", "no", "off"):
+        return False
+    if val in ("1", "true", "yes", "on"):
+        return True
+    raise ValueError(f"{name} must be a boolean flag "
+                     f"(1/true/yes/on or 0/false/no/off), got {val!r}")
 
 
 def apply_overrides(cfgs: dict[str, Any], overrides: list[str]) -> dict[str, Any]:

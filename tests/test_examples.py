@@ -40,6 +40,7 @@ _EXAMPLES = [
     ("07_lm_long_context.py",
      ["--steps", "3", "lm.pos_encoding=rope", "lm.num_kv_heads=2"], "final:"),
     ("09_lora_finetune.py", [], "base_frozen=True"),
+    ("10_fsdp_elastic.py", ["train.epochs=2"], "elastic 8 -> 4"),
 ]
 
 

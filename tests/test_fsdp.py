@@ -147,3 +147,40 @@ def test_trainer_fsdp_fit_and_sharded_resume(tmp_path, silver):
     assert res2.epochs_run == 4
     assert int(jax.device_get(res2.state.step)) == 2 * int(
         jax.device_get(res.state.step))
+
+
+def test_trainer_fsdp_elastic_resume_8_to_4(tmp_path, silver):
+    """Elasticity: a fit checkpointed on an 8-device mesh resumes on a
+    4-device mesh — the sharded restore assembles each new shard from the
+    overlapping saved shards, no full gather, and training continues."""
+    import os
+
+    from ddw_tpu.train.trainer import Trainer
+    from ddw_tpu.utils.config import DataCfg
+
+    train_tbl, val_tbl, _ = silver
+    data = DataCfg(img_height=24, img_width=24)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                     dtype="float32")
+    ckpt_dir = str(tmp_path / "eck")
+
+    def cfg(epochs, n_dev):
+        return TrainCfg(batch_size=4, epochs=epochs, warmup_epochs=0,
+                        learning_rate=1e-2, seed=0, fsdp=True,
+                        num_devices=n_dev,
+                        checkpoint_dir=ckpt_dir, checkpoint_every_epochs=1)
+
+    res8 = Trainer(data, model, cfg(2, 8)).fit(train_tbl, val_tbl)
+    assert res8.epochs_run == 2
+
+    res4 = Trainer(data, model, cfg(4, 4)).fit(train_tbl, val_tbl,
+                                               resume=True)
+    assert res4.epochs_run == 4 and np.isfinite(res4.val_loss)
+    # params live sharded over the NEW 4-device mesh
+    sharded = [l for l in jax.tree.leaves(res4.state.params)
+               if any(ax for ax in l.sharding.spec)]
+    assert sharded
+    for leaf in sharded:
+        assert len({s.device for s in leaf.addressable_shards}) == 4
+        assert max(s.data.size for s in leaf.addressable_shards) \
+            == leaf.size // 4

@@ -1,0 +1,120 @@
+"""Per-block activation remat for the LM: identical math, recomputed backward.
+
+``lm.remat`` is the long-context memory lever (SURVEY §5 long-context role —
+trade FLOPs for HBM via rematerialization): 'full' keeps nothing per block,
+'dots' keeps matmul outputs. Both must be numerically identical to 'none' —
+remat changes the schedule, never the function.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddw_tpu.models.lm import TransformerLM
+from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS, SEQ_AXIS
+from ddw_tpu.train.lm_step import init_lm_state, make_lm_train_step
+
+VOCAB = 32
+
+
+def _lm(remat, seq_axis=None, num_experts=0, decode=False):
+    return TransformerLM(vocab_size=VOCAB, max_len=64, hidden=32, depth=2,
+                         num_heads=2, mlp_dim=64, dropout=0.0,
+                         dtype=jnp.float32, seq_axis=seq_axis,
+                         num_experts=num_experts,
+                         expert_axis=None, remat=remat, decode=decode)
+
+
+def _grads(model, tokens, targets):
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        tokens)["params"]
+
+    def loss(p):
+        logits = model.apply({"params": p}, tokens, train=True)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], -1))
+
+    return jax.value_and_grad(loss)(params)
+
+
+@pytest.mark.parametrize("mode", ["full", "dots"])
+def test_remat_grads_match_none(mode):
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, VOCAB, size=(2, 17)).astype(np.int32)
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    l0, g0 = _grads(_lm("none"), inp, tgt)
+    l1, g1 = _grads(_lm(mode), inp, tgt)
+    assert float(l0) == pytest.approx(float(l1), abs=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_remat_invalid_mode_raises():
+    with pytest.raises(ValueError, match="unknown remat"):
+        _lm("everything").init({"params": jax.random.PRNGKey(0)},
+                               np.zeros((1, 4), np.int32))
+
+
+def test_remat_composes_with_sp_train_step():
+    """Full remat under the DPxSP shard_map step: one step == the no-remat
+    step (the ring hops recompute cleanly inside the checkpointed block)."""
+    n = 4
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, 1), (SEQ_AXIS, n))),
+                     devices=jax.devices()[:n])
+    tx = optax.adam(1e-2)
+    rng = np.random.RandomState(1)
+    tokens = rng.randint(0, VOCAB, size=(2, 33)).astype(np.int32)
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+
+    outs = {}
+    for mode in ("none", "full"):
+        model = _lm(mode, seq_axis=SEQ_AXIS)
+        state = init_lm_state(model, tx, jax.random.PRNGKey(0), seq_len=8)
+        step = make_lm_train_step(model, tx, mesh, DATA_AXIS,
+                                  seq_axis=SEQ_AXIS, donate=False)
+        new_state, metrics = step(state, inp, tgt, jax.random.PRNGKey(2))
+        outs[mode] = (float(metrics["loss"]), new_state.params)
+    assert outs["none"][0] == pytest.approx(outs["full"][0], abs=1e-5)
+    for a, b in zip(jax.tree.leaves(outs["none"][1]),
+                    jax.tree.leaves(outs["full"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_remat_moe_telemetry_still_sown():
+    """The MoE aux loss and routing telemetry are sown inside the block;
+    remat must not drop them (flax threads sown collections through the
+    checkpointed call)."""
+    model = _lm("full", num_experts=4)
+    tokens = np.zeros((2, 8), np.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, tokens)
+    out, mut = model.apply(variables, tokens, train=True,
+                           mutable=["intermediates"],
+                           rngs={"dropout": jax.random.PRNGKey(1)})
+    leaves = jax.tree.leaves(mut.get("intermediates", {}))
+    assert leaves, "no sown intermediates under remat"
+
+
+def test_decode_ignores_remat():
+    """decode=True never wraps blocks (no backward in decode); generation
+    from a remat-trained model is exercised via shared params."""
+    model = _lm("full")
+    tokens = (np.arange(8, dtype=np.int32) % VOCAB).reshape(1, 8)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 4), np.int32))["params"]
+    dec = _lm("full", decode=True)
+    full_logits = model.apply({"params": params}, tokens)
+    from ddw_tpu.models.lm import init_cache
+
+    cache = init_cache(dec, 1)
+    logits = None
+    for t in range(8):
+        logits, mut = dec.apply({"params": params, "cache": cache},
+                                tokens[:, t:t + 1], mutable=["cache"])
+        cache = mut["cache"]
+    np.testing.assert_allclose(np.asarray(logits[0, 0]),
+                               np.asarray(full_logits[0, -1]),
+                               rtol=1e-5, atol=1e-5)

@@ -75,7 +75,7 @@ run_item() {
 log "runner started pid=$$"
 while :; do
   all_done=1
-  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat conv_profile_mn conv_profile_rn ab_conv fa2_sweep; do
+  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan conv_profile_mn conv_profile_rn ab_conv fa2_sweep; do
     [ -f "$LOGDIR/$name.done" ] || { [ -f "$LOGDIR/$name.attempts" ] && [ "$(cat "$LOGDIR/$name.attempts")" -ge "$MAX_ATTEMPTS" ]; } || all_done=0
   done
   if [ "$all_done" -eq 1 ]; then
@@ -91,6 +91,10 @@ while :; do
     run_item lm_flash        "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=lm_flash python -u bench.py" || continue
     run_item lm_moe          "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=lm_moe python -u bench.py" || continue
     run_item mn_frozen_repeat "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_frozen_feature_cache python -u bench.py" || continue
+    # Same two rows, scan-chained (one dispatch per 8 steps): if this row is
+    # fast while the loop row is slow, the window-1 frozen regression was the
+    # tunnel's dispatch rate, not the device.
+    run_item mn_frozen_scan  "DDW_BENCH_STALL_S=900 DDW_BENCH_CHAIN=scan DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_frozen_feature_cache python -u bench.py" || continue
     run_item conv_profile_mn "python -u tools/conv_profile.py mobilenet_v2" || continue
     ITEM_TIMEOUT=5400 run_item conv_profile_rn "python -u tools/conv_profile.py resnet50" || continue
     run_item ab_conv         "DDW_BENCH_STALL_S=900 DDW_BENCH_S2D=1 DDW_BENCH_DW=pallas DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_unfrozen,resnet50 python -u bench.py" || continue
