@@ -119,6 +119,26 @@ def fsdp_fraction_sharded(state: TrainState, mesh: Mesh,
     return _fraction_sharded(state.params, mesh, axis)
 
 
+def _global_microbatches(x, accum: int, mesh: Mesh, axis: str):
+    """Split a globally-sharded batch into ``accum`` interleaved microbatches
+    ``[accum, B/accum, ...]``.
+
+    Interleaved (row i goes to microbatch ``i % accum``), not contiguous:
+    the batch dim is block-sharded over ``axis``, so interleaving keeps every
+    device contributing ``B/(accum*n)`` of each microbatch — the sharding
+    constraint below is then a device-local transpose, no cross-device
+    data movement. Any equal-size partition gives identical optimizer math
+    (mean of microbatch means == full-batch mean)."""
+    b = x.shape[0]
+    if b % accum:
+        raise ValueError(f"global batch {b} not divisible by "
+                         f"grad_accum_steps {accum}")
+    mb = b // accum
+    x = jnp.moveaxis(x.reshape(mb, accum, *x.shape[1:]), 1, 0)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(None, axis)))
+
+
 def _make_sharded_state_step(
     shardings_fn,
     model,
@@ -126,17 +146,30 @@ def _make_sharded_state_step(
     mesh: Mesh,
     axis: str = DATA_AXIS,
     donate: bool = True,
+    grad_accum_steps: int = 1,
 ) -> Callable:
     """Shared factory behind the ZeRO-1 and FSDP steps: a jit'd DP step whose
     TrainState in/out shardings come from ``shardings_fn(state, mesh, axis)``;
-    GSPMD derives the collective schedule from those annotations."""
+    GSPMD derives the collective schedule from those annotations.
+    ``grad_accum_steps > 1`` scans interleaved global microbatches
+    (:func:`_global_microbatches`) — 1/accum the activation memory, the same
+    optimizer math, and each microbatch's gradients reduce-scatter straight
+    into the sharded accumulator."""
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(axis))
 
     def _step(state: TrainState, images, labels, rng):
         dropout_rng = jax.random.fold_in(rng, state.step)
-        loss, acc, new_bs, grads = forward_and_grads(
-            model, state, images, labels, dropout_rng)
+        if grad_accum_steps > 1:
+            from ddw_tpu.train.step import scan_microbatches
+
+            im = _global_microbatches(images, grad_accum_steps, mesh, axis)
+            lb = _global_microbatches(labels, grad_accum_steps, mesh, axis)
+            loss, acc, new_bs, grads = scan_microbatches(
+                model, state, im, lb, dropout_rng)
+        else:
+            loss, acc, new_bs, grads = forward_and_grads(
+                model, state, images, labels, dropout_rng)
         # No explicit psum: GSPMD derives the collective schedule from the
         # state shardings. ZeRO-1 (params replicated, moments sharded):
         # gradients reduce-scatter into the moment shards, the param update
@@ -181,6 +214,7 @@ def make_zero_train_step(
     mesh: Mesh,
     axis: str = DATA_AXIS,
     donate: bool = True,
+    grad_accum_steps: int = 1,
 ) -> Callable:
     """DP train step with ZeRO-1 sharded optimizer state.
 
@@ -198,7 +232,7 @@ def make_zero_train_step(
     for stateless-norm models at dropout=0 (what the equivalence test pins).
     """
     return _make_sharded_state_step(zero_state_shardings, model, tx, mesh,
-                                    axis, donate)
+                                    axis, donate, grad_accum_steps)
 
 
 def make_fsdp_train_step(
@@ -207,6 +241,7 @@ def make_fsdp_train_step(
     mesh: Mesh,
     axis: str = DATA_AXIS,
     donate: bool = True,
+    grad_accum_steps: int = 1,
 ) -> Callable:
     """DP train step with ZeRO-3/FSDP fully-sharded params + optimizer state.
 
@@ -219,4 +254,4 @@ def make_fsdp_train_step(
     by the equivalence tests) — sharding placement does not change the math.
     """
     return _make_sharded_state_step(fsdp_state_shardings, model, tx, mesh,
-                                    axis, donate)
+                                    axis, donate, grad_accum_steps)

@@ -305,6 +305,15 @@ def accumulate_grads(model, state: TrainState, images, labels, base_rng,
     mb = b // accum
     im = images.reshape(accum, mb, *images.shape[1:])
     lb = labels.reshape(accum, mb, *labels.shape[1:])
+    return scan_microbatches(model, state, im, lb, base_rng)
+
+
+def scan_microbatches(model, state: TrainState, im, lb, base_rng):
+    """The :func:`accumulate_grads` scan core over pre-split microbatches
+    ``im/lb[accum, mb, ...]`` — exposed separately so the GSPMD ZeRO/FSDP
+    steps (:mod:`ddw_tpu.parallel.zero`) can feed globally-interleaved
+    splits instead of the shard_map path's per-device contiguous ones."""
+    accum = im.shape[0]
 
     def body(carry, xs):
         bs, gsum, lsum, asum = carry

@@ -211,9 +211,6 @@ class Trainer:
                 raise ValueError("train.zero and train.fsdp are mutually "
                                  "exclusive (fsdp already shards the "
                                  "optimizer state) — pick one")
-            if cfg.grad_accum_steps > 1:
-                raise ValueError(f"{flag} with grad_accum_steps>1 is not "
-                                 "supported yet — pick one")
             if cfg.ema_decay:
                 raise ValueError(f"{flag} with ema_decay is not supported "
                                  "yet — the Polyak shadow would need its own "
@@ -231,7 +228,8 @@ class Trainer:
             make_sharded = (make_fsdp_train_step if cfg.fsdp
                             else make_zero_train_step)
             train_step = make_sharded(self.model, tx, self.mesh,
-                                      cfg.data_axis)
+                                      cfg.data_axis,
+                                      grad_accum_steps=cfg.grad_accum_steps)
         else:
             train_step = make_train_step(self.model, tx, self.mesh, cfg.data_axis,
                                          grad_accum_steps=cfg.grad_accum_steps)

@@ -143,9 +143,10 @@ class TrainCfg:
                                         # the data axis (parallel/zero.py);
                                         # checkpoints switch to the sharded
                                         # per-process format (no full gather).
-                                        # Incompatible with grad_accum_steps>1
-                                        # and async_checkpoint (saves are
-                                        # collective+synchronous) — both raise.
+                                        # Composes with grad_accum_steps.
+                                        # Incompatible with async_checkpoint
+                                        # (saves are collective+synchronous)
+                                        # — raises.
     fsdp: bool = False                  # ZeRO-3/FSDP: shard params AND
                                         # optimizer state over the data axis
                                         # (~1/N model residency per device;

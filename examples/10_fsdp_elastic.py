@@ -56,6 +56,7 @@ def main():
                if any(ax for ax in l.sharding.spec)]
     frac = sum(l.size for l in sharded) / max(
         1, sum(l.size for l in jax.tree.leaves(res1.state.params)))
+    step1 = int(jax.device_get(res1.state.step))
     print(f"phase 1 done: val_acc={res1.val_accuracy:.4f} "
           f"params sharded={frac:.0%} over {mesh1.shape[DATA_AXIS]} devices")
 
@@ -70,9 +71,12 @@ def main():
     shards = {s.device for l in jax.tree.leaves(res2.state.params)
               if any(ax for ax in l.sharding.spec)
               for s in l.addressable_shards}
+    step2 = int(jax.device_get(res2.state.step))
     print(f"phase 2 done: val_loss={res2.val_loss:.4f} "
           f"val_accuracy={res2.val_accuracy:.4f} "
-          f"devices_holding_shards={len(shards)} base_step_continued=True")
+          f"devices_holding_shards={len(shards)} "
+          f"base_step_continued={step2 > step1} "
+          f"(step {step1} -> {step2})")
 
 
 if __name__ == "__main__":

@@ -76,3 +76,26 @@ def test_host_pipeline(bench_json):
     if host["native_images_per_sec"] is not None:
         assert host["native_images_per_sec"] > 0
         assert host["native_ok_fraction"] == 1.0
+
+
+def test_scan_chained_rows():
+    """DDW_BENCH_CHAIN=scan: the lax.scan megastep arm produces valid rows
+    tagged "chain": "scan" for vision, feature-cache and LM families — the
+    arm chip_queue.sh's mn_frozen_scan item relies on during scarce tunnel
+    windows must not regress silently in CI."""
+    env = dict(os.environ, DDW_BENCH_SMOKE="1", DDW_BENCH_CHAIN="scan",
+               DDW_BENCH_ONLY=("mobilenet_v2_frozen,"
+                               "mobilenet_v2_frozen_feature_cache,lm_flash"),
+               PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert set(d["configs"]) == {"mobilenet_v2_frozen",
+                                 "mobilenet_v2_frozen_feature_cache",
+                                 "lm_flash"}
+    for name, row in d["configs"].items():
+        assert row["chain"] == "scan", (name, row)
+        assert row["rate_per_chip"] > 0, (name, row)

@@ -184,3 +184,44 @@ def test_trainer_fsdp_elastic_resume_8_to_4(tmp_path, silver):
         assert len({s.device for s in leaf.addressable_shards}) == 4
         assert max(s.data.size for s in leaf.addressable_shards) \
             == leaf.size // 4
+
+
+def test_fsdp_grad_accum_matches_single_shot():
+    """FSDP with grad_accum_steps=2 == FSDP single-shot on the same global
+    batch (equal-size microbatches preserve the optimizer math; dropout=0)."""
+    mesh, m, state, tx = _setup(4)
+    imgs, lbls = _batch(32)
+
+    one = make_fsdp_train_step(m, tx, mesh, donate=False)
+    two = make_fsdp_train_step(m, tx, mesh, donate=False, grad_accum_steps=2)
+    s1, m1 = one(one.place_state(state), imgs, lbls, jax.random.PRNGKey(1))
+    s2, m2 = two(two.place_state(state), imgs, lbls, jax.random.PRNGKey(1))
+    assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), abs=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_fsdp_grad_accum_indivisible_raises():
+    mesh, m, state, tx = _setup(4)
+    step = make_fsdp_train_step(m, tx, mesh, donate=False, grad_accum_steps=3)
+    imgs, lbls = _batch(32)  # 32 % 3 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        step(step.place_state(state), imgs, lbls, jax.random.PRNGKey(1))
+
+
+def test_trainer_fsdp_with_grad_accum(tmp_path, silver):
+    """train.fsdp=true + grad_accum_steps=2 through the Trainer."""
+    from ddw_tpu.train.trainer import Trainer
+    from ddw_tpu.utils.config import DataCfg
+
+    train_tbl, val_tbl, _ = silver
+    data = DataCfg(img_height=24, img_width=24)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                     dtype="float32")
+    cfg = TrainCfg(batch_size=4, epochs=2, warmup_epochs=0,
+                   learning_rate=1e-2, seed=0, fsdp=True, grad_accum_steps=2)
+    res = Trainer(data, model, cfg).fit(train_tbl, val_tbl)
+    assert res.epochs_run == 2 and np.isfinite(res.val_loss)
+    specs = [l.sharding.spec for l in jax.tree.leaves(res.state.params)]
+    assert any(DATA_AXIS in (ax for ax in s if ax) for s in specs)

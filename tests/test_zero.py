@@ -144,3 +144,19 @@ def test_trainer_zero_fit_and_sharded_resume(tmp_path, silver):
     assert res2.epochs_run == 4
     assert int(jax.device_get(res2.state.step)) == 2 * int(
         jax.device_get(res.state.step))
+
+
+def test_zero_grad_accum_matches_single_shot():
+    """ZeRO-1 with grad_accum_steps=2 == ZeRO-1 single-shot on the same
+    global batch (the newly-permitted train.zero + accumulation combo)."""
+    mesh, m, state, tx = _setup(4)
+    imgs, lbls = _batch(32)
+
+    one = make_zero_train_step(m, tx, mesh, donate=False)
+    two = make_zero_train_step(m, tx, mesh, donate=False, grad_accum_steps=2)
+    s1, m1 = one(one.place_state(state), imgs, lbls, jax.random.PRNGKey(1))
+    s2, m2 = two(two.place_state(state), imgs, lbls, jax.random.PRNGKey(1))
+    assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), abs=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
