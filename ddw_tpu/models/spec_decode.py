@@ -1,0 +1,160 @@
+"""Speculative decoding: draft-model proposals verified by the target in one
+batched decode call (Leviathan et al. 2211.17192, greedy acceptance).
+
+Beyond-parity serving feature for the LM family (the reference has no LM —
+SURVEY.md §5 "Long-context ... Absent"). Autoregressive decode is
+latency-bound by one target forward per token; a small draft model proposes
+``k`` tokens and the target scores all of them in a single ``S=k+1`` decode
+call (the KV-cached path accepts multi-token blocks with intra-block
+causality — ``models/lm.py`` CausalSelfAttention decode tiling), so each
+round costs one target forward + k cheap draft forwards and yields between 1
+and k+1 confirmed tokens.
+
+Greedy acceptance: drafts are accepted while they equal the target's own
+argmax, and the first disagreement is replaced by the target's choice — the
+output is therefore EXACTLY the target's greedy continuation (pinned by
+``test_spec_decode.py``); the draft only changes latency, never content.
+
+Cache bookkeeping: both models' KV caches advance during drafting/verification
+and are rewound over rejected positions by resetting the ``cache_index`` /
+``pos_index`` scalars (stale K/V rows beyond the index are never attended —
+the decode mask bounds keys by query position — and are overwritten by the
+next write at that position).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddw_tpu.models.lm import TransformerLM, init_cache
+
+_REWIND_KEYS = ("cache_index", "pos_index")
+
+
+def _rewind(cache, n: int):
+    """Roll a decode cache back ``n`` positions (index scalars only)."""
+    if n == 0:
+        return cache
+
+    def fix(path, leaf):
+        if path[-1].key in _REWIND_KEYS:
+            return leaf - n
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.partial(jax.jit, static_argnames=("_dm",))
+def _run(dm_params, cache, toks, *, _dm):
+    """One decode call; module-level so the jit cache (keyed on the static
+    module + shapes) amortizes across generate_speculative invocations."""
+    logits, vars_ = _dm.apply({"params": dm_params, "cache": cache},
+                              toks, mutable=["cache"])
+    return vars_["cache"], logits
+
+
+def generate_speculative(model: TransformerLM, params,
+                         draft_model: TransformerLM, draft_params,
+                         prompt, num_steps: int, k: int = 4):
+    """Greedy continuation of ``prompt`` equal to ``generate(model, ...,
+    temperature=0)``, produced with draft-verified rounds.
+
+    ``prompt`` is int32 ``[1, P]`` (speculative decoding is a latency
+    optimization — per-row acceptance lengths diverge, so batching is out of
+    scope and B>1 raises). Returns ``(tokens[1, num_steps], stats)`` where
+    ``stats`` reports rounds, draft tokens proposed/accepted and the
+    acceptance rate.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, plen = prompt.shape
+    if b != 1:
+        raise ValueError(f"speculative decoding is per-sequence (B=1), "
+                         f"got batch {b}")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if model.vocab_size != draft_model.vocab_size:
+        raise ValueError("target and draft must share a vocabulary "
+                         f"({model.vocab_size} vs {draft_model.vocab_size})")
+    # Verification writes up to k unaccepted rows past the confirmed prefix
+    # before the rewind; they must stay inside the cache or the overflow
+    # NaN-poison fires on rows that would later be rolled back.
+    if plen + num_steps + k + 1 > model.max_len:
+        raise ValueError(f"prompt {plen} + steps {num_steps} + lookahead "
+                         f"{k + 1} exceeds target max_len {model.max_len}")
+    if plen + num_steps + k + 1 > draft_model.max_len:
+        raise ValueError(f"prompt {plen} + steps {num_steps} + lookahead "
+                         f"{k + 1} exceeds draft max_len {draft_model.max_len}")
+
+    dm_t = model.clone(decode=True, seq_axis=None, dropout=0.0)
+    dm_d = draft_model.clone(decode=True, seq_axis=None, dropout=0.0)
+    run_t = functools.partial(_run, _dm=dm_t)
+    run_d = functools.partial(_run, _dm=dm_d)
+
+    cache_t = init_cache(dm_t, 1)
+    cache_d = init_cache(dm_d, 1)
+
+    # Prefill the target; its last-position argmax is the first confirmed
+    # token (identical to greedy generate's first pick). The draft prefills
+    # everything except the last prompt token — that token is its first
+    # drafting input next round.
+    cache_t, logits = run_t(params, cache_t, prompt)
+    first = int(jnp.argmax(logits[0, -1]))
+    if plen > 1:
+        cache_d, _ = run_d(draft_params, cache_d, prompt[:, :-1])
+
+    # H = confirmed sequence; invariant between rounds: the target cache has
+    # processed H[:-1], the draft cache H[:p_d] with p_d <= len(H)-1.
+    H = list(np.asarray(prompt[0])) + [first]
+    p_d = plen - 1
+    rounds = proposed = accepted_drafts = 0
+
+    while len(H) - plen < num_steps:
+        rounds += 1
+        # -- draft k greedy proposals ------------------------------------
+        lag = H[p_d:]  # unprocessed confirmed tokens, ending with H[-1]
+        cache_d, dlogits = run_d(draft_params, cache_d,
+                                 jnp.asarray([lag], jnp.int32))
+        drafts = [int(jnp.argmax(dlogits[0, -1]))]
+        for _ in range(k - 1):
+            cache_d, dlogits = run_d(draft_params, cache_d,
+                                     jnp.asarray([[drafts[-1]]], jnp.int32))
+            drafts.append(int(jnp.argmax(dlogits[0, -1])))
+        p_d = len(H) + k - 1  # processed: lag + drafts[:-1]
+
+        # -- verify: one target call over [t_cur, d_1..d_k] ---------------
+        block = jnp.asarray([[H[-1]] + drafts], jnp.int32)
+        cache_t, tlogits = run_t(params, cache_t, block)
+        preds = np.asarray(jnp.argmax(tlogits[0], axis=-1))  # [k+1]
+        m = 0
+        while m < k and preds[m] == drafts[m]:
+            m += 1
+        t_new = int(preds[m])
+
+        # -- bookkeeping + rewinds ----------------------------------------
+        proposed += k
+        accepted_drafts += m
+        H.extend(drafts[:m] + [t_new])
+        cache_t = _rewind(cache_t, k - m)      # keep inputs t_cur, d_1..d_m
+        # Draft processed t_cur, d_1..d_{k-1}; its valid prefix is
+        # t_cur..d_m. Full acceptance (m == k) rewinds nothing — d_k simply
+        # stays unprocessed and rides in next round's lag.
+        rew_d = (k - 1) - m if m < k else 0
+        if rew_d:
+            cache_d = _rewind(cache_d, rew_d)
+            p_d -= rew_d
+
+    gen = H[plen:plen + num_steps]
+    target_calls = rounds + 1  # verification rounds + the prefill call
+    stats = {"rounds": rounds, "target_calls": target_calls,
+             "drafts_proposed": proposed,
+             "drafts_accepted": accepted_drafts,
+             "acceptance_rate": (accepted_drafts / proposed if proposed
+                                 else 0.0),
+             # returned tokens over target forwards — plain greedy decode
+             # would be 1.0 by this same accounting
+             "tokens_per_target_call": len(gen) / target_calls}
+    return jnp.asarray([gen], jnp.int32), stats

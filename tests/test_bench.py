@@ -15,20 +15,25 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.fixture(scope="module")
-def bench_json():
-    # Pin the virtual-CPU backend: the structural assertions below must not
-    # depend on the TPU tunnel being reachable (PALLAS_AXON_POOL_IPS="" skips
-    # the axon sitecustomize; same recipe as the root conftest).
+def _run_bench(**extra_env):
+    """Smoke-run bench.py on the pinned virtual-CPU backend and parse its
+    one-line JSON (PALLAS_AXON_POOL_IPS="" skips the axon sitecustomize so
+    the assertions never depend on the TPU tunnel; same recipe as the root
+    conftest)."""
     env = dict(os.environ, DDW_BENCH_SMOKE="1", PALLAS_AXON_POOL_IPS="",
                JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               **extra_env)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
     assert out.returncode == 0, out.stderr[-2000:]
-    line = out.stdout.strip().splitlines()[-1]
-    return json.loads(line)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.fixture(scope="module")
+def bench_json():
+    return _run_bench()
 
 
 def test_headline_contract(bench_json):
@@ -83,16 +88,10 @@ def test_scan_chained_rows():
     tagged "chain": "scan" for vision, feature-cache and LM families — the
     arm chip_queue.sh's mn_frozen_scan item relies on during scarce tunnel
     windows must not regress silently in CI."""
-    env = dict(os.environ, DDW_BENCH_SMOKE="1", DDW_BENCH_CHAIN="scan",
-               DDW_BENCH_ONLY=("mobilenet_v2_frozen,"
-                               "mobilenet_v2_frozen_feature_cache,lm_flash"),
-               PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=8")
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
-    assert out.returncode == 0, out.stderr[-2000:]
-    d = json.loads(out.stdout.strip().splitlines()[-1])
+    d = _run_bench(
+        DDW_BENCH_CHAIN="scan",
+        DDW_BENCH_ONLY=("mobilenet_v2_frozen,"
+                        "mobilenet_v2_frozen_feature_cache,lm_flash"))
     assert set(d["configs"]) == {"mobilenet_v2_frozen",
                                  "mobilenet_v2_frozen_feature_cache",
                                  "lm_flash"}

@@ -39,6 +39,8 @@ _EXAMPLES = [
     ("07_lm_long_context.py", ["--steps", "3"], "final:"),
     ("07_lm_long_context.py",
      ["--steps", "3", "lm.pos_encoding=rope", "lm.num_kv_heads=2"], "final:"),
+    ("07_lm_long_context.py",
+     ["--steps", "3", "--speculative"], "speculative: identical"),
     ("09_lora_finetune.py", [], "base_frozen=True"),
     ("10_fsdp_elastic.py", ["train.epochs=2"], "elastic 8 -> 4"),
 ]

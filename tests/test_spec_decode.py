@@ -1,0 +1,89 @@
+"""Speculative decoding: exact greedy equivalence, acceptance accounting,
+cache-rewind correctness across rounds."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddw_tpu.models.lm import TransformerLM, generate
+from ddw_tpu.models.spec_decode import generate_speculative
+
+VOCAB = 32
+
+
+def _lm(depth=2, hidden=32, seed=0):
+    m = TransformerLM(vocab_size=VOCAB, max_len=128, hidden=hidden,
+                      depth=depth, num_heads=2, mlp_dim=hidden * 2,
+                      dropout=0.0, dtype=jnp.float32)
+    p = m.init({"params": jax.random.PRNGKey(seed)},
+               np.zeros((1, 4), np.int32))["params"]
+    return m, p
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_spec_decode_equals_greedy(k):
+    """The output is EXACTLY the target's greedy continuation, whatever the
+    draft proposes (here: an independently random model — low acceptance)."""
+    target, tp = _lm(seed=0)
+    draft, dp = _lm(depth=1, hidden=16, seed=7)
+    prompt = (np.arange(6, dtype=np.int32) % VOCAB).reshape(1, 6)
+
+    ref = generate(target, tp, prompt, num_steps=12)
+    out, stats = generate_speculative(target, tp, draft, dp, prompt,
+                                      num_steps=12, k=k)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert stats["rounds"] >= 1
+    assert 0.0 <= stats["acceptance_rate"] <= 1.0
+
+
+def test_spec_decode_self_draft_accepts_everything():
+    """Draft == target: every proposal matches the target argmax, so every
+    round accepts all k drafts + the bonus token (k+1 tokens per target
+    call) and the output still equals greedy."""
+    target, tp = _lm(seed=3)
+    prompt = (np.arange(5, dtype=np.int32) % VOCAB).reshape(1, 5)
+    k = 4
+    ref = generate(target, tp, prompt, num_steps=10)
+    out, stats = generate_speculative(target, tp, target, tp, prompt,
+                                      num_steps=10, k=k)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert stats["acceptance_rate"] == 1.0
+    # k+1 confirmed tokens per verification round
+    assert stats["tokens_per_target_call"] > k / 2
+
+
+def test_spec_decode_validation():
+    target, tp = _lm()
+    draft, dp = _lm(depth=1)
+    prompt = np.zeros((1, 4), np.int32)
+    with pytest.raises(ValueError, match="B=1"):
+        generate_speculative(target, tp, draft, dp,
+                             np.zeros((2, 4), np.int32), 4)
+    with pytest.raises(ValueError, match="k must be"):
+        generate_speculative(target, tp, draft, dp, prompt, 4, k=0)
+    with pytest.raises(ValueError, match="exceeds target max_len"):
+        generate_speculative(target, tp, draft, dp, prompt, 124, k=4)
+    small_vocab = TransformerLM(vocab_size=8, max_len=64, hidden=16,
+                                depth=1, num_heads=2, mlp_dim=32,
+                                dropout=0.0, dtype=jnp.float32)
+    sp = small_vocab.init({"params": jax.random.PRNGKey(0)},
+                          np.zeros((1, 4), np.int32))["params"]
+    with pytest.raises(ValueError, match="vocabulary"):
+        generate_speculative(target, tp, small_vocab, sp, prompt, 4)
+
+
+def test_spec_decode_gqa_rope_target():
+    """Composes with the round-3 LM features (RoPE positions + GQA cache)."""
+    target = TransformerLM(vocab_size=VOCAB, max_len=128, hidden=32, depth=2,
+                           num_heads=4, num_kv_heads=2, mlp_dim=64,
+                           dropout=0.0, dtype=jnp.float32,
+                           pos_encoding="rope")
+    tp = target.init({"params": jax.random.PRNGKey(1)},
+                     np.zeros((1, 4), np.int32))["params"]
+    draft, dp = _lm(depth=1, hidden=16, seed=9)
+    prompt = (np.arange(4, dtype=np.int32) * 3 % VOCAB).reshape(1, 4)
+    ref = generate(target, tp, prompt, num_steps=8)
+    out, _ = generate_speculative(target, tp, draft, dp, prompt,
+                                  num_steps=8, k=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
