@@ -29,6 +29,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ddw_tpu.models.lm import TransformerLM, init_cache
 
@@ -55,6 +56,31 @@ def _run(dm_params, cache, toks, *, _dm):
     logits, vars_ = _dm.apply({"params": dm_params, "cache": cache},
                               toks, mutable=["cache"])
     return vars_["cache"], logits
+
+
+@functools.partial(jax.jit, static_argnames=("_dm", "k"))
+def _draft_round(dm_params, cache, lag_toks, *, _dm, k):
+    """One whole drafting round as ONE dispatch: consume the lag block, then
+    greedy-decode k tokens via lax.scan inside the jit. A per-token host loop
+    would pay k dispatch+fetch round-trips per round — on a TPU that latency
+    is exactly what speculative decoding exists to amortize, so the draft
+    must not reintroduce it. Returns (cache, drafts[k])."""
+    def step(cache, tok):
+        logits, vars_ = _dm.apply({"params": dm_params, "cache": cache},
+                                  tok, mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+        return vars_["cache"], nxt
+
+    cache, tok = step(cache, lag_toks)  # d_1 from the lag block
+
+    def body(carry, _):
+        cache, tok = carry
+        new_cache, nxt = step(cache, tok)
+        return (new_cache, nxt), tok[0, 0]
+
+    (cache, last), emitted = lax.scan(body, (cache, tok), None, length=k - 1)
+    drafts = jnp.concatenate([emitted, last[0]])  # d_1..d_{k-1} + d_k
+    return cache, drafts
 
 
 def generate_speculative(model: TransformerLM, params,
@@ -114,15 +140,12 @@ def generate_speculative(model: TransformerLM, params,
 
     while len(H) - plen < num_steps:
         rounds += 1
-        # -- draft k greedy proposals ------------------------------------
+        # -- draft k greedy proposals (one dispatch, one fetch) ------------
         lag = H[p_d:]  # unprocessed confirmed tokens, ending with H[-1]
-        cache_d, dlogits = run_d(draft_params, cache_d,
-                                 jnp.asarray([lag], jnp.int32))
-        drafts = [int(jnp.argmax(dlogits[0, -1]))]
-        for _ in range(k - 1):
-            cache_d, dlogits = run_d(draft_params, cache_d,
-                                     jnp.asarray([[drafts[-1]]], jnp.int32))
-            drafts.append(int(jnp.argmax(dlogits[0, -1])))
+        cache_d, draft_arr = _draft_round(draft_params, cache_d,
+                                          jnp.asarray([lag], jnp.int32),
+                                          _dm=dm_d, k=k)
+        drafts = [int(t) for t in np.asarray(draft_arr)]
         p_d = len(H) + k - 1  # processed: lag + drafts[:-1]
 
         # -- verify: one target call over [t_cur, d_1..d_k] ---------------

@@ -211,10 +211,6 @@ class Trainer:
                 raise ValueError("train.zero and train.fsdp are mutually "
                                  "exclusive (fsdp already shards the "
                                  "optimizer state) — pick one")
-            if cfg.ema_decay:
-                raise ValueError(f"{flag} with ema_decay is not supported "
-                                 "yet — the Polyak shadow would need its own "
-                                 "sharding rules; pick one")
             if cfg.async_checkpoint:
                 raise ValueError(
                     f"{flag} with async_checkpoint=true is not supported: "

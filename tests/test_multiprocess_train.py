@@ -249,3 +249,72 @@ def test_two_process_batch_scorer_merges(silver, store, worker_pythonpath,
     assert out["merged_rows"] == val_tbl.num_records
     assert out["merged_from"] == ["predictions_p0", "predictions_p1"]
     assert out["paths"] == sorted(r.path for r in val_tbl.iter_records())
+
+
+def _fsdp_train_worker() -> dict:
+    """FSDP step over the real 2-process gang: every process computes the
+    same jitted program; each holds only its devices' param shards."""
+    import jax
+    import numpy as np
+
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.parallel.zero import make_fsdp_train_step
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
+    from ddw_tpu.train.step import init_state
+    from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+    mesh = make_mesh(MeshSpec((("data", -1),)))
+    n = mesh.shape["data"]
+    mcfg = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                    dtype="float32")
+    tcfg = TrainCfg(batch_size=8, learning_rate=1e-2)
+    model = build_model(mcfg)
+    state, tx = init_state(model, mcfg, tcfg, (16, 16, 3),
+                           jax.random.PRNGKey(0))
+    step = make_fsdp_train_step(model, tx, mesh, donate=False)
+
+    from ddw_tpu.parallel.zero import fsdp_state_shardings
+
+    host = jax.tree.map(np.asarray, state)  # identical on every host (seed)
+    sh = fsdp_state_shardings(state, mesh)
+    gstate = jax.tree.map(
+        lambda x, s: jax.make_array_from_callback(x.shape, s,
+                                                  lambda idx: x[idx]),
+        host, sh)
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(32, 16, 16, 3).astype(np.float32)
+    lbls = rng.randint(0, 5, size=(32,)).astype(np.int32)
+    gi = jax.make_array_from_callback(imgs.shape, step.batch_sharding,
+                                      lambda idx: imgs[idx])
+    gl = jax.make_array_from_callback(lbls.shape, step.batch_sharding,
+                                      lambda idx: lbls[idx])
+
+    losses = []
+    for i in range(6):
+        gstate, metrics = step(gstate, gi, gl, jax.random.PRNGKey(i))
+        losses.append(float(jax.device_get(metrics["loss"])))
+
+    shard_ok = True
+    local_devs = len(jax.local_devices())
+    n_sharded = 0
+    for leaf in jax.tree.leaves(gstate.params):
+        if any(ax for ax in leaf.sharding.spec):
+            n_sharded += 1
+            shards = leaf.addressable_shards
+            shard_ok &= len(shards) == local_devs
+            shard_ok &= max(s.data.size for s in shards) == leaf.size // n
+    return {"processes": jax.process_count(), "world": n,
+            "losses": losses, "n_sharded": n_sharded, "shard_ok": shard_ok}
+
+
+def test_two_process_fsdp_train(worker_pythonpath):
+    """FSDP executes over a real 2-process gang (4 devices): loss descends,
+    and each process holds exactly its devices' 1/4 param shards — the
+    multi-host claim behind train.fsdp, not just the virtual-mesh one."""
+    out = Launcher(np=2, devices_per_proc=2, timeout_s=540).run(
+        _fsdp_train_worker)
+    assert out["processes"] == 2 and out["world"] == 4
+    assert out["n_sharded"] > 0 and out["shard_ok"]
+    assert np.isfinite(out["losses"]).all()
+    assert out["losses"][-1] < out["losses"][0]

@@ -160,3 +160,25 @@ def test_zero_grad_accum_matches_single_shot():
     for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_zero_with_ema(tmp_path, silver):
+    """train.zero=true + ema_decay (refusal removed): the Polyak shadow is
+    param-shaped opt_state, so the generic ZeRO leaf sharding covers it —
+    the fit runs, eval reads the shadow, and the shadow lives sharded."""
+    from ddw_tpu.train.step import ema_params
+    from ddw_tpu.train.trainer import Trainer
+    from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+    train_tbl, val_tbl, _ = silver
+    data = DataCfg(img_height=24, img_width=24)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                     dtype="float32")
+    cfg = TrainCfg(batch_size=4, epochs=2, warmup_epochs=0,
+                   learning_rate=1e-2, seed=0, zero=True, ema_decay=0.5)
+    res = Trainer(data, model, cfg).fit(train_tbl, val_tbl)
+    assert res.epochs_run == 2 and np.isfinite(res.val_loss)
+    shadow = ema_params(res.state)
+    assert shadow is not None
+    specs = [l.sharding.spec for l in jax.tree.leaves(shadow)]
+    assert any(DATA_AXIS in (ax for ax in s if ax) for s in specs), specs
