@@ -78,6 +78,27 @@ def _path_key(path) -> str:
                     for p in path)
 
 
+def check_spec_divisibility(key: str, shape: tuple, spec: P, mesh: Mesh) -> None:
+    """Refuse loudly where GSPMD would fail opaquely at compile time: every
+    sharded dim must divide by its mesh-axis size. The common trip-wire is
+    GQA/MQA (num_kv_heads < model-axis size shrinks the k/v head dim the TP
+    rules shard). Shared by the 1D TP path and the 2D FSDP×TP path."""
+    for d, axis in enumerate(spec):
+        if axis is None or d >= len(shape):
+            continue
+        # a spec entry may name several mesh axes (P(("data","model"),...))
+        names = axis if isinstance(axis, tuple) else (axis,)
+        n = 1
+        for a in names:
+            n *= mesh.shape[a]
+        if shape[d] % n:
+            raise ValueError(
+                f"cannot shard {key} dim {d} (size {shape[d]}) over mesh "
+                f"axis {axis!r} (size {n}): not divisible. For GQA/MQA "
+                f"models either keep num_kv_heads a multiple of the "
+                f"model-axis size or override the k/v rules to replicate.")
+
+
 def shardings_for_params(tree, mesh: Mesh, rules: PartitionRules):
     """Pytree of NamedShardings matching ``tree`` via the path rules.
 
@@ -89,24 +110,7 @@ def shardings_for_params(tree, mesh: Mesh, rules: PartitionRules):
         key = _path_key(path)
         shape = tuple(getattr(leaf, "shape", ()))
         spec = rules.spec_for(key, len(shape))
-        # Refuse loudly where GSPMD would fail opaquely at compile time: every
-        # sharded dim must divide by its mesh-axis size. The common trip-wire
-        # is GQA/MQA (num_kv_heads < model-axis size shrinks the k/v head dim
-        # the TP rules shard).
-        for d, axis in enumerate(spec):
-            if axis is None or d >= len(shape):
-                continue
-            # a spec entry may name several mesh axes (P(("data","model"),...))
-            names = axis if isinstance(axis, tuple) else (axis,)
-            n = 1
-            for a in names:
-                n *= mesh.shape[a]
-            if shape[d] % n:
-                raise ValueError(
-                    f"cannot shard {key} dim {d} (size {shape[d]}) over mesh "
-                    f"axis {axis!r} (size {n}): not divisible. For GQA/MQA "
-                    f"models either keep num_kv_heads a multiple of the "
-                    f"model-axis size or override the k/v rules to replicate.")
+        check_spec_divisibility(key, shape, spec, mesh)
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(to_sharding, tree)

@@ -119,6 +119,75 @@ def fsdp_fraction_sharded(state: TrainState, mesh: Mesh,
     return _fraction_sharded(state.params, mesh, axis)
 
 
+def fsdp_tp_state_shardings(state: TrainState, mesh: Mesh, rules,
+                            axis: str = DATA_AXIS) -> TrainState:
+    """2D shardings: tensor-parallel dims per ``rules`` (model axis), then
+    FSDP over ``axis`` on the largest still-unsharded divisible dim of every
+    param/opt leaf — the scaling-book 2D recipe (params live as [data x
+    model] tiles; GSPMD emits per-layer all-gathers over ``axis`` and the
+    Megatron activation reductions over the model axis).
+
+    Works on any tree whose leaf paths end with the rule suffixes — Adam
+    moments and the EMA shadow mirror param paths, so they tile identically.
+    """
+    from ddw_tpu.parallel.sharding import _path_key, check_spec_divisibility
+
+    n = mesh.shape[axis]
+    repl = NamedSharding(mesh, P())
+
+    def to_sharding(path, leaf):
+        key = _path_key(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        base = rules.spec_for(key, len(shape))
+        check_spec_divisibility(key, shape, base, mesh)
+        spec = list(base) + [None] * (len(shape) - len(base))
+        taken = [d for d, ax in enumerate(spec) if ax is not None]
+        best = None
+        for d, s in enumerate(shape):
+            if d in taken:
+                continue
+            if s % n == 0 and s >= n and (best is None or s > shape[best]):
+                best = d
+        if best is not None:
+            spec[best] = axis
+        return NamedSharding(mesh, P(*spec))
+
+    def tree_sh(tree):
+        return jax.tree_util.tree_map_with_path(to_sharding, tree)
+
+    return TrainState(
+        params=tree_sh(state.params),
+        batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+        opt_state=tree_sh(state.opt_state),
+        step=repl,
+    )
+
+
+def make_fsdp_tp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    rules,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+    grad_accum_steps: int = 1,
+) -> Callable:
+    """2D FSDP x TP train step over a ``(data, model)`` mesh.
+
+    Same call contract as :func:`make_fsdp_train_step`; params and optimizer
+    state tile over BOTH axes (:func:`fsdp_tp_state_shardings` with e.g.
+    ``ddw_tpu.parallel.sharding.VIT_TP_RULES``), the batch shards over
+    ``axis``. XLA inserts the Megatron collectives over the model axis and
+    the FSDP gather/reduce-scatter over the data axis from the annotations
+    alone. Numerics pinned against the plain DP step.
+    """
+    def shardings_fn(state, mesh_, axis_):
+        return fsdp_tp_state_shardings(state, mesh_, rules, axis_)
+
+    return _make_sharded_state_step(shardings_fn, model, tx, mesh,
+                                    axis, donate, grad_accum_steps)
+
+
 def _global_microbatches(x, accum: int, mesh: Mesh, axis: str):
     """Split a globally-sharded batch into ``accum`` interleaved microbatches
     ``[accum, B/accum, ...]``.
