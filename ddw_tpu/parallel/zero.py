@@ -41,10 +41,14 @@ from ddw_tpu.runtime.mesh import DATA_AXIS
 from ddw_tpu.train.step import TrainState, apply_gradients, forward_and_grads
 
 
-def _leaf_spec(shape: tuple[int, ...], n: int, axis: str) -> P:
-    """Shard the largest dimension divisible by ``n``; replicate if none."""
+def _leaf_spec(shape: tuple[int, ...], n: int, axis: str,
+               exclude: frozenset[int] = frozenset()) -> P:
+    """Shard the largest dimension divisible by ``n``; replicate if none.
+    ``exclude`` marks dims already owned by another axis (the 2D path)."""
     best = None
     for d, s in enumerate(shape):
+        if d in exclude:
+            continue
         if s % n == 0 and s >= n and (best is None or s > shape[best]):
             best = d
     if best is None:
@@ -141,15 +145,11 @@ def fsdp_tp_state_shardings(state: TrainState, mesh: Mesh, rules,
         base = rules.spec_for(key, len(shape))
         check_spec_divisibility(key, shape, base, mesh)
         spec = list(base) + [None] * (len(shape) - len(base))
-        taken = [d for d, ax in enumerate(spec) if ax is not None]
-        best = None
-        for d, s in enumerate(shape):
-            if d in taken:
-                continue
-            if s % n == 0 and s >= n and (best is None or s > shape[best]):
-                best = d
-        if best is not None:
-            spec[best] = axis
+        taken = frozenset(d for d, ax in enumerate(spec) if ax is not None)
+        fsdp = _leaf_spec(shape, n, axis, exclude=taken)
+        for d, ax in enumerate(fsdp):
+            if ax is not None:
+                spec[d] = ax
         return NamedSharding(mesh, P(*spec))
 
     def tree_sh(tree):

@@ -33,13 +33,15 @@ export DDW_REQUIRE_TPU=1
 log() { echo "[queue] $(date -u +%Y-%m-%dT%H:%M:%SZ) $*" >> "$QLOG"; }
 
 probe() {
+  # 9>&- : children must not inherit the flock fd — a hung probe would
+  # otherwise hold the lock past the parent's death and block restarts.
   timeout 75 python -c "
 import jax, jax.numpy as jnp
 d = jax.devices()[0]
 assert 'TPU' in d.device_kind, f'backend fell back to {d.device_kind}'
 x = jnp.ones((1024, 1024), jnp.bfloat16)
 print(float((x @ x).astype(jnp.float32).sum()))
-" >/dev/null 2>&1
+" >/dev/null 2>&1 9>&-
 }
 
 # run_item <name> <command...>  — returns 0 if done (now or before)
@@ -60,7 +62,7 @@ run_item() {
   # record a wedged window leaves). <name>.{out,err} always point at the
   # latest attempt via copy-on-success.
   timeout "${ITEM_TIMEOUT:-2700}" bash -c "$*" \
-    > "$LOGDIR/$name.a$att.out" 2> "$LOGDIR/$name.a$att.err"
+    > "$LOGDIR/$name.a$att.out" 2> "$LOGDIR/$name.a$att.err" 9>&-
   local rc=$?
   log "end $name rc=$rc"
   if [ "$rc" -eq 0 ]; then
@@ -75,7 +77,7 @@ run_item() {
 log "runner started pid=$$"
 while :; do
   all_done=1
-  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan conv_profile_mn conv_profile_rn ab_conv fa2_sweep; do
+  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan conv_profile_mn conv_profile_rn ab_conv fa2_sweep packaged_infer packaged_infer_int8; do
     [ -f "$LOGDIR/$name.done" ] || { [ -f "$LOGDIR/$name.attempts" ] && [ "$(cat "$LOGDIR/$name.attempts")" -ge "$MAX_ATTEMPTS" ]; } || all_done=0
   done
   if [ "$all_done" -eq 1 ]; then
@@ -99,6 +101,8 @@ while :; do
     ITEM_TIMEOUT=5400 run_item conv_profile_rn "python -u tools/conv_profile.py resnet50" || continue
     run_item ab_conv         "DDW_BENCH_STALL_S=900 DDW_BENCH_S2D=1 DDW_BENCH_DW=pallas DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_unfrozen,resnet50 python -u bench.py" || continue
     ITEM_TIMEOUT=5400 run_item fa2_sweep "python -u tools/fa2_sweep.py" || continue
+    run_item packaged_infer  "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=packaged_infer python -u bench.py" || continue
+    run_item packaged_infer_int8 "DDW_BENCH_STALL_S=900 DDW_BENCH_INT8=1 DDW_BENCH_ONLY=packaged_infer python -u bench.py" || continue
   fi
-  sleep "$PROBE_SLEEP"
+  sleep "$PROBE_SLEEP" 9>&-
 done
