@@ -30,6 +30,15 @@ TPU-first formulation:
 Scope: training/eval steps for :class:`ddw_tpu.models.lm.TransformerLM` with
 ``dropout == 0`` and ``seq_axis is None`` (PP composes with DP by adding a
 data axis to the mesh; the batch dim shards over it transparently).
+
+Why GPipe-with-remat rather than 1F1B: 1F1B's advantage over GPipe is peak
+activation memory (O(n_stages) live microbatches instead of O(m)); its bubble
+fraction is the same (n-1)/(m+n-1). Here every tick's stage application is
+``jax.checkpoint``-ed, so the scan already retains only the [mb, S, H]
+inter-stage activations per tick — 1F1B's memory profile — while backward
+remains plain ``jax.grad`` (XLA transposes the schedule, ppermute hops
+reverse automatically). A literal 1F1B would trade that for a hand-written
+interleaved VJP schedule with no bubble improvement to show for it.
 """
 
 from __future__ import annotations
