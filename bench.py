@@ -111,13 +111,15 @@ def _compiled_flops(lowered_compiled) -> float | None:
 # returned, 0 bytes of output after 40 min). Every completed unit of work
 # beats this heartbeat; a daemon watchdog (started in main) emits the final
 # JSON with whatever configs already finished and exits nonzero when the
-# heartbeat goes stale. Threshold must exceed the longest legitimate gap —
-# a cold compile (~40-90 s on the tunneled chip) or one differential run
-# (~2-8 s of device work + fetch latency). SMOKE (CPU CI) gets a much laxer
-# default: a loaded 1-core host can legitimately take minutes per compile,
-# and the guard's target failure mode is the tunnel, not CI contention.
+# heartbeat goes stale. Beats land after every compile AND every completed
+# differential, so the threshold must exceed ONE cold compile (the longest
+# observed legitimate gap; resnet50 exceeded 420 s in the 2026-07-31 window)
+# or one differential run (~2-8 s of device work + fetch latency). SMOKE
+# (CPU CI) gets a much laxer default: a loaded 1-core host can legitimately
+# take minutes per compile, and the guard's target failure mode is the
+# tunnel, not CI contention.
 STALL_S = float(os.environ.get("DDW_BENCH_STALL_S", "")
-                or ("1800" if SMOKE else "420"))
+                or ("1800" if SMOKE else "600"))
 _progress_t = [time.time()]
 
 
@@ -173,7 +175,8 @@ def _chained_runner(step, compiled, state, args):
     mega_c = jax.jit(mega, donate_argnums=(0,))
     st, last = mega_c(holder["state"], *args)  # warmup/compile
     np.asarray(last)
-    holder["state"] = st
+    _beat("scan megastep: compiled")  # the scan program is a second cold
+    holder["state"] = st              # compile — it must beat too
 
     def run_n(n):
         assert n % SCAN_CHUNK == 0, (n, SCAN_CHUNK)
@@ -284,6 +287,7 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
 
     # AOT: one compile, reused for both the FLOP count and every timed call.
     compiled = step.lower(state, images, labels, key).compile()
+    _beat("vision: compiled")
     flops = _compiled_flops(compiled)
 
     state, metrics = compiled(state, images, labels, key)  # warmup
@@ -335,6 +339,7 @@ def bench_packaged_infer(*, batch: int, img: tuple, peak: float | None) -> dict:
                             quantize=quant)
         pm = PackagedModel(tmp)
         pm.predict_logits(imgs)  # warmup: compile the 128-sub-batch apply
+        _beat("packaged_infer: compiled")
 
         def run_n(n):
             t0 = time.perf_counter()
@@ -390,6 +395,7 @@ def bench_head_features(*, batch: int, feature_dim: int,
     key = jax.random.PRNGKey(1)
 
     compiled = step.lower(state, feats, labels, key).compile()
+    _beat("head: compiled")
     flops = _compiled_flops(compiled)
     state, metrics = compiled(state, feats, labels, key)
     np.asarray(metrics["loss"])
@@ -437,6 +443,7 @@ def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
     key = jax.random.PRNGKey(1)
 
     compiled = step.lower(state, inputs, targets, key).compile()
+    _beat("lm: compiled")
     flops = _compiled_flops(compiled)
     state, metrics = compiled(state, inputs, targets, key)
     np.asarray(metrics["loss"])
