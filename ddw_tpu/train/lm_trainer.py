@@ -1,0 +1,258 @@
+"""LM trainer: the Trainer amenities for the long-context model family.
+
+The vision :class:`ddw_tpu.train.trainer.Trainer` mirrors the reference's
+``train_and_evaluate`` contracts; the LM family (beyond parity — the
+reference has no language model, SURVEY.md §5 "Long-context ... Absent")
+previously trained through hand-rolled loops (example 07). This wraps the
+same loop machinery around :mod:`ddw_tpu.train.lm_step`:
+
+- DP×SP mesh construction (``seq_devices`` splits the sequence axis; the
+  model binds the ring-attention axis automatically),
+- the shared callback suite — per-batch Goyal warmup, plateau or cosine LR,
+  early stopping — driven through the same dynamic-LR optimizer state,
+- epoch checkpoints with callback-counter metadata and exact resume
+  (deterministic per-epoch shuffle keyed by ``seed + epoch``: an
+  epoch-boundary resume replays the uninterrupted stream),
+- tracker logging (params once, metrics per epoch).
+
+Data model: one token array ``[num_seqs, seq_len + 1]`` (next-token pairs
+are carved per batch); a held-out validation split is taken up front with a
+seeded permutation, mirroring the reference's seed-42 split discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from ddw_tpu.checkpoint.ckpt import CheckpointManager
+from ddw_tpu.models.lm import build_lm
+from ddw_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS, MeshSpec, make_mesh
+from ddw_tpu.train.callbacks import (
+    CosineDecay,
+    EarlyStopping,
+    LRWarmup,
+    ReduceLROnPlateau,
+)
+from ddw_tpu.train.lm_step import (
+    init_lm_state,
+    make_lm_eval_step,
+    make_lm_train_step,
+)
+from ddw_tpu.train.step import TrainState, get_lr, make_optimizer, set_lr
+from ddw_tpu.utils.config import LMCfg, TrainCfg, to_dict
+
+
+@dataclasses.dataclass
+class LMTrainResult:
+    val_loss: float
+    val_accuracy: float
+    history: list[dict[str, float]]
+    state: TrainState
+    epochs_run: int
+
+
+class LMTrainer:
+    """``fit(tokens)`` for :class:`ddw_tpu.models.lm.TransformerLM`."""
+
+    def __init__(self, lm_cfg: LMCfg, train_cfg: TrainCfg,
+                 mesh=None, seq_devices: int = 1, run=None):
+        if train_cfg.ema_decay:
+            raise ValueError("LMTrainer does not support train.ema_decay yet "
+                             "— drop the flag (the vision Trainer carries the "
+                             "EMA machinery)")
+        if train_cfg.zero or train_cfg.fsdp:
+            raise ValueError("LMTrainer uses the shard_map DPxSP step; for "
+                             "ZeRO/FSDP LM training use "
+                             "parallel.zero.make_fsdp_train_step / "
+                             "make_fsdp_tp_train_step directly")
+        self.lm_cfg, self.train_cfg, self.run = lm_cfg, train_cfg, run
+        if mesh is None:
+            devices = jax.devices()
+            if train_cfg.num_devices:
+                devices = devices[: train_cfg.num_devices]
+            n = len(devices)
+            if n % seq_devices:
+                raise ValueError(f"seq_devices {seq_devices} must divide "
+                                 f"device count {n}")
+            dp = n // seq_devices
+            axes = ((DATA_AXIS, dp),) if seq_devices == 1 else (
+                (DATA_AXIS, dp), (SEQ_AXIS, seq_devices))
+            mesh = make_mesh(MeshSpec(axes), devices=devices)
+        self.mesh = mesh
+        self.seq_axis = SEQ_AXIS if SEQ_AXIS in mesh.shape else None
+        self.model = build_lm(lm_cfg, seq_axis=self.seq_axis,
+                              expert_axis=(DATA_AXIS if lm_cfg.num_experts
+                                           else None))
+
+    # ------------------------------------------------------------------
+    def fit(self, tokens: np.ndarray, val_fraction: float = 0.1,
+            resume: bool = False) -> LMTrainResult:
+        cfg = self.train_cfg
+        mesh = self.mesh
+        dp = mesh.shape[DATA_AXIS]
+        sp = mesh.shape.get(SEQ_AXIS, 1)
+
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim != 2 or tokens.shape[1] < 2:
+            raise ValueError(f"tokens must be [num_seqs, seq_len+1], got "
+                             f"{tokens.shape}")
+        seq_len = tokens.shape[1] - 1
+        if seq_len % sp:
+            raise ValueError(f"seq_len {seq_len} not divisible by "
+                             f"seq_devices {sp}")
+
+        # Seeded split (the seed-42 discipline, reference 01_data_prep.py).
+        perm = np.random.RandomState(cfg.seed).permutation(len(tokens))
+        n_val = max(1, int(len(tokens) * val_fraction))
+        val, train = tokens[perm[:n_val]], tokens[perm[n_val:]]
+
+        global_batch = cfg.batch_size * dp
+        steps_per_epoch = max(1, len(train) // global_batch)
+        val_steps = max(1, len(val) // global_batch)
+        if len(train) < global_batch:
+            raise ValueError(f"{len(train)} train sequences < global batch "
+                             f"{global_batch}")
+
+        tx = make_optimizer(cfg)
+        rng = jax.random.PRNGKey(cfg.seed)
+        state = init_lm_state(self.model, tx, rng, seq_len=min(8, seq_len))
+        step = make_lm_train_step(self.model, tx, mesh,
+                                  seq_axis=self.seq_axis,
+                                  grad_accum_steps=cfg.grad_accum_steps)
+        eval_step = make_lm_eval_step(self.model, mesh,
+                                      seq_axis=self.seq_axis)
+
+        ckpt = (CheckpointManager(cfg.checkpoint_dir,
+                                  async_write=cfg.async_checkpoint)
+                if cfg.checkpoint_dir else None)
+        start_epoch = 0
+        restored_meta = None
+        if ckpt and resume:
+            state, at_step = ckpt.restore(state)
+            if at_step is not None:
+                start_epoch = int(at_step) // steps_per_epoch
+                restored_meta = ckpt.read_metadata(at_step)
+
+        if cfg.lr_schedule not in ("plateau", "cosine"):
+            raise ValueError(f"unknown train.lr_schedule {cfg.lr_schedule!r}")
+        warmup = LRWarmup(cfg.learning_rate,
+                          dp if cfg.scale_lr_by_world else 1,
+                          cfg.warmup_epochs)
+        cosine = (CosineDecay(cfg.learning_rate,
+                              dp if cfg.scale_lr_by_world else 1,
+                              cfg.warmup_epochs, cfg.epochs,
+                              cfg.cosine_final_lr_frac)
+                  if cfg.lr_schedule == "cosine" else None)
+        plateau = ReduceLROnPlateau(cfg.plateau_patience, cfg.plateau_factor)
+        early = (EarlyStopping(cfg.early_stop_patience)
+                 if cfg.early_stop_patience else None)
+        if restored_meta and "callbacks" in restored_meta:
+            cb = restored_meta["callbacks"]
+            plateau.load_state_dict(cb["plateau"])
+            if early is not None and "early" in cb:
+                early.load_state_dict(cb["early"])
+
+        if self.run is not None:
+            self.run.log_params(
+                {f"train.{k}": v for k, v in to_dict(cfg).items()})
+            self.run.log_params(
+                {f"lm.{k}": v for k, v in to_dict(self.lm_cfg).items()})
+            self.run.log_params({"mesh": dict(mesh.shape),
+                                 "steps_per_epoch": steps_per_epoch,
+                                 "global_batch": global_batch})
+
+        history: list[dict[str, float]] = []
+        step_rng = jax.random.PRNGKey(cfg.seed + 1)
+        epochs_run = start_epoch
+        resumed = ckpt is not None and resume and start_epoch > 0
+        if cosine is None and start_epoch >= cfg.warmup_epochs and not resumed:
+            # Past warmup: start at the scaled target once; afterwards only
+            # the plateau callback changes the LR. A resumed opt_state
+            # already carries the LR training left off at — don't clobber.
+            state = set_lr(state, warmup.lr_for_epoch(cfg.warmup_epochs))
+        in_warmup = (lambda e: e < cfg.warmup_epochs
+                     and warmup.world_size > 1)
+        # Host-side step counter: folding the device counter into the rng
+        # would force a blocking device_get every step (serializing async
+        # dispatch); the host knows it exactly.
+        host_step = int(jax.device_get(state.step))
+        try:
+            for epoch in range(start_epoch, cfg.epochs):
+                order = np.random.RandomState(cfg.seed + 1 + epoch
+                                              ).permutation(len(train))
+                tlosses, taccs = [], []
+                for i in range(steps_per_epoch):
+                    if cosine is not None:
+                        state = set_lr(
+                            state, cosine.lr_for_step(epoch, i,
+                                                      steps_per_epoch))
+                    elif in_warmup(epoch):
+                        state = set_lr(
+                            state, warmup.lr_for_step(epoch, i,
+                                                      steps_per_epoch))
+                    idx = order[i * global_batch:(i + 1) * global_batch]
+                    batch = train[idx]
+                    state, m = step(state, batch[:, :-1], batch[:, 1:],
+                                    jax.random.fold_in(step_rng, host_step))
+                    host_step += 1
+                    tlosses.append(m["loss"])
+                    taccs.append(m["accuracy"])
+
+                vlosses, vaccs = [], []
+                for i in range(val_steps):
+                    # index modulo the split: every eval batch is exactly
+                    # global_batch (shard_map divisibility) even for tiny
+                    # validation sets
+                    idx = np.arange(i * global_batch,
+                                    (i + 1) * global_batch) % len(val)
+                    vb = val[idx]
+                    vm = eval_step(state, vb[:, :-1], vb[:, 1:])
+                    vlosses.append(vm["loss"])
+                    vaccs.append(vm["accuracy"])
+                row = {
+                    "epoch": epoch,
+                    "loss": float(np.mean(jax.device_get(tlosses))),
+                    "accuracy": float(np.mean(jax.device_get(taccs))),
+                    "val_loss": float(np.mean(jax.device_get(vlosses))),
+                    "val_accuracy": float(np.mean(jax.device_get(vaccs))),
+                    "lr": get_lr(state),
+                }
+                history.append(row)
+                epochs_run = epoch + 1
+                if self.run is not None:
+                    self.run.log_metrics(row, step=epoch)
+
+                # Callback ordering mirrors the vision Trainer: plateau (only
+                # past warmup — a cut fired during warmup would be dropped and
+                # its counter reset) and early-stop consume this epoch's
+                # metrics FIRST, then the checkpoint saves the post-callback
+                # counters/LR — resume = continuation.
+                if cosine is None and epoch + 1 >= cfg.warmup_epochs:
+                    lr_now = get_lr(state)
+                    new_lr = plateau.update(row["val_loss"], lr_now)
+                    if new_lr != lr_now:
+                        state = set_lr(state, new_lr)
+                stop = (early is not None
+                        and early.should_stop(row["val_loss"]))
+                if ckpt and (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+                    callbacks = {"plateau": plateau.state_dict()}
+                    if early is not None:
+                        callbacks["early"] = early.state_dict()
+                    ckpt.save(state, host_step,
+                              metadata={"epoch": epoch,
+                                        "callbacks": callbacks})
+                if stop:
+                    break
+        finally:
+            if ckpt:
+                ckpt.close()
+
+        last = history[-1] if history else {"val_loss": float("nan"),
+                                            "val_accuracy": float("nan")}
+        return LMTrainResult(val_loss=last["val_loss"],
+                             val_accuracy=last["val_accuracy"],
+                             history=history, state=state,
+                             epochs_run=epochs_run)

@@ -64,6 +64,9 @@ def main():
     ap.add_argument("--steps", type=int, default=0)
     ap.add_argument("--speculative", action="store_true",
                     help="also decode via draft-verified speculative rounds")
+    ap.add_argument("--trainer", action="store_true",
+                    help="train via LMTrainer (epochs, checkpoints, tracker, "
+                         "LR schedules) instead of the raw step loop")
     ap.add_argument("overrides", nargs="*", help="lm.key=value / train.key=value")
     args = ap.parse_args()
 
@@ -80,6 +83,28 @@ def main():
     sp = args.seq_devices or max(1, n // 2)
     dp = n // sp
     assert dp * sp == n, f"seq devices {sp} must divide device count {n}"
+
+    if args.trainer:
+        # The managed path: LMTrainer carries the vision Trainer's amenities
+        # (epoch loop, LR schedules, checkpoints, tracker) over the DPxSP
+        # LM step — same contracts, token-array data model.
+        from ddw_tpu.train.lm_trainer import LMTrainer
+
+        rng = np.random.RandomState(train_cfg.seed)
+        seq_len = min(lm_cfg.max_len - 1, 64 * sp) // sp * sp
+        # corpus sized from the mesh: the 0.9 train split must cover at
+        # least one global batch (batch_size * dp) at every dp/sp choice
+        n_seqs = max(96, 3 * train_cfg.batch_size * dp)
+        corpus = synthetic_text(rng, n_seqs, seq_len, lm_cfg.vocab_size)
+        res = LMTrainer(lm_cfg, train_cfg, seq_devices=sp).fit(corpus)
+        for row in res.history:
+            print({k: round(v, 4) if isinstance(v, float) else v
+                   for k, v in row.items()})
+        print(f"trainer: mesh dp={dp} sp={sp} epochs={res.epochs_run} "
+              f"val_loss={res.val_loss:.4f} "
+              f"val_accuracy={res.val_accuracy:.3f}")
+        return
+
     if args.pipeline:
         # GPipe pipeline schedule: stages over a 'pipe' axis (x DP when the
         # mesh is bigger), stage-sharded stacked block params.

@@ -41,6 +41,8 @@ _EXAMPLES = [
      ["--steps", "3", "lm.pos_encoding=rope", "lm.num_kv_heads=2"], "final:"),
     ("07_lm_long_context.py",
      ["--steps", "3", "--speculative"], "speculative: identical"),
+    ("07_lm_long_context.py",
+     ["--trainer", "train.epochs=2"], "trainer: mesh"),
     ("09_lora_finetune.py", [], "base_frozen=True"),
     ("10_fsdp_elastic.py", ["train.epochs=2"], "elastic 8 -> 4"),
 ]
