@@ -1,0 +1,148 @@
+"""Packaged LM artifacts: self-contained scoring + generation directories.
+
+The image side packages a classifier (``serving/package.py`` — the
+``mlflow.pyfunc`` role, reference ``03_pyfunc_distributed_inference.py:
+157-184``); this is the same contract for the LM family (beyond parity — the
+reference has no LM): one directory holding config + weights that any worker
+can load and drive without the training code path.
+
+Layout (mirrors the image package):
+
+    package.json     lm config, format/version metadata, optional quantization
+    params.msgpack   flax params — full precision or int8 weight-only
+                     (``ddw_tpu.serving.quantize``, ~4x smaller artifact)
+
+``LMPackagedModel`` exposes:
+
+- ``score(tokens[B, S+1]) -> nll[B]`` — mean next-token negative
+  log-likelihood per sequence (the batch-scoring primitive; perplexity is
+  ``exp(nll)``);
+- ``generate(prompt, num_steps, ...)`` — the KV-cached decode path with the
+  same sampling controls as :func:`ddw_tpu.models.lm.generate`;
+- ``generate_speculative(draft, prompt, num_steps, k)`` — draft-verified
+  decoding against another packaged model, exact greedy output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from ddw_tpu.models.lm import build_lm, generate
+from ddw_tpu.utils.config import LMCfg
+
+_LM_FORMAT_VERSION = 1
+_LM_FORMAT_VERSION_QUANT = 2
+_SUPPORTED = (_LM_FORMAT_VERSION, _LM_FORMAT_VERSION_QUANT)
+
+
+def save_lm_package(out_dir: str, lm_cfg: LMCfg, params,
+                    extra_meta: dict | None = None,
+                    quantize: str | None = None) -> str:
+    """Write a packaged-LM directory. ``quantize="int8"`` stores kernels as
+    per-output-channel int8 (transparent dequantize at load)."""
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unknown quantize mode {quantize!r}; use 'int8'")
+    reserved = {"kind", "format_version", "lm_cfg", "quantization"}
+    clash = reserved & set(extra_meta or {})
+    if clash:
+        # loud at save time: a clobbered kind/format_version would only be
+        # discovered when the artifact fails to load
+        raise ValueError(f"extra_meta must not override reserved keys "
+                         f"{sorted(clash)}")
+    os.makedirs(out_dir, exist_ok=True)
+    meta = {
+        "kind": "lm",
+        "format_version": _LM_FORMAT_VERSION,
+        "lm_cfg": dataclasses.asdict(lm_cfg),
+        **(extra_meta or {}),
+    }
+    tree = {"params": jax.device_get(params)}
+    if quantize == "int8":
+        from ddw_tpu.serving.quantize import MODE_INT8, quantize_tree
+
+        meta["quantization"] = MODE_INT8
+        meta["format_version"] = _LM_FORMAT_VERSION_QUANT
+        tree = quantize_tree(tree)
+    with open(os.path.join(out_dir, "package.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    with open(os.path.join(out_dir, "params.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(tree))
+    return out_dir
+
+
+class LMPackagedModel:
+    """Self-contained LM scorer/generator loaded from a package directory."""
+
+    def __init__(self, model_dir: str):
+        with open(os.path.join(model_dir, "package.json")) as f:
+            self.meta = json.load(f)
+        if self.meta.get("kind") != "lm":
+            raise ValueError(
+                f"not an LM package (kind={self.meta.get('kind')!r}); image "
+                f"packages load via ddw_tpu.serving.PackagedModel")
+        if self.meta["format_version"] not in _SUPPORTED:
+            raise ValueError(
+                f"unsupported LM package format {self.meta['format_version']}")
+        self.lm_cfg = LMCfg(**{k: (tuple(v) if isinstance(v, list) else v)
+                               for k, v in self.meta["lm_cfg"].items()})
+        self.model = build_lm(self.lm_cfg)
+        with open(os.path.join(model_dir, "params.msgpack"), "rb") as f:
+            blob = f.read()
+        h = hashlib.sha256(blob)
+        h.update(json.dumps(self.meta, sort_keys=True).encode())
+        self.content_digest = h.hexdigest()[:16]
+        restored = serialization.msgpack_restore(blob)
+        quant = self.meta.get("quantization")
+        if quant is not None:
+            from ddw_tpu.serving.quantize import MODE_INT8, dequantize_tree
+
+            if quant != MODE_INT8:
+                raise ValueError(f"unsupported quantization mode {quant!r}")
+            restored = dequantize_tree(restored)
+        self.params = restored["params"]
+
+        def _nll(tokens):
+            inp, tgt = tokens[:, :-1], tokens[:, 1:]
+            logits = self.model.apply({"params": self.params}, inp,
+                                      train=False)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            tok_ll = jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
+            return -jnp.mean(tok_ll, axis=-1)
+
+        self._nll = jax.jit(_nll)
+
+    def score(self, tokens) -> np.ndarray:
+        """Mean next-token NLL per sequence; perplexity = exp(score)."""
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim != 2 or tokens.shape[1] < 2:
+            raise ValueError(f"tokens must be [B, S+1], got {tokens.shape}")
+        if tokens.shape[1] - 1 > self.lm_cfg.max_len:
+            raise ValueError(f"sequence {tokens.shape[1] - 1} exceeds "
+                             f"max_len {self.lm_cfg.max_len}")
+        return np.asarray(self._nll(tokens))
+
+    def generate(self, prompt, num_steps: int, **kw) -> np.ndarray:
+        return np.asarray(generate(self.model, self.params,
+                                   np.asarray(prompt, np.int32),
+                                   num_steps, **kw))
+
+    def generate_speculative(self, draft: "LMPackagedModel", prompt,
+                             num_steps: int, k: int = 4):
+        from ddw_tpu.models.spec_decode import generate_speculative
+
+        out, stats = generate_speculative(
+            self.model, self.params, draft.model, draft.params,
+            np.asarray(prompt, np.int32), num_steps, k=k)
+        return np.asarray(out), stats
+
+
+def load_lm_package(model_dir: str) -> LMPackagedModel:
+    return LMPackagedModel(model_dir)

@@ -63,7 +63,14 @@ def save_packaged_model(
     if quantize not in (None, "int8"):
         raise ValueError(f"unknown quantize mode {quantize!r}; use 'int8'")
     os.makedirs(out_dir, exist_ok=True)
+    reserved = {"kind", "format_version", "model_cfg", "classes",
+                "quantization"}
+    clash = reserved & set(extra_meta or {})
+    if clash:
+        raise ValueError(f"extra_meta must not override reserved keys "
+                         f"{sorted(clash)}")
     meta = {
+        "kind": "image",
         "format_version": _FORMAT_VERSION,
         "model_cfg": dataclasses.asdict(model_cfg),
         "classes": list(classes),
@@ -105,6 +112,13 @@ class PackagedModel:
     def __init__(self, model_dir: str):
         with open(os.path.join(model_dir, "package.json")) as f:
             self.meta = json.load(f)
+        # 'kind' is absent from pre-round-3 image packages — accept those;
+        # refuse packages that declare another kind (e.g. an LM artifact).
+        kind = self.meta.get("kind", "image")
+        if kind != "image":
+            raise ValueError(
+                f"not an image package (kind={kind!r}); LM packages load via "
+                f"ddw_tpu.serving.load_lm_package")
         if self.meta["format_version"] not in _SUPPORTED_VERSIONS:
             raise ValueError(f"unsupported package format {self.meta['format_version']}")
         self.model_cfg = ModelCfg(**self.meta["model_cfg"])

@@ -30,17 +30,12 @@ import numpy as np
 from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.models.lm import build_lm
 from ddw_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS, MeshSpec, make_mesh
-from ddw_tpu.train.callbacks import (
-    CosineDecay,
-    EarlyStopping,
-    LRWarmup,
-    ReduceLROnPlateau,
-)
 from ddw_tpu.train.lm_step import (
     init_lm_state,
     make_lm_eval_step,
     make_lm_train_step,
 )
+from ddw_tpu.train.schedule import ScheduleSuite
 from ddw_tpu.train.step import TrainState, get_lr, make_optimizer, set_lr
 from ddw_tpu.utils.config import LMCfg, TrainCfg, to_dict
 
@@ -74,6 +69,9 @@ class LMTrainer:
             if train_cfg.num_devices:
                 devices = devices[: train_cfg.num_devices]
             n = len(devices)
+            if seq_devices < 1:
+                raise ValueError(f"seq_devices must be >= 1, got "
+                                 f"{seq_devices}")
             if n % seq_devices:
                 raise ValueError(f"seq_devices {seq_devices} must divide "
                                  f"device count {n}")
@@ -136,24 +134,7 @@ class LMTrainer:
                 start_epoch = int(at_step) // steps_per_epoch
                 restored_meta = ckpt.read_metadata(at_step)
 
-        if cfg.lr_schedule not in ("plateau", "cosine"):
-            raise ValueError(f"unknown train.lr_schedule {cfg.lr_schedule!r}")
-        warmup = LRWarmup(cfg.learning_rate,
-                          dp if cfg.scale_lr_by_world else 1,
-                          cfg.warmup_epochs)
-        cosine = (CosineDecay(cfg.learning_rate,
-                              dp if cfg.scale_lr_by_world else 1,
-                              cfg.warmup_epochs, cfg.epochs,
-                              cfg.cosine_final_lr_frac)
-                  if cfg.lr_schedule == "cosine" else None)
-        plateau = ReduceLROnPlateau(cfg.plateau_patience, cfg.plateau_factor)
-        early = (EarlyStopping(cfg.early_stop_patience)
-                 if cfg.early_stop_patience else None)
-        if restored_meta and "callbacks" in restored_meta:
-            cb = restored_meta["callbacks"]
-            plateau.load_state_dict(cb["plateau"])
-            if early is not None and "early" in cb:
-                early.load_state_dict(cb["early"])
+        sched = ScheduleSuite.build(cfg, dp, restored_meta)
 
         if self.run is not None:
             self.run.log_params(
@@ -168,13 +149,7 @@ class LMTrainer:
         step_rng = jax.random.PRNGKey(cfg.seed + 1)
         epochs_run = start_epoch
         resumed = ckpt is not None and resume and start_epoch > 0
-        if cosine is None and start_epoch >= cfg.warmup_epochs and not resumed:
-            # Past warmup: start at the scaled target once; afterwards only
-            # the plateau callback changes the LR. A resumed opt_state
-            # already carries the LR training left off at — don't clobber.
-            state = set_lr(state, warmup.lr_for_epoch(cfg.warmup_epochs))
-        in_warmup = (lambda e: e < cfg.warmup_epochs
-                     and warmup.world_size > 1)
+        state = sched.initial_state(state, start_epoch, resumed)
         # Host-side step counter: folding the device counter into the rng
         # would force a blocking device_get every step (serializing async
         # dispatch); the host knows it exactly.
@@ -185,14 +160,9 @@ class LMTrainer:
                                               ).permutation(len(train))
                 tlosses, taccs = [], []
                 for i in range(steps_per_epoch):
-                    if cosine is not None:
-                        state = set_lr(
-                            state, cosine.lr_for_step(epoch, i,
-                                                      steps_per_epoch))
-                    elif in_warmup(epoch):
-                        state = set_lr(
-                            state, warmup.lr_for_step(epoch, i,
-                                                      steps_per_epoch))
+                    lr = sched.lr_for_batch(epoch, i, steps_per_epoch)
+                    if lr is not None:
+                        state = set_lr(state, lr)
                     idx = order[i * global_batch:(i + 1) * global_batch]
                     batch = train[idx]
                     state, m = step(state, batch[:, :-1], batch[:, 1:],
@@ -225,25 +195,14 @@ class LMTrainer:
                 if self.run is not None:
                     self.run.log_metrics(row, step=epoch)
 
-                # Callback ordering mirrors the vision Trainer: plateau (only
-                # past warmup — a cut fired during warmup would be dropped and
-                # its counter reset) and early-stop consume this epoch's
-                # metrics FIRST, then the checkpoint saves the post-callback
-                # counters/LR — resume = continuation.
-                if cosine is None and epoch + 1 >= cfg.warmup_epochs:
-                    lr_now = get_lr(state)
-                    new_lr = plateau.update(row["val_loss"], lr_now)
-                    if new_lr != lr_now:
-                        state = set_lr(state, new_lr)
-                stop = (early is not None
-                        and early.should_stop(row["val_loss"]))
+                # Callbacks consume this epoch's metrics FIRST, then the
+                # checkpoint saves the post-callback counters/LR — resume =
+                # continuation (ScheduleSuite holds the ordering rules).
+                state, stop = sched.epoch_end(state, row["val_loss"], epoch)
                 if ckpt and (epoch + 1) % cfg.checkpoint_every_epochs == 0:
-                    callbacks = {"plateau": plateau.state_dict()}
-                    if early is not None:
-                        callbacks["early"] = early.state_dict()
                     ckpt.save(state, host_step,
                               metadata={"epoch": epoch,
-                                        "callbacks": callbacks})
+                                        "callbacks": sched.state_dicts()})
                 if stop:
                     break
         finally:

@@ -38,8 +38,7 @@ from ddw_tpu.data.store import Table
 from ddw_tpu.models.registry import build_model
 from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
 from ddw_tpu.tracking.tracker import Run
-from ddw_tpu.train.callbacks import (CosineDecay, EarlyStopping, LRWarmup,
-                                     ReduceLROnPlateau)
+from ddw_tpu.train.schedule import ScheduleSuite
 from ddw_tpu.train.step import (
     TrainState,
     batch_sharding,
@@ -255,26 +254,9 @@ class Trainer:
             # already-sharded state)
             state = train_step.place_state(state)
 
-        if cfg.lr_schedule not in ("plateau", "cosine"):
-            raise ValueError(f"unknown train.lr_schedule {cfg.lr_schedule!r}; "
-                             f"use 'plateau' or 'cosine'")
-        warmup = LRWarmup(cfg.learning_rate, world if cfg.scale_lr_by_world else 1,
-                          cfg.warmup_epochs)
-        cosine = None
-        if cfg.lr_schedule == "cosine":
-            cosine = CosineDecay(cfg.learning_rate,
-                                 world if cfg.scale_lr_by_world else 1,
-                                 cfg.warmup_epochs, cfg.epochs,
-                                 cfg.cosine_final_lr_frac)
-        plateau = ReduceLROnPlateau(cfg.plateau_patience, cfg.plateau_factor)
-        early = EarlyStopping(cfg.early_stop_patience) if cfg.early_stop_patience else None
-        if restored_meta and "callbacks" in restored_meta:
-            # Resumed patience counters: an interrupted-then-resumed run tracks
-            # the uninterrupted one metric-for-metric (test_resume pins it).
-            cb = restored_meta["callbacks"]
-            plateau.load_state_dict(cb["plateau"])
-            if early is not None and "early" in cb:
-                early.load_state_dict(cb["early"])
+        # warmup/cosine/plateau/early + counter restore, shared with the LM
+        # trainer (train/schedule.py holds the ordering/resume rules)
+        sched = ScheduleSuite.build(cfg, world, restored_meta)
 
         if self.run is not None:
             self.run.log_params({f"train.{k}": v for k, v in to_dict(cfg).items()})
@@ -304,14 +286,7 @@ class Trainer:
             epochs_run = 0
             tracing = False
             resumed = ckpt is not None and resume and start_epoch > 0
-            if cosine is None and start_epoch >= cfg.warmup_epochs and not resumed:
-                # Past warmup (incl. warmup_epochs=0): start at the scaled target once;
-                # afterwards only the plateau callback may change the LR. On resume the
-                # restored opt_state already carries the LR training left off at
-                # (including plateau reductions) — don't clobber it; the plateau/
-                # early-stop counters were restored from checkpoint metadata above.
-                state = set_lr(state, warmup.lr_for_epoch(cfg.warmup_epochs))
-            in_warmup = lambda e: e < cfg.warmup_epochs and warmup.world_size > 1  # noqa: E731
+            state = sched.initial_state(state, start_epoch, resumed)
             try:
                 for epoch in range(start_epoch, cfg.epochs):
                     if cfg.trace_dir and epoch == start_epoch and jax.process_index() == 0:
@@ -325,19 +300,15 @@ class Trainer:
                     t0 = time.time()
                     losses, accs = [], []
                     for step_i in range(steps_per_epoch):
-                        if cosine is not None:
-                            # Stateless per-batch schedule: warmup ramp then
-                            # half-cycle decay; resume recomputes from
-                            # (epoch, step) alone.
-                            state = set_lr(
-                                state,
-                                cosine.lr_for_step(epoch, step_i, steps_per_epoch))
-                        elif in_warmup(epoch):
-                            # Per-batch gradual LR scaling (Goyal et al.), the Horovod
-                            # warmup-callback granularity (reference :314-318). set_lr is
-                            # a dynamic-hyperparameter write — no recompilation.
-                            state = set_lr(
-                                state, warmup.lr_for_step(epoch, step_i, steps_per_epoch))
+                        # Per-batch LR: cosine everywhere, or the Goyal warmup
+                        # ramp (Horovod warmup-callback granularity, reference
+                        # :314-318); None past warmup in the plateau regime.
+                        # set_lr is a dynamic-hyperparameter write — no
+                        # recompilation.
+                        lr_b = sched.lr_for_batch(epoch, step_i,
+                                                  steps_per_epoch)
+                        if lr_b is not None:
+                            state = set_lr(state, lr_b)
                         images, labels = next(train_iter)
                         state, metrics = train_step(state, images, labels, step_rng)
                         losses.append(metrics["loss"])
@@ -389,11 +360,7 @@ class Trainer:
 
                     # LR-plateau AFTER metrics are world-consistent (ordering contract,
                     # reference :310-313 — trivially satisfied: metrics are pmean-ed in-step)
-                    if cosine is None and epoch + 1 >= cfg.warmup_epochs:
-                        new_lr = plateau.update(val_loss, lr)
-                        if new_lr != lr:
-                            state = set_lr(state, new_lr)
-                    stop = early is not None and early.should_stop(val_loss)
+                    state, stop = sched.epoch_end(state, val_loss, epoch)
                     if self._on_epoch is not None and self._on_epoch(row):
                         stop = True
 
@@ -401,13 +368,10 @@ class Trainer:
                     # so the saved counters (and any plateau LR cut) are exactly the
                     # state the next epoch starts from — resume = continuation.
                     if ckpt and ((epoch + 1) % cfg.checkpoint_every_epochs == 0):
-                        callbacks = {"plateau": plateau.state_dict()}
-                        if early is not None:
-                            callbacks["early"] = early.state_dict()
                         ckpt.save(state, int(jax.device_get(state.step)),
                                   metadata={"epoch": epoch, "val_loss": val_loss,
                                             "val_accuracy": val_acc,
-                                            "callbacks": callbacks})
+                                            "callbacks": sched.state_dicts()})
                     if stop:
                         break
 

@@ -90,6 +90,12 @@ def main():
         # LM step — same contracts, token-array data model.
         from ddw_tpu.train.lm_trainer import LMTrainer
 
+        if args.moe:
+            lm_cfg.num_experts = args.moe  # MoE composes with the trainer
+        if args.pipeline:
+            raise SystemExit("--trainer drives the DPxSP step; --pipeline "
+                             "uses the GPipe path — pick one")
+
         rng = np.random.RandomState(train_cfg.seed)
         seq_len = min(lm_cfg.max_len - 1, 64 * sp) // sp * sp
         # corpus sized from the mesh: the 0.9 train split must cover at

@@ -1,0 +1,103 @@
+"""Packaged LM artifacts: roundtrip identity, int8 variant, generation,
+speculative decode from packages, format guards."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddw_tpu.models.lm import build_lm, generate
+from ddw_tpu.serving.lm_package import (
+    LMPackagedModel,
+    load_lm_package,
+    save_lm_package,
+)
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 32
+
+
+def _trained(seed=0):
+    cfg = LMCfg(vocab_size=VOCAB, max_len=64, hidden=32, depth=2,
+                num_heads=2, mlp_dim=64, dropout=0.0, dtype="float32")
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(seed)},
+                        np.zeros((1, 8), np.int32))["params"]
+    return cfg, model, params
+
+
+def _tokens(n=4, seq=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, VOCAB, size=(n, seq + 1)).astype(np.int32)
+
+
+def test_roundtrip_scores_and_generation_match(tmp_path):
+    cfg, model, params = _trained()
+    d = save_lm_package(str(tmp_path / "pkg"), cfg, params)
+    pm = load_lm_package(d)
+    toks = _tokens()
+
+    # score == direct NLL from the source model
+    inp, tgt = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+    logits = model.apply({"params": params}, inp, train=False)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    ref = -np.mean(np.take_along_axis(np.asarray(logp),
+                                      toks[:, 1:, None], -1)[..., 0], -1)
+    np.testing.assert_allclose(pm.score(toks), ref, rtol=1e-6, atol=1e-6)
+
+    # generation == source-model greedy
+    ref_gen = np.asarray(generate(model, params, toks[:1, :8], num_steps=8))
+    np.testing.assert_array_equal(pm.generate(toks[:1, :8], 8), ref_gen)
+    assert len(pm.content_digest) == 16
+
+
+def test_int8_package_close_and_smaller(tmp_path):
+    cfg, model, params = _trained()
+    d32 = save_lm_package(str(tmp_path / "f32"), cfg, params)
+    d8 = save_lm_package(str(tmp_path / "i8"), cfg, params, quantize="int8")
+    s32 = os.path.getsize(os.path.join(d32, "params.msgpack"))
+    s8 = os.path.getsize(os.path.join(d8, "params.msgpack"))
+    assert s8 < 0.45 * s32, (s8, s32)
+    toks = _tokens()
+    nll32 = load_lm_package(d32).score(toks)
+    nll8 = load_lm_package(d8).score(toks)
+    np.testing.assert_allclose(nll8, nll32, rtol=0.05, atol=0.05)
+
+
+def test_speculative_from_packages(tmp_path):
+    cfg, model, params = _trained(seed=0)
+    dcfg, dmodel, dparams = _trained(seed=7)
+    t = save_lm_package(str(tmp_path / "t"), cfg, params)
+    d = save_lm_package(str(tmp_path / "d"), dcfg, dparams)
+    target, draft = load_lm_package(t), load_lm_package(d)
+    prompt = _tokens(1, 8)[:, :8]
+    out, stats = target.generate_speculative(draft, prompt, num_steps=8, k=3)
+    np.testing.assert_array_equal(out, target.generate(prompt, 8))
+    assert stats["rounds"] >= 1
+
+
+def test_format_guards(tmp_path):
+    cfg, model, params = _trained()
+    d = save_lm_package(str(tmp_path / "pkg"), cfg, params)
+    # image loader must not open LM packages and vice versa — both sides
+    # diagnose by the 'kind' field
+    from ddw_tpu.serving.package import PackagedModel
+
+    with pytest.raises(ValueError, match="not an image package"):
+        PackagedModel(d)
+    with pytest.raises(ValueError, match="reserved keys"):
+        save_lm_package(str(tmp_path / "z"), cfg, params,
+                        extra_meta={"kind": "my-lm"})
+    meta = json.load(open(os.path.join(d, "package.json")))
+    meta["kind"] = "image"
+    json.dump(meta, open(os.path.join(d, "package.json"), "w"))
+    with pytest.raises(ValueError, match="not an LM package"):
+        LMPackagedModel(d)
+    with pytest.raises(ValueError, match="quantize"):
+        save_lm_package(str(tmp_path / "x"), cfg, params, quantize="int4")
+    pm = load_lm_package(save_lm_package(str(tmp_path / "y"), cfg, params))
+    with pytest.raises(ValueError, match="exceeds"):
+        pm.score(_tokens(1, 128))

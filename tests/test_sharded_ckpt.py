@@ -125,3 +125,68 @@ def test_structure_mismatch_raises(tmp_path):
     bad_sh = jax.tree.map(lambda _: repl, state)
     with pytest.raises(ValueError, match="shape"):
         restore_sharded(d, bad_target, bad_sh)
+
+
+def _random_tree(rng, n_leaves):
+    """Random nested pytree of arrays: mixed ranks, dtypes, odd shapes."""
+    dtypes = [np.float32, np.float16, np.int32, np.uint8]
+    tree = {}
+    for i in range(n_leaves):
+        rank = rng.randint(0, 4)
+        shape = tuple(int(rng.choice([1, 2, 3, 4, 6, 8, 12, 16]))
+                      for _ in range(rank))
+        dt = dtypes[rng.randint(len(dtypes))]
+        arr = (rng.randn(*shape) * 10).astype(dt) if shape else \
+            np.asarray(rng.randn() * 10, dt)
+        # nest every third leaf one level deeper
+        if i % 3 == 2:
+            tree.setdefault(f"sub{i % 5}", {})[f"leaf{i}"] = arr
+        else:
+            tree[f"leaf{i}"] = arr
+    return tree
+
+
+def _random_shardings(rng, tree, mesh, axis):
+    """Random per-leaf shardings: shard a random divisible dim or replicate."""
+    n = mesh.shape[axis]
+
+    def sh(leaf):
+        shape = tuple(leaf.shape)
+        cands = [d for d, s in enumerate(shape) if s % n == 0 and s >= n]
+        if cands and rng.rand() < 0.7:
+            spec = [None] * len(shape)
+            spec[cands[rng.randint(len(cands))]] = axis
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(sh, tree)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_roundtrip_random_trees_and_shardings(tmp_path, seed):
+    """Property: ANY pytree under ANY mix of replicated/sharded leaves
+    round-trips bit-exact, including restoring onto a different mesh size
+    and different (re-randomized) shardings."""
+    rng = np.random.RandomState(seed)
+    mesh8 = make_mesh(MeshSpec(((DATA_AXIS, 8),)), devices=jax.devices()[:8])
+    tree = _random_tree(rng, n_leaves=12)
+    sh8 = _random_shardings(rng, tree, mesh8, DATA_AXIS)
+    placed = jax.tree.map(
+        lambda x, s: jax.make_array_from_callback(x.shape, s,
+                                                  lambda idx: x[idx]),
+        tree, sh8)
+    save_sharded(str(tmp_path), placed, step=seed)
+
+    # restore 1: same mesh, same shardings
+    r1, at = restore_sharded(str(tmp_path), tree, sh8)
+    assert at == seed
+    _assert_trees_equal(tree, r1)
+
+    # restore 2: half the devices, fresh random shardings (elastic reshard)
+    mesh4 = make_mesh(MeshSpec(((DATA_AXIS, 4),)), devices=jax.devices()[:4])
+    sh4 = _random_shardings(np.random.RandomState(seed + 100), tree, mesh4,
+                            DATA_AXIS)
+    r2, _ = restore_sharded(str(tmp_path), tree, sh4)
+    _assert_trees_equal(tree, r2)
+    for leaf, s in zip(jax.tree.leaves(r2), jax.tree.leaves(sh4)):
+        assert leaf.sharding == s
