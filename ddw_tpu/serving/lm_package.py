@@ -26,14 +26,10 @@ Layout (mirrors the image package):
 from __future__ import annotations
 
 import dataclasses
-import hashlib
-import json
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from flax import serialization
 
 from ddw_tpu.models.lm import build_lm, generate
 from ddw_tpu.utils.config import LMCfg
@@ -48,8 +44,8 @@ def save_lm_package(out_dir: str, lm_cfg: LMCfg, params,
                     quantize: str | None = None) -> str:
     """Write a packaged-LM directory. ``quantize="int8"`` stores kernels as
     per-output-channel int8 (transparent dequantize at load)."""
-    if quantize not in (None, "int8"):
-        raise ValueError(f"unknown quantize mode {quantize!r}; use 'int8'")
+    from ddw_tpu.serving.package import write_package_dir
+
     reserved = {"kind", "format_version", "lm_cfg", "quantization"}
     clash = reserved & set(extra_meta or {})
     if clash:
@@ -57,7 +53,6 @@ def save_lm_package(out_dir: str, lm_cfg: LMCfg, params,
         # discovered when the artifact fails to load
         raise ValueError(f"extra_meta must not override reserved keys "
                          f"{sorted(clash)}")
-    os.makedirs(out_dir, exist_ok=True)
     meta = {
         "kind": "lm",
         "format_version": _LM_FORMAT_VERSION,
@@ -65,48 +60,22 @@ def save_lm_package(out_dir: str, lm_cfg: LMCfg, params,
         **(extra_meta or {}),
     }
     tree = {"params": jax.device_get(params)}
-    if quantize == "int8":
-        from ddw_tpu.serving.quantize import MODE_INT8, quantize_tree
-
-        meta["quantization"] = MODE_INT8
-        meta["format_version"] = _LM_FORMAT_VERSION_QUANT
-        tree = quantize_tree(tree)
-    with open(os.path.join(out_dir, "package.json"), "w") as f:
-        json.dump(meta, f, indent=2)
-    with open(os.path.join(out_dir, "params.msgpack"), "wb") as f:
-        f.write(serialization.to_bytes(tree))
-    return out_dir
+    return write_package_dir(out_dir, meta, tree, quantize,
+                             _LM_FORMAT_VERSION_QUANT)
 
 
 class LMPackagedModel:
     """Self-contained LM scorer/generator loaded from a package directory."""
 
     def __init__(self, model_dir: str):
-        with open(os.path.join(model_dir, "package.json")) as f:
-            self.meta = json.load(f)
-        if self.meta.get("kind") != "lm":
-            raise ValueError(
-                f"not an LM package (kind={self.meta.get('kind')!r}); image "
-                f"packages load via ddw_tpu.serving.PackagedModel")
-        if self.meta["format_version"] not in _SUPPORTED:
-            raise ValueError(
-                f"unsupported LM package format {self.meta['format_version']}")
+        from ddw_tpu.serving.package import read_package_dir
+
+        self.meta, restored, self.content_digest = read_package_dir(
+            model_dir, "lm", _SUPPORTED,
+            "image packages load via ddw_tpu.serving.PackagedModel")
         self.lm_cfg = LMCfg(**{k: (tuple(v) if isinstance(v, list) else v)
                                for k, v in self.meta["lm_cfg"].items()})
         self.model = build_lm(self.lm_cfg)
-        with open(os.path.join(model_dir, "params.msgpack"), "rb") as f:
-            blob = f.read()
-        h = hashlib.sha256(blob)
-        h.update(json.dumps(self.meta, sort_keys=True).encode())
-        self.content_digest = h.hexdigest()[:16]
-        restored = serialization.msgpack_restore(blob)
-        quant = self.meta.get("quantization")
-        if quant is not None:
-            from ddw_tpu.serving.quantize import MODE_INT8, dequantize_tree
-
-            if quant != MODE_INT8:
-                raise ValueError(f"unsupported quantization mode {quant!r}")
-            restored = dequantize_tree(restored)
         self.params = restored["params"]
 
         def _nll(tokens):
@@ -127,6 +96,12 @@ class LMPackagedModel:
         if tokens.shape[1] - 1 > self.lm_cfg.max_len:
             raise ValueError(f"sequence {tokens.shape[1] - 1} exceeds "
                              f"max_len {self.lm_cfg.max_len}")
+        # jnp gathers clamp out-of-bounds indices, which would silently score
+        # a padding/sentinel id as the nearest vocab row
+        if tokens.min() < 0 or tokens.max() >= self.lm_cfg.vocab_size:
+            raise ValueError(
+                f"token ids outside [0, {self.lm_cfg.vocab_size}): "
+                f"min={tokens.min()}, max={tokens.max()}")
         return np.asarray(self._nll(tokens))
 
     def generate(self, prompt, num_steps: int, **kw) -> np.ndarray:

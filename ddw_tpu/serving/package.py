@@ -44,6 +44,61 @@ _SUPPORTED_VERSIONS = (1, 2)
 _PREDICT_BATCH = 128  # reference :64
 
 
+def write_package_dir(out_dir: str, meta: dict, tree, quantize: str | None,
+                      quant_version: int) -> str:
+    """Shared artifact-writing protocol (image + LM packages): quantization
+    gate, package.json, params.msgpack. ``meta`` must already carry
+    ``kind``/``format_version``; int8 rewrites ``format_version`` to
+    ``quant_version`` so pre-quantization readers reject cleanly."""
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unknown quantize mode {quantize!r}; use 'int8'")
+    os.makedirs(out_dir, exist_ok=True)
+    if quantize == "int8":
+        from ddw_tpu.serving.quantize import MODE_INT8, quantize_tree
+
+        meta = dict(meta, quantization=MODE_INT8,
+                    format_version=quant_version)
+        tree = quantize_tree(tree)
+    with open(os.path.join(out_dir, "package.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    with open(os.path.join(out_dir, "params.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(tree))
+    return out_dir
+
+
+def read_package_dir(model_dir: str, expected_kind: str,
+                     supported_versions: tuple,
+                     other_kind_hint: str) -> tuple[dict, dict, str]:
+    """Shared artifact-reading protocol: kind/version gates, sha256 content
+    digest over blob+meta, msgpack restore, transparent dequantize. ``kind``
+    is absent from pre-round-3 image packages — treated as 'image'.
+    Returns ``(meta, restored_tree, content_digest)``."""
+    import hashlib
+
+    with open(os.path.join(model_dir, "package.json")) as f:
+        meta = json.load(f)
+    kind = meta.get("kind", "image")
+    if kind != expected_kind:
+        raise ValueError(f"not an {expected_kind} package (kind={kind!r}); "
+                         f"{other_kind_hint}")
+    if meta["format_version"] not in supported_versions:
+        raise ValueError(
+            f"unsupported package format {meta['format_version']}")
+    with open(os.path.join(model_dir, "params.msgpack"), "rb") as f:
+        blob = f.read()
+    h = hashlib.sha256(blob)
+    h.update(json.dumps(meta, sort_keys=True).encode())
+    restored = serialization.msgpack_restore(blob)
+    quant = meta.get("quantization")
+    if quant is not None:
+        from ddw_tpu.serving.quantize import MODE_INT8, dequantize_tree
+
+        if quant != MODE_INT8:
+            raise ValueError(f"unsupported quantization mode {quant!r}")
+        restored = dequantize_tree(restored)
+    return meta, restored, h.hexdigest()[:16]
+
+
 def save_packaged_model(
     out_dir: str,
     model_cfg: ModelCfg,
@@ -60,11 +115,9 @@ def save_packaged_model(
     order). ``quantize="int8"`` stores kernels as per-channel int8 (~4x
     smaller artifact; see :mod:`ddw_tpu.serving.quantize`) — loading
     dequantizes transparently."""
-    if quantize not in (None, "int8"):
-        raise ValueError(f"unknown quantize mode {quantize!r}; use 'int8'")
-    os.makedirs(out_dir, exist_ok=True)
     reserved = {"kind", "format_version", "model_cfg", "classes",
-                "quantization"}
+                "quantization", "img_height", "img_width",
+                "preprocess_impl"}
     clash = reserved & set(extra_meta or {})
     if clash:
         raise ValueError(f"extra_meta must not override reserved keys "
@@ -83,18 +136,8 @@ def save_packaged_model(
     }
     tree = {"params": jax.device_get(params),
             "batch_stats": jax.device_get(batch_stats or {})}
-    if quantize == "int8":
-        from ddw_tpu.serving.quantize import MODE_INT8, quantize_tree
-
-        meta["quantization"] = MODE_INT8
-        meta["format_version"] = _FORMAT_VERSION_QUANT
-        tree = quantize_tree(tree)
-    with open(os.path.join(out_dir, "package.json"), "w") as f:
-        json.dump(meta, f, indent=2)
-    blob = serialization.to_bytes(tree)
-    with open(os.path.join(out_dir, "params.msgpack"), "wb") as f:
-        f.write(blob)
-    return out_dir
+    return write_package_dir(out_dir, meta, tree, quantize,
+                             _FORMAT_VERSION_QUANT)
 
 
 def load_packaged_model(model_dir: str) -> "PackagedModel":
@@ -110,17 +153,12 @@ class PackagedModel:
     """
 
     def __init__(self, model_dir: str):
-        with open(os.path.join(model_dir, "package.json")) as f:
-            self.meta = json.load(f)
-        # 'kind' is absent from pre-round-3 image packages — accept those;
-        # refuse packages that declare another kind (e.g. an LM artifact).
-        kind = self.meta.get("kind", "image")
-        if kind != "image":
-            raise ValueError(
-                f"not an image package (kind={kind!r}); LM packages load via "
-                f"ddw_tpu.serving.load_lm_package")
-        if self.meta["format_version"] not in _SUPPORTED_VERSIONS:
-            raise ValueError(f"unsupported package format {self.meta['format_version']}")
+        # content_digest: identity of this packaged model (weights + meta) —
+        # lets shared-nothing scorers agree on a run token without
+        # communicating.
+        self.meta, restored, self.content_digest = read_package_dir(
+            model_dir, "image", _SUPPORTED_VERSIONS,
+            "LM packages load via ddw_tpu.serving.load_lm_package")
         self.model_cfg = ModelCfg(**self.meta["model_cfg"])
         self.classes: list[str] = self.meta["classes"]
         self.height, self.width = self.meta["img_height"], self.meta["img_width"]
@@ -134,23 +172,6 @@ class PackagedModel:
                 f"decoded pixels differ slightly (train/serve preprocessing "
                 f"skew)", stacklevel=2)
         self.model = build_model(self.model_cfg)
-        with open(os.path.join(model_dir, "params.msgpack"), "rb") as f:
-            blob = f.read()
-        # Content identity of this packaged model (weights + meta): lets
-        # shared-nothing scorers agree on a run token without communicating.
-        import hashlib
-
-        h = hashlib.sha256(blob)
-        h.update(json.dumps(self.meta, sort_keys=True).encode())
-        self.content_digest = h.hexdigest()[:16]
-        restored = serialization.msgpack_restore(blob)
-        quant = self.meta.get("quantization")
-        if quant is not None:
-            from ddw_tpu.serving.quantize import MODE_INT8, dequantize_tree
-
-            if quant != MODE_INT8:
-                raise ValueError(f"unsupported quantization mode {quant!r}")
-            restored = dequantize_tree(restored)
         self.params = restored["params"]
         self.batch_stats = restored.get("batch_stats") or {}
         self._apply = jax.jit(self._apply_fn)

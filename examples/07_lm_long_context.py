@@ -95,6 +95,12 @@ def main():
         if args.pipeline:
             raise SystemExit("--trainer drives the DPxSP step; --pipeline "
                              "uses the GPipe path — pick one")
+        if args.speculative or args.steps:
+            raise SystemExit("--trainer runs epochs, not --steps, and skips "
+                             "the generation demos — use train.epochs=N, and "
+                             "run --speculative without --trainer (or see "
+                             "examples/11_lm_lifecycle.py for the packaged "
+                             "speculative path)")
 
         rng = np.random.RandomState(train_cfg.seed)
         seq_len = min(lm_cfg.max_len - 1, 64 * sp) // sp * sp

@@ -45,6 +45,8 @@ _EXAMPLES = [
      ["--trainer", "train.epochs=2"], "trainer: mesh"),
     ("09_lora_finetune.py", [], "base_frozen=True"),
     ("10_fsdp_elastic.py", ["train.epochs=2"], "elastic 8 -> 4"),
+    ("11_lm_lifecycle.py", ["train.epochs=2"], "model_prefers_structure=True"),
+    ("11_lm_lifecycle.py", ["--int8", "train.epochs=2"], "int8 weight-only"),
 ]
 
 

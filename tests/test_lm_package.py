@@ -94,7 +94,7 @@ def test_format_guards(tmp_path):
     meta = json.load(open(os.path.join(d, "package.json")))
     meta["kind"] = "image"
     json.dump(meta, open(os.path.join(d, "package.json"), "w"))
-    with pytest.raises(ValueError, match="not an LM package"):
+    with pytest.raises(ValueError, match="not an lm package"):
         LMPackagedModel(d)
     with pytest.raises(ValueError, match="quantize"):
         save_lm_package(str(tmp_path / "x"), cfg, params, quantize="int4")

@@ -45,8 +45,13 @@ def _state_bytes(state) -> int:
 
 
 def _assert_trees_equal(a, b):
-    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
-        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert jax.tree.structure(a) == jax.tree.structure(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype, (x.dtype, y.dtype)  # bit-exact incl. dtype
+        np.testing.assert_array_equal(x, y)
 
 
 def test_save_restore_roundtrip_zero_sharded(tmp_path):
