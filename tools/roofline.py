@@ -89,6 +89,46 @@ def model_floor(name: str, specs: list, batch: int, mode: str,
 PARAMS = {"mobilenet_v2": 2.26e6, "resnet50": 23.6e6}
 
 
+def transformer_floor(name: str, *, batch: int, seq: int, hidden: int,
+                      depth: int, mlp_dim: int, vocab: int,
+                      mode: str = "fwdbwd") -> dict:
+    """Analytic floor for the matmul-dominated transformer rows (ViT / LM).
+
+    Per block: qkv+out projections (4·S·H² MACs), attention score+value
+    matmuls (2·S²·H), MLP (2·S·H·mlp). Bytes: weights + activations once per
+    pass (weights dominate at small batch·seq; activations at long S).
+    Softmax/LN/residuals are assumed fused (zero extra HBM). Head/vocab
+    matmul included; bwd = 2x fwd flops, ~2.5x fwd bytes (the conv model's
+    accounting). A deliberately optimistic ceiling, like the conv version.
+    """
+    t = batch * seq
+    per_block_macs = (4 * t * hidden * hidden           # qkv + out proj
+                     + 2 * batch * seq * seq * hidden   # scores + values
+                     + 2 * t * hidden * mlp_dim)        # mlp fc1+fc2
+    head_macs = t * hidden * vocab
+    fwd_flops = 2 * (depth * per_block_macs + head_macs)
+    w_bytes = 2 * (depth * (4 * hidden * hidden + 2 * hidden * mlp_dim)
+                   + hidden * vocab)
+    act_bytes = 2 * t * hidden * (depth * 6 + 2)  # block in/out + qkv + mlp
+    if mode == "fwd":
+        flops, bts = fwd_flops, w_bytes + act_bytes
+        t_opt = 0.0
+    else:
+        flops = 3 * fwd_flops
+        bts = 3 * w_bytes + 2.5 * act_bytes
+        # Adam stream, same accounting as model_floor: read p,m,v,g + write
+        # p,m,v in f32 (w_bytes counts bf16 weights, so params = w_bytes/2)
+        t_opt = 7 * (w_bytes / 2) * 4 / (HBM_GBPS * 1e9)
+    t_mxu = flops / (PEAK_TFLOPS * 1e12)
+    t_hbm = bts / (HBM_GBPS * 1e9)
+    floor = max(t_mxu, t_hbm) + t_opt
+    return {"name": name, "floor_ms": floor * 1e3, "flops": flops,
+            "bytes": bts,
+            "mfu_ceiling": flops / floor / (PEAK_TFLOPS * 1e12),
+            "bound": "mem" if t_hbm > t_mxu else "mxu",
+            "ai": flops / bts}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=256)
@@ -126,6 +166,24 @@ def main():
             for k, (ms, fl, bt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
                 print(f"    {k:<12}{ms:>8.2f} ms  {fl/1e9:>7.0f} GF "
                       f"{bt/1e9:>6.2f} GB  AI {fl/max(bt,1):>5.0f}")
+
+    # The transformer rows at bench.py's fixed shapes: the in-tree ViT mean-
+    # pools 196 patch tokens (no CLS — models/vit.py), the LM runs seq 2048.
+    # Matmul-dominated, so the ceilings sit near peak — the honest contrast
+    # with the conv models' memory-bound ~10%.
+    print(f"\n{'transformer rows (bench shapes)':<42}{'floor ms':>9}"
+          f"{'GFLOP':>8}{'GB':>7}{'MFU ceil':>9}{'bound':>9}{'AI':>7}")
+    for r in (
+        transformer_floor("vit (224², p16, S=196, b256)", batch=256,
+                          seq=196, hidden=192, depth=6,
+                          mlp_dim=768, vocab=5),
+        transformer_floor("lm (S=2048, h512, d6, b8)", batch=8, seq=2048,
+                          hidden=512, depth=6, mlp_dim=2048,
+                          vocab=8192),
+    ):
+        print(f"{r['name']:<42}{r['floor_ms']:>9.2f}{r['flops']/1e9:>8.0f}"
+              f"{r['bytes']/1e9:>7.2f}{r['mfu_ceiling']*100:>8.1f}%"
+              f"{r['bound']:>9}{r['ai']:>7.0f}")
 
 
 if __name__ == "__main__":
