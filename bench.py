@@ -566,7 +566,31 @@ def _device_problem(timeout_s: float = 240.0) -> str | None:
             "possible; see BASELINE.md for the last recorded matrix")
 
 
+# Static matrix names: DDW_BENCH_ONLY validates against these BEFORE any
+# device init, so a typo'd queue item fails on attempt 1 without consuming a
+# tunnel window.
+_CONFIG_NAMES = ("mobilenet_v2_frozen", "mobilenet_v2_frozen_feature_cache",
+                 "mobilenet_v2_unfrozen", "resnet50", "vit", "lm_flash",
+                 "lm_moe", "packaged_infer")
+
+
 def main():
+    only = [s for s in os.environ.get("DDW_BENCH_ONLY", "").split(",") if s]
+    unknown = sorted(set(only) - set(_CONFIG_NAMES))
+    if unknown:
+        # same one-JSON-line contract as every other failure path: a typo'd
+        # config name must leave a parseable record, not a bare traceback
+        print(json.dumps({
+            "metric": "mobilenet_v2_frozen_train_images_per_sec_per_chip",
+            "value": None,
+            "unit": "images/sec/chip",
+            "vs_baseline": None,
+            "error": f"DDW_BENCH_ONLY names unknown configs {unknown}; "
+                     f"have {sorted(_CONFIG_NAMES)}",
+        }))
+        sys.stdout.flush()
+        sys.exit(2)
+
     problem = _device_problem()
     if problem:
         print(json.dumps({
@@ -612,8 +636,10 @@ def main():
         "packaged_infer": lambda: bench_packaged_infer(
             batch=batch, img=img, peak=peak),
     }
+    assert set(matrix) == set(_CONFIG_NAMES), (
+        "matrix drifted from _CONFIG_NAMES — update both")
     only = [s for s in os.environ.get("DDW_BENCH_ONLY", "").split(",") if s]
-    if only:
+    if only:  # names validated against _CONFIG_NAMES at the top of main
         matrix = {k: v for k, v in matrix.items() if k in only}
 
     configs: dict = {}
