@@ -574,22 +574,28 @@ _CONFIG_NAMES = ("mobilenet_v2_frozen", "mobilenet_v2_frozen_feature_cache",
                  "lm_moe", "packaged_infer")
 
 
+def _json_error_exit(message: str, code: int) -> None:
+    """The one-JSON-line failure contract every exit path honors."""
+    print(json.dumps({
+        "metric": "mobilenet_v2_frozen_train_images_per_sec_per_chip",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": message,
+    }))
+    sys.stdout.flush()
+    sys.exit(code)
+
+
 def main():
-    only = [s for s in os.environ.get("DDW_BENCH_ONLY", "").split(",") if s]
+    only = [s.strip() for s in os.environ.get("DDW_BENCH_ONLY", "").split(",")
+            if s.strip()]
     unknown = sorted(set(only) - set(_CONFIG_NAMES))
     if unknown:
-        # same one-JSON-line contract as every other failure path: a typo'd
-        # config name must leave a parseable record, not a bare traceback
-        print(json.dumps({
-            "metric": "mobilenet_v2_frozen_train_images_per_sec_per_chip",
-            "value": None,
-            "unit": "images/sec/chip",
-            "vs_baseline": None,
-            "error": f"DDW_BENCH_ONLY names unknown configs {unknown}; "
-                     f"have {sorted(_CONFIG_NAMES)}",
-        }))
-        sys.stdout.flush()
-        sys.exit(2)
+        # a typo'd config name must leave a parseable record, not a bare
+        # traceback — and must fail BEFORE device init
+        _json_error_exit(f"DDW_BENCH_ONLY names unknown configs {unknown}; "
+                         f"have {sorted(_CONFIG_NAMES)}", 2)
 
     problem = _device_problem()
     if problem:
@@ -636,9 +642,10 @@ def main():
         "packaged_infer": lambda: bench_packaged_infer(
             batch=batch, img=img, peak=peak),
     }
-    assert set(matrix) == set(_CONFIG_NAMES), (
-        "matrix drifted from _CONFIG_NAMES — update both")
-    only = [s for s in os.environ.get("DDW_BENCH_ONLY", "").split(",") if s]
+    if set(matrix) != set(_CONFIG_NAMES):  # not assert: -O strips, and the
+        _json_error_exit(                  # contract wants JSON, not a trace
+            f"bench.py bug: matrix {sorted(matrix)} drifted from "
+            f"_CONFIG_NAMES {sorted(_CONFIG_NAMES)} — update both", 2)
     if only:  # names validated against _CONFIG_NAMES at the top of main
         matrix = {k: v for k, v in matrix.items() if k in only}
 
