@@ -202,6 +202,11 @@ def _time_steps(run_n) -> tuple[float, int]:
     N) — i.e. the time N steps take.
     """
     n = 2 if SMOKE else 8
+    if CHAIN == "scan":
+        # The scan runner executes whole SCAN_CHUNK megasteps, so n must be a
+        # multiple of SCAN_CHUNK. Round up here (doubling preserves it) rather
+        # than relying on the starting n and SCAN_CHUNK staying equal.
+        n = -(-n // SCAN_CHUNK) * SCAN_CHUNK
     while True:
         dt = run_n(2 * n) - run_n(n)
         _beat()

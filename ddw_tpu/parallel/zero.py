@@ -203,6 +203,13 @@ def _global_microbatches(x, accum: int, mesh: Mesh, axis: str):
         raise ValueError(f"global batch {b} not divisible by "
                          f"grad_accum_steps {accum}")
     mb = b // accum
+    n_dev = mesh.shape[axis]
+    if mb % n_dev:
+        raise ValueError(
+            f"microbatch size {mb} (global batch {b} / grad_accum_steps "
+            f"{accum}) not divisible by the '{axis}' axis size {n_dev}; the "
+            f"interleaved split would force uneven sharding instead of the "
+            f"device-local transpose this path guarantees")
     x = jnp.moveaxis(x.reshape(mb, accum, *x.shape[1:]), 1, 0)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(None, axis)))

@@ -23,6 +23,7 @@ seeded permutation, mirroring the reference's seed-42 split discipline.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 
 import jax
 import numpy as np
@@ -134,6 +135,28 @@ class LMTrainer:
                 start_epoch = int(at_step) // steps_per_epoch
                 restored_meta = ckpt.read_metadata(at_step)
 
+        if ckpt and resume and start_epoch > 0 and start_epoch >= cfg.epochs:
+            # The restored checkpoint already covers every requested epoch —
+            # the loop below would not run and the result would silently be
+            # NaN. Surface the checkpoint's own last metrics so callers
+            # gating on val_loss see the real numbers.
+            saved = (restored_meta or {}).get("metrics")
+            ckpt.close()
+            if saved is None:
+                raise ValueError(
+                    f"resume=True restored a checkpoint at epoch "
+                    f"{start_epoch} >= cfg.epochs={cfg.epochs}, and it "
+                    f"predates metric metadata; raise cfg.epochs above "
+                    f"{start_epoch} to continue training, or retrain")
+            warnings.warn(
+                f"resume=True restored a checkpoint at epoch {start_epoch} "
+                f">= cfg.epochs={cfg.epochs}; the run is already complete — "
+                f"returning the checkpointed metrics, no training performed")
+            return LMTrainResult(val_loss=saved["val_loss"],
+                                 val_accuracy=saved["val_accuracy"],
+                                 history=[saved], state=state,
+                                 epochs_run=start_epoch)
+
         sched = ScheduleSuite.build(cfg, dp, restored_meta)
 
         if self.run is not None:
@@ -202,7 +225,8 @@ class LMTrainer:
                 if ckpt and (epoch + 1) % cfg.checkpoint_every_epochs == 0:
                     ckpt.save(state, host_step,
                               metadata={"epoch": epoch,
-                                        "callbacks": sched.state_dicts()})
+                                        "callbacks": sched.state_dicts(),
+                                        "metrics": row})
                 if stop:
                     break
         finally:

@@ -59,6 +59,23 @@ def test_checkpoint_resume_continues(tmp_path):
     assert res4.history[0]["epoch"] == 2
 
 
+def test_resume_already_complete_returns_checkpointed_metrics(tmp_path):
+    """resume=True on a checkpoint that already covers cfg.epochs must not
+    silently return NaN: it warns and returns the checkpoint's own last
+    metrics (saved in metadata at checkpoint time)."""
+    lm, tr = _cfgs(num_devices=4, epochs=2,
+                   checkpoint_dir=str(tmp_path / "ck"),
+                   checkpoint_every_epochs=1)
+    res = LMTrainer(lm, tr).fit(_tokens())
+    assert res.epochs_run == 2
+    with pytest.warns(UserWarning, match="already complete"):
+        res2 = LMTrainer(lm, tr).fit(_tokens(), resume=True)
+    assert res2.epochs_run == 2
+    assert np.isfinite(res2.val_loss)
+    assert res2.val_loss == pytest.approx(res.val_loss, abs=1e-6)
+    assert res2.val_accuracy == pytest.approx(res.val_accuracy, abs=1e-6)
+
+
 def test_cosine_schedule_and_early_stop():
     lm, tr = _cfgs(num_devices=4, lr_schedule="cosine", epochs=4,
                    early_stop_patience=1)

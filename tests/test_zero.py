@@ -162,6 +162,17 @@ def test_zero_grad_accum_matches_single_shot():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_zero_accum_microbatch_must_divide_data_axis():
+    """batch % accum == 0 is not enough: the microbatch (b//accum) must also
+    divide the data axis, else the P(None, axis) constraint pads unevenly and
+    the device-local-transpose property silently breaks. Refuse loudly."""
+    mesh, m, state, tx = _setup(4)
+    step = make_zero_train_step(m, tx, mesh, donate=False, grad_accum_steps=4)
+    imgs, lbls = _batch(8)  # 8 % 4 == 0 but microbatch 2 < 4 devices
+    with pytest.raises(ValueError, match="axis size 4"):
+        step(step.place_state(state), imgs, lbls, jax.random.PRNGKey(1))
+
+
 def test_trainer_zero_with_ema(tmp_path, silver):
     """train.zero=true + ema_decay (refusal removed): the Polyak shadow is
     param-shaped opt_state, so the generic ZeRO leaf sharding covers it —
