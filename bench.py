@@ -135,8 +135,39 @@ if CHAIN not in ("loop", "scan"):
 SCAN_CHUNK = 2 if SMOKE else 8
 
 
-def _chained_runner(step, compiled, state, args):
+class _SetupHeartbeat:
+    """Beat periodically through a long setup phase (table prep, featurize,
+    eager init) that has no natural per-compile beat points. This blinds the
+    stall watchdog to a genuine wedge DURING setup — acceptable because setup
+    produces no partial matrix worth emitting and the queue's outer ``timeout``
+    is the wedge backstop; the watchdog's job is guarding the measurement
+    phase, which this context manager must never wrap."""
+
+    def __init__(self, note: str, period_s: float = 60.0):
+        self._note, self._period = note, period_s
+        self._stop = threading.Event()
+
+    def __enter__(self):
+        def beat_loop():
+            while not self._stop.wait(self._period):
+                _beat(f"{self._note}: setup in progress")
+        self._t = threading.Thread(target=beat_loop, daemon=True)
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join()
+        return False
+
+
+def _chained_runner(step, compiled, state, args, next_batch=None):
     """Build ``run_n`` for :func:`_time_steps` over a train step.
+
+    ``next_batch()`` (optional) supplies fresh leading step arguments per
+    step — the loader-fed e2e rows. Those rows are host-loop by construction
+    (a lax.scan cannot pull host batches), so ``next_batch`` forces the loop
+    arm whatever ``DDW_BENCH_CHAIN`` says.
 
     ``DDW_BENCH_CHAIN=loop`` (default) dispatches every step from the host —
     steps pipeline asynchronously, so on a healthy backend the device never
@@ -152,12 +183,13 @@ def _chained_runner(step, compiled, state, args):
     cannot be called under tracing and serves the 'loop' arm + FLOP count.
     """
     holder = {"state": state}
-    if CHAIN == "loop":
+    if CHAIN == "loop" or next_batch is not None:
         def run_n(n):
             st = holder["state"]
             t0 = time.perf_counter()
             for _ in range(n):
-                st, m = compiled(st, *args)
+                a = (*next_batch(), *args) if next_batch else args
+                st, m = compiled(st, *a)
             np.asarray(m["loss"])  # forced D2H: true completion barrier
             holder["state"] = st
             return time.perf_counter() - t0
@@ -414,6 +446,131 @@ def bench_head_features(*, batch: int, feature_dim: int,
     return row
 
 
+def bench_e2e_loader(*, kind: str, batch: int, img: tuple,
+                     peak: float | None) -> dict:
+    """End-to-end loader-fed training: table on disk -> ShardedLoader -> chip.
+
+    The synthetic rows measure the train step alone; this row measures the
+    SYSTEM the reference's Petastorm converter feeds (``make_tf_dataset`` ->
+    ``fit``, ``03_model_training_distributed.py:332-337``): records read from
+    the sharded table store, batches assembled on host threads, transferred on
+    the loader's prefetch thread (uint8 for ``raw_u8`` — 4x smaller H2D,
+    dequantized on device), and consumed by the SAME jitted train step the
+    synthetic row times. The e2e/synthetic ratio is the whole input-pipeline
+    tax; BASELINE.md's host-pipeline section predicts ~1.0 for these
+    materialized paths and ~1/65 for live JPEG decode on this 1-core host.
+
+    ``kind='raw_u8'``: pre-decoded pixel table (``prep.materialize_decoded``)
+    feeding the frozen-MobileNetV2 step — compare ``mobilenet_v2_frozen``.
+    ``kind='feature_cache'``: pooled-feature table
+    (``transfer.materialize_features``) feeding the head-only step — compare
+    ``mobilenet_v2_frozen_feature_cache``.
+
+    The table lives under a deterministic tempdir and is reused across
+    attempts (prep is one-time host work; a tunnel-window retry must not
+    re-pay it). Records cycle (infinite loader repeat), so host page cache
+    serves the reads — stated in the row (``table_records``); this measures
+    the assemble+transfer+step system, not cold disk.
+    """
+    import tempfile
+    import warnings
+
+    from ddw_tpu.data.loader import ShardedLoader
+    from ddw_tpu.data.prep import (generate_synthetic_flowers,
+                                   materialize_decoded, prepare_flowers)
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
+    from ddw_tpu.train.step import (TrainState, batch_sharding, init_state,
+                                    make_optimizer, make_train_step,
+                                    replicated_sharding)
+    from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+    if kind not in ("raw_u8", "feature_cache"):
+        raise ValueError(f"kind must be 'raw_u8' or 'feature_cache', got {kind!r}")
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)), devices=devices)
+    global_batch = batch * n_chips
+    h, w, _ = img
+
+    per_class = 8 if SMOKE else 128
+    root = os.path.join(tempfile.gettempdir(), f"ddw_e2e_{h}x{w}_{per_class}")
+    store = TableStore(os.path.join(root, "store"))
+    train_cfg = TrainCfg(batch_size=batch, optimizer="adam", learning_rate=1e-3)
+
+    # Setup (prep, eager init, featurize — cold compiles and whole-table
+    # forwards with no natural beat points) runs under a periodic heartbeat;
+    # the queue's outer timeout is the wedge backstop for this phase.
+    with _SetupHeartbeat(f"e2e {kind}"):
+        if not store.exists("silver_train"):
+            generate_synthetic_flowers(os.path.join(root, "jpegs"),
+                                       images_per_class=per_class, size=h)
+            prepare_flowers(os.path.join(root, "jpegs"), store,
+                            sample_fraction=1.0)
+        silver = store.table("silver_train")
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # frozen-random warning: speed only
+            mcfg = ModelCfg(name="mobilenet_v2", num_classes=5, dropout=0.5,
+                            freeze_base=True, allow_frozen_random=True,
+                            dtype="bfloat16")
+            full = build_model(mcfg)
+            full_state, full_tx = init_state(full, mcfg, train_cfg, img,
+                                             jax.random.PRNGKey(0))
+
+        if kind == "raw_u8":
+            name = f"raw_{h}x{w}"
+            if not store.exists(name):
+                materialize_decoded(silver, store, name, h, w)
+            table = store.table(name)
+            model, state, tx = full, full_state, full_tx
+        else:
+            from ddw_tpu.train.transfer import TransferHead, materialize_features
+
+            table = materialize_features(  # cached: fingerprint + freshness
+                full, full_state.params, full_state.batch_stats, silver, store,
+                f"feats_{h}x{w}", (h, w))
+            model = TransferHead(num_classes=5, dropout=0.5)
+            params = model.init(
+                {"params": jax.random.PRNGKey(0)},
+                jnp.zeros((1, table.meta["feature_dim"])), train=False)["params"]
+            tx = make_optimizer(train_cfg)
+            state = TrainState(params, {}, tx.init(params),
+                               jnp.zeros((), jnp.int32))
+    _beat(f"e2e {kind}: setup done ({table.num_records} records)")
+
+    data_sh = batch_sharding(mesh, DATA_AXIS)
+    loader = ShardedLoader(table, batch_size=global_batch, image_size=(h, w),
+                           num_epochs=None, shuffle=True, workers=4,
+                           prefetch=4, prefetch_to=data_sh)
+    it = iter(loader)
+    step = make_train_step(model, tx, mesh, DATA_AXIS, donate=True)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    key = jax.random.PRNGKey(1)
+
+    imgs, lbls = next(it)
+    compiled = step.lower(state, imgs, lbls, key).compile()
+    _beat(f"e2e {kind}: compiled")
+    flops = _compiled_flops(compiled)
+    state, metrics = compiled(state, imgs, lbls, key)  # warmup
+    np.asarray(metrics["loss"])
+
+    run_n = _chained_runner(step, compiled, state, (key,),
+                            next_batch=lambda: next(it))
+
+    dt, measured = _time_steps(run_n)
+    row = _row(global_batch, n_chips, dt, measured, flops, peak,
+               "images/sec/chip")
+    # The loader feeds per-step from the host: this row is host-loop by
+    # construction, whatever DDW_BENCH_CHAIN says.
+    row["chain"] = "loop"
+    row.update(batch_per_chip=batch, encoding=kind,
+               table_records=table.num_records, pipeline="loader_prefetch")
+    return row
+
+
 def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
              vocab: int, peak: float | None, num_experts: int = 0) -> dict:
     import optax
@@ -576,7 +733,7 @@ def _device_problem(timeout_s: float = 240.0) -> str | None:
 # tunnel window.
 _CONFIG_NAMES = ("mobilenet_v2_frozen", "mobilenet_v2_frozen_feature_cache",
                  "mobilenet_v2_unfrozen", "resnet50", "vit", "lm_flash",
-                 "lm_moe", "packaged_infer")
+                 "lm_moe", "packaged_infer", "e2e_raw_u8", "e2e_feature_cache")
 
 
 def _json_error_exit(message: str, code: int) -> None:
@@ -646,6 +803,10 @@ def main():
         "lm_moe": lambda: bench_lm(**lm_kw, num_experts=8),
         "packaged_infer": lambda: bench_packaged_infer(
             batch=batch, img=img, peak=peak),
+        "e2e_raw_u8": lambda: bench_e2e_loader(
+            kind="raw_u8", batch=batch, img=img, peak=peak),
+        "e2e_feature_cache": lambda: bench_e2e_loader(
+            kind="feature_cache", batch=batch, img=img, peak=peak),
     }
     if set(matrix) != set(_CONFIG_NAMES):  # not assert: -O strips, and the
         _json_error_exit(                  # contract wants JSON, not a trace

@@ -47,7 +47,8 @@ def test_matrix_rows(bench_json):
     configs = bench_json["configs"]
     for name in ("mobilenet_v2_frozen", "mobilenet_v2_frozen_feature_cache",
                  "mobilenet_v2_unfrozen", "resnet50",
-                 "vit", "lm_flash", "lm_moe"):
+                 "vit", "lm_flash", "lm_moe",
+                 "e2e_raw_u8", "e2e_feature_cache"):
         row = configs[name]
         assert "error" not in row, f"{name}: {row}"
         assert row["rate_per_chip"] > 0
@@ -57,6 +58,13 @@ def test_matrix_rows(bench_json):
         if row["step_flops"]:
             assert row["achieved_tflops_per_chip"] > 0
     assert configs["lm_flash"]["unit"] == "tokens/sec/chip"
+    # e2e rows measure the loader-fed system: always host-loop, and they
+    # must say what fed them (encoding + table size, for the honest caveat).
+    for name in ("e2e_raw_u8", "e2e_feature_cache"):
+        row = configs[name]
+        assert row["chain"] == "loop"
+        assert row["pipeline"] == "loader_prefetch"
+        assert row["table_records"] > 0
 
 
 def test_flops_ordering(bench_json):

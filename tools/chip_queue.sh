@@ -77,7 +77,7 @@ run_item() {
 log "runner started pid=$$"
 while :; do
   all_done=1
-  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan conv_profile_mn conv_profile_rn ab_conv fa2_sweep packaged_infer packaged_infer_int8; do
+  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan e2e_loader conv_profile_mn conv_profile_rn ab_conv fa2_sweep packaged_infer packaged_infer_int8; do
     [ -f "$LOGDIR/$name.done" ] || { [ -f "$LOGDIR/$name.attempts" ] && [ "$(cat "$LOGDIR/$name.attempts")" -ge "$MAX_ATTEMPTS" ]; } || all_done=0
   done
   if [ "$all_done" -eq 1 ]; then
@@ -97,6 +97,9 @@ while :; do
     # fast while the loop row is slow, the window-1 frozen regression was the
     # tunnel's dispatch rate, not the device.
     run_item mn_frozen_scan  "DDW_BENCH_STALL_S=900 DDW_BENCH_CHAIN=scan DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_frozen_feature_cache python -u bench.py" || continue
+    # End-to-end loader-fed rows (VERDICT r3 item 3): the Petastorm-role
+    # system number — table -> ShardedLoader prefetch -> train step.
+    run_item e2e_loader      "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=e2e_raw_u8,e2e_feature_cache python -u bench.py" || continue
     run_item conv_profile_mn "python -u tools/conv_profile.py mobilenet_v2" || continue
     ITEM_TIMEOUT=5400 run_item conv_profile_rn "python -u tools/conv_profile.py resnet50" || continue
     run_item ab_conv         "DDW_BENCH_STALL_S=900 DDW_BENCH_S2D=1 DDW_BENCH_DW=pallas DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_unfrozen,resnet50 python -u bench.py" || continue
