@@ -12,7 +12,10 @@ import numpy as np
 import optax
 
 from ddw_tpu.models.lm import TransformerLM
+import pytest
+
 from ddw_tpu.parallel.pipeline import (
+    bubble_fraction,
     init_pp_state,
     lm_params_from_pp,
     make_pp_lm_train_step,
@@ -44,13 +47,19 @@ def test_pp_params_roundtrip():
                  base.params, back)
 
 
-def test_pp_train_step_matches_single_device():
-    """One pipelined step (4 stages x 4 microbatches) == one plain DP=1 step:
-    identical loss, accuracy, and updated params."""
+@pytest.mark.parametrize("schedule,m,v", [
+    ("gpipe", 2, 1), ("gpipe", 4, 1), ("gpipe", 8, 1),   # microbatch scaling
+    ("interleaved", 2, 2), ("interleaved", 4, 2),
+])
+def test_pp_train_step_matches_single_device(schedule, m, v):
+    """One pipelined step == one plain DP=1 step: identical loss, accuracy,
+    and updated params — across microbatch counts (m in {2,4,8}, GPipe) and
+    the interleaved virtual-stage schedule. Microbatching + masking +
+    ppermute hops are pure plumbing whatever the schedule."""
     n = 4
     mesh_pp = make_mesh(MeshSpec((("pipe", n),)), devices=jax.devices()[:n])
     mesh_1 = make_mesh(MeshSpec(((DATA_AXIS, 1),)), devices=jax.devices()[:1])
-    model = tiny_lm(depth=4)
+    model = tiny_lm(depth=8)
     tx = optax.sgd(1e-1)
     rng = np.random.RandomState(0)
     inputs, targets = _batch(rng, b=8, s=16)
@@ -60,19 +69,80 @@ def test_pp_train_step_matches_single_device():
                                   donate=False)
     ref_new, ref_m = ref_step(ref_state, inputs, targets, jax.random.PRNGKey(2))
 
-    pp_state = init_pp_state(model, tx, mesh_pp, jax.random.PRNGKey(1))
-    step = make_pp_lm_train_step(model, tx, mesh_pp, num_microbatches=4,
-                                 donate=False)
+    pp_state = init_pp_state(model, tx, mesh_pp, jax.random.PRNGKey(1),
+                             virtual_stages=v)
+    step = make_pp_lm_train_step(model, tx, mesh_pp, num_microbatches=m,
+                                 donate=False, schedule=schedule,
+                                 virtual_stages=v)
     pp_state = step.place_state(pp_state)
     pp_new, pp_m = step(pp_state, inputs, targets)
 
     assert abs(float(pp_m["loss"]) - float(ref_m["loss"])) < 1e-5
     assert abs(float(pp_m["accuracy"]) - float(ref_m["accuracy"])) < 1e-6
-    got = lm_params_from_pp(jax.device_get(pp_new.params), 4, model.depth)
+    assert float(pp_m["pp_bubble_fraction"]) == pytest.approx(
+        bubble_fraction(n, m, v))
+    got = lm_params_from_pp(jax.device_get(pp_new.params), n, model.depth, v)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
         got, jax.device_get(ref_new.params))
+
+
+def test_interleaved_roundtrip_and_layout():
+    """[v, n, bpc, ...] round-robin chunk layout round-trips exactly, and the
+    placed stage leaves shard P(None, 'pipe')."""
+    n, v = 4, 2
+    model = tiny_lm(depth=8)
+    base = init_lm_state(model, optax.sgd(0.1), jax.random.PRNGKey(0))
+    pp = pp_params_from_lm(base.params, n, 8, virtual_stages=v)
+    back = lm_params_from_pp(pp, n, 8, virtual_stages=v)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 base.params, back)
+
+    mesh = make_mesh(MeshSpec((("pipe", n),)), devices=jax.devices()[:n])
+    tx = optax.adam(1e-3)
+    state = init_pp_state(model, tx, mesh, jax.random.PRNGKey(0),
+                          virtual_stages=v)
+    step = make_pp_lm_train_step(model, tx, mesh, num_microbatches=2,
+                                 donate=False, schedule="interleaved",
+                                 virtual_stages=v)
+    state = step.place_state(state)
+    leaf = jax.tree.leaves(state.params["stages"])[0]
+    assert leaf.sharding.spec == jax.sharding.PartitionSpec(None, "pipe")
+
+
+def test_interleaved_bubble_smaller_and_refusals():
+    """The interleaved schedule's analytic bubble beats GPipe's at equal m;
+    m > n and schedule typos refuse loudly."""
+    assert bubble_fraction(4, 4, 2) == pytest.approx(3 / 11)
+    assert bubble_fraction(4, 4, 1) == pytest.approx(3 / 7)
+    assert bubble_fraction(4, 4, 2) < bubble_fraction(4, 4, 1)
+    # more virtual stages -> smaller bubble, monotonically
+    assert (bubble_fraction(4, 4, 4) < bubble_fraction(4, 4, 2)
+            < bubble_fraction(4, 4, 1))
+
+    n = 4
+    mesh = make_mesh(MeshSpec((("pipe", n),)), devices=jax.devices()[:n])
+    model = tiny_lm(depth=8)
+    tx = optax.sgd(0.1)
+    with pytest.raises(ValueError, match="stall-free"):
+        make_pp_lm_train_step(model, tx, mesh, num_microbatches=8,
+                              schedule="interleaved", virtual_stages=2)
+    with pytest.raises(ValueError, match="schedule"):
+        make_pp_lm_train_step(model, tx, mesh, schedule="1f1b")
+    with pytest.raises(ValueError, match="virtual_stages"):
+        make_pp_lm_train_step(model, tx, mesh, num_microbatches=2,
+                              schedule="interleaved", virtual_stages=3)
+    # the analytic helper shares the constructor's validity domain
+    with pytest.raises(ValueError, match="stall-free"):
+        bubble_fraction(4, 20, 2)
+    # a v=1 state fed to an interleaved step refuses at placement, not with
+    # an opaque sharding error deep inside the schedule
+    state_v1 = init_pp_state(model, tx, mesh, jax.random.PRNGKey(0))
+    istep = make_pp_lm_train_step(model, tx, mesh, num_microbatches=2,
+                                  schedule="interleaved", virtual_stages=2)
+    with pytest.raises(ValueError, match="layout mismatch"):
+        istep.place_state(state_v1)
 
 
 def test_pp_stage_params_actually_sharded():
