@@ -1,5 +1,8 @@
 from ddw_tpu.runtime.mesh import (  # noqa: F401
+    HybridMeshSpec,
     MeshSpec,
+    device_slice_index,
+    make_hybrid_mesh,
     make_mesh,
     initialize_distributed,
     process_index,

@@ -35,6 +35,27 @@ SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
 
 
+def _resolve_sizes(sizes: list[int], total: int, kind: str,
+                   what: str) -> list[int]:
+    """Shared wildcard algebra: one -1 absorbs the remainder; the product
+    must come out to ``total``."""
+    wild = [k for k, s in enumerate(sizes) if s == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one {kind} size may be -1")
+    prod = int(np.prod([s for s in sizes if s != -1]))
+    if wild:
+        if total % prod:
+            raise ValueError(f"{what} not divisible by fixed {kind} "
+                             f"sizes {sizes}")
+        sizes = list(sizes)
+        sizes[wild[0]] = total // prod
+        prod = total
+    if prod != total:
+        raise ValueError(f"{kind} sizes {sizes} multiply to {prod}, "
+                         f"expected {what}")
+    return sizes
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Logical mesh shape by axis name. Size -1 means "absorb remaining devices"."""
@@ -42,17 +63,9 @@ class MeshSpec:
     axes: tuple[tuple[str, int], ...] = ((DATA_AXIS, -1),)
 
     def resolve(self, n_devices: int) -> tuple[tuple[str, int], ...]:
-        fixed = [(a, s) for a, s in self.axes if s != -1]
-        wild = [a for a, s in self.axes if s == -1]
-        if len(wild) > 1:
-            raise ValueError("at most one axis may be -1")
-        prod = int(np.prod([s for _, s in fixed])) if fixed else 1
-        if n_devices % prod:
-            raise ValueError(f"{n_devices} devices not divisible by fixed axes {fixed}")
-        out = []
-        for a, s in self.axes:
-            out.append((a, n_devices // prod if s == -1 else s))
-        return tuple(out)
+        sizes = _resolve_sizes([s for _, s in self.axes], n_devices,
+                               "axis", f"{n_devices} devices")
+        return tuple((a, s) for (a, _), s in zip(self.axes, sizes))
 
 
 def initialize_distributed(
@@ -102,6 +115,122 @@ def local_device_count() -> int:
 
 def global_device_count() -> int:
     return jax.device_count()
+
+
+@dataclasses.dataclass(frozen=True)
+class HybridMeshSpec:
+    """Slice-aware mesh shape for multi-slice / multi-pod topologies.
+
+    Each axis is ``(name, dcn_size, ici_size)``: the axis's extent across
+    slices (DCN — the slow inter-slice network) times its extent within a
+    slice (ICI). The realized mesh axis has size ``dcn_size * ici_size``,
+    laid out slice-major: along that axis, consecutive devices sit in the
+    same slice and the slice boundary is the largest stride — so XLA's
+    hierarchical collectives ride ICI inside a slice and cross DCN only at
+    the outermost step (the "data axis outermost over DCN" recipe of the
+    scaling playbook; reference's multi-machine analog:
+    ``03_model_training_distributed.py:258-263``).
+
+    Latency-sensitive axes refuse to cross slices: ``model`` (Megatron
+    all-reduces inside every layer) and ``seq`` (per-block ring hops) raise
+    if given ``dcn_size != 1`` — cross-slice TP/SP turns every layer into a
+    DCN round-trip. ``data`` (one gradient reduction per step, amortized)
+    and ``pipe`` (one activation hop per microbatch, the classic weak-link
+    axis) may span slices.
+
+    ``-1`` is allowed once among the dcn sizes (absorb remaining slices) and
+    once among the ici sizes (absorb remaining per-slice devices).
+    """
+
+    axes: tuple[tuple[str, int, int], ...] = ((DATA_AXIS, -1, -1),)
+
+    _DCN_REFUSED = (MODEL_AXIS, SEQ_AXIS)
+
+    def resolve(self, n_slices: int,
+                per_slice: int) -> tuple[tuple[str, int, int], ...]:
+        dcn_sizes = _resolve_sizes([d for _, d, _ in self.axes], n_slices,
+                                   "dcn", f"{n_slices} slices")
+        ici_sizes = _resolve_sizes([i for _, _, i in self.axes], per_slice,
+                                   "ici", f"{per_slice} devices per slice")
+        # Refuse AFTER wildcard resolution: a -1 that resolves to 1 (single
+        # slice) is legal anywhere.
+        for (name, _, _), dcn in zip(self.axes, dcn_sizes):
+            if name in self._DCN_REFUSED and dcn != 1:
+                raise ValueError(
+                    f"axis {name!r} with dcn_size={dcn} would put per-layer "
+                    f"collectives on the inter-slice network — cross-slice "
+                    f"tensor/sequence parallelism is refused; keep "
+                    f"{name!r} inside one slice (dcn_size=1) and span "
+                    f"slices with 'data' or 'pipe'")
+        return tuple((name, d, i) for (name, _, _), d, i
+                     in zip(self.axes, dcn_sizes, ici_sizes))
+
+
+def device_slice_index(d: jax.Device) -> int:
+    """Which slice (pod unit connected by ICI) a device belongs to.
+
+    Real multi-slice TPU backends expose ``slice_index``; elsewhere (CPU
+    meshes, single-slice TPUs) fall back to the owning process — which is
+    exactly right for the CPU stand-in where each launcher process plays
+    one slice, and harmless on a single slice (every device maps to 0 or
+    its host; equal-sized groups still form).
+    """
+    idx = getattr(d, "slice_index", None)
+    if idx is not None:
+        return int(idx)
+    return int(d.process_index)
+
+
+def make_hybrid_mesh(
+    spec: HybridMeshSpec | Sequence[tuple[str, int, int]] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+    slice_index_fn=None,
+) -> Mesh:
+    """Build a DCN-aware :class:`Mesh` over a multi-slice topology.
+
+    Devices group into slices via ``slice_index_fn`` (default
+    :func:`device_slice_index`); slices must be equal-sized. Each mesh axis
+    realizes as ``dcn_size * ici_size`` laid out slice-major (see
+    :class:`HybridMeshSpec`); within a slice, ``mesh_utils`` picks the
+    ICI-friendly device order.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = HybridMeshSpec()
+    if not isinstance(spec, HybridMeshSpec):
+        spec = HybridMeshSpec(tuple(spec))
+    fn = slice_index_fn or device_slice_index
+    groups: dict[int, list[jax.Device]] = {}
+    for d in devices:
+        groups.setdefault(fn(d), []).append(d)
+    sizes = {len(g) for g in groups.values()}
+    if len(sizes) > 1:
+        raise ValueError(f"unequal slices: {sorted((k, len(g)) for k, g in groups.items())}")
+    n_slices, per_slice = len(groups), sizes.pop()
+    shape = spec.resolve(n_slices, per_slice)
+    dcn_dims = tuple(d for _, d, _ in shape)
+    ici_dims = tuple(i for _, _, i in shape)
+
+    def inner(slice_devices):
+        try:
+            from jax.experimental import mesh_utils
+
+            return mesh_utils.create_device_mesh(
+                ici_dims, devices=list(slice_devices))
+        except Exception:
+            return np.asarray(list(slice_devices)).reshape(ici_dims)
+
+    ordered = [groups[k] for k in sorted(groups)]
+    # [*dcn_dims, *ici_dims] -> interleave (d_j, i_j) pairs -> fuse each pair:
+    # along every realized axis, same-slice devices are consecutive and the
+    # slice boundary is the outermost stride.
+    arr = np.stack([inner(g) for g in ordered]).reshape(
+        (*dcn_dims, *ici_dims))
+    k = len(shape)
+    arr = np.transpose(arr, [a for j in range(k) for a in (j, k + j)])
+    arr = arr.reshape([d * i for d, i in zip(dcn_dims, ici_dims)])
+    return Mesh(arr, tuple(name for name, _, _ in shape))
 
 
 def make_mesh(
