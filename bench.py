@@ -340,6 +340,33 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
     return row
 
 
+def throwaway_image_package(tmp: str, img: tuple, quantize=None):
+    """Frozen-random bf16 MobileNetV2 packaged into ``tmp`` and loaded back —
+    the ONE serving fixture both ``bench_packaged_infer`` and
+    ``tools/serving_curve.py`` measure, so their numbers describe the same
+    artifact. Returns the loaded :class:`PackagedModel`."""
+    import warnings
+
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.serving.package import PackagedModel, save_packaged_model
+    from ddw_tpu.utils.config import ModelCfg
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # frozen-random warning: speed only
+        mcfg = ModelCfg(name="mobilenet_v2", num_classes=5, dropout=0.0,
+                        freeze_base=True, allow_frozen_random=True,
+                        dtype="bfloat16")
+        model = build_model(mcfg)
+        variables = model.init({"params": jax.random.PRNGKey(0)},
+                               jnp.zeros((1, *img)), train=False)
+        save_packaged_model(tmp, mcfg, [f"c{i}" for i in range(5)],
+                            variables["params"],
+                            variables.get("batch_stats"),
+                            img_height=img[0], img_width=img[1],
+                            quantize=quantize)
+        return PackagedModel(tmp)
+
+
 def bench_packaged_infer(*, batch: int, img: tuple, peak: float | None) -> dict:
     """Serving throughput through the packaged-model surface: the
     ``PackagedModel.predict_logits`` path the distributed scorer drives
@@ -349,32 +376,15 @@ def bench_packaged_infer(*, batch: int, img: tuple, peak: float | None) -> dict:
     load; reference role: the mlflow.pyfunc artifact each Spark executor
     loads, ``03_pyfunc_distributed_inference.py:157-184``)."""
     import tempfile
-    import warnings
 
-    from ddw_tpu.models.registry import build_model
-    from ddw_tpu.serving.package import PackagedModel, save_packaged_model
-    from ddw_tpu.utils.config import ModelCfg
     from ddw_tpu.utils.config import env_flag as _flag
 
     quant = "int8" if _flag("DDW_BENCH_INT8") else None
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")  # frozen-random warning: speed only
-        mcfg = ModelCfg(name="mobilenet_v2", num_classes=5, dropout=0.0,
-                        freeze_base=True, allow_frozen_random=True,
-                        dtype="bfloat16")
-        model = build_model(mcfg)
-    variables = model.init({"params": jax.random.PRNGKey(0)},
-                           jnp.zeros((1, *img)), train=False)
     rng = np.random.RandomState(0)
     imgs = rng.rand(batch, *img).astype(np.float32) * 2 - 1
 
     with tempfile.TemporaryDirectory() as tmp:
-        save_packaged_model(tmp, mcfg, [f"c{i}" for i in range(5)],
-                            variables["params"],
-                            variables.get("batch_stats"),
-                            img_height=img[0], img_width=img[1],
-                            quantize=quant)
-        pm = PackagedModel(tmp)
+        pm = throwaway_image_package(tmp, img, quantize=quant)
         pm.predict_logits(imgs)  # warmup: compile the 128-sub-batch apply
         _beat("packaged_infer: compiled")
 

@@ -241,6 +241,24 @@ _TYPES = {"data": DataCfg, "model": ModelCfg, "train": TrainCfg, "tune": TuneCfg
           "lm": LMCfg}
 
 
+def require_tpu_or_exit(verb: str = "measure") -> str:
+    """The one DDW_REQUIRE_TPU refusal contract every measurement tool and
+    chip_queue.sh attempt accounting depend on: when the flag is set and the
+    backend is not a TPU (axon fell back to CPU — tunnel down at connect),
+    print the refusal to stderr and exit 4. Returns the device kind."""
+    import sys
+
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    if env_flag("DDW_REQUIRE_TPU") and "TPU" not in kind:
+        print(f"DDW_REQUIRE_TPU set but backend is {kind!r} (axon fell back "
+              f"to CPU — tunnel down at connect); refusing to {verb}",
+              file=sys.stderr)
+        sys.exit(4)
+    return kind
+
+
 def env_flag(name: str) -> bool:
     """Boolean environment flag shared by bench.py and the perf tools.
 

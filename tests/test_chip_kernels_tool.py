@@ -1,0 +1,24 @@
+"""tools/chip_kernels.py contract: JSON line, numerics rows, ring evidence."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_chip_kernels_smoke():
+    env = dict(os.environ, DDW_BENCH_SMOKE="1", PALLAS_AXON_POOL_IPS="",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/chip_kernels.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["mode"] == "interpret"  # CPU run covers plumbing, not Mosaic
+    assert all(r["numerics_ok"] for r in d["depthwise"])
+    assert d["ring"]["n1_identity_ok"] is True
+    assert d["ring"]["n2_compile"] == "ok"  # 8 virtual devices: lowers fine

@@ -1,0 +1,42 @@
+"""tools/serving_curve.py contract: one JSON line, curve + LM blocks."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_serving_curve_smoke():
+    env = dict(os.environ, DDW_BENCH_SMOKE="1", PALLAS_AXON_POOL_IPS="",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/serving_curve.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert [r["batch"] for r in d["image_curve"]] == [1, 4]
+    for r in d["image_curve"]:
+        assert r["median_ms"] > 0 and r["images_per_sec"] > 0
+        assert r["p90_ms"] >= r["median_ms"]
+    lm = d["lm"]
+    assert lm["generate"]["median_ms_per_token"] > 0
+    spec = lm["generate_speculative"]
+    assert spec["median_ms_per_token"] > 0 and spec["k"] == 4
+    # the acceptance caveat must be visible in the output
+    assert "acceptance_rate" in spec["stats"]
+
+
+def test_serving_curve_refuses_cpu_fallback():
+    env = dict(os.environ, DDW_BENCH_SMOKE="1", DDW_REQUIRE_TPU="1",
+               PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/serving_curve.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert out.returncode == 4
+    assert "refusing" in out.stderr

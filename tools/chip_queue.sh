@@ -77,7 +77,7 @@ run_item() {
 log "runner started pid=$$"
 while :; do
   all_done=1
-  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan e2e_loader conv_profile_mn conv_profile_rn ab_conv fa2_sweep packaged_infer packaged_infer_int8; do
+  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan e2e_loader chip_kernels conv_profile_mn conv_profile_rn ab_conv fa2_sweep packaged_infer packaged_infer_int8 serving_curve; do
     [ -f "$LOGDIR/$name.done" ] || { [ -f "$LOGDIR/$name.attempts" ] && [ "$(cat "$LOGDIR/$name.attempts")" -ge "$MAX_ATTEMPTS" ]; } || all_done=0
   done
   if [ "$all_done" -eq 1 ]; then
@@ -100,12 +100,19 @@ while :; do
     # End-to-end loader-fed rows (VERDICT r3 item 3): the Petastorm-role
     # system number — table -> ShardedLoader prefetch -> train step.
     run_item e2e_loader      "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=e2e_raw_u8,e2e_feature_cache python -u bench.py" || continue
+    # Mosaic-compiled validation of the interpreter-only kernels (VERDICT
+    # r3 item 7): depthwise numerics+timing vs XLA, plus ring n=1 exec (the
+    # single-device tunnel can't run the 2-party arms; report says so).
+    run_item chip_kernels    "python -u tools/chip_kernels.py" || continue
     run_item conv_profile_mn "python -u tools/conv_profile.py mobilenet_v2" || continue
     ITEM_TIMEOUT=5400 run_item conv_profile_rn "python -u tools/conv_profile.py resnet50" || continue
     run_item ab_conv         "DDW_BENCH_STALL_S=900 DDW_BENCH_S2D=1 DDW_BENCH_DW=pallas DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_unfrozen,resnet50 python -u bench.py" || continue
     ITEM_TIMEOUT=5400 run_item fa2_sweep "python -u tools/fa2_sweep.py" || continue
     run_item packaged_infer  "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=packaged_infer python -u bench.py" || continue
     run_item packaged_infer_int8 "DDW_BENCH_STALL_S=900 DDW_BENCH_INT8=1 DDW_BENCH_ONLY=packaged_infer python -u bench.py" || continue
+    # Serving-under-load curves (VERDICT r3 item 8): batch 1->256 image
+    # latency + LM per-token latency, speculative on/off.
+    ITEM_TIMEOUT=5400 run_item serving_curve "python -u tools/serving_curve.py" || continue
   fi
   sleep "$PROBE_SLEEP" 9>&-
 done

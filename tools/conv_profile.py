@@ -285,13 +285,8 @@ def main():
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--img", type=int, default=224)
     args = ap.parse_args()
-    kind = jax.devices()[0].device_kind
-    from ddw_tpu.utils.config import env_flag
-    if env_flag("DDW_REQUIRE_TPU") and "TPU" not in kind:
-        print(f"DDW_REQUIRE_TPU set but backend is {kind!r} (axon fell back "
-              f"to CPU — tunnel down at connect); refusing to profile",
-              file=sys.stderr)
-        sys.exit(4)
+    from ddw_tpu.utils.config import require_tpu_or_exit
+    kind = require_tpu_or_exit("profile")
     print(f"device: {kind} "
           f"(assumed {PEAK_TFLOPS} TF/s bf16, {HBM_GBPS} GB/s)")
     for m in (args.models or ["mobilenet_v2", "resnet50"]):

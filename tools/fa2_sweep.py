@@ -95,13 +95,8 @@ def main():
     args = ap.parse_args()
     b, h, d = args.batch, args.heads, args.dim
     blocks = [int(x) for x in args.blocks.split(",")]
-    kind = jax.devices()[0].device_kind
-    from ddw_tpu.utils.config import env_flag
-    if env_flag("DDW_REQUIRE_TPU") and "TPU" not in kind:
-        print(f"DDW_REQUIRE_TPU set but backend is {kind!r} (axon fell back "
-              f"to CPU — tunnel down at connect); refusing to sweep",
-              file=sys.stderr)
-        sys.exit(4)
+    from ddw_tpu.utils.config import require_tpu_or_exit
+    kind = require_tpu_or_exit("sweep")
     print(f"device: {kind}  shape B{b} H{h} D{d} "
           f"causal fwd+bwd")
 

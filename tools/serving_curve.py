@@ -1,0 +1,149 @@
+"""Serving under load: latency/throughput curves for the packaged artifacts.
+
+Completes the reference's ``spark_udf`` scoring role
+(``Part 2 - Distributed Tuning & Inference/03_pyfunc_distributed_inference.py:
+466-472``) with numbers: the image package's batch-size curve (what a scorer
+worker sees per ``predict_logits`` call, H2D/D2H included) and the LM
+package's per-token generation latency with speculative decoding off/on.
+
+Usage (chip): ``DDW_REQUIRE_TPU=1 python tools/serving_curve.py``
+CI smoke:     ``DDW_BENCH_SMOKE=1`` shrinks shapes/batches/steps.
+
+Prints ONE JSON line: ``{"device": ..., "image_curve": [rows], "lm": {...}}``
+— each image row is {batch, median_ms, p90_ms, images_per_sec}; the LM block
+carries per-token ms for plain and speculative generation plus the
+speculative acceptance stats. Speculative speedup depends on draft/target
+agreement — random-weight packages measure the compute path, not the
+acceptance rate a trained pair would get (stats are reported so that caveat
+is visible).
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import json
+import statistics
+import tempfile
+import time
+
+import jax
+import numpy as np
+
+from ddw_tpu.utils.config import env_flag
+
+SMOKE = env_flag("DDW_BENCH_SMOKE")
+REPEATS = 3 if SMOKE else 7
+
+
+def _timed(call, *args, **kw):
+    """Median/p90 wall ms of a serving call (outputs are host arrays — the
+    fetch IS the completion barrier, exactly what a scorer worker pays)."""
+    call(*args, **kw)  # warmup/compile
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        call(*args, **kw)
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return (statistics.median(times),
+            times[min(len(times) - 1, int(0.9 * len(times)))])
+
+
+def image_curve(batches, img):
+    from bench import throwaway_image_package
+
+    rng = np.random.RandomState(0)
+    rows = []
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = throwaway_image_package(tmp, img)
+        for b in batches:
+            imgs = rng.rand(b, *img).astype(np.float32) * 2 - 1
+            med, p90 = _timed(pm.predict_logits, imgs)
+            rows.append({"batch": b, "median_ms": round(med, 3),
+                         "p90_ms": round(p90, 3),
+                         "images_per_sec": round(b / med * 1e3, 1)})
+            print(f"[curve] image b={b}: {med:.2f} ms "
+                  f"({b / med * 1e3:.0f} img/s)", file=sys.stderr, flush=True)
+    return rows
+
+
+def lm_latencies(hidden, depth, heads, vocab, max_len, prompt_len, steps,
+                 spec_k):
+    from ddw_tpu.models.lm import TransformerLM
+    from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+    from ddw_tpu.train.lm_step import init_lm_state
+    from ddw_tpu.utils.config import LMCfg
+
+    import optax
+
+    def make_pkg(tmp, name, h, d):
+        cfg = LMCfg(vocab_size=vocab, max_len=max_len, hidden=h, depth=d,
+                    num_heads=heads, mlp_dim=4 * h, dropout=0.0,
+                    dtype="bfloat16")
+        model = TransformerLM(vocab_size=vocab, max_len=max_len, hidden=h,
+                              depth=d, num_heads=heads, mlp_dim=4 * h,
+                              dropout=0.0, dtype="bfloat16")
+        state = init_lm_state(model, optax.sgd(0.0), jax.random.PRNGKey(0))
+        out = os.path.join(tmp, name)
+        save_lm_package(out, cfg, state.params)
+        return load_lm_package(out)
+
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, vocab, size=(1, prompt_len)).astype(np.int32)
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        target = make_pkg(tmp, "target", hidden, depth)
+        draft = make_pkg(tmp, "draft", max(hidden // 4, 16), 2)
+
+        med, p90 = _timed(target.generate, prompt, steps)
+        out["generate"] = {"steps": steps, "median_ms_per_token":
+                           round(med / steps, 3), "p90_ms_total": round(p90, 2)}
+        print(f"[curve] lm generate: {med / steps:.2f} ms/token",
+              file=sys.stderr, flush=True)
+
+        stats_box = {}
+
+        def spec_call():
+            _, stats = target.generate_speculative(draft, prompt, steps,
+                                                   k=spec_k)
+            stats_box.update(stats)
+
+        med, p90 = _timed(spec_call)
+        out["generate_speculative"] = {
+            "steps": steps, "k": spec_k,
+            "median_ms_per_token": round(med / steps, 3),
+            "p90_ms_total": round(p90, 2),
+            "stats": {k: (round(float(v), 4) if isinstance(v, float)
+                          else int(v) if isinstance(v, (int, np.integer))
+                          else v) for k, v in stats_box.items()},
+        }
+        print(f"[curve] lm speculative(k={spec_k}): {med / steps:.2f} "
+              f"ms/token", file=sys.stderr, flush=True)
+    return out
+
+
+def main():
+    from ddw_tpu.utils.config import require_tpu_or_exit
+
+    kind = require_tpu_or_exit("measure")
+    print(f"device: {kind}", file=sys.stderr, flush=True)
+
+    if SMOKE:
+        batches, img = [1, 4], (64, 64, 3)
+        lm_kw = dict(hidden=64, depth=2, heads=4, vocab=256, max_len=128,
+                     prompt_len=16, steps=8, spec_k=4)
+    else:
+        batches, img = [1, 2, 4, 8, 16, 32, 64, 128, 256], (224, 224, 3)
+        lm_kw = dict(hidden=512, depth=6, heads=8, vocab=8192, max_len=2048,
+                     prompt_len=64, steps=128, spec_k=4)
+
+    result = {
+        "device": {"kind": kind, "n": jax.device_count()},
+        "image_curve": image_curve(batches, img),
+        "lm": lm_latencies(**lm_kw),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
