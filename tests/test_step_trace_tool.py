@@ -1,0 +1,32 @@
+"""tools/step_trace.py contract: traces land on disk, JSON line reports them.
+
+A typo'd queue item must fail in CI, not burn a tunnel-window attempt.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_step_trace_smoke(tmp_path):
+    env = dict(os.environ, DDW_BENCH_SMOKE="1", PALLAS_AXON_POOL_IPS="",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/step_trace.py"),
+         "vit", "lm_flash", "--out", str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    for name in ("vit", "lm_flash"):
+        assert d[name]["steps"] > 0 and d[name]["seconds"] > 0
+        assert os.listdir(d[name]["dir"])  # profiler wrote something
+
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/step_trace.py"), "nope"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert bad.returncode != 0 and "unknown configs" in bad.stderr

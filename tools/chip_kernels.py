@@ -138,19 +138,22 @@ def ring_report() -> dict:
             ring2.lower(jax.ShapeDtypeStruct((16, 256), jnp.float32)).compile()
             out["n2_compile"] = "ok"
 
-            # Gradient-sized ring-vs-psum: the decision number for routing
-            # runtime/collectives.ring_all_reduce through the kernel.
-            n_rows = 16 if SMOKE else 4096
-            buf = jnp.asarray(np.random.RandomState(0).randn(n_rows, 256),
-                              jnp.float32)
-            psum2 = jax.jit(jax.shard_map(
-                lambda v: jax.lax.psum(v, "r"), mesh=mesh2,
-                in_specs=P("r"), out_specs=P("r"), check_vma=False))
-            out["n2_vs_psum_ms"] = {
-                "buffer_mib": round(buf.nbytes / 2**20, 3),
-                "ring": round(_t(ring2, buf) * 1e3, 4),
-                "psum": round(_t(psum2, buf) * 1e3, 4),
-            }
+            if jax.default_backend() == "tpu":
+                # Gradient-sized ring-vs-psum: the decision number for
+                # routing runtime/collectives.ring_all_reduce through the
+                # kernel. TPU only — interpreter timings are dispatch noise,
+                # not data (same gate as depthwise_report).
+                n_rows = 16 if SMOKE else 4096
+                buf = jnp.asarray(
+                    np.random.RandomState(0).randn(n_rows, 256), jnp.float32)
+                psum2 = jax.jit(jax.shard_map(
+                    lambda v: jax.lax.psum(v, "r"), mesh=mesh2,
+                    in_specs=P("r"), out_specs=P("r"), check_vma=False))
+                out["n2_vs_psum_ms"] = {
+                    "buffer_mib": round(buf.nbytes / 2**20, 3),
+                    "ring": round(_t(ring2, buf) * 1e3, 4),
+                    "psum": round(_t(psum2, buf) * 1e3, 4),
+                }
         else:
             out["n2_compile"] = ("skipped: 1 visible device (the 2-party "
                                  "arms need a multi-chip host — see "
@@ -161,13 +164,10 @@ def ring_report() -> dict:
 
 
 def main():
-    kind = jax.devices()[0].device_kind
+    from ddw_tpu.utils.config import require_tpu_or_exit
+
+    kind = require_tpu_or_exit("measure")
     on_tpu = "TPU" in kind
-    if env_flag("DDW_REQUIRE_TPU") and not on_tpu:
-        print(f"DDW_REQUIRE_TPU set but backend is {kind!r} (axon fell back "
-              f"to CPU — tunnel down at connect); refusing to measure",
-              file=sys.stderr)
-        sys.exit(4)
     print(f"device: {kind}", file=sys.stderr, flush=True)
     result = {
         "device": {"kind": kind, "n": jax.device_count()},
