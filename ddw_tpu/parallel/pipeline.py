@@ -274,9 +274,10 @@ def make_pp_lm_train_step(
         out, aux = lax.scan(body, x, stage_params)
         return out, jnp.sum(aux)
 
-    def grad_fn(pp_params, inputs, targets):
-        """Per-rank pipeline forward+backward. inputs/targets [B, S] replicated
-        over the pipe axis (shard them over a data axis for DPxPP)."""
+    def _forward(pp_params, inputs, targets):
+        """Per-rank pipeline forward: the schedule scan, shared by the train
+        step (under ``value_and_grad``) and the eval step (called plain).
+        Returns ``(total_loss, (ce, acc, aux))``."""
         r = lax.axis_index(pipe_axis)
         b, s = inputs.shape
         if b % m:
@@ -360,8 +361,13 @@ def make_pp_lm_train_step(
             aux = lax.psum(aux_sum, pipe_axis) / (m * model.depth)
             return loss + aux_w * aux, (loss, acc, aux)
 
+        return loss_fn(pp_params)
+
+    def grad_fn(pp_params, inputs, targets):
+        """Per-rank pipeline forward+backward. inputs/targets [B, S] replicated
+        over the pipe axis (shard them over a data axis for DPxPP)."""
         (_, (loss, acc, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(pp_params)
+            lambda p: _forward(p, inputs, targets), has_aux=True)(pp_params)
         # The loss comes out of a psum, replicated on every rank; under
         # shard_map AD each rank's unit cotangent flows through the psum
         # transpose, so raw grads are n_stages x the true gradient (verified
@@ -371,14 +377,27 @@ def make_pp_lm_train_step(
         # non-zero grads — psum makes every rank's grad the true global one.
         grads["embed"] = lax.psum(grads["embed"], pipe_axis)
         grads["head"] = lax.psum(grads["head"], pipe_axis)
+        metrics = _metrics(loss, acc, aux)
+        if data_axis is not None:
+            # DPxPP: average gradients across pipeline replicas (metrics
+            # already pmean-ed in _metrics).
+            grads = lax.pmean(grads, data_axis)
+        return grads, metrics
+
+    def _metrics(loss, acc, aux):
+        """ONE metrics assembly for the train and eval halves — a metric
+        added to one cannot silently miss the other."""
         metrics = {"loss": loss, "accuracy": acc}
         if moe:
             metrics["aux_loss"] = aux
         if data_axis is not None:
-            # DPxPP: average gradients and metrics across pipeline replicas.
-            grads = lax.pmean(grads, data_axis)
             metrics = lax.pmean(metrics, data_axis)
-        return grads, metrics
+        return metrics
+
+    def metrics_fn(pp_params, inputs, targets):
+        """Forward-only pipeline metrics (the eval half of the step)."""
+        _, (loss, acc, aux) = _forward(pp_params, inputs, targets)
+        return _metrics(loss, acc, aux)
 
     def _build(template_params):
         specs = _spec_tree(template_params, pipe_axis, v)
@@ -387,6 +406,11 @@ def make_pp_lm_train_step(
             grad_fn, mesh=mesh,
             in_specs=(specs, tok_spec, tok_spec),
             out_specs=(specs, P()),
+            check_vma=False)
+        smapped_eval = jax.shard_map(
+            metrics_fn, mesh=mesh,
+            in_specs=(specs, tok_spec, tok_spec),
+            out_specs=P(),
             check_vma=False)
 
         def _step(state: TrainState, inputs, targets):
@@ -398,7 +422,11 @@ def make_pp_lm_train_step(
             new_params = optax.apply_updates(state.params, updates)
             return TrainState(new_params, {}, new_opt, state.step + 1), metrics
 
-        return jax.jit(_step, donate_argnums=(0,) if donate else ())
+        def _eval(state: TrainState, inputs, targets):
+            return smapped_eval(state.params, inputs, targets)
+
+        return (jax.jit(_step, donate_argnums=(0,) if donate else ()),
+                jax.jit(_eval))
 
     bpc = model.depth // (n * v)
 
@@ -417,13 +445,23 @@ def make_pp_lm_train_step(
 
     _jits: dict = {}
 
-    def stepper(state: TrainState, inputs, targets):
+    def _fns(state: TrainState):
         key = jax.tree.structure(state)
-        fn = _jits.get(key)
-        if fn is None:
+        fns = _jits.get(key)
+        if fns is None:
             _check_layout(state.params)
-            fn = _jits[key] = _build(state.params)
-        return fn(state, inputs, targets)
+            fns = _jits[key] = _build(state.params)
+        return fns
+
+    def stepper(state: TrainState, inputs, targets):
+        return _fns(state)[0](state, inputs, targets)
+
+    def eval_step(state: TrainState, inputs, targets):
+        """Forward-only metrics over the same schedule (no update, no
+        donation — the state is reused across the whole eval pass)."""
+        return _fns(state)[1](state, inputs, targets)
+
+    stepper.eval_step = eval_step  # type: ignore[attr-defined]
 
     def place_state(state: TrainState) -> TrainState:
         _check_layout(state.params)

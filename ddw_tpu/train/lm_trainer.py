@@ -30,7 +30,7 @@ import numpy as np
 
 from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.models.lm import build_lm
-from ddw_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS, MeshSpec, make_mesh
+from ddw_tpu.runtime.mesh import DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MeshSpec, make_mesh
 from ddw_tpu.train.lm_step import (
     init_lm_state,
     make_lm_eval_step,
@@ -65,6 +65,20 @@ class LMTrainer:
                              "parallel.zero.make_fsdp_train_step / "
                              "make_fsdp_tp_train_step directly")
         self.lm_cfg, self.train_cfg, self.run = lm_cfg, train_cfg, run
+        self.pp = train_cfg.pipeline_stages > 0
+        if self.pp:
+            if seq_devices != 1:
+                raise ValueError("pipeline_stages does not compose with "
+                                 "seq_devices — the pipeline step shards "
+                                 "depth, not sequence (use one or the other)")
+            if lm_cfg.dropout:
+                raise ValueError("pipeline training requires lm.dropout == 0 "
+                                 "(the pipeline step is deterministic)")
+            if train_cfg.grad_accum_steps > 1:
+                raise ValueError("pipeline_stages does not compose with "
+                                 "grad_accum_steps — microbatching IS the "
+                                 "pipeline's accumulation; raise "
+                                 "pipeline_microbatches instead")
         if mesh is None:
             devices = jax.devices()
             if train_cfg.num_devices:
@@ -76,15 +90,41 @@ class LMTrainer:
             if n % seq_devices:
                 raise ValueError(f"seq_devices {seq_devices} must divide "
                                  f"device count {n}")
-            dp = n // seq_devices
-            axes = ((DATA_AXIS, dp),) if seq_devices == 1 else (
-                (DATA_AXIS, dp), (SEQ_AXIS, seq_devices))
-            mesh = make_mesh(MeshSpec(axes), devices=devices)
+            if self.pp:
+                stages = train_cfg.pipeline_stages
+                if n % stages:
+                    raise ValueError(f"pipeline_stages {stages} must divide "
+                                     f"device count {n}")
+                mesh = make_mesh(MeshSpec(((DATA_AXIS, n // stages),
+                                           (PIPE_AXIS, stages))),
+                                 devices=devices)
+            else:
+                dp = n // seq_devices
+                axes = ((DATA_AXIS, dp),) if seq_devices == 1 else (
+                    (DATA_AXIS, dp), (SEQ_AXIS, seq_devices))
+                mesh = make_mesh(MeshSpec(axes), devices=devices)
+        if self.pp:
+            # A user-supplied mesh must actually realize the configured
+            # layout — a silent stage-count mismatch or a missing data axis
+            # would otherwise surface as a wrong parallelism layout or a
+            # bare KeyError deep inside fit.
+            if mesh.shape.get(PIPE_AXIS) != train_cfg.pipeline_stages:
+                raise ValueError(
+                    f"pipeline_stages={train_cfg.pipeline_stages} but the "
+                    f"mesh is {dict(mesh.shape)} — its '{PIPE_AXIS}' axis "
+                    f"must exist with exactly that size")
+            if DATA_AXIS not in mesh.shape:
+                raise ValueError(
+                    f"the pipeline trainer batches over '{DATA_AXIS}'; give "
+                    f"the mesh a (possibly size-1) '{DATA_AXIS}' axis: "
+                    f"{dict(mesh.shape)}")
         self.mesh = mesh
         self.seq_axis = SEQ_AXIS if SEQ_AXIS in mesh.shape else None
+        # Under PP, MoE experts stay dense/local (the pipeline step rejects
+        # an expert_axis); otherwise EP routes over the data axis.
         self.model = build_lm(lm_cfg, seq_axis=self.seq_axis,
                               expert_axis=(DATA_AXIS if lm_cfg.num_experts
-                                           else None))
+                                           and not self.pp else None))
 
     # ------------------------------------------------------------------
     def fit(self, tokens: np.ndarray, val_fraction: float = 0.1,
@@ -117,12 +157,28 @@ class LMTrainer:
 
         tx = make_optimizer(cfg)
         rng = jax.random.PRNGKey(cfg.seed)
-        state = init_lm_state(self.model, tx, rng, seq_len=min(8, seq_len))
-        step = make_lm_train_step(self.model, tx, mesh,
-                                  seq_axis=self.seq_axis,
-                                  grad_accum_steps=cfg.grad_accum_steps)
-        eval_step = make_lm_eval_step(self.model, mesh,
-                                      seq_axis=self.seq_axis)
+        if self.pp:
+            from ddw_tpu.parallel.pipeline import (init_pp_state,
+                                                   make_pp_lm_train_step)
+
+            vstages = (cfg.pipeline_virtual_stages
+                       if cfg.pipeline_schedule == "interleaved" else 1)
+            state = init_pp_state(self.model, tx, mesh, rng,
+                                  virtual_stages=vstages)
+            step = make_pp_lm_train_step(
+                self.model, tx, mesh, data_axis=DATA_AXIS,
+                num_microbatches=cfg.pipeline_microbatches,
+                donate=True, schedule=cfg.pipeline_schedule,
+                virtual_stages=vstages)
+            eval_step = step.eval_step
+        else:
+            state = init_lm_state(self.model, tx, rng,
+                                  seq_len=min(8, seq_len))
+            step = make_lm_train_step(self.model, tx, mesh,
+                                      seq_axis=self.seq_axis,
+                                      grad_accum_steps=cfg.grad_accum_steps)
+            eval_step = make_lm_eval_step(self.model, mesh,
+                                          seq_axis=self.seq_axis)
 
         ckpt = (CheckpointManager(cfg.checkpoint_dir,
                                   async_write=cfg.async_checkpoint)
@@ -157,6 +213,12 @@ class LMTrainer:
                                  history=[saved], state=state,
                                  epochs_run=start_epoch)
 
+        if self.pp:
+            # Placement AFTER restore: the checkpoint template is the
+            # unplaced stacked-stage pytree; placing shards stage leaves
+            # over the pipe axis.
+            state = step.place_state(state)
+
         sched = ScheduleSuite.build(cfg, dp, restored_meta)
 
         if self.run is not None:
@@ -188,8 +250,12 @@ class LMTrainer:
                         state = set_lr(state, lr)
                     idx = order[i * global_batch:(i + 1) * global_batch]
                     batch = train[idx]
-                    state, m = step(state, batch[:, :-1], batch[:, 1:],
-                                    jax.random.fold_in(step_rng, host_step))
+                    if self.pp:  # the pipeline step is deterministic: no rng
+                        state, m = step(state, batch[:, :-1], batch[:, 1:])
+                    else:
+                        state, m = step(state, batch[:, :-1], batch[:, 1:],
+                                        jax.random.fold_in(step_rng,
+                                                           host_step))
                     host_step += 1
                     tlosses.append(m["loss"])
                     taccs.append(m["accuracy"])
@@ -213,6 +279,9 @@ class LMTrainer:
                     "val_accuracy": float(np.mean(jax.device_get(vaccs))),
                     "lr": get_lr(state),
                 }
+                if self.pp:  # schedule idle fraction, logged beside loss
+                    row["pp_bubble_fraction"] = float(
+                        jax.device_get(m["pp_bubble_fraction"]))
                 history.append(row)
                 epochs_run = epoch + 1
                 if self.run is not None:

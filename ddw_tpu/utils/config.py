@@ -154,6 +154,17 @@ class TrainCfg:
                                         # Same checkpoint format and flag
                                         # incompatibilities as zero; zero and
                                         # fsdp are mutually exclusive.
+    pipeline_stages: int = 0            # >0: LMTrainer trains the LM through
+                                        # the pipeline step (parallel/
+                                        # pipeline.py) over a (data, pipe)
+                                        # mesh — pipe=stages, data absorbs
+                                        # the remaining devices. Requires
+                                        # lm.dropout == 0 and divides depth.
+    pipeline_schedule: str = "gpipe"    # "gpipe" | "interleaved" (virtual
+                                        # stages; ~v-fold smaller bubble,
+                                        # microbatches <= stages)
+    pipeline_microbatches: int = 4      # per-replica batch must divide this
+    pipeline_virtual_stages: int = 2    # interleaved only: chunks per device
     checkpoint_dir: str = ""            # "" = no per-epoch checkpoints
     async_checkpoint: bool = False      # serialize+write checkpoints on a
                                         # background thread (device snapshot is

@@ -76,6 +76,65 @@ def test_resume_already_complete_returns_checkpointed_metrics(tmp_path):
     assert res2.val_accuracy == pytest.approx(res.val_accuracy, abs=1e-6)
 
 
+def test_fit_pipeline_gpipe_and_resume(tmp_path):
+    """train.pipeline_stages=4 over 8 devices (DPxPP): the managed trainer
+    runs the GPipe step, evals through the pipeline eval step, logs the
+    bubble, checkpoints, and resumes as a continuation."""
+    import dataclasses
+
+    lm, tr = _cfgs(num_devices=8, epochs=2, pipeline_stages=4,
+                   pipeline_microbatches=4,
+                   checkpoint_dir=str(tmp_path / "ck"),
+                   checkpoint_every_epochs=1)
+    lm = dataclasses.replace(lm, depth=4)
+    res = LMTrainer(lm, tr).fit(_tokens())
+    assert res.epochs_run == 2 and np.isfinite(res.val_loss)
+    assert res.history[0]["pp_bubble_fraction"] == pytest.approx(3 / 7)
+    # params stayed in the stacked-stage layout, sharded over pipe
+    leaf = jax.tree.leaves(res.state.params["stages"])[0]
+    assert "pipe" in str(leaf.sharding.spec)
+
+    res4 = LMTrainer(lm, dataclasses.replace(tr, epochs=4)).fit(
+        _tokens(), resume=True)
+    assert res4.epochs_run == 4
+    assert res4.history[0]["epoch"] == 2
+
+
+def test_fit_pipeline_interleaved():
+    import dataclasses
+
+    lm, tr = _cfgs(num_devices=4, epochs=1, pipeline_stages=4,
+                   pipeline_schedule="interleaved", pipeline_microbatches=2,
+                   pipeline_virtual_stages=2)
+    lm = dataclasses.replace(lm, depth=8)
+    res = LMTrainer(lm, tr).fit(_tokens())
+    assert res.epochs_run == 1 and np.isfinite(res.val_loss)
+    assert res.history[0]["pp_bubble_fraction"] == pytest.approx(5 / 9)
+
+
+def test_pipeline_refusals():
+    import dataclasses
+
+    lm, tr = _cfgs(num_devices=4, pipeline_stages=4)
+    with pytest.raises(ValueError, match="dropout"):
+        LMTrainer(dataclasses.replace(lm, dropout=0.1, depth=4), tr)
+    with pytest.raises(ValueError, match="seq_devices"):
+        LMTrainer(dataclasses.replace(lm, depth=4), tr, seq_devices=2)
+    with pytest.raises(ValueError, match="grad_accum"):
+        LMTrainer(dataclasses.replace(lm, depth=4),
+                  dataclasses.replace(tr, grad_accum_steps=2))
+    # user-supplied meshes must realize the configured layout
+    from ddw_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    bad_stage = make_mesh(MeshSpec((("data", 2), ("pipe", 2))),
+                          devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="exactly that size"):
+        LMTrainer(dataclasses.replace(lm, depth=4), tr, mesh=bad_stage)
+    no_data = make_mesh(MeshSpec((("pipe", 4),)), devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="'data' axis"):
+        LMTrainer(dataclasses.replace(lm, depth=4), tr, mesh=no_data)
+
+
 def test_cosine_schedule_and_early_stop():
     lm, tr = _cfgs(num_devices=4, lr_schedule="cosine", epochs=4,
                    early_stop_patience=1)
