@@ -59,13 +59,33 @@ class LMTrainer:
             raise ValueError("LMTrainer does not support train.ema_decay yet "
                              "— drop the flag (the vision Trainer carries the "
                              "EMA machinery)")
-        if train_cfg.zero or train_cfg.fsdp:
-            raise ValueError("LMTrainer uses the shard_map DPxSP step; for "
-                             "ZeRO/FSDP LM training use "
-                             "parallel.zero.make_fsdp_train_step / "
-                             "make_fsdp_tp_train_step directly")
         self.lm_cfg, self.train_cfg, self.run = lm_cfg, train_cfg, run
         self.pp = train_cfg.pipeline_stages > 0
+        self.sharded = train_cfg.zero or train_cfg.fsdp
+        if self.sharded:
+            flag = "train.fsdp" if train_cfg.fsdp else "train.zero"
+            if train_cfg.zero and train_cfg.fsdp:
+                raise ValueError("train.zero and train.fsdp are mutually "
+                                 "exclusive (fsdp already shards the "
+                                 "optimizer state) — pick one")
+            if train_cfg.async_checkpoint:
+                raise ValueError(
+                    f"{flag} with async_checkpoint=true is not supported: "
+                    "sharded saves are collective and synchronous — drop "
+                    "one of the flags")
+            if self.pp:
+                raise ValueError(f"{flag} does not compose with "
+                                 f"pipeline_stages — the pipeline step "
+                                 f"already shards stage params over 'pipe'")
+            if seq_devices != 1:
+                raise ValueError(f"{flag} uses the GSPMD DP step (no "
+                                 f"sequence axis) — seq_devices must be 1")
+            if lm_cfg.num_experts:
+                raise ValueError(
+                    f"{flag} does not support MoE models: the GSPMD step's "
+                    f"forward discards the sown Switch aux loss, which would "
+                    f"silently train an unbalanced router — use the plain "
+                    f"DP/EP step (no zero/fsdp) for MoE")
         if self.pp:
             if seq_devices != 1:
                 raise ValueError("pipeline_stages does not compose with "
@@ -120,11 +140,13 @@ class LMTrainer:
                     f"{dict(mesh.shape)}")
         self.mesh = mesh
         self.seq_axis = SEQ_AXIS if SEQ_AXIS in mesh.shape else None
-        # Under PP, MoE experts stay dense/local (the pipeline step rejects
-        # an expert_axis); otherwise EP routes over the data axis.
+        # Under PP and ZeRO/FSDP (GSPMD steps with no named axis inside the
+        # program), MoE experts stay dense/local; otherwise EP routes over
+        # the data axis.
         self.model = build_lm(lm_cfg, seq_axis=self.seq_axis,
                               expert_axis=(DATA_AXIS if lm_cfg.num_experts
-                                           and not self.pp else None))
+                                           and not (self.pp or self.sharded)
+                                           else None))
 
     # ------------------------------------------------------------------
     def fit(self, tokens: np.ndarray, val_fraction: float = 0.1,
@@ -171,6 +193,23 @@ class LMTrainer:
                 donate=True, schedule=cfg.pipeline_schedule,
                 virtual_stages=vstages)
             eval_step = step.eval_step
+        elif self.sharded:
+            from ddw_tpu.parallel.zero import (make_fsdp_train_step,
+                                               make_zero_train_step)
+
+            state = init_lm_state(self.model, tx, rng,
+                                  seq_len=min(8, seq_len))
+            make_sharded = (make_fsdp_train_step if cfg.fsdp
+                            else make_zero_train_step)
+            # DATA_AXIS, not cfg.data_axis: LMTrainer builds (and validates)
+            # its meshes with the constant throughout.
+            step = make_sharded(self.model, tx, mesh, DATA_AXIS,
+                                grad_accum_steps=cfg.grad_accum_steps)
+            # Eval reads the sharded params through the shard_map eval step's
+            # replicated in-spec: GSPMD gathers per eval call (same trade the
+            # vision Trainer makes).
+            eval_step = make_lm_eval_step(self.model, mesh,
+                                          seq_axis=self.seq_axis)
         else:
             state = init_lm_state(self.model, tx, rng,
                                   seq_len=min(8, seq_len))
@@ -180,9 +219,18 @@ class LMTrainer:
             eval_step = make_lm_eval_step(self.model, mesh,
                                           seq_axis=self.seq_axis)
 
-        ckpt = (CheckpointManager(cfg.checkpoint_dir,
-                                  async_write=cfg.async_checkpoint)
-                if cfg.checkpoint_dir else None)
+        if not cfg.checkpoint_dir:
+            ckpt = None
+        elif self.sharded:
+            # per-process sharded format: saving must NOT all-gather the
+            # ZeRO/FSDP leaves into one host
+            from ddw_tpu.train.trainer import _ZeroCheckpointAdapter
+
+            ckpt = _ZeroCheckpointAdapter(cfg.checkpoint_dir, mesh,
+                                          DATA_AXIS, fsdp=cfg.fsdp)
+        else:
+            ckpt = CheckpointManager(cfg.checkpoint_dir,
+                                     async_write=cfg.async_checkpoint)
         start_epoch = 0
         restored_meta = None
         if ckpt and resume:
@@ -213,10 +261,11 @@ class LMTrainer:
                                  history=[saved], state=state,
                                  epochs_run=start_epoch)
 
-        if self.pp:
+        if self.pp or self.sharded:
             # Placement AFTER restore: the checkpoint template is the
-            # unplaced stacked-stage pytree; placing shards stage leaves
-            # over the pipe axis.
+            # unplaced pytree; placing shards stage leaves over 'pipe' (PP)
+            # or params/moments over the data axis (ZeRO/FSDP) — a no-op on
+            # a restored already-sharded state.
             state = step.place_state(state)
 
         sched = ScheduleSuite.build(cfg, dp, restored_meta)

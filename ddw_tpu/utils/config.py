@@ -163,7 +163,7 @@ class TrainCfg:
     pipeline_schedule: str = "gpipe"    # "gpipe" | "interleaved" (virtual
                                         # stages; ~v-fold smaller bubble,
                                         # microbatches <= stages)
-    pipeline_microbatches: int = 4      # per-replica batch must divide this
+    pipeline_microbatches: int = 4      # must divide the per-replica batch
     pipeline_virtual_stages: int = 2    # interleaved only: chunks per device
     checkpoint_dir: str = ""            # "" = no per-epoch checkpoints
     async_checkpoint: bool = False      # serialize+write checkpoints on a
