@@ -37,7 +37,8 @@ from ddw_tpu.train.lm_step import (
     make_lm_train_step,
 )
 from ddw_tpu.train.schedule import ScheduleSuite
-from ddw_tpu.train.step import TrainState, get_lr, make_optimizer, set_lr
+from ddw_tpu.train.step import (TrainState, ema_params, get_lr,
+                                make_optimizer, set_lr)
 from ddw_tpu.utils.config import LMCfg, TrainCfg, to_dict
 
 
@@ -55,10 +56,6 @@ class LMTrainer:
 
     def __init__(self, lm_cfg: LMCfg, train_cfg: TrainCfg,
                  mesh=None, seq_devices: int = 1, run=None):
-        if train_cfg.ema_decay:
-            raise ValueError("LMTrainer does not support train.ema_decay yet "
-                             "— drop the flag (the vision Trainer carries the "
-                             "EMA machinery)")
         self.lm_cfg, self.train_cfg, self.run = lm_cfg, train_cfg, run
         self.pp = train_cfg.pipeline_stages > 0
         self.sharded = train_cfg.zero or train_cfg.fsdp
@@ -178,6 +175,17 @@ class LMTrainer:
                              f"{global_batch}")
 
         tx = make_optimizer(cfg)
+        if cfg.ema_decay:
+            from ddw_tpu.train.step import with_param_ema
+
+            # Outermost wrap (mirrors vision init_state): the shadow tracks
+            # the final post-mask updates. LoRA wraps INSIDE init_lm_state's
+            # _maybe_lora_tx, which would invert that order — refuse.
+            if getattr(self.lm_cfg, "lora_rank", 0):
+                raise ValueError("train.ema_decay with lm.lora_rank is not "
+                                 "supported: the LoRA mask would wrap "
+                                 "outside the EMA shadow — drop one")
+            tx = with_param_ema(tx, cfg.ema_decay)
         rng = jax.random.PRNGKey(cfg.seed)
         if self.pp:
             from ddw_tpu.parallel.pipeline import (init_pp_state,
@@ -310,6 +318,17 @@ class LMTrainer:
                     taccs.append(m["accuracy"])
 
                 vlosses, vaccs = [], []
+                eval_state = state
+                if self.sharded:
+                    # eval reads only params: dropping the sharded moments
+                    # keeps the eval jit from all-gathering them to match
+                    # its replicated in-spec (FSDP params DO get gathered —
+                    # eval wants full weights)
+                    eval_state = eval_state.replace(opt_state=())
+                if cfg.ema_decay:
+                    # evaluate the Polyak shadow (what serving should ship)
+                    eval_state = eval_state.replace(
+                        params=ema_params(state), opt_state=())
                 for i in range(val_steps):
                     # index modulo the split: every eval batch is exactly
                     # global_batch (shard_map divisibility) even for tiny
@@ -317,7 +336,7 @@ class LMTrainer:
                     idx = np.arange(i * global_batch,
                                     (i + 1) * global_batch) % len(val)
                     vb = val[idx]
-                    vm = eval_step(state, vb[:, :-1], vb[:, 1:])
+                    vm = eval_step(eval_state, vb[:, :-1], vb[:, 1:])
                     vlosses.append(vm["loss"])
                     vaccs.append(vm["accuracy"])
                 row = {
