@@ -59,6 +59,13 @@ class LMTrainer:
         self.lm_cfg, self.train_cfg, self.run = lm_cfg, train_cfg, run
         self.pp = train_cfg.pipeline_stages > 0
         self.sharded = train_cfg.zero or train_cfg.fsdp
+        if train_cfg.ema_decay and getattr(lm_cfg, "lora_rank", 0):
+            # fail at construction like every other invalid combination:
+            # LoRA wraps inside init_lm_state's _maybe_lora_tx, which would
+            # put the mask outside the EMA shadow
+            raise ValueError("train.ema_decay with lm.lora_rank is not "
+                             "supported: the LoRA mask would wrap outside "
+                             "the EMA shadow — drop one")
         if self.sharded:
             flag = "train.fsdp" if train_cfg.fsdp else "train.zero"
             if train_cfg.zero and train_cfg.fsdp:
@@ -179,12 +186,7 @@ class LMTrainer:
             from ddw_tpu.train.step import with_param_ema
 
             # Outermost wrap (mirrors vision init_state): the shadow tracks
-            # the final post-mask updates. LoRA wraps INSIDE init_lm_state's
-            # _maybe_lora_tx, which would invert that order — refuse.
-            if getattr(self.lm_cfg, "lora_rank", 0):
-                raise ValueError("train.ema_decay with lm.lora_rank is not "
-                                 "supported: the LoRA mask would wrap "
-                                 "outside the EMA shadow — drop one")
+            # the final post-mask updates (LoRA+EMA refused in __init__).
             tx = with_param_ema(tx, cfg.ema_decay)
         rng = jax.random.PRNGKey(cfg.seed)
         if self.pp:

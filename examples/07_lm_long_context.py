@@ -93,8 +93,30 @@ def main():
         if args.moe:
             lm_cfg.num_experts = args.moe  # MoE composes with the trainer
         if args.pipeline:
-            raise SystemExit("--trainer drives the DPxSP step; --pipeline "
-                             "uses the GPipe path — pick one")
+            # The managed pipeline path: train.pipeline_stages builds the
+            # (data, pipe) mesh and the trainer drives the GPipe step
+            # (ddw_tpu/train/lm_trainer.py; schedule knobs on TrainCfg).
+            lm_cfg.dropout = 0.0  # the pipeline step is deterministic
+            train_cfg.pipeline_stages = args.pipeline
+            if lm_cfg.depth % args.pipeline:
+                adjusted = max(args.pipeline,
+                               lm_cfg.depth // args.pipeline * args.pipeline)
+                print(f"[pipeline] adjusting lm.depth {lm_cfg.depth} -> "
+                      f"{adjusted} (must divide {args.pipeline} stages)")
+                lm_cfg.depth = adjusted
+            mb = train_cfg.pipeline_microbatches
+            if mb < 1 or train_cfg.batch_size % mb:
+                fixed = next(c for c in range(min(max(mb, 1),
+                                                  train_cfg.batch_size), 0, -1)
+                             if train_cfg.batch_size % c == 0)
+                print(f"[pipeline] adjusting pipeline_microbatches {mb} -> "
+                      f"{fixed} (must divide batch_size "
+                      f"{train_cfg.batch_size})")
+                train_cfg.pipeline_microbatches = fixed
+            # the pipeline shards depth, not sequence; dp comes from the
+            # devices the trainer will actually use
+            eff_n = train_cfg.num_devices or n
+            sp, dp = 1, eff_n // args.pipeline
         if args.speculative or args.steps:
             raise SystemExit("--trainer runs epochs, not --steps, and skips "
                              "the generation demos — use train.epochs=N, and "
@@ -112,7 +134,9 @@ def main():
         for row in res.history:
             print({k: round(v, 4) if isinstance(v, float) else v
                    for k, v in row.items()})
-        print(f"trainer: mesh dp={dp} sp={sp} epochs={res.epochs_run} "
+        layout = (f"pipe={args.pipeline} dp={dp}"
+                  if args.pipeline else f"dp={dp} sp={sp}")
+        print(f"trainer: mesh {layout} epochs={res.epochs_run} "
               f"val_loss={res.val_loss:.4f} "
               f"val_accuracy={res.val_accuracy:.3f}")
         return

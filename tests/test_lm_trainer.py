@@ -100,6 +100,24 @@ def test_fit_pipeline_gpipe_and_resume(tmp_path):
     assert res4.history[0]["epoch"] == 2
 
 
+def test_fit_pipeline_with_ema():
+    """pipeline_stages + ema_decay: the shadow is pp-layout opt_state, rides
+    the stacked-stage sharding, and eval reads it through the pipeline eval
+    step."""
+    import dataclasses
+
+    from ddw_tpu.train.step import ema_params
+
+    lm, tr = _cfgs(num_devices=4, epochs=1, pipeline_stages=4,
+                   pipeline_microbatches=4, ema_decay=0.9)
+    lm = dataclasses.replace(lm, depth=4)
+    res = LMTrainer(lm, tr).fit(_tokens())
+    assert res.epochs_run == 1 and np.isfinite(res.val_loss)
+    shadow = ema_params(res.state)
+    assert shadow is not None
+    assert jax.tree.structure(shadow) == jax.tree.structure(res.state.params)
+
+
 def test_fit_pipeline_interleaved():
     import dataclasses
 
@@ -198,6 +216,17 @@ def test_tracker_logging(tmp_path):
     run.end()
     hist = run.metric_history("val_loss")
     assert len(hist) == 2
+
+
+def test_ema_composes_with_zero():
+    """train.zero + ema_decay: the shadow is param-shaped opt_state covered
+    by the generic ZeRO leaf sharding; eval reads the sharded shadow."""
+    from ddw_tpu.train.step import ema_params
+
+    lm, tr = _cfgs(num_devices=4, epochs=1, zero=True, ema_decay=0.9)
+    res = LMTrainer(lm, tr).fit(_tokens())
+    assert res.epochs_run == 1 and np.isfinite(res.val_loss)
+    assert ema_params(res.state) is not None
 
 
 def test_ema_evaluates_shadow():
