@@ -30,3 +30,21 @@ def test_step_trace_smoke(tmp_path):
         [sys.executable, os.path.join(REPO, "tools/step_trace.py"), "nope"],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
     assert bad.returncode != 0 and "unknown configs" in bad.stderr
+
+    # the offline decomposition pass reads the capture back
+    summ = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/trace_summary.py"),
+         d["lm_flash"]["dir"], "--top", "5"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert summ.returncode == 0, summ.stderr[-2000:]
+    s = json.loads(summ.stdout.strip().splitlines()[-1])
+    assert s["processes"], s
+    proc = next(iter(s["processes"].values()))
+    assert proc["busy_ms"] > 0 and proc["top_ops"]
+    assert abs(sum(proc["buckets_pct"].values()) - 100) < 1
+
+    missing = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/trace_summary.py"),
+         str(tmp_path / "empty")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert missing.returncode != 0 and "trace.json.gz" in missing.stderr
