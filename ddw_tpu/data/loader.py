@@ -212,6 +212,13 @@ class ShardedLoader:
                              if table.meta.get("encoding") == "features_f32"
                              else None)
 
+        # Token table (prep.write_token_table): content is an int32 [S+1]
+        # sequence; batches are next-token pairs (inputs, targets) for the
+        # LM family — a memcpy per record, no image work.
+        self._token_len = (table.meta.get("seq_plus_one")
+                           if table.meta.get("encoding") == "tokens_i32"
+                           else None)
+
         # Pre-decoded table (prep.materialize_decoded): content is raw uint8
         # [H, W, 3] pixels; batches come from a memcpy + scale, no JPEG work.
         self._raw_u8 = table.meta.get("encoding") == "raw_u8"
@@ -311,6 +318,20 @@ class ShardedLoader:
 
     def _iter_batches(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         from ddw_tpu.native.decode import decode_batch_native, native_available
+
+        if self._token_len:
+            # Token fast path: yield next-token pairs [B, S] — the LM step's
+            # exact (inputs, targets) contract.
+            t = self._token_len
+            toks = np.empty((self.batch_size, t), np.int32)
+            i = 0
+            for content, _ in self._iter_raw_resumed():
+                toks[i] = np.frombuffer(content, np.int32, count=t)
+                i += 1
+                if i == self.batch_size:
+                    yield toks[:, :-1].copy(), toks[:, 1:].copy()
+                    i = 0
+            return  # drop remainder: static shapes for XLA
 
         if self._feature_dim:
             # Cached-feature fast path: batches are (B, D) f32 vectors — a

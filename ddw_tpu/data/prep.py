@@ -285,6 +285,30 @@ def materialize_decoded(
             shard_size=shard_size, meta=meta)
 
 
+def write_token_table(
+    store: TableStore,
+    name: str,
+    tokens,
+    shard_size: int = 2048,
+) -> Table:
+    """Materialize a token corpus ``[N, S+1]`` int32 as a ``tokens_i32``
+    table — the LM family's storage format, completing the same
+    store -> loader -> trainer path the vision families train through
+    (the reference's only corpus is images, ``01_data_prep.py``; the LM
+    stack is beyond parity and gets the same data discipline). The loader
+    detects ``meta.encoding == 'tokens_i32'`` and yields next-token pairs
+    ``(batch[:, :-1], batch[:, 1:])`` with zero decode work.
+    """
+    tokens = np.asarray(tokens, np.int32)
+    if tokens.ndim != 2 or tokens.shape[1] < 2 or tokens.shape[0] < 1:
+        raise ValueError(f"tokens must be a non-empty [num_seqs, seq_len+1], "
+                         f"got {tokens.shape}")
+    meta = {"encoding": "tokens_i32", "seq_plus_one": int(tokens.shape[1])}
+    recs = (Record(path=f"seq/{i:08d}", content=np.ascontiguousarray(row).tobytes())
+            for i, row in enumerate(tokens))
+    return store.write(name, recs, shard_size=shard_size, meta=meta)
+
+
 # ---------------------------------------------------------------------------
 # Synthetic flowers (zero-egress stand-in for tf_flowers)
 # ---------------------------------------------------------------------------

@@ -155,10 +155,10 @@ class LMTrainer:
     # ------------------------------------------------------------------
     def fit(self, tokens: np.ndarray, val_fraction: float = 0.1,
             resume: bool = False) -> LMTrainResult:
+        """Train from an in-memory token corpus ``[num_seqs, seq_len+1]``."""
         cfg = self.train_cfg
-        mesh = self.mesh
-        dp = mesh.shape[DATA_AXIS]
-        sp = mesh.shape.get(SEQ_AXIS, 1)
+        dp = self.mesh.shape[DATA_AXIS]
+        sp = self.mesh.shape.get(SEQ_AXIS, 1)
 
         tokens = np.asarray(tokens, np.int32)
         if tokens.ndim != 2 or tokens.shape[1] < 2:
@@ -180,6 +180,125 @@ class LMTrainer:
         if len(train) < global_batch:
             raise ValueError(f"{len(train)} train sequences < global batch "
                              f"{global_batch}")
+
+        def make_providers(start_epoch, step):
+            def train_batches(epoch):
+                order = np.random.RandomState(cfg.seed + 1 + epoch
+                                              ).permutation(len(train))
+                for i in range(steps_per_epoch):
+                    idx = order[i * global_batch:(i + 1) * global_batch]
+                    b = train[idx]
+                    yield b[:, :-1], b[:, 1:]
+
+            def val_batches():
+                for i in range(val_steps):
+                    # index modulo the split: every eval batch is exactly
+                    # global_batch (shard_map divisibility) even for tiny
+                    # validation sets
+                    idx = np.arange(i * global_batch,
+                                    (i + 1) * global_batch) % len(val)
+                    vb = val[idx]
+                    yield vb[:, :-1], vb[:, 1:]
+
+            return train_batches, val_batches
+
+        return self._run(seq_len, steps_per_epoch, val_steps, global_batch,
+                         make_providers, resume)
+
+    def fit_tables(self, train_table, val_table,
+                   resume: bool = False) -> LMTrainResult:
+        """Train from materialized token tables (``prep.write_token_table``)
+        — the LM family through the same store -> sharded-loader path the
+        vision families use: shard-selected reads, seeded shuffle, infinite
+        repeat, exact ``skip_records`` resume of the consumed stream."""
+        from ddw_tpu.data.loader import ShardedLoader
+
+        cfg = self.train_cfg
+        dp = self.mesh.shape[DATA_AXIS]
+        sp = self.mesh.shape.get(SEQ_AXIS, 1)
+
+        for tbl, role in ((train_table, "train"), (val_table, "val")):
+            if tbl.meta.get("encoding") != "tokens_i32":
+                raise ValueError(
+                    f"{role} table encoding "
+                    f"{tbl.meta.get('encoding')!r} != 'tokens_i32' — "
+                    f"materialize with prep.write_token_table")
+        spo = train_table.meta["seq_plus_one"]
+        if val_table.meta["seq_plus_one"] != spo:
+            raise ValueError("train/val token tables disagree on sequence "
+                             "length")
+        seq_len = spo - 1
+        if seq_len % sp:
+            raise ValueError(f"seq_len {seq_len} not divisible by "
+                             f"seq_devices {sp}")
+
+        global_batch = cfg.batch_size * dp
+        steps_per_epoch = train_table.num_records // global_batch
+        if steps_per_epoch < 1:
+            raise ValueError(f"{train_table.num_records} train sequences < "
+                             f"global batch {global_batch}")
+        val_steps = val_table.num_records // global_batch
+        if val_steps < 1:
+            raise ValueError(
+                f"{val_table.num_records} val sequences < global batch "
+                f"{global_batch} — the eval pass needs at least one full "
+                f"batch (static shapes)")
+
+        # Multi-process: each host reads a disjoint shard subset and a
+        # per-host slice of the batch; the loader assembles global arrays
+        # (make_array_from_process_local_data) via prefetch_to — the same
+        # wiring as the vision Trainer. PP lacks a batch sharding to
+        # assemble onto; refuse rather than silently duplicate data.
+        n_proc = jax.process_count()
+        if n_proc > 1 and self.pp:
+            raise ValueError("fit_tables under multi-process pipeline "
+                             "parallelism is not supported — run PP "
+                             "single-process or use fit(tokens)")
+        if global_batch % n_proc:
+            raise ValueError(f"global batch {global_batch} not divisible by "
+                             f"{n_proc} processes")
+        host_batch = global_batch // n_proc
+
+        def make_providers(start_epoch, step):
+            prefetch_to = getattr(step, "batch_sharding", None)
+            if n_proc > 1 and prefetch_to is None:
+                raise ValueError("multi-process fit_tables needs a step "
+                                 "with a batch sharding to assemble global "
+                                 "arrays")
+            shard_kw = dict(cur_shard=jax.process_index(),
+                            shard_count=n_proc, prefetch_to=prefetch_to)
+            train_iter = iter(ShardedLoader(
+                train_table, batch_size=host_batch, num_epochs=None,
+                shuffle=True, seed=cfg.seed + 1,
+                skip_records=start_epoch * steps_per_epoch * host_batch,
+                **shard_kw))
+
+            def train_batches(epoch):
+                for _ in range(steps_per_epoch):
+                    yield next(train_iter)
+
+            def val_batches():
+                # fresh unshuffled single pass per epoch: every eval sees
+                # the SAME leading val_steps full batches (no window drift
+                # across epochs or resumes)
+                loader = ShardedLoader(val_table, batch_size=host_batch,
+                                       num_epochs=1, shuffle=False,
+                                       **shard_kw)
+                for i, batch in enumerate(loader):
+                    if i >= val_steps:
+                        break
+                    yield batch
+
+            return train_batches, val_batches
+
+        return self._run(seq_len, steps_per_epoch, val_steps, global_batch,
+                         make_providers, resume)
+
+    def _run(self, seq_len, steps_per_epoch, val_steps, global_batch,
+             make_providers, resume) -> LMTrainResult:
+        cfg = self.train_cfg
+        mesh = self.mesh
+        dp = mesh.shape[DATA_AXIS]
 
         tx = make_optimizer(cfg)
         if cfg.ema_decay:
@@ -289,6 +408,8 @@ class LMTrainer:
                                  "steps_per_epoch": steps_per_epoch,
                                  "global_batch": global_batch})
 
+        train_batches, val_batches = make_providers(start_epoch, step)
+
         history: list[dict[str, float]] = []
         step_rng = jax.random.PRNGKey(cfg.seed + 1)
         epochs_run = start_epoch
@@ -300,19 +421,15 @@ class LMTrainer:
         host_step = int(jax.device_get(state.step))
         try:
             for epoch in range(start_epoch, cfg.epochs):
-                order = np.random.RandomState(cfg.seed + 1 + epoch
-                                              ).permutation(len(train))
                 tlosses, taccs = [], []
-                for i in range(steps_per_epoch):
+                for i, (inputs, targets) in enumerate(train_batches(epoch)):
                     lr = sched.lr_for_batch(epoch, i, steps_per_epoch)
                     if lr is not None:
                         state = set_lr(state, lr)
-                    idx = order[i * global_batch:(i + 1) * global_batch]
-                    batch = train[idx]
                     if self.pp:  # the pipeline step is deterministic: no rng
-                        state, m = step(state, batch[:, :-1], batch[:, 1:])
+                        state, m = step(state, inputs, targets)
                     else:
-                        state, m = step(state, batch[:, :-1], batch[:, 1:],
+                        state, m = step(state, inputs, targets,
                                         jax.random.fold_in(step_rng,
                                                            host_step))
                     host_step += 1
@@ -331,14 +448,8 @@ class LMTrainer:
                     # evaluate the Polyak shadow (what serving should ship)
                     eval_state = eval_state.replace(
                         params=ema_params(state), opt_state=())
-                for i in range(val_steps):
-                    # index modulo the split: every eval batch is exactly
-                    # global_batch (shard_map divisibility) even for tiny
-                    # validation sets
-                    idx = np.arange(i * global_batch,
-                                    (i + 1) * global_batch) % len(val)
-                    vb = val[idx]
-                    vm = eval_step(eval_state, vb[:, :-1], vb[:, 1:])
+                for vin, vtg in val_batches():
+                    vm = eval_step(eval_state, vin, vtg)
                     vlosses.append(vm["loss"])
                     vaccs.append(vm["accuracy"])
                 row = {

@@ -218,6 +218,55 @@ def test_tracker_logging(tmp_path):
     assert len(hist) == 2
 
 
+def test_fit_tables_learns_and_resumes(tmp_path):
+    """The LM family through the store -> sharded-loader path: token tables
+    materialized with write_token_table, trained via fit_tables with exact
+    epoch-boundary resume (skip_records replays the consumed stream)."""
+    import dataclasses
+
+    from ddw_tpu.data.prep import write_token_table
+    from ddw_tpu.data.store import TableStore
+
+    store = TableStore(str(tmp_path / "store"))
+    toks = _tokens(n=96)
+    train_tbl = write_token_table(store, "lm_train", toks[:80])
+    val_tbl = write_token_table(store, "lm_val", toks[80:])
+
+    lm, tr = _cfgs(num_devices=4, epochs=3,
+                   checkpoint_dir=str(tmp_path / "ck"),
+                   checkpoint_every_epochs=1)
+    res = LMTrainer(lm, tr).fit_tables(train_tbl, val_tbl)
+    assert res.epochs_run == 3 and np.isfinite(res.val_loss)
+    assert res.history[-1]["loss"] < res.history[0]["loss"]
+
+    res5 = LMTrainer(lm, dataclasses.replace(tr, epochs=5)).fit_tables(
+        train_tbl, val_tbl, resume=True)
+    assert res5.epochs_run == 5 and res5.history[0]["epoch"] == 3
+    assert int(jax.device_get(res5.state.step)) == 5 * (80 // 16)
+
+
+def test_fit_tables_refusals(tmp_path):
+    from ddw_tpu.data.prep import write_token_table
+    from ddw_tpu.data.store import TableStore
+
+    store = TableStore(str(tmp_path / "store"))
+    tok_tbl = write_token_table(store, "toks", _tokens(n=32))
+    short = write_token_table(store, "short", _tokens(n=32, seq=8))
+
+    lm, tr = _cfgs(num_devices=4)
+    with pytest.raises(ValueError, match="tokens_i32"):
+        # a non-token table (no encoding meta) refuses loudly
+        from ddw_tpu.data.store import Record
+
+        bad = store.write("bad", [Record(path="x", content=b"1234")], meta={})
+        LMTrainer(lm, tr).fit_tables(bad, tok_tbl)
+    with pytest.raises(ValueError, match="disagree"):
+        LMTrainer(lm, tr).fit_tables(tok_tbl, short)
+    with pytest.raises(ValueError, match="global batch"):
+        tiny = write_token_table(store, "tiny", _tokens(n=8))
+        LMTrainer(lm, tr).fit_tables(tiny, tok_tbl)
+
+
 def test_ema_composes_with_zero():
     """train.zero + ema_decay: the shadow is param-shaped opt_state covered
     by the generic ZeRO leaf sharding; eval reads the sharded shadow."""
