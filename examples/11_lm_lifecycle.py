@@ -60,10 +60,25 @@ def main():
     corpus = synthetic_text(rng, max(96, 3 * train_cfg.batch_size * n), seq,
                             lm_cfg.vocab_size)
 
-    # -- train (managed) ------------------------------------------------------
+    # -- prep: materialize the corpus as token tables -------------------------
+    # The image arc's store discipline for the LM family: a seeded split
+    # written once (prep.write_token_table), streamed back through the
+    # sharded loader by the trainer (fit_tables).
+    from ddw_tpu.data.prep import write_token_table
+    from ddw_tpu.data.store import TableStore
+
+    store = TableStore(os.path.join(args.workdir, "lm_store"))
+    split = np.random.RandomState(train_cfg.seed).permutation(len(corpus))
+    n_val = max(train_cfg.batch_size * n, len(corpus) // 10)
+    train_tbl = write_token_table(store, "lm_train", corpus[split[n_val:]])
+    val_tbl = write_token_table(store, "lm_val", corpus[split[:n_val]])
+    print(f"[prep] token tables: train={train_tbl.num_records} "
+          f"val={val_tbl.num_records} seq+1={train_tbl.meta['seq_plus_one']}")
+
+    # -- train (managed, table-fed) -------------------------------------------
     tracker = Tracker(os.path.join(args.workdir, "runs"), "workshop")
     run = tracker.start_run("lm_lifecycle")
-    res = LMTrainer(lm_cfg, train_cfg, run=run).fit(corpus)
+    res = LMTrainer(lm_cfg, train_cfg, run=run).fit_tables(train_tbl, val_tbl)
     run.end()
     print(f"[train] epochs={res.epochs_run} val_loss={res.val_loss:.4f} "
           f"val_accuracy={res.val_accuracy:.3f}")
@@ -97,10 +112,11 @@ def main():
     print(f"[generate] 12-token greedy continuation matches the arithmetic "
           f"stream {match:.0%}")
 
-    # the draft trains on the same corpus: agreement (and therefore
-    # acceptance) grows with how much signal both models have absorbed
+    # the draft trains on the same token tables: agreement (and therefore
+    # acceptance) grows with how much signal both models have absorbed, and
+    # the target's val split stays held out from BOTH models
     draft_cfg = dataclasses.replace(lm_cfg, hidden=32, depth=1, mlp_dim=64)
-    draft_res = LMTrainer(draft_cfg, train_cfg).fit(corpus)
+    draft_res = LMTrainer(draft_cfg, train_cfg).fit_tables(train_tbl, val_tbl)
     draft_dir = os.path.join(args.workdir, "lm_draft_package")
     save_lm_package(draft_dir, draft_cfg, draft_res.state.params,
                     quantize=quant)
