@@ -1,5 +1,5 @@
 from ddw_tpu.serving.package import PackagedModel, save_packaged_model, load_packaged_model  # noqa: F401
-from ddw_tpu.serving.batch import BatchScorer  # noqa: F401
+from ddw_tpu.serving.batch import BatchScorer, LMBatchScorer  # noqa: F401
 from ddw_tpu.serving.lm_package import (  # noqa: F401
     LMPackagedModel,
     load_lm_package,

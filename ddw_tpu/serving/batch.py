@@ -31,6 +31,25 @@ from ddw_tpu.runtime.mesh import DATA_AXIS, make_mesh, MeshSpec
 from ddw_tpu.serving.package import PackagedModel
 
 
+def _scoring_run_id(table: Table, content_digest: str) -> str:
+    """Deterministic scoring-run token — identical on every process for the
+    same (input table version, packaged model), without communication.
+    Shared by the image and LM scorers' part writes AND merge waits."""
+    return TableStore.run_token(table.manifest["name"],
+                                table.manifest["version"],
+                                content_digest)
+
+
+def _process_shards(table: Table) -> list[str]:
+    """This process's disjoint shard subset (round-robin by rank); small
+    tables fall to rank 0 — shared by the image and LM scorers."""
+    shards = table.shard_paths
+    n_proc = jax.process_count()
+    if len(shards) >= n_proc:
+        return shards[jax.process_index()::n_proc]
+    return shards if jax.process_index() == 0 else []
+
+
 class BatchScorer:
     """Score a table of JPEG-bytes records with a packaged model over the local
     devices of each participating host."""
@@ -62,11 +81,7 @@ class BatchScorer:
                               out_shardings=NamedSharding(self.mesh, P()))
 
     def _my_shards(self, table: Table) -> list[str]:
-        shards = table.shard_paths
-        n_proc = jax.process_count()
-        if len(shards) >= n_proc:
-            return shards[jax.process_index()::n_proc]
-        return shards if jax.process_index() == 0 else []
+        return _process_shards(table)
 
     def score_table(self, table: Table, out_store: TableStore | None = None,
                     out_name: str = "predictions",
@@ -208,11 +223,91 @@ class BatchScorer:
         return results
 
     def _run_id(self, table: Table) -> str:
-        """Deterministic scoring-run token — identical on every process for the
-        same (input table version, packaged model), without communication."""
-        return TableStore.run_token(table.manifest["name"],
-                                    table.manifest["version"],
-                                    self.model.content_digest)
+        return _scoring_run_id(table, self.model.content_digest)
+
+
+class LMBatchScorer:
+    """Score a ``tokens_i32`` table with a packaged LM over the local devices
+    — per-sequence mean next-token NLL (the ``spark_udf`` scoring role for
+    the language family; the tokens analog of :class:`BatchScorer`, same
+    shared-nothing host split and run-token part merge)."""
+
+    def __init__(self, model, mesh: Mesh | None = None,
+                 batch_per_device: int = 64):
+        from ddw_tpu.serving.lm_package import load_lm_package
+
+        self.model = (load_lm_package(model) if isinstance(model, str)
+                      else model)
+        if mesh is None:
+            mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)))
+        local = [d for d in np.asarray(mesh.devices).flat
+                 if d.process_index == jax.process_index()]
+        self.mesh = Mesh(np.asarray(local), (DATA_AXIS,))
+        self.batch = batch_per_device * len(local)
+        self._sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        from ddw_tpu.serving.lm_package import sequence_nll
+
+        pm = self.model
+        self._nll = jax.jit(
+            lambda tokens: sequence_nll(pm.model, pm.params, tokens),
+            in_shardings=self._sharding,
+            out_shardings=NamedSharding(self.mesh, P()))
+
+    def score_table(self, table: Table, out_store: TableStore | None = None,
+                    out_name: str = "lm_scores",
+                    merge: bool = True) -> list[tuple[str, float]]:
+        """Returns [(path, nll)] for this process's shard subset; with
+        ``out_store`` also writes a scores table (label = formatted NLL,
+        content = f32 bytes) and process 0 merges the per-process parts
+        under the same run-token discipline as the image scorer."""
+        if table.meta.get("encoding") != "tokens_i32":
+            raise ValueError(f"LMBatchScorer needs a tokens_i32 table, got "
+                             f"encoding {table.meta.get('encoding')!r} — "
+                             f"materialize with prep.write_token_table")
+        t = table.meta["seq_plus_one"]
+        if t - 1 > self.model.lm_cfg.max_len:
+            raise ValueError(f"table sequences ({t - 1}) exceed the packaged "
+                             f"model's max_len {self.model.lm_cfg.max_len}")
+        results: list[tuple[str, float]] = []
+        buf = np.zeros((self.batch, t), np.int32)
+        paths: list[str] = []
+
+        from ddw_tpu.serving.lm_package import check_token_ids
+
+        def flush():
+            if not paths:
+                return
+            n = len(paths)
+            buf[n:] = 0  # padded rows: valid ids, sliced off below
+            check_token_ids(buf[:n], self.model.lm_cfg.vocab_size)
+            dev = jax.device_put(buf, self._sharding)
+            nll = np.asarray(self._nll(dev))[:n]
+            results.extend((p, float(v)) for p, v in zip(paths, nll))
+            paths.clear()
+
+        for sp in _process_shards(table):
+            for rec in read_shard(sp):
+                buf[len(paths)] = np.frombuffer(rec.content, np.int32,
+                                                count=t)
+                paths.append(rec.path)
+                if len(paths) == self.batch:
+                    flush()
+        flush()
+
+        if out_store is not None:
+            n_proc = jax.process_count()
+            run_id = _scoring_run_id(table, self.model.content_digest)
+            name = (out_name if n_proc == 1
+                    else f"{out_name}_p{jax.process_index()}")
+            out_store.write(
+                name, (Record(path=p, content=np.float32(v).tobytes(),
+                              label=f"{v:.6f}") for p, v in results),
+                meta={"metric": "mean_next_token_nll",
+                      "source_table": table.manifest["name"],
+                      "run_id": run_id})
+            if merge and n_proc > 1 and jax.process_index() == 0:
+                merge_predictions(out_store, out_name, n_proc, run_id)
+        return results
 
 
 def merge_predictions(out_store: TableStore, out_name: str, n_parts: int,

@@ -39,6 +39,27 @@ _LM_FORMAT_VERSION_QUANT = 2
 _SUPPORTED = (_LM_FORMAT_VERSION, _LM_FORMAT_VERSION_QUANT)
 
 
+def sequence_nll(model, params, tokens):
+    """Per-sequence mean next-token NLL of ``tokens [B, S+1]`` — THE single
+    scoring definition, jitted by both :class:`LMPackagedModel` and
+    ``serving.batch.LMBatchScorer`` so the two paths cannot drift. Callers
+    must bounds-check token ids first (:func:`check_token_ids`): jnp gathers
+    clamp out-of-range indices, which would silently score the nearest
+    vocab row."""
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    logits = model.apply({"params": params}, inp, train=False)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    tok_ll = jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
+    return -jnp.mean(tok_ll, axis=-1)
+
+
+def check_token_ids(tokens, vocab_size: int) -> None:
+    """Refuse out-of-vocab ids before any gather sees them."""
+    if tokens.min() < 0 or tokens.max() >= vocab_size:
+        raise ValueError(f"token ids outside [0, {vocab_size}): "
+                         f"min={tokens.min()}, max={tokens.max()}")
+
+
 def save_lm_package(out_dir: str, lm_cfg: LMCfg, params,
                     extra_meta: dict | None = None,
                     quantize: str | None = None) -> str:
@@ -78,15 +99,8 @@ class LMPackagedModel:
         self.model = build_lm(self.lm_cfg)
         self.params = restored["params"]
 
-        def _nll(tokens):
-            inp, tgt = tokens[:, :-1], tokens[:, 1:]
-            logits = self.model.apply({"params": self.params}, inp,
-                                      train=False)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-            tok_ll = jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
-            return -jnp.mean(tok_ll, axis=-1)
-
-        self._nll = jax.jit(_nll)
+        self._nll = jax.jit(
+            lambda tokens: sequence_nll(self.model, self.params, tokens))
 
     def score(self, tokens) -> np.ndarray:
         """Mean next-token NLL per sequence; perplexity = exp(score)."""
@@ -96,12 +110,7 @@ class LMPackagedModel:
         if tokens.shape[1] - 1 > self.lm_cfg.max_len:
             raise ValueError(f"sequence {tokens.shape[1] - 1} exceeds "
                              f"max_len {self.lm_cfg.max_len}")
-        # jnp gathers clamp out-of-bounds indices, which would silently score
-        # a padding/sentinel id as the nearest vocab row
-        if tokens.min() < 0 or tokens.max() >= self.lm_cfg.vocab_size:
-            raise ValueError(
-                f"token ids outside [0, {self.lm_cfg.vocab_size}): "
-                f"min={tokens.min()}, max={tokens.max()}")
+        check_token_ids(tokens, self.lm_cfg.vocab_size)
         return np.asarray(self._nll(tokens))
 
     def generate(self, prompt, num_steps: int, **kw) -> np.ndarray:

@@ -105,6 +105,18 @@ def main():
           f"(ppl {np.exp(nll_noise):.1f})  "
           f"model_prefers_structure={nll_structured < nll_noise}")
 
+    # -- distributed batch scoring over the val table -------------------------
+    # The spark_udf leg for the LM family: shared-nothing shard split,
+    # per-sequence NLL, one scores table (ddw_tpu.serving.LMBatchScorer).
+    from ddw_tpu.serving import LMBatchScorer
+
+    rows = LMBatchScorer(pm, batch_per_device=8).score_table(
+        val_tbl, out_store=store)
+    table_nll = float(np.mean([v for _, v in rows]))
+    print(f"[batch-score] {len(rows)} val sequences -> "
+          f"{store.table('lm_scores').num_records}-row scores table "
+          f"(mean nll {table_nll:.3f})")
+
     # -- generate + speculative ----------------------------------------------
     prompt = probe[:1, :12]
     cont = pm.generate(prompt, num_steps=12)

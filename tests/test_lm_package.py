@@ -101,3 +101,63 @@ def test_format_guards(tmp_path):
     pm = load_lm_package(save_lm_package(str(tmp_path / "y"), cfg, params))
     with pytest.raises(ValueError, match="exceeds"):
         pm.score(_tokens(1, 128))
+
+
+def test_lm_batch_scorer_over_token_table(tmp_path):
+    """LMBatchScorer: per-sequence NLL over a tokens_i32 table matches the
+    package's own score() exactly (padding sliced off), order preserved,
+    scores table written with the run-token meta; encoding mismatches and
+    over-length sequences refuse loudly."""
+    from ddw_tpu.data.prep import write_token_table
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.serving.batch import LMBatchScorer
+
+    cfg, model, params = _trained()
+    d = save_lm_package(str(tmp_path / "pkg"), cfg, params)
+    pm = load_lm_package(d)
+
+    store = TableStore(str(tmp_path / "store"))
+    toks = _tokens(n=22, seq=16)  # 22 % batch != 0: padding path exercised
+    tbl = write_token_table(store, "toks", toks, shard_size=8)
+
+    scorer = LMBatchScorer(d, batch_per_device=2)  # 8 devices -> batch 16
+    rows = scorer.score_table(tbl, out_store=store)
+    assert len(rows) == 22
+    want = pm.score(toks)
+    got = np.array([v for _, v in rows])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert [p for p, _ in rows] == [r.path for r in tbl.iter_records()]
+
+    out = store.table("lm_scores")
+    assert out.num_records == 22
+    assert out.meta["metric"] == "mean_next_token_nll"
+    assert out.meta["run_id"]
+    rec = next(out.iter_records())
+    assert float(rec.label) == pytest.approx(
+        np.frombuffer(rec.content, np.float32)[0], abs=1e-5)
+
+    with pytest.raises(ValueError, match="tokens_i32"):
+        from ddw_tpu.data.store import Record
+
+        bad = store.write("bad", [Record(path="x", content=b"12")], meta={})
+        scorer.score_table(bad)
+    with pytest.raises(ValueError, match="max_len"):
+        long = write_token_table(store, "long", _tokens(n=4, seq=100))
+        scorer.score_table(long)
+
+
+def test_lm_batch_scorer_rejects_out_of_vocab(tmp_path):
+    """The batch scorer shares score()'s bounds discipline: out-of-vocab ids
+    refuse instead of silently clamping into the nearest vocab row."""
+    from ddw_tpu.data.prep import write_token_table
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.serving.batch import LMBatchScorer
+
+    cfg, _, params = _trained()
+    d = save_lm_package(str(tmp_path / "pkg"), cfg, params)
+    store = TableStore(str(tmp_path / "store"))
+    bad = _tokens(n=4, seq=16)
+    bad[0, 3] = VOCAB + 5
+    tbl = write_token_table(store, "bad", bad)
+    with pytest.raises(ValueError, match="token ids outside"):
+        LMBatchScorer(d, batch_per_device=1).score_table(tbl)
