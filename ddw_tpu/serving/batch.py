@@ -50,6 +50,33 @@ def _process_shards(table: Table) -> list[str]:
     return shards if jax.process_index() == 0 else []
 
 
+def _local_mesh(mesh: Mesh | None) -> Mesh:
+    """A 1-D data mesh over THIS process's addressable devices (scoring is
+    shared-nothing: a global-mesh program would deadlock on unequal shard
+    counts — module docstring)."""
+    if mesh is None:
+        mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)))
+    local = [d for d in np.asarray(mesh.devices).flat
+             if d.process_index == jax.process_index()]
+    return Mesh(np.asarray(local), (DATA_AXIS,))
+
+
+def _write_scored_table(out_store: TableStore, out_name: str, records,
+                        meta: dict, table: Table, content_digest: str,
+                        merge: bool) -> None:
+    """The multi-host scores-table protocol, shared by both scorer families:
+    per-process ``{out_name}_pN`` parts stamped with the run token, rank-0
+    merge wait."""
+    n_proc = jax.process_count()
+    run_id = _scoring_run_id(table, content_digest)
+    name = out_name if n_proc == 1 else f"{out_name}_p{jax.process_index()}"
+    out_store.write(name, records,
+                    meta={**meta, "source_table": table.manifest["name"],
+                          "run_id": run_id})
+    if merge and n_proc > 1 and jax.process_index() == 0:
+        merge_predictions(out_store, out_name, n_proc, run_id)
+
+
 class BatchScorer:
     """Score a table of JPEG-bytes records with a packaged model over the local
     devices of each participating host."""
@@ -57,13 +84,8 @@ class BatchScorer:
     def __init__(self, model: PackagedModel | str, mesh: Mesh | None = None,
                  batch_per_device: int = 128, workers: int = 4):
         self.model = model if isinstance(model, PackagedModel) else PackagedModel(model)
-        if mesh is None:
-            mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)))
-        # Restrict to this process's addressable devices (see module docstring).
-        local = [d for d in np.asarray(mesh.devices).flat
-                 if d.process_index == jax.process_index()]
-        self.mesh = Mesh(np.asarray(local), (DATA_AXIS,))
-        self.n_devices = len(local)
+        self.mesh = _local_mesh(mesh)
+        self.n_devices = self.mesh.devices.size
         self.batch = batch_per_device * self.n_devices
         self.workers = workers
         self._sharding = NamedSharding(self.mesh, P(DATA_AXIS))
@@ -79,9 +101,6 @@ class BatchScorer:
         self._apply = jax.jit(apply_fn,
                               in_shardings=self._sharding,
                               out_shardings=NamedSharding(self.mesh, P()))
-
-    def _my_shards(self, table: Table) -> list[str]:
-        return _process_shards(table)
 
     def score_table(self, table: Table, out_store: TableStore | None = None,
                     out_name: str = "predictions",
@@ -114,7 +133,7 @@ class BatchScorer:
                 f"JPEG silver table")
 
         def records():
-            for sp in self._my_shards(table):
+            for sp in _process_shards(table):
                 yield from read_shard(sp)
 
         def score(imgs: np.ndarray, n: int, paths: list[str]):
@@ -210,20 +229,13 @@ class BatchScorer:
                     score(np.stack(buf_imgs), len(buf_imgs), buf_paths)
 
         if out_store is not None:
-            n_proc = jax.process_count()
-            run_id = self._run_id(table)
-            name = out_name if n_proc == 1 else f"{out_name}_p{jax.process_index()}"
-            out_store.write(name, (Record(path=p, content=b"", label=pred)
-                                   for p, pred in results),
-                            meta={"model_classes": self.model.classes,
-                                  "source_table": table.manifest["name"],
-                                  "run_id": run_id})
-            if merge and n_proc > 1 and jax.process_index() == 0:
-                merge_predictions(out_store, out_name, n_proc, run_id)
+            _write_scored_table(
+                out_store, out_name,
+                (Record(path=p, content=b"", label=pred)
+                 for p, pred in results),
+                {"model_classes": self.model.classes}, table,
+                self.model.content_digest, merge)
         return results
-
-    def _run_id(self, table: Table) -> str:
-        return _scoring_run_id(table, self.model.content_digest)
 
 
 class LMBatchScorer:
@@ -238,12 +250,8 @@ class LMBatchScorer:
 
         self.model = (load_lm_package(model) if isinstance(model, str)
                       else model)
-        if mesh is None:
-            mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)))
-        local = [d for d in np.asarray(mesh.devices).flat
-                 if d.process_index == jax.process_index()]
-        self.mesh = Mesh(np.asarray(local), (DATA_AXIS,))
-        self.batch = batch_per_device * len(local)
+        self.mesh = _local_mesh(mesh)
+        self.batch = batch_per_device * self.mesh.devices.size
         self._sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         from ddw_tpu.serving.lm_package import sequence_nll
 
@@ -295,18 +303,12 @@ class LMBatchScorer:
         flush()
 
         if out_store is not None:
-            n_proc = jax.process_count()
-            run_id = _scoring_run_id(table, self.model.content_digest)
-            name = (out_name if n_proc == 1
-                    else f"{out_name}_p{jax.process_index()}")
-            out_store.write(
-                name, (Record(path=p, content=np.float32(v).tobytes(),
-                              label=f"{v:.6f}") for p, v in results),
-                meta={"metric": "mean_next_token_nll",
-                      "source_table": table.manifest["name"],
-                      "run_id": run_id})
-            if merge and n_proc > 1 and jax.process_index() == 0:
-                merge_predictions(out_store, out_name, n_proc, run_id)
+            _write_scored_table(
+                out_store, out_name,
+                (Record(path=p, content=np.float32(v).tobytes(),
+                        label=f"{v:.6f}") for p, v in results),
+                {"metric": "mean_next_token_nll"}, table,
+                self.model.content_digest, merge)
         return results
 
 
