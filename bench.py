@@ -49,6 +49,14 @@ import sys
 import threading
 import time
 
+# Persistent XLA compilation cache, set BEFORE jax import: tunnel windows are
+# ~10-20 min and cold compiles cost 30-420 s each — a retried or A/B'd config
+# must reuse the programs the first attempt already paid for.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchruns",
+                 "xla_cache"))
+
 import jax
 import jax.numpy as jnp
 import numpy as np

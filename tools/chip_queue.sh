@@ -30,6 +30,12 @@ flock -n 9 || { echo "[queue] another instance holds $LOGDIR/.lock — exiting" 
 # record CPU timings as v5e results or burn attempts on 1000x-slow runs).
 export DDW_REQUIRE_TPU=1
 
+# Persistent XLA compilation cache shared by every queue item: a wedged
+# attempt's compiles are not lost — the retry (and every A/B arm sharing a
+# program) skips straight to measurement. Windows are minutes; compiles are
+# the single largest spend inside them.
+export JAX_COMPILATION_CACHE_DIR="$LOGDIR/xla_cache"
+
 log() { echo "[queue] $(date -u +%Y-%m-%dT%H:%M:%SZ) $*" >> "$QLOG"; }
 
 probe() {
