@@ -83,7 +83,7 @@ run_item() {
 log "runner started pid=$$"
 while :; do
   all_done=1
-  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan e2e_loader ab_vit_attn ab_lm_attn step_trace chip_kernels conv_profile_mn conv_profile_rn ab_conv fa2_sweep packaged_infer packaged_infer_int8 serving_curve; do
+  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan e2e_loader ab_vit_attn ab_lm_attn ab_lm_remat step_trace chip_kernels conv_profile_mn conv_profile_rn ab_conv fa2_sweep packaged_infer packaged_infer_int8 serving_curve; do
     [ -f "$LOGDIR/$name.done" ] || { [ -f "$LOGDIR/$name.attempts" ] && [ "$(cat "$LOGDIR/$name.attempts")" -ge "$MAX_ATTEMPTS" ]; } || all_done=0
   done
   if [ "$all_done" -eq 1 ]; then
@@ -114,6 +114,9 @@ while :; do
     # whole-step complement to fa2_sweep's isolated-kernel table.
     run_item ab_vit_attn     "DDW_BENCH_STALL_S=900 DDW_ATTN_XLA_PLAIN_MAX=1073741824 DDW_BENCH_ONLY=vit python -u bench.py" || continue
     run_item ab_lm_attn      "DDW_BENCH_STALL_S=900 DDW_ATTN_XLA_PLAIN_MAX=0 DDW_ATTN_XLA_CKPT_MAX=0 DDW_BENCH_ONLY=lm_flash python -u bench.py" || continue
+    # Remat FLOP/HBM trade at the bench shape (knob landed round 3, never
+    # yet queued): checkpoint-dots vs none on the headline LM row.
+    run_item ab_lm_remat     "DDW_BENCH_STALL_S=900 DDW_BENCH_LM_REMAT=dots DDW_BENCH_ONLY=lm_flash python -u bench.py" || continue
     # Per-op profiler traces of the two transformer steps, for offline
     # analysis after the window closes.
     run_item step_trace      "python -u tools/step_trace.py" || continue
