@@ -2,6 +2,7 @@ from ddw_tpu.runtime.mesh import (  # noqa: F401
     HybridMeshSpec,
     MeshSpec,
     device_slice_index,
+    make_data_mesh,
     make_hybrid_mesh,
     make_mesh,
     initialize_distributed,

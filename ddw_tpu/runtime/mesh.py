@@ -233,6 +233,28 @@ def make_hybrid_mesh(
     return Mesh(arr, tuple(name for name, _, _ in shape))
 
 
+def make_data_mesh(devices: Sequence[jax.Device] | None = None,
+                   slice_index_fn=None) -> Mesh:
+    """The trainers' default 1-D ``data`` mesh — DCN-aware automatically.
+
+    When the devices span multiple slices the axis lays out slice-major
+    (:func:`make_hybrid_mesh`): the per-step gradient reduction reduces over
+    ICI inside each slice and crosses the DCN once, with zero configuration.
+    Single-slice (or unequal-slice, e.g. a truncated ``num_devices``)
+    device sets get the plain ICI-optimized mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    fn = slice_index_fn or device_slice_index
+    if len({fn(d) for d in devices}) > 1:
+        try:
+            return make_hybrid_mesh(((DATA_AXIS, -1, -1),), devices=devices,
+                                    slice_index_fn=fn)
+        except ValueError:
+            pass  # unequal slices: flat mesh is the honest layout
+    return make_mesh(MeshSpec(((DATA_AXIS, -1),)), devices=devices)
+
+
 def make_mesh(
     spec: MeshSpec | Sequence[tuple[str, int]] | None = None,
     devices: Sequence[jax.Device] | None = None,

@@ -30,7 +30,8 @@ import numpy as np
 
 from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.models.lm import build_lm
-from ddw_tpu.runtime.mesh import DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MeshSpec, make_mesh
+from ddw_tpu.runtime.mesh import (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MeshSpec,
+                                  make_data_mesh, make_mesh)
 from ddw_tpu.train.lm_step import (
     init_lm_state,
     make_lm_eval_step,
@@ -122,11 +123,23 @@ class LMTrainer:
                 mesh = make_mesh(MeshSpec(((DATA_AXIS, n // stages),
                                            (PIPE_AXIS, stages))),
                                  devices=devices)
+            elif seq_devices == 1:
+                ep = lm_cfg.num_experts and not (self.pp or self.sharded)
+                if ep:
+                    # EP all-to-alls ride the data axis PER LAYER — the
+                    # slice-major hybrid layout would put them on the DCN
+                    # (exactly what HybridMeshSpec refuses for model/seq).
+                    # Keep the flat ICI-optimized mesh for MoE routing.
+                    mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)),
+                                     devices=devices)
+                else:
+                    # DCN-aware by default (runtime.mesh.make_data_mesh)
+                    mesh = make_data_mesh(devices=devices)
             else:
                 dp = n // seq_devices
-                axes = ((DATA_AXIS, dp),) if seq_devices == 1 else (
-                    (DATA_AXIS, dp), (SEQ_AXIS, seq_devices))
-                mesh = make_mesh(MeshSpec(axes), devices=devices)
+                mesh = make_mesh(MeshSpec(((DATA_AXIS, dp),
+                                           (SEQ_AXIS, seq_devices))),
+                                 devices=devices)
         if self.pp:
             # A user-supplied mesh must actually realize the configured
             # layout — a silent stage-count mismatch or a missing data axis

@@ -36,7 +36,7 @@ from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.data.loader import ShardedLoader
 from ddw_tpu.data.store import Table
 from ddw_tpu.models.registry import build_model
-from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
+from ddw_tpu.runtime.mesh import make_data_mesh, make_mesh, MeshSpec, DATA_AXIS
 from ddw_tpu.tracking.tracker import Run
 from ddw_tpu.train.schedule import ScheduleSuite
 from ddw_tpu.train.step import (
@@ -130,7 +130,9 @@ class Trainer:
             devices = jax.devices()
             if train_cfg.num_devices:
                 devices = devices[: train_cfg.num_devices]
-            mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)), devices=devices)
+            # DCN-aware by default: multi-slice jobs get a slice-major data
+            # axis with zero configuration (runtime.mesh.make_data_mesh).
+            mesh = make_data_mesh(devices=devices)
         self.mesh = mesh
         self.run = run
         self.model = model if model is not None else build_model(model_cfg)
