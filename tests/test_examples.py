@@ -46,6 +46,10 @@ _EXAMPLES = [
     ("07_lm_long_context.py",
      ["--trainer", "--pipeline", "4", "lm.depth=4", "train.epochs=2"],
      "trainer: mesh pipe=4"),
+    ("07_lm_long_context.py",
+     ["--trainer", "--pipeline", "4", "lm.depth=8", "train.epochs=1",
+      "train.pipeline_schedule=interleaved", "train.pipeline_microbatches=2"],
+     "trainer: mesh pipe=4"),
     ("09_lora_finetune.py", [], "base_frozen=True"),
     ("10_fsdp_elastic.py", ["train.epochs=2"], "elastic 8 -> 4"),
     ("11_lm_lifecycle.py", ["train.epochs=2"], "model_prefers_structure=True"),
