@@ -171,3 +171,60 @@ class CheckpointManager:
         path = os.path.join(self.ckpt_dir, f"step_{step:010d}", "metadata.json")
         with open(path) as f:
             return json.load(f)
+
+
+class BestCheckpointKeeper:
+    """Keep the single best-``val_loss`` checkpoint under ``<dir>/best``.
+
+    The main checkpoint stream is a resume mechanism with a newest-K
+    retention policy — an old best would be pruned. Model *selection* (the
+    reference picks its production model by best metric,
+    ``01_hyperopt_single_machine_model.py:253-262``) therefore lives in its
+    own single-slot directory: whenever an epoch's ``val_loss`` beats every
+    previous one (including across resumes — the slot's own metadata seeds
+    the bar), the state is saved there with the epoch's metrics.
+
+    ``make_manager(dir)`` builds the underlying manager, so the keeper works
+    unchanged over the classic full-state format AND the ZeRO/FSDP
+    per-process sharded format (the trainers pass their own factory).
+    """
+
+    def __init__(self, ckpt_dir: str, make_manager=None):
+        make_manager = make_manager or (
+            lambda d: CheckpointManager(d, keep=1))
+        self._mgr = make_manager(os.path.join(ckpt_dir, "best"))
+        # The slot is indexed by its own monotonic counter, NOT the train
+        # step: retention prunes by step order, and a new best written at a
+        # LOWER train step than a stale slot (fresh run into an old dir)
+        # would otherwise be the one deleted. The true train step rides in
+        # metadata.
+        self._slot = self._mgr.latest_step() or 0
+        meta = self._mgr.read_metadata() if self._slot else None
+        self.best_val_loss = ((meta or {}).get("metrics") or {}).get(
+            "val_loss", float("inf"))
+
+    def maybe_save(self, state, step: int, metrics: dict,
+                   extra_metadata: dict | None = None) -> bool:
+        """Save iff this epoch's val_loss is a strict new best; returns
+        whether it saved. NaN never qualifies (and never poisons the bar —
+        ``not (nan < x)`` keeps refusing)."""
+        if not (metrics["val_loss"] < self.best_val_loss):
+            return False
+        self.best_val_loss = metrics["val_loss"]
+        self._slot += 1
+        self._mgr.save(state, self._slot,
+                       metadata={**(extra_metadata or {}),
+                                 "train_step": int(step),
+                                 "metrics": dict(metrics)})
+        return True
+
+    def restore(self, target):
+        """Restore the best slot into ``target``; returns ``(state, slot)``
+        (the training step is in ``read_metadata()['train_step']``)."""
+        return self._mgr.restore(target)
+
+    def read_metadata(self):
+        return self._mgr.read_metadata()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -410,6 +410,22 @@ class LMTrainer:
             # a restored already-sharded state.
             state = step.place_state(state)
 
+        best = None
+        if cfg.checkpoint_keep_best:
+            if not ckpt:
+                raise ValueError("checkpoint_keep_best needs a "
+                                 "checkpoint_dir")
+            from ddw_tpu.checkpoint.ckpt import BestCheckpointKeeper
+            from ddw_tpu.train.trainer import _ZeroCheckpointAdapter
+
+            best = BestCheckpointKeeper(
+                cfg.checkpoint_dir,
+                (lambda d: _ZeroCheckpointAdapter(d, mesh, DATA_AXIS,
+                                                  fsdp=cfg.fsdp, keep=1))
+                if self.sharded else
+                (lambda d: CheckpointManager(
+                    d, keep=1, async_write=cfg.async_checkpoint)))
+
         sched = ScheduleSuite.build(cfg, dp, restored_meta)
 
         if self.run is not None:
@@ -490,11 +506,15 @@ class LMTrainer:
                               metadata={"epoch": epoch,
                                         "callbacks": sched.state_dicts(),
                                         "metrics": row})
+                if best is not None:
+                    best.maybe_save(state, host_step, row, {"epoch": epoch})
                 if stop:
                     break
         finally:
             if ckpt:
                 ckpt.close()
+            if best is not None:
+                best.close()
 
         last = history[-1] if history else {"val_loss": float("nan"),
                                             "val_accuracy": float("nan")}

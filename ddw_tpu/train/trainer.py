@@ -61,10 +61,11 @@ class _ZeroCheckpointAdapter:
     is collective (every process writes its shards), matching how the trainer
     already calls it on every rank."""
 
-    def __init__(self, ckpt_dir: str, mesh, axis: str, fsdp: bool = False):
+    def __init__(self, ckpt_dir: str, mesh, axis: str, fsdp: bool = False,
+                 keep: int = 3):
         from ddw_tpu.checkpoint.sharded import ShardedCheckpointManager
 
-        self._mgr = ShardedCheckpointManager(ckpt_dir)
+        self._mgr = ShardedCheckpointManager(ckpt_dir, keep=keep)
         self._mesh, self._axis, self._fsdp = mesh, axis, fsdp
 
     def save(self, state, step: int, metadata: dict | None = None):
@@ -256,6 +257,21 @@ class Trainer:
             # already-sharded state)
             state = train_step.place_state(state)
 
+        best = None
+        if cfg.checkpoint_keep_best:
+            if not ckpt:
+                raise ValueError("checkpoint_keep_best needs a "
+                                 "checkpoint_dir")
+            from ddw_tpu.checkpoint.ckpt import BestCheckpointKeeper
+
+            best = BestCheckpointKeeper(
+                cfg.checkpoint_dir,
+                (lambda d: _ZeroCheckpointAdapter(d, self.mesh, cfg.data_axis,
+                                                  fsdp=cfg.fsdp, keep=1))
+                if sharded_state else
+                (lambda d: CheckpointManager(
+                    d, keep=1, async_write=cfg.async_checkpoint)))
+
         # warmup/cosine/plateau/early + counter restore, shared with the LM
         # trainer (train/schedule.py holds the ordering/resume rules)
         sched = ScheduleSuite.build(cfg, world, restored_meta)
@@ -374,6 +390,9 @@ class Trainer:
                                   metadata={"epoch": epoch, "val_loss": val_loss,
                                             "val_accuracy": val_acc,
                                             "callbacks": sched.state_dicts()})
+                    if best is not None:
+                        best.maybe_save(state, int(jax.device_get(state.step)),
+                                        row, {"epoch": epoch})
                     if stop:
                         break
 
@@ -391,4 +410,6 @@ class Trainer:
                     # thread must be joined either way
                     if ckpt is not None:
                         ckpt.close()
+                    if best is not None:
+                        best.close()
             return TrainResult(val_loss, val_acc, history, state, epochs_run)

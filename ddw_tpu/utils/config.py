@@ -171,6 +171,10 @@ class TrainCfg:
                                         # still synchronous) so IO overlaps the
                                         # next epoch's compute
     checkpoint_every_epochs: int = 1
+    checkpoint_keep_best: bool = False  # also keep the single best-val_loss
+                                        # state under <checkpoint_dir>/best
+                                        # (model selection; the resume stream's
+                                        # newest-K retention would prune it)
     log_every_steps: int = 10
     trace_dir: str = ""                 # --trace flag role (jax.profiler), SURVEY §5
     debug_cross_host_checks: bool = False  # SPMD consistency sanitizer, SURVEY §5

@@ -267,6 +267,60 @@ def test_fit_tables_refusals(tmp_path):
         LMTrainer(lm, tr).fit_tables(tiny, tok_tbl)
 
 
+def test_best_checkpoint_keeper_slot_semantics(tmp_path):
+    """The keeper saves only strict improvements, and a reopened keeper
+    seeds its bar from the slot's own metadata (cross-resume behavior)."""
+    from ddw_tpu.checkpoint.ckpt import BestCheckpointKeeper
+
+    state = {"w": np.arange(4.0)}
+    k = BestCheckpointKeeper(str(tmp_path))
+    assert k.maybe_save(state, 100, {"val_loss": 1.0})
+    assert not k.maybe_save(state, 200, {"val_loss": 2.0})  # worse: kept out
+    assert not k.maybe_save(state, 300, {"val_loss": float("nan")})
+    assert k.best_val_loss == pytest.approx(1.0)  # NaN cannot poison the bar
+    k.close()
+
+    k2 = BestCheckpointKeeper(str(tmp_path))
+    assert k2.best_val_loss == pytest.approx(1.0)  # seeded from the slot
+    assert not k2.maybe_save(state, 300, {"val_loss": 1.5})
+    # a better save at a LOWER train step than the slot still wins (slot
+    # counter, not train step, drives retention)
+    assert k2.maybe_save({"w": np.ones(4)}, 4, {"val_loss": 0.5})
+    got, _ = k2.restore({"w": np.zeros(4)})
+    assert np.allclose(got["w"], 1.0)
+    assert k2.read_metadata()["train_step"] == 4
+    k2.close()
+
+
+def test_keep_best_checkpoint(tmp_path):
+    """checkpoint_keep_best through the trainer: the <dir>/best slot tracks
+    the minimum val_loss across the original fit AND its resume (the resume
+    stream's newest-K retention cannot prune it)."""
+    import dataclasses
+
+    from ddw_tpu.checkpoint.ckpt import CheckpointManager
+
+    lm, tr = _cfgs(num_devices=4, epochs=3,
+                   checkpoint_dir=str(tmp_path / "ck"),
+                   checkpoint_every_epochs=1, checkpoint_keep_best=True)
+    res = LMTrainer(lm, tr).fit(_tokens())
+    best_dir = str(tmp_path / "ck" / "best")
+    meta = CheckpointManager(best_dir).read_metadata()
+    assert meta["metrics"]["val_loss"] == pytest.approx(
+        min(r["val_loss"] for r in res.history), abs=1e-6)
+
+    res4 = LMTrainer(lm, dataclasses.replace(tr, epochs=4)).fit(
+        _tokens(), resume=True)
+    all_vals = [r["val_loss"] for r in res.history + res4.history]
+    meta2 = CheckpointManager(best_dir).read_metadata()
+    assert meta2["metrics"]["val_loss"] == pytest.approx(min(all_vals),
+                                                         abs=1e-6)
+
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        LMTrainer(lm, _cfgs(num_devices=4,
+                            checkpoint_keep_best=True)[1]).fit(_tokens())
+
+
 def test_ema_composes_with_zero():
     """train.zero + ema_decay: the shadow is param-shaped opt_state covered
     by the generic ZeRO leaf sharding; eval reads the sharded shadow."""

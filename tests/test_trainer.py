@@ -160,3 +160,24 @@ def test_warmup_ramps_per_batch():
     # world 1: no ramp, constant base
     w1 = LRWarmup(base_lr=1e-3, world_size=1, warmup_epochs=2)
     assert w1.lr_for_step(0, 0, steps) == pytest.approx(1e-3)
+
+
+def test_keep_best_checkpoint(small_cfgs, silver, tmp_path):
+    """checkpoint_keep_best (vision): <dir>/best holds the min-val_loss
+    epoch's state with its metrics, independent of the resume stream."""
+    from ddw_tpu.checkpoint.ckpt import CheckpointManager
+
+    train_tbl, val_tbl, _ = silver
+    ck = str(tmp_path / "ck_best")
+    tr = _mk_trainer(small_cfgs, silver, tmp_path, epochs=3,
+                     checkpoint_dir=ck, checkpoint_keep_best=True)
+    res = tr.fit(train_tbl, val_tbl)
+    meta = CheckpointManager(str(tmp_path / "ck_best" / "best")).read_metadata()
+    assert meta["metrics"]["val_loss"] == pytest.approx(
+        min(r["val_loss"] for r in res.history), abs=1e-6)
+    assert "val_accuracy" in meta["metrics"]
+
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        _mk_trainer(small_cfgs, silver, tmp_path, epochs=1,
+                    checkpoint_dir="", checkpoint_keep_best=True).fit(
+            train_tbl, val_tbl)
