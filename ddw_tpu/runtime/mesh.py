@@ -169,16 +169,21 @@ class HybridMeshSpec:
 def device_slice_index(d: jax.Device) -> int:
     """Which slice (pod unit connected by ICI) a device belongs to.
 
-    Real multi-slice TPU backends expose ``slice_index``; elsewhere (CPU
-    meshes, single-slice TPUs) fall back to the owning process — which is
-    exactly right for the CPU stand-in where each launcher process plays
-    one slice, and harmless on a single slice (every device maps to 0 or
-    its host; equal-sized groups still form).
+    Real multi-slice TPU backends expose ``slice_index``. An accelerator
+    device WITHOUT it must be treated as single-slice: inferring slices
+    from ``process_index`` would make every multi-host single-slice pod
+    (on a jax build lacking the attribute) look multi-slice and silently
+    trade ``mesh_utils``' pod-wide ICI-aware ordering for a host-major
+    layout — a perf regression with no DCN to justify it. Only the CPU
+    stand-in (launcher gang tests, where each process plays one slice)
+    keeps the process-index fallback.
     """
     idx = getattr(d, "slice_index", None)
     if idx is not None:
         return int(idx)
-    return int(d.process_index)
+    if d.platform == "cpu":
+        return int(d.process_index)
+    return 0
 
 
 def make_hybrid_mesh(

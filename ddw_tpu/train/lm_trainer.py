@@ -398,6 +398,11 @@ class LMTrainer:
                 f"resume=True restored a checkpoint at epoch {start_epoch} "
                 f">= cfg.epochs={cfg.epochs}; the run is already complete — "
                 f"returning the checkpointed metrics, no training performed")
+            if self.pp or self.sharded:
+                # Same placement contract as every normal completion:
+                # callers that keep training or serving from result.state
+                # must not see placement depend on which path returned.
+                state = step.place_state(state)
             return LMTrainResult(val_loss=saved["val_loss"],
                                  val_accuracy=saved["val_accuracy"],
                                  history=[saved], state=state,

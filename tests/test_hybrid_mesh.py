@@ -141,6 +141,24 @@ def test_make_data_mesh_auto_detects_slices():
     assert dict(uneven.shape) == {"data": 6}
 
 
+def test_device_slice_index_tpu_without_attr_is_single_slice():
+    """A TPU device lacking slice_index must map to slice 0, not its host:
+    a multi-host single-slice pod on a jax build without the attribute
+    would otherwise silently lose mesh_utils' pod-wide ICI ordering to a
+    host-major hybrid layout. CPU devices keep the process-index fallback
+    (the launcher gang stand-in depends on it)."""
+    from types import SimpleNamespace
+
+    from ddw_tpu.runtime.mesh import device_slice_index
+
+    tpu_no_attr = SimpleNamespace(platform="tpu", process_index=3)
+    assert device_slice_index(tpu_no_attr) == 0
+    tpu_with = SimpleNamespace(platform="tpu", process_index=3, slice_index=2)
+    assert device_slice_index(tpu_with) == 2
+    cpu = SimpleNamespace(platform="cpu", process_index=3)
+    assert device_slice_index(cpu) == 3
+
+
 def _slice_report():
     """Runs inside each launcher worker: two processes = two slices."""
     import jax

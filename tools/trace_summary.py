@@ -47,25 +47,59 @@ def load_events(trace_dir: str):
     if not paths:
         raise SystemExit(f"no *.trace.json.gz under {trace_dir} — pass the "
                          f"directory given to jax.profiler.trace")
-    events, procs = [], {}
-    for p in paths:
+    # pids are only unique WITHIN one trace file — a multi-host capture (one
+    # file per host) reuses them. Key everything by (file_idx, pid) so one
+    # file's op-lane filter can never drop another file's events.
+    events, procs, threads = [], {}, {}
+    for fi, p in enumerate(paths):
         d = json.loads(gzip.open(p).read())
         for e in d.get("traceEvents", []):
             if e.get("ph") == "M" and e.get("name") == "process_name":
-                procs[e["pid"]] = e["args"]["name"]
+                procs[(fi, e["pid"])] = e["args"]["name"]
+            elif e.get("ph") == "M" and e.get("name") == "thread_name":
+                threads[(fi, e["pid"], e.get("tid"))] = e["args"]["name"]
             elif e.get("ph") == "X" and e.get("dur", 0) > 0:
+                e["_fpid"] = (fi, e["pid"])
                 events.append(e)
-    return events, procs
+    return events, procs, threads
 
 
 def summarize(trace_dir: str, top: int) -> dict:
-    events, procs = load_events(trace_dir)
+    events, procs, threads = load_events(trace_dir)
+    # jax.profiler's Chrome export nests lanes under each device pid: "XLA
+    # Modules" / "Steps" spans ENCLOSE the per-op "XLA Ops" events, so summing
+    # every 'X' event under a pid double-counts — busy_ms can exceed wall
+    # time. Keep only the op-level lane(s) where one exists; pids without a
+    # recognizable op lane (host threads, CPU captures) keep all lanes.
+    op_tids: dict = collections.defaultdict(set)
+    for (fi, pid, tid), name in threads.items():
+        if "xla ops" in name.lower():
+            op_tids[(fi, pid)].add(tid)
+    # Display names collide across files too (every host calls its device
+    # "/device:TPU:0") — merging them would sum distinct devices' busy time
+    # into one entry. Suffix the file index only when a name is ambiguous.
+    name_files: dict = collections.defaultdict(set)
+    for (fi, pid), name in procs.items():
+        name_files[name].add(fi)
+
+    def display(fpid):
+        name = procs.get(fpid, str(fpid))
+        if len(name_files.get(name, ())) > 1:
+            return f"{name} [file{fpid[0]}]"
+        return name
+
     per_proc: dict = collections.defaultdict(lambda: collections.Counter())
     counts: dict = collections.defaultdict(lambda: collections.Counter())
+    lanes_used: dict = collections.defaultdict(set)
     for e in events:
-        key = procs.get(e["pid"], str(e["pid"]))
+        fpid = e["_fpid"]
+        if op_tids.get(fpid) and e.get("tid") not in op_tids[fpid]:
+            continue
+        key = display(fpid)
         per_proc[key][e["name"]] += e["dur"]
         counts[key][e["name"]] += 1
+        lanes_used[key].add(
+            threads.get((*fpid, e.get("tid")), str(e.get("tid"))))
 
     out = {"trace_dir": trace_dir, "processes": {}}
     # Device processes first (the interesting ones on a TPU capture).
@@ -83,6 +117,7 @@ def summarize(trace_dir: str, top: int) -> dict:
                 for name, dur in ops.most_common(top)]
         out["processes"][proc] = {
             "busy_ms": round(total / 1e3, 3),
+            "lanes": sorted(lanes_used[proc]),
             "buckets_pct": {b: round(100 * d / total, 2)
                             for b, d in buckets.most_common()},
             "top_ops": rows,
