@@ -33,6 +33,20 @@ or a run on another machine, proves byte-for-byte the same pipeline):
   package-score   the inference contract: package the winner, batch-score
                   the val table, agreement must match the fit's accuracy
 
+A failed run resumes: ``--resume`` skips every stage already recorded in
+<work>/acceptance_report.json whose artifacts still exist (a dropped
+connection during fetch, or a crash in package-score, must not re-pay
+training or HPO; hpo-dist records its tuned params in the report so
+package-score can resume past it).
+
+On the bar: the reference never publishes a top-1 number for its headline
+run (BASELINE.md "Published numbers" documents the absence), so 0.85 is this
+framework's own stake — chosen below the 0.88-0.92 that frozen
+ImageNet-MobileNetV2 transfer on tf_flowers typically reaches, so it fails
+on real regressions (wrong preprocessing, broken weight import) without
+flaking on seed/split variance. ``--bar`` overrides it; fixtures cap it at
+chance+0.10 because stand-in artifacts only validate the mechanism.
+
 Offline dry-run (what tests/test_real_acceptance.py exercises — every stage
 except the two downloads, on generated stand-ins):
 
@@ -97,16 +111,43 @@ def tree_sha(arrays: dict) -> str:
 class Stages:
     """Run stages in order; record fingerprints; verify against goldens."""
 
-    def __init__(self, work: str, golden_path: str, record: bool):
+    def __init__(self, work: str, golden_path: str, record: bool,
+                 resume: bool = False):
         self.work = work
         self.report_path = os.path.join(work, "acceptance_report.json")
         self.golden_path = golden_path
         self.record = record
         self.report: dict = {}
         self.golden: dict = {}
+        self.previous: dict = {}
         if golden_path and os.path.exists(golden_path):
             with open(golden_path) as f:
                 self.golden = json.load(f)
+        if resume and os.path.exists(self.report_path):
+            with open(self.report_path) as f:
+                self.previous = json.load(f)
+            print(f"[resume] {len(self.previous)} stage(s) recorded in "
+                  f"{self.report_path}")
+
+    def skip(self, stage: str, *artifacts: str):
+        """On ``--resume``: the stage's previously recorded entry, if it
+        completed, every artifact it produced still exists, AND its
+        fingerprint agrees with the golden (a carried-forward entry must
+        not dodge the verification a re-run would face). None = run it."""
+        entry = self.previous.get(stage)
+        if entry is None or any(not os.path.exists(a) for a in artifacts):
+            return None
+        want = self.golden.get(stage, {}).get("fingerprint")
+        if want is not None and want != entry.get("fingerprint"):
+            print(f"[{stage}] recorded fingerprint != golden — re-running, "
+                  f"not resuming")
+            return None
+        entry = {**entry, "golden": "match" if want else entry.get("golden")}
+        self.report[stage] = entry
+        with open(self.report_path, "w") as f:
+            json.dump(self.report, f, indent=1)
+        print(f"[{stage}] resumed ({entry.get('fingerprint', '')[:16]}...)")
+        return entry
 
     def done(self, stage: str, fingerprint: str, **info) -> None:
         entry = {"fingerprint": fingerprint, **info}
@@ -160,10 +201,15 @@ def main():
         os.path.dirname(__file__), "real_acceptance_golden.json"))
     ap.add_argument("--record", action="store_true",
                     help="write this run's fingerprints as the new goldens")
+    ap.add_argument("--resume", action="store_true",
+                    help="skip stages already recorded in the work dir's "
+                         "acceptance_report.json whose artifacts still exist "
+                         "(a crash mid-run must not re-pay downloads, "
+                         "training, or HPO)")
     args = ap.parse_args()
 
     os.makedirs(args.work, exist_ok=True)
-    st = Stages(args.work, args.golden, args.record)
+    st = Stages(args.work, args.golden, args.record, resume=args.resume)
     fixtures = bool(args.fixture_weights or args.fixture_flowers)
     if fixtures and not (args.fixture_weights and args.fixture_flowers):
         raise SystemExit("--fixture-weights and --fixture-flowers go together")
@@ -180,50 +226,94 @@ def main():
     epochs = 2 if args.quick else 3
     t0 = time.time()
 
+    # -- environment --------------------------------------------------------
+    # Recorded (report AND golden) so a non-reproducing run on another
+    # machine shows WHAT differed; the constant fingerprint means version
+    # drift is visible, not fatal — the artifact sha stages are the pins.
+    import jax
+    import torch
+
+    run_cfg = {"quick": args.quick, "bar": args.bar, "fixtures": fixtures,
+               "width": width, "img": img, "epochs": epochs}
+    prev_cfg = st.previous.get("environment", {}).get("config")
+    if prev_cfg is not None and prev_cfg != run_cfg:
+        # Mixing entries from two configurations would fingerprint a
+        # pipeline no single invocation can reproduce.
+        raise SystemExit(f"[resume] config mismatch: the recorded run used "
+                         f"{prev_cfg}, this one is {run_cfg} — rerun with "
+                         f"the same flags, or drop --resume")
+    st.done("environment", "-", python=sys.version.split()[0],
+            torch=torch.__version__, jax=jax.__version__,
+            numpy=np.__version__, config=run_cfg,
+            weights_url=WEIGHTS_URL, flowers_url=FLOWERS_URL)
+
     # -- fetch-weights ------------------------------------------------------
     if fixtures:
         wpath = args.fixture_weights
-        st.done("fetch-weights", sha256_file(wpath), source="fixture")
+        if not st.skip("fetch-weights", wpath):
+            st.done("fetch-weights", sha256_file(wpath), source="fixture")
     else:
-        wpath = fetch(WEIGHTS_URL, os.path.join(args.work, "mnv2_imagenet.pth"))
-        digest = sha256_file(wpath)
-        # torchvision convention: the filename's 8-hex chunk is the sha256
-        # prefix of the artifact — an integrity check with no golden needed.
-        expect = os.path.basename(WEIGHTS_URL).rsplit("-", 1)[1].split(".")[0]
-        if not digest.startswith(expect):
-            raise SystemExit(f"weights sha256 {digest[:8]} != published "
-                             f"prefix {expect} — corrupt download")
-        st.done("fetch-weights", digest, source=WEIGHTS_URL)
+        wpath = os.path.join(args.work, "mnv2_imagenet.pth")
+        if not st.skip("fetch-weights", wpath):
+            fetch(WEIGHTS_URL, wpath)
+            digest = sha256_file(wpath)
+            # torchvision convention: the filename's 8-hex chunk is the
+            # sha256 prefix of the artifact — an integrity check with no
+            # golden needed.
+            expect = os.path.basename(WEIGHTS_URL).rsplit("-", 1)[1].split(".")[0]
+            if not digest.startswith(expect):
+                os.remove(wpath)  # a --resume retry must re-download
+                raise SystemExit(f"weights sha256 {digest[:8]} != published "
+                                 f"prefix {expect} — corrupt download")
+            st.done("fetch-weights", digest, source=WEIGHTS_URL)
 
     # -- fetch-flowers ------------------------------------------------------
     if fixtures:
         flowers_dir = args.fixture_flowers
-        st.done("fetch-flowers", "fixture", source="fixture")
+        if not st.skip("fetch-flowers", flowers_dir):
+            st.done("fetch-flowers", "fixture", source="fixture")
     else:
-        tgz = fetch(FLOWERS_URL, os.path.join(args.work, "flower_photos.tgz"))
-        digest = sha256_file(tgz)
-        # Verify BEFORE extracting: a recorded golden must reject a tampered
-        # archive without a single member touching disk; filter='data'
-        # additionally refuses path-escaping members on first (unrecorded)
-        # runs.
-        st.done("fetch-flowers", digest, source=FLOWERS_URL)
         flowers_dir = os.path.join(args.work, "flower_photos")
-        if not os.path.isdir(flowers_dir):
-            with tarfile.open(tgz) as tf:
-                tf.extractall(args.work, filter="data")
+        if not st.skip("fetch-flowers", flowers_dir):
+            tgz = fetch(FLOWERS_URL,
+                        os.path.join(args.work, "flower_photos.tgz"))
+            digest = sha256_file(tgz)
+            # Golden check BEFORE extracting: a recorded golden must reject
+            # a tampered archive without a single member touching disk;
+            # filter='data' additionally refuses path-escaping members on
+            # first (unrecorded) runs.
+            want = st.golden.get("fetch-flowers", {}).get("fingerprint")
+            if want is not None and want != digest:
+                raise SystemExit(f"flowers archive sha256 {digest[:16]}... "
+                                 f"!= golden {want[:16]}... — refusing to "
+                                 f"extract")
+            if not os.path.isdir(flowers_dir):
+                # Extract atomically (tmp dir + rename) and record done()
+                # only AFTER: a crash mid-extract must not leave a partial
+                # tree that --resume would accept as complete.
+                tmp_extract = os.path.join(args.work, ".flowers_extract")
+                import shutil
+
+                shutil.rmtree(tmp_extract, ignore_errors=True)
+                with tarfile.open(tgz) as tf:
+                    tf.extractall(tmp_extract, filter="data")
+                os.replace(os.path.join(tmp_extract, "flower_photos"),
+                           flowers_dir)
+                shutil.rmtree(tmp_extract, ignore_errors=True)
+            st.done("fetch-flowers", digest, source=FLOWERS_URL)
 
     # -- convert ------------------------------------------------------------
-    import torch
-
-    from ddw_tpu.models.convert import convert_torch_mobilenet_v2, save_pretrained
-
-    sd = torch.load(wpath, map_location="cpu", weights_only=True)
-    tree = convert_torch_mobilenet_v2(sd)
-    flat = {f"{g}/{k}": np.asarray(v) for g, sub in tree.items()
-            for k, v in _flatten(sub)}
     backbone_npz = os.path.join(args.work, "imagenet_backbone.npz")
-    save_pretrained(backbone_npz, tree)
-    st.done("convert", tree_sha(flat), leaves=len(flat))
+    if not st.skip("convert", backbone_npz):
+        from ddw_tpu.models.convert import (convert_torch_mobilenet_v2,
+                                            save_pretrained)
+
+        sd = torch.load(wpath, map_location="cpu", weights_only=True)
+        tree = convert_torch_mobilenet_v2(sd)
+        flat = {f"{g}/{k}": np.asarray(v) for g, sub in tree.items()
+                for k, v in _flatten(sub)}
+        save_pretrained(backbone_npz, tree)
+        st.done("convert", tree_sha(flat), leaves=len(flat))
 
     # -- prep (contract 1) --------------------------------------------------
     from ddw_tpu.data.prep import prepare_flowers
@@ -264,23 +354,23 @@ def main():
         return Trainer(data_cfg, mcfg, tcfg).fit(train_tbl, val_tbl), mcfg
 
     # -- train-single (contract 2) ------------------------------------------
-    res1, _ = head_fit(num_devices=1)
-    require(res1.val_accuracy >= bar,
-            f"single-node frozen transfer top-1 {res1.val_accuracy:.3f} < "
-            f"bar {bar:.2f}")
-    st.done("train-single", f"{res1.val_accuracy:.4f}",
-            val_accuracy=round(res1.val_accuracy, 4), bar=round(bar, 3))
+    if not st.skip("train-single"):
+        res1, _ = head_fit(num_devices=1)
+        require(res1.val_accuracy >= bar,
+                f"single-node frozen transfer top-1 {res1.val_accuracy:.3f} "
+                f"< bar {bar:.2f}")
+        st.done("train-single", f"{res1.val_accuracy:.4f}",
+                val_accuracy=round(res1.val_accuracy, 4), bar=round(bar, 3))
 
     # -- train-dist (contract 3) --------------------------------------------
-    import jax
-
-    res2, _ = head_fit(num_devices=len(jax.devices()))
-    require(res2.val_accuracy >= bar,
-            f"distributed frozen transfer top-1 {res2.val_accuracy:.3f} < "
-            f"bar {bar:.2f}")
-    st.done("train-dist", f"{res2.val_accuracy:.4f}",
-            val_accuracy=round(res2.val_accuracy, 4),
-            devices=len(jax.devices()))
+    if not st.skip("train-dist"):
+        res2, _ = head_fit(num_devices=len(jax.devices()))
+        require(res2.val_accuracy >= bar,
+                f"distributed frozen transfer top-1 {res2.val_accuracy:.3f} "
+                f"< bar {bar:.2f}")
+        st.done("train-dist", f"{res2.val_accuracy:.4f}",
+                val_accuracy=round(res2.val_accuracy, 4),
+                devices=len(jax.devices()))
 
     # -- hpo (contract 4) ---------------------------------------------------
     from ddw_tpu.tune import STATUS_OK, Trials, choice, fmin, loguniform, uniform
@@ -295,11 +385,12 @@ def main():
                         optimizer=params["optimizer"], n_epochs=1)
         return {"loss": -r.val_accuracy, "status": STATUS_OK}
 
-    trials = Trials()
-    fmin(objective, space, max_evals=2 if args.quick else 8,
-         trials=trials, parallelism=1, seed=0)
-    st.done("hpo", trials_sha(trials),
-            evals=len(trials), best_acc=round(-trials.best["loss"], 4))
+    if not st.skip("hpo"):
+        trials = Trials()
+        fmin(objective, space, max_evals=2 if args.quick else 8,
+             trials=trials, parallelism=1, seed=0)
+        st.done("hpo", trials_sha(trials),
+                evals=len(trials), best_acc=round(-trials.best["loss"], 4))
 
     # -- hpo-dist (contract 5) ----------------------------------------------
     def objective_dist(params, trial=None):
@@ -307,43 +398,57 @@ def main():
                         dropout=params["dropout"], n_epochs=1)
         return {"loss": -r.val_accuracy, "status": STATUS_OK}
 
-    dtrials = Trials()
-    fmin(objective_dist,
-         {"lr": loguniform("lr", np.log(1e-4), np.log(1e-1)),
-          "dropout": uniform("dropout", 0.1, 0.9)},
-         max_evals=2 if args.quick else 4, trials=dtrials, parallelism=1,
-         seed=0)
-    st.done("hpo-dist", trials_sha(dtrials),
-            best_acc=round(-dtrials.best["loss"], 4))
+    # The tuned params ride the report entry so a --resume past this stage
+    # (e.g. after a package-score crash) still knows the winner. A report
+    # from an older script version lacks them — fall back to re-running.
+    prev = st.skip("hpo-dist")
+    if prev and "tuned_lr" in prev:
+        tuned = {"lr": prev["tuned_lr"], "dropout": prev["tuned_dropout"]}
+    else:
+        dtrials = Trials()
+        fmin(objective_dist,
+             {"lr": loguniform("lr", np.log(1e-4), np.log(1e-1)),
+              "dropout": uniform("dropout", 0.1, 0.9)},
+             max_evals=2 if args.quick else 4, trials=dtrials, parallelism=1,
+             seed=0)
+        tuned = dtrials.best["params"]
+        st.done("hpo-dist", trials_sha(dtrials),
+                best_acc=round(-dtrials.best["loss"], 4),
+                tuned_lr=float(tuned["lr"]),
+                tuned_dropout=float(tuned["dropout"]))
 
     # -- package-score ------------------------------------------------------
-    from ddw_tpu.serving.batch import BatchScorer
-    from ddw_tpu.serving.package import save_packaged_model
-
-    # The winner: the tuned hyperparameters from contract 5, retrained at
-    # full epochs over the whole mesh (the reference's best-run -> registry
-    # -> production arc, 01_hyperopt_single_machine_model.py:253-293).
-    tuned = dtrials.best["params"]
-    res_best, mcfg_best = head_fit(num_devices=len(jax.devices()),
-                                   lr=tuned["lr"], dropout=tuned["dropout"])
-    classes = [c for c, _ in sorted(labels.items(), key=lambda kv: kv[1])]
     pkg = os.path.join(args.work, "accepted_pkg")
-    save_packaged_model(pkg, mcfg_best, classes, res_best.state.params,
-                        res_best.state.batch_stats,
-                        img_height=img, img_width=img)
-    rows = BatchScorer(pkg, batch_per_device=32).score_table(val_tbl)
-    truth = {r.path: r.label for r in val_tbl.iter_records()}
-    agree = sum(truth[p] == pred for p, pred in rows) / len(rows)
-    # score_table covers every record; the fit's eval drops remainder batches
-    # — tiny fixture tables make that gap large, real flowers keep it small.
-    tol = 0.25 if fixtures else 0.05
-    require(abs(agree - res_best.val_accuracy) < tol,
-            f"packaged-score agreement {agree:.3f} vs fit accuracy "
-            f"{res_best.val_accuracy:.3f} — train/serve skew")
-    st.done("package-score", f"{agree:.4f}", rows=len(rows),
-            agreement=round(agree, 4),
-            tuned_lr=round(tuned["lr"], 6),
-            tuned_dropout=round(tuned["dropout"], 3))
+    if not st.skip("package-score", pkg):
+        from ddw_tpu.serving.batch import BatchScorer
+        from ddw_tpu.serving.package import save_packaged_model
+
+        # The winner: the tuned hyperparameters from contract 5, retrained at
+        # full epochs over the whole mesh (the reference's best-run ->
+        # registry -> production arc,
+        # 01_hyperopt_single_machine_model.py:253-293).
+        res_best, mcfg_best = head_fit(num_devices=len(jax.devices()),
+                                       lr=tuned["lr"],
+                                       dropout=tuned["dropout"])
+        classes = [c for c, _ in sorted(labels.items(),
+                                        key=lambda kv: kv[1])]
+        save_packaged_model(pkg, mcfg_best, classes, res_best.state.params,
+                            res_best.state.batch_stats,
+                            img_height=img, img_width=img)
+        rows = BatchScorer(pkg, batch_per_device=32).score_table(val_tbl)
+        truth = {r.path: r.label for r in val_tbl.iter_records()}
+        agree = sum(truth[p] == pred for p, pred in rows) / len(rows)
+        # score_table covers every record; the fit's eval drops remainder
+        # batches — tiny fixture tables make that gap large, real flowers
+        # keep it small.
+        tol = 0.25 if fixtures else 0.05
+        require(abs(agree - res_best.val_accuracy) < tol,
+                f"packaged-score agreement {agree:.3f} vs fit accuracy "
+                f"{res_best.val_accuracy:.3f} — train/serve skew")
+        st.done("package-score", f"{agree:.4f}", rows=len(rows),
+                agreement=round(agree, 4),
+                tuned_lr=round(float(tuned["lr"]), 6),
+                tuned_dropout=round(float(tuned["dropout"]), 3))
 
     st.finish()
     print(f"[acceptance] ALL STAGES PASSED in {time.time() - t0:.0f}s "

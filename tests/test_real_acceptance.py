@@ -18,8 +18,13 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-STAGES = ("fetch-weights", "fetch-flowers", "convert", "prep",
+STAGES = ("environment", "fetch-weights", "fetch-flowers", "convert", "prep",
           "train-single", "train-dist", "hpo", "hpo-dist", "package-score")
+
+# stages --resume may carry forward from a previous run's report (everything
+# expensive: downloads, weight convert, the four fits, the packaged scoring)
+RESUMABLE = ("fetch-weights", "fetch-flowers", "convert", "train-single",
+             "train-dist", "hpo", "hpo-dist", "package-score")
 
 
 @pytest.fixture(scope="module")
@@ -52,7 +57,8 @@ def fixtures_dir(tmp_path_factory):
     return {"flowers": flowers, "weights": wpath}
 
 
-def _run(workdir, fixtures, golden, record=False, expect_fail=False):
+def _run(workdir, fixtures, golden, record=False, expect_fail=False,
+         resume=False):
     env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8",
                PYTHONPATH=REPO)
@@ -63,6 +69,8 @@ def _run(workdir, fixtures, golden, record=False, expect_fail=False):
            "--golden", str(golden)]
     if record:
         cmd.append("--record")
+    if resume:
+        cmd.append("--resume")
     out = subprocess.run(cmd, capture_output=True, text=True, env=env,
                          cwd=REPO, timeout=1800)
     if expect_fail:
@@ -70,23 +78,33 @@ def _run(workdir, fixtures, golden, record=False, expect_fail=False):
         return out.stdout + out.stderr
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     with open(os.path.join(workdir, "acceptance_report.json")) as f:
-        return json.load(f)
+        return json.load(f), out.stdout
 
 
 def test_all_stages_record_and_reproduce(fixtures_dir, tmp_path):
     golden = tmp_path / "golden.json"
 
-    rep1 = _run(tmp_path / "run1", fixtures_dir, golden, record=True)
+    rep1, _ = _run(tmp_path / "run1", fixtures_dir, golden, record=True)
     assert set(rep1) == set(STAGES)
     assert all(rep1[s]["golden"] == "recorded" for s in STAGES)
     assert rep1["prep"]["classes"] == 5
     assert rep1["convert"]["leaves"] > 100  # full backbone tree converted
+    assert rep1["environment"]["jax"]  # versions pinned into the golden
 
     # Same fixtures, fresh workdir, goldens enforced: every deterministic
     # stage must reproduce its fingerprint exactly.
-    rep2 = _run(tmp_path / "run2", fixtures_dir, golden)
+    rep2, _ = _run(tmp_path / "run2", fixtures_dir, golden)
     for s in STAGES:
         assert rep2[s]["golden"] == "match", (s, rep2[s])
+
+    # --resume over a completed workdir: every expensive stage is carried
+    # forward from the report (no re-training, no re-download role), and the
+    # hpo-dist entry still feeds package-score its tuned params.
+    rep3, out3 = _run(tmp_path / "run2", fixtures_dir, golden, resume=True)
+    for s in RESUMABLE:
+        assert f"[{s}] resumed" in out3, (s, out3[-2000:])
+        assert rep3[s]["fingerprint"] == rep2[s]["fingerprint"], s
+    assert "tuned_lr" in rep3["hpo-dist"]
 
 
 def test_golden_mismatch_fails_loudly(fixtures_dir, tmp_path):

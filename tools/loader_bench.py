@@ -1,0 +1,155 @@
+"""Host-side loader throughput: records/s for each ShardedLoader fast path.
+
+The loader's contract ("the TPU never waits on host IO", ``data/loader.py``)
+has two sides: the chip's consumption rate (measured by ``bench.py``'s
+``e2e_*`` rows when the tunnel is up) and the host's production rate — this
+tool, which needs NO device at all: it iterates the loader's host pipeline
+(read -> decode/reinterpret -> assemble) and reports records/s per path.
+Completes the Petastorm reader-pool role with a number on the host side
+(reference ``Part 1 - Distributed Training/03_model_training_distributed
+.py:200,332-337`` sizes ``workers_count`` against exactly this rate).
+
+Paths:
+- ``jpeg``:    live libjpeg decode from the silver table (prep-time path)
+- ``raw_u8``:  materialized pre-decoded pixels (training default)
+- ``feature``: pooled-feature cache (head-only fine-tune path)
+- ``token``:   int32 next-token pairs (LM path)
+
+Usage: ``python tools/loader_bench.py [--workers N] [--steps M]``
+CI smoke: ``DDW_BENCH_SMOKE=1`` shrinks images/records/steps.
+Prints ONE JSON line:
+``{"paths": {name: {"records_per_sec": ..., ...}}, "host": {...}}``.
+
+The table set lives in a deterministic tempdir keyed by the size parameters
+and is reused across runs (prep is one-time host work, not the thing being
+measured). Records cycle through the OS page cache — this measures the
+decode/assemble pipeline, not cold disk.
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import argparse
+import json
+import platform
+import tempfile
+import time
+
+import numpy as np
+
+from ddw_tpu.utils.config import env_flag
+
+SMOKE = env_flag("DDW_BENCH_SMOKE")
+
+
+def build_tables(root: str, *, n_images: int, img: int, n_tokens: int,
+                 seq: int):
+    """Synthetic flowers -> silver/raw_u8/feature tables + a token table."""
+    import jax
+
+    from ddw_tpu.data.prep import (generate_synthetic_flowers,
+                                   materialize_decoded, prepare_flowers,
+                                   write_token_table)
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.train.step import init_state
+    from ddw_tpu.train.transfer import materialize_features
+    from ddw_tpu.utils.config import ModelCfg, TrainCfg
+
+    store = TableStore(os.path.join(root, "tables"))
+    src = os.path.join(root, "flowers_src")
+    if not os.path.isdir(src):
+        generate_synthetic_flowers(src, images_per_class=n_images // 5,
+                                   size=img + 16)
+    # The store is append-only versioned: an unguarded prepare/materialize
+    # would re-decode everything into NEW versions every run (and invalidate
+    # the feature cache's source-version check) — reuse is the point here.
+    if store.exists("silver_train"):
+        train_tbl = store.table("silver_train")
+    else:
+        train_tbl, _, _ = prepare_flowers(src, store, sample_fraction=1.0,
+                                          shard_size=max(16, n_images // 8))
+    if store.exists("bench_raw"):
+        raw_tbl = store.table("bench_raw")
+    else:
+        raw_tbl = materialize_decoded(train_tbl, store, "bench_raw", img, img)
+
+    # feature caching needs a backbone/head zoo model; the smallest is fine —
+    # the bench measures the loader's (B, D) assemble path, not the backbone
+    mcfg = ModelCfg(name="mobilenet_v2", num_classes=5, dropout=0.0,
+                    dtype="float32")
+    model = build_model(mcfg)
+    state, _ = init_state(model, mcfg, TrainCfg(batch_size=8), (img, img, 3),
+                          jax.random.PRNGKey(0))
+    feat_tbl = materialize_features(model, state.params, state.batch_stats,
+                                    train_tbl, store, "bench_feat",
+                                    (img, img))
+
+    if not store.exists("bench_tokens"):
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, 1024, size=(n_tokens, seq + 1)).astype(np.int32)
+        write_token_table(store, "bench_tokens", toks,
+                          shard_size=max(16, n_tokens // 8))
+    tok_tbl = store.table("bench_tokens")
+    return {"jpeg": train_tbl, "raw_u8": raw_tbl, "feature": feat_tbl,
+            "token": tok_tbl}
+
+
+def measure(table, *, batch: int, img: int, workers: int, steps: int) -> dict:
+    from ddw_tpu.data.loader import ShardedLoader
+
+    loader = ShardedLoader(table, batch_size=batch, image_size=(img, img),
+                           workers=workers, shuffle=True, seed=0,
+                           shuffle_buffer=256)
+    it = iter(loader)
+    next(it)  # warm: threads up, page cache hot
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        next(it)
+    dt = time.perf_counter() - t0
+    return {"records_per_sec": round(steps * batch / dt, 1),
+            "batch": batch, "steps": steps, "workers": workers,
+            "seconds": round(dt, 3),
+            "table_records": table.num_records}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workers", type=int, default=1,
+                    help="decode thread pool size (default 1: the floor; "
+                    "scale-up is the reader-pool knob)")
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args()
+
+    if SMOKE:
+        n_images, img, batch = 40, 32, 8
+        n_tokens, seq = 128, 64
+        steps = args.steps or 6
+        jpeg_steps = 2
+    else:
+        n_images, img, batch = 320, 224, 32
+        n_tokens, seq = 4096, 512
+        steps = args.steps or 30
+        jpeg_steps = max(2, steps // 10)  # live decode is ~65x slower: fewer
+
+    root = os.path.join(tempfile.gettempdir(),
+                        f"ddw_loader_bench_{n_images}x{img}")
+    os.makedirs(root, exist_ok=True)
+    tables = build_tables(root, n_images=n_images, img=img,
+                          n_tokens=n_tokens, seq=seq)
+
+    out = {"paths": {}, "host": {"cpus": os.cpu_count(),
+                                 "machine": platform.machine(),
+                                 "smoke": SMOKE}}
+    for name, tbl in tables.items():
+        n = jpeg_steps if name == "jpeg" else steps
+        out["paths"][name] = measure(tbl, batch=batch, img=img,
+                                     workers=args.workers, steps=n)
+        print(f"[loader] {name:<8} {out['paths'][name]['records_per_sec']:>9} "
+              f"rec/s (batch {batch} x {n} steps, workers={args.workers})",
+              file=sys.stderr, flush=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
