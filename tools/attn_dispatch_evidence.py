@@ -35,7 +35,8 @@ import subprocess
 # DDW_BENCH_SMOKE shrinks them for CI (mechanism only — tiny scores all land
 # in the plain tier, so smoke exercises the ckpt_force delta, not the real
 # dispatch decisions)
-if os.environ.get("DDW_BENCH_SMOKE", "").lower() not in ("", "0", "false"):
+SMOKE = os.environ.get("DDW_BENCH_SMOKE", "").lower() not in ("", "0", "false")
+if SMOKE:
     CONFIGS = {
         "vit": dict(batch=8, img=64),
         "lm_flash": dict(batch=4, seq=128, hidden=64, depth=2, heads=4,
@@ -56,15 +57,17 @@ ARMS = {
 }
 
 
-def worker(config: str) -> dict:
-    import importlib
+def lower_bench_step(config: str):
+    """Build + abstractly lower the EXACT bench train step for ``config``.
 
+    Shared by this tool and ``tools/mxu_roofline.py`` so the two can never
+    lower different programs. Returns ``(lowered_stablehlo_text, dims)``
+    where ``dims`` carries the model geometry derived from the REAL model
+    object (batch, seqlen, heads, head_dim, hidden, depth, mlp_dim, vocab).
+    """
     import jax
     import jax.numpy as jnp
 
-    # ddw_tpu.ops re-exports a `flash_attention` FUNCTION that shadows the
-    # submodule under `from ... import` — resolve the module itself
-    fa = importlib.import_module("ddw_tpu.ops.flash_attention")
     from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
 
     mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)))
@@ -91,9 +94,14 @@ def worker(config: str) -> dict:
                 jax.ShapeDtypeStruct((b, *img), jnp.float32),
                 jax.ShapeDtypeStruct((b,), jnp.int32),
                 jax.random.PRNGKey(1))
-        # q/k/v as the model builds them: S = (img/patch)² + cls token
-        seqlen = (cfg["img"] // model.patch) ** 2 + 1
-        heads, head_dim = model.num_heads, model.hidden // model.num_heads
+        dims = dict(batch=b,
+                    # no CLS token in this ViT: patches are mean-pooled
+                    # (models/vit.py), S = (img/patch)²
+                    seqlen=(cfg["img"] // model.patch) ** 2,
+                    heads=model.num_heads,
+                    head_dim=model.hidden // model.num_heads,
+                    hidden=model.hidden, depth=model.depth,
+                    mlp_dim=model.mlp_dim, vocab=model.num_classes)
     else:
         import optax
 
@@ -114,14 +122,28 @@ def worker(config: str) -> dict:
                 jax.ShapeDtypeStruct((b, cfg["seq"]), jnp.int32),
                 jax.ShapeDtypeStruct((b, cfg["seq"]), jnp.int32),
                 jax.random.PRNGKey(1))
-        seqlen, heads, head_dim = cfg["seq"], cfg["heads"], \
-            cfg["hidden"] // cfg["heads"]
+        dims = dict(batch=b, seqlen=model.max_len, heads=model.num_heads,
+                    head_dim=model.hidden // model.num_heads,
+                    hidden=model.hidden, depth=model.depth,
+                    mlp_dim=model.mlp_dim, vocab=model.vocab_size)
+    return step.lower(*args).as_text(), dims
 
-    qk = jax.ShapeDtypeStruct((b, heads, seqlen, head_dim), jnp.bfloat16)
+
+def worker(config: str) -> dict:
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    # ddw_tpu.ops re-exports a `flash_attention` FUNCTION that shadows the
+    # submodule under `from ... import` — resolve the module itself
+    fa = importlib.import_module("ddw_tpu.ops.flash_attention")
+
+    text, d = lower_bench_step(config)
+    qk = jax.ShapeDtypeStruct(
+        (d["batch"], d["heads"], d["seqlen"], d["head_dim"]), jnp.bfloat16)
     tier = fa._attn_impl(qk, qk, "auto")
-    score_mb = b * heads * seqlen * seqlen * 4 / 1024**2
-
-    text = step.lower(*args).as_text()
+    score_mb = d["batch"] * d["heads"] * d["seqlen"] ** 2 * 4 / 1024**2
     dots = len(re.findall(r"stablehlo\.dot_general", text))
     # Attention's QKᵀ / PV matmuls (and their grads/recomputes) are the
     # module's only [B, H]-batched dot_generals — projections contract over

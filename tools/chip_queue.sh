@@ -108,7 +108,7 @@ while :; do
     run_item e2e_loader      "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=e2e_raw_u8,e2e_feature_cache python -u bench.py" || continue
     # Transformer-gap levers (VERDICT r4 item 1), CORRECTED round 5 by
     # tools/attn_dispatch_evidence.py (structural lowering, no chip): the
-    # bench ViT (H4, not the H12 the round-4 note assumed) has a 151.6 MB
+    # bench ViT (H4, not the H12 the round-4 note assumed) has a 150.1 MB
     # score matrix — ALREADY in the plain tier, PLAIN_MAX=1GiB is a
     # byte-identical no-op, so the old ab_vit_attn arm is retired. The LM's
     # 1.0 GiB scores DO sit in xla_ckpt (12 recomputed attention dots per

@@ -60,11 +60,21 @@ def family_of(opcode: str) -> str:
 
 
 def decompress(path: str) -> tuple[int, bytes]:
-    """-> (compile_seconds, serialized_executable)."""
-    import zstandard
+    """-> (compile_seconds, serialized_executable).
 
-    blob = zstandard.ZstdDecompressor().decompress(
-        open(path, "rb").read(), max_output_size=1 << 31)
+    jax writes zstd entries when ``zstandard`` is importable and falls back
+    to zlib otherwise (``jax/_src/compilation_cache.py``) — mirror that by
+    sniffing the zstd magic so entries from either kind of host mine."""
+    raw = open(path, "rb").read()
+    if raw[:4] == b"\x28\xb5\x2f\xfd":
+        import zstandard
+
+        blob = zstandard.ZstdDecompressor().decompress(
+            raw, max_output_size=1 << 31)
+    else:
+        import zlib
+
+        blob = zlib.decompress(raw)
     return int.from_bytes(blob[:4], "big"), blob[4:]
 
 
@@ -81,7 +91,9 @@ def hlo_stats(hlo_text: str) -> dict:
 def raw_scan(serialized: bytes) -> dict:
     """Backend-free approximation: count op_name metadata strings inside the
     serialized module proto (readable even for foreign-platform entries)."""
-    names = re.findall(rb"jvp\([\w]+\)|transpose\(jvp\([\w]+\)\)", serialized)
+    # longer alternative first: bare jvp( would otherwise always win and
+    # the transpose(...)-tagged backward ops would never be counted
+    names = re.findall(rb"transpose\(jvp\([\w]+\)\)|jvp\([\w]+\)", serialized)
     kinds = collections.Counter()
     for pat, label in ((rb"\bfusion\.\d+", "fusion"),
                        (rb"\bdot\.\d+|\bdot_general", "dot"),
