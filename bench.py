@@ -83,6 +83,16 @@ CHIP_ANCHORS = {
     "lm_flash": (129639.0, 2),
 }
 
+# Tile-quantized MFU ceilings for the transformer rows (tools/mxu_roofline
+# .py, round 5): the 128x128 MXU caps these shapes well below peak (ViT's
+# head_dim-48 attention dots run at 28% tile utilization), so each full-shape
+# row reports mfu alongside the ceiling its own shapes can actually reach —
+# mfu/mfu_ceiling is the implementation gap, not mfu/1.0.
+MFU_CEILINGS = {
+    "vit": 0.59,
+    "lm_flash": 0.71,
+}
+
 from ddw_tpu.utils.config import env_flag
 
 SMOKE = env_flag("DDW_BENCH_SMOKE")
@@ -912,6 +922,14 @@ def main():
             if anchor and rate and "TPU" in kind and not SMOKE:
                 row["vs_anchor"] = round(rate / anchor[0], 3)
                 row["anchor_round"] = anchor[1]
+            ceiling = MFU_CEILINGS.get(name)
+            # v5e-only like the ceilings themselves (mxu_roofline derives
+            # them from v5e peak/bandwidth + these exact headline shapes);
+            # on another TPU generation frac_of_ceiling would be fiction.
+            if (ceiling and not SMOKE and row.get("mfu")
+                    and ("v5e" in kind.lower() or "v5 lite" in kind.lower())):
+                row["mfu_ceiling"] = ceiling
+                row["frac_of_ceiling"] = round(row["mfu"] / ceiling, 4)
             configs[name] = row
             _beat(f"{name}: done ({row.get('rate_per_chip')} "
                   f"{row.get('unit')})")
