@@ -17,7 +17,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # shapes (32x32, batch 8): raw_u8 ~83k, feature ~145k, token ~939k, jpeg
 # ~19k rec/s. They only catch order-of-magnitude regressions — by design;
 # this host is shared and slow.
-FLOORS = {"jpeg": 150, "raw_u8": 800, "feature": 1500, "token": 8000}
+FLOORS = {"jpeg": 150, "raw_u8": 800, "raw_u8_assemble": 2000,
+          "feature": 1500, "token": 8000}
 
 
 def test_loader_bench_smoke_and_floors(tmp_path):
@@ -31,10 +32,17 @@ def test_loader_bench_smoke_and_floors(tmp_path):
         capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
     assert out.returncode == 0, out.stderr[-2000:]
     d = json.loads(out.stdout.strip().splitlines()[-1])
-    assert set(d["paths"]) == {"jpeg", "raw_u8", "feature", "token"}
+    assert set(d["paths"]) == {"jpeg", "raw_u8", "raw_u8_assemble",
+                               "feature", "token"}
     for name, row in d["paths"].items():
         assert row["records_per_sec"] > FLOORS[name], (name, row)
-        assert row["steps"] > 0 and row["workers"] == 1
+        assert row["steps"] > 0
+        # floors are a 1-worker contract (0 = the workerless assemble loop)
+        assert row["workers"] == (0 if name == "raw_u8_assemble" else 1)
     # materialized paths must beat live decode per record
     assert (d["paths"]["raw_u8"]["records_per_sec"]
             > d["paths"]["jpeg"]["records_per_sec"])
+    # the uint8 assemble ceiling (training path: dequant rides the device)
+    # must beat the host-dequant row — the gap IS the dequant cost
+    assert (d["paths"]["raw_u8_assemble"]["records_per_sec"]
+            > d["paths"]["raw_u8"]["records_per_sec"])
