@@ -1,0 +1,162 @@
+"""ConvNeXt — the third CNN family of the model zoo.
+
+The reference's zoo is one architecture (MobileNetV2 transfer,
+``Part 1 - Distributed Training/02_model_training_single_node.py:159-178``);
+ConvNeXt joins ResNet as proof the trainer / serving / HPO stack is
+model-agnostic, and it exercises the zoo paths the other CNNs cannot:
+
+- **no BatchNorm** — LayerNorm only, so the model carries NO
+  ``batch_stats`` collection: the stats-free branches of the train step,
+  checkpoints, packaging, and feature cache run for a real conv family
+  (previously only ViT/LM hit them);
+- **7×7 depthwise** convolutions — a second depthwise consumer at a kernel
+  size the in-tree Pallas 3×3 kernel deliberately does not claim
+  (``ops/depthwise_conv.py``), so it rides XLA's grouped-conv lowering: the
+  honest A/B partner for the Pallas kernel's scope decision.
+
+Architecture follows the ConvNeXt **V2** recipe (patchify stem, per-stage
+``LN + 2×2/2 conv`` downsampling, blocks of 7×7 depthwise → LN →
+pointwise 4× expand → GELU → GRN → project, residual) — V2's global
+response normalization replaces V1's 1e-6 layer scale, which trains
+unstably under the zoo's plain-Adam contract (see ``_GRN``). Stochastic
+depth is omitted — the zoo's regularization knob is the head dropout the
+reference's transfer contract defines. Same ``backbone``/``head`` naming +
+``frozen_prefixes`` protocol as the rest of the zoo, so transfer mode,
+checkpoints, packaging, and the cached-feature path work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# variant -> (blocks per stage, channels per stage)
+_CONFIGS = {
+    "tiny": ((3, 3, 9, 3), (96, 192, 384, 768)),
+    "small": ((3, 3, 27, 3), (96, 192, 384, 768)),
+}
+
+
+class _GRN(nn.Module):
+    """Global response normalization (ConvNeXt V2): per-channel spatial L2
+    energy, normalized by the cross-channel mean, gates the features —
+    ``gamma * (x * nx) + beta + x``. Replaces V1's 1e-6 layer scale, whose
+    tiny params take violently large *relative* Adam steps the first
+    post-warmup epochs (observed: loss 1.75 → 7+ spikes on the flowers
+    fit); GRN's params start at 0 with O(1) dynamics and the residual term
+    keeps init an identity. Runs in f32 like the zoo's other norms."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        xf = x.astype(jnp.float32)
+        gx = jnp.sqrt(jnp.sum(xf * xf, axis=(1, 2), keepdims=True) + 1e-6)
+        nx = gx / (jnp.mean(gx, axis=-1, keepdims=True) + 1e-6)
+        gamma = self.param("gamma", nn.initializers.zeros, (self.features,),
+                           jnp.float32)
+        beta = self.param("beta", nn.initializers.zeros, (self.features,),
+                          jnp.float32)
+        return (gamma * (xf * nx) + beta + xf).astype(x.dtype)
+
+
+class _Block(nn.Module):
+    """7×7 depthwise → LN → 4× pointwise expand → GELU → GRN → project →
+    residual (the ConvNeXt V2 block). LayerNorm/GRN run in f32 (same policy
+    as the BN layers elsewhere in the zoo); convs/MLP in the compute
+    dtype."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.features, (7, 7), padding="SAME",
+                    feature_group_count=self.features, dtype=self.dtype,
+                    name="dwconv")(x)
+        h = nn.LayerNorm(dtype=jnp.float32)(h)
+        h = nn.Dense(4 * self.features, dtype=self.dtype, name="expand")(h)
+        h = nn.gelu(h)
+        h = _GRN(4 * self.features, name="grn")(h)
+        # zero-init the projection: every block is an identity at init, so
+        # the 18-deep residual stream starts perfectly conditioned (the
+        # role V1's 1e-6 layer scale played, without its pathological
+        # Adam dynamics — observed as loss 1.6 → 7 spikes in the first
+        # post-warmup epoch with default init)
+        h = nn.Dense(self.features, dtype=self.dtype, name="project",
+                     kernel_init=nn.initializers.zeros)(h)
+        return x + h
+
+
+class ConvNeXtBackbone(nn.Module):
+    variant: str = "tiny"
+    width_mult: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train  # no BN, no stochastic depth: inference == training graph
+        depths, dims = _CONFIGS[self.variant]
+        dims = [max(8, int(d * self.width_mult)) for d in dims]
+        # patchify stem: 4×4 stride-4 conv + LN
+        x = nn.Conv(dims[0], (4, 4), strides=(4, 4), dtype=self.dtype,
+                    name="stem")(x)
+        # cast back after the f32 norm: stage 0's residual carrier (the
+        # highest-resolution stream) must run in the compute dtype, or
+        # `x + h` promotes the whole stage to f32 (2x activation bytes)
+        x = nn.LayerNorm(dtype=jnp.float32, name="stem_norm")(x).astype(
+            self.dtype)
+        for stage, (n_blocks, feats) in enumerate(zip(depths, dims)):
+            if stage > 0:
+                x = nn.LayerNorm(dtype=jnp.float32,
+                                 name=f"down{stage}_norm")(x)
+                x = nn.Conv(feats, (2, 2), strides=(2, 2), dtype=self.dtype,
+                            name=f"down{stage}")(x)
+            for i in range(n_blocks):
+                x = _Block(feats, dtype=self.dtype,
+                           name=f"stage{stage}_block{i}")(x)
+        # The recipe's final LN lives in the BACKBONE (per-position, pre-GAP
+        # — the paper applies it post-GAP; per-position is the map-shaped
+        # equivalent) so the head stays the zoo-standard Dropout→Dense and
+        # the residual stream is normalized before it reaches the head /
+        # feature cache. Without it the un-normalized sum of 18 residual
+        # branches destabilizes training within an epoch.
+        return nn.LayerNorm(dtype=jnp.float32, name="final_norm")(x)
+
+
+class ConvNeXt(nn.Module):
+    """Backbone + the zoo-standard transfer head (GAP → Dropout → Dense).
+
+    Deviation from the paper recipe: the final LayerNorm lives in the
+    backbone (per-position, pre-GAP) instead of post-GAP in the head, so
+    the head is byte-compatible with the zoo contract
+    (``train.transfer.TransferHead``: ``head_dropout``/``head`` params) —
+    one feature cache and one head-merge path serve every family."""
+
+    num_classes: int = 5
+    variant: str = "tiny"
+    width_mult: float = 1.0
+    dropout: float = 0.5
+    freeze_base: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        feats = ConvNeXtBackbone(self.variant, self.width_mult, self.dtype,
+                                 name="backbone")(x, train and not self.freeze_base)
+        if self.freeze_base:
+            # Keras trainable=False semantics (same contract as the other
+            # zoo families; XLA drops the backbone backward entirely).
+            feats = jax.lax.stop_gradient(feats)
+        h = jnp.mean(feats.astype(jnp.float32), axis=(1, 2))
+        h = nn.Dropout(self.dropout, deterministic=not train,
+                       name="head_dropout")(h)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(h)
+
+    @staticmethod
+    def frozen_prefixes(freeze_base: bool) -> tuple[str, ...]:
+        return ("backbone",) if freeze_base else ()

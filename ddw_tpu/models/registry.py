@@ -114,6 +114,31 @@ def _resnet(cfg: ModelCfg):
     )
 
 
+@register_model("convnext_tiny")
+@register_model("convnext_small")
+def _convnext(cfg: ModelCfg):
+    from ddw_tpu.models.convnext import ConvNeXt
+
+    if cfg.dw_impl != "xla":
+        # The in-tree Pallas depthwise kernel is 3x3-only; ConvNeXt's 7x7
+        # depthwise rides XLA's grouped-conv lowering by design. Silently
+        # ignoring the knob would make a dw_impl A/B compare identical
+        # programs.
+        raise ValueError(
+            f"convnext ignores model.dw_impl={cfg.dw_impl!r}: its 7x7 "
+            f"depthwise always lowers via XLA (the Pallas kernel is "
+            f"3x3-only — see ddw_tpu/ops/depthwise_conv.py); drop the "
+            f"setting or use mobilenet_v2 for the Pallas arm")
+    return ConvNeXt(
+        num_classes=cfg.num_classes,
+        variant=cfg.name.removeprefix("convnext_"),
+        width_mult=cfg.width_mult,
+        dropout=cfg.dropout,
+        freeze_base=cfg.freeze_base,
+        dtype=_dtype(cfg),
+    )
+
+
 @register_model("vit")
 def _vit(cfg: ModelCfg):
     from ddw_tpu.models.vit import ViT

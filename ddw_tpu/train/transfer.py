@@ -72,6 +72,7 @@ def _pooled_feature_fn(model):
     variable dict) in inference mode, then the same GAP the full model's
     ``__call__`` computes. Frozen-base semantics exactly: BN running stats,
     f32 pooling of the compute-dtype feature map."""
+    from ddw_tpu.models.convnext import ConvNeXt, ConvNeXtBackbone
     from ddw_tpu.models.mobilenet_v2 import MobileNetV2, MobileNetV2Backbone
     from ddw_tpu.models.resnet import ResNet, ResNetBackbone
 
@@ -80,10 +81,13 @@ def _pooled_feature_fn(model):
                                        model.dtype)
     elif isinstance(model, ResNet):
         backbone = ResNetBackbone(model.depth, model.width_mult, model.dtype)
+    elif isinstance(model, ConvNeXt):
+        backbone = ConvNeXtBackbone(model.variant, model.width_mult,
+                                    model.dtype)
     else:
         raise TypeError(
             f"cached-feature transfer needs a backbone/head zoo model "
-            f"(MobileNetV2, ResNet); got {type(model).__name__}")
+            f"(MobileNetV2, ResNet, ConvNeXt); got {type(model).__name__}")
 
     def apply(variables, images):
         vs = {"params": variables["params"]["backbone"]}

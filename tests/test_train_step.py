@@ -147,6 +147,34 @@ def test_resnet_family_trains(name):
     assert ResNet.frozen_prefixes(True) == ("backbone",)
 
 
+def test_convnext_family_trains():
+    """ConvNeXt zoo entry: init, DP step, loss decreases — and, unlike the
+    BN families, NO batch_stats collection (the stats-free train-step path
+    for a conv model; only ViT/LM exercised it before)."""
+    from ddw_tpu.models.convnext import ConvNeXt
+
+    mesh = make_mesh(MeshSpec((("data", 2),)), devices=jax.devices()[:2])
+    mcfg = ModelCfg(name="convnext_tiny", num_classes=5, dropout=0.0,
+                    width_mult=0.25, dtype="float32", freeze_base=False)
+    tcfg = TrainCfg(batch_size=4, learning_rate=1e-3, optimizer="adam")
+    m = build_model(mcfg)
+    assert isinstance(m, ConvNeXt)
+    state, tx = init_state(m, mcfg, tcfg, IMG, jax.random.PRNGKey(0))
+    assert not state.batch_stats, "convnext is LayerNorm-only"
+    step = make_train_step(m, tx, mesh, donate=False)
+    imgs, lbls = _batch(8)
+    losses = []
+    for i in range(8):
+        state, metrics = step(state, imgs, lbls, jax.random.PRNGKey(i))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert ConvNeXt.frozen_prefixes(True) == ("backbone",)
+    # 7x7 depthwise + GRN actually present in the tree
+    p0 = state.params["backbone"]["stage0_block0"]
+    assert p0["dwconv"]["kernel"].shape[:2] == (7, 7)
+    assert "grn" in p0
+
+
 def test_grad_accum_equivalence():
     """grad_accum_steps=2 on the same per-device batch == one full-batch step
     (mean of equal microbatch means is the full mean; GroupNorm is per-example

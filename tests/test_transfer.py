@@ -139,6 +139,43 @@ def _frozen_cfg(**kw):
     return ModelCfg(**base)
 
 
+def test_feature_cache_convnext_stats_free(tmp_path):
+    """The cached-feature path for a BN-free family: ConvNeXt has no
+    batch_stats, so the backbone surgery, fingerprint, and cache must work
+    with an empty stats tree (only ViT-adjacent code hit this before)."""
+    import warnings
+
+    from ddw_tpu.data.loader import preprocess_image
+    from ddw_tpu.data.store import TableStore
+    from ddw_tpu.train.transfer import _pooled_feature_fn, materialize_features
+
+    store = TableStore(str(tmp_path / "tables"))
+    tbl = _jpeg_table(store, "silver", n=9)
+    cfg = _frozen_cfg(name="convnext_tiny", width_mult=0.25)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = build_model(cfg)
+    state, _ = init_state(model, cfg, TrainCfg(batch_size=4), (HW, HW, 3),
+                          jax.random.PRNGKey(0))
+    assert not state.batch_stats
+
+    ft = materialize_features(model, state.params, state.batch_stats, tbl,
+                              store, "cnx_feat", (HW, HW), batch_size=4)
+    assert ft.num_records == 9
+    dim = ft.meta["feature_dim"]
+    assert dim == max(8, int(768 * 0.25))
+
+    rec = next(tbl.iter_records())
+    direct = _pooled_feature_fn(model)(
+        {"params": state.params},
+        jnp.asarray(preprocess_image(rec.content, HW, HW)[None]))
+    cached = np.frombuffer(next(ft.iter_records()).content, np.float32)
+    # batch-4 (cache) vs batch-1 (direct) jit fusion drift through 15 LN
+    # blocks: looser than the MobileNet check, still ~1e-4 relative
+    np.testing.assert_allclose(np.asarray(direct)[0], cached,
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_feature_cache_roundtrip_reuse_and_stale_rejection(tmp_path):
     """materialize_features: every record featurized (no drop-remainder), the
     cache is reused on identical backbone+source, and recomputed when the
