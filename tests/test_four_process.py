@@ -289,6 +289,115 @@ def test_four_process_ring_attention_matches_single_process(
                                rtol=1e-5, atol=1e-6)
 
 
+def _ep_worker() -> dict:
+    """MoE LM with expert parallelism over a REAL 4-process gang: each
+    process hosts one expert, so every routed token crosses processes via
+    all_to_all — 4-way dispatch/combine, not a pair swap."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ddw_tpu.models.lm import TransformerLM
+    from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
+    from ddw_tpu.train.lm_step import init_lm_state, make_lm_train_step
+
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, 4),)), devices=jax.devices()[:4])
+    model = TransformerLM(vocab_size=32, max_len=32, hidden=32, depth=2,
+                          num_heads=2, mlp_dim=64, dropout=0.0,
+                          dtype=jnp.float32, num_experts=4,
+                          expert_axis=DATA_AXIS)
+    tx = optax.sgd(1e-1)
+    state = init_lm_state(model, tx, jax.random.PRNGKey(2))
+    step = make_lm_train_step(model, tx, mesh, DATA_AXIS, seq_axis=None,
+                              donate=False)
+    rng = np.random.RandomState(1)
+    toks = rng.randint(0, 32, size=(8, 17)).astype(np.int32)
+    losses, aux = [], []
+    for i in range(3):
+        state, metrics = step(state, toks[:, :-1], toks[:, 1:],
+                              jax.random.PRNGKey(3 + i))
+        losses.append(float(jax.device_get(metrics["loss"])))
+        aux.append(float(jax.device_get(metrics["aux_loss"])))
+    return {"processes": jax.process_count(), "losses": losses, "aux": aux}
+
+
+def test_four_process_expert_parallel_matches_single_process(
+        worker_pythonpath):
+    """4-way expert dispatch over 4 OS processes computes the same losses
+    and Switch aux loss as over 4 virtual devices in one process — the
+    all_to_all analog of the pipeline/ring gang tests. Completes the
+    real-gang series: DP, FSDP, hybrid, PP, SP, EP."""
+    out = Launcher(np=4, devices_per_proc=1, timeout_s=900).run(_ep_worker)
+    assert out["processes"] == 4
+    assert np.isfinite(out["losses"]).all()
+    assert out["losses"][-1] < out["losses"][0]
+
+    ref = _ep_worker()
+    assert ref["processes"] == 1
+    np.testing.assert_allclose(out["losses"], ref["losses"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out["aux"], ref["aux"], rtol=1e-5, atol=1e-6)
+
+
+def _tp_worker() -> dict:
+    """Megatron-style tensor parallelism over a REAL 4-process gang: the
+    `model` axis spans 4 processes, so every layer's activation psum
+    crosses process boundaries (GSPMD inserts them per LM_TP_RULES)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ddw_tpu.models.lm import TransformerLM
+    from ddw_tpu.parallel.sharding import LM_TP_RULES, make_sharded_train_step
+    from ddw_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, make_mesh,
+                                      MeshSpec)
+    from ddw_tpu.train.lm_step import init_lm_state
+
+    mesh = make_mesh(MeshSpec(((DATA_AXIS, 1), (MODEL_AXIS, 4))),
+                     devices=jax.devices()[:4])
+    # heads/vocab/mlp all divisible by the 4-way model axis
+    model = TransformerLM(vocab_size=32, max_len=32, hidden=32, depth=2,
+                          num_heads=4, mlp_dim=64, dropout=0.0,
+                          dtype=jnp.float32, seq_axis=None)
+    tx = optax.sgd(1e-1)
+    state = init_lm_state(model, tx, jax.random.PRNGKey(2))
+    step = make_sharded_train_step(model, tx, mesh, LM_TP_RULES)
+    state = step.place_state(state)
+    emb_spec = str(jax.tree.leaves(
+        state.params["tok_embed"])[0].sharding.spec)
+    rng = np.random.RandomState(1)
+    toks = rng.randint(0, 32, size=(4, 17)).astype(np.int32)
+    inputs = jax.device_put(toks[:, :-1], step.batch_sharding)
+    targets = jax.device_put(toks[:, 1:], step.batch_sharding)
+    losses = []
+    for i in range(3):
+        state, metrics = step(state, inputs, targets, jax.random.PRNGKey(3 + i))
+        losses.append(float(jax.device_get(metrics["loss"])))
+    return {"processes": jax.process_count(), "losses": losses,
+            "emb_spec": emb_spec}
+
+
+def test_four_process_tensor_parallel_matches_single_process(
+        worker_pythonpath):
+    """4-way TP over 4 OS processes: params genuinely sharded over the
+    cross-process model axis, losses identical to the same program on 4
+    virtual devices in one process."""
+    out = Launcher(np=4, devices_per_proc=1, timeout_s=900).run(_tp_worker)
+    assert out["processes"] == 4
+    # exact spec, not a substring: vocab-sharded embedding per LM_TP_RULES
+    # (the loss comparison alone cannot tell whether TP happened at all)
+    assert out["emb_spec"] == "PartitionSpec('model', None)", out["emb_spec"]
+    assert np.isfinite(out["losses"]).all()
+    assert out["losses"][-1] < out["losses"][0]
+
+    ref = _tp_worker()
+    assert ref["processes"] == 1
+    np.testing.assert_allclose(out["losses"], ref["losses"],
+                               rtol=1e-5, atol=1e-6)
+
+
 def _elastic_state_and_step():
     """Shared skeleton for the save/restore gangs: ZeRO state over
     data=-1 (whatever this gang's world is) + its train step."""
