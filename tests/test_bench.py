@@ -91,6 +91,83 @@ def test_host_pipeline(bench_json):
         assert host["native_ok_fraction"] == 1.0
 
 
+def test_banked_window_fallback(tmp_path, monkeypatch):
+    """When the tunnel is down at capture, bench.py falls back to the
+    default-knob measurements this round's queue windows banked under
+    benchruns/ — merged newest-wins per config, headline value from the
+    frozen row, honestly labeled — and NEVER merges A/B-arm outputs (same
+    config names, overridden knobs)."""
+    import time as _time
+
+    import bench
+
+    now = _time.time()
+
+    def write(name, configs, mtime):
+        p = tmp_path / f"{name}.out"
+        p.write_text(json.dumps({
+            "device": {"kind": "TPU v5 lite", "n": 1},
+            "configs": configs}) + "\n")
+        os.utime(p, (mtime, mtime))
+
+    write("resnet50", {"resnet50": {"rate_per_chip": 2000.0}}, now - 3000)
+    # an older window measured the frozen row slower; the newer wins
+    write("mn_frozen_repeat",
+          {"mobilenet_v2_frozen": {"rate_per_chip": 26000.0}}, now - 3500)
+    write("e2e_loader", {"mobilenet_v2_frozen": {"rate_per_chip": 39000.0},
+                         "e2e_raw_u8": {"error": "wedged"}}, now - 2000)
+    # A/B arm at overridden knobs: must NOT appear as lm_flash
+    write("ab_lm_plain", {"lm_flash": {"rate_per_chip": 9e9}}, now - 1000)
+    # a previous round's leftover: outside the 24 h staleness bound
+    write("lm_moe", {"lm_moe": {"rate_per_chip": 5.0}}, now - 30 * 3600)
+    monkeypatch.setenv("DDW_BENCH_RUNDIR", str(tmp_path))
+
+    got = bench._banked_window_fallback()
+    assert got["live_measurement"] is False
+    assert got["value"] == 39000.0  # newest frozen row wins
+    assert got["vs_baseline"] == round(39000.0 / bench.BASELINE_IPS, 3)
+    assert got["configs"]["resnet50"]["rate_per_chip"] == 2000.0
+    assert "lm_flash" not in got["configs"]
+    assert "e2e_raw_u8" not in got["configs"]  # error rows never merge
+    assert "lm_moe" not in got["configs"]  # stale rounds never merge
+    assert got["config_sources"]["mobilenet_v2_frozen"].startswith(
+        "benchruns/e2e_loader.out @ ")
+    assert got["device"]["kind"] == "TPU v5 lite"
+
+    # a banked payload that leaked into an .out must never re-enter the merge
+    write("vit", {"mobilenet_v2_frozen": {"rate_per_chip": 1.0}}, now - 500)
+    p = tmp_path / "vit.out"
+    leaked = json.loads(p.read_text())
+    leaked["live_measurement"] = False
+    p.write_text(json.dumps(leaked) + "\n")
+    os.utime(p, (now - 500, now - 500))
+    assert bench._banked_window_fallback()["value"] == 39000.0
+
+    monkeypatch.setenv("DDW_BENCH_RUNDIR", str(tmp_path / "empty"))
+    assert bench._banked_window_fallback() is None  # honest-null path
+
+
+def test_default_knob_items_match_queue_script():
+    """bench._DEFAULT_KNOB_ITEMS must track tools/chip_queue.sh: every queue
+    item that invokes bench.py at default knobs (only the stall budget and
+    the config selector set) belongs in the fallback merge, and every
+    overridden-knob arm (ab_*, scan-chained, int8) must stay out. Guards the
+    two-file pairing the same way the matrix/_CONFIG_NAMES check guards
+    bench.py internally."""
+    import re
+
+    import bench
+
+    script = open(os.path.join(REPO, "tools", "chip_queue.sh")).read()
+    default_knob = set()
+    for name, cmd in re.findall(
+            r'run_item\s+(\S+)\s+"([^"]*bench\.py[^"]*)"', script):
+        env_keys = set(re.findall(r"(DDW_[A-Z0-9_]+)=", cmd))
+        if env_keys <= {"DDW_BENCH_STALL_S", "DDW_BENCH_ONLY"}:
+            default_knob.add(name)
+    assert default_knob == set(bench._DEFAULT_KNOB_ITEMS)
+
+
 def test_scan_chained_rows():
     """DDW_BENCH_CHAIN=scan: the lax.scan megastep arm produces valid rows
     tagged "chain": "scan" for vision, feature-cache and LM families — the

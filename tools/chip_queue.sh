@@ -83,7 +83,7 @@ run_item() {
 log "runner started pid=$$"
 while :; do
   all_done=1
-  for name in resnet50 vit lm_flash lm_moe mn_frozen_repeat mn_frozen_scan e2e_loader ab_lm_plain ab_lm_attn ab_lm_remat step_trace chip_kernels conv_profile_mn conv_profile_rn ab_conv fa2_sweep packaged_infer packaged_infer_int8 serving_curve; do
+  for name in mn_frozen_repeat mn_frozen_scan resnet50 e2e_loader vit lm_flash ab_lm_plain ab_lm_attn ab_lm_remat lm_moe step_trace chip_kernels conv_profile_mn conv_profile_rn ab_conv packaged_infer packaged_infer_int8 fa2_sweep serving_curve; do
     [ -f "$LOGDIR/$name.done" ] || { [ -f "$LOGDIR/$name.attempts" ] && [ "$(cat "$LOGDIR/$name.attempts")" -ge "$MAX_ATTEMPTS" ]; } || all_done=0
   done
   if [ "$all_done" -eq 1 ]; then
@@ -92,20 +92,23 @@ while :; do
   fi
   if probe; then
     log "tunnel UP — draining queue"
-    # Priority order: finish the headline matrix first, then the profile,
-    # then the A/B candidates, then the FA2 sweep (longest).
-    run_item resnet50        "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=resnet50 python -u bench.py" || continue
-    run_item vit             "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=vit python -u bench.py" || continue
-    run_item lm_flash        "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=lm_flash python -u bench.py" || continue
-    run_item lm_moe          "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=lm_moe python -u bench.py" || continue
+    # Priority order (windows observed at ~7-10 min, so cheapest-compile +
+    # headline-feeding rows first): the frozen rows resolve the 26.6k-vs-40k
+    # anomaly AND are the headline metric bench.py's banked-window fallback
+    # reports if the tunnel is down at driver time; then the e2e system rows;
+    # then the transformer rows + their A/B arms (which reuse the lm_flash
+    # compile cache); then profiles/kernels; the long sweeps last.
     run_item mn_frozen_repeat "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_frozen_feature_cache python -u bench.py" || continue
     # Same two rows, scan-chained (one dispatch per 8 steps): if this row is
     # fast while the loop row is slow, the window-1 frozen regression was the
     # tunnel's dispatch rate, not the device.
     run_item mn_frozen_scan  "DDW_BENCH_STALL_S=900 DDW_BENCH_CHAIN=scan DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_frozen_feature_cache python -u bench.py" || continue
+    run_item resnet50        "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=resnet50 python -u bench.py" || continue
     # End-to-end loader-fed rows (VERDICT r3 item 3): the Petastorm-role
     # system number — table -> ShardedLoader prefetch -> train step.
     run_item e2e_loader      "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=e2e_raw_u8,e2e_feature_cache python -u bench.py" || continue
+    run_item vit             "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=vit python -u bench.py" || continue
+    run_item lm_flash        "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=lm_flash python -u bench.py" || continue
     # Transformer-gap levers (VERDICT r4 item 1), CORRECTED round 5 by
     # tools/attn_dispatch_evidence.py (structural lowering, no chip): the
     # bench ViT (H4, not the H12 the round-4 note assumed) has a 150.1 MB
@@ -120,6 +123,7 @@ while :; do
     # Remat FLOP/HBM trade at the bench shape (knob landed round 3, never
     # yet queued): checkpoint-dots vs none on the headline LM row.
     run_item ab_lm_remat     "DDW_BENCH_STALL_S=900 DDW_BENCH_LM_REMAT=dots DDW_BENCH_ONLY=lm_flash python -u bench.py" || continue
+    run_item lm_moe          "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=lm_moe python -u bench.py" || continue
     # Per-op profiler traces of the two transformer steps, for offline
     # analysis after the window closes.
     run_item step_trace      "python -u tools/step_trace.py" || continue
@@ -130,9 +134,9 @@ while :; do
     run_item conv_profile_mn "python -u tools/conv_profile.py mobilenet_v2" || continue
     ITEM_TIMEOUT=5400 run_item conv_profile_rn "python -u tools/conv_profile.py resnet50" || continue
     run_item ab_conv         "DDW_BENCH_STALL_S=900 DDW_BENCH_S2D=1 DDW_BENCH_DW=pallas DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_unfrozen,resnet50 python -u bench.py" || continue
-    ITEM_TIMEOUT=5400 run_item fa2_sweep "python -u tools/fa2_sweep.py" || continue
     run_item packaged_infer  "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=packaged_infer python -u bench.py" || continue
     run_item packaged_infer_int8 "DDW_BENCH_STALL_S=900 DDW_BENCH_INT8=1 DDW_BENCH_ONLY=packaged_infer python -u bench.py" || continue
+    ITEM_TIMEOUT=5400 run_item fa2_sweep "python -u tools/fa2_sweep.py" || continue
     # Serving-under-load curves (VERDICT r3 item 8): batch 1->256 image
     # latency + LM per-token latency, speculative on/off.
     ITEM_TIMEOUT=5400 run_item serving_curve "python -u tools/serving_curve.py" || continue
