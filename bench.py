@@ -334,10 +334,18 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
             raise ValueError(f"DDW_BENCH_DW must be 'xla' or 'pallas', got {dw!r}")
         if not model_name.startswith("mobilenet"):
             dw = "xla"
+        # A/B knobs for the tile-aligned ViT arm (ab_vit_tile): the default
+        # h192/H4 geometry runs head_dim-48 attention dots at 28% MXU tile
+        # utilization and caps the row at 59% MFU (tools/mxu_roofline.py);
+        # h256/H2 puts every dot on full 128-wide tiles. ViT only — the conv
+        # families have no head geometry.
+        from ddw_tpu.utils.config import vit_geometry_env
+
+        vit_kw = vit_geometry_env() if model_name == "vit" else {}
         model_cfg = ModelCfg(name=model_name, num_classes=5, dropout=0.5,
                              freeze_base=freeze_base, dtype="bfloat16",
                              allow_frozen_random=freeze_base, stem_s2d=s2d,
-                             dw_impl=dw)
+                             dw_impl=dw, **vit_kw)
         model = build_model(model_cfg)
     train_cfg = TrainCfg(batch_size=batch, optimizer="adam", learning_rate=1e-3)
     state, tx = init_state(model, model_cfg, train_cfg, img, jax.random.PRNGKey(0))
@@ -368,6 +376,9 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
                "images/sec/chip")
     row["batch_per_chip"] = batch
     row["image"] = list(img)
+    if vit_kw:  # non-default geometry: the A/B row must say what it measured
+        row["model_shape"] = {"hidden": model.hidden,
+                              "num_heads": model.num_heads}
     return row
 
 
@@ -625,8 +636,14 @@ def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
     n_chips = len(devices)
     mesh = make_mesh(MeshSpec(((DATA_AXIS, -1),)), devices=devices)
 
-    # A/B knob: DDW_BENCH_LM_REMAT=full|dots measures the remat FLOP/HBM
-    # trade on the chip (default none — the headline row).
+    # A/B knobs: DDW_BENCH_LM_REMAT=full|dots measures the remat FLOP/HBM
+    # trade on the chip (default none — the headline row);
+    # DDW_BENCH_LM_HEADS overrides the head count at IDENTICAL step FLOPs
+    # (h512/H8 gives head_dim-64 attention dots at 50% MXU tile utilization;
+    # H4 gives d128 full tiles — the ab_lm_tile arm).
+    from ddw_tpu.utils.config import lm_heads_env
+
+    heads = lm_heads_env(heads)
     model = TransformerLM(vocab_size=vocab, max_len=seq, hidden=hidden,
                           depth=depth, num_heads=heads, mlp_dim=hidden * 4,
                           dropout=0.0, dtype=jnp.bfloat16, seq_axis=None,
@@ -657,6 +674,8 @@ def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
     row = _row(global_batch * seq, n_chips, dt, measured_steps, flops, peak,
                "tokens/sec/chip")
     row.update(batch_per_chip=batch, seq_len=seq, hidden=hidden, depth=depth)
+    if os.environ.get("DDW_BENCH_LM_HEADS"):
+        row["num_heads"] = heads  # non-default geometry: say what ran
     if num_experts:
         row["num_experts"] = num_experts
     return row

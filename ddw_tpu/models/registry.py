@@ -146,6 +146,11 @@ def _vit(cfg: ModelCfg):
     kwargs = {}
     if cfg.num_heads:
         kwargs["num_heads"] = cfg.num_heads
+    if cfg.hidden:
+        # mlp_dim keeps the 4x ratio the default geometry uses; everything
+        # else (patch, depth) is shape-independent of width
+        kwargs["hidden"] = cfg.hidden
+        kwargs["mlp_dim"] = 4 * cfg.hidden
     return ViT(num_classes=cfg.num_classes, dropout=cfg.dropout, dtype=_dtype(cfg),
                lora_rank=cfg.lora_rank, lora_alpha=cfg.lora_alpha,
                lora_targets=tuple(cfg.lora_targets), **kwargs)

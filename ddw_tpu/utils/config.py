@@ -63,6 +63,14 @@ class ModelCfg:
                                         # Param shapes depend on it — set it when
                                         # restoring a package saved with a
                                         # non-default head count.
+    hidden: int = 0                     # encoder width (ViT); 0 = model default
+                                        # (192). The v5e MXU is a 128x128 array:
+                                        # hidden=256 with num_heads=2 puts every
+                                        # projection and attention dot on full
+                                        # 128-wide tiles (tools/mxu_roofline.py
+                                        # quantifies the default's 59% ceiling).
+                                        # Param shapes depend on it — set it when
+                                        # restoring a non-default package.
     pretrained_path: str = ""           # optional converted-weights artifact
     allow_frozen_random: bool = False   # opt-in: keep freeze_base=True even with
                                         # no pretrained_path (build_model otherwise
@@ -290,6 +298,33 @@ def env_flag(name: str) -> bool:
         return True
     raise ValueError(f"{name} must be a boolean flag "
                      f"(1/true/yes/on or 0/false/no/off), got {val!r}")
+
+
+def vit_geometry_env() -> dict:
+    """``DDW_BENCH_VIT_HIDDEN`` / ``DDW_BENCH_VIT_HEADS`` → ModelCfg kwargs.
+
+    The ONE parser for the tile-geometry A/B knobs, shared by ``bench.py``
+    (the chip arm) and ``tools/attn_dispatch_evidence.py`` (the offline
+    lowering ``tools/mxu_roofline.py`` analyzes) — the two must describe the
+    same program by construction, not by hand-synced duplication. Empty or
+    unset vars mean "model default"."""
+    import os
+
+    geo = {}
+    if os.environ.get("DDW_BENCH_VIT_HIDDEN", "").strip():
+        geo["hidden"] = int(os.environ["DDW_BENCH_VIT_HIDDEN"])
+    if os.environ.get("DDW_BENCH_VIT_HEADS", "").strip():
+        geo["num_heads"] = int(os.environ["DDW_BENCH_VIT_HEADS"])
+    return geo
+
+
+def lm_heads_env(default: int) -> int:
+    """``DDW_BENCH_LM_HEADS`` override (tile-geometry A/B arm), shared like
+    :func:`vit_geometry_env`. Empty or unset means ``default``."""
+    import os
+
+    val = os.environ.get("DDW_BENCH_LM_HEADS", "").strip()
+    return int(val) if val else default
 
 
 def apply_overrides(cfgs: dict[str, Any], overrides: list[str]) -> dict[str, Any]:

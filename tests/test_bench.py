@@ -168,6 +168,43 @@ def test_default_knob_items_match_queue_script():
     assert default_knob == set(bench._DEFAULT_KNOB_ITEMS)
 
 
+def test_vit_hidden_override_builds_tile_geometry():
+    """ModelCfg.hidden=256 + num_heads=2 (the ab_vit_tile geometry) must
+    reach the ViT: encoder width, mlp_dim 4x ratio, and head_dim 128 — the
+    full-tile shape tools/mxu_roofline.py shows lifts the MFU ceiling from
+    59% to 94%."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.utils.config import ModelCfg
+
+    model = build_model(ModelCfg(name="vit", num_classes=5, hidden=256,
+                                 num_heads=2))
+    assert model.hidden == 256
+    assert model.mlp_dim == 1024
+    assert model.num_heads == 2
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 32, 32, 3)), train=False)["params"]
+    q = params["backbone_block0"]["attn"]["query"]["kernel"]
+    assert q.shape == (256, 2, 128)  # (hidden, heads, head_dim=128)
+
+
+def test_tile_geometry_arm_rows():
+    """The ab_lm_tile / ab_vit_tile knobs must produce valid rows tagged
+    with the non-default geometry they measured (the chip arms' outputs are
+    read by humans folding them into BASELINE.md — a silently-default row
+    would record the wrong experiment)."""
+    d = _run_bench(DDW_BENCH_LM_HEADS="2",
+                   DDW_BENCH_VIT_HIDDEN="64", DDW_BENCH_VIT_HEADS="2",
+                   DDW_BENCH_ONLY="vit,lm_flash")
+    vit, lm = d["configs"]["vit"], d["configs"]["lm_flash"]
+    assert vit["rate_per_chip"] > 0
+    assert vit["model_shape"] == {"hidden": 64, "num_heads": 2}
+    assert lm["rate_per_chip"] > 0
+    assert lm["num_heads"] == 2
+
+
 def test_scan_chained_rows():
     """DDW_BENCH_CHAIN=scan: the lax.scan megastep arm produces valid rows
     tagged "chain": "scan" for vision, feature-cache and LM families — the

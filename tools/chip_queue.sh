@@ -83,7 +83,7 @@ run_item() {
 log "runner started pid=$$"
 while :; do
   all_done=1
-  for name in mn_frozen_repeat mn_frozen_scan resnet50 e2e_loader vit lm_flash ab_lm_plain ab_lm_attn ab_lm_remat lm_moe step_trace chip_kernels conv_profile_mn conv_profile_rn ab_conv packaged_infer packaged_infer_int8 fa2_sweep serving_curve; do
+  for name in mn_frozen_repeat mn_frozen_scan resnet50 e2e_loader vit lm_flash ab_lm_plain ab_lm_attn ab_lm_remat lm_moe step_trace chip_kernels conv_profile_mn conv_profile_rn ab_conv packaged_infer packaged_infer_int8 fa2_sweep serving_curve ab_lm_tile ab_vit_tile; do
     [ -f "$LOGDIR/$name.done" ] || { [ -f "$LOGDIR/$name.attempts" ] && [ "$(cat "$LOGDIR/$name.attempts")" -ge "$MAX_ATTEMPTS" ]; } || all_done=0
   done
   if [ "$all_done" -eq 1 ]; then
@@ -140,6 +140,13 @@ while :; do
     # Serving-under-load curves (VERDICT r3 item 8): batch 1->256 image
     # latency + LM per-token latency, speculative on/off.
     ITEM_TIMEOUT=5400 run_item serving_curve "python -u tools/serving_curve.py" || continue
+    # Tile-aligned geometry arms (round 5, tools/mxu_roofline.py): the LM arm
+    # changes ONLY the head count (identical step FLOPs — h512/H8 d64 dots at
+    # 50% tile util vs H4 d128 full tiles); the ViT arm is the tile-aligned
+    # width (h256/H2, every dot on full 128-wide tiles — more FLOPs than
+    # h192, so compare MFU-vs-ceiling, not raw img/s).
+    run_item ab_lm_tile      "DDW_BENCH_STALL_S=900 DDW_BENCH_LM_HEADS=4 DDW_BENCH_ONLY=lm_flash python -u bench.py" || continue
+    run_item ab_vit_tile     "DDW_BENCH_STALL_S=900 DDW_BENCH_VIT_HIDDEN=256 DDW_BENCH_VIT_HEADS=2 DDW_BENCH_ONLY=vit python -u bench.py" || continue
   fi
   sleep "$PROBE_SLEEP" 9>&-
 done
