@@ -20,3 +20,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(__file__))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection failure-path tests "
+        "(runtime.faults / GangSupervisor); run in tier-1 on CPU")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running variants (multi-restart gangs, full-trainer "
+        "fault drills) excluded from the tier-1 'not slow' selection")

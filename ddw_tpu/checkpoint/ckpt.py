@@ -32,18 +32,32 @@ def _write_host_state(ckpt_dir: str, host_state, step: int,
                       metadata: dict | None, keep: int) -> str:
     """The pure host-side write: serialize + atomic rename + retention.
     Runs on the caller's thread (sync mode) or the manager's writer thread
-    (async mode) — takes only host arrays, never device handles."""
+    (async mode) — takes only host arrays, never device handles.
+
+    Crash-consistency discipline (docs/fault_tolerance.md): every file lands
+    fully inside the ``.tmp`` staging dir and is fsynced before the single
+    ``os.replace`` publishes the step — a kill at any instant leaves either
+    no ``step_N`` dir or a complete one. The metadata sidecar records the
+    exact serialized byte count so readers can *detect* a torn dir (however
+    produced — non-atomic writers, partial copies, filesystem loss) and
+    quarantine it rather than poisoning resume."""
     os.makedirs(ckpt_dir, exist_ok=True)
     final = os.path.join(ckpt_dir, f"step_{step:010d}")
     tmp = final + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
+    blob = serialization.to_bytes(host_state)
     with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
-        f.write(serialization.to_bytes(host_state))
-    meta = {"step": step, "created_unix": time.time(), **(metadata or {})}
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    meta = {"step": step, "created_unix": time.time(),
+            "state_bytes": len(blob), **(metadata or {})}
     with open(os.path.join(tmp, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
     if os.path.exists(final):
         shutil.rmtree(final)
     os.replace(tmp, final)
@@ -75,23 +89,78 @@ def _list_steps(ckpt_dir: str) -> list[int]:
             try:
                 out.append(int(d[len("step_"):]))
             except ValueError:
-                pass
+                pass  # also skips quarantined "step_N.torn<k>" dirs
     return out
 
 
+def _step_dir_complete(ckpt_dir: str, step: int) -> bool:
+    """Torn-write detector: a step dir is usable iff both files are present,
+    the metadata parses, and (when the writer recorded it) the state file's
+    size matches the serialized byte count. Atomically-published dirs always
+    pass; partial dirs from non-atomic writers, kills mid-copy, or filesystem
+    loss fail."""
+    d = os.path.join(ckpt_dir, f"step_{step:010d}")
+    state_path = os.path.join(d, "state.msgpack")
+    meta_path = os.path.join(d, "metadata.json")
+    if not (os.path.isfile(state_path) and os.path.isfile(meta_path)):
+        return False
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except Exception:
+        return False
+    expect = meta.get("state_bytes")
+    if expect is not None and os.path.getsize(state_path) != expect:
+        return False
+    return True
+
+
+def _quarantine_step(ckpt_dir: str, step: int) -> str | None:
+    """Move a torn ``step_N`` dir aside (``step_N.torn<k>``) so it stops
+    shadowing older good checkpoints; kept for forensics, invisible to
+    ``_list_steps``. Concurrent quarantines of the same dir race benignly —
+    one rename wins, the loser's OSError is swallowed."""
+    src = os.path.join(ckpt_dir, f"step_{step:010d}")
+    for k in range(100):
+        dst = f"{src}.torn{k}"
+        if os.path.exists(dst):
+            continue
+        try:
+            os.replace(src, dst)
+            return dst
+        except OSError:
+            return None
+    return None
+
+
 def latest_step(ckpt_dir: str) -> int | None:
-    steps = _list_steps(ckpt_dir)
-    return max(steps) if steps else None
+    """Newest *complete* step. Torn step dirs encountered on the way are
+    quarantined — a kill mid-write (or a torn copy) must never poison resume;
+    the scan falls back to the previous good step."""
+    for s in sorted(_list_steps(ckpt_dir), reverse=True):
+        if _step_dir_complete(ckpt_dir, s):
+            return s
+        _quarantine_step(ckpt_dir, s)
+    return None
 
 
 def restore_checkpoint(ckpt_dir: str, target, step: int | None = None):
     """Restore into ``target``'s structure (a template TrainState). Every host reads
     the same file — identical restore replaces the rank-0 broadcast. Returns
-    (state, step) or (target, None) when no checkpoint exists."""
+    (state, step) or (target, None) when no checkpoint exists. With
+    ``step=None`` torn step dirs are quarantined and the newest good step is
+    used; an explicitly requested torn step raises (the caller named a
+    checkpoint that does not usably exist)."""
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
             return target, None
+    elif not _step_dir_complete(ckpt_dir, step):
+        quarantined = _quarantine_step(ckpt_dir, step)
+        raise FileNotFoundError(
+            f"checkpoint step {step} in {ckpt_dir} is missing or torn"
+            + (f" (quarantined to {quarantined})" if quarantined else "")
+            + "; pass step=None to fall back to the newest good checkpoint")
     path = os.path.join(ckpt_dir, f"step_{step:010d}", "state.msgpack")
     with open(path, "rb") as f:
         state = serialization.from_bytes(target, f.read())

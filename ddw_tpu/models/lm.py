@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ddw_tpu.utils.compat import axis_size
+
 from ddw_tpu.ops.flash_attention import flash_mha
 from ddw_tpu.parallel.ring_attention import ring_attention
 
@@ -293,7 +295,7 @@ class TransformerLM(nn.Module):
             # reuse the last positions on trailing shards instead of failing.
             # (RoPE has no table — positions extrapolate, so SP sequences may
             # exceed max_len; only the decode cache stays bounded by it.)
-            n_shards = lax.axis_size(self.seq_axis)
+            n_shards = axis_size(self.seq_axis)
             if (self.pos_encoding == "learned"
                     and s_local * n_shards > self.max_len):
                 raise ValueError(

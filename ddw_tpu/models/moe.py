@@ -41,6 +41,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ddw_tpu.utils.compat import axis_size
+
 
 def collect_sown(mods: dict, name: str) -> list:
     """Every value sown under ``name`` anywhere in an ``intermediates``
@@ -232,7 +234,7 @@ class MoEMlp(nn.Module):
         if self.expert_axis is None:
             expert_out = ffn(expert_in, w1, b1, w2, b2)        # [E, C, D]
         else:
-            n = lax.axis_size(self.expert_axis)
+            n = axis_size(self.expert_axis)
             if e % n:
                 raise ValueError(f"num_experts {e} not divisible by "
                                  f"{self.expert_axis!r} axis size {n}")

@@ -38,6 +38,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddw_tpu.utils.compat import axis_size
+
 _LANE = 128  # TPU lane tile; chunks are padded to this multiple
 _VMEM_BUDGET_BYTES = 8 * 2**20  # per-kernel budget for in + out + comm scratch
 
@@ -118,7 +120,7 @@ def ring_all_reduce_pallas(x: jax.Array, axis_name: str,
     auto-selects the Pallas TPU interpreter off-TPU so tests cover the kernel
     on a CPU mesh.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return x
     if interpret is None:

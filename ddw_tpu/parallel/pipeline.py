@@ -74,6 +74,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ddw_tpu.models.lm import DecoderBlock, TransformerLM
 from ddw_tpu.train.lm_step import lm_loss
 from ddw_tpu.train.step import TrainState
+from ddw_tpu.utils.compat import shard_map
 
 PIPE_AXIS = "pipe"
 
@@ -402,12 +403,12 @@ def make_pp_lm_train_step(
     def _build(template_params):
         specs = _spec_tree(template_params, pipe_axis, v)
         tok_spec = P() if data_axis is None else P(data_axis)
-        smapped = jax.shard_map(
+        smapped = shard_map(
             grad_fn, mesh=mesh,
             in_specs=(specs, tok_spec, tok_spec),
             out_specs=(specs, P()),
             check_vma=False)
-        smapped_eval = jax.shard_map(
+        smapped_eval = shard_map(
             metrics_fn, mesh=mesh,
             in_specs=(specs, tok_spec, tok_spec),
             out_specs=P(),

@@ -41,6 +41,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ddw_tpu.utils.compat import axis_size
+
 from ddw_tpu.ops.flash_attention import flash_mha_lse
 
 _NEG_INF = -1e30
@@ -73,7 +75,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     LOCAL S_local x S_local score footprint, so moderate shards get the fused
     XLA arm and long-context shards the Pallas flash kernel.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     if sm_scale is None:

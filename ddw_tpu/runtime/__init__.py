@@ -19,4 +19,19 @@ from ddw_tpu.runtime.collectives import (  # noqa: F401
     all_gather_axis,
     ring_all_reduce,
 )
-from ddw_tpu.runtime.launcher import Launcher  # noqa: F401
+from ddw_tpu.runtime.launcher import GangError, Launcher  # noqa: F401
+from ddw_tpu.runtime.faults import (  # noqa: F401
+    FaultInjected,
+    Preempted,
+    install_preemption_handler,
+    maybe_fault,
+    preemption_requested,
+    request_preemption,
+    reset_preemption,
+)
+from ddw_tpu.runtime.supervisor import (  # noqa: F401
+    AttemptReport,
+    GangFailure,
+    GangSupervisor,
+    restart_generation,
+)

@@ -4,20 +4,80 @@ Each spawned process: initialize the distributed runtime (the ``hvd.init()`` /
 mpirun-rendezvous analog), unpickle and run the train fn, and — rank 0 only — write
 the return value back for the driver (the HorovodRunner return contract,
 reference ``03_model_training_distributed.py:375``).
+
+Robustness contract (docs/fault_tolerance.md):
+
+- SIGTERM is routed to the graceful-preemption flag before any work starts;
+  a step loop that honors it checkpoints and raises ``Preempted``, which this
+  process converts to ``EXIT_PREEMPTED`` so the supervisor restarts without
+  burning the crash budget.
+- A coordinator port-bind failure (the ``_free_port`` probe-to-bind race)
+  exits ``EXIT_COORD_BIND`` so the launcher respawns the gang on a fresh port
+  instead of hanging every other rank until the gang deadline.
+- ``result.pkl`` is written atomically (tmp + ``os.replace``): a rank 0
+  killed mid-write must leave either no result (detected as
+  ``result-missing``) or a complete one — never a torn pickle that masks the
+  root cause or unpickles as garbage on the success path.
 """
 
 from __future__ import annotations
 
+import os
 import pickle
 import sys
 import traceback
 
+from ddw_tpu.runtime.faults import (
+    EXIT_COORD_BIND,
+    EXIT_PREEMPTED,
+    Preempted,
+    install_preemption_handler,
+    maybe_fault,
+)
+
+_BIND_FAILURE_MARKERS = ("address already in use", "failed to bind",
+                         "errno 98", "eaddrinuse", "bind address")
+
+
+def _looks_like_bind_failure(text: str) -> bool:
+    text = text.lower()
+    return any(m in text for m in _BIND_FAILURE_MARKERS)
+
+
+def _write_result(result_path: str, status) -> None:
+    """Atomic result write: serialize fully, then publish via os.replace —
+    the driver either sees the complete pickle or none at all."""
+    try:
+        blob = pickle.dumps(status)
+    except Exception as e:  # unpicklable return value: report, don't mask
+        status = ("error", f"rank-0 return value is not picklable: {e!r}")
+        blob = pickle.dumps(status)
+    tmp = f"{result_path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, result_path)
+
 
 def main() -> int:
     payload_path, result_path = sys.argv[1], sys.argv[2]
+    install_preemption_handler()
+    maybe_fault("coord_bind")
     from ddw_tpu.runtime.mesh import initialize_distributed, is_coordinator
 
-    initialize_distributed()  # reads DDW_COORDINATOR / DDW_NUM_PROCESSES / DDW_PROCESS_ID
+    try:
+        initialize_distributed()  # reads DDW_COORDINATOR / DDW_NUM_PROCESSES / DDW_PROCESS_ID
+    except Exception:
+        tb = traceback.format_exc()
+        if (os.environ.get("DDW_PROCESS_ID", "0") == "0"
+                and _looks_like_bind_failure(tb)):
+            # Coordinator lost the spawn-time port race — a distinguished
+            # exit code tells the launcher "respawn on a fresh port", which
+            # a generic crash must not trigger.
+            sys.stderr.write(tb)
+            return EXIT_COORD_BIND
+        raise
     with open(payload_path, "rb") as f:
         fn_spec, args, kwargs = pickle.load(f)
     kind, blob, qualname = fn_spec
@@ -36,17 +96,25 @@ def main() -> int:
     try:
         value = fn(*args, **kwargs)
         status = ("ok", value)
+    except Preempted as e:
+        # Graceful preemption: the step loop already checkpointed. A clean,
+        # distinguished exit lets the supervisor restart outside the crash
+        # budget.
+        status = ("preempted", {"step": e.step})
     except Exception:
         status = ("error", traceback.format_exc())
     if is_coordinator():
-        try:
-            blob = pickle.dumps(status)
-        except Exception as e:  # unpicklable return value: report, don't mask
-            status = ("error", f"rank-0 return value is not picklable: {e!r}")
-            blob = pickle.dumps(status)
-        with open(result_path, "wb") as f:
-            f.write(blob)
-    return 0 if status[0] == "ok" else 1
+        _write_result(result_path, status)
+    if status[0] == "ok":
+        return 0
+    # Error/preemption exits skip interpreter finalization (os._exit): the
+    # jax.distributed shutdown hooks block on gang peers, and on these paths
+    # a peer is typically wedged inside a collective — a clean sys.exit would
+    # hang this rank until the gang deadline instead of failing fast. The
+    # result file is already durable (fsync + rename above).
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(EXIT_PREEMPTED if status[0] == "preempted" else 1)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ddw_tpu.utils.compat import axis_size
+
 T = TypeVar("T")
 
 
@@ -106,7 +108,7 @@ def ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
     """
     from ddw_tpu.ops.ring_reduce import ring_chunks
 
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return x
     me = lax.axis_index(axis_name)

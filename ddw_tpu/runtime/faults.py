@@ -1,0 +1,235 @@
+"""Deterministic fault injection + graceful-preemption primitives.
+
+The gang's failure modes (worker crash, stall, silent exit, torn checkpoint,
+preemption, coordinator-port collision) are rare and timing-dependent in
+production but must be *reproducible in CI on CPU* for the recovery machinery
+(:mod:`ddw_tpu.runtime.supervisor`, checkpoint quarantine) to stay tested.
+This module turns each of them into an env-var knob:
+
+    DDW_FAULT=<kind>[:key=value]*
+
+Kinds (and the hook site each fires at):
+
+========== ============ ==========================================================
+kind        site         effect when the spec matches
+========== ============ ==========================================================
+crash       step         ``os._exit(EXIT_FAULT_CRASH)`` — a hard SIGKILL-like death
+raise       step         raise :class:`FaultInjected` — the worker writes an error
+                         result and exits nonzero (exercises the rank-0-traceback
+                         surfacing path)
+stall       step         sleep forever — exercises the gang deadline
+exit0_early step         ``os._exit(0)`` before writing a result — a "successful"
+                         exit that leaves the driver with no result.pkl
+preempt     step         deliver SIGTERM to this process (the cluster-manager
+                         preemption analog); the installed handler sets the flag
+                         the trainers' step loops check
+ckpt_torn   step         drop a torn (partial, non-atomic) step dir into the
+                         checkpoint directory, then crash — exercises quarantine
+bind_fail   coord_bind   ``os._exit(EXIT_COORD_BIND)`` before the coordinator
+                         binds — the port-collision (TOCTOU) analog
+========== ============ ==========================================================
+
+Match keys (all optional): ``rank=N`` (default: any rank; read from
+``DDW_PROCESS_ID``), ``step=N`` (default: first check of the site),
+``gen=N|*`` (restart generation, from ``DDW_RESTART_GEN``; default 0 so a
+fault fires in the first generation only and the restarted gang runs clean),
+``attempt=N|*`` (spawn attempt within one generation, from
+``DDW_SPAWN_ATTEMPT``; default 0 so a bind failure clears on the launcher's
+respawn). ``*`` means "any".
+
+Example: ``DDW_FAULT=crash:rank=1:step=3`` kills rank 1 at global step 3 of
+the first generation; every other process/step/generation is untouched. With
+no ``DDW_FAULT`` set, :func:`maybe_fault` is a near-free no-op — the hooks are
+safe to leave in production step loops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+
+# Worker exit codes with supervisor/launcher meaning. Chosen in the 64..113
+# user range so they can't collide with shell/signal conventions.
+EXIT_FAULT_CRASH = 77   # injected hard crash (deterministic stand-in for SIGKILL)
+EXIT_PREEMPTED = 83     # graceful preemption: checkpointed, then clean exit
+EXIT_COORD_BIND = 84    # coordinator could not bind its port (spawn-time race)
+
+KINDS = ("crash", "raise", "stall", "exit0_early", "preempt", "ckpt_torn",
+         "bind_fail")
+
+_SITE_BY_KIND = {k: ("coord_bind" if k == "bind_fail" else "step")
+                 for k in KINDS}
+
+
+class FaultInjected(RuntimeError):
+    """Raised by the ``raise`` fault kind — an injected application error."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Parsed ``DDW_FAULT`` value. ``None`` fields match anything."""
+
+    kind: str
+    rank: int | None = None
+    step: int | None = None
+    gen: int | None = 0
+    attempt: int | None = 0
+
+    @property
+    def site(self) -> str:
+        return _SITE_BY_KIND[self.kind]
+
+    def matches(self, site: str, step: int | None = None,
+                rank: int | None = None, gen: int | None = None,
+                attempt: int | None = None) -> bool:
+        """Pure matching logic (env-independent — unit-testable)."""
+        if site != self.site:
+            return False
+        if self.rank is not None and rank != self.rank:
+            return False
+        if self.gen is not None and gen != self.gen:
+            return False
+        if self.attempt is not None and attempt != self.attempt:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        return True
+
+
+def parse_fault(spec: str) -> FaultSpec | None:
+    """Parse a ``DDW_FAULT`` value; empty/None -> None. Malformed specs raise
+    (a typo'd fault that silently never fires would "pass" every CI run)."""
+    if not spec:
+        return None
+    parts = spec.split(":")
+    kind = parts[0].strip()
+    if kind not in KINDS:
+        raise ValueError(f"unknown DDW_FAULT kind {kind!r}; expected one of "
+                         f"{KINDS}")
+    fields: dict[str, int | None] = {}
+    for part in parts[1:]:
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if key not in ("rank", "step", "gen", "attempt"):
+            raise ValueError(f"unknown DDW_FAULT key {key!r} in {spec!r}")
+        val = val.strip()
+        fields[key] = None if val == "*" else int(val)
+    return FaultSpec(kind=kind, rank=fields.get("rank"),
+                     step=fields.get("step"),
+                     gen=fields.get("gen", 0),
+                     attempt=fields.get("attempt", 0))
+
+
+def _env_int(name: str, default: int = 0) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def active_fault() -> FaultSpec | None:
+    """The currently configured fault, re-read from the env on every call
+    (tests monkeypatch ``DDW_FAULT`` mid-process)."""
+    return parse_fault(os.environ.get("DDW_FAULT", ""))
+
+
+def maybe_fault(site: str, step: int | None = None,
+                ckpt_dir: str | None = None) -> None:
+    """Hook call: fire the configured fault iff its spec matches this site /
+    step / rank / generation / spawn attempt. No-op without ``DDW_FAULT``."""
+    if "DDW_FAULT" not in os.environ:  # fast path for production step loops
+        return
+    spec = active_fault()
+    if spec is None or not spec.matches(
+            site, step=step,
+            rank=_env_int("DDW_PROCESS_ID", 0),
+            gen=_env_int("DDW_RESTART_GEN", 0),
+            attempt=_env_int("DDW_SPAWN_ATTEMPT", 0)):
+        return
+    _fire(spec, step, ckpt_dir)
+
+
+def _fire(spec: FaultSpec, step: int | None, ckpt_dir: str | None) -> None:
+    where = f"rank {_env_int('DDW_PROCESS_ID', 0)}, step {step}, " \
+            f"gen {_env_int('DDW_RESTART_GEN', 0)}"
+    if spec.kind == "crash":
+        os._exit(EXIT_FAULT_CRASH)
+    if spec.kind == "raise":
+        raise FaultInjected(f"injected fault ({where})")
+    if spec.kind == "stall":
+        while True:  # hold the gang hostage until the deadline kill
+            time.sleep(0.5)
+    if spec.kind == "exit0_early":
+        os._exit(0)
+    if spec.kind == "preempt":
+        # The cluster-manager SIGTERM, delivered to ourselves: the installed
+        # handler sets the flag; the step loop notices and checkpoints.
+        # Install first so an in-process (np=-1) test doesn't die to the
+        # default SIGTERM disposition.
+        install_preemption_handler()
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
+    if spec.kind == "ckpt_torn":
+        if ckpt_dir:
+            _write_torn_step_dir(ckpt_dir, (step or 0) + 1000)
+        os._exit(EXIT_FAULT_CRASH)
+    if spec.kind == "bind_fail":
+        os._exit(EXIT_COORD_BIND)
+
+
+def _write_torn_step_dir(ckpt_dir: str, step: int) -> str:
+    """A partial step dir as a non-atomic writer killed mid-write would leave:
+    truncated state bytes, no metadata sidecar. ``latest_step``/``restore``
+    must quarantine it and fall back to the previous good step."""
+    d = os.path.join(ckpt_dir, f"step_{step:010d}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "state.msgpack"), "wb") as f:
+        f.write(b"torn")
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Graceful preemption: SIGTERM -> flag -> checkpoint-and-clean-exit.
+# ---------------------------------------------------------------------------
+
+_preempt_flag = threading.Event()
+
+
+class Preempted(Exception):
+    """Raised by a step loop after it checkpointed in response to SIGTERM.
+
+    In-process (np=-1) runs see it directly; gang workers convert it to
+    ``EXIT_PREEMPTED`` (:mod:`ddw_tpu.runtime._launch_worker`), which the
+    :class:`~ddw_tpu.runtime.supervisor.GangSupervisor` treats as restartable
+    without consuming the crash-restart budget.
+    """
+
+    def __init__(self, step: int | None = None):
+        self.step = step
+        super().__init__(f"preempted at step {step}")
+
+
+def install_preemption_handler(signum: int = signal.SIGTERM) -> None:
+    """Route ``signum`` (default SIGTERM — what cluster managers send before
+    reclaiming a node) to the preemption flag instead of immediate death.
+    Main-thread only (a CPython signal constraint); idempotent."""
+    signal.signal(signum, lambda _sig, _frame: _preempt_flag.set())
+
+
+def preemption_requested() -> bool:
+    """Checked by the trainers once per step: True after SIGTERM arrived."""
+    return _preempt_flag.is_set()
+
+
+def request_preemption() -> None:
+    """Set the flag directly (signal-free path for tests/embedding hosts)."""
+    _preempt_flag.set()
+
+
+def reset_preemption() -> None:
+    _preempt_flag.clear()

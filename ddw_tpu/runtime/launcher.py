@@ -36,11 +36,46 @@ import tempfile
 import time
 from typing import Any, Callable
 
+from ddw_tpu.runtime.faults import EXIT_COORD_BIND, EXIT_PREEMPTED
+
 
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+class GangError(RuntimeError):
+    """Structured gang failure — what the :class:`GangSupervisor` needs to
+    decide restartability without parsing message strings.
+
+    ``kind``: ``"crash"`` (a worker exited nonzero), ``"deadline"`` (shared
+    gang deadline exceeded), ``"coord-bind"`` (the coordinator lost the
+    spawn-time port race, retried ``spawn_retries`` times), or
+    ``"result-missing"`` (every worker exited 0 but rank 0 never wrote a
+    readable result — a silent early exit). ``exit_codes`` is per-rank
+    (``None`` = still running when the gang was killed); ``rank0_traceback``
+    is rank 0's formatted traceback when it got far enough to report one.
+    """
+
+    def __init__(self, message: str, *, kind: str,
+                 exit_codes: list[int | None],
+                 rank0_traceback: str | None = None):
+        super().__init__(message)
+        self.kind = kind
+        self.exit_codes = list(exit_codes)
+        self.rank0_traceback = rank0_traceback
+
+    @property
+    def is_preemption(self) -> bool:
+        """True when any rank exited ``EXIT_PREEMPTED`` (checkpointed, clean
+        SIGTERM exit). Preemption dominates the collateral deaths of the
+        other ranks — they die as the preempted peer leaves the collective
+        (a transport error -> nonzero exit, or the gang kill -> signal), and
+        the preempted rank's exit code guarantees a durable checkpoint to
+        restart from."""
+        return any(c == EXIT_PREEMPTED for c in self.exit_codes
+                   if c is not None)
 
 
 class Launcher:
@@ -52,17 +87,23 @@ class Launcher:
     coordinator, run ``fn`` everywhere, return rank-0's return value.
     """
 
-    def __init__(self, np: int = -1, devices_per_proc: int = 1, timeout_s: float = 600.0):
+    def __init__(self, np: int = -1, devices_per_proc: int = 1,
+                 timeout_s: float = 600.0, spawn_retries: int = 3):
         self.np = np
         self.devices_per_proc = devices_per_proc
         self.timeout_s = timeout_s
+        # Bounded respawn-with-fresh-port attempts when the coordinator loses
+        # the _free_port probe-to-bind race (TOCTOU): the port checked free at
+        # spawn time can be taken before jax.distributed binds it.
+        self.spawn_retries = max(1, spawn_retries)
+        self.last_spawn_attempts = 0  # spawns used by the last _run_multiproc
 
     def run(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         if self.np == -1:
             return fn(*args, **kwargs)
         return self._run_multiproc(fn, args, kwargs)
 
-    def _run_multiproc(self, fn, args, kwargs) -> Any:
+    def _run_multiproc(self, fn, args, kwargs, extra_env: dict | None = None) -> Any:
         # Functions defined in a script's __main__ can't unpickle inside the worker
         # (whose __main__ is the worker module) — the problem HorovodRunner solves
         # with cloudpickle. We ship a (file, qualname) reference instead and the
@@ -82,76 +123,110 @@ class Launcher:
             result = os.path.join(tmp, "result.pkl")
             with open(payload, "wb") as f:
                 pickle.dump((fn_spec, args, kwargs), f)
-            port = _free_port()
-            procs = []
-            for rank in range(self.np):
-                env = dict(os.environ)
-                # Force an isolated CPU backend in workers: disable the axon/TPU
-                # plugin hook and give each process its own virtual device set.
-                env.pop("PALLAS_AXON_POOL_IPS", None)
-                env["JAX_PLATFORMS"] = "cpu"
-                env["XLA_FLAGS"] = (
-                    env.get("DDW_WORKER_XLA_FLAGS", "")
-                    + f" --xla_force_host_platform_device_count={self.devices_per_proc}"
-                ).strip()
-                env["DDW_COORDINATOR"] = f"127.0.0.1:{port}"
-                env["DDW_NUM_PROCESSES"] = str(self.np)
-                env["DDW_PROCESS_ID"] = str(rank)
-                p = subprocess.Popen(
-                    [sys.executable, "-m", "ddw_tpu.runtime._launch_worker", payload, result],
-                    env=env,
-                    stdout=None if rank == 0 else subprocess.DEVNULL,
-                    stderr=None,
-                )
-                procs.append(p)
-            try:
-                # Failure detection (SURVEY §5): poll the whole gang and kill
-                # everyone the moment ANY rank dies abnormally — a crashed rank
-                # must not leave the others hanging in a collective until the
-                # deadline (the Spark-barrier all-or-nothing semantics the
-                # reference relies on, 03_model_training_distributed.py:256).
-                # One shared deadline for the whole gang (not np * timeout).
-                deadline = time.monotonic() + self.timeout_s
-                codes: list[int | None] = [None] * self.np
-                while any(c is None for c in codes):
-                    for i, p in enumerate(procs):
-                        if codes[i] is None:
-                            codes[i] = p.poll()
-                    if any(c not in (None, 0) for c in codes):
-                        for p in procs:
-                            if p.poll() is None:
-                                p.kill()
-                        codes = [p.wait() for p in procs]
-                        raise RuntimeError(
-                            f"worker crashed (exit codes {codes}); gang killed"
-                            + self._rank0_error(result))
-                    if time.monotonic() > deadline:
-                        raise RuntimeError(
-                            f"gang deadline ({self.timeout_s}s) exceeded; "
-                            f"exit codes so far {codes}; killing all workers")
-                    if any(c is None for c in codes):
-                        time.sleep(0.05)
-            finally:
-                for p in procs:
-                    if p.poll() is None:
-                        p.kill()
-            # Reaching here means every worker exited 0.
+            for attempt in range(self.spawn_retries):
+                self.last_spawn_attempts = attempt + 1
+                if os.path.exists(result):  # stale result from a lost spawn
+                    os.remove(result)
+                try:
+                    return self._run_gang(payload, result, attempt, extra_env)
+                except GangError as e:
+                    # Coordinator lost the probe-to-bind port race: the whole
+                    # gang is dead anyway — respawn it on a fresh port instead
+                    # of surfacing (or worse, hanging the caller until the
+                    # gang deadline while ranks wait on a dead coordinator).
+                    if e.kind == "coord-bind" and attempt + 1 < self.spawn_retries:
+                        continue
+                    raise
+
+    def _run_gang(self, payload: str, result: str, attempt: int,
+                  extra_env: dict | None) -> Any:
+        port = _free_port()
+        procs = []
+        for rank in range(self.np):
+            env = dict(os.environ)
+            # Force an isolated CPU backend in workers: disable the axon/TPU
+            # plugin hook and give each process its own virtual device set.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("DDW_WORKER_XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={self.devices_per_proc}"
+            ).strip()
+            env["DDW_COORDINATOR"] = f"127.0.0.1:{port}"
+            env["DDW_NUM_PROCESSES"] = str(self.np)
+            env["DDW_PROCESS_ID"] = str(rank)
+            env["DDW_SPAWN_ATTEMPT"] = str(attempt)
+            if extra_env:
+                env.update({k: str(v) for k, v in extra_env.items()})
+            p = subprocess.Popen(
+                [sys.executable, "-m", "ddw_tpu.runtime._launch_worker", payload, result],
+                env=env,
+                stdout=None if rank == 0 else subprocess.DEVNULL,
+                stderr=None,
+            )
+            procs.append(p)
+        try:
+            # Failure detection (SURVEY §5): poll the whole gang and kill
+            # everyone the moment ANY rank dies abnormally — a crashed rank
+            # must not leave the others hanging in a collective until the
+            # deadline (the Spark-barrier all-or-nothing semantics the
+            # reference relies on, 03_model_training_distributed.py:256).
+            # One shared deadline for the whole gang (not np * timeout).
+            deadline = time.monotonic() + self.timeout_s
+            codes: list[int | None] = [None] * self.np
+            while any(c is None for c in codes):
+                for i, p in enumerate(procs):
+                    if codes[i] is None:
+                        codes[i] = p.poll()
+                if any(c not in (None, 0) for c in codes):
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    codes = [p.wait() for p in procs]
+                    suffix, tb = self._rank0_error(result)
+                    kind = ("coord-bind" if EXIT_COORD_BIND in codes
+                            else "crash")
+                    raise GangError(
+                        f"worker crashed (exit codes {codes}); gang killed"
+                        + suffix,
+                        kind=kind, exit_codes=codes, rank0_traceback=tb)
+                if time.monotonic() > deadline:
+                    raise GangError(
+                        f"gang deadline ({self.timeout_s}s) exceeded; "
+                        f"exit codes so far {codes}; killing all workers",
+                        kind="deadline", exit_codes=codes)
+                if any(c is None for c in codes):
+                    time.sleep(0.05)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        # Reaching here means every worker exited 0.
+        try:
             with open(result, "rb") as f:
                 status, value = pickle.load(f)
-            if status == "error":
-                raise RuntimeError(f"rank-0 worker raised: {value}")
-            return value
+        except Exception as e:
+            # exit 0 across the gang with no readable result: rank 0 skipped
+            # its contract (silent early exit / torn write) — surface it
+            # instead of crashing on the unpickle or returning garbage.
+            raise GangError(
+                f"all workers exited 0 but the rank-0 result at {result} is "
+                f"missing or unreadable ({e!r})",
+                kind="result-missing", exit_codes=[0] * self.np) from e
+        if status == "error":
+            raise RuntimeError(f"rank-0 worker raised: {value}")
+        return value
 
     @staticmethod
-    def _rank0_error(result_path: str) -> str:
+    def _rank0_error(result_path: str) -> tuple[str, str | None]:
         """Root cause for the crash message: if rank 0 got far enough to write
         an error result before exiting nonzero, surface its traceback instead
-        of leaving only exit codes."""
+        of leaving only exit codes. Returns ``(message_suffix, traceback)``."""
         try:
             with open(result_path, "rb") as f:
                 status, value = pickle.load(f)
             if status == "error":
-                return f"; rank-0 worker raised: {value}"
+                return f"; rank-0 worker raised: {value}", str(value)
         except Exception:
             pass
-        return ""
+        return "", None

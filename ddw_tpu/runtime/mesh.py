@@ -86,6 +86,15 @@ def initialize_distributed(
         return  # single-process
     num_processes = num_processes or int(os.environ.get("DDW_NUM_PROCESSES", "1"))
     process_id = process_id if process_id is not None else int(os.environ.get("DDW_PROCESS_ID", "0"))
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # The CPU stand-in gang (launcher tests, dev boxes) needs a real
+        # cross-process collectives transport; without gloo, XLA:CPU refuses
+        # multiprocess computations. Best-effort: jax versions where gloo is
+        # the built-in default dropped the option.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

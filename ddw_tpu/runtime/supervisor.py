@@ -1,0 +1,163 @@
+"""Gang supervisor — bounded auto-restart-from-checkpoint over the Launcher.
+
+The :class:`~ddw_tpu.runtime.launcher.Launcher` implements the *detection*
+half of the reference's all-or-nothing gang semantics (poll every rank, kill
+the gang on the first abnormal exit, one shared deadline — the Spark-barrier
+behavior of Horovod jobs, arXiv:1802.05799 §"fault tolerance"); its recovery
+story is the operator's: "restart from the last checkpoint". This module is
+that recovery half, automated:
+
+- on a worker crash or gang deadline, re-launch the whole gang with
+  exponential backoff + jitter, passing ``DDW_RESTART_GEN=<n>`` through the
+  env so the train fn knows it is a restart and resumes from the latest
+  *durable* checkpoint (``CheckpointManager.latest_step`` — which quarantines
+  torn step dirs, :mod:`ddw_tpu.checkpoint.ckpt`) instead of step 0;
+- graceful preemption (a rank exited ``EXIT_PREEMPTED`` after its SIGTERM
+  handler let the step loop checkpoint and leave cleanly) is *restartable
+  progress*, not failure: it has its own, larger budget and does not consume
+  ``max_restarts``;
+- when the budget is exhausted, raise :class:`GangFailure` carrying the full
+  per-attempt forensic record (exit codes, rank-0 tracebacks, elapsed time
+  per generation) instead of only the last error string.
+
+The supervised train fn needs no new API: write checkpoints under a stable
+directory and pass ``resume=True`` (restore-from-empty is a no-op, so
+generation 0 starts from step 0 and every later generation resumes).
+:func:`restart_generation` exposes the counter for fns that want to branch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Any, Callable
+
+from ddw_tpu.runtime.faults import (  # noqa: F401  (re-exported: one import
+    EXIT_PREEMPTED,                   # site for supervision + preemption)
+    Preempted,
+    install_preemption_handler,
+    preemption_requested,
+    reset_preemption,
+)
+from ddw_tpu.runtime.launcher import GangError, Launcher
+
+
+def restart_generation() -> int:
+    """Which restart generation this process is running in (0 = first
+    launch). Set in the worker env by the supervisor."""
+    try:
+        return int(os.environ.get("DDW_RESTART_GEN", "0") or 0)
+    except ValueError:
+        return 0
+
+
+@dataclasses.dataclass
+class AttemptReport:
+    """One failed generation, as the supervisor saw it."""
+
+    generation: int
+    kind: str                       # crash | deadline | preempted | coord-bind | result-missing
+    exit_codes: list
+    rank0_traceback: str | None
+    elapsed_s: float
+
+    def __str__(self) -> str:
+        return (f"gen {self.generation}: {self.kind}, exit codes "
+                f"{self.exit_codes}, after {self.elapsed_s:.1f}s")
+
+
+class GangFailure(RuntimeError):
+    """The gang died permanently: restart budget exhausted (or restarts
+    disabled). Carries every attempt's exit codes and the most recent rank-0
+    traceback, so the root cause survives N failed generations."""
+
+    def __init__(self, attempts: list[AttemptReport], max_restarts: int):
+        self.attempts = list(attempts)
+        self.max_restarts = max_restarts
+        self.exit_codes = [a.exit_codes for a in attempts]
+        self.rank0_traceback = next(
+            (a.rank0_traceback for a in reversed(attempts)
+             if a.rank0_traceback), None)
+        lines = [f"gang failed permanently after {len(attempts)} attempt(s) "
+                 f"(max_restarts={max_restarts}):"]
+        lines += [f"  {a}" for a in attempts]
+        if self.rank0_traceback:
+            lines.append("rank-0 traceback (most recent attempt that "
+                         "captured one):")
+            lines += ["  " + ln for ln in
+                      str(self.rank0_traceback).splitlines()]
+        super().__init__("\n".join(lines))
+
+
+class GangSupervisor:
+    """Run a train fn through a :class:`Launcher` gang, restarting the gang
+    from the latest durable checkpoint on failure.
+
+    ``max_restarts`` bounds crash/deadline restarts (0 = fail on the first
+    abnormal death — the pre-supervisor behavior, but with the structured
+    :class:`GangFailure`). ``max_preemption_restarts`` bounds graceful
+    preemptions separately: a preempted gang checkpointed and exited cleanly,
+    so rescheduling it is cheap forward progress, not failure churn — only a
+    preemption *storm* should give up. Backoff between restarts is
+    ``backoff_base_s * 2**(restart-1)`` capped at ``backoff_max_s``, plus
+    uniform jitter of up to ``jitter * delay`` (decorrelates re-rendezvous
+    stampedes when several supervised jobs share a cluster event).
+
+    With an ``np=-1`` launcher the fn runs in-process exactly once —
+    restarting the surrounding process is not the supervisor's to do.
+    """
+
+    def __init__(self, launcher: Launcher, max_restarts: int = 2,
+                 max_preemption_restarts: int = 8,
+                 backoff_base_s: float = 1.0, backoff_max_s: float = 30.0,
+                 jitter: float = 0.25):
+        self.launcher = launcher
+        self.max_restarts = max_restarts
+        self.max_preemption_restarts = max_preemption_restarts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.jitter = jitter
+        self.attempts: list[AttemptReport] = []  # failed attempts, last run()
+        self.generations = 0                     # gangs launched, last run()
+
+    def run(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+        if self.launcher.np == -1:
+            self.generations = 1
+            return self.launcher.run(fn, *args, **kwargs)
+        self.attempts = []
+        crash_restarts = preempt_restarts = 0
+        gen = 0
+        while True:
+            self.generations = gen + 1
+            t0 = time.monotonic()
+            try:
+                return self.launcher._run_multiproc(
+                    fn, args, kwargs,
+                    extra_env={"DDW_RESTART_GEN": str(gen)})
+            except GangError as e:
+                kind = "preempted" if e.is_preemption else e.kind
+                self.attempts.append(AttemptReport(
+                    generation=gen, kind=kind, exit_codes=e.exit_codes,
+                    rank0_traceback=e.rank0_traceback,
+                    elapsed_s=time.monotonic() - t0))
+                if kind == "preempted":
+                    preempt_restarts += 1
+                    if preempt_restarts > self.max_preemption_restarts:
+                        raise GangFailure(self.attempts,
+                                          self.max_restarts) from e
+                else:
+                    crash_restarts += 1
+                    if crash_restarts > self.max_restarts:
+                        raise GangFailure(self.attempts,
+                                          self.max_restarts) from e
+            self._backoff(crash_restarts + preempt_restarts)
+            gen += 1
+
+    def _backoff(self, nth_restart: int) -> None:
+        delay = min(self.backoff_max_s,
+                    self.backoff_base_s * (2 ** max(0, nth_restart - 1)))
+        delay += random.uniform(0.0, self.jitter * delay)
+        if delay > 0:
+            time.sleep(delay)

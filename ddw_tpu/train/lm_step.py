@@ -25,6 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddw_tpu.train.step import TrainState, cross_entropy_loss
+from ddw_tpu.utils.compat import shard_map
 
 # next-token CE is the same sparse CE (it broadcasts over [B, S, V] vs [B, S])
 lm_loss = cross_entropy_loss
@@ -165,7 +166,7 @@ def make_lm_train_step(
         return TrainState(new_params, {}, new_opt, state.step + 1), metrics
 
     tok_spec = P(data_axis) if seq_axis is None else P(data_axis, seq_axis)
-    smapped = jax.shard_map(
+    smapped = shard_map(
         _step, mesh=mesh,
         in_specs=(P(), tok_spec, tok_spec, P()),
         out_specs=(P(), P()),
@@ -188,7 +189,7 @@ def make_lm_eval_step(model, mesh: Mesh, data_axis: str = "data",
         return {"loss": lax.pmean(loss, axes), "accuracy": lax.pmean(acc, axes)}
 
     tok_spec = P(data_axis) if seq_axis is None else P(data_axis, seq_axis)
-    smapped = jax.shard_map(
+    smapped = shard_map(
         _eval, mesh=mesh,
         in_specs=(P(), tok_spec, tok_spec),
         out_specs=P(),

@@ -30,6 +30,7 @@ import numpy as np
 
 from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.models.lm import build_lm
+from ddw_tpu.runtime.faults import Preempted, maybe_fault, preemption_requested
 from ddw_tpu.runtime.mesh import (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MeshSpec,
                                   make_data_mesh, make_mesh)
 from ddw_tpu.train.lm_step import (
@@ -457,6 +458,21 @@ class LMTrainer:
             for epoch in range(start_epoch, cfg.epochs):
                 tlosses, taccs = [], []
                 for i, (inputs, targets) in enumerate(train_batches(epoch)):
+                    # Fault-injection hook (runtime.faults): free no-op
+                    # unless DDW_FAULT targets this rank/step/generation.
+                    maybe_fault("step", step=host_step,
+                                ckpt_dir=cfg.checkpoint_dir or None)
+                    if preemption_requested():
+                        # Graceful preemption (SIGTERM): checkpoint mid-epoch
+                        # and leave via Preempted; the gang worker converts it
+                        # to EXIT_PREEMPTED (restart outside the crash
+                        # budget). The finally block joins the async writer.
+                        if ckpt:
+                            ckpt.save(state, host_step,
+                                      metadata={"epoch": epoch,
+                                                "preempted": True,
+                                                "callbacks": sched.state_dicts()})
+                        raise Preempted(host_step)
                     lr = sched.lr_for_batch(epoch, i, steps_per_epoch)
                     if lr is not None:
                         state = set_lr(state, lr)

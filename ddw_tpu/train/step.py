@@ -39,6 +39,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddw_tpu.utils.config import ModelCfg, TrainCfg
+from ddw_tpu.utils.compat import shard_map
 
 
 @flax.struct.dataclass
@@ -372,7 +373,7 @@ def make_train_step(
     n_data = mesh.shape[axis_name]
     repl = P()
     data_spec = P(axis_name)
-    smapped = jax.shard_map(
+    smapped = shard_map(
         _step,
         mesh=mesh,
         in_specs=(repl, data_spec, data_spec, repl),
@@ -394,7 +395,7 @@ def make_eval_step(model, mesh: Mesh, axis_name: str = "data") -> Callable:
         acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
         return {"loss": lax.pmean(loss, axis_name), "accuracy": lax.pmean(acc, axis_name)}
 
-    smapped = jax.shard_map(
+    smapped = shard_map(
         _eval,
         mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name)),

@@ -36,6 +36,7 @@ from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.data.loader import ShardedLoader
 from ddw_tpu.data.store import Table
 from ddw_tpu.models.registry import build_model
+from ddw_tpu.runtime.faults import Preempted, maybe_fault, preemption_requested
 from ddw_tpu.runtime.mesh import make_data_mesh, make_mesh, MeshSpec, DATA_AXIS
 from ddw_tpu.tracking.tracker import Run
 from ddw_tpu.train.schedule import ScheduleSuite
@@ -318,6 +319,25 @@ class Trainer:
                     t0 = time.time()
                     losses, accs = [], []
                     for step_i in range(steps_per_epoch):
+                        # Fault-injection hook (runtime.faults): free no-op
+                        # unless DDW_FAULT targets this rank/step/generation.
+                        maybe_fault("step",
+                                    step=epoch * steps_per_epoch + step_i,
+                                    ckpt_dir=cfg.checkpoint_dir or None)
+                        if preemption_requested():
+                            # Graceful preemption (SIGTERM): checkpoint the
+                            # live state mid-epoch, then leave via Preempted —
+                            # the gang worker converts it to EXIT_PREEMPTED so
+                            # the supervisor restarts without burning the
+                            # crash budget. The finally block below joins the
+                            # async writer, making the save durable.
+                            step_now = int(jax.device_get(state.step))
+                            if ckpt:
+                                ckpt.save(state, step_now,
+                                          metadata={"epoch": epoch,
+                                                    "preempted": True,
+                                                    "callbacks": sched.state_dicts()})
+                            raise Preempted(step_now)
                         # Per-batch LR: cosine everywhere, or the Goyal warmup
                         # ramp (Horovod warmup-callback granularity, reference
                         # :314-318); None past warmup in the plateau regime.

@@ -1,0 +1,36 @@
+"""jax API-surface compatibility shims.
+
+The codebase targets the modern public jax surface; older jax spells two of
+the primitives it leans on differently. One import site keeps every step
+builder and collective working across both, resolved once at import time:
+
+- ``shard_map``: public ``jax.shard_map`` (replication checking via
+  ``check_vma``) vs ``jax.experimental.shard_map.shard_map`` (``check_rep``).
+- ``axis_size``: ``jax.lax.axis_size(name)`` vs ``jax.core.axis_frame(name)``
+  (which returns the static mesh-axis extent on the older surface).
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+if hasattr(jax.lax, "axis_size"):
+    def axis_size(axis_name) -> int:
+        """Static extent of a bound mesh/pmap axis, inside the mapped fn."""
+        return jax.lax.axis_size(axis_name)
+else:
+    def axis_size(axis_name) -> int:
+        """Static extent of a bound mesh/pmap axis, inside the mapped fn."""
+        return int(jax.core.axis_frame(axis_name))

@@ -12,6 +12,9 @@ import sys
 
 import pytest
 
+# bench arms run full training sweeps — beyond the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

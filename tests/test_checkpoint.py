@@ -81,3 +81,86 @@ def test_retention_keeps_newest(tmp_path):
     steps = sorted(int(d[len("step_"):]) for d in os.listdir(tmp_path)
                    if d.startswith("step_") and not d.endswith(".tmp"))
     assert steps == [3, 4]
+
+
+# -- crash-consistency audit: torn step dirs are quarantined ----------------
+
+def _tear(ckpt_dir, step, mode):
+    """Corrupt step dir in one of the ways a non-atomic kill could leave it."""
+    d = os.path.join(ckpt_dir, f"step_{step:010d}")
+    if mode == "no_meta":
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "state.msgpack"), "wb") as f:
+            f.write(b"torn")
+    elif mode == "truncated_state":
+        with open(os.path.join(d, "state.msgpack"), "r+b") as f:
+            f.truncate(8)  # metadata's state_bytes no longer matches
+    elif mode == "no_state":
+        os.remove(os.path.join(d, "state.msgpack"))
+    elif mode == "bad_meta":
+        with open(os.path.join(d, "metadata.json"), "w") as f:
+            f.write("{not json")
+    return d
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("mode", ["no_meta", "truncated_state", "no_state",
+                                  "bad_meta"])
+def test_torn_latest_step_quarantined_restore_falls_back(tmp_path, mode):
+    """A torn newest step dir (any flavor) must not poison resume:
+    latest_step() quarantines it and restore() lands on the previous good
+    step."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), 1)
+    mgr.save(_state(2.0), 2)
+    if mode == "no_meta":
+        _tear(str(tmp_path), 3, mode)     # fresh partial dir, never completed
+    else:
+        mgr.save(_state(3.0), 3)
+        _tear(str(tmp_path), 3, mode)     # completed dir, then corrupted
+
+    assert mgr.latest_step() == 2
+    restored, step = mgr.restore(_state(0.0))
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                  np.full((4, 4), 2.0, np.float32))
+    # forensics: the torn dir is renamed aside, not deleted, and no longer
+    # shadows the good steps
+    names = os.listdir(tmp_path)
+    assert "step_0000000003" not in names
+    assert any(n.startswith("step_0000000003.torn") for n in names)
+
+
+@pytest.mark.faults
+def test_restore_explicit_torn_step_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), 1)
+    _tear(str(tmp_path), 2, "no_meta")
+    with pytest.raises(FileNotFoundError, match="missing or torn"):
+        mgr.restore(_state(0.0), step=2)
+    # the torn dir was quarantined by the failed explicit restore too
+    restored, step = mgr.restore(_state(0.0))
+    assert step == 1
+
+
+def test_metadata_records_state_bytes(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), 1)
+    meta = mgr.read_metadata(1)
+    path = os.path.join(str(tmp_path), "step_0000000001", "state.msgpack")
+    assert meta["state_bytes"] == os.path.getsize(path)
+
+
+def test_async_write_error_surfaces_on_next_save(tmp_path):
+    """Regression (satellite): a failed background write must surface on the
+    NEXT save(), not only on an explicit wait() — the trainer's per-epoch
+    save cadence is the only call site most runs ever hit."""
+    mgr = CheckpointManager(str(tmp_path), async_write=True)
+    bad = TrainState({"w": object()}, {}, (), jnp.asarray(0, jnp.int32))
+    mgr.save(bad, 1)
+    with pytest.raises(Exception):
+        mgr.save(_state(2.0), 2)  # joins write 1 -> re-raises its error
+    # the failed join cleared the pending slot: the manager keeps working
+    mgr.save(_state(3.0), 3)
+    mgr.wait()
+    assert mgr.latest_step() == 3

@@ -6,6 +6,8 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ddw_tpu.utils.compat import shard_map
+
 from ddw_tpu.runtime import collectives
 from ddw_tpu.runtime.mesh import make_mesh, MeshSpec
 
@@ -16,7 +18,7 @@ def mesh():
 
 
 def _smap(fn, mesh, n_out=1):
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
                                  check_vma=False))
 
 
@@ -26,7 +28,7 @@ def test_all_reduce_sum_mean(mesh):
     def f(xs):
         return collectives.all_reduce_sum(xs, "data"), collectives.all_reduce_mean(xs, "data")
 
-    s, m = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("data"),
+    s, m = jax.jit(shard_map(f, mesh=mesh, in_specs=P("data"),
                                  out_specs=(P("data"), P("data")), check_vma=False))(x)
     np.testing.assert_allclose(np.asarray(s), np.full((8, 1), 28.0))
     np.testing.assert_allclose(np.asarray(m), np.full((8, 1), 3.5))
@@ -38,7 +40,7 @@ def test_all_reduce_tree(mesh):
     def f(t):
         return collectives.all_reduce_mean(t, "data")
 
-    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
                                 check_vma=False))(tree)
     np.testing.assert_allclose(np.asarray(out["a"]), np.ones((8, 2)))
     np.testing.assert_allclose(np.asarray(out["b"]), np.full((8, 1), 3.5))
@@ -78,7 +80,7 @@ def test_ring_all_reduce_single_axis_size():
     def ring(xs):
         return collectives.ring_all_reduce(xs[0], "data")[None]
 
-    out = jax.jit(jax.shard_map(ring, mesh=mesh1, in_specs=P("data"), out_specs=P("data"),
+    out = jax.jit(shard_map(ring, mesh=mesh1, in_specs=P("data"), out_specs=P("data"),
                                 check_vma=False))(x)
     np.testing.assert_allclose(np.asarray(out), x)
 
@@ -105,7 +107,7 @@ def test_pallas_ring_all_reduce_matches_sum(n, shape, dtype):
     rng = np.random.RandomState(n * 1000 + shape[0])
     x = rng.randn(n, *shape).astype(dtype)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda xs: ring_all_reduce_pallas(xs[0], "data")[None],
         mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))
     out = np.asarray(fn(x))
@@ -124,7 +126,7 @@ def test_pallas_ring_all_reduce_bf16_accumulates_f32():
     x = rng.randn(n, 96).astype(np.float32)
     xb = x.astype(jnp.bfloat16)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda xs: ring_all_reduce_pallas(xs[0], "data")[None],
         mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))
     out = np.asarray(fn(xb)).astype(np.float32)
@@ -139,7 +141,7 @@ def test_all_reduce_sum_impl_dispatch(mesh):
     x = np.arange(16, dtype=np.float32).reshape(8, 2)
 
     def f(impl):
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda xs: collectives.all_reduce_sum({"a": xs, "b": xs * 2}, "data",
                                                   impl=impl),
             mesh=mesh, in_specs=P("data"),
@@ -166,7 +168,7 @@ def test_pallas_ring_race_detector_clean():
     x = np.ones((n, 128), np.float32)
     # detect_races asserts internally on any cross-device read/write race
     params = pltpu.InterpretParams(detect_races=True)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda xs: ring_all_reduce_pallas(xs[0], "data", interpret=params)[None],
         mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))
     out = np.asarray(fn(x))
@@ -183,7 +185,7 @@ def test_pallas_ring_all_reduce_multi_axis_mesh():
     rng = np.random.RandomState(7)
     x = rng.randn(2, 4, 160).astype(np.float32)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda xs: ring_all_reduce_pallas(xs[0, 0], "seq")[None, None],
         mesh=mesh, in_specs=P("data", "seq"), out_specs=P("data", "seq"),
         check_vma=False))
@@ -207,7 +209,7 @@ def test_pallas_ring_all_reduce_segments_large_arrays(monkeypatch):
     rng = np.random.RandomState(11)
     x = rng.randn(n, 4 * 560).astype(np.float32)  # chunk 560 -> 5 segments
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda xs: rr.ring_all_reduce_pallas(xs[0], "data")[None],
         mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))
     out = np.asarray(fn(x))

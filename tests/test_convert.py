@@ -22,6 +22,9 @@ from ddw_tpu.models.convert import (  # noqa: E402
 )
 from ddw_tpu.models.mobilenet_v2 import MobileNetV2, MobileNetV2Backbone  # noqa: E402
 
+# weight-converter round-trips — beyond the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 
 def _convbnrelu(inp, oup, k=3, s=1, groups=1):
     return nn.Sequential(

@@ -14,46 +14,64 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# (script, extra overrides, must-appear output fragment)
+# (script, extra overrides, must-appear output fragment). The tier-1 subset
+# is the core contract chain (prep -> train -> distributed -> package+score
+# -> supervised gang); the heavier arms (HPO sweeps, LM family, transfer,
+# FSDP, lifecycle) ride in the `slow` tier — with the whole ladder actually
+# training now, the full chain far exceeds the tier-1 wall-clock budget.
+_slow = pytest.mark.slow
 _EXAMPLES = [
     ("01_data_prep.py", [], "silver_train"),
     ("02_train_single_node.py", ["train.epochs=1"], "val_accuracy"),
-    ("02_train_single_node.py",
-     ["--cache-features", "train.epochs=1"], "val_accuracy"),
+    pytest.param("02_train_single_node.py",
+                 ["--cache-features", "train.epochs=1"], "val_accuracy",
+                 marks=_slow),
     ("03_train_distributed.py", ["train.epochs=1"], "world=8"),
-    ("04_hyperopt_parallel.py",
-     ["tune.max_evals=2", "tune.parallelism=2", "train.epochs=1"], "best"),
-    ("04_hyperopt_parallel.py",
-     ["--cache-features", "tune.max_evals=2", "tune.parallelism=2",
-      "train.epochs=1"], "trials train heads only"),
-    ("04_hyperopt_parallel.py",
-     ["--nested-space", "tune.max_evals=2", "tune.parallelism=2",
-      "train.epochs=1"], "best"),
-    ("05_hyperopt_distributed.py",
-     ["tune.max_evals=2", "train.epochs=1"], "best"),
+    pytest.param("04_hyperopt_parallel.py",
+                 ["tune.max_evals=2", "tune.parallelism=2", "train.epochs=1"],
+                 "best", marks=_slow),
+    pytest.param("04_hyperopt_parallel.py",
+                 ["--cache-features", "tune.max_evals=2", "tune.parallelism=2",
+                  "train.epochs=1"], "trials train heads only", marks=_slow),
+    pytest.param("04_hyperopt_parallel.py",
+                 ["--nested-space", "tune.max_evals=2", "tune.parallelism=2",
+                  "train.epochs=1"], "best", marks=_slow),
+    pytest.param("05_hyperopt_distributed.py",
+                 ["tune.max_evals=2", "train.epochs=1"], "best", marks=_slow),
     ("06_packaged_inference.py", ["train.epochs=1"], "distributed scoring"),
-    ("06_packaged_inference.py", ["--int8", "train.epochs=1"],
-     "int8 weight-only"),
-    ("08_pretrained_transfer.py",
-     ["--pretrain-epochs", "1", "train.epochs=1"], "[score]"),
-    ("07_lm_long_context.py", ["--steps", "3"], "final:"),
-    ("07_lm_long_context.py",
-     ["--steps", "3", "lm.pos_encoding=rope", "lm.num_kv_heads=2"], "final:"),
-    ("07_lm_long_context.py",
-     ["--steps", "3", "--speculative"], "speculative: identical"),
-    ("07_lm_long_context.py",
-     ["--trainer", "train.epochs=2"], "trainer: mesh"),
-    ("07_lm_long_context.py",
-     ["--trainer", "--pipeline", "4", "lm.depth=4", "train.epochs=2"],
-     "trainer: mesh pipe=4"),
-    ("07_lm_long_context.py",
-     ["--trainer", "--pipeline", "4", "lm.depth=8", "train.epochs=1",
-      "train.pipeline_schedule=interleaved", "train.pipeline_microbatches=2"],
-     "trainer: mesh pipe=4"),
-    ("09_lora_finetune.py", [], "base_frozen=True"),
-    ("10_fsdp_elastic.py", ["train.epochs=2"], "elastic 8 -> 4"),
-    ("11_lm_lifecycle.py", ["train.epochs=2"], "model_prefers_structure=True"),
-    ("11_lm_lifecycle.py", ["--int8", "train.epochs=2"], "int8 weight-only"),
+    pytest.param("06_packaged_inference.py", ["--int8", "train.epochs=1"],
+                 "int8 weight-only", marks=_slow),
+    pytest.param("08_pretrained_transfer.py",
+                 ["--pretrain-epochs", "1", "train.epochs=1"], "[score]",
+                 marks=_slow),
+    pytest.param("07_lm_long_context.py", ["--steps", "3"], "final:",
+                 marks=_slow),
+    pytest.param("07_lm_long_context.py",
+                 ["--steps", "3", "lm.pos_encoding=rope", "lm.num_kv_heads=2"],
+                 "final:", marks=_slow),
+    pytest.param("07_lm_long_context.py",
+                 ["--steps", "3", "--speculative"], "speculative: identical",
+                 marks=_slow),
+    pytest.param("07_lm_long_context.py",
+                 ["--trainer", "train.epochs=2"], "trainer: mesh",
+                 marks=_slow),
+    pytest.param("07_lm_long_context.py",
+                 ["--trainer", "--pipeline", "4", "lm.depth=4",
+                  "train.epochs=2"], "trainer: mesh pipe=4", marks=_slow),
+    pytest.param("07_lm_long_context.py",
+                 ["--trainer", "--pipeline", "4", "lm.depth=8",
+                  "train.epochs=1",
+                  "train.pipeline_schedule=interleaved",
+                  "train.pipeline_microbatches=2"], "trainer: mesh pipe=4",
+                 marks=_slow),
+    pytest.param("09_lora_finetune.py", [], "base_frozen=True", marks=_slow),
+    pytest.param("10_fsdp_elastic.py", ["train.epochs=2"], "elastic 8 -> 4",
+                 marks=_slow),
+    pytest.param("11_lm_lifecycle.py", ["train.epochs=2"],
+                 "model_prefers_structure=True", marks=_slow),
+    pytest.param("11_lm_lifecycle.py", ["--int8", "train.epochs=2"],
+                 "int8 weight-only", marks=_slow),
+    ("13_supervised_gang.py", [], "resume_step=3"),
 ]
 
 
@@ -63,7 +81,9 @@ def workdir(tmp_path_factory):
 
 
 @pytest.mark.parametrize("script,extra,expect",
-                         _EXAMPLES, ids=[e[0] for e in _EXAMPLES])
+                         _EXAMPLES,
+                         ids=[e.values[0] if hasattr(e, "values") else e[0]
+                              for e in _EXAMPLES])
 def test_example_runs(script, extra, expect, workdir):
     env = dict(os.environ)
     env.update({

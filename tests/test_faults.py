@@ -1,0 +1,201 @@
+"""Fault-injection harness unit tests (ddw_tpu.runtime.faults) plus the
+in-process trainer integration: the step-loop hooks fire deterministically,
+graceful preemption checkpoints mid-epoch and resumes, and the harness is a
+no-op when DDW_FAULT is unset."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from ddw_tpu.runtime import faults
+from ddw_tpu.runtime.faults import (
+    FaultInjected,
+    FaultSpec,
+    Preempted,
+    maybe_fault,
+    parse_fault,
+)
+
+
+@pytest.fixture()
+def preemption_cleanup():
+    """Restore signal disposition + flag after tests that exercise SIGTERM."""
+    yield
+    faults.reset_preemption()
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
+# -- spec parsing ----------------------------------------------------------
+
+def test_parse_full_spec():
+    spec = parse_fault("crash:rank=1:step=3")
+    assert spec == FaultSpec(kind="crash", rank=1, step=3, gen=0, attempt=0)
+
+
+def test_parse_defaults_and_wildcards():
+    assert parse_fault("") is None
+    assert parse_fault("stall") == FaultSpec("stall", None, None, 0, 0)
+    spec = parse_fault("preempt:rank=*:gen=*:attempt=*:step=5")
+    assert spec.rank is None and spec.gen is None and spec.attempt is None
+    assert spec.step == 5
+
+
+@pytest.mark.parametrize("bad", ["explode", "crash:when=3", "crash:rank=x"])
+def test_parse_malformed_raises(bad):
+    with pytest.raises(ValueError):
+        parse_fault(bad)
+
+
+# -- matching --------------------------------------------------------------
+
+def test_matching_matrix():
+    spec = FaultSpec("crash", rank=1, step=3, gen=0, attempt=0)
+    ok = dict(rank=1, step=3, gen=0, attempt=0)
+    assert spec.matches("step", **ok)
+    assert not spec.matches("coord_bind", **ok)
+    assert not spec.matches("step", **{**ok, "rank": 0})
+    assert not spec.matches("step", **{**ok, "step": 2})
+    assert not spec.matches("step", **{**ok, "gen": 1})  # restarted gang runs clean
+    wild = FaultSpec("crash", rank=None, step=None, gen=None, attempt=None)
+    assert wild.matches("step", rank=7, step=99, gen=4, attempt=2)
+
+
+def test_maybe_fault_noop_without_env(monkeypatch):
+    monkeypatch.delenv("DDW_FAULT", raising=False)
+    maybe_fault("step", step=0)  # must not raise or exit
+
+
+def test_raise_kind_fires_only_on_matching_step(monkeypatch):
+    monkeypatch.setenv("DDW_FAULT", "raise:step=2")
+    monkeypatch.delenv("DDW_PROCESS_ID", raising=False)
+    monkeypatch.delenv("DDW_RESTART_GEN", raising=False)
+    maybe_fault("step", step=1)
+    with pytest.raises(FaultInjected, match="injected fault"):
+        maybe_fault("step", step=2)
+    monkeypatch.setenv("DDW_RESTART_GEN", "1")
+    maybe_fault("step", step=2)  # next generation: clean
+
+
+def test_preempt_kind_sets_flag_via_sigterm(monkeypatch, preemption_cleanup):
+    monkeypatch.setenv("DDW_FAULT", "preempt:step=0")
+    assert not faults.preemption_requested()
+    maybe_fault("step", step=0)
+    assert faults.preemption_requested()
+    faults.reset_preemption()
+    assert not faults.preemption_requested()
+
+
+def test_request_preemption_signal_free():
+    faults.request_preemption()
+    assert faults.preemption_requested()
+    faults.reset_preemption()
+
+
+def test_torn_step_dir_writer(tmp_path):
+    d = faults._write_torn_step_dir(str(tmp_path), 7)
+    assert os.path.isdir(d)
+    assert os.path.getsize(os.path.join(d, "state.msgpack")) == 4
+    assert not os.path.exists(os.path.join(d, "metadata.json"))
+
+
+# -- trainer integration (in-process, np=-1 semantics) ---------------------
+
+def _lm_trainer(tmp_path, epochs=3):
+    from ddw_tpu.train.lm_trainer import LMTrainer
+    from ddw_tpu.utils.config import LMCfg, TrainCfg
+
+    lm = LMCfg(vocab_size=32, max_len=16, hidden=16, depth=1, num_heads=2,
+               mlp_dim=32, dropout=0.0, dtype="float32")
+    tr = TrainCfg(batch_size=2, epochs=epochs, warmup_epochs=0, seed=0,
+                  learning_rate=1e-2, num_devices=2,
+                  checkpoint_dir=str(tmp_path / "ck"),
+                  checkpoint_every_epochs=1)
+    return LMTrainer(lm, tr)
+
+
+def _toy_tokens():
+    rng = np.random.RandomState(0)
+    starts = rng.randint(0, 32, size=(44, 1))
+    return ((starts + np.arange(17)[None]) % 32).astype(np.int32)
+
+
+@pytest.mark.faults
+def test_lm_trainer_graceful_preemption_checkpoints_then_resumes(
+        tmp_path, monkeypatch, preemption_cleanup):
+    """SIGTERM mid-epoch -> the step loop checkpoints the live state and
+    raises Preempted; a resume run completes all epochs from that point."""
+    from ddw_tpu.checkpoint.ckpt import latest_step
+
+    toks = _toy_tokens()
+    monkeypatch.setenv("DDW_FAULT", "preempt:step=4")
+    with pytest.raises(Preempted) as exc:
+        _lm_trainer(tmp_path).fit(toks, val_fraction=0.1)
+    assert exc.value.step == 4
+
+    ck = str(tmp_path / "ck")
+    assert latest_step(ck) == 4  # mid-epoch durable checkpoint
+    import json
+    with open(os.path.join(ck, "step_0000000004", "metadata.json")) as f:
+        assert json.load(f)["preempted"] is True
+
+    monkeypatch.delenv("DDW_FAULT")
+    faults.reset_preemption()
+    res = _lm_trainer(tmp_path).fit(toks, val_fraction=0.1, resume=True)
+    assert res.epochs_run == 3
+    assert np.isfinite(res.val_loss)
+
+
+@pytest.mark.faults
+def test_vision_trainer_step_hook_fires(silver, monkeypatch):
+    """The vision Trainer's per-step hook is live: an injected 'raise' fault
+    at global step 0 propagates out of fit before any step executes."""
+    from ddw_tpu.train.trainer import Trainer
+    from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+    train_tbl, val_tbl, _ = silver
+    data = DataCfg(img_height=24, img_width=24, loader_workers=2,
+                   shuffle_buffer=32)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                     dtype="float32")
+    tr = TrainCfg(batch_size=4, epochs=1, warmup_epochs=0, seed=0,
+                  learning_rate=1e-2)
+    monkeypatch.setenv("DDW_FAULT", "raise:step=0")
+    with pytest.raises(FaultInjected):
+        Trainer(data, model, tr).fit(train_tbl, val_tbl)
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_vision_trainer_graceful_preemption(silver, tmp_path, monkeypatch,
+                                            preemption_cleanup):
+    """Full vision-trainer preemption drill: checkpoint-on-SIGTERM mid-run,
+    then a resumed fit completes the remaining epochs."""
+    from ddw_tpu.checkpoint.ckpt import latest_step
+    from ddw_tpu.train.trainer import Trainer
+    from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+    train_tbl, val_tbl, _ = silver
+    data = DataCfg(img_height=24, img_width=24, loader_workers=2,
+                   shuffle_buffer=32)
+    model = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                     dtype="float32")
+
+    def cfg(epochs):
+        return TrainCfg(batch_size=4, epochs=epochs, warmup_epochs=0, seed=0,
+                        learning_rate=1e-2,
+                        checkpoint_dir=str(tmp_path / "ck"),
+                        checkpoint_every_epochs=1)
+
+    monkeypatch.setenv("DDW_FAULT", "preempt:step=3")
+    with pytest.raises(Preempted):
+        Trainer(data, model, cfg(epochs=4)).fit(train_tbl, val_tbl)
+    assert (latest_step(str(tmp_path / "ck")) or 0) > 0
+
+    monkeypatch.delenv("DDW_FAULT")
+    faults.reset_preemption()
+    res = Trainer(data, model, cfg(epochs=4)).fit(train_tbl, val_tbl,
+                                                  resume=True)
+    assert res.epochs_run == 4
+    assert np.isfinite(res.val_loss)

@@ -8,6 +8,9 @@ import pytest
 
 from ddw_tpu.models.lm import TransformerLM, generate, init_cache
 
+# GQA decode sweeps — beyond the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 
 def _lm(depth=2, **kw):
     return TransformerLM(vocab_size=32, max_len=64, hidden=32, depth=depth,

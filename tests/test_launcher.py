@@ -7,10 +7,12 @@ collective, and the rank-0 return contract.
 """
 
 import functools
+import os
+import pickle
 
 import pytest
 
-from ddw_tpu.runtime.launcher import Launcher
+from ddw_tpu.runtime.launcher import GangError, Launcher
 
 
 def _world_report(scale: float = 1.0):
@@ -51,5 +53,70 @@ def test_multiprocess_worker_error_propagates(worker_pythonpath):
         Launcher(np=2, devices_per_proc=1, timeout_s=300).run(_boom)
 
 
+def test_worker_error_is_structured_gangerror(worker_pythonpath):
+    """Crash failures carry machine-readable exit codes + rank-0 traceback
+    (what GangSupervisor classifies on), not only a message string."""
+    with pytest.raises(GangError) as exc:
+        Launcher(np=2, devices_per_proc=1, timeout_s=300).run(_boom)
+    assert exc.value.kind == "crash"
+    assert len(exc.value.exit_codes) == 2
+    assert exc.value.rank0_traceback is not None
+    assert "intentional worker failure" in exc.value.rank0_traceback
+
+
 def _boom():
     raise ValueError("intentional worker failure")
+
+
+@pytest.mark.faults
+def test_coordinator_bind_race_respawns_on_fresh_port(monkeypatch,
+                                                      worker_pythonpath):
+    """The _free_port TOCTOU race: a coordinator that can't bind its probed
+    port (injected via bind_fail, which fires only on spawn attempt 0) makes
+    the launcher respawn the whole gang on a fresh port instead of hanging
+    the other ranks until the gang deadline."""
+    monkeypatch.setenv("DDW_FAULT", "bind_fail:rank=0")
+    launcher = Launcher(np=2, devices_per_proc=2, timeout_s=300)
+    out = launcher.run(functools.partial(_world_report, scale=1.0))
+    assert out == {"processes": 2, "process_index": 0,
+                   "global_devices": 4, "psum": 4.0}
+    assert launcher.last_spawn_attempts == 2
+
+
+@pytest.mark.faults
+def test_coordinator_bind_retries_bounded(monkeypatch, worker_pythonpath):
+    """attempt=* re-fires the bind failure on every respawn: the launcher
+    gives up after spawn_retries with the structured coord-bind error rather
+    than looping forever."""
+    monkeypatch.setenv("DDW_FAULT", "bind_fail:rank=0:attempt=*")
+    launcher = Launcher(np=2, devices_per_proc=1, timeout_s=300,
+                        spawn_retries=2)
+    with pytest.raises(GangError) as exc:
+        launcher.run(_world_report)
+    assert exc.value.kind == "coord-bind"
+    assert launcher.last_spawn_attempts == 2
+
+
+def test_result_written_atomically(tmp_path):
+    """result.pkl publishes via tmp + os.replace: the final path only ever
+    holds a complete pickle, and no staging junk is left behind."""
+    from ddw_tpu.runtime._launch_worker import _write_result
+
+    p = str(tmp_path / "result.pkl")
+    _write_result(p, ("ok", {"x": 1}))
+    with open(p, "rb") as f:
+        assert pickle.load(f) == ("ok", {"x": 1})
+    _write_result(p, ("error", "tb"))  # overwrite is atomic too
+    with open(p, "rb") as f:
+        assert pickle.load(f)[0] == "error"
+    assert os.listdir(tmp_path) == ["result.pkl"]
+
+
+def test_unpicklable_result_degrades_to_error(tmp_path):
+    from ddw_tpu.runtime._launch_worker import _write_result
+
+    p = str(tmp_path / "result.pkl")
+    _write_result(p, ("ok", lambda: None))  # lambdas don't pickle
+    with open(p, "rb") as f:
+        status, value = pickle.load(f)
+    assert status == "error" and "not picklable" in value

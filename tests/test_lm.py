@@ -7,6 +7,8 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from ddw_tpu.utils.compat import shard_map
+
 from ddw_tpu.models.lm import TransformerLM
 from ddw_tpu.parallel.sharding import LM_TP_RULES, make_sharded_train_step
 from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
@@ -58,7 +60,7 @@ def test_sp_forward_matches_single_device():
     params = full.init({"params": jax.random.PRNGKey(1)}, inputs)["params"]
 
     ref = full.apply({"params": params}, inputs)
-    sp_fwd = jax.jit(jax.shard_map(
+    sp_fwd = jax.jit(shard_map(
         lambda p, x: sp.apply({"params": p}, x),
         mesh=mesh, in_specs=(P(), P(None, SEQ_AXIS)),
         out_specs=P(None, SEQ_AXIS, None), check_vma=False))
@@ -129,7 +131,7 @@ def test_sp_global_seq_exceeding_max_len_raises():
     inputs = np.zeros((1, 256), np.int32)  # global 256 > 128
     params = tiny_lm().init({"params": jax.random.PRNGKey(0)},
                             inputs[:, :8])["params"]
-    fwd = jax.jit(jax.shard_map(
+    fwd = jax.jit(shard_map(
         lambda p, x: sp.apply({"params": p}, x),
         mesh=mesh, in_specs=(P(), P(None, SEQ_AXIS)),
         out_specs=P(None, SEQ_AXIS, None), check_vma=False))

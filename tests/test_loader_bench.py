@@ -6,10 +6,14 @@ floors so a regression that craters a fast path (e.g. an accidental
 per-record decode on raw_u8) fails CI even on the loaded 1-core host.
 """
 
+import pytest
 import json
 import os
 import subprocess
 import sys
+
+# loader throughput bench — beyond the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

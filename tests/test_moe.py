@@ -13,6 +13,8 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from ddw_tpu.utils.compat import shard_map
+
 from ddw_tpu.models.lm import TransformerLM
 from ddw_tpu.models.moe import MoEMlp, top1_routing
 from ddw_tpu.runtime.mesh import make_mesh, MeshSpec, DATA_AXIS
@@ -86,7 +88,7 @@ def test_moe_layer_ep_matches_dense():
     params = dense.init(jax.random.PRNGKey(0), x)["params"]
 
     ref = dense.apply({"params": params}, x)
-    ep_fwd = jax.jit(jax.shard_map(
+    ep_fwd = jax.jit(shard_map(
         lambda p, x: ep.apply({"params": p}, x),
         mesh=mesh, in_specs=(P(), P(DATA_AXIS)),
         out_specs=P(DATA_AXIS), check_vma=False))
@@ -155,7 +157,7 @@ def test_moe_expert_axis_must_divide():
     x = jnp.zeros((4, 2, 8), jnp.float32)
     params = MoEMlp(num_experts=6, mlp_dim=16, dtype=jnp.float32).init(
         jax.random.PRNGKey(0), x)["params"]
-    fwd = jax.jit(jax.shard_map(
+    fwd = jax.jit(shard_map(
         lambda p, x: ep.apply({"params": p}, x),
         mesh=mesh, in_specs=(P(), P(DATA_AXIS)),
         out_specs=P(DATA_AXIS), check_vma=False))
@@ -248,7 +250,7 @@ def test_top2_moe_lm_ep_matches_dense():
     x = jnp.asarray(rng.randn(8, 6, 16).astype(np.float32))
     params = dense.init(jax.random.PRNGKey(0), x)["params"]
     ref = dense.apply({"params": params}, x)
-    ep_fwd = jax.jit(jax.shard_map(
+    ep_fwd = jax.jit(shard_map(
         lambda p, x: ep.apply({"params": p}, x),
         mesh=mesh, in_specs=(P(), P(DATA_AXIS)),
         out_specs=P(DATA_AXIS), check_vma=False))

@@ -11,8 +11,14 @@ and rank-0 returning the fit result.
 import functools
 
 import numpy as np
+import pytest
 
 from ddw_tpu.runtime.launcher import Launcher
+
+# Full multi-process *training* runs (several real fits across 2-process
+# gangs) far exceed the tier-1 wall-clock budget; tier-1 keeps real-gang
+# coverage via the lightweight test_supervisor / test_launcher gangs.
+pytestmark = pytest.mark.slow
 
 
 def _fit_worker(table_root: str) -> dict:

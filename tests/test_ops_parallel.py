@@ -7,6 +7,8 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ddw_tpu.utils.compat import shard_map
+
 from ddw_tpu.ops.flash_attention import flash_attention, mha_reference
 from ddw_tpu.parallel.ring_attention import ring_attention
 from ddw_tpu.parallel.sharding import (
@@ -111,7 +113,7 @@ def test_ring_attention_matches_full(causal):
     def f(q, k, v):
         return ring_attention(q, k, v, "seq", causal=causal)
 
-    smapped = jax.jit(jax.shard_map(
+    smapped = jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(P(None, None, "seq", None),) * 3,
         out_specs=P(None, None, "seq", None), check_vma=False))
@@ -248,7 +250,7 @@ def test_ring_attention_gradients_match_full(causal):
     v = rng.randn(b, h, s, d).astype(np.float32)
 
     def ring_loss(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal),
             mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
             out_specs=P(None, None, "seq", None), check_vma=False)(q, k, v)
@@ -386,7 +388,7 @@ def test_ring_attention_pallas_arm_matches_full():
     q, k, v = _qkv(b=1, h=2, s=32 * n, d=32, seed=11)
 
     def ring_loss(q, k, v):
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda q, k, v: ring_attention(q, k, v, "seq", causal=True,
                                            impl="pallas"),
             mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,

@@ -48,8 +48,13 @@ def test_pp_params_roundtrip():
 
 
 @pytest.mark.parametrize("schedule,m,v", [
-    ("gpipe", 2, 1), ("gpipe", 4, 1), ("gpipe", 8, 1),   # microbatch scaling
-    ("interleaved", 2, 2), ("interleaved", 4, 2),
+    # microbatch scaling: one arm per schedule in tier-1; the scaling sweep
+    # (m=4,8 / interleaved m=4) rides in the slow tier
+    ("gpipe", 2, 1),
+    pytest.param("gpipe", 4, 1, marks=pytest.mark.slow),
+    pytest.param("gpipe", 8, 1, marks=pytest.mark.slow),
+    ("interleaved", 2, 2),
+    pytest.param("interleaved", 4, 2, marks=pytest.mark.slow),
 ])
 def test_pp_train_step_matches_single_device(schedule, m, v):
     """One pipelined step == one plain DP=1 step: identical loss, accuracy,

@@ -13,6 +13,7 @@ Calibration (single run, 8-dev CPU mesh, width 0.35 @ 32px): pretrained-frozen
 0.61 vs random-frozen 0.20 — the bars below leave ~2x margin on the gap.
 """
 
+import pytest
 import numpy as np
 
 from ddw_tpu.data.prep import generate_synthetic_flowers, prepare_flowers
@@ -29,6 +30,9 @@ from ddw_tpu.models.export import (
 )
 from ddw_tpu.train.trainer import Trainer
 from ddw_tpu.utils.config import DataCfg, ModelCfg, TrainCfg
+
+# end-to-end pretrain+convert+transfer chain — beyond the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
 
 WIDTH = 0.35
 DATA = DataCfg(img_height=32, img_width=32)

@@ -16,6 +16,9 @@ import sys
 import numpy as np
 import pytest
 
+# full acceptance-chain dry-run — beyond the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 STAGES = ("environment", "fetch-weights", "fetch-flowers", "convert", "prep",

@@ -1,9 +1,13 @@
 """tools/serving_curve.py contract: one JSON line, curve + LM blocks."""
 
+import pytest
 import json
 import os
 import subprocess
 import sys
+
+# serving latency/throughput curve — beyond the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -9,6 +9,9 @@ import pytest
 from ddw_tpu.models.lm import TransformerLM, generate
 from ddw_tpu.models.spec_decode import generate_speculative
 
+# speculative-decode sweeps — beyond the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 VOCAB = 32
 
 

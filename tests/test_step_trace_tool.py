@@ -3,10 +3,14 @@
 A typo'd queue item must fail in CI, not burn a tunnel-window attempt.
 """
 
+import pytest
 import json
 import os
 import subprocess
 import sys
+
+# profiler-trace tool smoke — beyond the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -1,0 +1,230 @@
+"""GangSupervisor failure-path tests — every scenario driven by the
+deterministic DDW_FAULT env hooks (ddw_tpu.runtime.faults), on CPU, with real
+OS-process gangs.
+
+The worker is a minimal supervised train loop with the trainers' exact
+contract: restore from the latest durable checkpoint, per-step fault hook +
+preemption check, a cross-process psum barrier per step (so a dead rank
+leaves the others blocked in a collective — the case the gang kill exists
+for), and a checkpoint after every step."""
+
+import functools
+
+import pytest
+
+from ddw_tpu.runtime.launcher import GangError, Launcher
+from ddw_tpu.runtime.supervisor import GangFailure, GangSupervisor
+
+TOTAL_STEPS = 6
+
+
+def _supervised_worker(ckpt_dir: str, total_steps: int) -> dict:
+    """Runs inside each rank. Checkpoints under ``ckpt_dir`` (rank-0 writer),
+    resumes from the newest good step, steps through a psum gang barrier."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from ddw_tpu.checkpoint.ckpt import CheckpointManager
+    from ddw_tpu.runtime.faults import (Preempted, maybe_fault,
+                                        preemption_requested)
+
+    psum = jax.pmap(lambda x: lax.psum(x, "i"), axis_name="i")
+    mgr = CheckpointManager(ckpt_dir)
+    state = {"w": np.zeros((4,), np.float32), "step": np.asarray(0, np.int32)}
+    start = 0
+    if mgr.latest_step() is not None:
+        state, start = mgr.restore(state)
+        start = int(start)
+    for step in range(start, total_steps):
+        maybe_fault("step", step=step, ckpt_dir=ckpt_dir)
+        if preemption_requested():
+            mgr.save(state, step, metadata={"preempted": True})
+            mgr.wait()
+            raise Preempted(step)
+        total = psum(jnp.ones((jax.local_device_count(),)))  # gang barrier
+        state = {"w": state["w"] + float(total[0]),
+                 "step": np.asarray(step + 1, np.int32)}
+        mgr.save(state, step + 1)
+    mgr.close()
+    return {"final_step": int(state["step"]), "resume_step": start,
+            "generation": int(os.environ.get("DDW_RESTART_GEN", "0"))}
+
+
+def _gang(timeout_s=300):
+    return Launcher(np=2, devices_per_proc=1, timeout_s=timeout_s)
+
+
+def _supervisor(launcher, **kw):
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("jitter", 0.0)
+    return GangSupervisor(launcher, **kw)
+
+
+# -- acceptance: crash -> bounded auto-restart-from-checkpoint -------------
+
+@pytest.mark.faults
+def test_crash_restart_resumes_from_checkpoint_and_completes(
+        tmp_path, monkeypatch, worker_pythonpath):
+    """DDW_FAULT=crash:rank=1:step=3 with max_restarts=2: rank 1 dies at
+    step 3 of generation 0, the supervisor relaunches the gang, generation 1
+    resumes from the durable checkpoint (resume step > 0, not step 0) and
+    finishes with the same final step count as a no-fault run."""
+    baseline = Launcher(np=-1).run(
+        functools.partial(_supervised_worker, str(tmp_path / "base"),
+                          TOTAL_STEPS))
+    assert baseline["final_step"] == TOTAL_STEPS
+
+    monkeypatch.setenv("DDW_FAULT", "crash:rank=1:step=3")
+    sup = _supervisor(_gang(), max_restarts=2)
+    out = sup.run(functools.partial(_supervised_worker,
+                                    str(tmp_path / "ck"), TOTAL_STEPS))
+    assert out["final_step"] == baseline["final_step"] == TOTAL_STEPS
+    assert out["resume_step"] > 0          # resumed from a checkpoint...
+    assert out["resume_step"] == 3         # ...exactly the last durable step
+    assert out["generation"] == 1
+    assert len(sup.attempts) == 1 and sup.attempts[0].kind == "crash"
+    from ddw_tpu.runtime.faults import EXIT_FAULT_CRASH
+
+    assert EXIT_FAULT_CRASH in sup.attempts[0].exit_codes
+
+
+@pytest.mark.faults
+def test_max_restarts_zero_raises_gangfailure_with_exit_codes(
+        tmp_path, monkeypatch, worker_pythonpath):
+    monkeypatch.setenv("DDW_FAULT", "crash:rank=1:step=1")
+    sup = _supervisor(_gang(), max_restarts=0)
+    with pytest.raises(GangFailure, match="failed permanently") as exc:
+        sup.run(functools.partial(_supervised_worker,
+                                  str(tmp_path / "ck"), TOTAL_STEPS))
+    from ddw_tpu.runtime.faults import EXIT_FAULT_CRASH
+
+    assert len(exc.value.attempts) == 1
+    assert EXIT_FAULT_CRASH in exc.value.attempts[0].exit_codes
+    assert exc.value.exit_codes == [exc.value.attempts[0].exit_codes]
+
+
+@pytest.mark.faults
+def test_gangfailure_carries_rank0_traceback(tmp_path, monkeypatch,
+                                             worker_pythonpath):
+    """A rank-0 exception survives budget exhaustion: the GangFailure carries
+    the formatted traceback, not just exit codes."""
+    monkeypatch.setenv("DDW_FAULT", "raise:rank=0:step=1")
+    sup = _supervisor(_gang(), max_restarts=0)
+    with pytest.raises(GangFailure, match="injected fault") as exc:
+        sup.run(functools.partial(_supervised_worker,
+                                  str(tmp_path / "ck"), TOTAL_STEPS))
+    assert "FaultInjected" in exc.value.rank0_traceback
+    assert "injected fault" in exc.value.rank0_traceback
+
+
+# -- graceful preemption ---------------------------------------------------
+
+@pytest.mark.faults
+def test_preemption_restarts_outside_crash_budget(tmp_path, monkeypatch,
+                                                  worker_pythonpath):
+    """SIGTERM-driven preemption: the worker checkpoints and exits cleanly
+    (EXIT_PREEMPTED); the supervisor restarts it even with max_restarts=0 —
+    preemption is restartable progress, not failure."""
+    monkeypatch.setenv("DDW_FAULT", "preempt:rank=0:step=2")
+    sup = _supervisor(_gang(), max_restarts=0)
+    out = sup.run(functools.partial(_supervised_worker,
+                                    str(tmp_path / "ck"), TOTAL_STEPS))
+    assert out["final_step"] == TOTAL_STEPS
+    assert out["resume_step"] == 2
+    assert out["generation"] == 1
+    assert len(sup.attempts) == 1 and sup.attempts[0].kind == "preempted"
+
+
+@pytest.mark.faults
+def test_preemption_budget_exhaustion_raises(tmp_path, monkeypatch,
+                                             worker_pythonpath):
+    """A preemption *storm* (every generation preempted) still terminates:
+    gen=* makes the fault re-fire after restart until the preemption budget
+    runs out."""
+    monkeypatch.setenv("DDW_FAULT", "preempt:rank=0:step=0:gen=*")
+    sup = _supervisor(_gang(), max_restarts=0, max_preemption_restarts=1)
+    with pytest.raises(GangFailure) as exc:
+        sup.run(functools.partial(_supervised_worker,
+                                  str(tmp_path / "ck"), TOTAL_STEPS))
+    assert [a.kind for a in exc.value.attempts] == ["preempted", "preempted"]
+
+
+# -- silent early exit + torn checkpoint + deadline ------------------------
+
+@pytest.mark.faults
+def test_exit0_early_surfaces_missing_result(tmp_path, monkeypatch,
+                                             worker_pythonpath):
+    """Every rank exits 0 before writing the result: the driver must surface
+    'result missing', not unpickle garbage or crash with FileNotFoundError."""
+    monkeypatch.setenv("DDW_FAULT", "exit0_early:step=1")
+    with pytest.raises(GangError, match="missing or unreadable") as exc:
+        _gang().run(functools.partial(_supervised_worker,
+                                      str(tmp_path / "ck"), TOTAL_STEPS))
+    assert exc.value.kind == "result-missing"
+    assert exc.value.exit_codes == [0, 0]
+
+
+@pytest.mark.faults
+def test_ckpt_torn_crash_quarantined_on_restart(tmp_path, monkeypatch,
+                                                worker_pythonpath):
+    """Rank 0 drops a torn (newer-numbered, partial) step dir and crashes:
+    the restarted generation must quarantine it and resume from the previous
+    good step — a kill mid-write never poisons resume."""
+    import os
+
+    ckpt_dir = str(tmp_path / "ck")
+    monkeypatch.setenv("DDW_FAULT", "ckpt_torn:rank=0:step=3")
+    sup = _supervisor(_gang(), max_restarts=2)
+    out = sup.run(functools.partial(_supervised_worker, ckpt_dir,
+                                    TOTAL_STEPS))
+    assert out["final_step"] == TOTAL_STEPS
+    assert out["resume_step"] == 3  # fell back past the torn step_1003 dir
+    torn = [d for d in os.listdir(ckpt_dir) if ".torn" in d]
+    assert torn, "torn step dir was not quarantined"
+    assert not os.path.exists(os.path.join(ckpt_dir, "step_0000001003"))
+
+
+@pytest.mark.faults
+def test_stall_hits_gang_deadline(tmp_path, monkeypatch, worker_pythonpath):
+    """A stalled rank trips the shared gang deadline (classified 'deadline',
+    not 'crash') instead of hanging the driver forever."""
+    monkeypatch.setenv("DDW_FAULT", "stall:rank=1:step=2")
+    with pytest.raises(GangError, match="deadline") as exc:
+        _gang(timeout_s=12).run(
+            functools.partial(_supervised_worker, str(tmp_path / "ck"),
+                              TOTAL_STEPS))
+    assert exc.value.kind == "deadline"
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_stall_deadline_then_restart_completes(tmp_path, monkeypatch,
+                                               worker_pythonpath):
+    """Deadline -> supervisor restart -> resume-from-checkpoint completes
+    (the multi-restart stall variant; excluded from tier-1 by `slow`)."""
+    monkeypatch.setenv("DDW_FAULT", "stall:rank=1:step=2")
+    sup = _supervisor(_gang(timeout_s=15), max_restarts=1)
+    out = sup.run(functools.partial(_supervised_worker,
+                                    str(tmp_path / "ck"), TOTAL_STEPS))
+    assert out["final_step"] == TOTAL_STEPS
+    assert out["resume_step"] == 2
+    assert sup.attempts[0].kind == "deadline"
+
+
+# -- pure classification logic --------------------------------------------
+
+def test_gangerror_preemption_classification():
+    from ddw_tpu.runtime.faults import EXIT_PREEMPTED
+
+    mk = lambda codes: GangError("x", kind="crash", exit_codes=codes)  # noqa: E731
+    assert mk([EXIT_PREEMPTED, -9]).is_preemption
+    assert mk([EXIT_PREEMPTED, 0]).is_preemption
+    assert mk([EXIT_PREEMPTED, EXIT_PREEMPTED]).is_preemption
+    # collateral death of a peer (collective error -> exit 1) doesn't mask it
+    assert mk([EXIT_PREEMPTED, 1]).is_preemption
+    assert not mk([0, 1]).is_preemption
+    assert not mk([None, -9]).is_preemption

@@ -123,7 +123,10 @@ def test_eval_step_deterministic():
     assert float(m1["loss"]) == float(m2["loss"])  # dropout off in eval
 
 
-@pytest.mark.parametrize("name", ["resnet18", "resnet50"])
+@pytest.mark.parametrize("name", [
+    "resnet18",
+    pytest.param("resnet50", marks=pytest.mark.slow),  # ~30s of conv compile
+])
 def test_resnet_family_trains(name):
     """ResNet zoo entries: init, DP step with BN stats pmean, loss decreases,
     frozen-base protocol present."""
@@ -147,6 +150,7 @@ def test_resnet_family_trains(name):
     assert ResNet.frozen_prefixes(True) == ("backbone",)
 
 
+@pytest.mark.slow  # ~35s of depthwise-conv compile on the CPU stand-in
 def test_convnext_family_trains():
     """ConvNeXt zoo entry: init, DP step, loss decreases — and, unlike the
     BN families, NO batch_stats collection (the stats-free train-step path

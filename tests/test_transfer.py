@@ -70,6 +70,7 @@ def _run(model_cfg: ModelCfg, imgs, labels, val_imgs, val_labels, steps: int,
     return float(metrics["accuracy"]), state, model
 
 
+@pytest.mark.slow  # two full frozen-backbone fits (~100s) — slow tier
 def test_frozen_pretrained_beats_frozen_random(tmp_path):
     rng = np.random.RandomState(0)
     imgs, labels = _gratings(rng, 512)

@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ddw_tpu.utils.compat import shard_map
+
 from ddw_tpu.utils.config import env_flag
 
 SMOKE = env_flag("DDW_BENCH_SMOKE")
@@ -120,7 +122,7 @@ def ring_report() -> dict:
     out = {}
     mesh1 = Mesh(np.array(jax.devices()[:1]), ("r",))
     x = jnp.arange(8.0, dtype=jnp.float32)
-    y = jax.jit(jax.shard_map(
+    y = jax.jit(shard_map(
         lambda v: ring_all_reduce_pallas(v, "r"), mesh=mesh1,
         in_specs=P(), out_specs=P()))(x)
     out["n1_identity_ok"] = bool(np.allclose(np.asarray(y), np.asarray(x)))
@@ -132,7 +134,7 @@ def ring_report() -> dict:
     try:
         if jax.device_count() >= 2:
             mesh2 = Mesh(np.array(jax.devices()[:2]), ("r",))
-            ring2 = jax.jit(jax.shard_map(
+            ring2 = jax.jit(shard_map(
                 lambda v: ring_all_reduce_pallas(v, "r"), mesh=mesh2,
                 in_specs=P("r"), out_specs=P("r"), check_vma=False))
             ring2.lower(jax.ShapeDtypeStruct((16, 256), jnp.float32)).compile()
@@ -146,7 +148,7 @@ def ring_report() -> dict:
                 n_rows = 16 if SMOKE else 4096
                 buf = jnp.asarray(
                     np.random.RandomState(0).randn(n_rows, 256), jnp.float32)
-                psum2 = jax.jit(jax.shard_map(
+                psum2 = jax.jit(shard_map(
                     lambda v: jax.lax.psum(v, "r"), mesh=mesh2,
                     in_specs=P("r"), out_specs=P("r"), check_vma=False))
                 out["n2_vs_psum_ms"] = {
