@@ -39,7 +39,11 @@ vs the round-1 TPU v5e anchor — and the full matrix rides along under
 "configs" / "host_pipeline" / "device".
 
 Env: ``DDW_BENCH_SMOKE=1`` shrinks every shape/step count for CPU CI;
-``DDW_BENCH_ONLY=name1,name2`` restricts the matrix.
+``DDW_BENCH_ONLY=name1,name2`` restricts the matrix;
+``DDW_BENCH_CHAIN=loop|scan|K`` picks the dispatch arm — ``K`` (an int >= 2)
+measures the fused K-step chain (``TrainCfg.steps_per_dispatch``) AND the
+host-loop arm on the same compiled step, reporting the per-step
+dispatch-overhead delta the chain amortizes (``dispatch_overhead_ms_per_step``).
 """
 
 import json
@@ -160,9 +164,23 @@ def _beat(note: str = "") -> None:
         print(f"[bench] {note}", file=sys.stderr, flush=True)
 
 
-CHAIN = os.environ.get("DDW_BENCH_CHAIN", "loop")
-if CHAIN not in ("loop", "scan"):
-    raise ValueError(f"DDW_BENCH_CHAIN must be 'loop' or 'scan', got {CHAIN!r}")
+_CHAIN_RAW = os.environ.get("DDW_BENCH_CHAIN", "loop")
+if _CHAIN_RAW in ("loop", "scan"):
+    CHAIN = _CHAIN_RAW
+else:
+    # Integer K: the fused K-step dispatch A/B arm (steps_per_dispatch) —
+    # a lax.scan over K steps fed by a stacked super-batch with state +
+    # super-batch donation, PLUS a host-loop measurement of the same
+    # compiled step so each row reports the measured per-step dispatch
+    # overhead the chain amortizes.
+    try:
+        CHAIN = int(_CHAIN_RAW)
+    except ValueError:
+        raise ValueError(f"DDW_BENCH_CHAIN must be 'loop', 'scan', or an "
+                         f"integer K >= 2, got {_CHAIN_RAW!r}") from None
+    if CHAIN < 2:
+        raise ValueError(f"DDW_BENCH_CHAIN=K needs K >= 2 (K=1 IS the loop "
+                         f"arm), got {CHAIN}")
 SCAN_CHUNK = 2 if SMOKE else 8
 
 
@@ -192,6 +210,23 @@ class _SetupHeartbeat:
         return False
 
 
+def _host_loop_runner(compiled, holder, args, next_batch=None):
+    """The per-step host-dispatch ``run_n`` over ``holder['state']`` — the
+    'loop' arm, and the A/B reference the DDW_BENCH_CHAIN=K arm times against
+    (same AOT-compiled step, same state stream)."""
+    def run_n(n):
+        st = holder["state"]
+        t0 = time.perf_counter()
+        for _ in range(n):
+            a = (*next_batch(), *args) if next_batch else args
+            st, m = compiled(st, *a)
+        np.asarray(m["loss"])  # forced D2H: true completion barrier
+        holder["state"] = st
+        return time.perf_counter() - t0
+
+    return run_n
+
+
 def _chained_runner(step, compiled, state, args, next_batch=None):
     """Build ``run_n`` for :func:`_time_steps` over a train step.
 
@@ -209,49 +244,102 @@ def _chained_runner(step, compiled, state, args, next_batch=None):
     under 'loop' while 'scan' still measures true device throughput —
     running both disambiguates device regression from transport regression
     (window-1 2026-07-31 frozen row: 9.6 ms/step on identical FLOPs).
+    ``=K`` (an int >= 2) measures the fused K-step dispatch mode
+    (``TrainCfg.steps_per_dispatch``): a scan over K steps fed by a stacked
+    ``[K, ...]`` super-batch (rebuilt per chain by a device-side stack, as
+    the training loader does), with state + super-batch donated — and ALSO
+    times the host-loop arm so the row reports the dispatch overhead the
+    chain amortizes (``_chain_ab_fields``).
 
     ``step`` must be the traceable (jitted) step — the AOT ``compiled`` one
     cannot be called under tracing and serves the 'loop' arm + FLOP count.
     """
     holder = {"state": state}
     if CHAIN == "loop" or next_batch is not None:
+        return _host_loop_runner(compiled, holder, args, next_batch)
+
+    if CHAIN == "scan":
+        def mega(st, *a):
+            def body(c, _):
+                c2, m = step(c, *a)
+                return c2, m["loss"]
+
+            st2, losses = jax.lax.scan(body, st, None, length=SCAN_CHUNK)
+            return st2, losses[-1]
+
+        mega_c = jax.jit(mega, donate_argnums=(0,))
+        st, last = mega_c(holder["state"], *args)  # warmup/compile
+        np.asarray(last)
+        _beat("scan megastep: compiled")  # the scan program is a second cold
+        holder["state"] = st              # compile — it must beat too
+
         def run_n(n):
+            assert n % SCAN_CHUNK == 0, (n, SCAN_CHUNK)
             st = holder["state"]
             t0 = time.perf_counter()
-            for _ in range(n):
-                a = (*next_batch(), *args) if next_batch else args
-                st, m = compiled(st, *a)
-            np.asarray(m["loss"])  # forced D2H: true completion barrier
+            for _ in range(n // SCAN_CHUNK):
+                st, last = mega_c(st, *args)
+            np.asarray(last)  # forced D2H: true completion barrier
             holder["state"] = st
             return time.perf_counter() - t0
 
+        run_n.chunk = SCAN_CHUNK
         return run_n
 
-    def mega(st, *a):
-        def body(c, _):
-            c2, m = step(c, *a)
+    # CHAIN = int K: fused K-step dispatch. Convention across the synthetic
+    # rows: args = (*per-step batch arrays, rng) — the batches stack to
+    # [K, ...] super-batches (consumed/donated per chain, re-stacked on
+    # device each call exactly as the training loader assembles them), the
+    # rng stays chain-static (the step folds state.step itself).
+    k = CHAIN
+    batch, static = args[:-1], args[-1:]
+    stack_k = jax.jit(
+        lambda g: jax.tree.map(lambda x: jnp.stack([x] * k), g))
+
+    def chain_fn(st, stacked, *stat):
+        def body(c, xs):
+            c2, m = step(c, *xs, *stat)
             return c2, m["loss"]
 
-        st2, losses = jax.lax.scan(body, st, None, length=SCAN_CHUNK)
+        st2, losses = jax.lax.scan(body, st, stacked)
         return st2, losses[-1]
 
-    mega_c = jax.jit(mega, donate_argnums=(0,))
-    st, last = mega_c(holder["state"], *args)  # warmup/compile
+    chain_c = jax.jit(chain_fn, donate_argnums=(0, 1))
+    st, last = chain_c(holder["state"], stack_k(batch), *static)  # warmup
     np.asarray(last)
-    _beat("scan megastep: compiled")  # the scan program is a second cold
-    holder["state"] = st              # compile — it must beat too
+    _beat(f"chain megastep (K={k}): compiled")
+    holder["state"] = st
 
     def run_n(n):
-        assert n % SCAN_CHUNK == 0, (n, SCAN_CHUNK)
+        assert n % k == 0, (n, k)
         st = holder["state"]
         t0 = time.perf_counter()
-        for _ in range(n // SCAN_CHUNK):
-            st, last = mega_c(st, *args)
+        for _ in range(n // k):
+            st, last = chain_c(st, stack_k(batch), *static)
         np.asarray(last)  # forced D2H: true completion barrier
         holder["state"] = st
         return time.perf_counter() - t0
 
+    run_n.chunk = k
+    run_n.chain_k = k
+    run_n.loop_run = _host_loop_runner(compiled, holder, args)
     return run_n
+
+
+def _chain_ab_fields(run_n, dt: float, measured_steps: int) -> dict:
+    """For the DDW_BENCH_CHAIN=K arm: time the host-loop arm on the same
+    compiled step/state stream and report the measured per-step host-overhead
+    delta the fused chain amortizes. Empty for the loop/scan arms."""
+    k = getattr(run_n, "chain_k", None)
+    if not k:
+        return {}
+    chain_ms = dt / measured_steps * 1e3
+    ldt, ln = _time_steps(run_n.loop_run)
+    _beat(f"chain A/B: loop arm measured ({ln} steps)")
+    loop_ms = ldt / ln * 1e3
+    return {"chain_k": k,
+            "loop_step_time_ms": round(loop_ms, 4),
+            "dispatch_overhead_ms_per_step": round(loop_ms - chain_ms, 4)}
 
 
 def _time_steps(run_n) -> tuple[float, int]:
@@ -265,11 +353,12 @@ def _time_steps(run_n) -> tuple[float, int]:
     N) — i.e. the time N steps take.
     """
     n = 2 if SMOKE else 8
-    if CHAIN == "scan":
-        # The scan runner executes whole SCAN_CHUNK megasteps, so n must be a
-        # multiple of SCAN_CHUNK. Round up here (doubling preserves it) rather
-        # than relying on the starting n and SCAN_CHUNK staying equal.
-        n = -(-n // SCAN_CHUNK) * SCAN_CHUNK
+    chunk = getattr(run_n, "chunk", 1)
+    if chunk > 1:
+        # Scan/chain runners execute whole megasteps, so n must be a multiple
+        # of the runner's chunk (SCAN_CHUNK or the chain K). Round up here
+        # (doubling preserves it).
+        n = -(-n // chunk) * chunk
     while True:
         dt = run_n(2 * n) - run_n(n)
         _beat()
@@ -374,6 +463,7 @@ def bench_vision(model_name: str, *, freeze_base: bool, batch: int,
     dt, measured_steps = _time_steps(run_n)
     row = _row(global_batch, n_chips, dt, measured_steps, flops, peak,
                "images/sec/chip")
+    row.update(_chain_ab_fields(run_n, dt, measured_steps))
     row["batch_per_chip"] = batch
     row["image"] = list(img)
     if vit_kw:  # non-default geometry: the A/B row must say what it measured
@@ -494,6 +584,7 @@ def bench_head_features(*, batch: int, feature_dim: int,
     dt, measured_steps = _time_steps(run_n)
     row = _row(global_batch, n_chips, dt, measured_steps, flops, peak,
                "images/sec/chip")
+    row.update(_chain_ab_fields(run_n, dt, measured_steps))
     row.update(batch_per_chip=batch, feature_dim=feature_dim)
     return row
 
@@ -673,6 +764,7 @@ def bench_lm(*, batch: int, seq: int, hidden: int, depth: int, heads: int,
     dt, measured_steps = _time_steps(run_n)
     row = _row(global_batch * seq, n_chips, dt, measured_steps, flops, peak,
                "tokens/sec/chip")
+    row.update(_chain_ab_fields(run_n, dt, measured_steps))
     row.update(batch_per_chip=batch, seq_len=seq, hidden=hidden, depth=depth)
     if os.environ.get("DDW_BENCH_LM_HEADS"):
         row["num_heads"] = heads  # non-default geometry: say what ran

@@ -25,6 +25,16 @@ process 0 waits for all markers, writes ``index.json``, and atomically renames
 to ``step_<N>``. Readers treat only renamed directories as checkpoints, so a
 partially written save is never restorable.
 
+Crash-consistency audit (the classic format's discipline,
+``checkpoint/ckpt.py`` / docs/fault_tolerance.md, ported here): every shard
+file, shard table, commit marker, and the index are fsynced before the
+publishing rename, and ``index.json`` records each process's exact shard-file
+byte count (``proc_bytes``). Readers *verify* a step dir against that record
+(:func:`_sharded_step_complete`) — a torn dir (non-atomic copy, partial
+restore from backup, filesystem loss) is quarantined to ``step_<N>.torn<k>``
+and the scan falls back to the previous good step instead of poisoning
+resume.
+
 Resharding restore: a requested device slice is assembled from every saved
 shard that overlaps it, so a state saved on one mesh (say ``{'data': 8}``)
 restores onto a different one (``{'data': 4}``, or different axis splits)
@@ -41,7 +51,18 @@ import time
 import jax
 import numpy as np
 
-from ddw_tpu.checkpoint.ckpt import _apply_retention, _list_steps
+from ddw_tpu.checkpoint.ckpt import (_apply_retention, _list_steps,
+                                     _quarantine_step)
+
+
+def _fsync_write(path: str, write_fn, mode: str = "w") -> None:
+    """Write ``path`` via ``write_fn(f)`` and fsync before returning — no
+    file participating in the commit protocol may be reordered past the
+    publishing rename by the filesystem."""
+    with open(path, mode) as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -125,23 +146,34 @@ def save_sharded(ckpt_dir: str, state, step: int, metadata: dict | None = None,
                                     "offset": offset, "nbytes": len(raw)})
                     f.write(raw)
                     offset += len(raw)
+        f.flush()
+        os.fsync(f.fileno())  # shard bytes durable before the commit marker
     os.replace(bin_partial, os.path.join(tmp, f"proc_{pid}.bin"))
-    with open(os.path.join(tmp, f"proc_{pid}.json.partial"), "w") as f:
-        json.dump({"entries": entries}, f)
+    _fsync_write(os.path.join(tmp, f"proc_{pid}.json.partial"),
+                 lambda f: json.dump({"entries": entries}, f))
     os.replace(os.path.join(tmp, f"proc_{pid}.json.partial"),
                os.path.join(tmp, f"proc_{pid}.json"))
-    with open(os.path.join(tmp, f"commit_{pid}"), "w") as f:
-        f.write("ok")
+    _fsync_write(os.path.join(tmp, f"commit_{pid}"), lambda f: f.write("ok"))
 
     if pid == 0:
         _wait_for(
             lambda: all(os.path.exists(os.path.join(tmp, f"commit_{i}"))
                         for i in range(nproc)),
             timeout_s, f"all {nproc} commit markers in {tmp}")
-        with open(os.path.join(tmp, "index.json"), "w") as f:
-            json.dump({"step": step, "created_unix": time.time(),
-                       "n_processes": nproc, "metadata": metadata or {},
-                       "leaves": leaves_meta}, f, indent=2)
+        # Completeness record (the classic format's state_bytes analog): the
+        # exact byte count of every process's shard file, so readers can
+        # DETECT a torn dir — however produced — instead of trusting the
+        # rename alone (which a non-atomic copy or partial restore bypasses).
+        proc_bytes = {
+            str(i): os.path.getsize(os.path.join(tmp, f"proc_{i}.bin"))
+            for i in range(nproc)}
+        _fsync_write(
+            os.path.join(tmp, "index.json"),
+            lambda f: json.dump({"step": step, "created_unix": time.time(),
+                                 "n_processes": nproc,
+                                 "proc_bytes": proc_bytes,
+                                 "metadata": metadata or {},
+                                 "leaves": leaves_meta}, f, indent=2))
         if os.path.exists(final):
             shutil.rmtree(final)
         os.replace(tmp, final)
@@ -207,6 +239,46 @@ class _ShardReader:
         self._files.clear()
 
 
+def _sharded_step_complete(ckpt_dir: str, step: int) -> bool:
+    """Torn-write detector for the sharded layout: a step dir is usable iff
+    ``index.json`` parses AND every process's shard file + shard table are
+    present with the shard file's size matching the recorded ``proc_bytes``.
+    Atomically-published dirs always pass; partial copies, kills mid-copy,
+    or filesystem loss fail. Pre-audit checkpoints (no ``proc_bytes``) keep
+    restoring — file presence is still verified."""
+    d = os.path.join(ckpt_dir, f"step_{step:010d}")
+    try:
+        with open(os.path.join(d, "index.json")) as f:
+            index = json.load(f)
+    except (OSError, ValueError):
+        return False
+    nproc = index.get("n_processes")
+    if not isinstance(nproc, int) or nproc < 1:
+        return False
+    proc_bytes = index.get("proc_bytes") or {}
+    for i in range(nproc):
+        binp = os.path.join(d, f"proc_{i}.bin")
+        if not (os.path.isfile(binp)
+                and os.path.isfile(os.path.join(d, f"proc_{i}.json"))):
+            return False
+        expect = proc_bytes.get(str(i))
+        if expect is not None and os.path.getsize(binp) != expect:
+            return False
+    return True
+
+
+def latest_complete_step(ckpt_dir: str) -> int | None:
+    """Newest *complete* sharded step. Torn step dirs found on the way are
+    quarantined (``step_N.torn<k>``, process 0 only — peers just skip them)
+    so they stop shadowing older good checkpoints; the scan falls back."""
+    for s in sorted(_list_steps(ckpt_dir), reverse=True):
+        if _sharded_step_complete(ckpt_dir, s):
+            return s
+        if jax.process_index() == 0:
+            _quarantine_step(ckpt_dir, s)
+    return None
+
+
 def restore_sharded(ckpt_dir: str, target, shardings, step: int | None = None):
     """Restore into ``target``'s structure with the given per-leaf shardings.
 
@@ -214,13 +286,22 @@ def restore_sharded(ckpt_dir: str, target, shardings, step: int | None = None):
     and ``shardings`` a matching pytree of ``jax.sharding.Sharding`` — e.g.
     :func:`ddw_tpu.parallel.zero.zero_state_shardings` output. Each process
     reads only the slices its devices need. Returns ``(state, step)`` or
-    ``(target, None)`` when no checkpoint exists.
+    ``(target, None)`` when no checkpoint exists. With ``step=None`` torn
+    step dirs are quarantined and the newest complete step is used; an
+    explicitly requested torn step raises (the caller named a checkpoint
+    that does not usably exist).
     """
     if step is None:
-        steps = _list_steps(ckpt_dir)
-        if not steps:
+        step = latest_complete_step(ckpt_dir)
+        if step is None:
             return target, None
-        step = max(steps)
+    elif not _sharded_step_complete(ckpt_dir, step):
+        quarantined = (_quarantine_step(ckpt_dir, step)
+                       if jax.process_index() == 0 else None)
+        raise FileNotFoundError(
+            f"sharded checkpoint step {step} in {ckpt_dir} is missing or torn"
+            + (f" (quarantined to {quarantined})" if quarantined else "")
+            + "; pass step=None to fall back to the newest good checkpoint")
     dirp = os.path.join(ckpt_dir, f"step_{step:010d}")
     with open(os.path.join(dirp, "index.json")) as f:
         index = json.load(f)
@@ -265,10 +346,9 @@ def restore_sharded(ckpt_dir: str, target, shardings, step: int | None = None):
 
 def read_metadata(ckpt_dir: str, step: int | None = None) -> dict | None:
     if step is None:
-        steps = _list_steps(ckpt_dir)
-        if not steps:
+        step = latest_complete_step(ckpt_dir)
+        if step is None:
             return None
-        step = max(steps)
     with open(os.path.join(ckpt_dir, f"step_{step:010d}", "index.json")) as f:
         return json.load(f)
 
@@ -289,8 +369,7 @@ class ShardedCheckpointManager:
         return restore_sharded(self.ckpt_dir, target, shardings, step)
 
     def latest_step(self) -> int | None:
-        steps = _list_steps(self.ckpt_dir)
-        return max(steps) if steps else None
+        return latest_complete_step(self.ckpt_dir)
 
     def read_metadata(self, step: int | None = None) -> dict | None:
         meta = read_metadata(self.ckpt_dir, step)

@@ -171,6 +171,14 @@ class ShardedLoader:
         position without decoding the skipped images. A trainer that consumed
         ``k`` batches before checkpointing resumes the identical stream with
         ``skip_records = k * batch_size``.
+      super_batch: fused-dispatch super-batches (``TrainCfg.steps_per_dispatch``):
+        an int K or a cyclic plan tuple (``ddw_tpu.train.step.chain_plan`` —
+        e.g. ``(K, K, tail)`` covering one epoch). Successive already-
+        transferred batches are stacked ON DEVICE on the prefetch thread into
+        ``[k, B, ...]`` arrays (chain dim unsharded), so host->HBM bytes are
+        exactly the per-batch path's — only the Python dispatch granularity
+        changes. Requires ``prefetch_to``; ``None``/all-ones means plain
+        per-step batches.
     """
 
     def __init__(
@@ -188,9 +196,29 @@ class ShardedLoader:
         prefetch: int = 2,
         prefetch_to=None,
         skip_records: int = 0,
+        super_batch=None,
     ):
         if not 0 <= cur_shard < shard_count:
             raise ValueError(f"cur_shard {cur_shard} out of range for shard_count {shard_count}")
+        if super_batch is not None:
+            plan = ((int(super_batch),) if isinstance(super_batch, int)
+                    else tuple(int(k) for k in super_batch))
+            if not plan or any(k < 1 for k in plan):
+                raise ValueError(f"super_batch must be a positive int or a "
+                                 f"tuple of positive chain lengths, got "
+                                 f"{super_batch!r}")
+            if all(k == 1 for k in plan):
+                plan = None  # K=1 everywhere: plain per-step batches
+            elif prefetch_to is None:
+                # refuse-loudly: the super-batch contract is DEVICE-side
+                # stacking on the prefetch thread; silently stacking on host
+                # would 1:1 change the H2D transfer granularity it promises
+                # not to touch
+                raise ValueError("super_batch needs prefetch_to (batches are "
+                                 "stacked on device on the prefetch thread)")
+            self._super_plan = plan
+        else:
+            self._super_plan = None
         self.table = table
         self.batch_size = batch_size
         self.height, self.width = image_size
@@ -457,13 +485,50 @@ class ShardedLoader:
                     continue
             return False
 
+        plan = self._super_plan
+        stack_fn = None
+        if plan is not None:
+            # Device-side super-batch stacking (steps_per_dispatch): K
+            # already-transferred batches concatenate into [k, B, ...] with
+            # the chain dim unsharded — one tiny fused device program per
+            # chain, on the prefetch thread like the transfer itself. Jitted
+            # once per distinct k (at most two: full chain + trailing tail).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            mesh = getattr(self.prefetch_to, "mesh", None)
+            spec = getattr(self.prefetch_to, "spec", None)
+            if mesh is None or spec is None:
+                raise ValueError(
+                    f"super_batch needs a NamedSharding prefetch_to to derive "
+                    f"the stacked [k, B, ...] sharding, got "
+                    f"{type(self.prefetch_to).__name__}")
+            sup_sh = NamedSharding(mesh, PartitionSpec(None, *spec))
+            stack_fn = jax.jit(
+                lambda g: jax.tree.map(lambda *xs: jax.numpy.stack(xs), *g),
+                out_shardings=(sup_sh, sup_sh))
+
         def producer():
             try:
-                for imgs, lbls in self._iter_batches():
-                    if stop.is_set():
-                        return
-                    if not put_or_stop(transfer(imgs, lbls)):
-                        return
+                if plan is None:
+                    for imgs, lbls in self._iter_batches():
+                        if stop.is_set():
+                            return
+                        if not put_or_stop(transfer(imgs, lbls)):
+                            return
+                else:
+                    group: list = []
+                    ci = 0
+                    for imgs, lbls in self._iter_batches():
+                        if stop.is_set():
+                            return
+                        group.append(transfer(imgs, lbls))
+                        if len(group) == plan[ci % len(plan)]:
+                            if not put_or_stop(stack_fn(tuple(group))):
+                                return
+                            group = []
+                            ci += 1
+                    # finite stream: a trailing incomplete group is dropped
+                    # (drop_remainder semantics at chain granularity)
                 put_or_stop(_SENTINEL)
             except Exception as e:  # surface errors on the consumer side
                 put_or_stop(e)

@@ -1,6 +1,8 @@
 from ddw_tpu.parallel.ring_attention import ring_attention  # noqa: F401
 from ddw_tpu.parallel.zero import (  # noqa: F401
+    make_fsdp_train_chain,
     make_fsdp_train_step,
+    make_zero_train_chain,
     make_zero_train_step,
 )
 from ddw_tpu.parallel.sharding import (  # noqa: F401

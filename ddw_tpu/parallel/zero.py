@@ -215,24 +215,11 @@ def _global_microbatches(x, accum: int, mesh: Mesh, axis: str):
         x, NamedSharding(mesh, P(None, axis)))
 
 
-def _make_sharded_state_step(
-    shardings_fn,
-    model,
-    tx: optax.GradientTransformation,
-    mesh: Mesh,
-    axis: str = DATA_AXIS,
-    donate: bool = True,
-    grad_accum_steps: int = 1,
-) -> Callable:
-    """Shared factory behind the ZeRO-1 and FSDP steps: a jit'd DP step whose
-    TrainState in/out shardings come from ``shardings_fn(state, mesh, axis)``;
-    GSPMD derives the collective schedule from those annotations.
-    ``grad_accum_steps > 1`` scans interleaved global microbatches
-    (:func:`_global_microbatches`) — 1/accum the activation memory, the same
-    optimizer math, and each microbatch's gradients reduce-scatter straight
-    into the sharded accumulator."""
-    repl = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(axis))
+def _make_sharded_step_body(model, tx: optax.GradientTransformation,
+                            mesh: Mesh, axis: str, grad_accum_steps: int):
+    """The single-update GSPMD body shared by the per-step stepper
+    (:func:`_make_sharded_state_step`) and the fused K-step chain
+    (:func:`_make_sharded_state_chain`)."""
 
     def _step(state: TrainState, images, labels, rng):
         dropout_rng = jax.random.fold_in(rng, state.step)
@@ -254,6 +241,30 @@ def _make_sharded_state_step(
         # gradients into the param/moment shards.
         new_state = apply_gradients(state, tx, grads, new_bs)
         return new_state, {"loss": loss, "accuracy": acc}
+
+    return _step
+
+
+def _make_sharded_state_step(
+    shardings_fn,
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+    grad_accum_steps: int = 1,
+) -> Callable:
+    """Shared factory behind the ZeRO-1 and FSDP steps: a jit'd DP step whose
+    TrainState in/out shardings come from ``shardings_fn(state, mesh, axis)``;
+    GSPMD derives the collective schedule from those annotations.
+    ``grad_accum_steps > 1`` scans interleaved global microbatches
+    (:func:`_global_microbatches`) — 1/accum the activation memory, the same
+    optimizer math, and each microbatch's gradients reduce-scatter straight
+    into the sharded accumulator."""
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(axis))
+
+    _step = _make_sharded_step_body(model, tx, mesh, axis, grad_accum_steps)
 
     def place_state(state: TrainState) -> TrainState:
         sh = shardings_fn(state, mesh, axis)
@@ -282,6 +293,101 @@ def _make_sharded_state_step(
     stepper.place_state = place_state  # type: ignore[attr-defined]
     stepper.batch_sharding = batch_sh  # type: ignore[attr-defined]
     return stepper
+
+
+def _make_sharded_state_chain(
+    shardings_fn,
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+    grad_accum_steps: int = 1,
+) -> Callable:
+    """Fused K-step chain over a sharded TrainState — the ZeRO/FSDP analog of
+    :func:`ddw_tpu.train.step.make_train_chain`. ``lax.scan`` iterates the
+    GSPMD step body K times inside one jit; each scanned step's gradients
+    reduce-scatter straight into the sharded moments (and, under FSDP, the
+    sharded params) exactly as the per-step program's do. The super-batch
+    ``[K, B, ...]`` shards its batch dim over ``axis`` (chain dim unsharded);
+    the TrainState donates (in-place param/moment aliasing — the buffers that
+    matter at ZeRO scale). K comes from the input shape — one callable serves
+    the full and the trailing partial chain lengths."""
+    repl = NamedSharding(mesh, P())
+    sup_sh = NamedSharding(mesh, P(None, axis))
+
+    body = _make_sharded_step_body(model, tx, mesh, axis, grad_accum_steps)
+
+    def _chain(state: TrainState, images, labels, rng):
+        def scanned(st, xs):
+            im, lb = xs
+            return body(st, im, lb, rng)
+
+        return jax.lax.scan(scanned, state, (images, labels))
+
+    def place_state(state: TrainState) -> TrainState:
+        sh = shardings_fn(state, mesh, axis)
+        return jax.tree.map(jax.device_put, state, sh)
+
+    # Keyed per state structure+shapes like the per-step stepper: the in/out
+    # shardings are derived from the concrete TrainState.
+    _jits: dict = {}
+
+    def chain(state, images, labels, rng):
+        key = (jax.tree.structure(state),
+               tuple(tuple(l.shape) for l in jax.tree.leaves(state)))
+        fn = _jits.get(key)
+        if fn is None:
+            state_sh = shardings_fn(state, mesh, axis)
+            # Donate the STATE only: under explicit in_shardings lowering,
+            # scan xs (the super-batch) can never alias an output, so jit
+            # would warn "donated buffers were not usable" on every compile
+            # — the no-copy-on-donate contract tests/test_chain.py pins. The
+            # state aliases fully (params/moments update in place).
+            fn = _jits[key] = jax.jit(
+                _chain,
+                in_shardings=(state_sh, sup_sh, sup_sh, repl),
+                out_shardings=(state_sh, repl),
+                donate_argnums=(0,) if donate else (),
+            )
+        return fn(state, images, labels, rng)
+
+    chain.place_state = place_state  # type: ignore[attr-defined]
+    chain.batch_sharding = NamedSharding(mesh, P(axis))  # per-step batches
+    chain.super_batch_sharding = sup_sh  # type: ignore[attr-defined]
+    return chain
+
+
+def make_zero_train_chain(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+    grad_accum_steps: int = 1,
+) -> Callable:
+    """Fused K-step chain with ZeRO-1 sharded optimizer state — same call
+    contract as :func:`ddw_tpu.train.step.make_train_chain` but the moments
+    live sharded (call ``chain.place_state(state)`` once, or reuse the
+    per-step stepper's placement). Training result is identical to K
+    sequential :func:`make_zero_train_step` dispatches (tests/test_chain.py)."""
+    return _make_sharded_state_chain(zero_state_shardings, model, tx, mesh,
+                                     axis, donate, grad_accum_steps)
+
+
+def make_fsdp_train_chain(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+    grad_accum_steps: int = 1,
+) -> Callable:
+    """Fused K-step chain with ZeRO-3/FSDP fully-sharded params + optimizer
+    state; the per-layer all-gather / reduce-scatter schedule repeats inside
+    the scan exactly as across K separate dispatches."""
+    return _make_sharded_state_chain(fsdp_state_shardings, model, tx, mesh,
+                                     axis, donate, grad_accum_steps)
 
 
 def make_zero_train_step(

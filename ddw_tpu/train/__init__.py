@@ -1,4 +1,13 @@
-from ddw_tpu.train.step import TrainState, make_optimizer, make_train_step, make_eval_step, init_state  # noqa: F401
+from ddw_tpu.train.step import (  # noqa: F401
+    TrainState,
+    chain_plan,
+    fetch_metrics_mean,
+    init_state,
+    make_eval_step,
+    make_optimizer,
+    make_train_chain,
+    make_train_step,
+)
 from ddw_tpu.train.trainer import Trainer, TrainResult  # noqa: F401
 from ddw_tpu.train.callbacks import LRWarmup, ReduceLROnPlateau, EarlyStopping  # noqa: F401
 from ddw_tpu.train.transfer import (  # noqa: F401

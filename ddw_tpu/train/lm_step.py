@@ -64,6 +64,22 @@ def init_lm_state(model, tx: optax.GradientTransformation,
     return TrainState(params, {}, tx.init(params), jnp.zeros((), jnp.int32))
 
 
+def _lm_axes(model, data_axis: str, seq_axis: str | None) -> tuple:
+    """Validate the model/step axis contract shared by the per-step and
+    chained factories; returns ``(axes, moe)``."""
+    axes = (data_axis,) if seq_axis is None else (data_axis, seq_axis)
+    if (model.seq_axis or None) != (seq_axis or None):
+        raise ValueError(f"model.seq_axis={model.seq_axis!r} but step "
+                         f"seq_axis={seq_axis!r} — construct the model with the "
+                         f"axis it will run under")
+    moe = getattr(model, "num_experts", 0) > 0
+    expert_axis = getattr(model, "expert_axis", None)
+    if expert_axis and expert_axis not in axes:
+        raise ValueError(f"model.expert_axis={expert_axis!r} is not a step "
+                         f"mesh axis {axes}")
+    return axes, moe
+
+
 def make_lm_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -85,16 +101,26 @@ def make_lm_train_step(
     with ``aux_loss_weight`` and reported as ``metrics['aux_loss']``.
     """
     tx = _maybe_lora_tx(model, tx)
-    axes = (data_axis,) if seq_axis is None else (data_axis, seq_axis)
-    if (model.seq_axis or None) != (seq_axis or None):
-        raise ValueError(f"model.seq_axis={model.seq_axis!r} but step "
-                         f"seq_axis={seq_axis!r} — construct the model with the "
-                         f"axis it will run under")
-    moe = getattr(model, "num_experts", 0) > 0
-    expert_axis = getattr(model, "expert_axis", None)
-    if expert_axis and expert_axis not in axes:
-        raise ValueError(f"model.expert_axis={expert_axis!r} is not a step "
-                         f"mesh axis {axes}")
+    axes, moe = _lm_axes(model, data_axis, seq_axis)
+    _step = _make_lm_step_body(model, tx, axes, moe, aux_loss_weight,
+                               grad_accum_steps)
+
+    tok_spec = P(data_axis) if seq_axis is None else P(data_axis, seq_axis)
+    smapped = shard_map(
+        _step, mesh=mesh,
+        in_specs=(P(), tok_spec, tok_spec, P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    step = jax.jit(smapped, donate_argnums=(0,) if donate else ())
+    step.batch_sharding = NamedSharding(mesh, tok_spec)  # type: ignore[attr-defined]
+    return step
+
+
+def _make_lm_step_body(model, tx: optax.GradientTransformation, axes, moe,
+                       aux_loss_weight: float, grad_accum_steps: int):
+    """The per-update shard_map body shared by :func:`make_lm_train_step`
+    and :func:`make_lm_train_chain` (which scans it K times)."""
 
     def _step(state: TrainState, inputs, targets, rng):
         # independent dropout masks per (data shard, seq shard, step)
@@ -165,16 +191,50 @@ def make_lm_train_step(
             metrics["aux_loss"] = lax.pmean(aux, axes)
         return TrainState(new_params, {}, new_opt, state.step + 1), metrics
 
+    return _step
+
+
+def make_lm_train_chain(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str | None = "seq",
+    donate: bool = True,
+    aux_loss_weight: float = 0.01,
+    grad_accum_steps: int = 1,
+) -> Callable:
+    """Fused K-step LM train program (``TrainCfg.steps_per_dispatch``): the
+    :func:`make_lm_train_step` body ``lax.scan``-ned over a stacked token
+    super-batch ``inputs/targets[K, global_batch, global_seq]`` (tokens shard
+    ``P(None, data_axis, seq_axis)``; the chain dim stays unsharded). Metrics
+    come back as ``[K]`` per-step arrays fetched once per chain; TrainState
+    and the super-batch donate through the program. K is read from the input
+    shape — one callable serves the full and the trailing partial chain."""
+    tx = _maybe_lora_tx(model, tx)
+    axes, moe = _lm_axes(model, data_axis, seq_axis)
+    body = _make_lm_step_body(model, tx, axes, moe, aux_loss_weight,
+                              grad_accum_steps)
+
+    def _chain(state: TrainState, inputs, targets, rng):
+        def scanned(st, xs):
+            in_i, tg_i = xs
+            return body(st, in_i, tg_i, rng)
+
+        return lax.scan(scanned, state, (inputs, targets))
+
     tok_spec = P(data_axis) if seq_axis is None else P(data_axis, seq_axis)
+    sup_spec = P(None, *tok_spec)
     smapped = shard_map(
-        _step, mesh=mesh,
-        in_specs=(P(), tok_spec, tok_spec, P()),
+        _chain, mesh=mesh,
+        in_specs=(P(), sup_spec, sup_spec, P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
-    step = jax.jit(smapped, donate_argnums=(0,) if donate else ())
-    step.batch_sharding = NamedSharding(mesh, tok_spec)  # type: ignore[attr-defined]
-    return step
+    chain = jax.jit(smapped, donate_argnums=(0, 1, 2) if donate else ())
+    chain.batch_sharding = NamedSharding(mesh, tok_spec)  # type: ignore[attr-defined]
+    chain.super_batch_sharding = NamedSharding(mesh, sup_spec)  # type: ignore[attr-defined]
+    return chain
 
 
 def make_lm_eval_step(model, mesh: Mesh, data_axis: str = "data",

@@ -36,11 +36,13 @@ from ddw_tpu.runtime.mesh import (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MeshSpec,
 from ddw_tpu.train.lm_step import (
     init_lm_state,
     make_lm_eval_step,
+    make_lm_train_chain,
     make_lm_train_step,
 )
 from ddw_tpu.train.schedule import ScheduleSuite
-from ddw_tpu.train.step import (TrainState, ema_params, get_lr,
-                                make_optimizer, set_lr)
+from ddw_tpu.train.step import (TrainState, chain_plan, ema_params,
+                                fetch_metrics_mean, get_lr, make_optimizer,
+                                set_lr)
 from ddw_tpu.utils.config import LMCfg, TrainCfg, to_dict
 
 
@@ -92,7 +94,16 @@ class LMTrainer:
                     f"forward discards the sown Switch aux loss, which would "
                     f"silently train an unbalanced router — use the plain "
                     f"DP/EP step (no zero/fsdp) for MoE")
+        if train_cfg.steps_per_dispatch < 1:
+            raise ValueError(f"train.steps_per_dispatch must be >= 1, got "
+                             f"{train_cfg.steps_per_dispatch}")
         if self.pp:
+            if train_cfg.steps_per_dispatch > 1:
+                raise ValueError("steps_per_dispatch does not compose with "
+                                 "pipeline_stages — the pipeline step already "
+                                 "fuses its microbatch schedule into one "
+                                 "dispatch; raise pipeline_microbatches "
+                                 "instead")
             if seq_devices != 1:
                 raise ValueError("pipeline_stages does not compose with "
                                  "seq_devices — the pipeline step shards "
@@ -195,14 +206,23 @@ class LMTrainer:
             raise ValueError(f"{len(train)} train sequences < global batch "
                              f"{global_batch}")
 
-        def make_providers(start_epoch, step):
+        def make_providers(start_epoch, step, plan, chained):
             def train_batches(epoch):
                 order = np.random.RandomState(cfg.seed + 1 + epoch
                                               ).permutation(len(train))
-                for i in range(steps_per_epoch):
-                    idx = order[i * global_batch:(i + 1) * global_batch]
+                i = 0
+                for k in plan:
+                    idx = order[i * global_batch:(i + k) * global_batch]
+                    i += k
                     b = train[idx]
-                    yield b[:, :-1], b[:, 1:]
+                    if chained:
+                        # [k, global_batch, S+1] super-batch: the SAME k
+                        # consecutive batches the per-step path would draw,
+                        # reshaped for the fused scan program.
+                        b = b.reshape(k, global_batch, -1)
+                        yield b[:, :, :-1], b[:, :, 1:]
+                    else:
+                        yield b[:, :-1], b[:, 1:]
 
             def val_batches():
                 for i in range(val_steps):
@@ -273,22 +293,31 @@ class LMTrainer:
                              f"{n_proc} processes")
         host_batch = global_batch // n_proc
 
-        def make_providers(start_epoch, step):
+        def make_providers(start_epoch, step, plan, chained):
             prefetch_to = getattr(step, "batch_sharding", None)
             if n_proc > 1 and prefetch_to is None:
                 raise ValueError("multi-process fit_tables needs a step "
                                  "with a batch sharding to assemble global "
                                  "arrays")
+            if chained and prefetch_to is None:
+                raise ValueError("steps_per_dispatch > 1 under fit_tables "
+                                 "needs a step with a batch sharding — the "
+                                 "loader stacks super-batches on device")
             shard_kw = dict(cur_shard=jax.process_index(),
                             shard_count=n_proc, prefetch_to=prefetch_to)
             train_iter = iter(ShardedLoader(
                 train_table, batch_size=host_batch, num_epochs=None,
                 shuffle=True, seed=cfg.seed + 1,
                 skip_records=start_epoch * steps_per_epoch * host_batch,
+                # chained: the loader stacks [k, B, S] token super-batches on
+                # its prefetch thread per the epoch plan (same record stream,
+                # same H2D bytes — only dispatch granularity changes)
+                super_batch=plan if chained else None,
                 **shard_kw))
 
             def train_batches(epoch):
-                for _ in range(steps_per_epoch):
+                # one item per chain (len(plan) == steps_per_epoch when K=1)
+                for _ in range(len(plan)):
                     yield next(train_iter)
 
             def val_batches():
@@ -321,6 +350,10 @@ class LMTrainer:
             # Outermost wrap (mirrors vision init_state): the shadow tracks
             # the final post-mask updates (LoRA+EMA refused in __init__).
             tx = with_param_ema(tx, cfg.ema_decay)
+        # Fused K-step dispatch: chain plan covering one epoch exactly
+        # (PP refused in __init__; all-ones plan keeps the per-step path).
+        plan = chain_plan(steps_per_epoch, cfg.steps_per_dispatch)
+        chained = cfg.steps_per_dispatch > 1 and any(k > 1 for k in plan)
         rng = jax.random.PRNGKey(cfg.seed)
         if self.pp:
             from ddw_tpu.parallel.pipeline import (init_pp_state,
@@ -337,7 +370,9 @@ class LMTrainer:
                 virtual_stages=vstages)
             eval_step = step.eval_step
         elif self.sharded:
-            from ddw_tpu.parallel.zero import (make_fsdp_train_step,
+            from ddw_tpu.parallel.zero import (make_fsdp_train_chain,
+                                               make_fsdp_train_step,
+                                               make_zero_train_chain,
                                                make_zero_train_step)
 
             state = init_lm_state(self.model, tx, rng,
@@ -348,6 +383,12 @@ class LMTrainer:
             # its meshes with the constant throughout.
             step = make_sharded(self.model, tx, mesh, DATA_AXIS,
                                 grad_accum_steps=cfg.grad_accum_steps)
+            if chained:
+                make_sharded_chain = (make_fsdp_train_chain if cfg.fsdp
+                                      else make_zero_train_chain)
+                chain = make_sharded_chain(
+                    self.model, tx, mesh, DATA_AXIS,
+                    grad_accum_steps=cfg.grad_accum_steps)
             # Eval reads the sharded params through the shard_map eval step's
             # replicated in-spec: GSPMD gathers per eval call (same trade the
             # vision Trainer makes).
@@ -359,6 +400,10 @@ class LMTrainer:
             step = make_lm_train_step(self.model, tx, mesh,
                                       seq_axis=self.seq_axis,
                                       grad_accum_steps=cfg.grad_accum_steps)
+            if chained:
+                chain = make_lm_train_chain(
+                    self.model, tx, mesh, seq_axis=self.seq_axis,
+                    grad_accum_steps=cfg.grad_accum_steps)
             eval_step = make_lm_eval_step(self.model, mesh,
                                           seq_axis=self.seq_axis)
 
@@ -443,7 +488,8 @@ class LMTrainer:
                                  "steps_per_epoch": steps_per_epoch,
                                  "global_batch": global_batch})
 
-        train_batches, val_batches = make_providers(start_epoch, step)
+        train_batches, val_batches = make_providers(
+            start_epoch, chain if chained else step, plan, chained)
 
         history: list[dict[str, float]] = []
         step_rng = jax.random.PRNGKey(cfg.seed + 1)
@@ -457,9 +503,15 @@ class LMTrainer:
         try:
             for epoch in range(start_epoch, cfg.epochs):
                 tlosses, taccs = [], []
-                for i, (inputs, targets) in enumerate(train_batches(epoch)):
+                batch_it = train_batches(epoch)
+                step_i = 0
+                for k_chain in plan:
+                    inputs, targets = next(batch_it)
                     # Fault-injection hook (runtime.faults): free no-op
                     # unless DDW_FAULT targets this rank/step/generation.
+                    # Under chained dispatch the hook (and the preemption
+                    # check / per-batch LR write) fires at CHAIN boundaries —
+                    # the host only regains control every k_chain steps.
                     maybe_fault("step", step=host_step,
                                 ckpt_dir=cfg.checkpoint_dir or None)
                     if preemption_requested():
@@ -473,16 +525,23 @@ class LMTrainer:
                                                 "preempted": True,
                                                 "callbacks": sched.state_dicts()})
                         raise Preempted(host_step)
-                    lr = sched.lr_for_batch(epoch, i, steps_per_epoch)
+                    lr = sched.lr_for_batch(epoch, step_i, steps_per_epoch)
                     if lr is not None:
                         state = set_lr(state, lr)
                     if self.pp:  # the pipeline step is deterministic: no rng
                         state, m = step(state, inputs, targets)
+                    elif chained:
+                        # [k, B, S] super-batch through the fused scan
+                        # program; metrics come back [k] per step
+                        state, m = chain(state, inputs, targets,
+                                         jax.random.fold_in(step_rng,
+                                                            host_step))
                     else:
                         state, m = step(state, inputs, targets,
                                         jax.random.fold_in(step_rng,
                                                            host_step))
-                    host_step += 1
+                    host_step += k_chain
+                    step_i += k_chain
                     tlosses.append(m["loss"])
                     taccs.append(m["accuracy"])
 
@@ -502,12 +561,16 @@ class LMTrainer:
                     vm = eval_step(eval_state, vin, vtg)
                     vlosses.append(vm["loss"])
                     vaccs.append(vm["accuracy"])
+                # ONE device reduction + fetch per metric for the whole epoch
+                # (fetch_metrics_mean) instead of a device_get per scalar —
+                # exact per-step mean whether entries are scalars or [k]
+                # chain arrays.
                 row = {
                     "epoch": epoch,
-                    "loss": float(np.mean(jax.device_get(tlosses))),
-                    "accuracy": float(np.mean(jax.device_get(taccs))),
-                    "val_loss": float(np.mean(jax.device_get(vlosses))),
-                    "val_accuracy": float(np.mean(jax.device_get(vaccs))),
+                    "loss": fetch_metrics_mean(tlosses),
+                    "accuracy": fetch_metrics_mean(taccs),
+                    "val_loss": fetch_metrics_mean(vlosses),
+                    "val_accuracy": fetch_metrics_mean(vaccs),
                     "lr": get_lr(state),
                 }
                 if self.pp:  # schedule idle fraction, logged beside loss

@@ -334,6 +334,34 @@ def scan_microbatches(model, state: TrainState, im, lb, base_rng):
     return lsum * inv, asum * inv, new_bs, jax.tree.map(lambda g: g * inv, gsum)
 
 
+def _dp_step_body(model, tx: optax.GradientTransformation, axis_name: str,
+                  grad_accum_steps: int, state: TrainState, images, labels,
+                  rng):
+    """One optimizer update on a per-device batch slice — the shard_map body
+    shared by :func:`make_train_step` (one dispatch per step) and
+    :func:`make_train_chain` (``lax.scan``-ned K times inside one program).
+    The dropout rng folds the device counter ``state.step``, so a scanned
+    step draws exactly the mask the equivalent host-dispatched step would."""
+    me = lax.axis_index(axis_name)
+    dropout_rng = jax.random.fold_in(jax.random.fold_in(rng, me), state.step)
+    if grad_accum_steps > 1:
+        loss, acc, new_bs, grads = accumulate_grads(
+            model, state, images, labels, dropout_rng, grad_accum_steps)
+    else:
+        loss, acc, new_bs, grads = forward_and_grads(
+            model, state, images, labels, dropout_rng)
+    # THE collective: gradient averaging across the data axis
+    # (hvd.DistributedOptimizer role, reference :302).
+    grads = lax.pmean(grads, axis_name)
+    if state.batch_stats:
+        new_bs = lax.pmean(new_bs, axis_name)  # world-consistent BN statistics
+    metrics = {
+        "loss": lax.pmean(loss, axis_name),
+        "accuracy": lax.pmean(acc, axis_name),
+    }
+    return apply_gradients(state, tx, grads, new_bs), metrics
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -350,27 +378,9 @@ def make_train_step(
     device's batch as that many sequential microbatches (see
     :func:`accumulate_grads`).
     """
-    def _step(state: TrainState, images, labels, rng):
-        me = lax.axis_index(axis_name)
-        dropout_rng = jax.random.fold_in(jax.random.fold_in(rng, me), state.step)
-        if grad_accum_steps > 1:
-            loss, acc, new_bs, grads = accumulate_grads(
-                model, state, images, labels, dropout_rng, grad_accum_steps)
-        else:
-            loss, acc, new_bs, grads = forward_and_grads(
-                model, state, images, labels, dropout_rng)
-        # THE collective: gradient averaging across the data axis
-        # (hvd.DistributedOptimizer role, reference :302).
-        grads = lax.pmean(grads, axis_name)
-        if state.batch_stats:
-            new_bs = lax.pmean(new_bs, axis_name)  # world-consistent BN statistics
-        metrics = {
-            "loss": lax.pmean(loss, axis_name),
-            "accuracy": lax.pmean(acc, axis_name),
-        }
-        return apply_gradients(state, tx, grads, new_bs), metrics
+    _step = functools.partial(_dp_step_body, model, tx, axis_name,
+                              grad_accum_steps)
 
-    n_data = mesh.shape[axis_name]
     repl = P()
     data_spec = P(axis_name)
     smapped = shard_map(
@@ -381,6 +391,82 @@ def make_train_step(
         check_vma=False,
     )
     return jax.jit(smapped, donate_argnums=(0,) if donate else ())
+
+
+def make_train_chain(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = "data",
+    donate: bool = True,
+    grad_accum_steps: int = 1,
+) -> Callable:
+    """Build the fused K-step train program: ``lax.scan`` over K optimizer
+    updates inside ONE jitted/shard_map program (``TrainCfg.steps_per_dispatch``).
+
+    ``chain(state, images, labels, rng) -> (state, metrics)`` with a stacked
+    super-batch ``images[K, B, ...]`` / ``labels[K, B]`` (batch dim sharded
+    over ``axis_name``, chain dim unsharded — the :class:`ShardedLoader`
+    assembles it on its prefetch thread) and ``metrics['loss'|'accuracy']``
+    as ``[K]`` per-step arrays fetched once per chain. One host dispatch and
+    one metric fetch cover K steps — the Python-dispatch/bookkeeping cost of
+    small compiled steps amortizes by ~1/K (docs/performance.md).
+
+    K is read from the input shape, so ONE returned callable serves both the
+    full chain length and a trailing partial chain (each compiles once).
+    ``donate=True`` donates the TrainState AND the super-batch buffers through
+    the chain. Math is identical to K host-dispatched ``make_train_step``
+    calls (the scanned body folds ``state.step`` into the dropout rng exactly
+    as the per-step program does) — pinned by ``tests/test_chain.py``.
+    """
+    body = functools.partial(_dp_step_body, model, tx, axis_name,
+                             grad_accum_steps)
+
+    def _chain(state: TrainState, images, labels, rng):
+        def scanned(st, xs):
+            im, lb = xs
+            return body(st, im, lb, rng)
+
+        return lax.scan(scanned, state, (images, labels))
+
+    repl = P()
+    sup_spec = P(None, axis_name)
+    smapped = shard_map(
+        _chain,
+        mesh=mesh,
+        in_specs=(repl, sup_spec, sup_spec, repl),
+        out_specs=(repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def chain_plan(steps_per_epoch: int, k: int) -> tuple[int, ...]:
+    """Chain lengths covering one epoch *exactly*: ``steps_per_epoch // k``
+    full chains plus one trailing partial chain for the remainder (the second
+    — and last — shape the chain program ever compiles). ``k=1`` is today's
+    per-step dispatch. Both trainers and the loader's super-batch assembly
+    consume the same plan, so step accounting cannot drift."""
+    if steps_per_epoch < 1:
+        raise ValueError(f"steps_per_epoch must be >= 1, got {steps_per_epoch}")
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+    if k <= 1:
+        return (1,) * steps_per_epoch
+    n_full, tail = divmod(steps_per_epoch, k)
+    return (k,) * n_full + ((tail,) if tail else ())
+
+
+def fetch_metrics_mean(values) -> float:
+    """Exact per-step mean of accumulated device metrics with ONE dispatch +
+    ONE host fetch. ``values`` mixes scalars (per-step dispatch) and ``[k]``
+    chain arrays; each element of the concatenation is one training step, so
+    the mean equals the old per-element ``device_get`` + ``np.mean`` exactly —
+    without a blocking host round-trip per scalar."""
+    if not values:
+        return float("nan")
+    flat = jnp.concatenate([jnp.ravel(jnp.asarray(v)) for v in values])
+    return float(jax.device_get(jnp.mean(flat)))
 
 
 def make_eval_step(model, mesh: Mesh, axis_name: str = "data") -> Callable:

@@ -30,7 +30,6 @@ import time
 from typing import Any
 
 import jax
-import numpy as np
 
 from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.data.loader import ShardedLoader
@@ -43,10 +42,13 @@ from ddw_tpu.train.schedule import ScheduleSuite
 from ddw_tpu.train.step import (
     TrainState,
     batch_sharding,
+    chain_plan,
     ema_params,
+    fetch_metrics_mean,
     get_lr,
     init_state,
     make_eval_step,
+    make_train_chain,
     make_train_step,
     params_checksum,
     set_lr,
@@ -149,7 +151,7 @@ class Trainer:
         return int(self.mesh.shape[self.train_cfg.data_axis])
 
     def _loaders(self, train_table: Table, val_table: Table,
-                 consumed_batches: int = 0):
+                 consumed_batches: int = 0, super_plan=None):
         n_proc = jax.process_count()
         per_host_batch = self.train_cfg.batch_size * self.world_size // n_proc
         sharding = batch_sharding(self.mesh, self.train_cfg.data_axis)
@@ -169,6 +171,9 @@ class Trainer:
             # True resume: fast-forward the deterministic stream to exactly
             # where the interrupted run stopped consuming.
             skip_records=consumed_batches * per_host_batch,
+            # Fused-dispatch mode: [k, B, ...] super-batches stacked on
+            # device per the epoch's chain plan (chain_plan(spe, K)).
+            super_batch=super_plan,
         )
         val_loader_factory = lambda: ShardedLoader(  # noqa: E731 — fresh pass per epoch
             val_table,
@@ -220,7 +225,9 @@ class Trainer:
                     "sharded saves are collective and synchronous (every "
                     "process writes its shards) — drop one of the flags")
             from ddw_tpu.parallel.zero import (
+                make_fsdp_train_chain,
                 make_fsdp_train_step,
+                make_zero_train_chain,
                 make_zero_train_step,
             )
 
@@ -229,9 +236,22 @@ class Trainer:
             train_step = make_sharded(self.model, tx, self.mesh,
                                       cfg.data_axis,
                                       grad_accum_steps=cfg.grad_accum_steps)
+            make_chain = (make_fsdp_train_chain if cfg.fsdp
+                          else make_zero_train_chain)
         else:
             train_step = make_train_step(self.model, tx, self.mesh, cfg.data_axis,
                                          grad_accum_steps=cfg.grad_accum_steps)
+            make_chain = make_train_chain
+        if cfg.steps_per_dispatch < 1:
+            raise ValueError(f"train.steps_per_dispatch must be >= 1, got "
+                             f"{cfg.steps_per_dispatch}")
+        # Fused K-step dispatch (steps_per_dispatch > 1): ONE compiled scan
+        # program covers K optimizer updates fed by a loader-stacked
+        # [k, B, ...] super-batch; built lazily below once steps_per_epoch
+        # fixes the chain plan. K=1 keeps the per-step dispatch path.
+        train_chain = (make_chain(self.model, tx, self.mesh, cfg.data_axis,
+                                  grad_accum_steps=cfg.grad_accum_steps)
+                       if cfg.steps_per_dispatch > 1 else None)
         eval_step = make_eval_step(self.model, self.mesh, cfg.data_axis)
 
         if not cfg.checkpoint_dir:
@@ -293,10 +313,17 @@ class Trainer:
 
             monitor = SystemMonitor(self.run, cfg.monitor_interval_s)
 
+        # Chain plan: lengths covering one epoch exactly (K-chains + one
+        # trailing partial chain). All-ones (K=1, or steps_per_epoch < 2)
+        # keeps the per-step dispatch path end to end.
+        plan = chain_plan(steps_per_epoch, cfg.steps_per_dispatch)
+        chained = train_chain is not None and any(k > 1 for k in plan)
+
         with monitor if monitor is not None else contextlib.nullcontext():
             train_loader, val_loader_factory = self._loaders(
                 train_table, val_table,
-                consumed_batches=start_epoch * steps_per_epoch)
+                consumed_batches=start_epoch * steps_per_epoch,
+                super_plan=plan if chained else None)
             train_iter = iter(train_loader)
             step_rng = jax.random.PRNGKey(cfg.seed + 1)
 
@@ -318,9 +345,14 @@ class Trainer:
                                 {"trace_dir": os.path.abspath(cfg.trace_dir)})
                     t0 = time.time()
                     losses, accs = [], []
-                    for step_i in range(steps_per_epoch):
+                    step_i = 0
+                    for k_chain in plan:
                         # Fault-injection hook (runtime.faults): free no-op
                         # unless DDW_FAULT targets this rank/step/generation.
+                        # Under chained dispatch it (like the preemption check
+                        # and the per-batch LR write below) fires at CHAIN
+                        # boundaries — the host only regains control every
+                        # k_chain steps (docs/performance.md).
                         maybe_fault("step",
                                     step=epoch * steps_per_epoch + step_i,
                                     ckpt_dir=cfg.checkpoint_dir or None)
@@ -348,11 +380,22 @@ class Trainer:
                         if lr_b is not None:
                             state = set_lr(state, lr_b)
                         images, labels = next(train_iter)
-                        state, metrics = train_step(state, images, labels, step_rng)
+                        if chained:
+                            # [k, B, ...] super-batch through the fused scan
+                            # program; metrics come back as [k] per-step
+                            # arrays — no per-step host work at all.
+                            state, metrics = train_chain(state, images,
+                                                         labels, step_rng)
+                        else:
+                            state, metrics = train_step(state, images, labels,
+                                                        step_rng)
                         losses.append(metrics["loss"])
                         accs.append(metrics["accuracy"])
-                    train_loss = float(np.mean(jax.device_get(losses)))
-                    train_acc = float(np.mean(jax.device_get(accs)))
+                        step_i += k_chain
+                    # ONE device reduction + fetch for the whole epoch
+                    # (fetch_metrics_mean) instead of a device_get per scalar.
+                    train_loss = fetch_metrics_mean(losses)
+                    train_acc = fetch_metrics_mean(accs)
                     epoch_s = time.time() - t0
                     if tracing:
                         jax.profiler.stop_trace()
@@ -375,8 +418,8 @@ class Trainer:
                         m = eval_step(eval_state, images, labels)
                         vlosses.append(m["loss"])
                         vaccs.append(m["accuracy"])
-                    val_loss = float(np.mean(jax.device_get(vlosses)))
-                    val_acc = float(np.mean(jax.device_get(vaccs)))
+                    val_loss = fetch_metrics_mean(vlosses)
+                    val_acc = fetch_metrics_mean(vaccs)
 
                     lr = get_lr(state)
                     row = {

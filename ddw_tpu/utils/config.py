@@ -140,6 +140,20 @@ class TrainCfg:
                                         # step (lax.scan), accumulating gradients —
                                         # same optimizer math, 1/N activation
                                         # memory; batches far beyond HBM fit.
+    steps_per_dispatch: int = 1         # >1: fuse K optimizer steps into ONE
+                                        # jitted program (lax.scan over a
+                                        # stacked [K, B, ...] super-batch the
+                                        # loader assembles on device;
+                                        # train/step.make_train_chain) — ~1/K
+                                        # the host dispatches and metric
+                                        # fetches; same training result.
+                                        # Fault hooks, preemption checks and
+                                        # per-batch LR writes move to chain
+                                        # boundaries (docs/performance.md).
+                                        # Composes with grad_accum_steps and
+                                        # zero/fsdp; refused with
+                                        # pipeline_stages (the pipeline step
+                                        # already fuses its microbatches).
     moment_dtype: str = "float32"       # "bfloat16": store Adam/SGD first
                                         # moments (mu) in bf16 — halves mu
                                         # bytes; nu stays f32 (feeds rsqrt).

@@ -223,3 +223,37 @@ def test_scan_chained_rows():
     for name, row in d["configs"].items():
         assert row["chain"] == "scan", (name, row)
         assert row["rate_per_chip"] > 0, (name, row)
+
+
+def test_fused_chain_arm_reports_dispatch_overhead():
+    """DDW_BENCH_CHAIN=K (the steps_per_dispatch A/B arm): rows must carry
+    the fused-chain tag AND the measured host-loop delta
+    (dispatch_overhead_ms_per_step) — the number the amortization claim
+    rests on — in smoke mode on CPU, so the arm can't regress silently."""
+    d = _run_bench(DDW_BENCH_CHAIN="2",
+                   DDW_BENCH_ONLY=("mobilenet_v2_frozen_feature_cache,"
+                                   "lm_flash"))
+    for name in ("mobilenet_v2_frozen_feature_cache", "lm_flash"):
+        row = d["configs"][name]
+        assert "error" not in row, (name, row)
+        assert row["chain"] == 2 and row["chain_k"] == 2, (name, row)
+        assert row["rate_per_chip"] > 0, (name, row)
+        assert row["loop_step_time_ms"] > 0, (name, row)
+        # the delta is a measurement — sign depends on backend noise; the
+        # contract is that it was measured and reported
+        assert "dispatch_overhead_ms_per_step" in row, (name, row)
+
+
+def test_chain_env_validation():
+    """A typo'd DDW_BENCH_CHAIN must refuse loudly at import, not silently
+    bench the loop arm (same contract as the other knob parsers)."""
+    import subprocess
+
+    for bad in ("chain", "1", "-3"):
+        out = subprocess.run(
+            [sys.executable, "-c", "import bench"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, DDW_BENCH_CHAIN=bad, PALLAS_AXON_POOL_IPS="",
+                     JAX_PLATFORMS="cpu"))
+        assert out.returncode != 0, bad
+        assert "DDW_BENCH_CHAIN" in out.stderr, out.stderr[-500:]

@@ -1,6 +1,9 @@
 """Sharded checkpointing: per-process shard files, exactly-once bytes,
-reshard-on-restore, and the ZeRO-1 integration (VERDICT r2 item 4)."""
+reshard-on-restore, the ZeRO-1 integration (VERDICT r2 item 4), and the
+crash-consistency audit (proc_bytes completeness record + torn-dir
+quarantine — the classic format's discipline ported, PR 2)."""
 
+import json
 import os
 
 import jax
@@ -10,6 +13,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddw_tpu.checkpoint.sharded import (
     ShardedCheckpointManager,
+    latest_complete_step,
     restore_sharded,
     save_sharded,
 )
@@ -130,6 +134,82 @@ def test_structure_mismatch_raises(tmp_path):
     bad_sh = jax.tree.map(lambda _: repl, state)
     with pytest.raises(ValueError, match="shape"):
         restore_sharded(d, bad_target, bad_sh)
+
+
+def test_index_records_proc_bytes(tmp_path):
+    """The completeness record: index.json carries every process's exact
+    shard-file byte count, matching what is on disk."""
+    _, state = _zero_state(2)
+    path = save_sharded(str(tmp_path), state, step=1)
+    with open(os.path.join(path, "index.json")) as f:
+        index = json.load(f)
+    assert index["proc_bytes"] == {
+        "0": os.path.getsize(os.path.join(path, "proc_0.bin"))}
+
+
+def test_torn_shard_file_quarantined_and_falls_back(tmp_path):
+    """A truncated shard file (non-atomic copy, filesystem loss) fails the
+    proc_bytes audit: latest_step/restore quarantine the torn dir and fall
+    back to the previous good step instead of poisoning resume."""
+    mesh, state = _zero_state(4)
+    mgr = ShardedCheckpointManager(str(tmp_path / "ck"))
+    mgr.save(state, 5)
+    mgr.save(state, 9)
+    binp = tmp_path / "ck" / "step_0000000009" / "proc_0.bin"
+    with open(binp, "r+b") as f:
+        f.truncate(os.path.getsize(binp) // 2)
+
+    assert mgr.latest_step() == 5
+    # torn dir moved aside, kept for forensics, invisible to the step scan
+    assert any(d.startswith("step_0000000009.torn")
+               for d in os.listdir(tmp_path / "ck"))
+    sh = zero_state_shardings(state, mesh)
+    restored, at = mgr.restore(jax.tree.map(np.asarray, state), sh)
+    assert at == 5
+    _assert_trees_equal(state, restored)
+
+
+def test_missing_index_quarantined(tmp_path):
+    """A step dir without index.json (killed before the publish rename could
+    never produce one — this simulates a partial copy) is quarantined."""
+    _, state = _zero_state(2)
+    save_sharded(str(tmp_path), state, step=3)
+    save_sharded(str(tmp_path), state, step=7)
+    os.remove(os.path.join(str(tmp_path), "step_0000000007", "index.json"))
+    assert latest_complete_step(str(tmp_path)) == 3
+    assert any(d.startswith("step_0000000007.torn")
+               for d in os.listdir(tmp_path))
+
+
+def test_explicit_torn_step_raises(tmp_path):
+    """Explicitly requesting a torn step raises (the caller named a
+    checkpoint that does not usably exist) rather than returning garbage."""
+    mesh, state = _zero_state(2)
+    save_sharded(str(tmp_path), state, step=4)
+    os.remove(os.path.join(str(tmp_path), "step_0000000004", "proc_0.json"))
+    sh = zero_state_shardings(state, mesh)
+    with pytest.raises(FileNotFoundError, match="missing or torn"):
+        restore_sharded(str(tmp_path), jax.tree.map(np.asarray, state), sh,
+                        step=4)
+
+
+def test_pre_audit_checkpoint_still_restores(tmp_path):
+    """Backward compat: a checkpoint whose index predates proc_bytes (older
+    writer) still passes the audit on file presence alone and restores."""
+    mesh, state = _zero_state(2)
+    path = save_sharded(str(tmp_path), state, step=2)
+    idx = os.path.join(path, "index.json")
+    with open(idx) as f:
+        index = json.load(f)
+    del index["proc_bytes"]
+    with open(idx, "w") as f:
+        json.dump(index, f)
+    assert latest_complete_step(str(tmp_path)) == 2
+    sh = zero_state_shardings(state, mesh)
+    restored, at = restore_sharded(str(tmp_path),
+                                   jax.tree.map(np.asarray, state), sh)
+    assert at == 2
+    _assert_trees_equal(state, restored)
 
 
 def _random_tree(rng, n_leaves):

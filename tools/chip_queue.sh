@@ -103,6 +103,12 @@ while :; do
     # fast while the loop row is slow, the window-1 frozen regression was the
     # tunnel's dispatch rate, not the device.
     run_item mn_frozen_scan  "DDW_BENCH_STALL_S=900 DDW_BENCH_CHAIN=scan DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_frozen_feature_cache python -u bench.py" || continue
+    # Fused K-step dispatch A/B (steps_per_dispatch, docs/performance.md):
+    # chains 8 steps behind one dispatch over a stacked super-batch AND
+    # times the host loop on the same compiled step, so the row reports the
+    # measured dispatch_overhead_ms_per_step the chain amortizes on the two
+    # dispatch-bound headline rows.
+    run_item ab_chain_frozen "DDW_BENCH_STALL_S=900 DDW_BENCH_CHAIN=8 DDW_BENCH_ONLY=mobilenet_v2_frozen,mobilenet_v2_frozen_feature_cache python -u bench.py" || continue
     run_item resnet50        "DDW_BENCH_STALL_S=900 DDW_BENCH_ONLY=resnet50 python -u bench.py" || continue
     # End-to-end loader-fed rows (VERDICT r3 item 3): the Petastorm-role
     # system number — table -> ShardedLoader prefetch -> train step.
