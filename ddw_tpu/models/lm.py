@@ -39,6 +39,11 @@ class CausalSelfAttention(nn.Module):
     seq_axis: str | None = None
     decode: bool = False     # autoregressive mode: KV cache, one token per call
     max_len: int = 2048      # cache capacity in decode mode
+    slot_decode: bool = False  # continuous-batching mode: the cache batch dim
+                             # is a pool of serving slots, each at its OWN
+                             # depth — cache_index becomes a [B] vector, K/V
+                             # writes scatter per row, and masking/overflow
+                             # go per-row (ddw_tpu.serve.slots). S must be 1.
     num_kv_heads: int = 0    # GQA (Ainslie et al. 2305.13245): 0 = num_heads
                              # (MHA); fewer KV heads shrink the k/v params and
                              # the decode cache by H/KV; K/V broadcast to the
@@ -87,26 +92,46 @@ class CausalSelfAttention(nn.Module):
             cap = -(-self.max_len // tile) * tile  # capacity, tile multiple
             # GQA: the cache holds KV heads only — the H/KV memory saving is
             # exactly what grouped queries exist for at generation time
+            if self.slot_decode and s != 1:
+                raise ValueError(f"slot_decode processes one token per slot "
+                                 f"per call, got S={s}")
             ck = self.variable("cache", "cached_key", jnp.zeros,
                                (b, cap, kv_heads, head_dim), k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
                                (b, cap, kv_heads, head_dim), v.dtype)
-            idx = self.variable("cache", "cache_index",
-                                lambda: jnp.zeros((), jnp.int32))
+            idx = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((b,) if self.slot_decode else (),
+                                  jnp.int32))
             # cumulative count of KV tiles actually computed — observability
             # hook proving the skip logic works (test_lm pins it); costs one
             # scalar add per call.
             tiles = self.variable("cache", "tiles_computed",
                                   lambda: jnp.zeros((), jnp.int32))
             pos = idx.value
-            ck.value = lax.dynamic_update_slice(ck.value, k, (0, pos, 0, 0))
-            cv.value = lax.dynamic_update_slice(cv.value, v, (0, pos, 0, 0))
+            if self.slot_decode:
+                # per-row write: each slot appends at its own depth
+                row_write = jax.vmap(
+                    lambda c, t, p: lax.dynamic_update_slice(c, t, (p, 0, 0)))
+                ck.value = row_write(ck.value, k, pos)
+                cv.value = row_write(cv.value, v, pos)
+            else:
+                ck.value = lax.dynamic_update_slice(ck.value, k, (0, pos, 0, 0))
+                cv.value = lax.dynamic_update_slice(cv.value, v, (0, pos, 0, 0))
             idx.value = pos + s
 
             q32 = (q.astype(jnp.float32) / float(head_dim) ** 0.5
                    ).transpose(0, 2, 1, 3)          # [B, H, S, hd]
-            qpos = pos + jnp.arange(s)              # [S] global query positions
-            last = pos + s - 1                      # newest filled position
+            if self.slot_decode:
+                qpos = pos[:, None] + jnp.arange(s)  # [B, S] per-row positions
+                last = jnp.max(pos) + s - 1          # deepest filled position
+            else:
+                qpos = pos + jnp.arange(s)          # [S] global query positions
+                last = pos + s - 1                  # newest filled position
+            # [B, S]: rows shallower than a tile mask it out entirely — the
+            # masked tile's (m, l, o) update is an exact no-op (m carries over,
+            # exp underflows to 0), so per-row results match a per-row skip.
+            qpos_b = qpos if qpos.ndim == 2 else qpos[None]
 
             def tile_body(carry, t):
                 start = t * tile
@@ -122,7 +147,7 @@ class CausalSelfAttention(nn.Module):
                         v_t = jnp.repeat(v_t, groups, axis=2)
                     s_t = jnp.einsum("bhqd,bkhd->bhqk", q32, k_t)  # [B,H,S,T]
                     kpos = start + jnp.arange(tile)
-                    mask = kpos[None, None, None, :] <= qpos[None, None, :, None]
+                    mask = kpos[None, None, None, :] <= qpos_b[:, None, :, None]
                     s_t = jnp.where(mask, s_t, -1e30)
                     m_new = jnp.maximum(m, s_t.max(-1))
                     p = jnp.exp(s_t - m_new[..., None])
@@ -145,8 +170,11 @@ class CausalSelfAttention(nn.Module):
             # Hard failure on overflow: a write past max_len would have
             # clamp-overwritten the last cache rows; NaN-poison the result so
             # the caller cannot miss it (host-side raise is not possible for a
-            # traced index).
+            # traced index). In slot mode only the overflowing ROW is poisoned
+            # — other slots keep decoding.
             overflow = (pos + s) > self.max_len
+            if self.slot_decode:
+                overflow = overflow[:, None, None, None]
             out = jnp.where(overflow, jnp.nan, out).astype(x.dtype)
         else:
             if groups > 1:
@@ -179,6 +207,7 @@ class DecoderBlock(nn.Module):
     seq_axis: str | None = None
     decode: bool = False
     max_len: int = 2048
+    slot_decode: bool = False
     num_experts: int = 0          # >0: MoE MLP (top-1/top-2) instead of dense
     expert_axis: str | None = None
     capacity_factor: float = 1.25
@@ -193,6 +222,7 @@ class DecoderBlock(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         h = CausalSelfAttention(self.num_heads, self.dtype, self.seq_axis,
                                 self.decode, self.max_len,
+                                slot_decode=self.slot_decode,
                                 num_kv_heads=self.num_kv_heads,
                                 lora_rank=self.lora_rank,
                                 lora_alpha=self.lora_alpha,
@@ -245,6 +275,10 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     seq_axis: str | None = None
     decode: bool = False     # KV-cached autoregressive mode (see generate())
+    slot_decode: bool = False  # continuous-batching decode: the batch dim is
+                             # a serving slot pool, each row at its own depth
+                             # (per-row cache/position indices; see
+                             # ddw_tpu.serve.slots.SlotPool). Implies decode.
     num_experts: int = 0     # >0: MoE MLP blocks (expert parallelism via
     expert_axis: str | None = None  # expert_axis inside shard_map)
     capacity_factor: float = 1.25
@@ -285,8 +319,11 @@ class TransformerLM(nn.Module):
             # keep per-layer indices; this top-level one feeds the pos embed).
             # Past max_len the attention layers NaN-poison the output (loud
             # failure); generate() additionally raises host-side up front.
-            pos_idx = self.variable("cache", "pos_index",
-                                    lambda: jnp.zeros((), jnp.int32))
+            # Slot mode keeps one position per pool row ([B] vector).
+            pos_idx = self.variable(
+                "cache", "pos_index",
+                lambda: jnp.zeros((b,) if self.slot_decode else (),
+                                  jnp.int32))
             offset = pos_idx.value
             pos_idx.value = offset + s_local
         elif self.seq_axis is not None:
@@ -305,15 +342,28 @@ class TransformerLM(nn.Module):
         else:
             offset = 0
         if self.pos_encoding == "learned":
-            pos = lax.dynamic_slice_in_dim(pos_table, offset, s_local, axis=0)
-            x = x + pos.astype(self.dtype)[None]
+            if self.decode and self.slot_decode:
+                # per-row gather: row i reads the table at its own depth
+                # (jnp.take clamps out-of-range rows — harmless, attention
+                # NaN-poisons those rows anyway)
+                rows = offset[:, None] + jnp.arange(s_local)  # [B, S]
+                pos = jnp.take(pos_table, rows, axis=0)       # [B, S, hidden]
+                x = x + pos.astype(self.dtype)
+            else:
+                pos = lax.dynamic_slice_in_dim(pos_table, offset, s_local,
+                                               axis=0)
+                x = x + pos.astype(self.dtype)[None]
             positions = None
         else:
             # RoPE: absolute positions feed the per-layer q/k rotation; no
             # table, no additive embedding. Works unchanged under SP (offset
             # = shard_index * s_local, K rotated before the ring) and decode
-            # (offset = tokens already written to the cache).
-            positions = offset + jnp.arange(s_local)
+            # (offset = tokens already written to the cache; [B]-shaped in
+            # slot mode, giving [B, S] per-row positions).
+            if self.decode and self.slot_decode:
+                positions = offset[:, None] + jnp.arange(s_local)
+            else:
+                positions = offset + jnp.arange(s_local)
         if self.remat not in ("none", "full", "dots"):
             raise ValueError(f"unknown remat {self.remat!r}; use 'none', "
                              f"'full' or 'dots'")
@@ -333,6 +383,7 @@ class TransformerLM(nn.Module):
             x = Block(self.num_heads, self.mlp_dim, self.dropout,
                       self.dtype, None if self.decode else self.seq_axis,
                       self.decode, self.max_len,
+                      slot_decode=self.slot_decode,
                       num_experts=self.num_experts,
                       expert_axis=None if self.decode else self.expert_axis,
                       capacity_factor=self.capacity_factor,
@@ -380,9 +431,25 @@ def init_cache(decode_model: TransformerLM, batch: int):
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
 
+def set_cache_lengths(cache, length):
+    """Rewrite every per-layer ``cache_index`` and the top-level ``pos_index``
+    in a decode cache to ``length`` (broadcast to the leaf's shape). Used by
+    padded-bucket prefill: the prompt is right-padded to a bucket, prefilled
+    in one forward, then the indices snap back to the TRUE length so decode
+    overwrites the pad garbage row by row (never attends it — positions past
+    a query are causally masked, and the row at the write position is
+    replaced before attention runs)."""
+    def fix(path, leaf):
+        name = getattr(path[-1], "key", None) if path else None
+        if name in ("cache_index", "pos_index"):
+            return jnp.full(leaf.shape, length, leaf.dtype)
+        return leaf
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
 def generate(model: TransformerLM, params, prompt, num_steps: int,
              rng: jax.Array | None = None, temperature: float = 0.0,
-             top_k: int = 0, top_p: float = 0.0):
+             top_k: int = 0, top_p: float = 0.0, prompt_len=None):
     """Autoregressive continuation via the KV-cached decode path.
 
     ``prompt`` is int32 ``[B, P]``; returns ``[B, num_steps]`` continuation
@@ -393,10 +460,17 @@ def generate(model: TransformerLM, params, prompt, num_steps: int,
     must fit ``model.max_len``. Prefill is one batched causal forward (bulk
     K/V cache write); decode is a ``lax.scan`` with O(1) per-token cost
     against the static-shape cache — the whole thing jits to one XLA program.
+
+    ``prompt_len`` (optional, may be a traced scalar): the TRUE shared prompt
+    length when ``prompt`` is right-padded to a shape bucket — continuation
+    starts after position ``prompt_len - 1`` and decode overwrites the pad
+    region. This is what lets callers jit one program per bucket instead of
+    one per prompt length (:class:`ddw_tpu.serving.LMPackagedModel`).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, plen = prompt.shape
-    if plen + num_steps > model.max_len:
+    if plen > model.max_len or (
+            prompt_len is None and plen + num_steps > model.max_len):
         raise ValueError(f"prompt {plen} + steps {num_steps} exceeds "
                          f"max_len {model.max_len}")
     if temperature < 0.0:
@@ -420,7 +494,14 @@ def generate(model: TransformerLM, params, prompt, num_steps: int,
 
     # Prefill: one batched causal forward writes the prompt's K/V in bulk.
     cache, prefill_logits = run(cache, prompt)
-    last_logits = prefill_logits[:, -1]
+    if prompt_len is None:
+        last_logits = prefill_logits[:, -1]
+    else:
+        # padded-bucket prefill: continue from the last REAL token and snap
+        # the cache indices back so decode overwrites the pad region
+        last_logits = jnp.take(prefill_logits,
+                               jnp.asarray(prompt_len) - 1, axis=1)
+        cache = set_cache_lengths(cache, jnp.asarray(prompt_len, jnp.int32))
 
     def pick(logits, key):
         if temperature == 0.0:

@@ -27,12 +27,13 @@ import jax.numpy as jnp
 
 def rope_angles(positions: jnp.ndarray, head_dim: int,
                 theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(cos, sin) tables for integer ``positions [S]`` -> ``[S, hd/2]``."""
+    """(cos, sin) tables for integer ``positions [S]`` -> ``[S, hd/2]``
+    (leading axes pass through: ``[B, S]`` -> ``[B, S, hd/2]``)."""
     if head_dim % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
     inv_freq = 1.0 / (theta ** (
         jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(ang), jnp.sin(ang)
 
 
@@ -40,21 +41,26 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, *, seq_axis: int = -2,
                theta: float = 10000.0) -> jnp.ndarray:
     """Rotate ``x`` by its positions. The last axis is the head dim;
     ``seq_axis`` is where S lives (``-2`` for ``[B, H, S, hd]``, ``1`` for
-    the pre-transpose ``[B, S, H, hd]`` projection layout). Returns the same
+    the pre-transpose ``[B, S, H, hd]`` projection layout). ``positions`` is
+    ``[S]`` (shared across the batch) or ``[B, S]`` (per-row positions — the
+    serving slot pool decodes rows at independent depths). Returns the same
     dtype as ``x``."""
     hd = x.shape[-1]
     axis = seq_axis % x.ndim
     if axis == x.ndim - 1:
         raise ValueError("seq_axis cannot be the head dim")
     s = x.shape[axis]
-    if positions.shape != (s,):
+    if positions.shape not in ((s,), (x.shape[0], s)):
         raise ValueError(f"positions {positions.shape} must match seq dim "
-                         f"{s} (axis {seq_axis})")
+                         f"{s} (axis {seq_axis}) or be [batch, {s}]")
     cos, sin = rope_angles(positions, hd, theta)
     # broadcast cos/sin to x's layout: S at `axis`, hd/2 at the last axis
+    # (and B leading when positions are per-row)
     bshape = [1] * x.ndim
     bshape[axis] = s
     bshape[-1] = hd // 2
+    if positions.ndim == 2:
+        bshape[0] = x.shape[0]
     cos = cos.reshape(bshape)
     sin = sin.reshape(bshape)
     x32 = x.astype(jnp.float32)

@@ -78,6 +78,11 @@ def main() -> int:
             sys.stderr.write(tb)
             return EXIT_COORD_BIND
         raise
+    # jax.distributed's preemption notifier replaces the SIGTERM disposition
+    # during initialize; re-route it to the graceful-preemption flag — the
+    # launcher's gang-wide broadcast must reach the step loop, not XLA's
+    # notifier.
+    install_preemption_handler()
     with open(payload_path, "rb") as f:
         fn_spec, args, kwargs = pickle.load(f)
     kind, blob, qualname = fn_spec
@@ -102,7 +107,17 @@ def main() -> int:
         # budget.
         status = ("preempted", {"step": e.step})
     except Exception:
-        status = ("error", traceback.format_exc())
+        from ddw_tpu.runtime.faults import preemption_requested
+
+        if preemption_requested():
+            # SIGTERM already arrived (the launcher forwards it gang-wide on
+            # the first EXIT_PREEMPTED): this exception is almost certainly
+            # the collateral collective error of a preempting peer, not an
+            # application bug — exit as preempted so the restart stays
+            # outside the crash budget.
+            status = ("preempted", {"step": None})
+        else:
+            status = ("error", traceback.format_exc())
     if is_coordinator():
         _write_result(result_path, status)
     if status[0] == "ok":

@@ -29,10 +29,12 @@ from __future__ import annotations
 
 import os
 import pickle
+import signal
 import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from typing import Any, Callable
 
@@ -51,9 +53,11 @@ class GangError(RuntimeError):
 
     ``kind``: ``"crash"`` (a worker exited nonzero), ``"deadline"`` (shared
     gang deadline exceeded), ``"coord-bind"`` (the coordinator lost the
-    spawn-time port race, retried ``spawn_retries`` times), or
+    spawn-time port race, retried ``spawn_retries`` times),
     ``"result-missing"`` (every worker exited 0 but rank 0 never wrote a
-    readable result — a silent early exit). ``exit_codes`` is per-rank
+    readable result — a silent early exit), or ``"preempted"`` (a rank left
+    ``EXIT_PREEMPTED`` and the rest of the gang was SIGTERM-forwarded and
+    drained within the grace window). ``exit_codes`` is per-rank
     (``None`` = still running when the gang was killed); ``rank0_traceback``
     is rank 0's formatted traceback when it got far enough to report one.
     """
@@ -85,10 +89,21 @@ class Launcher:
     process's devices). ``np>=1``: spawn ``np`` python processes on this machine,
     each with ``devices_per_proc`` forced-host CPU devices, rendezvous via a local
     coordinator, run ``fn`` everywhere, return rank-0's return value.
+
+    Preemption propagation: the moment ANY rank exits ``EXIT_PREEMPTED`` the
+    launcher forwards SIGTERM to every still-running rank and waits up to
+    ``preempt_grace_s`` for them to checkpoint and leave on their own —
+    peers stop dying as collective-error collateral with no chance to act on
+    the preemption. ``forward_sigterm=True`` additionally routes a SIGTERM
+    delivered to the DRIVER (the cluster-manager preemption of the whole
+    allocation) to the gang via :meth:`broadcast_preemption`, so every rank
+    sees the flag while still running, not after its peers vanished.
     """
 
     def __init__(self, np: int = -1, devices_per_proc: int = 1,
-                 timeout_s: float = 600.0, spawn_retries: int = 3):
+                 timeout_s: float = 600.0, spawn_retries: int = 3,
+                 preempt_grace_s: float = 10.0,
+                 forward_sigterm: bool = False):
         self.np = np
         self.devices_per_proc = devices_per_proc
         self.timeout_s = timeout_s
@@ -97,6 +112,26 @@ class Launcher:
         # spawn time can be taken before jax.distributed binds it.
         self.spawn_retries = max(1, spawn_retries)
         self.last_spawn_attempts = 0  # spawns used by the last _run_multiproc
+        self.preempt_grace_s = preempt_grace_s
+        self.forward_sigterm = forward_sigterm
+        self._procs: list = []        # live gang (broadcast target)
+        self._procs_lock = threading.Lock()
+
+    def broadcast_preemption(self) -> int:
+        """Send SIGTERM to every still-running rank of the live gang (the
+        workers' installed handler turns it into the graceful-preemption
+        flag). Thread-safe; callable from a driver signal handler or a
+        cluster-integration hook. Returns how many ranks were signalled."""
+        n = 0
+        with self._procs_lock:
+            for p in self._procs:
+                if p.poll() is None:
+                    try:
+                        p.send_signal(signal.SIGTERM)
+                        n += 1
+                    except OSError:
+                        pass  # exited between poll and signal
+        return n
 
     def run(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         if self.np == -1:
@@ -165,6 +200,17 @@ class Launcher:
                 stderr=None,
             )
             procs.append(p)
+        with self._procs_lock:
+            self._procs = procs
+        prev_handler = None
+        if self.forward_sigterm and \
+                threading.current_thread() is threading.main_thread():
+            # Cluster-manager preemption arrives at the DRIVER: forward it to
+            # the gang so every rank checkpoints gracefully instead of dying
+            # as collateral when the first peer leaves a collective.
+            prev_handler = signal.signal(
+                signal.SIGTERM,
+                lambda _sig, _frame: self.broadcast_preemption())
         try:
             # Failure detection (SURVEY §5): poll the whole gang and kill
             # everyone the moment ANY rank dies abnormally — a crashed rank
@@ -172,13 +218,19 @@ class Launcher:
             # deadline (the Spark-barrier all-or-nothing semantics the
             # reference relies on, 03_model_training_distributed.py:256).
             # One shared deadline for the whole gang (not np * timeout).
+            # EXCEPTION: a rank that exited EXIT_PREEMPTED checkpointed and
+            # left deliberately — instead of killing its peers, forward the
+            # SIGTERM to them and give them preempt_grace_s to checkpoint
+            # and exit on their own (ranks wedged inside a collective are
+            # killed when the grace runs out).
             deadline = time.monotonic() + self.timeout_s
+            grace_end: float | None = None
             codes: list[int | None] = [None] * self.np
             while any(c is None for c in codes):
                 for i, p in enumerate(procs):
                     if codes[i] is None:
                         codes[i] = p.poll()
-                if any(c not in (None, 0) for c in codes):
+                if any(c not in (None, 0, EXIT_PREEMPTED) for c in codes):
                     for p in procs:
                         if p.poll() is None:
                             p.kill()
@@ -190,6 +242,18 @@ class Launcher:
                         f"worker crashed (exit codes {codes}); gang killed"
                         + suffix,
                         kind=kind, exit_codes=codes, rank0_traceback=tb)
+                if EXIT_PREEMPTED in codes:
+                    if grace_end is None:
+                        grace_end = min(deadline,
+                                        time.monotonic()
+                                        + self.preempt_grace_s)
+                        self.broadcast_preemption()
+                    if time.monotonic() > grace_end:
+                        for p in procs:
+                            if p.poll() is None:
+                                p.kill()
+                        codes = [p.wait() for p in procs]
+                        break
                 if time.monotonic() > deadline:
                     raise GangError(
                         f"gang deadline ({self.timeout_s}s) exceeded; "
@@ -197,7 +261,16 @@ class Launcher:
                         kind="deadline", exit_codes=codes)
                 if any(c is None for c in codes):
                     time.sleep(0.05)
+            if EXIT_PREEMPTED in codes:
+                raise GangError(
+                    f"gang preempted (exit codes {codes}); SIGTERM was "
+                    f"forwarded to all ranks",
+                    kind="preempted", exit_codes=codes)
         finally:
+            if prev_handler is not None:
+                signal.signal(signal.SIGTERM, prev_handler)
+            with self._procs_lock:
+                self._procs = []
             for p in procs:
                 if p.poll() is None:
                     p.kill()

@@ -107,18 +107,27 @@ class GangSupervisor:
 
     With an ``np=-1`` launcher the fn runs in-process exactly once —
     restarting the surrounding process is not the supervisor's to do.
+
+    ``tracker_run`` (a :class:`ddw_tpu.tracking.tracker.Run`) makes the
+    recovery story a first-class tracked artifact: whatever the outcome,
+    the supervisor logs per-attempt metrics (``supervisor.attempt_*`` series
+    indexed by generation), the restart/preemption totals, an ``outcome``
+    tag, and a ``supervisor_attempts.json`` forensic artifact — so "how
+    often did this job die and why" is queryable next to its loss curves
+    instead of buried in driver logs.
     """
 
     def __init__(self, launcher: Launcher, max_restarts: int = 2,
                  max_preemption_restarts: int = 8,
                  backoff_base_s: float = 1.0, backoff_max_s: float = 30.0,
-                 jitter: float = 0.25):
+                 jitter: float = 0.25, tracker_run=None):
         self.launcher = launcher
         self.max_restarts = max_restarts
         self.max_preemption_restarts = max_preemption_restarts
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self.jitter = jitter
+        self.tracker_run = tracker_run
         self.attempts: list[AttemptReport] = []  # failed attempts, last run()
         self.generations = 0                     # gangs launched, last run()
 
@@ -129,31 +138,75 @@ class GangSupervisor:
         self.attempts = []
         crash_restarts = preempt_restarts = 0
         gen = 0
-        while True:
-            self.generations = gen + 1
-            t0 = time.monotonic()
-            try:
-                return self.launcher._run_multiproc(
-                    fn, args, kwargs,
-                    extra_env={"DDW_RESTART_GEN": str(gen)})
-            except GangError as e:
-                kind = "preempted" if e.is_preemption else e.kind
-                self.attempts.append(AttemptReport(
-                    generation=gen, kind=kind, exit_codes=e.exit_codes,
-                    rank0_traceback=e.rank0_traceback,
-                    elapsed_s=time.monotonic() - t0))
-                if kind == "preempted":
-                    preempt_restarts += 1
-                    if preempt_restarts > self.max_preemption_restarts:
-                        raise GangFailure(self.attempts,
-                                          self.max_restarts) from e
-                else:
-                    crash_restarts += 1
-                    if crash_restarts > self.max_restarts:
-                        raise GangFailure(self.attempts,
-                                          self.max_restarts) from e
-            self._backoff(crash_restarts + preempt_restarts)
-            gen += 1
+        try:
+            while True:
+                self.generations = gen + 1
+                t0 = time.monotonic()
+                try:
+                    value = self.launcher._run_multiproc(
+                        fn, args, kwargs,
+                        extra_env={"DDW_RESTART_GEN": str(gen)})
+                    self._report("completed", crash_restarts,
+                                 preempt_restarts)
+                    return value
+                except GangError as e:
+                    kind = "preempted" if e.is_preemption else e.kind
+                    self.attempts.append(AttemptReport(
+                        generation=gen, kind=kind, exit_codes=e.exit_codes,
+                        rank0_traceback=e.rank0_traceback,
+                        elapsed_s=time.monotonic() - t0))
+                    if kind == "preempted":
+                        preempt_restarts += 1
+                        if preempt_restarts > self.max_preemption_restarts:
+                            raise GangFailure(self.attempts,
+                                              self.max_restarts) from e
+                    else:
+                        crash_restarts += 1
+                        if crash_restarts > self.max_restarts:
+                            raise GangFailure(self.attempts,
+                                              self.max_restarts) from e
+                self._backoff(crash_restarts + preempt_restarts)
+                gen += 1
+        except GangFailure:
+            self._report("failed", crash_restarts, preempt_restarts)
+            raise
+
+    def _report(self, outcome: str, crash_restarts: int,
+                preempt_restarts: int) -> None:
+        """Surface the attempt record into the tracker run (no-op without
+        one; never takes the job down — the record is observability)."""
+        run = self.tracker_run
+        if run is None:
+            return
+        try:
+            run.log_metrics({
+                "supervisor.generations": float(self.generations),
+                "supervisor.failed_attempts": float(len(self.attempts)),
+                "supervisor.crash_restarts": float(crash_restarts),
+                "supervisor.preemption_restarts": float(preempt_restarts),
+            })
+            for a in self.attempts:
+                run.log_metric("supervisor.attempt_elapsed_s", a.elapsed_s,
+                               step=a.generation)
+                run.log_metric(
+                    "supervisor.attempt_preempted",
+                    1.0 if a.kind == "preempted" else 0.0,
+                    step=a.generation)
+            run.set_tags({"supervisor.outcome": outcome})
+            import json
+
+            art = run.artifact_dir("supervisor")
+            with open(os.path.join(art, "supervisor_attempts.json"),
+                      "w") as f:
+                json.dump({"outcome": outcome,
+                           "max_restarts": self.max_restarts,
+                           "max_preemption_restarts":
+                               self.max_preemption_restarts,
+                           "attempts": [dataclasses.asdict(a)
+                                        for a in self.attempts]},
+                          f, indent=2, default=str)
+        except Exception:
+            pass
 
     def _backoff(self, nth_restart: int) -> None:
         delay = min(self.backoff_max_s,

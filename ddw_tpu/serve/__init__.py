@@ -1,0 +1,22 @@
+"""Online serving engine — continuous-batching inference (docs/serving.md)."""
+
+from ddw_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    DeadlineExceeded,
+    Overloaded,
+    Rejected,
+)
+from ddw_tpu.serve.bucketing import (  # noqa: F401
+    batch_bucket,
+    bucket_len,
+    length_buckets,
+    pad_to_bucket,
+)
+from ddw_tpu.serve.engine import (  # noqa: F401
+    EngineCfg,
+    GenerateResult,
+    PredictResult,
+    ServingEngine,
+)
+from ddw_tpu.serve.metrics import EngineMetrics, RequestRecord  # noqa: F401
+from ddw_tpu.serve.slots import SlotPool  # noqa: F401

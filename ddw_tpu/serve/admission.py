@@ -1,0 +1,146 @@
+"""Admission control — bounded queues, deadlines, structured load shedding.
+
+An online engine under overload has exactly three honest options: queue
+(bounded — an unbounded queue converts overload into unbounded latency),
+refuse at the door (backpressure the caller can act on), or shed work whose
+deadline already passed (device time spent on an answer nobody is waiting
+for is stolen from requests that could still make their SLO). This module
+implements all three as data, not policy buried in the engine loop:
+
+- :class:`AdmissionController` holds one bounded FIFO per request kind;
+  ``offer`` refuses with a structured :class:`Overloaded` (capacity, depth,
+  ``retry_after_ms``) the moment the queue is full — submission never
+  blocks and never hangs;
+- every queued request carries an absolute ``deadline``; ``take`` pops in
+  arrival order but splits expired requests out BEFORE any device work is
+  spent on them, so the engine completes them with
+  :class:`DeadlineExceeded` instead of prefilling a corpse.
+
+Both reply types are exceptions (a future can carry them) AND structured
+records (``to_dict``) so a transport layer can serialize the reply without
+parsing message strings — the same discipline as
+:class:`ddw_tpu.runtime.launcher.GangError`.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+
+class Rejected(RuntimeError):
+    """Base of the structured serving refusals."""
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+
+class Overloaded(Rejected):
+    """Queue full at submission time — backpressure, not a hang. Carries
+    what a client-side retry policy needs: the configured capacity, the
+    depth observed, and a crude ``retry_after_ms`` hint (current depth times
+    the recent per-request service estimate, when known)."""
+
+    def __init__(self, kind: str, capacity: int, depth: int,
+                 retry_after_ms: float | None = None):
+        self.kind = kind
+        self.capacity = capacity
+        self.depth = depth
+        self.retry_after_ms = retry_after_ms
+        hint = (f"; retry in ~{retry_after_ms:.0f} ms"
+                if retry_after_ms else "")
+        super().__init__(
+            f"{kind} queue full ({depth}/{capacity}); request refused{hint}")
+
+    def to_dict(self) -> dict:
+        return {"error": "overloaded", "kind": self.kind,
+                "capacity": self.capacity, "depth": self.depth,
+                "retry_after_ms": self.retry_after_ms}
+
+
+class DeadlineExceeded(Rejected):
+    """The request's deadline passed while it was still queued — shed
+    before any device work was spent on it."""
+
+    def __init__(self, kind: str, waited_ms: float, timeout_ms: float):
+        self.kind = kind
+        self.waited_ms = waited_ms
+        self.timeout_ms = timeout_ms
+        super().__init__(f"{kind} request shed after {waited_ms:.0f} ms in "
+                         f"queue (deadline {timeout_ms:.0f} ms)")
+
+    def to_dict(self) -> dict:
+        return {"error": "deadline_exceeded", "kind": self.kind,
+                "waited_ms": self.waited_ms, "timeout_ms": self.timeout_ms}
+
+
+class AdmissionController:
+    """Bounded per-kind FIFOs with deadline-aware dequeue. Thread-safe:
+    callers submit from any thread; the engine loop drains from one."""
+
+    def __init__(self, capacity: int, clock=time.monotonic):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._clock = clock
+        self._queues: dict[str, collections.deque] = {}
+        self._lock = threading.Lock()
+
+    def depth(self, kind: str | None = None) -> int:
+        with self._lock:
+            if kind is not None:
+                return len(self._queues.get(kind, ()))
+            return sum(len(q) for q in self._queues.values())
+
+    def oldest_wait_s(self, kind: str) -> float | None:
+        """How long the head-of-line request has been queued (None when
+        empty) — the dynamic batcher's flush trigger."""
+        with self._lock:
+            q = self._queues.get(kind)
+            if not q:
+                return None
+            return self._clock() - q[0].times.submitted
+
+    def offer(self, kind: str, request,
+              retry_after_ms: float | None = None) -> None:
+        """Enqueue or raise :class:`Overloaded`. The capacity bound is
+        per-kind (an LM burst must not starve image admission)."""
+        with self._lock:
+            q = self._queues.setdefault(kind, collections.deque())
+            if len(q) >= self.capacity:
+                raise Overloaded(kind, self.capacity, len(q), retry_after_ms)
+            q.append(request)
+
+    def take(self, kind: str, max_n: int) -> tuple[list, list]:
+        """Pop up to ``max_n`` live requests in arrival order. Returns
+        ``(admitted, expired)`` — expired requests (deadline already past)
+        do not count against ``max_n`` and must be completed with
+        :class:`DeadlineExceeded` by the caller, never run."""
+        admitted, expired = [], []
+        now = self._clock()
+        with self._lock:
+            q = self._queues.get(kind)
+            while q and len(admitted) < max_n:
+                req = q.popleft()
+                if req.deadline is not None and now > req.deadline:
+                    expired.append(req)
+                else:
+                    admitted.append(req)
+        return admitted, expired
+
+    def shed_expired(self, kind: str) -> list:
+        """Remove every already-expired request from the queue (in place,
+        order preserved for the rest)."""
+        now = self._clock()
+        expired = []
+        with self._lock:
+            q = self._queues.get(kind)
+            if q:
+                live = [r for r in q
+                        if not (r.deadline is not None and now > r.deadline)]
+                expired = [r for r in q
+                           if r.deadline is not None and now > r.deadline]
+                q.clear()
+                q.extend(live)
+        return expired

@@ -1,0 +1,494 @@
+"""Online serving engine — request queue, dynamic batching, slot decode.
+
+Everything under ``ddw_tpu/serving/`` is offline: the batch scorers walk
+static tables, and ``LMPackagedModel.generate`` serves exactly one request
+at a time. This module is the online half of the capability table — an
+in-process engine that admits concurrent image and LM requests and keeps
+the device busy with a small, fixed set of compiled programs:
+
+- **LM**: continuous batching over a :class:`~ddw_tpu.serve.slots.SlotPool`.
+  New requests prefill into a free slot the moment one exists (bucketed
+  prompt lengths — one program per bucket); every engine tick advances ALL
+  active slots ``steps_per_tick`` tokens in one chained, donated dispatch;
+  finished sequences evict without stalling their neighbors. Outputs are
+  token-identical to the sequential ``generate`` path for any admission
+  interleaving (pinned by tests/test_serve_engine.py).
+- **image**: classic dynamic batching — requests coalesce until
+  ``max_batch`` are waiting or the oldest has waited ``max_wait_ms``, the
+  batch pads to a power-of-two bucket, and one jitted apply serves it.
+- **admission** (:mod:`ddw_tpu.serve.admission`): bounded queues refuse
+  over-capacity submissions with a structured ``Overloaded`` reply, and
+  deadline-expired requests are shed before any device work is spent.
+- **metrics** (:mod:`ddw_tpu.serve.metrics`): queue time, TTFT, tokens/sec
+  and latency tails per request, exportable into a ``tracking.Run`` (with
+  ``utils.sysmon.SystemMonitor`` sampling utilization alongside) so serving
+  runs are first-class tracked artifacts.
+
+The engine is in-process by design — the same shape as the rest of the
+stack (the Launcher's np=-1 mode, the in-tree tracker): a transport layer
+in front of it is somebody else's concern; everything behind the socket is
+here. Engine sampling supports per-request temperature; ``top_k``/``top_p``
+remain single-request-path features (``LMPackagedModel.generate``).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import threading
+import time
+
+import jax
+import numpy as np
+
+from ddw_tpu.serve.admission import (AdmissionController, DeadlineExceeded,
+                                     Overloaded)
+from ddw_tpu.serve.bucketing import (batch_bucket, bucket_len, pad_to_bucket)
+from ddw_tpu.serve.metrics import EngineMetrics, RequestRecord
+from ddw_tpu.serve.slots import SlotPool
+
+__all__ = ["EngineCfg", "ServingEngine", "GenerateResult", "PredictResult",
+           "Overloaded", "DeadlineExceeded"]
+
+
+@dataclasses.dataclass
+class EngineCfg:
+    """Batching / admission policy knobs."""
+
+    n_slots: int = 8            # concurrent LM sequences on device
+    steps_per_tick: int = 4     # decode chain length per dispatch (the
+                                # steps_per_dispatch of serving; raises
+                                # throughput, bounds added TTFT for requests
+                                # arriving mid-chain)
+    max_batch: int = 8          # image dynamic-batch cap
+    max_wait_ms: float = 2.0    # image batch formation window
+    queue_depth: int = 64       # bounded admission queue per request kind
+    default_timeout_s: float = 30.0
+    min_bucket: int = 8         # smallest prompt-length bucket
+    donate: bool = True         # donate the pool cache through decode ticks
+
+
+@dataclasses.dataclass
+class GenerateResult:
+    """Completed LM request: tokens + its own SLO numbers."""
+
+    tokens: np.ndarray          # [num_steps] int32
+    queue_ms: float
+    ttft_ms: float
+    total_ms: float
+    tokens_per_sec: float
+
+
+@dataclasses.dataclass
+class PredictResult:
+    """Completed image request."""
+
+    logits: np.ndarray          # [num_classes] f32
+    label: str
+    index: int
+    queue_ms: float
+    total_ms: float
+
+
+class _Times:
+    __slots__ = ("submitted", "admitted", "first_output", "done")
+
+    def __init__(self, submitted: float):
+        self.submitted = submitted
+        self.admitted = self.first_output = self.done = submitted
+
+
+class _LMRequest:
+    __slots__ = ("prompt", "num_steps", "temperature", "keys", "deadline",
+                 "future", "times", "tokens", "emitted")
+
+    def __init__(self, prompt, num_steps, temperature, keys, deadline, now):
+        self.prompt = prompt
+        self.num_steps = num_steps
+        self.temperature = temperature
+        self.keys = keys            # [num_steps, 2] uint32 or None (greedy)
+        self.deadline = deadline
+        self.future = concurrent.futures.Future()
+        self.times = _Times(now)
+        self.tokens: list[int] = []
+        self.emitted = 0
+
+
+class _ImageRequest:
+    __slots__ = ("image", "deadline", "future", "times")
+
+    def __init__(self, image, deadline, now):
+        self.image = image
+        self.deadline = deadline
+        self.future = concurrent.futures.Future()
+        self.times = _Times(now)
+
+
+class ServingEngine:
+    """In-process online inference engine over packaged models.
+
+    ``lm`` / ``image`` accept a packaged model (anything with an
+    ``engine_handle()``) or the handle itself; at least one is required.
+    With ``run`` set, SLO metrics land in the tracker on :meth:`stop` and a
+    :class:`~ddw_tpu.utils.sysmon.SystemMonitor` samples utilization while
+    the engine is live (``monitor_interval_s > 0``).
+    """
+
+    def __init__(self, lm=None, image=None, cfg: EngineCfg | None = None,
+                 run=None, monitor_interval_s: float = 0.0):
+        if lm is None and image is None:
+            raise ValueError("engine needs an lm and/or image model")
+        self.cfg = cfg or EngineCfg()
+        self.run = run
+        self.metrics = EngineMetrics()
+        self._ctrl = AdmissionController(self.cfg.queue_depth)
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._monitor = None
+        self._monitor_interval_s = monitor_interval_s
+        self._service_ms = 0.0      # decaying per-request service estimate
+
+        self._lm = lm.engine_handle() if hasattr(lm, "engine_handle") else lm
+        if self._lm is not None:
+            self.pool = SlotPool(self._lm.model, self._lm.params,
+                                 self.cfg.n_slots,
+                                 steps_per_tick=self.cfg.steps_per_tick,
+                                 donate=self.cfg.donate)
+            n = self.cfg.n_slots
+            self._slot_req: dict[int, _LMRequest] = {}
+            self._cur = np.zeros((n,), np.int32)
+            self._temps = np.zeros((n,), np.float32)
+        else:
+            self.pool = None
+
+        self._image = (image.engine_handle()
+                       if hasattr(image, "engine_handle") else image)
+        if self._image is not None:
+            h = self._image
+
+            def make_apply():
+                variables = {"params": h.params}
+                if h.batch_stats:
+                    variables["batch_stats"] = h.batch_stats
+                return jax.jit(
+                    lambda imgs: h.model.apply(variables, imgs, train=False))
+
+            self._image_apply = make_apply()  # one callable; jit caches per
+            #                                   padded batch-bucket shape
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="ddw-serve", daemon=True)
+            self._thread.start()
+            if self.run is not None and self._monitor_interval_s > 0:
+                from ddw_tpu.utils.sysmon import SystemMonitor
+
+                self._monitor = SystemMonitor(
+                    self.run, interval_s=self._monitor_interval_s).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+            self._thread = None
+        self._fail_pending(RuntimeError("engine stopped"))
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
+        if self.run is not None:
+            self.metrics.log_to(self.run)
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission (any thread) -------------------------------------------
+    def submit_generate(self, prompt, num_steps: int,
+                        temperature: float = 0.0, rng=None,
+                        timeout_s: float | None = None
+                        ) -> concurrent.futures.Future:
+        """Queue one LM continuation; returns a future resolving to a
+        :class:`GenerateResult` (or raising ``Overloaded`` here /
+        ``DeadlineExceeded`` on the future). ``prompt`` is 1-D ``[P]`` or
+        ``[1, P]`` int tokens; greedy at ``temperature == 0``."""
+        if self._lm is None:
+            raise ValueError("engine was built without an LM model")
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(f"prompt must be [P] or [1, P] tokens, got "
+                             f"shape {prompt.shape}")
+        from ddw_tpu.serving.lm_package import check_token_ids
+
+        check_token_ids(prompt, self._lm.cfg.vocab_size)
+        if num_steps < 1:
+            raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+        if prompt.size + num_steps > self._lm.cfg.max_len:
+            raise ValueError(
+                f"prompt {prompt.size} + steps {num_steps} exceeds max_len "
+                f"{self._lm.cfg.max_len}")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) requires rng")
+        keys = None
+        if temperature > 0.0:
+            # same per-step key schedule as models/lm.generate: token i is
+            # picked with split(rng)[i]
+            keys = np.asarray(jax.random.split(rng, num_steps))
+        now = time.monotonic()
+        timeout = self.cfg.default_timeout_s if timeout_s is None else timeout_s
+        req = _LMRequest(prompt, num_steps, float(temperature), keys,
+                         now + timeout if timeout else None, now)
+        self._offer("lm", req)
+        return req.future
+
+    def generate(self, prompt, num_steps: int, **kw) -> GenerateResult:
+        """Synchronous :meth:`submit_generate`."""
+        return self.submit_generate(prompt, num_steps, **kw).result()
+
+    def submit_predict(self, item, timeout_s: float | None = None
+                       ) -> concurrent.futures.Future:
+        """Queue one image prediction (JPEG bytes, file path, or decoded
+        ``[H, W, 3]`` float array); future resolves to
+        :class:`PredictResult`."""
+        if self._image is None:
+            raise ValueError("engine was built without an image model")
+        image = self._image.decode_one(item)
+        now = time.monotonic()
+        timeout = self.cfg.default_timeout_s if timeout_s is None else timeout_s
+        req = _ImageRequest(np.asarray(image, np.float32),
+                            now + timeout if timeout else None, now)
+        self._offer("image", req)
+        return req.future
+
+    def predict(self, items, timeout_s: float | None = None
+                ) -> list[PredictResult]:
+        futures = [self.submit_predict(x, timeout_s=timeout_s) for x in items]
+        return [f.result() for f in futures]
+
+    def warmup(self, prompt_lens=(8,)) -> None:
+        """Precompile every program the given traffic shape needs (prefill
+        per bucket x group size, the decode chain, the image batch buckets)
+        so no live request pays XLA compile time. Call before submitting —
+        it drives the device from the caller's thread."""
+        if self.pool is not None:
+            self.pool.warmup([bucket_len(n, self._lm.cfg.max_len,
+                                         self.cfg.min_bucket)
+                              for n in prompt_lens])
+        if self._image is not None:
+            h = self._image
+            sizes, g = [], 1
+            while g < self.cfg.max_batch:
+                sizes.append(g)
+                g *= 2
+            sizes.append(self.cfg.max_batch)
+            for g in sizes:
+                self._image_apply(
+                    np.zeros((g, h.height, h.width, 3), np.float32))
+
+    def snapshot(self) -> dict[str, float]:
+        return self.metrics.snapshot()
+
+    # -- internals ----------------------------------------------------------
+    def _offer(self, kind: str, req) -> None:
+        try:
+            self._ctrl.offer(kind, req, retry_after_ms=(
+                self._service_ms * (self._ctrl.depth(kind) + 1)
+                if self._service_ms else None))
+        except Overloaded:
+            self.metrics.count_overloaded()
+            raise
+        with self._cv:
+            self._cv.notify_all()
+
+    def _fail_pending(self, exc: Exception) -> None:
+        for kind in ("lm", "image"):
+            drained, expired = self._ctrl.take(kind, self._ctrl.capacity)
+            for req in drained + expired:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+        if self.pool is not None:
+            for req in self._slot_req.values():
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            self._slot_req.clear()
+
+    def _shed(self, req, kind: str) -> None:
+        self.metrics.count_deadline()
+        waited = (time.monotonic() - req.times.submitted) * 1e3
+        timeout = ((req.deadline - req.times.submitted) * 1e3
+                   if req.deadline is not None else float("inf"))
+        req.future.set_exception(DeadlineExceeded(kind, waited, timeout))
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                worked = False
+                for kind in ("lm", "image"):
+                    for req in self._ctrl.shed_expired(kind):
+                        self._shed(req, kind)
+                        worked = True
+                if self.pool is not None:
+                    worked |= self._admit_lm()
+                    worked |= self._decode_tick()
+                if self._image is not None:
+                    worked |= self._image_tick()
+                if not worked:
+                    with self._cv:
+                        if not self._stop.is_set():
+                            self._cv.wait(timeout=max(
+                                self.cfg.max_wait_ms, 1.0) / 1e3)
+        except BaseException as e:  # an engine bug must not hang clients
+            self._fail_pending(RuntimeError(f"engine loop died: {e!r}"))
+            raise
+
+    # LM: continuous batching ------------------------------------------------
+    def _admit_lm(self) -> bool:
+        free = self.pool.free_slots
+        if free == 0:
+            return False
+        admitted, expired = self._ctrl.take("lm", free)
+        for req in expired:
+            self._shed(req, "lm")
+        if not admitted:
+            return bool(expired)
+        # group by length bucket: one prefill dispatch per group (an
+        # admission burst after a wave of evictions costs O(buckets)
+        # programs, not O(requests) round-trips on an idle pool)
+        groups: dict[int, list[_LMRequest]] = {}
+        now = time.monotonic()
+        for req in admitted:
+            req.times.admitted = now
+            bucket = bucket_len(req.prompt.size, self._lm.cfg.max_len,
+                                self.cfg.min_bucket)
+            groups.setdefault(bucket, []).append(req)
+        for bucket, reqs in groups.items():
+            g = batch_bucket(len(reqs), self.cfg.n_slots)
+            prompts = np.zeros((g, bucket), np.int32)
+            true_lens = np.ones((g,), np.int32)   # dummy rows: length 1
+            temps = np.zeros((g,), np.float32)
+            keys = np.zeros((g, 2), np.uint32)
+            for i, req in enumerate(reqs):
+                prompts[i] = pad_to_bucket(req.prompt[None, :], bucket)[0]
+                true_lens[i] = req.prompt.size
+                temps[i] = req.temperature
+                if req.keys is not None:
+                    keys[i] = req.keys[0]
+            cache_g, toks = self.pool.prefill(prompts, true_lens, temps,
+                                              keys)
+            toks = np.asarray(toks)               # fetch = the TTFT barrier
+            first = time.monotonic()
+            self.metrics.count("prefills")
+            for i, req in enumerate(reqs):
+                slot = self.pool.acquire()
+                self.pool.insert(slot, cache_g, req.prompt.size, row=i)
+                req.times.first_output = first
+                tok0 = int(toks[i])
+                req.tokens.append(tok0)
+                req.emitted = 1
+                if req.emitted >= req.num_steps:
+                    self.pool.release(slot)
+                    self._finish_lm(req)
+                else:
+                    self._slot_req[slot] = req
+                    self._cur[slot] = tok0
+                    self._temps[slot] = req.temperature
+        return True
+
+    def _decode_tick(self) -> bool:
+        if not self._slot_req:
+            return False
+        k = self.cfg.steps_per_tick
+        n = self.cfg.n_slots
+        keys = np.zeros((n, k, 2), np.uint32)
+        for slot, req in self._slot_req.items():
+            if req.keys is not None:
+                rows = req.keys[req.emitted:req.emitted + k]
+                keys[slot, :len(rows)] = rows
+        toks = self.pool.decode(self._cur, self._temps, keys)  # [S, k]
+        self.metrics.count("decode_ticks")
+        finished = []
+        for slot, req in self._slot_req.items():
+            take = min(k, req.num_steps - req.emitted)
+            req.tokens.extend(int(t) for t in toks[slot, :take])
+            req.emitted += take
+            if req.emitted >= req.num_steps:
+                finished.append(slot)
+        self._cur = toks[:, -1].astype(np.int32).copy()
+        for slot in finished:
+            req = self._slot_req.pop(slot)
+            self.pool.release(slot)
+            self._temps[slot] = 0.0
+            self._cur[slot] = 0
+            self._finish_lm(req)
+        return True
+
+    def _finish_lm(self, req: _LMRequest) -> None:
+        req.times.done = time.monotonic()
+        t = req.times
+        gen_s = max(t.done - t.first_output, 1e-9)
+        rec = RequestRecord("lm", t.submitted, t.admitted, t.first_output,
+                            t.done, tokens=req.num_steps)
+        self.metrics.record(rec)
+        self._update_service(rec.total_ms)
+        req.future.set_result(GenerateResult(
+            tokens=np.asarray(req.tokens[:req.num_steps], np.int32),
+            queue_ms=rec.queue_ms, ttft_ms=rec.ttft_ms,
+            total_ms=rec.total_ms,
+            tokens_per_sec=(req.num_steps - 1) / gen_s if req.num_steps > 1
+            else req.num_steps / max(t.done - t.submitted, 1e-9)))
+
+    # image: dynamic batching -------------------------------------------------
+    def _image_tick(self) -> bool:
+        depth = self._ctrl.depth("image")
+        if depth == 0:
+            return False
+        if depth < self.cfg.max_batch:
+            # flush only once the oldest request has waited out the window
+            waited = self._ctrl.oldest_wait_s("image")
+            if waited is None or waited * 1e3 < self.cfg.max_wait_ms:
+                return False
+        admitted, expired = self._ctrl.take("image", self.cfg.max_batch)
+        for req in expired:
+            self._shed(req, "image")
+        if not admitted:
+            return bool(expired)
+        now = time.monotonic()
+        for req in admitted:
+            req.times.admitted = now
+        imgs = np.stack([r.image for r in admitted])
+        bucket = batch_bucket(len(imgs), self.cfg.max_batch)
+        if bucket > len(imgs):
+            imgs = np.concatenate(
+                [imgs, np.zeros((bucket - len(imgs), *imgs.shape[1:]),
+                                np.float32)])
+        logits = np.asarray(self._image_apply(imgs))
+        self.metrics.count("image_batches")
+        done = time.monotonic()
+        classes = self._image.classes
+        for i, req in enumerate(admitted):
+            req.times.first_output = req.times.done = done
+            rec = RequestRecord("image", req.times.submitted,
+                                req.times.admitted, done, done)
+            self.metrics.record(rec)
+            self._update_service(rec.total_ms)
+            idx = int(np.argmax(logits[i]))
+            req.future.set_result(PredictResult(
+                logits=logits[i], label=classes[idx] if classes else str(idx),
+                index=idx, queue_ms=rec.queue_ms, total_ms=rec.total_ms))
+        return True
+
+    def _update_service(self, ms: float) -> None:
+        self._service_ms = (0.8 * self._service_ms + 0.2 * ms
+                            if self._service_ms else ms)

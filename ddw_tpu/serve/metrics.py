@@ -1,0 +1,145 @@
+"""SLO metrics for the serving engine — queue time, TTFT, latency tails.
+
+Training runs are first-class tracked artifacts (``tracking.Run`` holds the
+loss curves, ``utils.sysmon.SystemMonitor`` the utilization series); this
+module gives serving runs the same standing. The engine records one
+:class:`RequestRecord` per completed request and counters for every shed;
+:meth:`EngineMetrics.snapshot` reduces them to the numbers an SLO is
+written against — p50/p95/p99 of queue time, time-to-first-token and total
+latency, aggregate tokens/sec — and :meth:`EngineMetrics.log_to` exports
+them through a tracker run (metrics + a ``serve_requests.jsonl`` artifact
+with the raw per-request rows, so tails can be re-sliced after the fact).
+
+Percentiles interpolate (``np.percentile``) — with few samples, indexing
+``int(0.99 * n)`` lands on the max and overstates tail fidelity (the same
+rule ``tools/serving_curve.py`` applies to its p90s).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+QUANTILES = (50, 95, 99)
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One completed request, host-clock timeline in monotonic seconds."""
+
+    kind: str                  # "lm" | "image"
+    submitted: float
+    admitted: float            # dequeued and bound to device work
+    first_output: float        # first token (LM) / batch completion (image)
+    done: float
+    tokens: int = 0            # generated tokens (LM); 0 for image
+
+    @property
+    def queue_ms(self) -> float:
+        return (self.admitted - self.submitted) * 1e3
+
+    @property
+    def ttft_ms(self) -> float:
+        return (self.first_output - self.submitted) * 1e3
+
+    @property
+    def total_ms(self) -> float:
+        return (self.done - self.submitted) * 1e3
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "queue_ms": round(self.queue_ms, 3),
+                "ttft_ms": round(self.ttft_ms, 3),
+                "total_ms": round(self.total_ms, 3), "tokens": self.tokens}
+
+
+class EngineMetrics:
+    """Thread-safe accumulator: the engine loop records, any thread reads."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._records: list[RequestRecord] = []
+        self.shed_overloaded = 0
+        self.shed_deadline = 0
+        self.decode_ticks = 0      # chained decode dispatches
+        self.prefills = 0
+        self.image_batches = 0
+        self._first_admit: float | None = None
+        self._last_done: float | None = None
+
+    # -- recording (engine side) -------------------------------------------
+    def record(self, rec: RequestRecord) -> None:
+        with self._lock:
+            self._records.append(rec)
+            if self._first_admit is None or rec.admitted < self._first_admit:
+                self._first_admit = rec.admitted
+            if self._last_done is None or rec.done > self._last_done:
+                self._last_done = rec.done
+
+    def count_overloaded(self) -> None:
+        with self._lock:
+            self.shed_overloaded += 1
+
+    def count_deadline(self) -> None:
+        with self._lock:
+            self.shed_deadline += 1
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    # -- reading -----------------------------------------------------------
+    def snapshot(self) -> dict[str, float]:
+        """Flat ``serve.*`` metric dict — the SLO view. Keys are stable;
+        latency keys appear only once at least one request completed."""
+        with self._lock:
+            recs = list(self._records)
+            out: dict[str, float] = {
+                "serve.completed": float(len(recs)),
+                "serve.shed_overloaded": float(self.shed_overloaded),
+                "serve.shed_deadline": float(self.shed_deadline),
+                "serve.decode_ticks": float(self.decode_ticks),
+                "serve.prefills": float(self.prefills),
+                "serve.image_batches": float(self.image_batches),
+            }
+            first, last = self._first_admit, self._last_done
+        if not recs:
+            return out
+        for name, vals in (("queue_ms", [r.queue_ms for r in recs]),
+                           ("ttft_ms", [r.ttft_ms for r in recs]),
+                           ("total_ms", [r.total_ms for r in recs])):
+            arr = np.asarray(vals, np.float64)
+            for q in QUANTILES:
+                out[f"serve.{name}_p{q}"] = float(np.percentile(arr, q))
+            out[f"serve.{name}_mean"] = float(arr.mean())
+        tokens = sum(r.tokens for r in recs)
+        out["serve.tokens_out"] = float(tokens)
+        if tokens and last is not None and last > first:
+            # aggregate decode throughput over the busy window — the number
+            # the continuous-batching claim is judged by
+            out["serve.tokens_per_sec"] = tokens / (last - first)
+        return out
+
+    def records(self) -> list[RequestRecord]:
+        with self._lock:
+            return list(self._records)
+
+    # -- export ------------------------------------------------------------
+    def log_to(self, run, step: int = 0) -> None:
+        """Write the snapshot as run metrics and the raw per-request rows as
+        a ``serve_requests.jsonl`` artifact (rank-0 discipline is the Run's)."""
+        run.log_metrics(self.snapshot(), step=step)
+        rows = self.records()
+        art = run.artifact_dir("serving")
+        path = os.path.join(art, "serve_requests.jsonl")
+        try:
+            with open(path, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r.to_dict()) + "\n")
+        except OSError:
+            pass  # non-writable ranks get a path but no directory

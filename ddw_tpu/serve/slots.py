@@ -1,0 +1,234 @@
+"""Slot-based KV-cache pool — the device half of continuous batching.
+
+The single-request decode path (:func:`ddw_tpu.models.lm.generate`) owns a
+``[1, cap, ...]`` cache and scans tokens sequentially; serving N requests
+that way runs N programs per token and leaves the chip at batch 1. The pool
+instead owns ONE cache tree whose batch dimension is ``n_slots`` serving
+slots, with per-row depth indices (``TransformerLM(slot_decode=True)``), and
+three jitted operations over it:
+
+- **prefill**: one bucketed causal forward of a new request's prompt into a
+  fresh single-request cache (one compiled program per length bucket), which
+  also picks the request's first token — TTFT is one prefill away from
+  admission, independent of other requests' progress;
+- **insert**: splice that prefill cache into pool row ``slot`` (pure
+  ``dynamic_update_slice`` tree surgery; indices snap to the TRUE prompt
+  length so decode overwrites the pad region);
+- **decode**: ONE jitted program advances every slot one token — and chains
+  ``k`` such steps per dispatch via ``lax.scan`` with the pool cache donated
+  through, the same dispatch-fusion discipline the train hot loop uses
+  (``TrainCfg.steps_per_dispatch``, docs/performance.md) — so the host pays
+  one dispatch and one token fetch per ``k * n_slots`` generated tokens.
+
+Requests at different depths coexist because masking is per-row: a slot
+admitted mid-flight (Orca-style iteration-level scheduling, arXiv 2309.06180
+lineage) neither stalls nor perturbs its neighbors — outputs are
+token-identical to the sequential path (pinned by tests/test_serve_engine).
+
+Free slots keep decoding a dummy token (static shapes — design rule 2); the
+waste is bounded by ``n_slots`` and their released rows are index-reset to 0
+so they never force extra attention tiles for live rows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ddw_tpu.models.lm import TransformerLM, init_cache
+
+
+def _pick(logits, temperature, key):
+    """Next-token pick over ``logits [..., V]`` (f32): greedy rows take the
+    raw argmax (bit-identical to :func:`ddw_tpu.models.lm.generate`'s greedy
+    branch), sampled rows divide by temperature and draw categorically with
+    their own key. ``temperature`` broadcasts over the leading axes; the
+    sampled branch always computes (traced) and ``where`` selects."""
+    t = jnp.where(temperature > 0, temperature, 1.0)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if key.ndim == 1:  # one key for the whole (batch=1) row block
+        sampled = jax.random.categorical(
+            key, logits.astype(jnp.float32) / t, axis=-1).astype(jnp.int32)
+    else:              # per-row keys
+        sampled = jax.vmap(
+            lambda k, l: jax.random.categorical(k, l).astype(jnp.int32)
+        )(key, logits.astype(jnp.float32) / t[:, None])
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+class SlotPool:
+    """Fixed-capacity continuous-batching cache pool over a
+    :class:`~ddw_tpu.models.lm.TransformerLM`."""
+
+    def __init__(self, model: TransformerLM, params, n_slots: int,
+                 steps_per_tick: int = 4, donate: bool = True):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if steps_per_tick < 1:
+            raise ValueError(
+                f"steps_per_tick must be >= 1, got {steps_per_tick}")
+        self.n_slots = n_slots
+        self.steps_per_tick = steps_per_tick
+        self.max_len = model.max_len
+        self.params = params
+        self._donate = donate
+        # the same weights run two program families: bucketed prefill
+        # (scalar-index decode, batch 1) and the slot-mode pool step
+        self._prefill_model = model.clone(decode=True, slot_decode=False,
+                                          seq_axis=None, dropout=0.0)
+        self._slot_model = model.clone(decode=True, slot_decode=True,
+                                       seq_axis=None, dropout=0.0)
+        self.cache = init_cache(self._slot_model, n_slots)
+        self._free = list(range(n_slots - 1, -1, -1))  # pop() -> slot 0 first
+        self._prefill_jit: dict[int, object] = {}   # by padded prompt length
+        self._decode_jit: dict[int, object] = {}    # by chain length k
+        don = (0,) if donate else ()
+        self._insert = jax.jit(self._insert_fn, donate_argnums=don)
+        self._release = jax.jit(self._release_fn, donate_argnums=don)
+
+    # -- slot bookkeeping ---------------------------------------------------
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def acquire(self) -> int:
+        """Claim a free slot id; raises when the pool is full (the engine
+        checks ``free_slots`` first — admission control lives above)."""
+        if not self._free:
+            raise RuntimeError("slot pool exhausted")
+        return self._free.pop()
+
+    def release(self, slot: int) -> None:
+        """Return ``slot`` to the pool and reset its row indices to 0 — a
+        parked row at depth 0 masks every attention tile, so finished
+        requests stop contributing to live rows' tile count."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free")
+        self.cache = self._release(self.cache, jnp.int32(slot))
+        self._free.append(slot)
+
+    def warmup(self, buckets) -> None:
+        """Precompile the program lattice for the given prompt-length
+        buckets: one prefill per (bucket, power-of-two group size up to
+        n_slots) plus the decode chain — so no request ever pays a compile
+        at serving time. Leaves the pool state untouched (indices snap back
+        to 0 after the dummy decode)."""
+        for bucket in sorted(set(buckets)):
+            g = 1
+            while g <= self.n_slots:
+                cache_g, _ = self.prefill(np.zeros((g, bucket), np.int32),
+                                          np.ones((g,), np.int32),
+                                          np.zeros((g,), np.float32),
+                                          np.zeros((g, 2), np.uint32))
+                if bucket == sorted(set(buckets))[0]:
+                    # insert's program depends on the group shape, not the
+                    # bucket (the spliced K/V rows are cache-capacity-sized)
+                    # — compile it once per group size
+                    slot = self.acquire()
+                    self.insert(slot, cache_g, 1, row=0)
+                    self.release(slot)
+                g *= 2
+        self.decode(np.zeros((self.n_slots,), np.int32),
+                    np.zeros((self.n_slots,), np.float32),
+                    np.zeros((self.n_slots, self.steps_per_tick, 2),
+                             np.uint32))
+        for slot in range(self.n_slots):
+            self.cache = self._release(self.cache, jnp.int32(slot))
+
+    # -- device programs ----------------------------------------------------
+    def prefill(self, padded_prompts, true_lens, temperatures, keys) -> tuple:
+        """Run a GROUP of new requests' bucketed prompts through the decode
+        model in one dispatch: ``padded_prompts [G, L]`` (same length
+        bucket), per-row ``true_lens [G]`` / ``temperatures [G]`` /
+        ``keys [G, 2]``. Returns ``(prefill_cache, first_tokens [G])`` —
+        one compiled program per (bucket, group-size); the engine pads the
+        group to a power of two so an admission burst costs one prefill per
+        bucket, not one per request. Row g splices into the pool via
+        :meth:`insert`; dummy pad rows are simply never inserted."""
+        padded_prompts = jnp.asarray(padded_prompts, jnp.int32)
+        if padded_prompts.ndim != 2:
+            raise ValueError(
+                f"prefill expects [G, L] prompts, got {padded_prompts.shape}")
+        g, length = padded_prompts.shape
+        fn = self._prefill_jit.get((g, length))
+        if fn is None:
+            model = self._prefill_model
+
+            def prefill_fn(prompts, true_lens, temps, keys):
+                cache = init_cache(model, prompts.shape[0])
+                logits, vars_ = model.apply(
+                    {"params": self.params, "cache": cache}, prompts,
+                    mutable=["cache"])
+                last = jnp.take_along_axis(
+                    logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+                toks = _pick(last, temps, keys)          # [G]
+                return vars_["cache"], toks
+
+            fn = self._prefill_jit[(g, length)] = jax.jit(prefill_fn)
+        return fn(padded_prompts, jnp.asarray(true_lens, jnp.int32),
+                  jnp.asarray(temperatures, jnp.float32), jnp.asarray(keys))
+
+    def insert(self, slot: int, prefill_cache, true_len: int,
+               row: int = 0) -> None:
+        """Splice row ``row`` of a (group) prefill cache into pool row
+        ``slot`` with its indices snapped to the true prompt length."""
+        self.cache = self._insert(self.cache, prefill_cache, jnp.int32(slot),
+                                  jnp.int32(true_len), jnp.int32(row))
+
+    def decode(self, tokens, temperatures, keys) -> np.ndarray:
+        """Advance EVERY slot ``steps_per_tick`` tokens in one dispatch.
+        ``tokens [S]`` is each slot's current token, ``temperatures [S]``
+        per-slot (0 = greedy), ``keys [S, k, 2]`` per-slot per-step sample
+        keys (zeros for greedy rows). Returns the generated ``[S, k]`` token
+        block (host); the pool cache advances in place (donated)."""
+        k = self.steps_per_tick
+        fn = self._decode_jit.get(k)
+        if fn is None:
+            model = self._slot_model
+
+            def chain(cache, tok, temps, keys_sk):
+                def body(carry, key_s):
+                    cache, tok = carry
+                    logits, vars_ = model.apply(
+                        {"params": self.params, "cache": cache},
+                        tok[:, None], mutable=["cache"])
+                    nxt = _pick(logits[:, 0], temps, key_s)
+                    return (vars_["cache"], nxt), nxt
+
+                (cache, _), toks = lax.scan(
+                    body, (cache, tok), jnp.swapaxes(keys_sk, 0, 1))
+                return cache, jnp.swapaxes(toks, 0, 1)  # [S, k]
+
+            fn = self._decode_jit[k] = jax.jit(
+                chain, donate_argnums=(0,) if self._donate else ())
+        self.cache, toks = fn(self.cache, jnp.asarray(tokens, jnp.int32),
+                              jnp.asarray(temperatures, jnp.float32),
+                              jnp.asarray(keys))
+        return np.asarray(toks)
+
+    # -- jitted bodies ------------------------------------------------------
+    @staticmethod
+    def _insert_fn(pool, pre, slot, true_len, row):
+        def fix(path, pl, sl):
+            name = getattr(path[-1], "key", None) if path else None
+            if name in ("cache_index", "pos_index"):
+                return pl.at[slot].set(true_len)
+            if name == "tiles_computed":
+                return pl  # pool-global observability counter
+            picked = lax.dynamic_slice_in_dim(sl, row, 1, axis=0)
+            return lax.dynamic_update_slice(
+                pl, picked.astype(pl.dtype), (slot,) + (0,) * (pl.ndim - 1))
+
+        return jax.tree_util.tree_map_with_path(fix, pool, pre)
+
+    @staticmethod
+    def _release_fn(pool, slot):
+        def fix(path, pl):
+            name = getattr(path[-1], "key", None) if path else None
+            if name in ("cache_index", "pos_index"):
+                return pl.at[slot].set(0)
+            return pl
+
+        return jax.tree_util.tree_map_with_path(fix, pool)

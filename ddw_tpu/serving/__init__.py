@@ -1,6 +1,12 @@
-from ddw_tpu.serving.package import PackagedModel, save_packaged_model, load_packaged_model  # noqa: F401
+from ddw_tpu.serving.package import (  # noqa: F401
+    ImageEngineHandle,
+    PackagedModel,
+    load_packaged_model,
+    save_packaged_model,
+)
 from ddw_tpu.serving.batch import BatchScorer, LMBatchScorer  # noqa: F401
 from ddw_tpu.serving.lm_package import (  # noqa: F401
+    LMEngineHandle,
     LMPackagedModel,
     load_lm_package,
     save_lm_package,

@@ -39,18 +39,27 @@ _LM_FORMAT_VERSION_QUANT = 2
 _SUPPORTED = (_LM_FORMAT_VERSION, _LM_FORMAT_VERSION_QUANT)
 
 
-def sequence_nll(model, params, tokens):
+def sequence_nll(model, params, tokens, lengths=None):
     """Per-sequence mean next-token NLL of ``tokens [B, S+1]`` — THE single
     scoring definition, jitted by both :class:`LMPackagedModel` and
     ``serving.batch.LMBatchScorer`` so the two paths cannot drift. Callers
     must bounds-check token ids first (:func:`check_token_ids`): jnp gathers
     clamp out-of-range indices, which would silently score the nearest
-    vocab row."""
+    vocab row.
+
+    ``lengths`` (optional ``[B]``) gives each row's TRUE target count when
+    ``tokens`` is right-padded to a shape bucket — padded positions drop out
+    of the mean (causal masking already keeps them out of real positions'
+    logits). Zero-length pad rows return 0, to be sliced off by the caller.
+    """
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
     logits = model.apply({"params": params}, inp, train=False)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
     tok_ll = jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
-    return -jnp.mean(tok_ll, axis=-1)
+    if lengths is None:
+        return -jnp.mean(tok_ll, axis=-1)
+    mask = jnp.arange(tgt.shape[1])[None, :] < lengths[:, None]
+    return -jnp.sum(tok_ll * mask, axis=-1) / jnp.maximum(lengths, 1)
 
 
 def check_token_ids(tokens, vocab_size: int) -> None:
@@ -85,8 +94,27 @@ def save_lm_package(out_dir: str, lm_cfg: LMCfg, params,
                              _LM_FORMAT_VERSION_QUANT)
 
 
+@dataclasses.dataclass
+class LMEngineHandle:
+    """What :class:`ddw_tpu.serve.ServingEngine` needs from an LM package:
+    the bare model/params plus the config that bounds admission validation.
+    A handle, not the package object, so any weight source (a fresh
+    ``init``, a checkpoint restore) can serve through the engine too."""
+
+    model: object               # TransformerLM (decode clones built inside)
+    params: object
+    cfg: LMCfg
+    content_digest: str = ""
+
+
 class LMPackagedModel:
-    """Self-contained LM scorer/generator loaded from a package directory."""
+    """Self-contained LM scorer/generator loaded from a package directory.
+
+    Variable request shapes are padded to the shared serving buckets
+    (:mod:`ddw_tpu.serve.bucketing`) before hitting jit, so scoring or
+    generating over arbitrary prompt lengths compiles O(log max_len)
+    programs instead of one per observed length — the same discipline the
+    online engine applies, here on the single-request path."""
 
     def __init__(self, model_dir: str):
         from ddw_tpu.serving.package import read_package_dir
@@ -100,10 +128,18 @@ class LMPackagedModel:
         self.params = restored["params"]
 
         self._nll = jax.jit(
-            lambda tokens: sequence_nll(self.model, self.params, tokens))
+            lambda tokens, lengths: sequence_nll(self.model, self.params,
+                                                 tokens, lengths))
+        self._gen_cache: dict[tuple, object] = {}
+
+    def engine_handle(self) -> LMEngineHandle:
+        return LMEngineHandle(self.model, self.params, self.lm_cfg,
+                              self.content_digest)
 
     def score(self, tokens) -> np.ndarray:
         """Mean next-token NLL per sequence; perplexity = exp(score)."""
+        from ddw_tpu.serve.bucketing import bucket_len, pad_to_bucket
+
         tokens = np.asarray(tokens, np.int32)
         if tokens.ndim != 2 or tokens.shape[1] < 2:
             raise ValueError(f"tokens must be [B, S+1], got {tokens.shape}")
@@ -111,12 +147,47 @@ class LMPackagedModel:
             raise ValueError(f"sequence {tokens.shape[1] - 1} exceeds "
                              f"max_len {self.lm_cfg.max_len}")
         check_token_ids(tokens, self.lm_cfg.vocab_size)
-        return np.asarray(self._nll(tokens))
+        b, width = tokens.shape
+        padded = pad_to_bucket(
+            tokens, bucket_len(width, self.lm_cfg.max_len + 1))
+        lengths = np.full((b,), width - 1, np.int32)
+        return np.asarray(self._nll(padded, lengths))
 
-    def generate(self, prompt, num_steps: int, **kw) -> np.ndarray:
-        return np.asarray(generate(self.model, self.params,
-                                   np.asarray(prompt, np.int32),
-                                   num_steps, **kw))
+    def generate(self, prompt, num_steps: int, rng=None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0) -> np.ndarray:
+        from ddw_tpu.serve.bucketing import bucket_len, pad_to_bucket
+
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 2 or prompt.shape[1] < 1:
+            raise ValueError(f"prompt must be [B, P], got {prompt.shape}")
+        b, plen = prompt.shape
+        if plen + num_steps > self.lm_cfg.max_len:
+            raise ValueError(f"prompt {plen} + steps {num_steps} exceeds "
+                             f"max_len {self.lm_cfg.max_len}")
+        bucket = bucket_len(plen, self.lm_cfg.max_len)
+        padded = pad_to_bucket(prompt, bucket)
+        # one compiled program per (bucket, batch, steps, sampling config) —
+        # sampling controls are static python scalars inside the trace
+        key = (bucket, b, num_steps, float(temperature), int(top_k),
+               float(top_p), rng is not None)
+        fn = self._gen_cache.get(key)
+        if fn is None:
+            if rng is None:
+                fn = jax.jit(lambda p, n: generate(
+                    self.model, self.params, p, num_steps,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    prompt_len=n))
+            else:
+                fn = jax.jit(lambda p, n, r: generate(
+                    self.model, self.params, p, num_steps, rng=r,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    prompt_len=n))
+            self._gen_cache[key] = fn
+        args = (jnp.asarray(padded), jnp.int32(plen))
+        if rng is not None:
+            args += (rng,)
+        return np.asarray(fn(*args))
 
     def generate_speculative(self, draft: "LMPackagedModel", prompt,
                              num_steps: int, k: int = 4):

@@ -144,6 +144,23 @@ def load_packaged_model(model_dir: str) -> "PackagedModel":
     return PackagedModel(model_dir)
 
 
+@dataclasses.dataclass
+class ImageEngineHandle:
+    """What :class:`ddw_tpu.serve.ServingEngine` needs from an image
+    package: model/params plus the input-coercion callable (shared with
+    :meth:`PackagedModel.predict` — same preprocessing, no train/serve or
+    offline/online skew)."""
+
+    model: object
+    params: object
+    batch_stats: object
+    classes: list
+    height: int
+    width: int
+    decode_one: object          # item -> [H, W, 3] float array
+    content_digest: str = ""
+
+
 class PackagedModel:
     """Self-contained predictor (the ``FlowerPyFunc`` role).
 
@@ -181,6 +198,11 @@ class PackagedModel:
         if self.batch_stats:
             variables["batch_stats"] = self.batch_stats
         return self.model.apply(variables, images, train=False)
+
+    def engine_handle(self) -> ImageEngineHandle:
+        return ImageEngineHandle(
+            self.model, self.params, self.batch_stats, self.classes,
+            self.height, self.width, self._decode_one, self.content_digest)
 
     # -- input coercion (the reference's bytes-vs-str handling, :214-234) -------
     def _decode_one(self, item) -> np.ndarray:

@@ -11,9 +11,13 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow   # subprocess bench smoke — the ROADMAP's "benches"
+#                     tier-2 class
 def test_smoke_arms_and_remat_delta():
     env = dict(os.environ, DDW_BENCH_SMOKE="1", PALLAS_AXON_POOL_IPS="",
                JAX_PLATFORMS="cpu",

@@ -71,7 +71,11 @@ _EXAMPLES = [
                  "model_prefers_structure=True", marks=_slow),
     pytest.param("11_lm_lifecycle.py", ["--int8", "train.epochs=2"],
                  "int8 weight-only", marks=_slow),
-    ("13_supervised_gang.py", [], "resume_step=3"),
+    # 13/14 spawn gangs / serve concurrent traffic — multi-process drill
+    # class, tier-2 like the rest of the example sweep
+    pytest.param("13_supervised_gang.py", [], "resume_step=3", marks=_slow),
+    pytest.param("14_online_serving.py", [],
+                 "engine_matches_sequential=12/12", marks=_slow),
 ]
 
 

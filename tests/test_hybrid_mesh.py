@@ -222,6 +222,8 @@ def _hybrid_fsdp_worker():
             "losses": losses, "n_sharded": sharded}
 
 
+@pytest.mark.slow   # 2-process gang train run — the ROADMAP's
+#                     "multi-process training" tier-2 class
 def test_two_process_hybrid_fsdp(worker_pythonpath):
     out = Launcher(np=2, devices_per_proc=2, timeout_s=540).run(
         _hybrid_fsdp_worker)

@@ -103,6 +103,41 @@ def test_format_guards(tmp_path):
         pm.score(_tokens(1, 128))
 
 
+def test_generate_bucketing_no_per_length_programs(tmp_path):
+    """Prompt lengths sharing a bucket share ONE jitted program (the
+    engine's bucketing applied to the single-request path), and the padded
+    path is token-identical to the unbucketed models.lm.generate."""
+    cfg, model, params = _trained()
+    pm = load_lm_package(save_lm_package(str(tmp_path / "pkg"), cfg, params))
+    rng = np.random.RandomState(2)
+    for plen in (3, 8):     # both in the 8-bucket (pad and exact)
+        prompt = rng.randint(0, VOCAB, size=(1, plen)).astype(np.int32)
+        ref = np.asarray(generate(model, params, prompt, num_steps=6))
+        np.testing.assert_array_equal(pm.generate(prompt, 6), ref)
+    assert len(pm._gen_cache) == 1     # one program for the whole bucket
+    # sampling composes with bucketing (same key schedule as the raw path)
+    prompt = rng.randint(0, VOCAB, size=(1, 5)).astype(np.int32)
+    ref = np.asarray(generate(model, params, prompt, num_steps=6,
+                              rng=jax.random.PRNGKey(4), temperature=0.9,
+                              top_k=7))
+    got = pm.generate(prompt, 6, rng=jax.random.PRNGKey(4), temperature=0.9,
+                      top_k=7)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_score_bucketing_matches_unpadded(tmp_path):
+    """Padded-bucket scoring == the exact per-length NLL (padded positions
+    masked out of the mean)."""
+    from ddw_tpu.serving.lm_package import sequence_nll
+
+    cfg, model, params = _trained()
+    pm = load_lm_package(save_lm_package(str(tmp_path / "pkg"), cfg, params))
+    for seq in (5, 16):   # pad-to-bucket and exact-bucket widths
+        toks = _tokens(n=3, seq=seq, seed=seq)
+        ref = np.asarray(sequence_nll(model, params, jnp.asarray(toks)))
+        np.testing.assert_allclose(pm.score(toks), ref, rtol=1e-5, atol=1e-6)
+
+
 def test_lm_batch_scorer_over_token_table(tmp_path):
     """LMBatchScorer: per-sequence NLL over a tokens_i32 table matches the
     package's own score() exactly (padding sliced off), order preserved,

@@ -1,0 +1,329 @@
+"""Online serving engine (ddw_tpu.serve): continuous-batching determinism,
+admission control, SLO metrics, int8 engine path, throughput-over-sequential.
+
+Runs on the 8-fake-CPU-device backend like every tier-1 test. The core
+acceptance pins: (1) engine LM outputs are token-identical to the sequential
+single-request generate path for ANY admission interleaving, across slot
+counts and eviction orders; (2) over-capacity requests get a structured
+``Overloaded`` (never a hang) and expired requests are shed before device
+work; (3) a quantized package served through the engine matches its direct
+apply; (4) batched continuous decoding beats sequential generation in
+aggregate tokens/sec at concurrency 8.
+
+One LM package is module-scoped: the sequential reference path
+(``LMPackagedModel.generate``) caches one compiled program per
+(bucket, steps) across every test here, so the tier-1 cost is the engine's
+own programs, not repeated reference compiles. The widest arms (extra slot
+configs, the throughput bench) carry the ``slow`` marker — the tier-2 suite
+runs them; tier-1 keeps one full determinism pin.
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ddw_tpu.models.lm import build_lm
+from ddw_tpu.serve import (
+    DeadlineExceeded,
+    EngineCfg,
+    Overloaded,
+    ServingEngine,
+    SlotPool,
+    batch_bucket,
+    bucket_len,
+    length_buckets,
+    pad_to_bucket,
+)
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+
+
+def _lm_pkg(out_dir, quantize=None, seed=0, **cfg_kw):
+    kw = dict(vocab_size=VOCAB, max_len=96, hidden=32, depth=2, num_heads=2,
+              mlp_dim=64, dropout=0.0, dtype="float32")
+    kw.update(cfg_kw)
+    cfg = LMCfg(**kw)
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(seed)},
+                        np.zeros((1, 8), np.int32))["params"]
+    d = save_lm_package(str(out_dir), cfg, params, quantize=quantize)
+    return load_lm_package(d)
+
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    """The shared f32 LM package — its generate/score program caches
+    persist across every test in this module."""
+    return _lm_pkg(tmp_path_factory.mktemp("serve_pkg") / "pkg")
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+# -- bucketing --------------------------------------------------------------
+
+def test_bucketing_ladder():
+    assert length_buckets(96, 8) == (8, 16, 32, 64, 96)
+    assert bucket_len(5, 96) == 8 and bucket_len(9, 96) == 16
+    assert bucket_len(96, 96) == 96
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_len(97, 96)
+    padded = pad_to_bucket(np.ones((1, 5), np.int32), 8)
+    assert padded.shape == (1, 8) and padded[0, 5:].sum() == 0
+    assert batch_bucket(3, 8) == 4 and batch_bucket(9, 8) == 8
+
+
+# -- determinism: engine == sequential generate -----------------------------
+
+@pytest.mark.slow   # the staggered-admissions test below is the tier-1
+#                     determinism pin; this matrix re-pins it across slot
+#                     counts / chain lengths / eviction orders in tier-2
+@pytest.mark.parametrize("n_slots,steps_per_tick", [(1, 1), (2, 4), (4, 3)])
+def test_engine_matches_sequential_across_slot_counts(pm, n_slots,
+                                                      steps_per_tick):
+    """More requests than slots, varied prompt lengths and step counts:
+    every eviction order / slot reuse pattern must reproduce the sequential
+    path token-for-token."""
+    prompts = _prompts([3, 9, 14, 5, 21, 7])
+    steps = [11, 4, 8, 1, 6, 13]
+    refs = [pm.generate(p[None, :], s)[0] for p, s in zip(prompts, steps)]
+    cfg = EngineCfg(n_slots=n_slots, steps_per_tick=steps_per_tick)
+    with ServingEngine(lm=pm, cfg=cfg) as eng:
+        futs = [eng.submit_generate(p, s) for p, s in zip(prompts, steps)]
+        out = [f.result(timeout=120) for f in futs]
+    for i, (r, ref) in enumerate(zip(out, refs)):
+        assert np.array_equal(r.tokens, ref), i
+        assert r.ttft_ms >= 0 and r.total_ms >= r.ttft_ms
+
+
+def test_engine_matches_sequential_with_staggered_admissions(pm):
+    """Admissions arriving WHILE other slots decode (the continuous-batching
+    case) — greedy requests interleaved with per-request temperature
+    sampling on the generate() key schedule: outputs stay token-identical
+    to the sequential path, and sampled/greedy neighbors don't perturb
+    each other. One engine serves both phases (one compile set)."""
+    prompts = _prompts([4, 12, 6, 17, 9, 3, 25, 8], seed=3)
+    refs = [pm.generate(p[None, :], 10)[0] for p in prompts]
+    ps1, ps2 = _prompts([9, 6], seed=5)
+    sref1 = pm.generate(ps1[None, :], 12, rng=jax.random.PRNGKey(11),
+                        temperature=0.7)[0]
+    sref2 = pm.generate(ps2[None, :], 12)[0]
+    with ServingEngine(lm=pm,
+                       cfg=EngineCfg(n_slots=3, steps_per_tick=2)) as eng:
+        futs = []
+        for p in prompts:
+            futs.append(eng.submit_generate(p, 10))
+            time.sleep(0.01)  # land mid-flight of earlier requests
+        out = [f.result(timeout=120) for f in futs]
+        f1 = eng.submit_generate(ps1, 12, rng=jax.random.PRNGKey(11),
+                                 temperature=0.7)
+        f2 = eng.submit_generate(ps2, 12)
+        assert np.array_equal(f1.result(120).tokens, sref1)
+        assert np.array_equal(f2.result(120).tokens, sref2)
+    for i, (r, ref) in enumerate(zip(out, refs)):
+        assert np.array_equal(r.tokens, ref), i
+
+
+# -- admission control ------------------------------------------------------
+
+def test_overloaded_is_structured_not_a_hang(pm):
+    """Submissions past queue_depth refuse IMMEDIATELY with the structured
+    reply (engine not even started — a wedged engine must also refuse)."""
+    eng = ServingEngine(lm=pm, cfg=EngineCfg(n_slots=1, queue_depth=2))
+    p = _prompts([5])[0]
+    eng.submit_generate(p, 4)
+    eng.submit_generate(p, 4)
+    t0 = time.monotonic()
+    with pytest.raises(Overloaded) as exc:
+        eng.submit_generate(p, 4)
+    assert time.monotonic() - t0 < 1.0
+    d = exc.value.to_dict()
+    assert d["error"] == "overloaded"
+    assert d["capacity"] == 2 and d["depth"] == 2
+    assert eng.metrics.snapshot()["serve.shed_overloaded"] == 1.0
+    eng.stop()
+
+
+def test_expired_requests_shed_before_device_work(pm):
+    """A request whose deadline passes while queued completes with
+    DeadlineExceeded — and the engine never prefilled it."""
+    eng = ServingEngine(lm=pm, cfg=EngineCfg(n_slots=1))
+    p = _prompts([5])[0]
+    fut = eng.submit_generate(p, 4, timeout_s=0.05)
+    time.sleep(0.2)       # expire while the engine is not running
+    eng.start()
+    with pytest.raises(DeadlineExceeded) as exc:
+        fut.result(timeout=30)
+    assert exc.value.to_dict()["error"] == "deadline_exceeded"
+    snap = eng.metrics.snapshot()
+    assert snap["serve.shed_deadline"] == 1.0
+    assert snap["serve.prefills"] == 0.0   # no device work was spent
+    eng.stop()
+
+
+def test_engine_rejects_invalid_requests(pm):
+    eng = ServingEngine(lm=pm)
+    p = _prompts([5])[0]
+    with pytest.raises(ValueError, match="token ids outside"):
+        eng.submit_generate(p + VOCAB, 4)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        eng.submit_generate(p, 96)
+    with pytest.raises(ValueError, match="num_steps"):
+        eng.submit_generate(p, 0)
+    with pytest.raises(ValueError, match="requires rng"):
+        eng.submit_generate(p, 4, temperature=0.5)
+    with pytest.raises(ValueError, match="image"):
+        eng.submit_predict(np.zeros((8, 8, 3), np.float32))
+
+
+# -- quantized packages through the engine ----------------------------------
+
+def test_int8_lm_package_through_engine_matches_direct(pm, tmp_path):
+    """serving/quantize.py engine-path coverage: an int8 LM package served
+    by the engine is token-identical to its own direct (dequantized) apply,
+    and close to the f32 package."""
+    pm8 = _lm_pkg(tmp_path / "i8", quantize="int8")
+    prompts = _prompts([6, 11, 4, 15], seed=9)
+    direct = [pm8.generate(p[None, :], 8)[0] for p in prompts]
+    with ServingEngine(lm=pm8,
+                       cfg=EngineCfg(n_slots=2, steps_per_tick=3)) as eng:
+        futs = [eng.submit_generate(p, 8) for p in prompts]
+        out = [f.result(timeout=120) for f in futs]
+    for i, (r, ref) in enumerate(zip(out, direct)):
+        assert np.array_equal(r.tokens, ref), i
+    # scores stay close to full precision (the quantization contract)
+    toks = np.stack([np.concatenate([prompts[0], direct[0]])])
+    np.testing.assert_allclose(pm8.score(toks), pm.score(toks),
+                               rtol=0.05, atol=0.05)
+
+
+def test_int8_image_package_through_engine_matches_direct(tmp_path):
+    from ddw_tpu.serving.package import (load_packaged_model,
+                                         save_packaged_model)
+    from ddw_tpu.utils.config import ModelCfg
+
+    mcfg = ModelCfg(name="small_cnn", num_classes=5, dropout=0.0,
+                    dtype="float32")
+    from ddw_tpu.models.registry import build_model
+
+    model = build_model(mcfg)
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(5, 32, 32, 3).astype(np.float32) * 2 - 1
+    variables = model.init({"params": jax.random.PRNGKey(0)}, imgs[:1],
+                           train=False)
+    d = save_packaged_model(
+        str(tmp_path / "img8"), mcfg, [f"c{i}" for i in range(5)],
+        variables["params"], variables.get("batch_stats"),
+        img_height=32, img_width=32, quantize="int8")
+    pkg = load_packaged_model(d)
+    ref = pkg.predict_logits(imgs)
+    with ServingEngine(image=pkg, cfg=EngineCfg(max_batch=4,
+                                                max_wait_ms=1.0)) as eng:
+        out = eng.predict(list(imgs))
+    got = np.stack([r.logits for r in out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    assert [r.label for r in out] == pkg.predict(imgs)
+    assert eng.metrics.snapshot()["serve.image_batches"] >= 1.0
+
+
+# -- SLO metrics + tracker export -------------------------------------------
+
+def test_metrics_snapshot_and_tracker_export(pm, tmp_path):
+    import json
+
+    from ddw_tpu.tracking.tracker import Tracker
+
+    run = Tracker(str(tmp_path / "mlruns"), "serving").start_run("engine")
+    prompts = _prompts([5, 9, 7, 12])
+    with ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2, steps_per_tick=2),
+                       run=run) as eng:
+        futs = [eng.submit_generate(p, 6) for p in prompts]
+        [f.result(timeout=120) for f in futs]
+        snap = eng.snapshot()
+    run.end()
+    assert snap["serve.completed"] == 4.0
+    for key in ("serve.queue_ms_p50", "serve.queue_ms_p95",
+                "serve.queue_ms_p99", "serve.ttft_ms_p95",
+                "serve.total_ms_p99", "serve.tokens_per_sec"):
+        assert key in snap and snap[key] >= 0.0
+    assert snap["serve.tokens_out"] == 24.0
+    # p-order sanity
+    assert snap["serve.total_ms_p99"] >= snap["serve.total_ms_p50"]
+    # exported through the tracker on stop()
+    m = run.final_metrics()
+    assert m["serve.completed"] == 4.0
+    art = os.path.join(run.run_dir, "artifacts", "serving",
+                       "serve_requests.jsonl")
+    rows = [json.loads(ln) for ln in open(art)]
+    assert len(rows) == 4 and all(r["kind"] == "lm" for r in rows)
+
+
+# -- continuous batching beats sequential -----------------------------------
+
+@pytest.mark.slow
+def test_engine_throughput_beats_sequential_at_concurrency_8(tmp_path):
+    """The continuous-batching claim, on CPU at smoke scale: aggregate
+    engine tokens/sec at concurrency 8 strictly above one-at-a-time
+    sequential generation of the same requests on the same package. The
+    package is wide enough (hidden 256) that decode is weight-stream-bound
+    — the regime batching exists for; at toy widths sequential's single
+    fused scan program wins on pure dispatch count (measured ~1.8x engine
+    win here, so CI noise has margin). The serving_curve smoke pins the
+    same win at hidden 384 through the bench path."""
+    wide = _lm_pkg(tmp_path / "wide", vocab_size=256, max_len=128,
+                   hidden=256, depth=3, num_heads=4, mlp_dim=1024)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 256, size=(8,)).astype(np.int32)
+               for _ in range(8)]
+    steps = 24
+    # warm both paths (compile time out of the measurement)
+    wide.generate(prompts[0][None, :], steps)
+    cfg = EngineCfg(n_slots=8, steps_per_tick=8)
+    with ServingEngine(lm=wide, cfg=cfg) as eng:
+        eng.warmup([8])
+        eng.generate(prompts[0], steps)
+        t0 = time.perf_counter()
+        futs = [eng.submit_generate(p, steps) for p in prompts]
+        [f.result(timeout=300) for f in futs]
+        engine_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for p in prompts:
+        wide.generate(p[None, :], steps)
+    seq_s = time.perf_counter() - t0
+    engine_tps = len(prompts) * steps / engine_s
+    seq_tps = len(prompts) * steps / seq_s
+    assert engine_tps > seq_tps, (engine_tps, seq_tps)
+
+
+# -- slot pool unit behavior ------------------------------------------------
+
+def test_slot_pool_acquire_release_cycle(pm):
+    pool = SlotPool(pm.model, pm.params, n_slots=2, steps_per_tick=1)
+    a, b = pool.acquire(), pool.acquire()
+    assert pool.free_slots == 0
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.acquire()
+    pool.release(a)
+    assert pool.free_slots == 1
+    with pytest.raises(ValueError, match="already free"):
+        pool.release(a)
+    pool.release(b)
+    assert sorted([pool.acquire(), pool.acquire()]) == [0, 1]
+
+
+def test_engine_stop_fails_pending_cleanly(pm):
+    """stop() with queued work completes the futures with an error instead
+    of leaving callers blocked forever."""
+    eng = ServingEngine(lm=pm, cfg=EngineCfg(n_slots=1))  # never started
+    fut = eng.submit_generate(_prompts([5])[0], 4)
+    eng.stop()
+    with pytest.raises(RuntimeError, match="engine stopped"):
+        fut.result(timeout=10)

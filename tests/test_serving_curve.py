@@ -32,6 +32,16 @@ def test_serving_curve_smoke():
     assert spec["median_ms_per_token"] > 0 and spec["k"] == 4
     # the acceptance caveat must be visible in the output
     assert "acceptance_rate" in spec["stats"]
+    # online engine arm: a row per offered-load level, each with the SLO
+    # numbers, and the continuous-batching win at concurrency 8
+    eng = d["engine"]
+    assert [r["concurrency"] for r in eng["sweep"]] == [1, 4, 8]
+    for r in eng["sweep"]:
+        assert r["tokens_per_sec"] > 0 and r["completed"] == 32
+        assert r["queue_ms_p50"] >= 0
+        assert r["total_ms_p99"] >= r["ttft_ms_p50"] > 0
+    by_c = {r["concurrency"]: r for r in eng["sweep"]}
+    assert by_c[8]["tokens_per_sec"] > eng["sequential_tokens_per_sec"]
 
 
 def test_serving_curve_refuses_cpu_fallback():

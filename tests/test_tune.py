@@ -385,6 +385,8 @@ def test_asha_rereport_is_idempotent_and_factory_dispatch():
         make_pruner(TuneCfg(prune=True, pruner="hyperband"))
 
 
+@pytest.mark.slow   # 4-trial LM-trainer sweep — the ROADMAP's "HPO/LM
+#                     example sweeps" tier-2 class; ~30 s of tier-1 budget
 def test_fmin_over_lm_trainer():
     """The HPO layer composes with the LM family (the reference tunes only
     its vision model): TPE over learning rate, objective = a managed

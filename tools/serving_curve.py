@@ -3,19 +3,25 @@
 Completes the reference's ``spark_udf`` scoring role
 (``Part 2 - Distributed Tuning & Inference/03_pyfunc_distributed_inference.py:
 466-472``) with numbers: the image package's batch-size curve (what a scorer
-worker sees per ``predict_logits`` call, H2D/D2H included) and the LM
-package's per-token generation latency with speculative decoding off/on.
+worker sees per ``predict_logits`` call, H2D/D2H included), the LM package's
+per-token generation latency with speculative decoding off/on, and the
+ONLINE arm — an offered-load sweep through the continuous-batching engine
+(``ddw_tpu.serve``): closed-loop clients at each concurrency level, reporting
+aggregate tokens/sec, queue time, TTFT, and p99 latency per load point
+against the sequential single-request baseline.
 
 Usage (chip): ``DDW_REQUIRE_TPU=1 python tools/serving_curve.py``
 CI smoke:     ``DDW_BENCH_SMOKE=1`` shrinks shapes/batches/steps.
 
-Prints ONE JSON line: ``{"device": ..., "image_curve": [rows], "lm": {...}}``
-— each image row is {batch, median_ms, p90_ms, images_per_sec}; the LM block
-carries per-token ms for plain and speculative generation plus the
-speculative acceptance stats. Speculative speedup depends on draft/target
-agreement — random-weight packages measure the compute path, not the
-acceptance rate a trained pair would get (stats are reported so that caveat
-is visible).
+Prints ONE JSON line: ``{"device": ..., "image_curve": [rows], "lm": {...},
+"engine": {...}}`` — each image row is {batch, median_ms, p90_ms,
+images_per_sec}; the LM block carries per-token ms for plain and speculative
+generation plus the speculative acceptance stats; the engine block carries
+{"sequential_tokens_per_sec", "sweep": [{concurrency, tokens_per_sec,
+queue_ms_p50, ttft_ms_p50, total_ms_p99, completed}]}. Speculative speedup
+depends on draft/target agreement — random-weight packages measure the
+compute path, not the acceptance rate a trained pair would get (stats are
+reported so that caveat is visible).
 """
 
 import sys, os
@@ -66,8 +72,7 @@ def image_curve(batches, img):
     return rows
 
 
-def lm_latencies(hidden, depth, heads, vocab, max_len, prompt_len, steps,
-                 spec_k):
+def _make_lm_pkg(tmp, name, h, d, heads, vocab, max_len, dtype="bfloat16"):
     from ddw_tpu.models.lm import TransformerLM
     from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
     from ddw_tpu.train.lm_step import init_lm_state
@@ -75,17 +80,21 @@ def lm_latencies(hidden, depth, heads, vocab, max_len, prompt_len, steps,
 
     import optax
 
+    cfg = LMCfg(vocab_size=vocab, max_len=max_len, hidden=h, depth=d,
+                num_heads=heads, mlp_dim=4 * h, dropout=0.0, dtype=dtype)
+    model = TransformerLM(vocab_size=vocab, max_len=max_len, hidden=h,
+                          depth=d, num_heads=heads, mlp_dim=4 * h,
+                          dropout=0.0, dtype=dtype)
+    state = init_lm_state(model, optax.sgd(0.0), jax.random.PRNGKey(0))
+    out = os.path.join(tmp, name)
+    save_lm_package(out, cfg, state.params)
+    return load_lm_package(out)
+
+
+def lm_latencies(hidden, depth, heads, vocab, max_len, prompt_len, steps,
+                 spec_k):
     def make_pkg(tmp, name, h, d):
-        cfg = LMCfg(vocab_size=vocab, max_len=max_len, hidden=h, depth=d,
-                    num_heads=heads, mlp_dim=4 * h, dropout=0.0,
-                    dtype="bfloat16")
-        model = TransformerLM(vocab_size=vocab, max_len=max_len, hidden=h,
-                              depth=d, num_heads=heads, mlp_dim=4 * h,
-                              dropout=0.0, dtype="bfloat16")
-        state = init_lm_state(model, optax.sgd(0.0), jax.random.PRNGKey(0))
-        out = os.path.join(tmp, name)
-        save_lm_package(out, cfg, state.params)
-        return load_lm_package(out)
+        return _make_lm_pkg(tmp, name, h, d, heads, vocab, max_len)
 
     rng = np.random.RandomState(0)
     prompt = rng.randint(0, vocab, size=(1, prompt_len)).astype(np.int32)
@@ -121,6 +130,88 @@ def lm_latencies(hidden, depth, heads, vocab, max_len, prompt_len, steps,
     return out
 
 
+def engine_load_sweep(levels, hidden, depth, heads, vocab, max_len,
+                      prompt_len, steps, n_slots, steps_per_tick,
+                      requests_per_level, dtype="bfloat16"):
+    """Offered-load sweep through the online engine: at each concurrency
+    level, that many closed-loop clients fire generate requests back to
+    back until ``requests_per_level`` complete; aggregate tokens/sec plus
+    the queue/TTFT/p99 SLO numbers come from the engine's own metrics. The
+    sequential baseline times the SAME requests one at a time through the
+    package path — the number continuous batching must beat."""
+    import threading
+
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, vocab, size=(prompt_len,)).astype(np.int32)
+               for _ in range(requests_per_level)]
+    out = {"steps": steps, "n_slots": n_slots,
+           "steps_per_tick": steps_per_tick, "sweep": []}
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "engine", hidden, depth, heads, vocab,
+                          max_len, dtype=dtype)
+        pm.generate(prompts[0][None, :], steps)  # warmup/compile
+        t0 = time.perf_counter()
+        for p in prompts:
+            pm.generate(p[None, :], steps)
+        seq_s = time.perf_counter() - t0
+        out["sequential_tokens_per_sec"] = round(
+            requests_per_level * steps / seq_s, 1)
+        print(f"[curve] engine baseline: sequential "
+              f"{out['sequential_tokens_per_sec']:.0f} tok/s",
+              file=sys.stderr, flush=True)
+        for conc in levels:
+            eng = ServingEngine(lm=pm, cfg=EngineCfg(
+                n_slots=n_slots, steps_per_tick=steps_per_tick,
+                queue_depth=max(2 * conc, 8), default_timeout_s=600.0))
+            with eng:
+                eng.warmup([prompt_len])         # compile outside the clock
+                eng.generate(prompts[0], steps)
+                eng.metrics = type(eng.metrics)()  # fresh window
+                it = iter(prompts)
+                lock = threading.Lock()
+
+                def client():
+                    while True:
+                        with lock:
+                            p = next(it, None)
+                        if p is None:
+                            return
+                        eng.generate(p, steps)
+
+                t0 = time.perf_counter()
+                threads = [threading.Thread(target=client)
+                           for _ in range(conc)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                snap = eng.snapshot()
+            row = {
+                "concurrency": conc,
+                "tokens_per_sec": round(
+                    requests_per_level * steps / wall, 1),
+                # busy-window aggregate from the engine's own metrics
+                # (first admission -> last completion): the steady-state
+                # number, insensitive to closed-loop arrival raggedness
+                "tokens_per_sec_busy": round(
+                    snap.get("serve.tokens_per_sec", 0.0), 1),
+                "queue_ms_p50": round(snap["serve.queue_ms_p50"], 2),
+                "ttft_ms_p50": round(snap["serve.ttft_ms_p50"], 2),
+                "ttft_ms_p99": round(snap["serve.ttft_ms_p99"], 2),
+                "total_ms_p99": round(snap["serve.total_ms_p99"], 2),
+                "completed": int(snap["serve.completed"]),
+            }
+            out["sweep"].append(row)
+            print(f"[curve] engine c={conc}: {row['tokens_per_sec']:.0f} "
+                  f"tok/s, ttft p50 {row['ttft_ms_p50']:.1f} ms, p99 "
+                  f"{row['total_ms_p99']:.1f} ms", file=sys.stderr,
+                  flush=True)
+    return out
+
+
 def main():
     from ddw_tpu.utils.config import require_tpu_or_exit
 
@@ -131,15 +222,30 @@ def main():
         batches, img = [1, 4], (64, 64, 3)
         lm_kw = dict(hidden=64, depth=2, heads=4, vocab=256, max_len=128,
                      prompt_len=16, steps=8, spec_k=4)
+        # wide enough that decode is weight-stream-bound — the regime the
+        # batching win exists in (tests pin engine > sequential here)
+        # f32 on the CPU smoke (bf16 matmuls emulate slowly on host and
+        # drown the batching signal), wide enough (hidden 384) that decode
+        # is weight-stream-bound — measured ~1.9x engine win at c=8, so the
+        # strictly-above assertion has CI-noise margin
+        eng_kw = dict(levels=[1, 4, 8], hidden=384, depth=3, heads=4,
+                      vocab=256, max_len=128, prompt_len=16, steps=24,
+                      n_slots=8, steps_per_tick=8, requests_per_level=32,
+                      dtype="float32")
     else:
         batches, img = [1, 2, 4, 8, 16, 32, 64, 128, 256], (224, 224, 3)
         lm_kw = dict(hidden=512, depth=6, heads=8, vocab=8192, max_len=2048,
                      prompt_len=64, steps=128, spec_k=4)
+        eng_kw = dict(levels=[1, 2, 4, 8, 16, 32], hidden=512, depth=6,
+                      heads=8, vocab=8192, max_len=2048, prompt_len=64,
+                      steps=128, n_slots=16, steps_per_tick=8,
+                      requests_per_level=64)
 
     result = {
         "device": {"kind": kind, "n": jax.device_count()},
         "image_curve": image_curve(batches, img),
         "lm": lm_latencies(**lm_kw),
+        "engine": engine_load_sweep(**eng_kw),
     }
     print(json.dumps(result))
 
