@@ -1,0 +1,33 @@
+"""HTTP serving gateway — the network front door over engine replicas.
+
+Layering (docs/serving.md "The HTTP gateway"):
+
+- :mod:`~ddw_tpu.gateway.http` — ``Gateway``: stdlib ThreadingHTTPServer
+  JSON API with chunked per-token streaming, 429/504 mapping from the
+  engine's structured refusals;
+- :mod:`~ddw_tpu.gateway.replica` — ``ReplicaSet``: least-outstanding
+  routing across N engine replicas, one sideways retry on a full queue,
+  fleet-merged metrics;
+- :mod:`~ddw_tpu.gateway.lifecycle` — ``ServerLifecycle``: readiness gated
+  on warmup, SIGTERM drain within the runtime layer's grace window;
+- :mod:`~ddw_tpu.gateway.client` — ``GatewayClient``: reference client
+  whose backoff honors ``Retry-After``.
+"""
+
+from ddw_tpu.gateway.client import (  # noqa: F401
+    GatewayClient,
+    GatewayDeadline,
+    GatewayError,
+    GatewayOverloaded,
+    GatewayUnavailable,
+)
+from ddw_tpu.gateway.http import Gateway  # noqa: F401
+from ddw_tpu.gateway.lifecycle import (  # noqa: F401
+    DRAINING,
+    READY,
+    STARTING,
+    STOPPED,
+    ServerLifecycle,
+    runtime_grace_s,
+)
+from ddw_tpu.gateway.replica import ReplicaSet  # noqa: F401

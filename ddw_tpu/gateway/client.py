@@ -1,0 +1,236 @@
+"""Python client for the HTTP gateway — retries that honor backpressure.
+
+The gateway's 429 reply is not an error so much as a scheduling hint: the
+body carries the engine's own ``retry_after_ms`` estimate (queue depth x
+the decaying per-request service time) and the header carries the RFC
+``Retry-After`` seconds. A client that retries immediately converts
+backpressure into a thundering herd; this one sleeps exactly what the
+server asked (the precise ms from the body when present, the coarser
+header otherwise, capped exponential backoff when neither is given) and
+gives up after ``max_retries`` with the structured refusal intact.
+
+Stdlib only (``http.client``), deliberately: it runs inside the test suite
+and ``tools/load_gen.py``, and is the reference for what any real client
+(another language, a sidecar) must implement — the protocol is plain
+enough that this file IS the spec: JSON bodies, NDJSON streaming lines,
+and the status table in :mod:`ddw_tpu.gateway.http`.
+
+Retryable: 429 (engine queue full) and 503 (gateway starting or draining —
+a fleet peer may answer; the balancer decides). Not retryable: 504 (the
+request's own deadline died — retrying re-spends it), 400, 500.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+
+__all__ = ["GatewayClient", "GatewayError", "GatewayOverloaded",
+           "GatewayUnavailable", "GatewayDeadline"]
+
+
+class GatewayError(RuntimeError):
+    """Non-2xx reply, structured body preserved."""
+
+    def __init__(self, status: int, body: dict):
+        self.status = status
+        self.body = body
+        super().__init__(f"gateway returned {status}: {body}")
+
+
+class GatewayOverloaded(GatewayError):
+    """429 survived every retry — the fleet really is full."""
+
+
+class GatewayUnavailable(GatewayError):
+    """503 survived every retry — not ready, or draining for good."""
+
+
+class GatewayDeadline(GatewayError):
+    """504 — the request's deadline passed while it was queued."""
+
+
+_RETRYABLE = (429, 503)
+
+
+class GatewayClient:
+    """Thin blocking client; one connection per request (the gateway is
+    thread-per-connection — holding sockets open across calls buys nothing
+    a benchmark would notice and costs drain determinism)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 60.0,
+                 max_retries: int = 4, backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0):
+        self.host, self.port = host, port
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.retries = 0            # total backoff sleeps taken (telemetry)
+
+    # -- transport -----------------------------------------------------------
+    def _connect(self) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+
+    def _retry_delay(self, resp_headers, body: dict, attempt: int) -> float:
+        ms = body.get("retry_after_ms") if isinstance(body, dict) else None
+        if ms:
+            return float(ms) / 1e3
+        ra = resp_headers.get("Retry-After")
+        if ra:
+            try:
+                return float(ra)
+            except ValueError:
+                pass
+        return min(self.backoff_s * (2 ** attempt), self.max_backoff_s)
+
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 retry: bool = True):
+        """One exchange with retry-on-backpressure. Returns
+        ``(status, headers, response, connection)``; the caller reads the
+        body and closes the connection."""
+        payload = json.dumps(body).encode() if body is not None else None
+        attempt = 0
+        while True:
+            conn = self._connect()
+            try:
+                headers = {"Content-Type": "application/json",
+                           "Connection": "close"}
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                if retry and resp.status in _RETRYABLE \
+                        and attempt < self.max_retries:
+                    parsed = json.loads(resp.read() or b"{}")
+                    delay = self._retry_delay(resp.headers, parsed, attempt)
+                    conn.close()
+                    self.retries += 1
+                    attempt += 1
+                    time.sleep(delay)
+                    continue
+                return resp.status, resp.headers, resp, conn
+            except Exception:
+                conn.close()
+                raise
+
+    def _json_call(self, method: str, path: str, body: dict | None = None
+                   ) -> dict:
+        status, _headers, resp, conn = self._request(method, path, body)
+        try:
+            parsed = json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+        if status == 429:
+            raise GatewayOverloaded(status, parsed)
+        if status == 503:
+            raise GatewayUnavailable(status, parsed)
+        if status == 504:
+            raise GatewayDeadline(status, parsed)
+        if status != 200:
+            raise GatewayError(status, parsed)
+        return parsed
+
+    # -- data plane ----------------------------------------------------------
+    def generate(self, prompt, num_steps: int, temperature: float = 0.0,
+                 seed: int | None = None, timeout_s: float | None = None,
+                 stream: bool = False, on_token=None) -> dict:
+        """One LM continuation. Returns the final reply dict (``tokens``
+        plus the SLO numbers). ``stream=True`` reads the chunked NDJSON
+        reply line by line, invoking ``on_token(index, token)`` as each
+        arrives — the tokens list in the return value is assembled from
+        the stream and identical to the non-streaming reply."""
+        body = {"prompt": [int(t) for t in prompt], "num_steps": num_steps,
+                "temperature": temperature}
+        if seed is not None:
+            body["seed"] = seed
+        if timeout_s is not None:
+            body["timeout_s"] = timeout_s
+        if not stream:
+            return self._json_call("POST", "/v1/generate", body)
+        body["stream"] = True
+        status, _headers, resp, conn = self._request(
+            "POST", "/v1/generate", body)
+        try:
+            if status != 200:       # refused before the stream began
+                parsed = json.loads(resp.read() or b"{}")
+                if status == 429:
+                    raise GatewayOverloaded(status, parsed)
+                if status == 503:
+                    raise GatewayUnavailable(status, parsed)
+                if status == 504:
+                    raise GatewayDeadline(status, parsed)
+                raise GatewayError(status, parsed)
+            tokens: list[int] = []
+            final: dict = {}
+            while True:
+                line = resp.readline()   # http.client de-chunks for us
+                if not line:
+                    break
+                row = json.loads(line)
+                if "token" in row:
+                    tokens.append(int(row["token"]))
+                    if on_token is not None:
+                        on_token(int(row["index"]), int(row["token"]))
+                else:
+                    final = row
+                    break
+            if "error" in final:     # mid-stream rejection rides the body
+                raise GatewayError(200, final)
+            final["tokens"] = tokens
+            return final
+        finally:
+            conn.close()
+
+    def predict(self, image, timeout_s: float | None = None,
+                return_logits: bool = False) -> dict:
+        body: dict = {"image": np_tolist(image)}
+        if timeout_s is not None:
+            body["timeout_s"] = timeout_s
+        if return_logits:
+            body["return_logits"] = True
+        return self._json_call("POST", "/v1/predict", body)
+
+    # -- control plane -------------------------------------------------------
+    def healthz(self) -> dict:
+        return self._json_call("GET", "/healthz")
+
+    def readyz(self) -> tuple[int, dict]:
+        status, _h, resp, conn = self._request("GET", "/readyz",
+                                               retry=False)
+        try:
+            return status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    def stats(self) -> dict:
+        return self._json_call("GET", "/stats")
+
+    def metrics_text(self) -> str:
+        status, _h, resp, conn = self._request("GET", "/metrics")
+        try:
+            data = resp.read().decode()
+        finally:
+            conn.close()
+        if status != 200:
+            raise GatewayError(status, {"body": data})
+        return data
+
+    def wait_ready(self, timeout_s: float = 30.0) -> bool:
+        """Poll ``/readyz`` until 200 (True) or the timeout (False) —
+        what a load balancer health check does, for tests and tools."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                status, _ = self.readyz()
+                if status == 200:
+                    return True
+            except OSError:
+                pass                 # listener not even up yet
+            time.sleep(0.02)
+        return False
+
+
+def np_tolist(image):
+    """Accept a numpy array or nested lists for the predict payload."""
+    return image.tolist() if hasattr(image, "tolist") else image

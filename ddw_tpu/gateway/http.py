@@ -1,0 +1,406 @@
+"""HTTP front door for the serving engine — stdlib, JSON, streaming.
+
+PR 3's engine ends at a Python futures API in the caller's process; the
+ROADMAP's open serving item names what's missing: "a thin HTTP transport in
+front of the in-process engine". This module is that transport — a
+``ThreadingHTTPServer`` (one thread per connection, stdlib only: the
+container rule is no new dependencies) whose handlers translate between
+HTTP and the engine's structured types. No serving policy lives here:
+admission, batching, deadlines, and metrics stay in :mod:`ddw_tpu.serve`;
+routing and fleet aggregation in :class:`~ddw_tpu.gateway.ReplicaSet`;
+readiness/drain in :class:`~ddw_tpu.gateway.ServerLifecycle`. The gateway
+only maps.
+
+API (JSON request/response; errors are the engine's own ``to_dict()``
+forms, never free-text parsing):
+
+====================  ======================================================
+``POST /v1/generate`` ``{"prompt": [ints], "num_steps": N, "temperature":
+                      t?, "seed": s?, "timeout_s": d?, "stream": false?}``
+                      → ``{"tokens": [...], queue_ms, ttft_ms, total_ms,
+                      tokens_per_sec}``. With ``"stream": true`` the reply
+                      is chunked NDJSON: one ``{"index": i, "token": t}``
+                      line per token the moment its decode tick fetches
+                      (the engine's ``on_token`` hook), then a final
+                      ``{"done": true, ...}`` line with the SLO numbers.
+``POST /v1/predict``  ``{"image": [[[floats]]], "timeout_s": d?,
+                      "return_logits": false?}`` → ``{label, index,
+                      queue_ms, total_ms}``
+``GET /healthz``      process liveness — 200 from listener-up onward.
+``GET /readyz``       load-balancer readiness — 200 only between warmup
+                      completion and drain start, else 503.
+``GET /metrics``      Prometheus text exposition, merged across replicas.
+``GET /stats``        the fleet SLO snapshot as JSON.
+====================  ======================================================
+
+Status-code mapping (docs/serving.md has the full table): ``Overloaded`` →
+**429** with a ``Retry-After`` header and the structured body (capacity,
+depth, ``retry_after_ms``); ``DeadlineExceeded`` → **504**; validation
+errors → **400**; not-ready or draining → **503** + ``Retry-After``;
+anything else → **500**. A rejection that happens after streaming began
+arrives as a final NDJSON ``{"error": ...}`` line instead (the status line
+already went out — HTTP has no second chance).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ddw_tpu.gateway.lifecycle import ServerLifecycle
+from ddw_tpu.gateway.replica import ReplicaSet
+from ddw_tpu.serve.admission import DeadlineExceeded, Overloaded, Rejected
+
+__all__ = ["Gateway"]
+
+
+class _GatewayHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    # the stdlib default accept backlog (5) drops/retries SYNs under a
+    # connection burst — the engine's admission control is the bounded
+    # queue here, not the kernel's
+    request_queue_size = 128
+
+    def __init__(self, addr, gateway: "Gateway"):
+        self.gateway = gateway
+        super().__init__(addr, _Handler)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"   # required for chunked streaming
+    server_version = "ddw-gateway"
+
+    def log_message(self, *args) -> None:
+        pass                        # request logs are the engine's jsonl
+
+    # -- plumbing ------------------------------------------------------------
+    def _send_json(self, status: int, obj: dict,
+                   extra_headers: dict | None = None) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_rejected(self, e: Rejected) -> None:
+        body = e.to_dict()
+        if isinstance(e, Overloaded):
+            ms = body.get("retry_after_ms")
+            # delay-seconds is an integer per RFC 9110; the exact ms hint
+            # rides in the body for clients that can honor it precisely
+            secs = max(1, math.ceil(ms / 1e3)) if ms else 1
+            self._send_json(429, body, {"Retry-After": str(secs)})
+        elif isinstance(e, DeadlineExceeded):
+            self._send_json(504, body)
+        else:
+            self._send_json(500, body)
+
+    def _read_body(self) -> dict | None:
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            return body
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": f"malformed JSON body: {e}"})
+            return None
+
+    # chunked writing (Transfer-Encoding: chunked framing by hand —
+    # BaseHTTPRequestHandler gives us the socket, not the framing)
+    def _start_stream(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def _write_chunk(self, obj: dict) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _end_stream(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+
+    # -- GET: health / metrics ----------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
+        gw = self.server.gateway
+        try:
+            if self.path == "/healthz":
+                self._send_json(200, {"status": "alive",
+                                      "state": gw.lifecycle.state})
+            elif self.path == "/readyz":
+                state = gw.lifecycle.state
+                if gw.lifecycle.is_ready:
+                    self._send_json(200, {"status": "ready"})
+                else:
+                    self._send_json(503, {"status": state},
+                                    {"Retry-After": "1"})
+            elif self.path == "/metrics":
+                text = gw.replica_set.prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+            elif self.path == "/stats":
+                self._send_json(200, {
+                    "state": gw.lifecycle.state,
+                    "inflight": gw.lifecycle.inflight,
+                    **gw.replica_set.snapshot()})
+            else:
+                self._send_json(404, {"error": "not_found",
+                                      "path": self.path})
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+
+    # -- POST: the data plane -------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802
+        gw = self.server.gateway
+        if self.path not in ("/v1/generate", "/v1/predict"):
+            self._send_json(404, {"error": "not_found", "path": self.path})
+            return
+        # admission into the lifecycle ledger FIRST: a draining or not-yet-
+        # warm gateway refuses before reading a byte of payload semantics
+        if not gw.lifecycle.try_begin_request():
+            self._send_json(503, {"error": "unavailable",
+                                  "state": gw.lifecycle.state},
+                            {"Retry-After": "1"})
+            return
+        try:
+            body = self._read_body()
+            if body is None:
+                return
+            if self.path == "/v1/generate":
+                self._generate(gw, body)
+            else:
+                self._predict(gw, body)
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True   # client went away; slot finishes
+        finally:
+            gw.lifecycle.end_request()
+
+    def _generate(self, gw: "Gateway", body: dict) -> None:
+        try:
+            prompt = np.asarray(body["prompt"], np.int32)
+            num_steps = int(body["num_steps"])
+            timeout_s = body.get("timeout_s")
+            kw = {"temperature": float(body.get("temperature", 0.0)),
+                  "timeout_s": None if timeout_s is None
+                  else float(timeout_s)}
+            if body.get("seed") is not None:
+                import jax
+
+                kw["rng"] = jax.random.PRNGKey(int(body["seed"]))
+        except (KeyError, TypeError, ValueError) as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": f"bad field: {e}"})
+            return
+        stream = bool(body.get("stream", False))
+        toks_q: queue.SimpleQueue | None = None
+        if stream:
+            toks_q = queue.SimpleQueue()
+            kw["on_token"] = lambda i, t: toks_q.put((i, t))
+        try:
+            fut = gw.replica_set.submit_generate(prompt, num_steps, **kw)
+        except Overloaded as e:
+            self._send_rejected(e)
+            return
+        except ValueError as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": str(e)})
+            return
+        if not stream:
+            try:
+                res = fut.result()
+            except Rejected as e:
+                self._send_rejected(e)
+                return
+            except Exception as e:
+                self._send_json(500, {"error": "internal",
+                                      "message": repr(e)})
+                return
+            self._send_json(200, {
+                "tokens": [int(t) for t in res.tokens],
+                "queue_ms": res.queue_ms, "ttft_ms": res.ttft_ms,
+                "total_ms": res.total_ms,
+                "tokens_per_sec": res.tokens_per_sec})
+            return
+        self._stream_generate(fut, toks_q)
+
+    def _stream_generate(self, fut, toks_q: queue.SimpleQueue) -> None:
+        """Relay the engine's on_token stream as chunked NDJSON. Headers are
+        deferred until the first token (or terminal error), so a request
+        shed before any device work still gets its proper status code."""
+        started = False
+
+        def relay_available(block: bool) -> None:
+            nonlocal started
+            timeout = 0.05 if block else 0.0
+            while True:
+                try:
+                    i, t = toks_q.get(timeout=timeout)
+                except queue.Empty:
+                    return
+                if not started:
+                    started = True
+                    self._start_stream()
+                self._write_chunk({"index": i, "token": int(t)})
+                timeout = 0.0    # drain the rest of the burst non-blocking
+
+        while not fut.done():
+            relay_available(block=True)
+        relay_available(block=False)       # the tail emitted before done
+        try:
+            res = fut.result()
+            final = {"done": True, "num_tokens": len(res.tokens),
+                     "queue_ms": res.queue_ms, "ttft_ms": res.ttft_ms,
+                     "total_ms": res.total_ms,
+                     "tokens_per_sec": res.tokens_per_sec}
+            if not started:                # num_steps >= 1 makes this rare,
+                started = True             # but a zero-token reply is still
+                self._start_stream()       # a well-formed stream
+        except Rejected as e:
+            if not started:
+                self._send_rejected(e)     # clean 429/504 — nothing sent yet
+                return
+            final = e.to_dict()
+        except Exception as e:
+            if not started:
+                self._send_json(500, {"error": "internal",
+                                      "message": repr(e)})
+                return
+            final = {"error": "internal", "message": repr(e)}
+        self._write_chunk(final)
+        self._end_stream()
+        self.close_connection = True
+
+    def _predict(self, gw: "Gateway", body: dict) -> None:
+        try:
+            image = np.asarray(body["image"], np.float32)
+            timeout_s = body.get("timeout_s")
+            timeout_s = None if timeout_s is None else float(timeout_s)
+        except (KeyError, TypeError, ValueError) as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": f"bad field: {e}"})
+            return
+        try:
+            fut = gw.replica_set.submit_predict(image, timeout_s=timeout_s)
+        except Overloaded as e:
+            self._send_rejected(e)
+            return
+        except ValueError as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": str(e)})
+            return
+        try:
+            res = fut.result()
+        except Rejected as e:
+            self._send_rejected(e)
+            return
+        except Exception as e:
+            self._send_json(500, {"error": "internal", "message": repr(e)})
+            return
+        out = {"label": res.label, "index": res.index,
+               "queue_ms": res.queue_ms, "total_ms": res.total_ms}
+        if body.get("return_logits"):
+            out["logits"] = [float(x) for x in res.logits]
+        self._send_json(200, out)
+
+
+class Gateway:
+    """One serving process: HTTP listener + replica fleet + lifecycle.
+
+    ``replicas`` is a :class:`ReplicaSet`, one engine, or a list of engines.
+    ``grace_s`` defaults to the runtime layer's ``preempt_grace_s``
+    (:func:`ddw_tpu.gateway.lifecycle.runtime_grace_s`). ``port=0`` binds an
+    ephemeral port (read it back from :attr:`port` — the TOCTOU-free
+    pattern, same reason the Launcher respawns on fresh ports).
+    """
+
+    def __init__(self, replicas, host: str = "127.0.0.1", port: int = 0,
+                 grace_s: float | None = None):
+        self.replica_set = (replicas if isinstance(replicas, ReplicaSet)
+                            else ReplicaSet(replicas))
+        self.lifecycle = ServerLifecycle(grace_s)
+        self._host, self._want_port = host, port
+        self._httpd: _GatewayHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self._drain_lock = threading.Lock()
+        self.drained_clean: bool | None = None   # last drain's verdict
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, warmup_prompt_lens=(8,)) -> "Gateway":
+        """Bring the listener up FIRST (``/healthz`` answers while XLA
+        compiles), then warm every replica's program lattice, then flip
+        ``/readyz`` — readiness is gated on warmup by construction."""
+        if self._httpd is not None:
+            return self
+        self.replica_set.start()
+        self._httpd = _GatewayHTTPServer((self._host, self._want_port), self)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ddw-gateway-http",
+            daemon=True)
+        self._http_thread.start()
+        if warmup_prompt_lens:
+            self.replica_set.warmup(warmup_prompt_lens)
+        self.lifecycle.mark_ready()
+        return self
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("gateway not started")
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def drain(self, grace_s: float | None = None) -> bool:
+        """Graceful shutdown: stop admission (new requests 503), wait out
+        in-flight responses up to the grace window, stop the engines, close
+        the listener. Returns True when every in-flight request finished
+        inside the window. Idempotent — a second caller blocks until the
+        first drain completes, then reports its verdict."""
+        with self._drain_lock:
+            if not self.lifecycle.begin_drain():
+                return bool(self.drained_clean)
+            clean = self.lifecycle.await_drained(
+                grace_s if grace_s is not None else self.lifecycle.grace_s)
+            self.replica_set.stop()   # stragglers' futures fail loudly here
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                if self._http_thread is not None:
+                    self._http_thread.join(timeout=10.0)
+                self._httpd.server_close()
+                self._httpd = None
+            self.lifecycle.restore_sigterm()
+            self.lifecycle.mark_stopped()
+            self.drained_clean = clean
+            return clean
+
+    def stop(self) -> bool:
+        return self.drain()
+
+    def install_sigterm(self) -> None:
+        """SIGTERM → drain, the serving analog of the training gang's
+        graceful preemption (main thread only)."""
+        self.lifecycle.install_sigterm(self.drain)
+
+    def __enter__(self) -> "Gateway":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.drain()

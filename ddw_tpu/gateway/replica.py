@@ -1,0 +1,151 @@
+"""ReplicaSet — one front door over N serving-engine replicas.
+
+A single :class:`~ddw_tpu.serve.ServingEngine` is bounded by its slot pool:
+``n_slots`` sequences decode per dispatch and everyone else queues. The
+fleet answer is horizontal — more engine replicas, each with its own
+compiled programs and KV pool — and this class is the piece that makes N
+replicas look like one engine to the transport layer above it:
+
+- **routing** is least-outstanding-requests: every submission goes to the
+  replica with the fewest requests in flight *through this set* (queued or
+  decoding), ties broken by replica index. Outstanding counts are kept
+  here, incremented at submit and decremented by a future done-callback,
+  so routing needs no cross-thread peeking into engine internals;
+- **backpressure spills sideways once**: a submission refused with
+  :class:`~ddw_tpu.serve.Overloaded` by the least-loaded replica is
+  retried on the next-least-loaded sibling before the refusal surfaces —
+  one replica's full queue must not turn away traffic a sibling has room
+  for. A second refusal propagates to the caller (the gateway maps it to
+  429): when the whole fleet is full, the honest answer is still no;
+- **metrics aggregate** (:func:`ddw_tpu.serve.metrics.merge_metrics`):
+  ``snapshot()`` and ``prometheus()`` reduce over every replica's records,
+  so the SLO view and the ``/metrics`` scrape are fleet totals, with
+  per-replica outstanding gauges alongside.
+
+The submission surface mirrors the engine (``submit_generate`` /
+``submit_predict`` / ``warmup`` / ``start`` / ``stop`` / context manager),
+so anything written against one engine — the HTTP gateway, the load
+generator, the tests — serves a fleet by swapping the object.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ddw_tpu.serve.admission import Overloaded
+from ddw_tpu.serve.metrics import merge_metrics, render_prometheus
+
+__all__ = ["ReplicaSet"]
+
+
+class ReplicaSet:
+    """Least-outstanding-requests router over engine replicas."""
+
+    def __init__(self, replicas):
+        if hasattr(replicas, "submit_generate"):   # a bare engine
+            replicas = [replicas]
+        self.replicas = list(replicas)
+        if not self.replicas:
+            raise ValueError("ReplicaSet needs at least one engine replica")
+        self._outstanding = [0] * len(self.replicas)
+        self._lock = threading.Lock()
+        self.retried_429 = 0    # refusals absorbed by a sibling retry
+
+    # -- lifecycle (fan-out) ------------------------------------------------
+    def start(self) -> "ReplicaSet":
+        for eng in self.replicas:
+            eng.start()
+        return self
+
+    def stop(self) -> None:
+        for eng in self.replicas:
+            eng.stop()
+
+    def warmup(self, prompt_lens=(8,)) -> None:
+        for eng in self.replicas:
+            eng.warmup(prompt_lens)
+
+    def __enter__(self) -> "ReplicaSet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- routing ------------------------------------------------------------
+    def outstanding(self) -> list[int]:
+        with self._lock:
+            return list(self._outstanding)
+
+    def _route(self) -> list[int]:
+        """Replica indices to try, in order: least outstanding first, then
+        ONE sibling (the 429-retry budget)."""
+        with self._lock:
+            order = sorted(range(len(self.replicas)),
+                           key=lambda i: (self._outstanding[i], i))
+        return order[:2]
+
+    def _dec(self, i: int) -> None:
+        with self._lock:
+            self._outstanding[i] -= 1
+
+    def _submit(self, method: str, args, kwargs):
+        route, last = self._route(), None
+        for attempt, i in enumerate(route):
+            with self._lock:
+                self._outstanding[i] += 1
+            try:
+                fut = getattr(self.replicas[i], method)(*args, **kwargs)
+            except Overloaded as e:
+                self._dec(i)
+                last = e
+                if attempt + 1 < len(route):
+                    with self._lock:
+                        self.retried_429 += 1
+                    continue
+                raise
+            except BaseException:
+                self._dec(i)     # validation errors etc. must not leak
+                raise            # an outstanding count into the router
+            fut.add_done_callback(lambda _f, i=i: self._dec(i))
+            return fut
+        raise last  # single-replica set: the one refusal surfaces
+
+    # -- submission (engine surface) ----------------------------------------
+    def submit_generate(self, prompt, num_steps: int, **kw):
+        return self._submit("submit_generate", (prompt, num_steps), kw)
+
+    def submit_predict(self, item, **kw):
+        return self._submit("submit_predict", (item,), kw)
+
+    def generate(self, prompt, num_steps: int, **kw):
+        return self.submit_generate(prompt, num_steps, **kw).result()
+
+    def predict(self, items, timeout_s: float | None = None):
+        futs = [self.submit_predict(x, timeout_s=timeout_s) for x in items]
+        return [f.result() for f in futs]
+
+    # -- fleet metrics -------------------------------------------------------
+    def merged_metrics(self):
+        return merge_metrics([eng.metrics for eng in self.replicas])
+
+    def snapshot(self) -> dict[str, float]:
+        """Fleet SLO view: the merged engine snapshot plus the routing
+        layer's own numbers (replica count, sideways retries, outstanding
+        per replica)."""
+        out = self.merged_metrics().snapshot()
+        with self._lock:
+            outstanding = list(self._outstanding)
+            out["gateway.retried_429"] = float(self.retried_429)
+        out["gateway.replicas"] = float(len(self.replicas))
+        for i, n in enumerate(outstanding):
+            out[f"gateway.outstanding_r{i}"] = float(n)
+        return out
+
+    def prometheus(self) -> str:
+        with self._lock:
+            gauges = {f'ddw_gateway_outstanding{{replica="{i}"}}': float(n)
+                      for i, n in enumerate(self._outstanding)}
+            gauges["ddw_gateway_retried_429"] = float(self.retried_429)
+        gauges["ddw_gateway_replicas"] = float(len(self.replicas))
+        return render_prometheus([eng.metrics for eng in self.replicas],
+                                 extra_gauges=gauges)

@@ -18,5 +18,10 @@ from ddw_tpu.serve.engine import (  # noqa: F401
     PredictResult,
     ServingEngine,
 )
-from ddw_tpu.serve.metrics import EngineMetrics, RequestRecord  # noqa: F401
+from ddw_tpu.serve.metrics import (  # noqa: F401
+    LATENCY_BUCKETS_MS,
+    EngineMetrics,
+    RequestRecord,
+    render_prometheus,
+)
 from ddw_tpu.serve.slots import SlotPool  # noqa: F401

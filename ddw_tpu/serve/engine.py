@@ -25,10 +25,18 @@ the device busy with a small, fixed set of compiled programs:
   runs are first-class tracked artifacts.
 
 The engine is in-process by design — the same shape as the rest of the
-stack (the Launcher's np=-1 mode, the in-tree tracker): a transport layer
-in front of it is somebody else's concern; everything behind the socket is
-here. Engine sampling supports per-request temperature; ``top_k``/``top_p``
-remain single-request-path features (``LMPackagedModel.generate``).
+stack (the Launcher's np=-1 mode, the in-tree tracker): everything behind
+the socket is here, and the socket itself is :mod:`ddw_tpu.gateway` (an
+HTTP front door over one or more engine replicas, docs/serving.md). Two
+hooks exist for that transport layer: ``submit_generate(on_token=...)``
+streams each token to the caller the moment the decode tick that produced
+it fetches (the gateway threads it into chunked HTTP responses), and the
+returned futures support ``cancel()`` — a request still queued is dropped
+before any device work and counted in ``snapshot()``; a request already in
+a slot runs to completion (eviction mid-chain would perturb neighbors for
+an answer nobody reads — the slot frees fastest by finishing). Engine
+sampling supports per-request temperature; ``top_k``/``top_p`` remain
+single-request-path features (``LMPackagedModel.generate``).
 """
 
 from __future__ import annotations
@@ -100,9 +108,10 @@ class _Times:
 
 class _LMRequest:
     __slots__ = ("prompt", "num_steps", "temperature", "keys", "deadline",
-                 "future", "times", "tokens", "emitted")
+                 "future", "times", "tokens", "emitted", "on_token")
 
-    def __init__(self, prompt, num_steps, temperature, keys, deadline, now):
+    def __init__(self, prompt, num_steps, temperature, keys, deadline, now,
+                 on_token=None):
         self.prompt = prompt
         self.num_steps = num_steps
         self.temperature = temperature
@@ -112,6 +121,18 @@ class _LMRequest:
         self.times = _Times(now)
         self.tokens: list[int] = []
         self.emitted = 0
+        self.on_token = on_token    # (index, token) -> None, engine thread
+
+    def emit(self, start: int) -> None:
+        """Stream tokens[start:] to the callback; a broken callback stops
+        its own stream but never the engine loop or the future."""
+        if self.on_token is None:
+            return
+        try:
+            for i in range(start, len(self.tokens[:self.num_steps])):
+                self.on_token(i, self.tokens[i])
+        except Exception:
+            self.on_token = None
 
 
 class _ImageRequest:
@@ -181,6 +202,13 @@ class ServingEngine:
     def start(self) -> "ServingEngine":
         if self._thread is None:
             self._stop.clear()
+            if self.run is not None:
+                import os
+
+                # per-request rows stream to disk as they complete, so a
+                # crashed/SIGKILLed server still leaves its forensics
+                self.metrics.stream_to(os.path.join(
+                    self.run.artifact_dir("serving"), "serve_requests.jsonl"))
             self._thread = threading.Thread(target=self._loop,
                                             name="ddw-serve", daemon=True)
             self._thread.start()
@@ -204,6 +232,7 @@ class ServingEngine:
             self._monitor = None
         if self.run is not None:
             self.metrics.log_to(self.run)
+        self.metrics.close_stream()
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -214,12 +243,21 @@ class ServingEngine:
     # -- submission (any thread) -------------------------------------------
     def submit_generate(self, prompt, num_steps: int,
                         temperature: float = 0.0, rng=None,
-                        timeout_s: float | None = None
-                        ) -> concurrent.futures.Future:
+                        timeout_s: float | None = None,
+                        on_token=None) -> concurrent.futures.Future:
         """Queue one LM continuation; returns a future resolving to a
         :class:`GenerateResult` (or raising ``Overloaded`` here /
         ``DeadlineExceeded`` on the future). ``prompt`` is 1-D ``[P]`` or
-        ``[1, P]`` int tokens; greedy at ``temperature == 0``."""
+        ``[1, P]`` int tokens; greedy at ``temperature == 0``.
+
+        ``on_token(index, token)`` is called from the engine thread the
+        moment each token's dispatch fetches — the streaming hook the HTTP
+        gateway builds chunked responses on. Keep it non-blocking (it runs
+        inside the serving hot loop); exceptions it raises end its own
+        stream, never the request. The future supports ``cancel()`` while
+        the request is still queued (dropped before any device work,
+        counted as ``serve.cancelled``); once admitted to a slot it runs to
+        completion."""
         if self._lm is None:
             raise ValueError("engine was built without an LM model")
         prompt = np.asarray(prompt, np.int32)
@@ -249,7 +287,8 @@ class ServingEngine:
         now = time.monotonic()
         timeout = self.cfg.default_timeout_s if timeout_s is None else timeout_s
         req = _LMRequest(prompt, num_steps, float(temperature), keys,
-                         now + timeout if timeout else None, now)
+                         now + timeout if timeout else None, now,
+                         on_token=on_token)
         self._offer("lm", req)
         return req.future
 
@@ -325,11 +364,23 @@ class ServingEngine:
             self._slot_req.clear()
 
     def _shed(self, req, kind: str) -> None:
+        if req.future.cancelled():      # cancelled first: nothing to tell
+            self.metrics.count_cancelled()
+            return
         self.metrics.count_deadline()
         waited = (time.monotonic() - req.times.submitted) * 1e3
         timeout = ((req.deadline - req.times.submitted) * 1e3
                    if req.deadline is not None else float("inf"))
         req.future.set_exception(DeadlineExceeded(kind, waited, timeout))
+
+    def _claim(self, req) -> bool:
+        """Transition a dequeued request to running; a False return means
+        the caller cancelled it while queued — drop it here, BEFORE any
+        device work, and count the drop."""
+        if req.future.set_running_or_notify_cancel():
+            return True
+        self.metrics.count_cancelled()
+        return False
 
     def _loop(self) -> None:
         try:
@@ -361,8 +412,10 @@ class ServingEngine:
         admitted, expired = self._ctrl.take("lm", free)
         for req in expired:
             self._shed(req, "lm")
+        n_taken = len(admitted)
+        admitted = [r for r in admitted if self._claim(r)]
         if not admitted:
-            return bool(expired)
+            return bool(expired) or n_taken > 0
         # group by length bucket: one prefill dispatch per group (an
         # admission burst after a wave of evictions costs O(buckets)
         # programs, not O(requests) round-trips on an idle pool)
@@ -397,6 +450,7 @@ class ServingEngine:
                 tok0 = int(toks[i])
                 req.tokens.append(tok0)
                 req.emitted = 1
+                req.emit(0)
                 if req.emitted >= req.num_steps:
                     self.pool.release(slot)
                     self._finish_lm(req)
@@ -421,8 +475,10 @@ class ServingEngine:
         finished = []
         for slot, req in self._slot_req.items():
             take = min(k, req.num_steps - req.emitted)
+            start = req.emitted
             req.tokens.extend(int(t) for t in toks[slot, :take])
             req.emitted += take
+            req.emit(start)
             if req.emitted >= req.num_steps:
                 finished.append(slot)
         self._cur = toks[:, -1].astype(np.int32).copy()
@@ -462,8 +518,10 @@ class ServingEngine:
         admitted, expired = self._ctrl.take("image", self.cfg.max_batch)
         for req in expired:
             self._shed(req, "image")
+        n_taken = len(admitted)
+        admitted = [r for r in admitted if self._claim(r)]
         if not admitted:
-            return bool(expired)
+            return bool(expired) or n_taken > 0
         now = time.monotonic()
         for req in admitted:
             req.times.admitted = now

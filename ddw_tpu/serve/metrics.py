@@ -13,6 +13,17 @@ with the raw per-request rows, so tails can be re-sliced after the fact).
 Percentiles interpolate (``np.percentile``) — with few samples, indexing
 ``int(0.99 * n)`` lands on the max and overstates tail fidelity (the same
 rule ``tools/serving_curve.py`` applies to its p90s).
+
+Two consumers beyond the tracker share this module:
+
+- the HTTP gateway's ``/metrics`` endpoint renders the same accumulators in
+  Prometheus text exposition format (:func:`render_prometheus` — counters,
+  gauges, and latency histograms over a fixed ms bucket ladder), merged
+  across every replica of a ``ReplicaSet`` so a scraper sees fleet totals;
+- :meth:`EngineMetrics.stream_to` appends one ``serve_requests.jsonl`` line
+  per completed request (flushed immediately), so a crashed or SIGKILLed
+  server still leaves its request forensics on disk instead of losing them
+  with the ``stop()`` that never ran.
 """
 
 from __future__ import annotations
@@ -26,6 +37,11 @@ import time
 import numpy as np
 
 QUANTILES = (50, 95, 99)
+
+# Prometheus histogram ladder (ms) — geometric-ish 1-2.5-5 decades wide
+# enough for CPU smoke and chip serving alike; le="+Inf" is implicit.
+LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0)
 
 
 @dataclasses.dataclass
@@ -66,11 +82,13 @@ class EngineMetrics:
         self._records: list[RequestRecord] = []
         self.shed_overloaded = 0
         self.shed_deadline = 0
+        self.cancelled = 0         # dropped via Future.cancel() while queued
         self.decode_ticks = 0      # chained decode dispatches
         self.prefills = 0
         self.image_batches = 0
         self._first_admit: float | None = None
         self._last_done: float | None = None
+        self._sink = None          # incremental serve_requests.jsonl stream
 
     # -- recording (engine side) -------------------------------------------
     def record(self, rec: RequestRecord) -> None:
@@ -80,6 +98,12 @@ class EngineMetrics:
                 self._first_admit = rec.admitted
             if self._last_done is None or rec.done > self._last_done:
                 self._last_done = rec.done
+            if self._sink is not None:
+                try:
+                    self._sink.write(json.dumps(rec.to_dict()) + "\n")
+                    self._sink.flush()
+                except OSError:
+                    self._sink = None   # disk went away; keep serving
 
     def count_overloaded(self) -> None:
         with self._lock:
@@ -88,6 +112,37 @@ class EngineMetrics:
     def count_deadline(self) -> None:
         with self._lock:
             self.shed_deadline += 1
+
+    def count_cancelled(self) -> None:
+        with self._lock:
+            self.cancelled += 1
+
+    # -- incremental on-disk stream ----------------------------------------
+    def stream_to(self, path: str) -> None:
+        """Append every subsequent :meth:`record` to ``path`` as one flushed
+        JSONL line — request forensics survive a crash or SIGKILL that never
+        reaches :meth:`log_to`. Rows already recorded are written out first
+        so the file is complete from whenever streaming starts."""
+        with self._lock:
+            if self._sink is not None:
+                return
+            try:
+                sink = open(path, "w")
+                for rec in self._records:
+                    sink.write(json.dumps(rec.to_dict()) + "\n")
+                sink.flush()
+            except OSError:
+                return              # non-writable ranks keep the path only
+            self._sink = sink
+
+    def close_stream(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except OSError:
+                    pass
+                self._sink = None
 
     def count(self, field: str, n: int = 1) -> None:
         with self._lock:
@@ -103,6 +158,7 @@ class EngineMetrics:
                 "serve.completed": float(len(recs)),
                 "serve.shed_overloaded": float(self.shed_overloaded),
                 "serve.shed_deadline": float(self.shed_deadline),
+                "serve.cancelled": float(self.cancelled),
                 "serve.decode_ticks": float(self.decode_ticks),
                 "serve.prefills": float(self.prefills),
                 "serve.image_batches": float(self.image_batches),
@@ -129,11 +185,22 @@ class EngineMetrics:
         with self._lock:
             return list(self._records)
 
+    def prometheus(self) -> str:
+        """This engine's accumulators in Prometheus text exposition format
+        (:func:`render_prometheus` merges several for a replica fleet)."""
+        return render_prometheus([self])
+
     # -- export ------------------------------------------------------------
     def log_to(self, run, step: int = 0) -> None:
         """Write the snapshot as run metrics and the raw per-request rows as
-        a ``serve_requests.jsonl`` artifact (rank-0 discipline is the Run's)."""
+        a ``serve_requests.jsonl`` artifact (rank-0 discipline is the Run's).
+        With :meth:`stream_to` active the artifact is already on disk row by
+        row — only the metrics snapshot is written here."""
         run.log_metrics(self.snapshot(), step=step)
+        with self._lock:
+            streaming = self._sink is not None
+        if streaming:
+            return
         rows = self.records()
         art = run.artifact_dir("serving")
         path = os.path.join(art, "serve_requests.jsonl")
@@ -143,3 +210,110 @@ class EngineMetrics:
                     f.write(json.dumps(r.to_dict()) + "\n")
         except OSError:
             pass  # non-writable ranks get a path but no directory
+
+
+# -- Prometheus text exposition ---------------------------------------------
+
+_COUNTER_HELP = (
+    ("completed", "Requests completed successfully."),
+    ("shed_overloaded", "Submissions refused at the door (queue full)."),
+    ("shed_deadline", "Queued requests shed after their deadline passed."),
+    ("cancelled", "Queued requests dropped via Future.cancel()."),
+    ("prefills", "Grouped LM prefill dispatches."),
+    ("decode_ticks", "Chained slot-decode dispatches."),
+    ("image_batches", "Dynamic-batched image apply dispatches."),
+    ("tokens_out", "Generated LM tokens."),
+)
+_HISTOGRAMS = ("queue_ms", "ttft_ms", "total_ms")
+
+
+def _histogram_lines(name: str, values: np.ndarray) -> list[str]:
+    full = f"ddw_serve_{name}"
+    lines = [f"# HELP {full} Request {name.replace('_', ' ')} histogram.",
+             f"# TYPE {full} histogram"]
+    acc = 0
+    for le in LATENCY_BUCKETS_MS:
+        acc = int((values <= le).sum())
+        lines.append(f'{full}_bucket{{le="{le:g}"}} {acc}')
+    lines.append(f'{full}_bucket{{le="+Inf"}} {values.size}')
+    lines.append(f"{full}_sum {float(values.sum()):g}")
+    lines.append(f"{full}_count {values.size}")
+    return lines
+
+
+def merge_metrics(metrics_list) -> "EngineMetrics":
+    """Fold several engines' accumulators into one read-only view — the
+    fleet aggregation a :class:`ddw_tpu.gateway.ReplicaSet` snapshot and
+    the gateway ``/metrics`` endpoint are built on. Counters sum, records
+    concatenate (so percentiles are over the union), and the busy window
+    spans first admission to last completion across every replica."""
+    out = EngineMetrics()
+    for m in metrics_list:
+        with m._lock:
+            out._records.extend(m._records)
+            out.shed_overloaded += m.shed_overloaded
+            out.shed_deadline += m.shed_deadline
+            out.cancelled += m.cancelled
+            out.decode_ticks += m.decode_ticks
+            out.prefills += m.prefills
+            out.image_batches += m.image_batches
+            if m._first_admit is not None:
+                out._first_admit = (m._first_admit if out._first_admit is None
+                                    else min(out._first_admit, m._first_admit))
+            if m._last_done is not None:
+                out._last_done = (m._last_done if out._last_done is None
+                                  else max(out._last_done, m._last_done))
+    return out
+
+
+def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
+                      = None) -> str:
+    """Render one or more :class:`EngineMetrics` as Prometheus text
+    exposition (version 0.0.4), MERGED — counters sum, histogram buckets
+    accumulate over every replica's records, and the throughput gauge spans
+    the union busy window. ``extra_gauges`` lets the caller (the gateway)
+    add fleet-level gauges like outstanding requests per replica."""
+    recs: list[RequestRecord] = []
+    counters = {name: 0.0 for name, _ in _COUNTER_HELP}
+    first, last = None, None
+    for m in metrics_list:
+        with m._lock:
+            recs.extend(m._records)
+            counters["shed_overloaded"] += m.shed_overloaded
+            counters["shed_deadline"] += m.shed_deadline
+            counters["cancelled"] += m.cancelled
+            counters["prefills"] += m.prefills
+            counters["decode_ticks"] += m.decode_ticks
+            counters["image_batches"] += m.image_batches
+            if m._first_admit is not None:
+                first = (m._first_admit if first is None
+                         else min(first, m._first_admit))
+            if m._last_done is not None:
+                last = (m._last_done if last is None
+                        else max(last, m._last_done))
+    counters["completed"] = float(len(recs))
+    tokens = sum(r.tokens for r in recs)
+    counters["tokens_out"] = float(tokens)
+
+    lines: list[str] = []
+    for name, help_ in _COUNTER_HELP:
+        full = f"ddw_serve_{name}_total"
+        lines += [f"# HELP {full} {help_}", f"# TYPE {full} counter",
+                  f"{full} {counters[name]:g}"]
+    tps = (tokens / (last - first)
+           if tokens and last is not None and last > first else 0.0)
+    lines += ["# HELP ddw_serve_tokens_per_sec Aggregate decode throughput "
+              "over the busy window.",
+              "# TYPE ddw_serve_tokens_per_sec gauge",
+              f"ddw_serve_tokens_per_sec {tps:g}"]
+    typed: set[str] = set()     # one TYPE line per family, labels or not
+    for key, val in (extra_gauges or {}).items():
+        base = key.split("{")[0]
+        if base not in typed:
+            typed.add(base)
+            lines.append(f"# TYPE {base} gauge")
+        lines.append(f"{key} {val:g}")
+    for name in _HISTOGRAMS:
+        vals = np.asarray([getattr(r, name) for r in recs], np.float64)
+        lines += _histogram_lines(name, vals)
+    return "\n".join(lines) + "\n"

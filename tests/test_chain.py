@@ -274,6 +274,9 @@ def test_fetch_metrics_mean_exact():
     assert np.isnan(fetch_metrics_mean([]))
 
 
+# tier-2: full-Trainer chain-vs-K=1 drill (the step-level chain
+# equivalence pins above stay tier-1)
+@pytest.mark.slow
 def test_trainer_steps_per_dispatch_equivalence(small_cfgs, silver):
     """End to end: Trainer with steps_per_dispatch=4 (full chains + a partial
     tail + loader device-stacking) matches the per-step run — same history
@@ -313,6 +316,9 @@ def test_trainer_steps_per_dispatch_equivalence(small_cfgs, silver):
                                    rtol=5e-3, atol=2e-4)
 
 
+# tier-2: full-LMTrainer chain-vs-K=1 drill (step-level LM chain
+# equivalence stays tier-1)
+@pytest.mark.slow
 def test_lm_trainer_steps_per_dispatch_equivalence():
     from ddw_tpu.train.lm_trainer import LMTrainer
     from ddw_tpu.utils.config import LMCfg

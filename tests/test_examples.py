@@ -76,6 +76,8 @@ _EXAMPLES = [
     pytest.param("13_supervised_gang.py", [], "resume_step=3", marks=_slow),
     pytest.param("14_online_serving.py", [],
                  "engine_matches_sequential=12/12", marks=_slow),
+    pytest.param("15_http_gateway.py", [],
+                 "http_matches_sequential=10/10", marks=_slow),
 ]
 
 

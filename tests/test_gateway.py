@@ -1,0 +1,420 @@
+"""HTTP gateway (ddw_tpu.gateway): streaming fidelity over the wire,
+admission status-code mapping, Retry-After-honoring client backoff,
+least-outstanding replica routing, and the SIGTERM drain lifecycle.
+
+Tier-1 discipline (the 870s budget): ONE module-scoped gateway over the
+shared tiny LM package serves every test that can share compiled programs;
+the drain test runs LAST in this file because draining is terminal. The
+429/504 mapping test needs its own one-slot gateway (different program
+set); the backoff and routing tests use stub servers / fake engines and
+never touch jax. The two-replica soak rides in tier-2 (``slow``) with the
+load-generator sweep (tests/test_load_gen.py).
+"""
+
+import http.server
+import json
+import os
+import signal
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ddw_tpu.gateway import (
+    Gateway,
+    GatewayClient,
+    GatewayDeadline,
+    GatewayOverloaded,
+    GatewayUnavailable,
+    ReplicaSet,
+    runtime_grace_s,
+)
+from ddw_tpu.serve import EngineCfg, Overloaded, ServingEngine
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    cfg = LMCfg(vocab_size=VOCAB, max_len=96, hidden=32, depth=2,
+                num_heads=2, mlp_dim=64, dropout=0.0, dtype="float32")
+    from ddw_tpu.models.lm import build_lm
+
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int32))["params"]
+    out = str(tmp_path_factory.mktemp("gw_pkg") / "pkg")
+    return load_lm_package(save_lm_package(out, cfg, params))
+
+
+@pytest.fixture(scope="module")
+def gw(pm):
+    """The shared gateway: one replica, 2 slots, warmed for buckets 8/16.
+    The drain test (last in this file) drains it; teardown is idempotent."""
+    g = Gateway(ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2,
+                                                   steps_per_tick=2)),
+                grace_s=60.0)
+    g.start(warmup_prompt_lens=(8, 16))
+    yield g
+    g.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(gw):
+    c = GatewayClient("127.0.0.1", gw.port)
+    assert c.wait_ready(30.0)
+    return c
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+# -- end-to-end fidelity: HTTP == engine == sequential -----------------------
+
+def test_streaming_and_unary_match_sequential(pm, gw, cli):
+    """Tokens over the wire — chunked streaming AND unary JSON — are
+    identical to the sequential generate path, for concurrent greedy and
+    seeded-sampling requests landing on a shared slot pool."""
+    prompts = _prompts([3, 9, 14, 5], seed=1)
+    steps = 10
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+    sref = pm.generate(prompts[1][None, :], steps,
+                       rng=jax.random.PRNGKey(11), temperature=0.7)[0]
+
+    results: dict[int, dict] = {}
+    streamed: dict[int, list] = {0: [], 2: []}
+
+    def call(i, stream):
+        on_tok = (lambda idx, t, i=i: streamed[i].append((idx, t))) \
+            if stream else None
+        results[i] = cli.generate(prompts[i], steps, stream=stream,
+                                  on_token=on_tok)
+
+    threads = [threading.Thread(target=call, args=(i, i % 2 == 0))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i, ref in enumerate(refs):
+        assert np.array_equal(results[i]["tokens"], ref), i
+        assert results[i]["total_ms"] >= results[i]["ttft_ms"] >= 0
+    # streamed callbacks saw every token, in order, same values
+    for i in (0, 2):
+        assert [idx for idx, _ in streamed[i]] == list(range(steps))
+        assert [t for _, t in streamed[i]] == list(results[i]["tokens"])
+
+    # seeded sampling over HTTP follows generate()'s key schedule exactly
+    out = cli.generate(prompts[1], steps, temperature=0.7, seed=11,
+                       stream=True)
+    assert np.array_equal(out["tokens"], sref)
+    assert out["done"] is True and out["num_tokens"] == steps
+
+
+def test_health_metrics_stats_endpoints(gw, cli):
+    assert cli.healthz()["status"] == "alive"
+    status, body = cli.readyz()
+    assert status == 200 and body["status"] == "ready"
+    text = cli.metrics_text()
+    for needle in ("ddw_serve_completed_total", "ddw_serve_tokens_out_total",
+                   'ddw_serve_ttft_ms_bucket{le="+Inf"}',
+                   "ddw_serve_total_ms_count", "ddw_gateway_replicas 1"):
+        assert needle in text, needle
+    # histogram buckets are cumulative and end at the count
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("ddw_serve_total_ms_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert counts == sorted(counts)
+    count = int(float([ln for ln in text.splitlines()
+                       if ln.startswith("ddw_serve_total_ms_count")]
+                      [0].rsplit(" ", 1)[1]))
+    assert counts[-1] == count >= 1
+    stats = cli.stats()
+    assert stats["state"] == "ready"
+    assert stats["serve.completed"] >= 5.0
+    assert stats["gateway.replicas"] == 1.0
+    assert "gateway.outstanding_r0" in stats
+    # malformed requests map to 400, unknown paths to 404
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=10)
+    conn.request("POST", "/v1/generate", body=b"{not json",
+                 headers={"Content-Length": "9"})
+    assert conn.getresponse().status == 400
+    conn.close()
+    with pytest.raises(Exception) as exc:
+        cli._json_call("GET", "/nope")
+    assert getattr(exc.value, "status", None) == 404
+
+
+# -- admission over HTTP: 429 + Retry-After, 504 deadline --------------------
+
+def test_429_maps_overloaded_and_504_maps_deadline(pm):
+    """Queue-full refusals become 429 with the engine's exact
+    ``retry_after_ms`` in the body and a consistent ``Retry-After`` header;
+    deadline sheds become 504 — both structured, straight from
+    ``Rejected.to_dict()``."""
+    g = Gateway(ServingEngine(lm=pm, cfg=EngineCfg(
+        n_slots=1, steps_per_tick=1, queue_depth=1)), grace_s=60.0)
+    g.start(warmup_prompt_lens=(8,))
+    try:
+        raw = GatewayClient("127.0.0.1", g.port, max_retries=0)
+        assert raw.wait_ready(30.0)
+        p = _prompts([5])[0]
+        raw.generate(p, 2)          # seeds the service-time estimate
+        box, first_tok = {}, threading.Event()
+        t = threading.Thread(target=lambda: box.update(r=raw.generate(
+            p, 80, stream=True,
+            on_token=lambda i, tok: first_tok.set())))
+        t.start()
+        assert first_tok.wait(30.0)  # the only slot is now provably busy
+        # 1) deadline shed while queued (queue empty, slot busy) -> 504,
+        #    before any device work is spent on it
+        with pytest.raises(GatewayDeadline) as exc2:
+            raw.generate(p, 2, timeout_s=0.01)
+        assert exc2.value.body["error"] == "deadline_exceeded"
+        assert exc2.value.body["waited_ms"] >= 10.0
+        # 2) fill the depth-1 queue, then the next submission -> 429
+        fill = threading.Thread(target=lambda: box.update(
+            q=raw.generate(p, 2)))
+        fill.start()
+        time.sleep(0.03)             # fill is queued behind the busy slot
+        with pytest.raises(GatewayOverloaded) as exc:
+            raw.generate(p, 2)
+        body = exc.value.body
+        assert body["error"] == "overloaded"
+        assert body["capacity"] == 1 and body["depth"] == 1
+        assert body["retry_after_ms"] > 0      # estimate was seeded
+        t.join(timeout=60)
+        fill.join(timeout=60)
+        assert len(box["r"]["tokens"]) == 80 and len(box["q"]["tokens"]) == 2
+        snap = raw.stats()
+        assert snap["serve.shed_overloaded"] >= 1.0
+        assert snap["serve.shed_deadline"] >= 1.0
+    finally:
+        g.stop()
+
+
+def test_client_backoff_honors_retry_after():
+    """No engine, no jax: a scripted stub server returns 429 twice — first
+    with the precise body ``retry_after_ms``, then with only the header —
+    and the client's observed inter-attempt gaps honor each in turn."""
+    script = [
+        (429, {"Retry-After": "9"}, {"error": "overloaded",
+                                     "retry_after_ms": 150.0}),
+        (429, {"Retry-After": "1"}, {"error": "overloaded"}),
+        (200, {}, {"tokens": [7], "queue_ms": 0.0}),
+    ]
+    arrivals = []
+
+    class Stub(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            arrivals.append(time.monotonic())
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            status, headers, body = script[min(len(arrivals) - 1,
+                                               len(script) - 1)]
+            data = json.dumps(body).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        c = GatewayClient("127.0.0.1", srv.server_address[1], max_retries=3)
+        out = c.generate([1, 2, 3], 1)
+        assert out["tokens"] == [7] and c.retries == 2
+        gap1 = arrivals[1] - arrivals[0]
+        gap2 = arrivals[2] - arrivals[1]
+        # body ms wins over the coarse header (0.15s, NOT 9s); header-only
+        # falls back to Retry-After seconds (1s)
+        assert 0.15 <= gap1 < 1.0, gap1
+        assert 1.0 <= gap2 < 3.0, gap2
+
+        # 504 is never retried — the request's own deadline already died
+        script[:] = [(504, {}, {"error": "deadline_exceeded"})]
+        arrivals.clear()
+        with pytest.raises(GatewayDeadline):
+            c.generate([1], 1)
+        assert len(arrivals) == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# -- replica routing (no jax: scripted fake engines) -------------------------
+
+class _FakeEngine:
+    def __init__(self, refuse: int = 0):
+        from ddw_tpu.serve.metrics import EngineMetrics
+
+        self.refuse = refuse        # how many submissions to 429 first
+        self.futures = []
+        self.calls = 0
+        self.metrics = EngineMetrics()
+
+    def start(self):
+        return self
+
+    def stop(self):
+        pass
+
+    def warmup(self, *a, **kw):
+        pass
+
+    def submit_generate(self, prompt, num_steps, **kw):
+        self.calls += 1
+        if self.refuse > 0:
+            self.refuse -= 1
+            raise Overloaded("lm", 1, 1, retry_after_ms=42.0)
+        import concurrent.futures
+
+        f = concurrent.futures.Future()
+        self.futures.append(f)
+        return f
+
+
+def test_replica_set_routes_least_outstanding_and_spills_429():
+    a, b = _FakeEngine(), _FakeEngine()
+    rs = ReplicaSet([a, b])
+    f0 = rs.submit_generate([1], 1)   # -> a (tie, lowest index)
+    rs.submit_generate([1], 1)        # -> b (a has 1 outstanding)
+    rs.submit_generate([1], 1)        # -> a or b tie again -> a
+    assert (a.calls, b.calls) == (2, 1)
+    assert rs.outstanding() == [2, 1]
+    f0.set_result(None)               # done-callback releases the count
+    assert rs.outstanding() == [1, 1]
+
+    # a full least-loaded replica spills sideways exactly once
+    a2, b2 = _FakeEngine(refuse=1), _FakeEngine()
+    rs2 = ReplicaSet([a2, b2])
+    fut = rs2.submit_generate([1], 1)
+    assert fut in b2.futures and rs2.retried_429 == 1
+    assert rs2.outstanding() == [0, 1]
+    snap = rs2.snapshot()
+    assert snap["gateway.replicas"] == 2.0
+    assert snap["gateway.retried_429"] == 1.0
+
+    # the WHOLE fleet full -> the refusal surfaces
+    a3, b3 = _FakeEngine(refuse=5), _FakeEngine(refuse=5)
+    rs3 = ReplicaSet([a3, b3])
+    with pytest.raises(Overloaded):
+        rs3.submit_generate([1], 1)
+    assert rs3.outstanding() == [0, 0]
+
+    # single-replica set: no sibling, refusal immediate
+    with pytest.raises(Overloaded):
+        ReplicaSet([_FakeEngine(refuse=1)]).submit_generate([1], 1)
+
+
+# -- two-replica soak (tier-2: a second compiled engine + heavy traffic) -----
+
+@pytest.mark.slow
+def test_two_replica_fleet_soak_deterministic(pm):
+    """24 concurrent requests spread over a 2-replica fleet by the
+    least-outstanding router: every output token-identical to the
+    sequential path regardless of which replica served it, fleet metrics
+    sum across replicas, and both replicas actually took traffic."""
+    engines = [ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2,
+                                                  steps_per_tick=2))
+               for _ in range(2)]
+    g = Gateway(ReplicaSet(engines), grace_s=60.0)
+    g.start(warmup_prompt_lens=(8, 16))
+    try:
+        c = GatewayClient("127.0.0.1", g.port)
+        assert c.wait_ready(60.0)
+        prompts = _prompts([3, 9, 14, 5, 21, 7, 11, 4] * 3, seed=7)
+        steps = 8
+        refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+        results = {}
+
+        def call(i):
+            results[i] = c.generate(prompts[i], steps, stream=(i % 3 == 0))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for i, ref in enumerate(refs):
+            assert np.array_equal(results[i]["tokens"], ref), i
+        snap = c.stats()
+        assert snap["serve.completed"] == float(len(prompts))
+        assert snap["gateway.replicas"] == 2.0
+        per_replica = [e.metrics.snapshot()["serve.completed"]
+                       for e in engines]
+        assert sum(per_replica) == len(prompts)
+        assert all(n > 0 for n in per_replica), per_replica
+        text = c.metrics_text()
+        assert f"ddw_serve_completed_total {len(prompts)}" in text
+        assert 'ddw_gateway_outstanding{replica="1"} 0' in text
+    finally:
+        g.stop()
+
+
+# -- drain lifecycle (LAST: draining the module gateway is terminal) ---------
+
+def test_sigterm_drains_inflight_and_refuses_new(pm, gw, cli):
+    """The acceptance pin: a SIGTERM'd gateway finishes every in-flight
+    request within the grace window (full token stream delivered) while
+    refusing new ones with 503, then stops cleanly."""
+    assert runtime_grace_s() == 10.0   # the runtime layer's default window
+    gw.install_sigterm()
+    prompt = _prompts([5], seed=4)[0]
+    ref = pm.generate(prompt[None, :], 80)[0]
+    seen, box = [], {}
+
+    def long_req():
+        box["r"] = cli.generate(prompt, 80, stream=True,
+                                on_token=lambda i, t: seen.append(t))
+
+    t = threading.Thread(target=long_req)
+    t.start()
+    deadline = time.monotonic() + 30
+    while not seen and time.monotonic() < deadline:
+        time.sleep(0.002)              # stream provably in flight
+    assert seen, "stream never started"
+    port = gw.port                         # read before teardown races us
+    os.kill(os.getpid(), signal.SIGTERM)   # -> lifecycle drain thread
+    raw = GatewayClient("127.0.0.1", port, max_retries=0)
+    refused = status = None
+    try:
+        status, _body = raw.readyz()
+        raw.generate(prompt, 2)
+    except GatewayUnavailable as e:
+        refused = e
+    except OSError:
+        refused = "closed"       # drain already finished server teardown
+    t.join(timeout=60)
+    # in-flight completed in full, token-identical, despite the drain
+    assert np.array_equal(box["r"]["tokens"], ref)
+    for _ in range(300):
+        if gw.lifecycle.state == "stopped":
+            break
+        time.sleep(0.05)
+    assert gw.lifecycle.state == "stopped"
+    assert gw.drained_clean is True
+    # the refusal observed during the window was a 503 (or the listener
+    # was already gone — the drain had nothing left to wait for)
+    if isinstance(refused, GatewayUnavailable):
+        assert refused.body["error"] == "unavailable"
+        assert status in (200, 503)    # readyz raced the drain start
+    gw.lifecycle.restore_sigterm()     # main thread can restore
+    assert signal.getsignal(signal.SIGTERM) is not None

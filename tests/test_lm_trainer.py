@@ -100,6 +100,9 @@ def test_fit_pipeline_gpipe_and_resume(tmp_path):
     assert res4.history[0]["epoch"] == 2
 
 
+# tier-2: EMA x pipeline variant drill (EMA shadow-eval pin stays
+# tier-1 in test_ema_evaluates_shadow; pipeline fit in the gpipe arm)
+@pytest.mark.slow
 def test_fit_pipeline_with_ema():
     """pipeline_stages + ema_decay: the shadow is pp-layout opt_state, rides
     the stacked-stage sharding, and eval reads it through the pipeline eval
@@ -118,6 +121,9 @@ def test_fit_pipeline_with_ema():
     assert jax.tree.structure(shadow) == jax.tree.structure(res.state.params)
 
 
+# tier-2: second pipeline schedule variant (gpipe arm is the tier-1
+# representative)
+@pytest.mark.slow
 def test_fit_pipeline_interleaved():
     import dataclasses
 
@@ -218,6 +224,10 @@ def test_tracker_logging(tmp_path):
     assert len(hist) == 2
 
 
+# tier-2: full tables->loader->fit->resume integration sweep (fit
+# learning pinned tier-1 by test_fit_learns_dp; resume by
+# test_checkpoint_resume_continues)
+@pytest.mark.slow
 def test_fit_tables_learns_and_resumes(tmp_path):
     """The LM family through the store -> sharded-loader path: token tables
     materialized with write_token_table, trained via fit_tables with exact
@@ -292,6 +302,8 @@ def test_best_checkpoint_keeper_slot_semantics(tmp_path):
     k2.close()
 
 
+# tier-2: checkpoint retention-policy drill over a full fit
+@pytest.mark.slow
 def test_keep_best_checkpoint(tmp_path):
     """checkpoint_keep_best through the trainer: the <dir>/best slot tracks
     the minimum val_loss across the original fit AND its resume (the resume
@@ -357,6 +369,8 @@ def test_refusals():
             _tokens(seq=15))
 
 
+# tier-2: LR-plateau behavior drill over a full fit
+@pytest.mark.slow
 def test_plateau_actually_cuts_lr():
     """A non-improving val_loss must reduce the LIVE LR — the cut lands in
     the returned state (history rows record lr before that epoch's cut, so

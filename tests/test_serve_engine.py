@@ -234,6 +234,42 @@ def test_int8_image_package_through_engine_matches_direct(tmp_path):
     assert eng.metrics.snapshot()["serve.image_batches"] >= 1.0
 
 
+# -- cancellation -----------------------------------------------------------
+
+def test_cancel_queued_request_dropped_before_device_work(pm):
+    """Future.cancel() on a still-queued request drops it without any
+    prefill and counts it; a request already claimed by a slot runs to
+    completion (cancel() returns False)."""
+    import concurrent.futures
+
+    eng = ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2, steps_per_tick=2))
+    p1, p2 = _prompts([5, 6], seed=2)
+    f1 = eng.submit_generate(p1, 4)
+    f2 = eng.submit_generate(p2, 4)
+    assert f2.cancel()                 # still queued: drop is guaranteed
+    eng.start()
+    r1 = f1.result(timeout=120)
+    assert len(r1.tokens) == 4
+    with pytest.raises(concurrent.futures.CancelledError):
+        f2.result(timeout=10)
+    snap = eng.snapshot()
+    assert snap["serve.cancelled"] == 1.0
+    assert snap["serve.completed"] == 1.0
+    assert snap["serve.prefills"] == 1.0   # the cancelled one never ran
+    # once admitted to a slot, cancel() is refused and the request finishes
+    got = []
+    f3 = eng.submit_generate(p1, 6, on_token=lambda i, t: got.append(t))
+    deadline = time.monotonic() + 60
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert got, "first token never streamed"
+    assert not f3.cancel()
+    r3 = f3.result(timeout=120)
+    assert np.array_equal(r3.tokens, pm.generate(p1[None, :], 6)[0])
+    assert got == list(r3.tokens)      # on_token streamed every token
+    eng.stop()
+
+
 # -- SLO metrics + tracker export -------------------------------------------
 
 def test_metrics_snapshot_and_tracker_export(pm, tmp_path):
@@ -248,6 +284,13 @@ def test_metrics_snapshot_and_tracker_export(pm, tmp_path):
         futs = [eng.submit_generate(p, 6) for p in prompts]
         [f.result(timeout=120) for f in futs]
         snap = eng.snapshot()
+        # the jsonl artifact streams incrementally: all completed rows are
+        # already on disk (flushed) while the engine is still live — a
+        # SIGKILL here would lose nothing
+        live = os.path.join(run.run_dir, "artifacts", "serving",
+                            "serve_requests.jsonl")
+        rows_live = [json.loads(ln) for ln in open(live)]
+        assert len(rows_live) == 4
     run.end()
     assert snap["serve.completed"] == 4.0
     for key in ("serve.queue_ms_p50", "serve.queue_ms_p95",
@@ -264,6 +307,58 @@ def test_metrics_snapshot_and_tracker_export(pm, tmp_path):
                        "serve_requests.jsonl")
     rows = [json.loads(ln) for ln in open(art)]
     assert len(rows) == 4 and all(r["kind"] == "lm" for r in rows)
+
+
+# -- Prometheus text exposition (pure unit: synthetic records) ---------------
+
+def test_prometheus_rendering_and_fleet_merge():
+    from ddw_tpu.serve import EngineMetrics, RequestRecord, render_prometheus
+    from ddw_tpu.serve.metrics import merge_metrics
+
+    a, b = EngineMetrics(), EngineMetrics()
+    t0 = 100.0
+    for m, offs, tokens in ((a, 0.0, 6), (a, 0.004, 8), (b, 0.030, 4)):
+        m.record(RequestRecord("lm", t0 + offs, t0 + offs + 0.001,
+                               t0 + offs + 0.003, t0 + offs + 0.008,
+                               tokens=tokens))
+    a.count_overloaded()
+    b.count_deadline()
+    b.count_cancelled()
+    a.count("prefills", 2)
+    b.count("decode_ticks", 5)
+
+    text = render_prometheus([a, b])
+    lines = dict(ln.rsplit(" ", 1) for ln in text.splitlines()
+                 if ln and not ln.startswith("#"))
+    assert lines["ddw_serve_completed_total"] == "3"
+    assert lines["ddw_serve_tokens_out_total"] == "18"
+    assert lines["ddw_serve_shed_overloaded_total"] == "1"
+    assert lines["ddw_serve_shed_deadline_total"] == "1"
+    assert lines["ddw_serve_cancelled_total"] == "1"
+    assert lines["ddw_serve_prefills_total"] == "2"
+    assert lines["ddw_serve_decode_ticks_total"] == "5"
+    # histogram: all three total_ms values are 8 ms -> cumulative counts
+    # 0 below the 10 ms bucket, 3 from it onward, +Inf == count
+    assert lines['ddw_serve_total_ms_bucket{le="5"}'] == "0"
+    assert lines['ddw_serve_total_ms_bucket{le="10"}'] == "3"
+    assert lines['ddw_serve_total_ms_bucket{le="+Inf"}'] == "3"
+    assert lines["ddw_serve_total_ms_count"] == "3"
+    assert float(lines["ddw_serve_total_ms_sum"]) == pytest.approx(24.0)
+    # busy-window throughput spans the union of both replicas' windows:
+    # first admit 100.001, last done 100.038 -> 18 tokens / 0.037 s
+    assert float(lines["ddw_serve_tokens_per_sec"]) == pytest.approx(
+        18 / 0.037, rel=1e-4)      # %g renders 6 significant digits
+    # the merged snapshot agrees with the exposition
+    snap = merge_metrics([a, b]).snapshot()
+    assert snap["serve.completed"] == 3.0
+    assert snap["serve.tokens_out"] == 18.0
+    assert snap["serve.cancelled"] == 1.0
+    # labeled extra gauges get exactly one TYPE line per family
+    text2 = render_prometheus([a], extra_gauges={
+        'ddw_gateway_outstanding{replica="0"}': 1.0,
+        'ddw_gateway_outstanding{replica="1"}': 2.0})
+    assert text2.count("# TYPE ddw_gateway_outstanding gauge") == 1
+    assert 'ddw_gateway_outstanding{replica="1"} 2' in text2
 
 
 # -- continuous batching beats sequential -----------------------------------
