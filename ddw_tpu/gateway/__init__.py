@@ -3,15 +3,20 @@
 Layering (docs/serving.md "The HTTP gateway"):
 
 - :mod:`~ddw_tpu.gateway.http` — ``Gateway``: stdlib ThreadingHTTPServer
-  JSON API with chunked per-token streaming, 429/504 mapping from the
-  engine's structured refusals;
-- :mod:`~ddw_tpu.gateway.replica` — ``ReplicaSet``: least-outstanding
-  routing across N engine replicas, one sideways retry on a full queue,
-  fleet-merged metrics;
+  JSON API with chunked per-token streaming, keep-alive with a bounded
+  connection guard, 429/503/504 mapping from the engine's structured
+  refusals;
+- :mod:`~ddw_tpu.gateway.replica` — ``ReplicaSet``: admission-aware
+  routing across N engine replicas behind per-replica circuit breakers,
+  one sideways retry on a full queue, failover of a dead replica's queued
+  work, fleet-merged metrics;
+- :mod:`~ddw_tpu.gateway.supervisor` — ``ReplicaSupervisor``: bounded
+  auto-restart of failed/stalled replicas with warmup-gated rejoin;
 - :mod:`~ddw_tpu.gateway.lifecycle` — ``ServerLifecycle``: readiness gated
-  on warmup, SIGTERM drain within the runtime layer's grace window;
+  on warmup (and on having live replicas), SIGTERM drain within the
+  runtime layer's grace window;
 - :mod:`~ddw_tpu.gateway.client` — ``GatewayClient``: reference client
-  whose backoff honors ``Retry-After``.
+  whose backoff honors ``Retry-After`` and reuses keep-alive connections.
 """
 
 from ddw_tpu.gateway.client import (  # noqa: F401
@@ -30,4 +35,14 @@ from ddw_tpu.gateway.lifecycle import (  # noqa: F401
     ServerLifecycle,
     runtime_grace_s,
 )
-from ddw_tpu.gateway.replica import ReplicaSet  # noqa: F401
+from ddw_tpu.gateway.replica import (  # noqa: F401
+    CIRCUIT_CLOSED,
+    CIRCUIT_HALF_OPEN,
+    CIRCUIT_OPEN,
+    CircuitBreaker,
+    ReplicaSet,
+)
+from ddw_tpu.gateway.supervisor import (  # noqa: F401
+    ReplicaAttempt,
+    ReplicaSupervisor,
+)

@@ -15,15 +15,28 @@ and ``tools/load_gen.py``, and is the reference for what any real client
 enough that this file IS the spec: JSON bodies, NDJSON streaming lines,
 and the status table in :mod:`ddw_tpu.gateway.http`.
 
-Retryable: 429 (engine queue full) and 503 (gateway starting or draining —
-a fleet peer may answer; the balancer decides). Not retryable: 504 (the
-request's own deadline died — retrying re-spends it), 400, 500.
+Retryable: 429 (engine queue full) and 503 (gateway starting, draining, a
+replica died mid-request, or every circuit is open — a fleet peer or the
+supervisor's restarted replica may answer; the balancer decides). Not
+retryable: 504 (the request's own deadline died — retrying re-spends it),
+400, 500.
+
+Connections are HTTP/1.1 keep-alive and REUSED: completed unary exchanges
+return their connection to a small per-client pool, so a retry storm (the
+chaos drill: one replica dies, every client backs off and re-asks) does
+not re-handshake per attempt and the gateway's ``max_connections`` guard
+is not eaten by churn. Streaming responses close their connection (the
+server ends the chunked stream with ``Connection: close``). A pooled
+connection the server quietly closed between requests is detected on use
+and replayed once on a fresh one. One client per thread is the intended
+shape (the pool makes sharing safe, not fast).
 """
 
 from __future__ import annotations
 
 import http.client
 import json
+import threading
 import time
 
 __all__ = ["GatewayClient", "GatewayError", "GatewayOverloaded",
@@ -61,18 +74,49 @@ class GatewayClient:
 
     def __init__(self, host: str, port: int, timeout_s: float = 60.0,
                  max_retries: int = 4, backoff_s: float = 0.05,
-                 max_backoff_s: float = 2.0):
+                 max_backoff_s: float = 2.0, pool_size: int = 4):
         self.host, self.port = host, port
         self.timeout_s = timeout_s
         self.max_retries = max_retries
         self.backoff_s = backoff_s
         self.max_backoff_s = max_backoff_s
+        self.pool_size = pool_size
         self.retries = 0            # total backoff sleeps taken (telemetry)
+        self.reused = 0             # keep-alive connections reused
+        self._pool: list[http.client.HTTPConnection] = []
+        self._pool_lock = threading.Lock()
 
     # -- transport -----------------------------------------------------------
     def _connect(self) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout_s)
+
+    def _acquire(self) -> tuple[http.client.HTTPConnection, bool]:
+        """A pooled keep-alive connection when one is idle, else fresh.
+        The bool says "pooled" — a stale pooled socket gets one replay."""
+        with self._pool_lock:
+            if self._pool:
+                self.reused += 1
+                return self._pool.pop(), True
+        return self._connect(), False
+
+    def _done(self, conn: http.client.HTTPConnection, resp) -> None:
+        """Return a fully-read connection to the pool (keep-alive) or close
+        it (server said close / stream / pool full)."""
+        reusable = (resp is not None and not resp.will_close
+                    and resp.isclosed())
+        if reusable:
+            with self._pool_lock:
+                if len(self._pool) < self.pool_size:
+                    self._pool.append(conn)
+                    return
+        conn.close()
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            conn.close()
 
     def _retry_delay(self, resp_headers, body: dict, attempt: int) -> float:
         ms = body.get("retry_after_ms") if isinstance(body, dict) else None
@@ -94,17 +138,26 @@ class GatewayClient:
         payload = json.dumps(body).encode() if body is not None else None
         attempt = 0
         while True:
-            conn = self._connect()
+            conn, pooled = self._acquire()
             try:
-                headers = {"Content-Type": "application/json",
-                           "Connection": "close"}
-                conn.request(method, path, body=payload, headers=headers)
+                conn.request(method, path, body=payload,
+                             headers={"Content-Type": "application/json"})
                 resp = conn.getresponse()
+            except (OSError, http.client.BadStatusLine,
+                    http.client.CannotSendRequest) as e:
+                conn.close()
+                if pooled:      # the server closed the idle keep-alive
+                    continue    # socket between requests; replay fresh once
+                raise e
+            except Exception:
+                conn.close()
+                raise
+            try:
                 if retry and resp.status in _RETRYABLE \
                         and attempt < self.max_retries:
                     parsed = json.loads(resp.read() or b"{}")
                     delay = self._retry_delay(resp.headers, parsed, attempt)
-                    conn.close()
+                    self._done(conn, resp)
                     self.retries += 1
                     attempt += 1
                     time.sleep(delay)
@@ -119,8 +172,10 @@ class GatewayClient:
         status, _headers, resp, conn = self._request(method, path, body)
         try:
             parsed = json.loads(resp.read() or b"{}")
-        finally:
+            self._done(conn, resp)
+        except Exception:
             conn.close()
+            raise
         if status == 429:
             raise GatewayOverloaded(status, parsed)
         if status == 503:
@@ -199,9 +254,12 @@ class GatewayClient:
         status, _h, resp, conn = self._request("GET", "/readyz",
                                                retry=False)
         try:
-            return status, json.loads(resp.read() or b"{}")
-        finally:
+            body = json.loads(resp.read() or b"{}")
+            self._done(conn, resp)
+            return status, body
+        except Exception:
             conn.close()
+            raise
 
     def stats(self) -> dict:
         return self._json_call("GET", "/stats")
@@ -210,8 +268,10 @@ class GatewayClient:
         status, _h, resp, conn = self._request("GET", "/metrics")
         try:
             data = resp.read().decode()
-        finally:
+            self._done(conn, resp)
+        except Exception:
             conn.close()
+            raise
         if status != 200:
             raise GatewayError(status, {"body": data})
         return data

@@ -36,10 +36,19 @@ forms, never free-text parsing):
 Status-code mapping (docs/serving.md has the full table): ``Overloaded`` →
 **429** with a ``Retry-After`` header and the structured body (capacity,
 depth, ``retry_after_ms``); ``DeadlineExceeded`` → **504**; validation
-errors → **400**; not-ready or draining → **503** + ``Retry-After``;
-anything else → **500**. A rejection that happens after streaming began
-arrives as a final NDJSON ``{"error": ...}`` line instead (the status line
-already went out — HTTP has no second chance).
+errors → **400**; ``ReplicaFailed``/``Unavailable`` (the replica died, or
+every circuit is open) → **503** + ``Retry-After`` (retryable: a sibling
+or the supervisor's restart may serve it); not-ready or draining → **503**
++ ``Retry-After``; anything else → **500**. A rejection that happens after
+streaming began arrives as a final NDJSON ``{"error": ...}`` line instead
+(the status line already went out — HTTP has no second chance).
+
+Transport hardening: connections are **HTTP/1.1 keep-alive** (the client
+reuses them — a chaos drill's reconnect storm must not re-handshake per
+request), bounded by ``max_connections``: past the cap the server answers
+a minimal 503 + ``Retry-After`` and closes, instead of letting unbounded
+accept threads pile up — the connection analog of the engine's bounded
+admission queues.
 """
 
 from __future__ import annotations
@@ -54,7 +63,9 @@ import numpy as np
 
 from ddw_tpu.gateway.lifecycle import ServerLifecycle
 from ddw_tpu.gateway.replica import ReplicaSet
-from ddw_tpu.serve.admission import DeadlineExceeded, Overloaded, Rejected
+from ddw_tpu.gateway.supervisor import ReplicaSupervisor
+from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded, Rejected,
+                                     ReplicaFailed, Unavailable)
 
 __all__ = ["Gateway"]
 
@@ -66,10 +77,39 @@ class _GatewayHTTPServer(ThreadingHTTPServer):
     # connection burst — the engine's admission control is the bounded
     # queue here, not the kernel's
     request_queue_size = 128
+    # keep-alive makes connections long-lived, so bound how many may be
+    # open at once; past the cap we answer a fast 503 (a structured refusal
+    # the client's backoff understands) rather than piling up threads
+    max_connections = 256
 
     def __init__(self, addr, gateway: "Gateway"):
         self.gateway = gateway
+        self._conn_lock = threading.Lock()
+        self.active_connections = 0
         super().__init__(addr, _Handler)
+
+    def process_request_thread(self, request, client_address):
+        with self._conn_lock:
+            over = self.active_connections >= self.max_connections
+            if not over:
+                self.active_connections += 1
+        if over:
+            body = b'{"error":"unavailable","reason":"connections"}\n'
+            try:
+                request.sendall(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                    b"Retry-After: 1\r\nConnection: close\r\n\r\n" + body)
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            return
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            with self._conn_lock:
+                self.active_connections -= 1
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -101,6 +141,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(429, body, {"Retry-After": str(secs)})
         elif isinstance(e, DeadlineExceeded):
             self._send_json(504, body)
+        elif isinstance(e, (ReplicaFailed, Unavailable)):
+            # the replica died under it / every circuit is open: retryable —
+            # a sibling or the supervisor's restart may serve the retry
+            ms = getattr(e, "retry_after_ms", None)
+            secs = max(1, math.ceil(ms / 1e3)) if ms else 1
+            self._send_json(503, body, {"Retry-After": str(secs)})
         else:
             self._send_json(500, body)
 
@@ -140,12 +186,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, {"status": "alive",
                                       "state": gw.lifecycle.state})
             elif self.path == "/readyz":
-                state = gw.lifecycle.state
-                if gw.lifecycle.is_ready:
-                    self._send_json(200, {"status": "ready"})
+                ready, body = gw.lifecycle.readiness()
+                if ready:
+                    self._send_json(200, body)
                 else:
-                    self._send_json(503, {"status": state},
-                                    {"Retry-After": "1"})
+                    self._send_json(503, body, {"Retry-After": "1"})
             elif self.path == "/metrics":
                 text = gw.replica_set.prometheus().encode()
                 self.send_response(200)
@@ -155,10 +200,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(text)
             elif self.path == "/stats":
-                self._send_json(200, {
-                    "state": gw.lifecycle.state,
-                    "inflight": gw.lifecycle.inflight,
-                    **gw.replica_set.snapshot()})
+                out = {"state": gw.lifecycle.state,
+                       "inflight": gw.lifecycle.inflight,
+                       "connections": (gw._httpd.active_connections
+                                       if gw._httpd else 0),
+                       **gw.replica_set.snapshot(),
+                       "replica_health": gw.replica_set.fleet_health()}
+                if gw.supervisor is not None:
+                    out["supervisor"] = gw.supervisor.report()
+                self._send_json(200, out)
             else:
                 self._send_json(404, {"error": "not_found",
                                       "path": self.path})
@@ -214,7 +264,7 @@ class _Handler(BaseHTTPRequestHandler):
             kw["on_token"] = lambda i, t: toks_q.put((i, t))
         try:
             fut = gw.replica_set.submit_generate(prompt, num_steps, **kw)
-        except Overloaded as e:
+        except Rejected as e:       # Overloaded / Unavailable / ReplicaFailed
             self._send_rejected(e)
             return
         except ValueError as e:
@@ -297,7 +347,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             fut = gw.replica_set.submit_predict(image, timeout_s=timeout_s)
-        except Overloaded as e:
+        except Rejected as e:       # Overloaded / Unavailable / ReplicaFailed
             self._send_rejected(e)
             return
         except ValueError as e:
@@ -327,18 +377,28 @@ class Gateway:
     (:func:`ddw_tpu.gateway.lifecycle.runtime_grace_s`). ``port=0`` binds an
     ephemeral port (read it back from :attr:`port` — the TOCTOU-free
     pattern, same reason the Launcher respawns on fresh ports).
+
+    ``supervise=True`` (default) runs a :class:`~ddw_tpu.gateway.
+    ReplicaSupervisor` over the fleet for the gateway's lifetime: failed
+    replicas restart within budget and rejoin warm; ``supervisor_kw``
+    forwards its knobs (``max_restarts``, ``stall_timeout_s``, ...).
     """
 
     def __init__(self, replicas, host: str = "127.0.0.1", port: int = 0,
-                 grace_s: float | None = None):
+                 grace_s: float | None = None, supervise: bool = True,
+                 supervisor_kw: dict | None = None):
         self.replica_set = (replicas if isinstance(replicas, ReplicaSet)
                             else ReplicaSet(replicas))
         self.lifecycle = ServerLifecycle(grace_s)
+        self.lifecycle.health_fn = self.replica_set.fleet_health
         self._host, self._want_port = host, port
         self._httpd: _GatewayHTTPServer | None = None
         self._http_thread: threading.Thread | None = None
         self._drain_lock = threading.Lock()
         self.drained_clean: bool | None = None   # last drain's verdict
+        self._supervise = supervise
+        self._supervisor_kw = dict(supervisor_kw or {})
+        self.supervisor: ReplicaSupervisor | None = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, warmup_prompt_lens=(8,)) -> "Gateway":
@@ -355,6 +415,12 @@ class Gateway:
         self._http_thread.start()
         if warmup_prompt_lens:
             self.replica_set.warmup(warmup_prompt_lens)
+        if self._supervise and self.supervisor is None:
+            kw = dict(warmup_prompt_lens=warmup_prompt_lens or (),
+                      lifecycle=self.lifecycle)
+            kw.update(self._supervisor_kw)
+            self.supervisor = ReplicaSupervisor(self.replica_set,
+                                                **kw).start()
         self.lifecycle.mark_ready()
         return self
 
@@ -379,6 +445,9 @@ class Gateway:
                 return bool(self.drained_clean)
             clean = self.lifecycle.await_drained(
                 grace_s if grace_s is not None else self.lifecycle.grace_s)
+            if self.supervisor is not None:
+                self.supervisor.stop()   # no resurrections during teardown
+                self.supervisor = None
             self.replica_set.stop()   # stragglers' futures fail loudly here
             if self._httpd is not None:
                 self._httpd.shutdown()

@@ -60,7 +60,15 @@ def runtime_grace_s() -> float:
 
 
 class ServerLifecycle:
-    """State machine + in-flight request ledger for one serving process."""
+    """State machine + in-flight request ledger for one serving process.
+
+    ``health_fn`` (optional, set by the gateway) reports fleet degradation
+    *within* READY: a process whose replicas are partially dead is still
+    ready — it serves on the survivors — but a load balancer weighing
+    backends and an operator reading ``/readyz`` both want the distinction,
+    so :meth:`readiness` carries it alongside the FSM state. The same
+    principle as warmup gating: readiness tells the truth about what is
+    behind the socket."""
 
     def __init__(self, grace_s: float | None = None):
         self.grace_s = runtime_grace_s() if grace_s is None else grace_s
@@ -68,6 +76,7 @@ class ServerLifecycle:
         self._state = STARTING
         self._inflight = 0
         self._prev_sigterm = None
+        self.health_fn = None       # () -> list[per-replica health dicts]
 
     # -- state ---------------------------------------------------------------
     @property
@@ -78,6 +87,32 @@ class ServerLifecycle:
     @property
     def is_ready(self) -> bool:
         return self.state == READY
+
+    def readiness(self) -> tuple[bool, dict]:
+        """The /readyz truth: (ready, body). Ready as long as the process
+        is READY and at least one replica can take traffic; the body names
+        the degradation (replicas up / total) so a fleet running on
+        survivors is visible without scraping /metrics."""
+        state = self.state
+        body: dict = {"status": "ready" if state == READY else state}
+        if self.health_fn is None:
+            return state == READY, body
+        try:
+            health = self.health_fn()
+        except Exception:
+            return state == READY, body
+        up = sum(1 for h in health
+                 if h.get("state") in ("alive", "degraded"))
+        body["replicas_up"] = up
+        body["replicas"] = len(health)
+        if up < len(health):
+            body["degraded"] = True
+        if state == READY and health and up == 0:
+            # every replica is dead: admitting traffic would only shed —
+            # tell the balancer to send it elsewhere until one rejoins
+            body["status"] = "no_replicas"
+            return False, body
+        return state == READY, body
 
     def mark_ready(self) -> None:
         with self._cv:

@@ -6,50 +6,209 @@ fleet answer is horizontal — more engine replicas, each with its own
 compiled programs and KV pool — and this class is the piece that makes N
 replicas look like one engine to the transport layer above it:
 
-- **routing** is least-outstanding-requests: every submission goes to the
-  replica with the fewest requests in flight *through this set* (queued or
-  decoding), ties broken by replica index. Outstanding counts are kept
-  here, incremented at submit and decremented by a future done-callback,
-  so routing needs no cross-thread peeking into engine internals;
+- **routing is admission-aware**: every submission goes to the replica with
+  the lowest projected wait — queue depth + busy slots weighted by the
+  engine's own decaying per-request service estimate (``ServingEngine.
+  load()``), falling back to the outstanding-futures count for replicas
+  that don't expose load. Outstanding counts are kept here, incremented at
+  submit and decremented by a future done-callback, so routing needs no
+  cross-thread peeking into engine internals;
+- **every replica sits behind a circuit breaker**
+  (:class:`CircuitBreaker`): consecutive :class:`~ddw_tpu.serve.admission.
+  ReplicaFailed` outcomes — or the engine's own death report — open the
+  circuit and routing skips the replica entirely; after a cooldown (or the
+  supervisor's explicit warmed-rejoin gate) ONE half-open probe request is
+  admitted, and its outcome closes or re-opens the circuit. When every
+  circuit is open the set refuses with a structured
+  :class:`~ddw_tpu.serve.admission.Unavailable` (503 + ``Retry-After`` at
+  the gateway) — never a hang;
 - **backpressure spills sideways once**: a submission refused with
-  :class:`~ddw_tpu.serve.Overloaded` by the least-loaded replica is
-  retried on the next-least-loaded sibling before the refusal surfaces —
-  one replica's full queue must not turn away traffic a sibling has room
-  for. A second refusal propagates to the caller (the gateway maps it to
-  429): when the whole fleet is full, the honest answer is still no;
+  :class:`~ddw_tpu.serve.Overloaded` by the best replica is retried on the
+  next candidate before the refusal surfaces. A dead replica
+  (``ReplicaFailed`` at submit) does NOT consume that budget — routing
+  walks past corpses to any live sibling;
+- **failover adopts a dead replica's queue**: when an engine dies it hands
+  its queued, nothing-emitted requests to :meth:`_on_replica_failure` (the
+  engine's ``on_failure`` hook); each is resubmitted to a healthy sibling
+  *with its original future intact* when its deadline (and the sibling's
+  projected wait) allows, else completed with the structured refusal —
+  callers see tokens or a clean 503/504, never a hang. Requests that had
+  already streamed tokens fail with ``ReplicaFailed`` (re-running them
+  would duplicate the stream; the client's retry policy owns that call);
 - **metrics aggregate** (:func:`ddw_tpu.serve.metrics.merge_metrics`):
   ``snapshot()`` and ``prometheus()`` reduce over every replica's records,
-  so the SLO view and the ``/metrics`` scrape are fleet totals, with
-  per-replica outstanding gauges alongside.
+  with per-replica outstanding/circuit/restart gauges alongside.
 
 The submission surface mirrors the engine (``submit_generate`` /
 ``submit_predict`` / ``warmup`` / ``start`` / ``stop`` / context manager),
 so anything written against one engine — the HTTP gateway, the load
-generator, the tests — serves a fleet by swapping the object.
+generator, the tests — serves a fleet by swapping the object. Restarting
+dead replicas is not this class's job: :class:`~ddw_tpu.gateway.supervisor.
+ReplicaSupervisor` watches the same health surface and owns recovery.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 
-from ddw_tpu.serve.admission import Overloaded
+from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded,
+                                     ReplicaFailed, Unavailable)
 from ddw_tpu.serve.metrics import merge_metrics, render_prometheus
 
-__all__ = ["ReplicaSet"]
+__all__ = ["ReplicaSet", "CircuitBreaker",
+           "CIRCUIT_CLOSED", "CIRCUIT_HALF_OPEN", "CIRCUIT_OPEN"]
+
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_HALF_OPEN = "half_open"
+CIRCUIT_OPEN = "open"
+
+# numeric encodings for the flat snapshot / Prometheus gauge
+_CIRCUIT_CODE = {CIRCUIT_CLOSED: 0.0, CIRCUIT_HALF_OPEN: 1.0,
+                 CIRCUIT_OPEN: 2.0}
+
+
+class CircuitBreaker:
+    """Per-replica request-outcome FSM: CLOSED (routing) → OPEN (skipped)
+    → HALF_OPEN (one probe) → CLOSED, the classic pattern.
+
+    OPENs on ``failure_threshold`` consecutive replica-fault outcomes, on a
+    failed half-open probe, or on an explicit :meth:`trip` (the engine's
+    death report / the supervisor's stall verdict). After ``cooldown_s`` it
+    lapses to HALF_OPEN by itself; the supervisor's :meth:`half_open` opens
+    the probe window immediately after a warmed restart instead of waiting
+    out the clock. Only replica faults count — ``Overloaded`` and deadline
+    sheds are honest load answers from a *live* replica, not failures."""
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 5.0,
+                 clock=time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}")
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CIRCUIT_CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self.opened = 0             # total trips (telemetry)
+
+    def _state_locked(self) -> str:
+        if (self._state == CIRCUIT_OPEN
+                and self._clock() - self._opened_at >= self.cooldown_s):
+            self._state = CIRCUIT_HALF_OPEN
+            self._probing = False
+        return self._state
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def available(self) -> bool:
+        """Peek: would a submission be routed here right now? (CLOSED, or
+        HALF_OPEN with the probe slot free.)"""
+        with self._lock:
+            s = self._state_locked()
+            return s == CIRCUIT_CLOSED or (s == CIRCUIT_HALF_OPEN
+                                           and not self._probing)
+
+    def begin_probe(self) -> None:
+        """Claim the single HALF_OPEN probe slot (no-op when CLOSED)."""
+        with self._lock:
+            if self._state_locked() == CIRCUIT_HALF_OPEN:
+                self._probing = True
+
+    def abort_probe(self) -> None:
+        """Release the probe slot on a neutral outcome (deadline shed,
+        cancel) that proves nothing about replica health."""
+        with self._lock:
+            self._probing = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state == CIRCUIT_OPEN:
+                return      # a straggler finishing does not close an
+            #                 opened circuit — only a probe can
+            self._state = CIRCUIT_CLOSED
+            self._consecutive = 0
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            s = self._state_locked()
+            self._consecutive += 1
+            if (s == CIRCUIT_HALF_OPEN
+                    or self._consecutive >= self.failure_threshold):
+                self._trip_locked()
+
+    def trip(self) -> None:
+        """Force OPEN now — the engine reported itself dead; waiting for
+        request outcomes to accumulate would route traffic into a corpse."""
+        with self._lock:
+            self._trip_locked()
+
+    def _trip_locked(self) -> None:
+        if self._state != CIRCUIT_OPEN:
+            self.opened += 1
+        self._state = CIRCUIT_OPEN
+        self._opened_at = self._clock()
+        self._probing = False
+
+    def half_open(self) -> None:
+        """Open the probe window immediately (the supervisor's rejoin gate
+        after a warmed restart) instead of waiting out the cooldown."""
+        with self._lock:
+            if self._state == CIRCUIT_OPEN:
+                self._state = CIRCUIT_HALF_OPEN
+                self._probing = False
+
+    def retry_after_ms(self) -> float:
+        """How long until this circuit's next probe window (0 when not
+        OPEN) — the honest Retry-After hint for a fleet-wide refusal."""
+        with self._lock:
+            if self._state_locked() != CIRCUIT_OPEN:
+                return 0.0
+            return max(0.0, (self._opened_at + self.cooldown_s
+                             - self._clock()) * 1e3)
 
 
 class ReplicaSet:
-    """Least-outstanding-requests router over engine replicas."""
+    """Admission-aware, circuit-breaking router over engine replicas."""
 
-    def __init__(self, replicas):
+    def __init__(self, replicas, failure_threshold: int = 3,
+                 cooldown_s: float = 5.0):
         if hasattr(replicas, "submit_generate"):   # a bare engine
             replicas = [replicas]
         self.replicas = list(replicas)
         if not self.replicas:
             raise ValueError("ReplicaSet needs at least one engine replica")
-        self._outstanding = [0] * len(self.replicas)
+        n = len(self.replicas)
+        self._outstanding = [0] * n
+        self._where: dict = {}      # future -> replica index (for the
+        #                             done-callback and failover moves)
         self._lock = threading.Lock()
-        self.retried_429 = 0    # refusals absorbed by a sibling retry
+        self.breakers = [CircuitBreaker(failure_threshold, cooldown_s)
+                         for _ in range(n)]
+        self.restarts = [0] * n     # supervisor restarts, via note_restart
+        self.replica_failures = 0   # terminal engine deaths observed
+        self.failed_over = 0        # requests adopted by a sibling
+        self.retried_429 = 0        # refusals absorbed by a sibling retry
+        self.failure_event = threading.Event()   # supervisor wake-up
+        for i, eng in enumerate(self.replicas):
+            self._wire(i, eng)
+
+    def _wire(self, i: int, eng) -> None:
+        """Attach the fleet identity + failover hook (best-effort: plain
+        fakes without the attributes still route)."""
+        try:
+            eng.replica_id = i
+            eng.on_failure = (lambda failure, salvage, _i=i:
+                              self._on_replica_failure(_i, failure, salvage))
+        except AttributeError:
+            pass
 
     # -- lifecycle (fan-out) ------------------------------------------------
     def start(self) -> "ReplicaSet":
@@ -71,26 +230,105 @@ class ReplicaSet:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    def replace(self, i: int, eng) -> None:
+        """Swap in a replacement replica (the clone_fresh recovery path for
+        a wedged thread). Outstanding futures of the old engine keep their
+        accounting — they resolve through the same done-callback."""
+        self._wire(i, eng)
+        self.replicas[i] = eng
+
+    def note_restart(self, i: int) -> None:
+        with self._lock:
+            self.restarts[i] += 1
+
     # -- routing ------------------------------------------------------------
     def outstanding(self) -> list[int]:
         with self._lock:
             return list(self._outstanding)
 
-    def _route(self) -> list[int]:
-        """Replica indices to try, in order: least outstanding first, then
-        ONE sibling (the 429-retry budget)."""
+    def fleet_health(self) -> list[dict]:
+        """Per-replica health + circuit view (the /stats payload)."""
+        out = []
+        for i, eng in enumerate(self.replicas):
+            h = (eng.health() if hasattr(eng, "health")
+                 else {"state": "unknown", "replica": i})
+            h["circuit"] = self.breakers[i].state
+            h["restarts"] = self.restarts[i]
+            with self._lock:
+                h["outstanding"] = self._outstanding[i]
+            out.append(h)
+        return out
+
+    def _score(self, i: int, outstanding: int):
+        """Projected-wait routing key: (estimated wait ms, pending work,
+        index). Engines exposing ``load()`` are scored on queue depth +
+        busy slots x their own EWMA service estimate — the ROADMAP's
+        admission-aware routing; anything else falls back to the
+        outstanding-futures count (ties by index keep it deterministic)."""
+        eng = self.replicas[i]
+        if hasattr(eng, "load"):
+            try:
+                ld = eng.load()
+                pending = float(ld["depth"] + ld["busy"])
+                return (pending * float(ld.get("service_ms") or 0.0),
+                        pending, i)
+            except Exception:
+                pass
+        return (0.0, float(outstanding), i)
+
+    def _order(self, exclude=()) -> list[int]:
+        """Healthy replica indices, best candidate first."""
         with self._lock:
-            order = sorted(range(len(self.replicas)),
-                           key=lambda i: (self._outstanding[i], i))
-        return order[:2]
+            outs = list(self._outstanding)
+        scored = [self._score(i, outs[i])
+                  for i in range(len(self.replicas))
+                  if i not in exclude and self.breakers[i].available()]
+        scored.sort()
+        return [s[-1] for s in scored]
+
+    def _min_retry_ms(self) -> float:
+        hints = [b.retry_after_ms() for b in self.breakers]
+        live = [h for h in hints if h > 0]
+        return min(live) if live else 1000.0
 
     def _dec(self, i: int) -> None:
         with self._lock:
             self._outstanding[i] -= 1
 
+    def _on_done(self, fut) -> None:
+        """Every routed future lands here exactly once — the accounting
+        decrement AND the breaker's outcome feed. Submission paths that
+        raise never registered the future, so the counter can't leak."""
+        with self._lock:
+            i = self._where.pop(fut, None)
+            if i is not None:
+                self._outstanding[i] -= 1
+        if i is None:
+            return
+        try:
+            exc = None if fut.cancelled() else fut.exception()
+        except Exception:
+            exc = None
+        if exc is None:
+            self.breakers[i].record_success()
+        elif isinstance(exc, ReplicaFailed):
+            self.breakers[i].record_failure()
+        else:
+            # Overloaded/DeadlineExceeded are honest load answers from a
+            # live replica — neutral for health, but a claimed probe slot
+            # must not leak
+            self.breakers[i].abort_probe()
+
     def _submit(self, method: str, args, kwargs):
-        route, last = self._route(), None
-        for attempt, i in enumerate(route):
+        order = self._order()
+        if not order:
+            raise Unavailable("all replica circuits open",
+                              retry_after_ms=self._min_retry_ms())
+        last = None
+        overloads = 0
+        for i in order:
+            if overloads >= 2:
+                break               # the single-sideways-spill budget
             with self._lock:
                 self._outstanding[i] += 1
             try:
@@ -98,17 +336,89 @@ class ReplicaSet:
             except Overloaded as e:
                 self._dec(i)
                 last = e
-                if attempt + 1 < len(route):
+                overloads += 1
+                if overloads < 2 and i != order[-1]:
                     with self._lock:
                         self.retried_429 += 1
-                    continue
-                raise
+                continue
+            except ReplicaFailed as e:
+                self._dec(i)        # a corpse doesn't consume the 429
+                last = e            # budget — walk to any live sibling
+                self.breakers[i].record_failure()
+                continue
             except BaseException:
                 self._dec(i)     # validation errors etc. must not leak
                 raise            # an outstanding count into the router
-            fut.add_done_callback(lambda _f, i=i: self._dec(i))
+            self.breakers[i].begin_probe()
+            with self._lock:
+                self._where[fut] = i
+            fut.add_done_callback(self._on_done)
             return fut
-        raise last  # single-replica set: the one refusal surfaces
+        raise last
+
+    # -- failover (the dead replica's on_failure hook) -----------------------
+    def _on_replica_failure(self, i: int, failure: ReplicaFailed,
+                            salvage) -> None:
+        """Runs on the dying engine's (or the supervisor's) thread: open
+        the circuit immediately, then re-home every salvaged queued request
+        — original futures intact — or complete it with a structured
+        refusal. Nothing may leave here unresolved."""
+        self.breakers[i].trip()
+        with self._lock:
+            self.replica_failures += 1
+        for kind, req in salvage:
+            try:
+                self._failover(i, kind, req, failure)
+            except Exception:
+                self._complete(req, ReplicaFailed(
+                    failure.kind, replica=i, phase="queued",
+                    forensics=failure.forensics))
+        self.failure_event.set()
+
+    def _failover(self, src: int, kind: str, req,
+                  failure: ReplicaFailed) -> None:
+        now = time.monotonic()
+        deadline = getattr(req, "deadline", None)
+        if deadline is not None and now > deadline:
+            waited = (now - req.times.submitted) * 1e3
+            self._complete(req, DeadlineExceeded(
+                kind, waited, (deadline - req.times.submitted) * 1e3))
+            return
+        for j in self._order(exclude=(src,)):
+            eng = self.replicas[j]
+            if not hasattr(eng, "adopt"):
+                continue
+            if deadline is not None and hasattr(eng, "load"):
+                ld = eng.load()
+                est_s = ((ld["depth"] + ld["busy"])
+                         * (ld.get("service_ms") or 0.0)) / 1e3
+                if now + est_s > deadline:
+                    continue    # deadline-aware: don't queue where the
+                #                 wait already busts the SLO
+            try:
+                eng.adopt(kind, req)
+            except (Overloaded, ReplicaFailed, ValueError):
+                continue
+            with self._lock:
+                fut = req.future
+                prev = self._where.get(fut)
+                if prev is not None:    # move the outstanding count with it
+                    self._outstanding[prev] -= 1
+                    self._outstanding[j] += 1
+                    self._where[fut] = j
+                self.failed_over += 1
+            return
+        self._complete(req, Unavailable(
+            "no sibling could adopt the request before its deadline",
+            retry_after_ms=self._min_retry_ms()))
+
+    @staticmethod
+    def _complete(req, exc: Exception) -> None:
+        if not req.future.done():
+            try:
+                req.future.set_exception(exc)
+            except Exception:
+                pass
 
     # -- submission (engine surface) ----------------------------------------
     def submit_generate(self, prompt, num_steps: int, **kw):
@@ -130,15 +440,21 @@ class ReplicaSet:
 
     def snapshot(self) -> dict[str, float]:
         """Fleet SLO view: the merged engine snapshot plus the routing
-        layer's own numbers (replica count, sideways retries, outstanding
-        per replica)."""
+        layer's own numbers (replica count, sideways retries, outstanding /
+        circuit state / restart count per replica)."""
         out = self.merged_metrics().snapshot()
         with self._lock:
             outstanding = list(self._outstanding)
+            restarts = list(self.restarts)
             out["gateway.retried_429"] = float(self.retried_429)
+            out["gateway.replica_failures"] = float(self.replica_failures)
+            out["gateway.failed_over"] = float(self.failed_over)
         out["gateway.replicas"] = float(len(self.replicas))
         for i, n in enumerate(outstanding):
             out[f"gateway.outstanding_r{i}"] = float(n)
+            out[f"gateway.circuit_r{i}"] = _CIRCUIT_CODE[
+                self.breakers[i].state]
+            out[f"gateway.restarts_r{i}"] = float(restarts[i])
         return out
 
     def prometheus(self) -> str:
@@ -146,6 +462,13 @@ class ReplicaSet:
             gauges = {f'ddw_gateway_outstanding{{replica="{i}"}}': float(n)
                       for i, n in enumerate(self._outstanding)}
             gauges["ddw_gateway_retried_429"] = float(self.retried_429)
+            gauges["ddw_gateway_replica_failures"] = float(
+                self.replica_failures)
+            for i, n in enumerate(self.restarts):
+                gauges[f'ddw_gateway_restarts{{replica="{i}"}}'] = float(n)
+        for i, b in enumerate(self.breakers):
+            gauges[f'ddw_gateway_circuit_state{{replica="{i}"}}'] = \
+                _CIRCUIT_CODE[b.state]
         gauges["ddw_gateway_replicas"] = float(len(self.replicas))
         return render_prometheus([eng.metrics for eng in self.replicas],
                                  extra_gauges=gauges)

@@ -1,0 +1,213 @@
+"""ReplicaSupervisor — bounded auto-restart over a serving replica fleet.
+
+The :class:`~ddw_tpu.gateway.ReplicaSet` is the *containment* half of
+serving fault tolerance: a dead replica's circuit opens, its queued work
+fails over to siblings, and routing walks around the corpse. This module is
+the *recovery* half, the serving analog of
+:class:`~ddw_tpu.runtime.supervisor.GangSupervisor` — same discipline,
+different failure geometry (threads in one process, restart one replica,
+keep serving on the rest):
+
+- a monitor thread watches every replica's :meth:`~ddw_tpu.serve.
+  ServingEngine.health` — woken immediately by the set's ``failure_event``,
+  polling otherwise — and classifies two conditions: **failed** (the engine
+  reported terminal death: crash, stall-abort, error-budget exhaustion) and
+  **stalled** (the loop heartbeat's ``last_tick_age_s`` exceeded
+  ``stall_timeout_s`` — a wedged device op or an injected
+  ``DDW_FAULT=serve:stall``; the supervisor declares it dead via
+  ``force_fail``, which also fails its futures so no client hangs);
+- recovery is **bounded restart with backoff + jitter**, mirroring the gang
+  supervisor's budgets: up to ``max_restarts`` per replica, delay
+  ``backoff_base_s * 2**(n-1)`` capped at ``backoff_max_s`` plus uniform
+  jitter (decorrelates a fleet-wide event from stampeding the device). A
+  replica over budget stays dark — its circuit stays open, the fleet keeps
+  serving degraded, and the per-attempt forensics are kept;
+- the **rejoin is warmup-gated** through the same discipline as
+  :class:`~ddw_tpu.gateway.ServerLifecycle` readiness: the restarted engine
+  re-compiles nothing in place (:meth:`~ddw_tpu.serve.ServingEngine.
+  restart` keeps program caches) but is still driven through
+  ``warmup(prompt_lens)`` before its breaker half-opens — no live request
+  pays a cold path behind a circuit that claimed the replica was back. A
+  thread wedged in real device work cannot be joined; that replica is
+  **replaced** (``clone_fresh`` + ``ReplicaSet.replace``) and the
+  replacement pays its compile inside the warmup gate, not on traffic.
+
+Per-attempt records (:class:`ReplicaAttempt`) mirror ``AttemptReport``:
+which replica, which generation, what killed it, how recovery went —
+queryable via :meth:`ReplicaSupervisor.report` and surfaced through the
+gateway's ``/stats``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+
+__all__ = ["ReplicaSupervisor", "ReplicaAttempt"]
+
+
+@dataclasses.dataclass
+class ReplicaAttempt:
+    """One observed replica death + the recovery attempted for it."""
+
+    replica: int
+    generation: int
+    kind: str                   # crash | stalled | errors | error
+    action: str                 # restarted | replaced | budget_exhausted
+    elapsed_s: float            # detection -> serving again (0 if not)
+    forensics: dict
+
+    def __str__(self) -> str:
+        return (f"replica {self.replica} gen {self.generation}: "
+                f"{self.kind} -> {self.action} ({self.elapsed_s:.2f}s)")
+
+
+class ReplicaSupervisor:
+    """Watch a :class:`~ddw_tpu.gateway.ReplicaSet`, restart dead replicas
+    within budget, and gate their rejoin on warmup.
+
+    ``lifecycle`` (a :class:`~ddw_tpu.gateway.ServerLifecycle`) scopes the
+    supervisor to the serving process's own state machine: once the process
+    is draining or stopped, dead replicas stay dead — restarting an engine
+    the drain is about to stop would race it back to life.
+    """
+
+    def __init__(self, replica_set, max_restarts: int = 2,
+                 backoff_base_s: float = 0.25, backoff_max_s: float = 30.0,
+                 jitter: float = 0.25, stall_timeout_s: float = 30.0,
+                 poll_interval_s: float = 0.25,
+                 warmup_prompt_lens=(8,), lifecycle=None):
+        self.rs = replica_set
+        self.max_restarts = max_restarts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.jitter = jitter
+        self.stall_timeout_s = stall_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self.warmup_prompt_lens = tuple(warmup_prompt_lens or ())
+        self.lifecycle = lifecycle
+        self.attempts: list[ReplicaAttempt] = []
+        self._next_attempt_at = [0.0] * len(replica_set.replicas)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ReplicaSupervisor":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="ddw-replica-supervisor", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.rs.failure_event.set()     # unblock the wait
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+
+    def __enter__(self) -> "ReplicaSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def report(self) -> dict:
+        """The forensic record: restart counts per replica + every attempt
+        (the GangFailure-style story, queryable instead of buried in
+        logs)."""
+        with self._lock:
+            return {"max_restarts": self.max_restarts,
+                    "restarts": list(self.rs.restarts),
+                    "attempts": [dataclasses.asdict(a)
+                                 for a in self.attempts]}
+
+    # -- monitor loop --------------------------------------------------------
+    def _draining(self) -> bool:
+        return (self.lifecycle is not None
+                and self.lifecycle.state in ("draining", "stopped"))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.rs.failure_event.wait(timeout=self.poll_interval_s)
+            self.rs.failure_event.clear()
+            if self._stop.is_set() or self._draining():
+                continue
+            now = time.monotonic()
+            for i, eng in enumerate(list(self.rs.replicas)):
+                try:
+                    if not hasattr(eng, "health"):
+                        continue
+                    h = eng.health()
+                    if (h["state"] in ("alive", "degraded") and h["running"]
+                            and h["last_tick_age_s"] > self.stall_timeout_s):
+                        # the loop's heartbeat went stale: declare it dead
+                        # so its futures resolve and its circuit opens; the
+                        # restart below reclaims (or replaces) the thread
+                        eng.force_fail("stalled")
+                        h = eng.health()
+                    if (h["state"] == "failed"
+                            and now >= self._next_attempt_at[i]):
+                        self._recover(i, eng)
+                except Exception:
+                    continue    # a monitor bug must never kill the monitor
+
+    def _recover(self, i: int, eng) -> None:
+        n_prior = self.rs.restarts[i]
+        failure = getattr(eng, "failure", None)
+        kind = failure.kind if failure is not None else "error"
+        forensics = dict(failure.forensics) if failure is not None else {}
+        gen = getattr(eng, "generation", 0)
+        if n_prior >= self.max_restarts:
+            with self._lock:
+                if not any(a.replica == i and a.action == "budget_exhausted"
+                           for a in self.attempts):
+                    self.attempts.append(ReplicaAttempt(
+                        replica=i, generation=gen, kind=kind,
+                        action="budget_exhausted", elapsed_s=0.0,
+                        forensics=forensics))
+            return                  # stays dark; circuit stays open
+        t0 = time.monotonic()
+        action = "restarted"
+        try:
+            try:
+                eng.restart()
+            except RuntimeError:
+                # thread wedged in device work — abandon it, swap in a
+                # fresh engine over the same handles (compiles inside the
+                # warmup gate below, not on live traffic)
+                eng = eng.clone_fresh()
+                self.rs.replace(i, eng)
+                eng.start()
+                action = "replaced"
+            if self.warmup_prompt_lens:
+                eng.warmup(self.warmup_prompt_lens)
+        except Exception as e:      # the restart itself died: try again
+            self._next_attempt_at[i] = time.monotonic() + self._backoff(
+                n_prior + 1)
+            self.rs.note_restart(i)
+            with self._lock:
+                self.attempts.append(ReplicaAttempt(
+                    replica=i, generation=gen, kind=kind,
+                    action=f"restart_failed: {e!r}"[:200], elapsed_s=0.0,
+                    forensics=forensics))
+            self.rs.failure_event.set()
+            return
+        self.rs.note_restart(i)
+        self._next_attempt_at[i] = time.monotonic() + self._backoff(
+            n_prior + 1)
+        self.rs.breakers[i].half_open()     # warmed: admit ONE probe
+        with self._lock:
+            self.attempts.append(ReplicaAttempt(
+                replica=i, generation=getattr(eng, "generation", gen),
+                kind=kind, action=action,
+                elapsed_s=time.monotonic() - t0, forensics=forensics))
+
+    def _backoff(self, nth_restart: int) -> float:
+        delay = min(self.backoff_max_s,
+                    self.backoff_base_s * (2 ** max(0, nth_restart - 1)))
+        return delay + random.uniform(0.0, self.jitter * delay)

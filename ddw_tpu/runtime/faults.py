@@ -41,6 +41,35 @@ Example: ``DDW_FAULT=crash:rank=1:step=3`` kills rank 1 at global step 3 of
 the first generation; every other process/step/generation is untouched. With
 no ``DDW_FAULT`` set, :func:`maybe_fault` is a near-free no-op — the hooks are
 safe to leave in production step loops.
+
+Serve scope
+-----------
+
+The serving stack (:mod:`ddw_tpu.serve`, :mod:`ddw_tpu.gateway`) has its own
+failure geometry: replicas are *threads in one process*, so a "crash" must
+kill an engine loop, not the interpreter, and the match keys are per-replica
+rather than per-rank. A ``serve:``-prefixed spec targets those hooks and is
+invisible to the gang sites (and vice versa):
+
+    DDW_FAULT=serve:<kind>[:site=prefill|decode|admit|*][:replica=N|*]
+                           [:after=N][:gen=N|*]
+
+Serve kinds: ``crash`` (raise :class:`ServeCrash` — the engine loop dies,
+transitions the replica to its terminal FAILED state and fails every pending
+future with a structured ``ReplicaFailed``), ``raise`` (raise
+:class:`FaultInjected` — one recoverable loop error; the replica degrades and
+its consecutive-error budget decides), ``stall`` (the hook blocks while the
+spec stays configured — exercises last-tick-age stall detection and the
+circuit breaker; clearing ``DDW_FAULT`` resumes the tick cleanly, so a test
+can hold an engine mid-decode and release it, while the engine's stop/fail
+signal aborts hard so a force-failed thread always stays joinable).
+
+Defaults mirror the gang scope's single-shot-drill safety: ``replica=0``
+(one of N replicas dies, the siblings keep serving), ``site=*`` (first hook
+reached), ``after=0`` (the first matching check fires), ``gen=0`` (the
+supervisor-restarted replica runs clean). The ``after=N`` key counts
+invocations of the matching site *within one replica generation*, so
+"die mid-stream on the 5th decode tick" is deterministic on CPU.
 """
 
 from __future__ import annotations
@@ -66,6 +95,12 @@ _SITE_BY_KIND = {k: ("coord_bind" if k == "bind_fail" else "step")
 
 class FaultInjected(RuntimeError):
     """Raised by the ``raise`` fault kind — an injected application error."""
+
+
+class ServeCrash(RuntimeError):
+    """Raised by the ``serve:crash`` kind (and by an aborted ``serve:stall``)
+    — the serving-engine analog of a hard rank death: the engine loop must
+    die, fail its pending futures, and leave the replica FAILED."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,8 +136,15 @@ class FaultSpec:
 
 def parse_fault(spec: str) -> FaultSpec | None:
     """Parse a ``DDW_FAULT`` value; empty/None -> None. Malformed specs raise
-    (a typo'd fault that silently never fires would "pass" every CI run)."""
+    (a typo'd fault that silently never fires would "pass" every CI run).
+    A ``serve:``-scoped spec parses as None here — it targets the serving
+    hooks (:func:`parse_serve_fault`), not the gang sites — but still
+    validates, so a typo'd serve spec fails loudly at the first gang hook
+    too."""
     if not spec:
+        return None
+    if spec.startswith("serve:"):
+        parse_serve_fault(spec)     # validate, then ignore at gang sites
         return None
     parts = spec.split(":")
     kind = parts[0].strip()
@@ -191,6 +233,107 @@ def _write_torn_step_dir(ckpt_dir: str, step: int) -> str:
     with open(os.path.join(d, "state.msgpack"), "wb") as f:
         f.write(b"torn")
     return d
+
+
+# ---------------------------------------------------------------------------
+# Serve scope: per-replica fault injection for the online serving stack.
+# ---------------------------------------------------------------------------
+
+SERVE_KINDS = ("crash", "raise", "stall")
+SERVE_SITES = ("prefill", "decode", "admit")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeFaultSpec:
+    """Parsed ``DDW_FAULT=serve:...`` value. ``None`` fields match anything;
+    defaults make a bare ``serve:crash`` a safe single-replica drill (replica
+    0, first hook reached, first generation only)."""
+
+    kind: str
+    site: str | None = None       # None = any serve site
+    replica: int | None = 0
+    after: int = 0                # fire on the Nth matching check (per gen)
+    gen: int | None = 0
+
+    def matches(self, site: str, replica: int, n: int, gen: int) -> bool:
+        """Pure matching logic. ``n`` is the engine's own invocation count
+        for this site within its current generation (0-based)."""
+        if self.site is not None and site != self.site:
+            return False
+        if self.replica is not None and replica != self.replica:
+            return False
+        if self.gen is not None and gen != self.gen:
+            return False
+        return n >= self.after
+
+
+def parse_serve_fault(spec: str) -> ServeFaultSpec | None:
+    """Parse a ``serve:``-scoped ``DDW_FAULT`` value; non-serve specs (and
+    empty) -> None. Malformed serve specs raise, same rule as
+    :func:`parse_fault`."""
+    if not spec or not spec.startswith("serve:"):
+        return None
+    parts = spec.split(":")[1:]
+    if not parts or parts[0].strip() not in SERVE_KINDS:
+        raise ValueError(f"unknown DDW_FAULT serve kind "
+                         f"{parts[0].strip() if parts else ''!r}; expected "
+                         f"one of {SERVE_KINDS}")
+    kind = parts[0].strip()
+    fields: dict[str, object] = {}
+    for part in parts[1:]:
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key, val = key.strip(), val.strip()
+        if key == "site":
+            if val != "*" and val not in SERVE_SITES:
+                raise ValueError(f"unknown DDW_FAULT serve site {val!r}; "
+                                 f"expected one of {SERVE_SITES} or '*'")
+            fields["site"] = None if val == "*" else val
+        elif key in ("replica", "gen"):
+            fields[key] = None if val == "*" else int(val)
+        elif key == "after":
+            fields[key] = int(val)
+        else:
+            raise ValueError(f"unknown DDW_FAULT serve key {key!r} in "
+                             f"{spec!r}")
+    return ServeFaultSpec(kind=kind, **fields)
+
+
+def active_serve_fault() -> ServeFaultSpec | None:
+    """The currently configured serve fault, re-read from the env on every
+    call (tests monkeypatch ``DDW_FAULT`` mid-process)."""
+    return parse_serve_fault(os.environ.get("DDW_FAULT", ""))
+
+
+def maybe_serve_fault(site: str, replica: int, n: int, gen: int,
+                      should_abort=None) -> None:
+    """Serving-engine hook: fire the configured ``serve:`` fault iff its
+    spec matches this site / replica / invocation count / generation.
+    No-op without ``DDW_FAULT``. ``should_abort`` (a nullary bool callable —
+    the engine's stop-or-fail signal) lets an injected stall end without
+    leaking an unjoinable thread: the stall raises :class:`ServeCrash` the
+    moment the engine is told to die."""
+    if "DDW_FAULT" not in os.environ:   # fast path for the serving hot loop
+        return
+    spec = active_serve_fault()
+    if spec is None or not spec.matches(site, replica=replica, n=n, gen=gen):
+        return
+    where = f"replica {replica}, site {site}, n {n}, gen {gen}"
+    if spec.kind == "crash":
+        raise ServeCrash(f"injected serve crash ({where})")
+    if spec.kind == "raise":
+        raise FaultInjected(f"injected serve fault ({where})")
+    if spec.kind == "stall":
+        # stall WHILE CONFIGURED: clearing/changing DDW_FAULT resumes the
+        # tick cleanly (a test can hold an engine mid-decode and release
+        # it); the engine's stop/fail signal instead aborts hard — the
+        # supervisor's force_fail path, where the thread must die joinable
+        while should_abort is None or not should_abort():
+            if active_serve_fault() != spec:
+                return
+            time.sleep(0.01)
+        raise ServeCrash(f"injected serve stall aborted ({where})")
 
 
 # ---------------------------------------------------------------------------

@@ -5,6 +5,8 @@ from ddw_tpu.serve.admission import (  # noqa: F401
     DeadlineExceeded,
     Overloaded,
     Rejected,
+    ReplicaFailed,
+    Unavailable,
 )
 from ddw_tpu.serve.bucketing import (  # noqa: F401
     batch_bucket,
@@ -13,6 +15,9 @@ from ddw_tpu.serve.bucketing import (  # noqa: F401
     pad_to_bucket,
 )
 from ddw_tpu.serve.engine import (  # noqa: F401
+    ALIVE,
+    DEGRADED,
+    FAILED,
     EngineCfg,
     GenerateResult,
     PredictResult,

@@ -59,6 +59,58 @@ class Overloaded(Rejected):
                 "retry_after_ms": self.retry_after_ms}
 
 
+class ReplicaFailed(Rejected):
+    """The replica holding this request died (engine loop crash, stall, or
+    error budget exhausted) before the request completed. Structured à la
+    :class:`~ddw_tpu.runtime.supervisor.GangFailure`: what killed the
+    replica (``kind``), which replica/generation, where the request was in
+    its lifecycle (``phase``: queued / in_slot / submitted), how many tokens
+    it had already emitted, and the replica's forensic record (traceback,
+    consecutive errors, last-tick age). Queued requests with nothing emitted
+    are failover candidates — the :class:`~ddw_tpu.gateway.ReplicaSet`
+    resubmits them to a sibling instead of surfacing this; everything else
+    maps to 503 + ``Retry-After`` at the gateway (a sibling or a restarted
+    replica may serve the retry)."""
+
+    def __init__(self, kind: str, replica: int = 0, generation: int = 0,
+                 phase: str = "submitted", emitted: int = 0,
+                 forensics: dict | None = None):
+        self.kind = kind
+        self.replica = replica
+        self.generation = generation
+        self.phase = phase
+        self.emitted = emitted
+        self.forensics = dict(forensics or {})
+        super().__init__(
+            f"replica {replica} (gen {generation}) failed: {kind}; request "
+            f"was {phase} with {emitted} token(s) emitted")
+
+    def to_dict(self) -> dict:
+        return {"error": "replica_failed", "kind": self.kind,
+                "replica": self.replica, "generation": self.generation,
+                "phase": self.phase, "emitted": self.emitted,
+                "forensics": self.forensics}
+
+
+class Unavailable(Rejected):
+    """No replica can take this request right now — every circuit is open
+    (fleet-wide failure or restarts in flight). Unlike :class:`Overloaded`
+    this is not backpressure from a live queue but absence of a server;
+    the gateway maps it to 503 + ``Retry-After`` so a balancer respills and
+    a client retries once the supervisor readmits a replica."""
+
+    def __init__(self, reason: str, retry_after_ms: float | None = None):
+        self.reason = reason
+        self.retry_after_ms = retry_after_ms
+        hint = (f"; retry in ~{retry_after_ms:.0f} ms"
+                if retry_after_ms else "")
+        super().__init__(f"no replica available ({reason}){hint}")
+
+    def to_dict(self) -> dict:
+        return {"error": "unavailable", "reason": self.reason,
+                "retry_after_ms": self.retry_after_ms}
+
+
 class DeadlineExceeded(Rejected):
     """The request's deadline passed while it was still queued — shed
     before any device work was spent on it."""

@@ -37,6 +37,26 @@ a slot runs to completion (eviction mid-chain would perturb neighbors for
 an answer nobody reads — the slot frees fastest by finishing). Engine
 sampling supports per-request temperature; ``top_k``/``top_p`` remain
 single-request-path features (``LMPackagedModel.generate``).
+
+Failure containment (docs/fault_tolerance.md "The serving fleet"): the
+request loop must never die *silently*. A recoverable error in one tick
+(an injected ``serve:raise``, a transient device error) fails the requests
+that tick touched with a structured
+:class:`~ddw_tpu.serve.admission.ReplicaFailed`, resets the slot pool to a
+known-good state, and keeps serving — the replica reports ``degraded``
+until clean work resumes. A terminal death (``serve:crash``, the
+consecutive-error budget, a :meth:`force_fail` from the supervisor's stall
+detector) transitions the replica to ``failed``: every queued and in-slot
+future resolves with ``ReplicaFailed`` forensics (never a hang), queued
+requests that emitted nothing are handed to ``on_failure`` for sibling
+failover, and subsequent submissions are refused immediately. A failed
+replica is restartable in place (:meth:`restart` — fresh generation, fresh
+pool cache, compiled programs kept) or replaceable (:meth:`clone_fresh`,
+for a thread wedged in device work); :meth:`health` exposes the
+state / last-tick age / consecutive-error view the circuit breaker and
+:class:`~ddw_tpu.gateway.ReplicaSupervisor` act on. Every failure mode is
+reproducible on CPU via ``DDW_FAULT=serve:...``
+(:mod:`ddw_tpu.runtime.faults`).
 """
 
 from __future__ import annotations
@@ -45,18 +65,27 @@ import concurrent.futures
 import dataclasses
 import threading
 import time
+import traceback
 
 import jax
 import numpy as np
 
+from ddw_tpu.runtime.faults import ServeCrash, maybe_serve_fault
 from ddw_tpu.serve.admission import (AdmissionController, DeadlineExceeded,
-                                     Overloaded)
+                                     Overloaded, ReplicaFailed)
 from ddw_tpu.serve.bucketing import (batch_bucket, bucket_len, pad_to_bucket)
 from ddw_tpu.serve.metrics import EngineMetrics, RequestRecord
 from ddw_tpu.serve.slots import SlotPool
 
 __all__ = ["EngineCfg", "ServingEngine", "GenerateResult", "PredictResult",
-           "Overloaded", "DeadlineExceeded"]
+           "Overloaded", "DeadlineExceeded", "ReplicaFailed"]
+
+# Replica health states (ServingEngine.state / health()["state"])
+ALIVE = "alive"          # loop running, last operation clean
+DEGRADED = "degraded"    # loop running, but the consecutive-error count > 0
+FAILED = "failed"        # terminal: loop dead, futures failed, submissions
+#                          refused — restart()/clone_fresh() to recover
+STOPPED = "stopped"      # clean stop()
 
 
 @dataclasses.dataclass
@@ -74,6 +103,9 @@ class EngineCfg:
     default_timeout_s: float = 30.0
     min_bucket: int = 8         # smallest prompt-length bucket
     donate: bool = True         # donate the pool cache through decode ticks
+    max_consecutive_errors: int = 3   # recoverable loop errors in a row
+    #                                   before the replica turns terminal
+    #                                   FAILED (clean work resets the count)
 
 
 @dataclasses.dataclass
@@ -156,7 +188,8 @@ class ServingEngine:
     """
 
     def __init__(self, lm=None, image=None, cfg: EngineCfg | None = None,
-                 run=None, monitor_interval_s: float = 0.0):
+                 run=None, monitor_interval_s: float = 0.0,
+                 replica_id: int = 0):
         if lm is None and image is None:
             raise ValueError("engine needs an lm and/or image model")
         self.cfg = cfg or EngineCfg()
@@ -169,6 +202,21 @@ class ServingEngine:
         self._monitor = None
         self._monitor_interval_s = monitor_interval_s
         self._service_ms = 0.0      # decaying per-request service estimate
+
+        # failure containment (ReplicaFailed semantics in the module doc)
+        self.replica_id = replica_id
+        self.generation = 0         # bumped by every restart()
+        self.on_failure = None      # (ReplicaFailed, [(kind, req), ...]) ->
+        #                             None; salvageable queued requests are
+        #                             handed over instead of failed (the
+        #                             ReplicaSet's failover hook)
+        self._failure: ReplicaFailed | None = None
+        self._fail_lock = threading.Lock()
+        self._consecutive_errors = 0
+        self._stopped = False
+        self._last_tick = time.monotonic()
+        self._fault_n: dict[str, int] = {}   # per-site hook counts (per gen)
+        self._inflight_admit: list = []      # claimed reqs mid-device-work
 
         self._lm = lm.engine_handle() if hasattr(lm, "engine_handle") else lm
         if self._lm is not None:
@@ -202,6 +250,8 @@ class ServingEngine:
     def start(self) -> "ServingEngine":
         if self._thread is None:
             self._stop.clear()
+            self._stopped = False
+            self._last_tick = time.monotonic()
             if self.run is not None:
                 import os
 
@@ -226,6 +276,7 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout=60.0)
             self._thread = None
+        self._stopped = True
         self._fail_pending(RuntimeError("engine stopped"))
         if self._monitor is not None:
             self._monitor.stop()
@@ -239,6 +290,127 @@ class ServingEngine:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # -- health / failure containment (any thread) --------------------------
+    @property
+    def state(self) -> str:
+        """``alive`` | ``degraded`` | ``failed`` | ``stopped``."""
+        if self._failure is not None:
+            return FAILED
+        if self._stopped:
+            return STOPPED
+        return DEGRADED if self._consecutive_errors > 0 else ALIVE
+
+    @property
+    def failure(self) -> ReplicaFailed | None:
+        """The terminal failure record, when :attr:`state` is ``failed``."""
+        return self._failure
+
+    def health(self) -> dict:
+        """The view the circuit breaker and supervisor act on: FSM state,
+        how stale the loop's last heartbeat is (a wedged device op or an
+        injected stall shows up here long before anything else), the
+        consecutive-error count, and the current load."""
+        running = self._thread is not None and self._thread.is_alive()
+        return {
+            "state": self.state,
+            "replica": self.replica_id,
+            "generation": self.generation,
+            "running": running,
+            "last_tick_age_s": (time.monotonic() - self._last_tick
+                                if running else 0.0),
+            "consecutive_errors": self._consecutive_errors,
+            "queue_depth": self._ctrl.depth(),
+            "busy_slots": len(self._slot_req) if self.pool is not None else 0,
+        }
+
+    def load(self) -> dict:
+        """What admission-aware routing needs: queued + on-device work and
+        the decaying per-request service estimate (ms)."""
+        return {"depth": self._ctrl.depth(),
+                "busy": len(self._slot_req) if self.pool is not None else 0,
+                "service_ms": self._service_ms}
+
+    def force_fail(self, kind: str = "stalled", reason: str = "") -> None:
+        """Declare this replica dead from OUTSIDE the engine thread — the
+        supervisor's stall path (the loop's heartbeat went stale; the thread
+        may be wedged in device work or held by an injected stall). Stops
+        admission, fails every pending future with :class:`ReplicaFailed`
+        (salvaging queued work through ``on_failure``), and signals the
+        loop to die — an injected stall aborts on that signal, so the
+        thread stays joinable for :meth:`restart`."""
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        self._enter_failed(kind, ServeCrash(
+            reason or f"replica {self.replica_id} forced failed ({kind})"))
+
+    def restart(self, join_timeout_s: float = 10.0) -> "ServingEngine":
+        """Bring a ``failed`` (or stopped) replica back in place: the dead
+        thread is joined, the slot pool's device state re-initialized
+        (compiled programs kept — the rejoin is warm), the generation
+        bumped (so a ``gen=0`` injected fault does not re-fire), and the
+        loop restarted. Raises if the old thread is still running — a
+        thread wedged in real device work cannot be reclaimed; use
+        :meth:`clone_fresh` and replace the replica instead."""
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout_s)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"replica {self.replica_id} thread still running after "
+                    f"{join_timeout_s}s — wedged in device work; replace it "
+                    f"via clone_fresh() instead of restarting in place")
+            self._thread = None
+        with self._fail_lock:
+            self._failure = None
+        self._consecutive_errors = 0
+        self.generation += 1
+        self._fault_n = {}
+        self._inflight_admit = []
+        if self.pool is not None:
+            self._slot_req.clear()
+            self._cur[:] = 0
+            self._temps[:] = 0.0
+            self.pool.reset()
+        self._stopped = False
+        return self.start()
+
+    def clone_fresh(self) -> "ServingEngine":
+        """A replacement replica over the same engine handles and config —
+        the recovery path for a thread wedged in device work (the old
+        engine's daemon thread is abandoned; its pool and programs go with
+        it, so the clone re-compiles). Carries the replica identity, the
+        next generation, and the failover hook."""
+        eng = ServingEngine(lm=self._lm, image=self._image, cfg=self.cfg,
+                            replica_id=self.replica_id)
+        eng.generation = self.generation + 1
+        eng.on_failure = self.on_failure
+        return eng
+
+    def adopt(self, kind: str, req) -> None:
+        """Take over a salvaged request from a failed sibling — the
+        original future rides along untouched, so the caller that holds it
+        never learns its first replica died. Only requests that emitted
+        nothing are adoptable (re-running a partially streamed request
+        would duplicate tokens). Raises ``Overloaded``/``ReplicaFailed``
+        like any submission."""
+        if getattr(req, "emitted", 0):
+            raise ValueError("cannot adopt a request that already emitted "
+                             "tokens")
+        if kind == "lm" and self._lm is None:
+            raise ValueError("engine was built without an LM model")
+        if kind == "image" and self._image is None:
+            raise ValueError("engine was built without an image model")
+        self._offer(kind, req)
+        self.metrics.count("failovers")
+
+    def _refusal(self) -> ReplicaFailed:
+        """A fresh submission-time refusal derived from the terminal
+        failure record."""
+        f = self._failure
+        return ReplicaFailed(f.kind, replica=self.replica_id,
+                             generation=self.generation, phase="submitted",
+                             forensics=f.forensics)
 
     # -- submission (any thread) -------------------------------------------
     def submit_generate(self, prompt, num_steps: int,
@@ -341,6 +513,8 @@ class ServingEngine:
 
     # -- internals ----------------------------------------------------------
     def _offer(self, kind: str, req) -> None:
+        if self._failure is not None:   # a failed replica refuses instantly
+            raise self._refusal()       # (structured — never a hang)
         try:
             self._ctrl.offer(kind, req, retry_after_ms=(
                 self._service_ms * (self._ctrl.depth(kind) + 1)
@@ -391,29 +565,175 @@ class ServingEngine:
                         self._shed(req, kind)
                         worked = True
                 if self.pool is not None:
-                    worked |= self._admit_lm()
-                    worked |= self._decode_tick()
+                    worked |= self._guarded(self._admit_lm)
+                    worked |= self._guarded(self._decode_tick)
                 if self._image is not None:
-                    worked |= self._image_tick()
+                    worked |= self._guarded(self._image_tick)
+                self._last_tick = time.monotonic()   # the loop heartbeat
                 if not worked:
                     with self._cv:
                         if not self._stop.is_set():
                             self._cv.wait(timeout=max(
                                 self.cfg.max_wait_ms, 1.0) / 1e3)
-        except BaseException as e:  # an engine bug must not hang clients
-            self._fail_pending(RuntimeError(f"engine loop died: {e!r}"))
-            raise
+        except BaseException as e:  # an engine bug must not hang clients:
+            self._enter_failed(     # terminal FAILED, every future resolves
+                getattr(e, "serve_kind", None)
+                or ("crash" if isinstance(e, ServeCrash) else "error"), e)
+            # no re-raise: the death is recorded (state, forensics, failed
+            # futures) — a traceback dump from a daemon thread adds noise,
+            # not information
+
+    def _guarded(self, tick) -> bool:
+        """One tick with the recoverable-error contract: an exception fails
+        the requests that tick touched (structured, never a hang), resets
+        the pool to a known-good state, and degrades the replica; only the
+        consecutive-error budget (or a ServeCrash) turns terminal. Clean
+        device work resets the count — degraded heals to alive."""
+        try:
+            worked = tick()
+        except ServeCrash:
+            raise                         # terminal by definition
+        except Exception as e:
+            self._note_loop_error(e)
+            return True
+        if worked:
+            self._consecutive_errors = 0
+        self._inflight_admit = []
+        return worked
+
+    def _note_loop_error(self, exc: Exception) -> None:
+        self.metrics.count("loop_errors")
+        self._consecutive_errors += 1
+        fail = ReplicaFailed(
+            "error", replica=self.replica_id, generation=self.generation,
+            phase="in_slot", forensics=self._forensics(exc))
+        # the extent of a mid-tick failure is unknowable from outside the
+        # dispatch (a donated cache may be invalid, a group partially
+        # inserted) — fail everything the device currently owns and reset
+        # the pool; queued work is untouched and keeps serving
+        for req in self._inflight_admit:
+            self._fail_req(req, ReplicaFailed(
+                "error", replica=self.replica_id,
+                generation=self.generation, phase="admitted",
+                emitted=getattr(req, "emitted", 0),
+                forensics=fail.forensics))
+        self._inflight_admit = []
+        if self.pool is not None:
+            for slot, req in list(self._slot_req.items()):
+                self._fail_req(req, ReplicaFailed(
+                    "error", replica=self.replica_id,
+                    generation=self.generation, phase="in_slot",
+                    emitted=req.emitted, forensics=fail.forensics))
+            self._slot_req.clear()
+            self._cur[:] = 0
+            self._temps[:] = 0.0
+            self.pool.reset()
+        if self._consecutive_errors >= self.cfg.max_consecutive_errors:
+            crash = ServeCrash(
+                f"replica {self.replica_id} exhausted its error budget "
+                f"({self._consecutive_errors} consecutive)")
+            crash.serve_kind = "errors"
+            raise crash from exc
+
+    @staticmethod
+    def _fail_req(req, exc: Exception) -> None:
+        if not req.future.done():
+            try:
+                req.future.set_exception(exc)
+            except concurrent.futures.InvalidStateError:
+                pass                    # lost a race with cancel()
+
+    def _forensics(self, exc: BaseException) -> dict:
+        """The GangFailure-style record that rides every ReplicaFailed."""
+        return {
+            "error": repr(exc),
+            "traceback": traceback.format_exc(limit=12),
+            "consecutive_errors": self._consecutive_errors,
+            "last_tick_age_s": round(time.monotonic() - self._last_tick, 3),
+            "busy_slots": len(self._slot_req) if self.pool is not None else 0,
+            "queue_depth": self._ctrl.depth(),
+        }
+
+    def _enter_failed(self, kind: str, exc: BaseException) -> None:
+        """Terminal transition (engine thread or supervisor thread):
+        records the failure, fails every in-slot/in-flight future with
+        forensics, and hands queued-nothing-emitted requests to
+        ``on_failure`` for sibling failover (failing them here if no hook
+        is installed or the hook itself dies). Idempotent — the loser of a
+        force_fail vs. dying-loop race returns without re-failing."""
+        with self._fail_lock:
+            if self._failure is not None:
+                return
+            failure = ReplicaFailed(
+                kind, replica=self.replica_id, generation=self.generation,
+                phase="terminal", forensics=self._forensics(exc))
+            self._failure = failure
+        # in-slot + mid-admission work already touched the device (and may
+        # have streamed tokens): not salvageable, fail with the record
+        for req in self._inflight_admit:
+            self._fail_req(req, ReplicaFailed(
+                kind, replica=self.replica_id, generation=self.generation,
+                phase="admitted", emitted=getattr(req, "emitted", 0),
+                forensics=failure.forensics))
+        self._inflight_admit = []
+        if self.pool is not None:
+            for req in self._slot_req.values():
+                self._fail_req(req, ReplicaFailed(
+                    kind, replica=self.replica_id,
+                    generation=self.generation, phase="in_slot",
+                    emitted=req.emitted, forensics=failure.forensics))
+            self._slot_req.clear()
+        # queued work: cancelled drops, expired sheds, the rest is
+        # salvageable (nothing emitted — a sibling can serve it bit-for-bit)
+        salvage = []
+        for kind_ in ("lm", "image"):
+            drained, expired = self._ctrl.take(kind_, self._ctrl.capacity)
+            for req in expired:
+                self._shed(req, kind_)
+            for req in drained:
+                if req.future.cancelled():
+                    self.metrics.count_cancelled()
+                elif req.future.done():
+                    pass
+                else:
+                    salvage.append((kind_, req))
+        handed_off = False
+        if self.on_failure is not None:
+            try:
+                self.on_failure(failure, salvage)
+                handed_off = True
+            except Exception:
+                pass                    # fall through: fail them here
+        if not handed_off:
+            for kind_, req in salvage:
+                self._fail_req(req, ReplicaFailed(
+                    kind, replica=self.replica_id,
+                    generation=self.generation, phase="queued",
+                    forensics=failure.forensics))
+
+    def _fault(self, site: str) -> None:
+        """Deterministic DDW_FAULT=serve:* hook (near-free when unset); the
+        per-site invocation count resets each restart generation."""
+        n = self._fault_n.get(site, 0)
+        self._fault_n[site] = n + 1
+        maybe_serve_fault(site, replica=self.replica_id, n=n,
+                          gen=self.generation,
+                          should_abort=self._stop.is_set)
 
     # LM: continuous batching ------------------------------------------------
     def _admit_lm(self) -> bool:
         free = self.pool.free_slots
         if free == 0:
             return False
+        if self._ctrl.depth("lm") > 0:
+            self._fault("admit")     # admission boundary: nothing claimed
+            #                          yet, queued work stays salvageable
         admitted, expired = self._ctrl.take("lm", free)
         for req in expired:
             self._shed(req, "lm")
         n_taken = len(admitted)
         admitted = [r for r in admitted if self._claim(r)]
+        self._inflight_admit = list(admitted)
         if not admitted:
             return bool(expired) or n_taken > 0
         # group by length bucket: one prefill dispatch per group (an
@@ -427,6 +747,8 @@ class ServingEngine:
                                 self.cfg.min_bucket)
             groups.setdefault(bucket, []).append(req)
         for bucket, reqs in groups.items():
+            self._fault("prefill")   # device-work boundary: this group is
+            #                          claimed — a fault here fails it
             g = batch_bucket(len(reqs), self.cfg.n_slots)
             prompts = np.zeros((g, bucket), np.int32)
             true_lens = np.ones((g,), np.int32)   # dummy rows: length 1
@@ -458,11 +780,13 @@ class ServingEngine:
                     self._slot_req[slot] = req
                     self._cur[slot] = tok0
                     self._temps[slot] = req.temperature
+        self._inflight_admit = []
         return True
 
     def _decode_tick(self) -> bool:
         if not self._slot_req:
             return False
+        self._fault("decode")
         k = self.cfg.steps_per_tick
         n = self.cfg.n_slots
         keys = np.zeros((n, k, 2), np.uint32)
@@ -515,11 +839,13 @@ class ServingEngine:
             waited = self._ctrl.oldest_wait_s("image")
             if waited is None or waited * 1e3 < self.cfg.max_wait_ms:
                 return False
+        self._fault("admit")
         admitted, expired = self._ctrl.take("image", self.cfg.max_batch)
         for req in expired:
             self._shed(req, "image")
         n_taken = len(admitted)
         admitted = [r for r in admitted if self._claim(r)]
+        self._inflight_admit = list(admitted)
         if not admitted:
             return bool(expired) or n_taken > 0
         now = time.monotonic()
@@ -545,6 +871,7 @@ class ServingEngine:
             req.future.set_result(PredictResult(
                 logits=logits[i], label=classes[idx] if classes else str(idx),
                 index=idx, queue_ms=rec.queue_ms, total_ms=rec.total_ms))
+        self._inflight_admit = []
         return True
 
     def _update_service(self, ms: float) -> None:

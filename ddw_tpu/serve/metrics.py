@@ -86,6 +86,9 @@ class EngineMetrics:
         self.decode_ticks = 0      # chained decode dispatches
         self.prefills = 0
         self.image_batches = 0
+        self.loop_errors = 0       # recoverable engine-loop errors survived
+        self.failovers = 0         # sibling requests adopted after a
+        #                            replica death (counted at the adopter)
         self._first_admit: float | None = None
         self._last_done: float | None = None
         self._sink = None          # incremental serve_requests.jsonl stream
@@ -162,6 +165,8 @@ class EngineMetrics:
                 "serve.decode_ticks": float(self.decode_ticks),
                 "serve.prefills": float(self.prefills),
                 "serve.image_batches": float(self.image_batches),
+                "serve.loop_errors": float(self.loop_errors),
+                "serve.failovers": float(self.failovers),
             }
             first, last = self._first_admit, self._last_done
         if not recs:
@@ -222,6 +227,8 @@ _COUNTER_HELP = (
     ("prefills", "Grouped LM prefill dispatches."),
     ("decode_ticks", "Chained slot-decode dispatches."),
     ("image_batches", "Dynamic-batched image apply dispatches."),
+    ("loop_errors", "Recoverable engine-loop errors survived."),
+    ("failovers", "Requests adopted from a failed sibling replica."),
     ("tokens_out", "Generated LM tokens."),
 )
 _HISTOGRAMS = ("queue_ms", "ttft_ms", "total_ms")
@@ -257,6 +264,8 @@ def merge_metrics(metrics_list) -> "EngineMetrics":
             out.decode_ticks += m.decode_ticks
             out.prefills += m.prefills
             out.image_batches += m.image_batches
+            out.loop_errors += m.loop_errors
+            out.failovers += m.failovers
             if m._first_admit is not None:
                 out._first_admit = (m._first_admit if out._first_admit is None
                                     else min(out._first_admit, m._first_admit))
@@ -285,6 +294,8 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
             counters["prefills"] += m.prefills
             counters["decode_ticks"] += m.decode_ticks
             counters["image_batches"] += m.image_batches
+            counters["loop_errors"] += m.loop_errors
+            counters["failovers"] += m.failovers
             if m._first_admit is not None:
                 first = (m._first_admit if first is None
                          else min(first, m._first_admit))

@@ -109,6 +109,15 @@ class SlotPool:
         self.cache = self._release(self.cache, jnp.int32(slot))
         self._free.append(slot)
 
+    def reset(self) -> None:
+        """Fresh device state after an engine failure: a crash mid-decode
+        can leave ``self.cache`` pointing at a donated (invalidated) buffer
+        or at rows whose indices no longer describe any live request. Re-init
+        the cache tree and free every slot — the compiled program caches are
+        kept, so a supervisor restart rejoins warm (no re-compile)."""
+        self.cache = init_cache(self._slot_model, self.n_slots)
+        self._free = list(range(self.n_slots - 1, -1, -1))
+
     def warmup(self, buckets) -> None:
         """Precompile the program lattice for the given prompt-length
         buckets: one prefill per (bucket, power-of-two group size up to

@@ -7,8 +7,10 @@ the virtual 8-device CPU mesh, with tiny override configs.
 """
 
 import os
+import signal
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -86,6 +88,45 @@ def workdir(tmp_path_factory):
     return str(tmp_path_factory.mktemp("workshop"))
 
 
+def _run_once(cmd, env, timeout_s=600):
+    """One example run with timeout forensics: on expiry the child gets
+    SIGABRT first — faulthandler (enabled via PYTHONFAULTHANDLER) dumps
+    every thread's stack to stderr — and only then the kill, so a wedged
+    run leaves WHERE it wedged instead of an empty ``TimeoutExpired``.
+    Returns ``(rc, stdout, stderr, elapsed_s, timed_out)``."""
+    t0 = time.monotonic()
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+        return proc.returncode, stdout, stderr, time.monotonic() - t0, False
+    except subprocess.TimeoutExpired:
+        try:
+            proc.send_signal(signal.SIGABRT)    # all-threads dump to stderr
+            stdout, stderr = proc.communicate(timeout=20)
+        except (subprocess.TimeoutExpired, OSError):
+            proc.kill()
+            stdout, stderr = proc.communicate()
+        return proc.returncode, stdout, stderr, time.monotonic() - t0, True
+
+
+def _forensics(attempt, rc, stdout, stderr, elapsed, timed_out, env):
+    """The root-cause record ADVICE asked for on the interleaved-PP flake:
+    exact outcome + timing + host load + the env that shaped the run, with
+    the faulthandler dump riding in the stderr tail on timeouts."""
+    try:
+        load = "%.1f/%.1f/%.1f" % os.getloadavg()
+    except OSError:
+        load = "n/a"
+    env_keys = ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH",
+                "PYTHONFAULTHANDLER", "DDW_FAULT")
+    env_view = {k: env.get(k, "") for k in env_keys if k in env}
+    return (f"attempt {attempt}: rc={rc} timed_out={timed_out} "
+            f"elapsed={elapsed:.1f}s loadavg={load} env={env_view}\n"
+            f"stdout:\n{(stdout or '')[-1500:]}\n"
+            f"stderr:\n{(stderr or '')[-2500:]}")
+
+
 @pytest.mark.parametrize("script,extra,expect",
                          _EXAMPLES,
                          ids=[e.values[0] if hasattr(e, "values") else e[0]
@@ -97,6 +138,10 @@ def test_example_runs(script, extra, expect, workdir):
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         "PYTHONPATH": REPO,
+        # faulthandler armed in every child: SIGABRT on a timed-out run
+        # dumps all threads, so "which collective/compile wedged" is in
+        # the forensics instead of lost to the kill
+        "PYTHONFAULTHANDLER": "1",
     })
     cmd = [sys.executable, os.path.join(REPO, "examples", script), "--quick"]
     if script.startswith(("07", "09")):
@@ -106,36 +151,30 @@ def test_example_runs(script, extra, expect, workdir):
     # One retry: these are subprocess smoke runs of full training scripts on
     # a shared 1-core host — a rare intermittent failure (observed ~1/20
     # full-suite runs on the 07 interleaved-PP arm, never reproducible in
-    # isolation) must not abort a `-x` suite. A real regression fails both
-    # attempts and reports both outputs.
+    # isolation) must not abort a `-x` suite. But the retry must not MASK:
+    # the first failure's full forensics (rc, timing, host load, env,
+    # faulthandler dump on timeout) ride the pytest warning so the flake's
+    # root cause accumulates evidence instead of vanishing on green.
     import warnings
 
     first_failure = None
+    rc = stdout = stderr = None
     for attempt in range(2):
-        try:
-            proc = subprocess.run(cmd, env=env, capture_output=True,
-                                  text=True, timeout=600)
-        except subprocess.TimeoutExpired as e:
-            # a timeout IS the flake mode a loaded host produces — retry it
-            first_failure = first_failure or f"attempt {attempt + 1}: {e}"
-            continue
-        if proc.returncode == 0 and expect in proc.stdout:
+        rc, stdout, stderr, elapsed, timed_out = _run_once(cmd, env)
+        if rc == 0 and not timed_out and expect in stdout:
             if first_failure is not None:
                 # warnings survive pytest capture (shown in the summary) —
-                # a rising flake rate must stay visible
+                # a rising flake rate must stay visible, with evidence
                 warnings.warn(f"{script}: attempt 1 failed, attempt 2 "
-                              f"passed; first failure: "
-                              f"{first_failure[:800]}")
+                              f"passed ({elapsed:.1f}s); first failure "
+                              f"forensics:\n{first_failure[:3500]}")
             return
-        first_failure = first_failure or (
-            f"attempt {attempt + 1}: rc={proc.returncode}\nstdout:\n"
-            f"{proc.stdout[-1500:]}\nstderr:\n{proc.stderr[-1500:]}")
-    else:
-        raise AssertionError(
-            f"{script} failed on both attempts.\n-- last attempt: "
-            + (f"rc={proc.returncode}, expect {expect!r} "
-               f"{'present' if expect in proc.stdout else 'MISSING'}\n"
-               f"stdout:\n{proc.stdout[-3000:]}\nstderr:\n"
-               f"{proc.stderr[-3000:]}" if "proc" in locals()
-               else "timed out")
-            + f"\n-- first failure:\n{first_failure}")
+        if first_failure is None:
+            first_failure = _forensics(attempt + 1, rc, stdout, stderr,
+                                       elapsed, timed_out, env)
+    raise AssertionError(
+        f"{script} failed on both attempts (expect {expect!r} "
+        f"{'present' if stdout and expect in stdout else 'MISSING'}).\n"
+        f"-- last attempt: rc={rc}\nstdout:\n{(stdout or '')[-3000:]}\n"
+        f"stderr:\n{(stderr or '')[-3000:]}\n"
+        f"-- first failure forensics:\n{first_failure}")

@@ -1,0 +1,683 @@
+"""Serving fleet supervision (ddw_tpu.gateway.supervisor + the hardened
+engine): fault-injected replica death, circuit breaking, bounded
+auto-restart with warmup-gated rejoin, and deadline-aware request failover.
+
+The acceptance pins, all deterministic on CPU via ``DDW_FAULT=serve:...``:
+
+1. **no future ever hangs** — a crashed/stalled replica resolves every
+   queued and in-slot future with a structured ``ReplicaFailed`` (or
+   tokens, via failover), and with every replica down each future resolves
+   immediately with a structured refusal;
+2. **failover preserves determinism** — queued work from a dead replica
+   re-homes to a sibling and its tokens are identical to the sequential
+   path;
+3. **the circuit opens and routes around the corpse**, the supervisor
+   restarts it within budget, and the replica serves traffic again after
+   warmup (generation gating: the restarted engine runs clean even with
+   ``DDW_FAULT`` still set);
+4. the whole story is visible over HTTP: mid-stream death becomes a final
+   NDJSON error line, refusals become 503 + ``Retry-After`` the reference
+   client's backoff survives, and ``/metrics``/``/stats`` show the restart
+   and circuit transitions.
+
+Tier-1 cost discipline: the pure FSM/routing/accounting tests never touch
+jax; the jax tests share ONE module-scoped package, ONE 2-replica fleet
+(whose compiled programs survive the in-place restarts) and ONE
+single-replica gateway. The heavier HTTP chaos soak rides in tier-2 with
+the load-generator chaos arm (tests/test_load_gen.py).
+"""
+
+import concurrent.futures
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ddw_tpu.gateway import (
+    CIRCUIT_CLOSED,
+    CIRCUIT_HALF_OPEN,
+    CIRCUIT_OPEN,
+    CircuitBreaker,
+    Gateway,
+    GatewayClient,
+    ReplicaSet,
+    ReplicaSupervisor,
+    ServerLifecycle,
+)
+from ddw_tpu.runtime.faults import (
+    FaultInjected,
+    ServeCrash,
+    parse_fault,
+    parse_serve_fault,
+)
+from ddw_tpu.serve import (
+    DeadlineExceeded,
+    EngineCfg,
+    Overloaded,
+    Rejected,
+    ReplicaFailed,
+    ServingEngine,
+    Unavailable,
+)
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+
+
+# -- serve fault-spec parsing and matching (pure) ----------------------------
+
+def test_serve_fault_spec_parsing_and_matching():
+    spec = parse_serve_fault("serve:crash")
+    assert spec.kind == "crash" and spec.site is None
+    assert spec.replica == 0 and spec.after == 0 and spec.gen == 0
+    # one-of-two drill defaults: replica 0 only, first generation only
+    assert spec.matches("decode", replica=0, n=3, gen=0)
+    assert spec.matches("admit", replica=0, n=0, gen=0)
+    assert not spec.matches("decode", replica=1, n=0, gen=0)
+    assert not spec.matches("decode", replica=0, n=0, gen=1)
+
+    spec = parse_serve_fault(
+        "serve:stall:site=decode:replica=1:after=5:gen=*")
+    assert spec.kind == "stall" and spec.site == "decode"
+    assert not spec.matches("prefill", replica=1, n=9, gen=0)
+    assert not spec.matches("decode", replica=1, n=4, gen=0)
+    assert spec.matches("decode", replica=1, n=5, gen=7)
+
+    assert parse_serve_fault("") is None
+    assert parse_serve_fault("crash:rank=1") is None   # gang scope
+    # the gang parser validates serve specs but never fires on them
+    assert parse_fault("serve:raise:site=admit") is None
+    for bad in ("serve:explode", "serve:crash:site=warp",
+                "serve:crash:when=3"):
+        with pytest.raises(ValueError):
+            parse_serve_fault(bad)
+    with pytest.raises(ValueError):
+        parse_fault("serve:explode")   # typo'd serve spec fails loudly
+
+
+def test_serve_fault_fires_and_stall_aborts(monkeypatch):
+    from ddw_tpu.runtime.faults import maybe_serve_fault
+
+    monkeypatch.setenv("DDW_FAULT", "serve:raise:site=admit")
+    with pytest.raises(FaultInjected):
+        maybe_serve_fault("admit", replica=0, n=0, gen=0)
+    maybe_serve_fault("decode", replica=0, n=0, gen=0)   # site filtered
+    monkeypatch.setenv("DDW_FAULT", "serve:stall")
+    abort = threading.Event()
+    t = threading.Timer(0.1, abort.set)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(ServeCrash, match="stall aborted"):
+        maybe_serve_fault("decode", replica=0, n=0, gen=0,
+                          should_abort=abort.is_set)
+    assert time.monotonic() - t0 >= 0.1   # actually held until the abort
+
+
+# -- circuit breaker FSM (pure) ----------------------------------------------
+
+def test_circuit_breaker_open_half_open_close():
+    now = [100.0]
+    b = CircuitBreaker(failure_threshold=3, cooldown_s=5.0,
+                       clock=lambda: now[0])
+    assert b.state == CIRCUIT_CLOSED and b.available()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CIRCUIT_CLOSED      # under threshold
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CIRCUIT_CLOSED      # success reset the streak
+    b.record_failure()
+    assert b.state == CIRCUIT_OPEN and not b.available()
+    assert b.opened == 1
+    assert 0.0 < b.retry_after_ms() <= 5000.0
+    # a straggler completing does NOT close an opened circuit
+    b.record_success()
+    assert b.state == CIRCUIT_OPEN
+    # cooldown lapses into half-open with ONE probe slot
+    now[0] += 5.0
+    assert b.state == CIRCUIT_HALF_OPEN and b.available()
+    b.begin_probe()
+    assert not b.available()              # probe slot claimed
+    b.record_failure()                    # probe failed -> re-open
+    assert b.state == CIRCUIT_OPEN and b.opened == 2
+    # the supervisor's warmed-rejoin gate opens the window immediately
+    b.half_open()
+    assert b.state == CIRCUIT_HALF_OPEN
+    b.begin_probe()
+    b.record_success()                    # probe succeeded -> closed
+    assert b.state == CIRCUIT_CLOSED and b.available()
+    # neutral outcomes release the probe slot without a verdict
+    b.trip()
+    b.half_open()
+    b.begin_probe()
+    assert not b.available()
+    b.abort_probe()
+    assert b.available()
+
+
+# -- routing / accounting over scripted fakes (pure) --------------------------
+
+class _FakeEngine:
+    """Scriptable replica: refuse N times with Overloaded, or be 'dead'
+    (ReplicaFailed at submit)."""
+
+    def __init__(self, refuse: int = 0, dead: bool = False):
+        from ddw_tpu.serve.metrics import EngineMetrics
+
+        self.refuse = refuse
+        self.dead = dead
+        self.futures = []
+        self.calls = 0
+        self.metrics = EngineMetrics()
+
+    def start(self):
+        return self
+
+    def stop(self):
+        pass
+
+    def warmup(self, *a, **kw):
+        pass
+
+    def submit_generate(self, prompt, num_steps, **kw):
+        self.calls += 1
+        if self.dead:
+            raise ReplicaFailed("crash", replica=getattr(
+                self, "replica_id", 0))
+        if self.refuse > 0:
+            self.refuse -= 1
+            raise Overloaded("lm", 1, 1, retry_after_ms=42.0)
+        f = concurrent.futures.Future()
+        self.futures.append(f)
+        return f
+
+
+def test_replica_set_accounting_never_leaks():
+    """The satellite pin: every way a submission can go wrong — refusal at
+    the door, validation error, replica death before OR after the future
+    exists — must leave the outstanding counters at zero once the dust
+    settles (a leak would skew routing forever)."""
+    a, b = _FakeEngine(), _FakeEngine()
+    rs = ReplicaSet([a, b])
+    # submission raising (fault-injected dead engine) does not leak
+    a.dead = True
+    fut = rs.submit_generate([1], 1)          # routes around the corpse
+    assert fut in b.futures
+    assert rs.outstanding() == [0, 1]
+    fut.set_result(None)
+    assert rs.outstanding() == [0, 0]
+    # a future the engine FAILS (death after submit) decrements via the
+    # done-callback path and feeds the breaker
+    a.dead = False
+    f1 = rs.submit_generate([1], 1)
+    f1.set_exception(ReplicaFailed("crash"))
+    assert rs.outstanding() == [0, 0]
+    # validation errors never leak either
+    class _Boom(_FakeEngine):
+        def submit_generate(self, *a, **kw):
+            raise ValueError("bad prompt")
+
+    rs2 = ReplicaSet([_Boom()])
+    with pytest.raises(ValueError):
+        rs2.submit_generate([1], 1)
+    assert rs2.outstanding() == [0]
+    # Overloaded storms: refused everywhere, counters still zero
+    rs3 = ReplicaSet([_FakeEngine(refuse=5), _FakeEngine(refuse=5)])
+    with pytest.raises(Overloaded):
+        rs3.submit_generate([1], 1)
+    assert rs3.outstanding() == [0, 0]
+    assert rs3.retried_429 == 1
+
+
+def test_all_circuits_open_refuses_structured_and_probes_back():
+    a, b = _FakeEngine(), _FakeEngine()
+    rs = ReplicaSet([a, b], failure_threshold=1, cooldown_s=30.0)
+    rs.breakers[0].trip()
+    rs.breakers[1].trip()
+    with pytest.raises(Unavailable) as exc:
+        rs.submit_generate([1], 1)
+    d = exc.value.to_dict()
+    assert d["error"] == "unavailable" and d["retry_after_ms"] > 0
+    snap = rs.snapshot()
+    assert snap["gateway.circuit_r0"] == 2.0
+    assert snap["gateway.circuit_r1"] == 2.0
+    # the supervisor's rejoin gate readmits ONE probe; its success closes
+    rs.breakers[0].half_open()
+    fut = rs.submit_generate([1], 1)
+    assert fut in a.futures
+    with pytest.raises(Unavailable):
+        rs.submit_generate([1], 1)        # probe slot claimed, b still open
+    fut.set_result(None)
+    assert rs.breakers[0].state == CIRCUIT_CLOSED
+    assert rs.submit_generate([1], 1) in a.futures
+
+
+def test_dead_replica_does_not_consume_spill_budget():
+    """A corpse at the head of the routing order must not eat the single
+    sideways-retry budget meant for Overloaded spills."""
+    dead, full, ok = _FakeEngine(dead=True), _FakeEngine(refuse=1), \
+        _FakeEngine()
+    rs = ReplicaSet([dead, full, ok])
+    fut = rs.submit_generate([1], 1)
+    assert fut in ok.futures
+    assert rs.breakers[0].state == CIRCUIT_CLOSED  # 1 failure < threshold
+    assert rs.outstanding() == [0, 0, 1]
+
+
+class _FakeRestartable:
+    """Minimal health/restart surface for supervisor unit tests."""
+
+    def __init__(self, wedged: bool = False):
+        self.replica_id = 0
+        self.generation = 0
+        self.on_failure = None
+        self.wedged = wedged
+        self.warmups = 0
+        self.restarts = 0
+        self.metrics = None
+        self._failed = None
+
+    def fail(self, kind="crash"):
+        self._failed = ReplicaFailed(kind, replica=self.replica_id,
+                                     generation=self.generation)
+
+    @property
+    def failure(self):
+        return self._failed
+
+    def health(self):
+        return {"state": "failed" if self._failed else "alive",
+                "replica": self.replica_id, "generation": self.generation,
+                "running": self._failed is None, "last_tick_age_s": 0.0,
+                "consecutive_errors": 0, "queue_depth": 0, "busy_slots": 0}
+
+    def start(self):
+        return self
+
+    def stop(self):
+        pass
+
+    def warmup(self, lens):
+        self.warmups += 1
+
+    def restart(self):
+        if self.wedged:
+            raise RuntimeError("thread still running")
+        self.restarts += 1
+        self.generation += 1
+        self._failed = None
+        return self
+
+    def clone_fresh(self):
+        eng = _FakeRestartable()
+        eng.replica_id = self.replica_id
+        eng.generation = self.generation + 1
+        return eng
+
+
+def test_supervisor_budget_and_replace_path():
+    """Bounded restarts: within budget the replica restarts (warmed, then
+    half-open); a wedged thread is REPLACED via clone_fresh; over budget it
+    stays dark and the circuit stays open."""
+    eng = _FakeRestartable()
+    rs = ReplicaSet([eng])
+    sup = ReplicaSupervisor(rs, max_restarts=2, backoff_base_s=0.0,
+                            jitter=0.0, poll_interval_s=0.01).start()
+    try:
+        for expected in (1, 2):
+            eng.fail()
+            rs.breakers[0].trip()
+            rs.failure_event.set()
+            deadline = time.monotonic() + 5
+            while rs.restarts[0] < expected and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert rs.restarts[0] == expected
+            assert eng.warmups == expected          # warmup-gated rejoin
+            assert rs.breakers[0].state == CIRCUIT_HALF_OPEN
+            rs.breakers[0].record_success()
+        # third death: budget exhausted -> stays dark
+        eng.fail()
+        rs.failure_event.set()
+        time.sleep(0.2)
+        assert rs.restarts[0] == 2
+        assert eng.health()["state"] == "failed"
+        rep = sup.report()
+        assert any(a["action"] == "budget_exhausted"
+                   for a in rep["attempts"])
+        assert [a["action"] for a in rep["attempts"][:2]] == \
+            ["restarted", "restarted"]
+    finally:
+        sup.stop()
+
+    # wedged thread: restart() refuses -> clone_fresh + replace
+    eng2 = _FakeRestartable(wedged=True)
+    rs2 = ReplicaSet([eng2])
+    sup2 = ReplicaSupervisor(rs2, max_restarts=1, backoff_base_s=0.0,
+                             jitter=0.0, poll_interval_s=0.01).start()
+    try:
+        eng2.fail()
+        rs2.failure_event.set()
+        deadline = time.monotonic() + 5
+        while rs2.replicas[0] is eng2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rs2.replicas[0] is not eng2          # replaced
+        assert rs2.replicas[0].generation == 1
+        assert any(a.action == "replaced" for a in sup2.attempts)
+    finally:
+        sup2.stop()
+
+
+def test_lifecycle_readiness_reports_fleet_degradation():
+    health = [{"state": "alive"}, {"state": "alive"}]
+    lc = ServerLifecycle(grace_s=1.0)
+    lc.health_fn = lambda: health
+    ready, body = lc.readiness()
+    assert not ready and body["status"] == "starting"
+    lc.mark_ready()
+    ready, body = lc.readiness()
+    assert ready and body["replicas_up"] == 2 and "degraded" not in body
+    health[0]["state"] = "failed"
+    ready, body = lc.readiness()
+    assert ready and body["degraded"] and body["replicas_up"] == 1
+    health[1]["state"] = "failed"              # every replica dead: tell
+    ready, body = lc.readiness()               # the balancer to go away
+    assert not ready and body["status"] == "no_replicas"
+    health[1]["state"] = "degraded"            # degraded still serves
+    ready, body = lc.readiness()
+    assert ready and body["replicas_up"] == 1
+
+
+# -- shed-not-hang: all replicas down (no device work, no compiles) ----------
+
+@pytest.mark.faults
+def test_every_future_resolves_when_all_replicas_die(pm):
+    """Queued work on a fleet whose every replica dies resolves immediately
+    with a structured refusal — tokens-or-503, never a hang — and new
+    submissions refuse with Unavailable."""
+    engines = [ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2))
+               for _ in range(2)]          # never started: queued only
+    rs = ReplicaSet(engines)
+    prompts = _prompts([5, 7, 4, 9], seed=3)
+    futs = [rs.submit_generate(p, 4, timeout_s=30.0) for p in prompts]
+    t0 = time.monotonic()
+    engines[0].force_fail("crash")
+    engines[1].force_fail("crash")
+    for f in futs:
+        with pytest.raises((ReplicaFailed, Unavailable, DeadlineExceeded)):
+            f.result(timeout=5)            # resolved, structured
+    assert time.monotonic() - t0 < 5.0
+    assert rs.outstanding() == [0, 0]      # the accounting-leak pin, live
+    with pytest.raises(Unavailable):
+        rs.submit_generate(prompts[0], 4)
+    snap = rs.snapshot()
+    assert snap["gateway.replica_failures"] == 2.0
+    assert snap["gateway.circuit_r0"] == 2.0
+    assert snap["gateway.circuit_r1"] == 2.0
+
+
+# -- jax fixtures ------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    cfg = LMCfg(vocab_size=VOCAB, max_len=96, hidden=32, depth=2,
+                num_heads=2, mlp_dim=64, dropout=0.0, dtype="float32")
+    from ddw_tpu.models.lm import build_lm
+
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int32))["params"]
+    out = str(tmp_path_factory.mktemp("sup_pkg") / "pkg")
+    return load_lm_package(save_lm_package(out, cfg, params))
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+@pytest.fixture(scope="module")
+def fleet(pm):
+    """One supervised 2-replica fleet shared by the ordered drills below:
+    the crash drill kills replica 0 (gen 0->1), the stall drill wedges
+    replica 1 (gen 0->1), the final test pins clean service + counters.
+    In-place restarts keep compiled programs, so the whole sequence costs
+    two engine compiles."""
+    engines = [ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2,
+                                                  steps_per_tick=2))
+               for _ in range(2)]
+    rs = ReplicaSet(engines, cooldown_s=30.0)   # rejoin via the
+    #                                             supervisor's gate, not
+    #                                             the cooldown clock
+    sup = ReplicaSupervisor(rs, max_restarts=2, backoff_base_s=0.05,
+                            backoff_max_s=0.2, jitter=0.0,
+                            stall_timeout_s=3.0, poll_interval_s=0.05,
+                            warmup_prompt_lens=(8, 16))
+    # stall_timeout at 3 s, and every drill prompt stays inside the warmed
+    # 8/16 buckets: an unwarmed-bucket XLA compile inside one loop
+    # iteration would stale the heartbeat past a tighter threshold and
+    # false-positive the stall detector on a loaded host
+    rs.start()
+    rs.warmup((8, 16))
+    sup.start()
+    yield rs, sup, engines
+    sup.stop()
+    rs.stop()
+
+
+def _await(cond, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# -- the chaos drills (ordered; shared fleet) --------------------------------
+
+@pytest.mark.faults
+def test_failover_preserves_determinism_after_mid_queue_kill(
+        fleet, pm, monkeypatch):
+    """DDW_FAULT=serve:crash kills replica 0 at its first decode tick with
+    requests queued behind its slots: every future resolves (tokens or
+    structured ReplicaFailed), queued work fails over to replica 1 with
+    token-identical output, the circuit opens, and the supervisor restarts
+    replica 0 — which then serves token-identical traffic again (the
+    restarted generation runs clean with the fault still set)."""
+    rs, sup, engines = fleet
+    prompts = _prompts([5, 9, 7, 4, 11, 6], seed=1)
+    steps = 6
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+    monkeypatch.setenv("DDW_FAULT", "serve:crash:site=decode:replica=0")
+    futs = [rs.submit_generate(p, steps) for p in prompts]
+    outcomes = []
+    for i, f in enumerate(futs):
+        try:
+            r = f.result(timeout=60)
+            assert np.array_equal(r.tokens, refs[i]), i   # determinism
+            outcomes.append("ok")
+        except ReplicaFailed as e:
+            assert e.to_dict()["error"] == "replica_failed"
+            assert e.forensics["traceback"]
+            outcomes.append("failed")
+    assert "ok" in outcomes            # the fleet kept serving
+    assert "failed" in outcomes        # the in-slot victims failed loudly
+    snap = rs.snapshot()
+    assert snap["gateway.replica_failures"] >= 1.0
+    # supervisor: bounded restart + warmed rejoin within its budget
+    assert _await(lambda: rs.restarts[0] >= 1)
+    assert _await(lambda: engines[0].state == "alive")
+    assert engines[0].generation == 1
+    assert any(a.kind == "crash" and a.action == "restarted"
+               for a in sup.attempts)
+    # the half-open probe readmits it; its success closes the circuit
+    assert rs.breakers[0].state in (CIRCUIT_HALF_OPEN, CIRCUIT_CLOSED)
+    r = rs.generate(prompts[0], steps)
+    assert np.array_equal(r.tokens, refs[0])
+    assert _await(lambda: rs.breakers[0].state == CIRCUIT_CLOSED, 5.0)
+
+
+@pytest.mark.faults
+def test_stalled_replica_detected_force_failed_and_restarted(
+        fleet, pm, monkeypatch):
+    """A decode tick that never returns (serve:stall) is invisible to
+    request outcomes — only the loop heartbeat catches it. The supervisor's
+    stall detector declares the replica dead (its futures resolve, nobody
+    hangs), joins the aborted thread, and restarts it in place."""
+    rs, sup, engines = fleet
+    assert _await(lambda: engines[0].state == "alive")  # prior drill done
+    monkeypatch.setenv("DDW_FAULT", "serve:stall:site=decode:replica=1")
+    prompts = _prompts([5, 8, 6, 9], seed=2)
+    steps = 4
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+    futs = [rs.submit_generate(p, steps) for p in prompts]
+    outcomes = {"ok": 0, "failed": 0}
+    for i, f in enumerate(futs):
+        try:
+            r = f.result(timeout=60)   # < stall forever: the pin is that
+            assert np.array_equal(r.tokens, refs[i]), i
+            outcomes["ok"] += 1
+        except (ReplicaFailed, Unavailable):
+            outcomes["failed"] += 1    # stalled slots fail, never hang
+    assert outcomes["ok"] >= 1
+    assert _await(lambda: any(a.kind == "stalled" for a in sup.attempts))
+    assert _await(lambda: rs.restarts[1] >= 1)
+    assert _await(lambda: engines[1].state == "alive")
+    assert engines[1].generation >= 1
+
+
+@pytest.mark.faults
+def test_fleet_serves_clean_after_drills_and_counters_pin(fleet, pm):
+    """After both drills: no fault env, both replicas restarted, full
+    determinism across the fleet, and the observability surface carries
+    the story (restart counts, circuit states, failover counters)."""
+    rs, sup, engines = fleet
+    assert _await(lambda: all(e.state == "alive" for e in engines))
+    prompts = _prompts([3, 12, 6, 15, 9, 4, 8, 5], seed=4)
+    steps = 5
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+    futs = [rs.submit_generate(p, steps) for p in prompts]
+    for i, f in enumerate(futs):
+        assert np.array_equal(f.result(timeout=60).tokens, refs[i]), i
+    snap = rs.snapshot()
+    assert snap["gateway.restarts_r0"] >= 1.0
+    assert snap["gateway.restarts_r1"] >= 1.0
+    assert snap["gateway.replica_failures"] >= 2.0
+    assert snap["gateway.circuit_r0"] == 0.0    # closed again
+    assert snap["gateway.circuit_r1"] == 0.0
+    text = rs.prometheus()
+    assert 'ddw_gateway_restarts{replica="0"}' in text
+    assert 'ddw_gateway_circuit_state{replica="0"} 0' in text
+    assert "ddw_gateway_replica_failures" in text
+    health = rs.fleet_health()
+    assert [h["state"] for h in health] == ["alive", "alive"]
+    assert all(h["generation"] >= 1 for h in health)
+    rep = sup.report()
+    assert len(rep["attempts"]) >= 2
+
+
+# -- the HTTP acceptance drill ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gw(pm):
+    """One supervised single-replica gateway for the HTTP tests (the chaos
+    drill restarts its replica in place, so the keep-alive test that
+    follows reuses the same compiled programs)."""
+    g = Gateway(ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2,
+                                                   steps_per_tick=2)),
+                grace_s=60.0,
+                supervisor_kw=dict(max_restarts=2, backoff_base_s=0.05,
+                                   backoff_max_s=0.2, jitter=0.0,
+                                   poll_interval_s=0.05))
+    g.start(warmup_prompt_lens=(8,))
+    yield g
+    g.stop()
+
+
+@pytest.mark.faults
+def test_gateway_chaos_drill_over_http(gw, pm, monkeypatch):
+    """The client-visible half of the acceptance pin: a replica crash
+    mid-stream ends the stream with a structured NDJSON error line (not a
+    hang), refusals while the replica is down are 503 + Retry-After that
+    the reference client's backoff survives into the restarted replica,
+    and /metrics + /stats show the restart and circuit transitions."""
+    eng = gw.replica_set.replicas[0]
+    cli = GatewayClient("127.0.0.1", gw.port)
+    assert cli.wait_ready(30.0)
+    prompt = _prompts([5], seed=6)[0]
+    ref = pm.generate(prompt[None, :], 6)[0]
+    assert np.array_equal(cli.generate(prompt, 6)["tokens"], ref)
+    # crash at the 2nd decode tick: the stream has tokens in flight
+    monkeypatch.setenv("DDW_FAULT", "serve:crash:site=decode:after=1")
+    seen = []
+    from ddw_tpu.gateway import GatewayError
+    with pytest.raises(GatewayError) as exc:
+        cli.generate(prompt, 40, stream=True,
+                     on_token=lambda i, t: seen.append(t))
+    assert exc.value.body["error"] == "replica_failed"   # final NDJSON
+    assert seen, "stream never started before the kill"  # mid-stream
+    # the client's 503 backoff rides out the restart window: the retry
+    # lands on the restarted (clean-generation) replica and succeeds
+    out = cli.generate(prompt, 6)
+    assert np.array_equal(out["tokens"], ref)
+    assert _await(lambda: gw.replica_set.restarts[0] >= 1, 10.0)
+    status, body = cli.readyz()
+    assert status == 200 and body["replicas_up"] == 1
+    text = cli.metrics_text()
+    assert 'ddw_gateway_restarts{replica="0"} 1' in text
+    assert 'ddw_gateway_circuit_state{replica="0"}' in text
+    assert "ddw_gateway_replica_failures 1" in text
+    stats = cli.stats()
+    assert stats["gateway.restarts_r0"] >= 1.0
+    assert stats["replica_health"][0]["generation"] >= 1
+    assert stats["supervisor"]["attempts"]
+    assert eng.metrics.snapshot()["serve.loop_errors"] == 0.0
+    cli.close()
+
+
+def test_client_reuses_keepalive_connections(gw):
+    """Transport-hardening satellite: unary exchanges ride one keep-alive
+    connection (the pool reuses it) and the server's connection guard
+    refuses past max_connections with a fast 503 instead of piling up.
+    Runs on the post-drill gateway — the restarted replica serves it."""
+    cli = GatewayClient("127.0.0.1", gw.port)
+    assert cli.wait_ready(30.0)
+    cli.healthz()
+    for _ in range(4):
+        cli.stats()
+    assert cli.reused >= 4        # wait_ready polls + the calls above
+    prompt = _prompts([5], seed=8)[0]
+    n0 = cli.reused
+    cli.generate(prompt, 3)
+    assert cli.reused > n0        # POSTs reuse too
+    # the connection guard: drop the cap, open idle keep-alive conns
+    # beyond it, and the next request gets a fast structured 503.
+    # Close the client's pooled keep-alive sockets first and wait for
+    # their server threads to notice, so the count starts at zero.
+    cli.close()
+    assert _await(lambda: gw._httpd.active_connections == 0, 10.0)
+    gw._httpd.max_connections = 1
+    import http.client
+
+    hold = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=10)
+    hold.request("GET", "/healthz")
+    hold.getresponse().read()      # keep-alive: the thread stays open
+    try:
+        probe = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                           timeout=10)
+        probe.request("GET", "/healthz")
+        resp = probe.getresponse()
+        assert resp.status == 503
+        assert resp.headers["Retry-After"] == "1"
+        import json as _json
+
+        assert _json.loads(resp.read())["error"] == "unavailable"
+        probe.close()
+    finally:
+        hold.close()
+        gw._httpd.max_connections = 256

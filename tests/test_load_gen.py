@@ -55,6 +55,32 @@ def test_load_gen_smoke_two_replicas_beat_one():
     assert bdual["slo_attainment"] >= 0.75
 
 
+def test_load_gen_chaos_kill_one_replica_mid_run():
+    """The chaos-arm pin (tier-2; tests/test_fleet_supervision.py carries
+    the tier-1 representative): with DDW_FAULT=serve:crash killing one of
+    two replicas mid-run, fleet goodput stays above zero, every request
+    resolves (200 or a structured refusal the client's backoff reported),
+    the supervisor restarts the replica within budget, and it is serving
+    again — circuit closed, generation bumped — by the end of the run."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/load_gen.py"),
+         "--chaos"],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])["chaos"]
+    row = d["row"]
+    # goodput through the death: the fleet kept completing requests
+    assert row["completed"] >= 1 and row["goodput_rps"] > 0
+    # every request resolved: completions + surfaced refusals == offered
+    assert row["completed"] + sum(row["errors"].values()) == row["offered"]
+    # the kill really happened, was contained, and was recovered from
+    assert d["replica_failures"] >= 1.0
+    assert d["restarts"][0] >= 1
+    assert d["replica_states"] == ["alive", "alive"]
+    assert d["generations"][0] >= 1
+    assert d["circuits"][1] == "closed"
+
+
 def test_load_gen_refuses_cpu_fallback():
     env = dict(_env(), DDW_REQUIRE_TPU="1")
     out = subprocess.run(

@@ -24,7 +24,17 @@ CI smoke (``DDW_BENCH_SMOKE=1``, no args): self-hosts a gateway on a
 throwaway package and runs the fleet-scaling comparison the slow suite
 pins — ONE replica vs TWO replicas (same slots each), closed-loop capacity
 rows plus the deadline-bounded burst rows where the 2-replica win is
-measured. The burst is the honest 1-core framing: replicas sharing a core
+measured.
+
+Chaos arm (``--chaos``, or ``DDW_BENCH_CHAOS=1`` with the smoke): the
+robustness pin rather than the capacity pin — closed-loop clients drive a
+supervised 2-replica fleet while ``DDW_FAULT=serve:crash`` kills replica 0
+mid-run. The drill asserts what docs/fault_tolerance.md promises: fleet
+goodput stays above zero through the death (the circuit opens and routes
+around the corpse; failed requests surface as structured 503s the client's
+backoff absorbs), the supervisor restarts the replica within budget, and
+it is serving again (generation bumped, circuit re-closed) by the end of
+the run. Prints one JSON line with the load row + the recovery record. The burst is the honest 1-core framing: replicas sharing a core
 cannot exceed its service rate (the closed rows prove that), but doubling
 slot capacity halves queue wait for a burst, so strictly more requests
 complete within their SLO — and the shed ones cost no device time. On a
@@ -250,6 +260,75 @@ def smoke(prompt_len=16, steps=24, steps_burst=48, requests=32, n_slots=4,
     return out
 
 
+def chaos(prompt_len=12, steps=16, requests=32, n_slots=2, steps_per_tick=4,
+          hidden=64, depth=2, clients=4, kill_after_ticks=6):
+    """Kill-one-replica-mid-run drill over the real HTTP path.
+
+    Small shapes on purpose (hidden 64): the subject is the failure
+    machinery, not throughput — the capacity story is :func:`smoke`. The
+    fault fires at replica 0's ``kill_after_ticks``-th decode tick of
+    generation 0, i.e. provably mid-run with requests in flight and
+    queued; the restarted generation runs clean by construction."""
+    import tempfile
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.gateway import Gateway, GatewayClient, ReplicaSet
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "chaos", hidden, depth, 2, 128, 96,
+                          dtype="float32")
+        engines = [ServingEngine(lm=pm, cfg=EngineCfg(
+            n_slots=n_slots, steps_per_tick=steps_per_tick,
+            default_timeout_s=600.0)) for _ in range(2)]
+        gw = Gateway(ReplicaSet(engines), grace_s=60.0,
+                     supervisor_kw=dict(max_restarts=2, backoff_base_s=0.1,
+                                        backoff_max_s=0.5, jitter=0.0,
+                                        poll_interval_s=0.05))
+        gw.start(warmup_prompt_lens=(prompt_len,))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 128, size=(prompt_len,)).astype(np.int32)
+                   for _ in range(requests)]
+        prev_fault = os.environ.get("DDW_FAULT")
+        os.environ["DDW_FAULT"] = (
+            f"serve:crash:site=decode:replica=0:after={kill_after_ticks}")
+        try:
+            # retries generous: a 503 while the corpse restarts is the
+            # expected path, and the client's Retry-After backoff IS the
+            # machinery under test
+            row = closed_loop(gw.url, prompts, steps, clients, retries=6)
+            cli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+            deadline = time.monotonic() + 30.0
+            while (time.monotonic() < deadline
+                   and gw.replica_set.restarts[0] < 1):
+                time.sleep(0.05)
+            stats = cli.stats()
+            out = {
+                "row": row,
+                "restarts": list(gw.replica_set.restarts),
+                "replica_failures": stats["gateway.replica_failures"],
+                "failed_over": stats["gateway.failed_over"],
+                "circuits": [b.state for b in gw.replica_set.breakers],
+                "replica_states": [h["state"]
+                                   for h in stats["replica_health"]],
+                "generations": [h["generation"]
+                                for h in stats["replica_health"]],
+            }
+            print(f"[load_gen] chaos: {row['completed']}/{requests} "
+                  f"completed (goodput {row['goodput_rps']:.2f} req/s), "
+                  f"restarts {out['restarts']}, "
+                  f"states {out['replica_states']}",
+                  file=sys.stderr, flush=True)
+            return out
+        finally:
+            if prev_fault is None:
+                os.environ.pop("DDW_FAULT", None)
+            else:
+                os.environ["DDW_FAULT"] = prev_fault
+            gw.stop()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default=None, help="target a live gateway")
@@ -261,6 +340,9 @@ def main():
     ap.add_argument("--rps", type=float, default=None,
                     help="open-loop offered rate (else closed loop)")
     ap.add_argument("--stream", action="store_true")
+    ap.add_argument("--chaos", action="store_true",
+                    help="self-hosted kill-one-replica drill instead of "
+                         "the capacity smoke")
     args = ap.parse_args()
 
     if args.url:
@@ -284,7 +366,12 @@ def main():
 
     kind = require_tpu_or_exit("measure")
     print(f"device: {kind}", file=sys.stderr, flush=True)
-    result = {"device": {"kind": kind, "n": jax.device_count()}, **smoke()}
+    if args.chaos or env_flag("DDW_BENCH_CHAOS"):
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "chaos": chaos()}
+    else:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  **smoke()}
     print(json.dumps(result))
 
 
