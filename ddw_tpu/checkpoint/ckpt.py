@@ -23,8 +23,15 @@ import time
 import jax
 from flax import serialization
 
+from ddw_tpu.runtime.faults import maybe_fault
+
 
 def _is_writer() -> bool:
+    # Elastic gangs (runtime/elastic.py) skip jax.distributed — every
+    # process would see process_index() == 0; the env rank keeps the
+    # rank-0-writer discipline intact there.
+    if os.environ.get("DDW_RENDEZVOUS_DIR"):
+        return os.environ.get("DDW_PROCESS_ID", "0") == "0"
     return jax.process_index() == 0
 
 
@@ -41,6 +48,9 @@ def _write_host_state(ckpt_dir: str, host_state, step: int,
     exact serialized byte count so readers can *detect* a torn dir (however
     produced — non-atomic writers, partial copies, filesystem loss) and
     quarantine it rather than poisoning resume."""
+    # Deterministic torn-async drill (DDW_FAULT=ckpt_async_torn): fires on
+    # whichever thread runs this write — the background writer in async mode.
+    maybe_fault("ckpt_async", step=step, ckpt_dir=ckpt_dir)
     os.makedirs(ckpt_dir, exist_ok=True)
     final = os.path.join(ckpt_dir, f"step_{step:010d}")
     tmp = final + ".tmp"
@@ -174,52 +184,74 @@ class CheckpointManager:
     synchronously (a consistent snapshot — training may donate/overwrite the
     device buffers immediately after), then serializes + writes on a single
     background thread, so msgpack encoding and disk IO overlap the next
-    epoch's compute instead of stalling the train loop. One write in flight
-    at a time — a new ``save`` first joins the previous one; every read-side
-    method joins too, and :meth:`wait` makes the last write durable (the
-    trainer calls it before returning). Background errors surface on the
-    next ``save``/``wait``.
+    epoch's compute instead of stalling the train loop. ``max_inflight``
+    bounds the write queue: a ``save`` blocks only while MORE than that many
+    writes are outstanding (depth 1 = join-previous-before-new, the
+    strictest cadence; the trainers default to 2 so one slow fsync never
+    stalls a chain boundary, see ``TrainCfg.async_checkpoint_inflight``).
+    Writes retire in submission order on the single writer thread, so
+    retention and ``latest_step`` stay coherent. Deferred background errors
+    are never swallowed: every ``save`` first reaps finished writes and
+    re-raises the oldest failure, and every read-side method (plus
+    :meth:`wait`, which the trainers call before returning) drains the
+    queue fully.
     """
 
-    def __init__(self, ckpt_dir: str, keep: int = 3, async_write: bool = False):
+    def __init__(self, ckpt_dir: str, keep: int = 3,
+                 async_write: bool = False, max_inflight: int = 1):
         self.ckpt_dir = ckpt_dir
         self.keep = keep
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        self.max_inflight = max_inflight
         self._executor = None
-        self._pending = None
+        from collections import deque
+
+        self._pending = deque()
         if async_write and _is_writer():
             from concurrent.futures import ThreadPoolExecutor
 
             self._executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-writer")
 
+    def _reap(self, max_left: int) -> None:
+        """Retire finished writes (surfacing any deferred error at THIS
+        boundary) and block until at most ``max_left`` remain in flight."""
+        while self._pending and (self._pending[0].done()
+                                 or len(self._pending) > max_left):
+            self._pending.popleft().result()
+
     def save(self, state, step: int, metadata: dict | None = None):
         if self._executor is None:
             return save_checkpoint(self.ckpt_dir, state, step, metadata, self.keep)
-        self.wait()  # join (and surface errors from) the previous write
+        # Surface finished writes' errors now; block only past the bound.
+        self._reap(self.max_inflight - 1)
         host_state = jax.device_get(state)  # snapshot before buffers mutate
         # Deep-copy metadata too: the caller may reuse/mutate its dict before
         # the writer thread serializes it.
         import copy
 
-        self._pending = self._executor.submit(
+        self._pending.append(self._executor.submit(
             _write_host_state, self.ckpt_dir, host_state, step,
-            copy.deepcopy(metadata), self.keep)
+            copy.deepcopy(metadata), self.keep))
         return os.path.join(self.ckpt_dir, f"step_{step:010d}")
 
     def wait(self) -> None:
-        """Block until the in-flight async write (if any) is durable on disk;
-        re-raises any background write error."""
-        if self._pending is not None:
-            pending, self._pending = self._pending, None
-            pending.result()
+        """Block until every in-flight async write is durable on disk;
+        re-raises the oldest background write error."""
+        self._reap(0)
 
     def close(self) -> None:
-        """Join the in-flight write and release the writer thread. The manager
-        stays usable — subsequent saves fall back to synchronous writes."""
-        self.wait()
-        if self._executor is not None:
-            self._executor.shutdown(wait=True)
-            self._executor = None
+        """Join the in-flight writes and release the writer thread. The
+        manager stays usable — subsequent saves fall back to synchronous
+        writes. A deferred write error still surfaces (after the thread is
+        released)."""
+        try:
+            self.wait()
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
+                self._executor = None
 
     def restore(self, target, step: int | None = None):
         self.wait()

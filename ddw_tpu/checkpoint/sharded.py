@@ -39,6 +39,13 @@ Resharding restore: a requested device slice is assembled from every saved
 shard that overlaps it, so a state saved on one mesh (say ``{'data': 8}``)
 restores onto a different one (``{'data': 4}``, or different axis splits)
 without any intermediate full array.
+
+Async writes: :func:`save_sharded` is the synchronous composition of
+:func:`snapshot_shards` (host copy at the chain boundary — donation-safe)
+and :func:`write_snapshot` (the full commit protocol, thread-agnostic);
+:class:`ShardedCheckpointManager` runs the write half on a bounded
+per-process background writer so the train loop never stalls on disk
+(docs/fault_tolerance.md "Async checkpointing").
 """
 
 from __future__ import annotations
@@ -53,6 +60,7 @@ import numpy as np
 
 from ddw_tpu.checkpoint.ckpt import (_apply_retention, _list_steps,
                                      _quarantine_step)
+from ddw_tpu.runtime.faults import maybe_fault
 
 
 def _fsync_write(path: str, write_fn, mode: str = "w") -> None:
@@ -94,14 +102,83 @@ def _start_offsets(index, shape) -> list[int]:
     return [int(sl.indices(dim)[0]) for sl, dim in zip(index, shape)]
 
 
-def save_sharded(ckpt_dir: str, state, step: int, metadata: dict | None = None,
-                 keep: int = 3, timeout_s: float = 300.0) -> str:
-    """Collective save: every process must call this with the same ``step``.
-    Returns the final checkpoint path (once it is committed)."""
+class ShardSnapshot:
+    """A host-side copy of everything one process contributes to a sharded
+    checkpoint — taken synchronously at the chain boundary (``tobytes``
+    copies out of the device buffers, so training may donate/overwrite them
+    immediately after), written later by :func:`write_snapshot` on whatever
+    thread the caller chooses. This is the device-snapshot / disk-write
+    split the async sharded manager is built on."""
+
+    __slots__ = ("entries", "leaves_meta", "blobs", "pid", "nproc")
+
+    def __init__(self, entries, leaves_meta, blobs, pid, nproc):
+        self.entries = entries          # shard table rows (offset/nbytes set)
+        self.leaves_meta = leaves_meta  # leaf path -> shape/dtype[/host]
+        self.blobs = blobs              # raw bytes, aligned with entries
+        self.pid = pid
+        self.nproc = nproc
+
+
+def snapshot_shards(state) -> ShardSnapshot:
+    """Synchronously copy this process's shards (replica 0 only, so
+    replicated leaves are written once) to host memory."""
     pid = jax.process_index()
     nproc = jax.process_count()
+    entries: list[dict] = []
+    leaves_meta: dict[str, dict] = {}
+    blobs: list[bytes] = []
+    offset = 0
+    for path_str, leaf in _flat_with_paths(state):
+        if isinstance(leaf, jax.Array):
+            leaves_meta[path_str] = {"shape": list(leaf.shape),
+                                     "dtype": str(leaf.dtype)}
+            for sh in leaf.addressable_shards:
+                if sh.replica_id != 0:
+                    continue  # exactly one replica writes each slice
+                data = np.asarray(sh.data)
+                raw = data.tobytes()    # tobytes copies: donation-safe
+                entries.append({
+                    "leaf": path_str,
+                    "start": _start_offsets(sh.index, leaf.shape),
+                    "shape": list(data.shape),
+                    "offset": offset,
+                    "nbytes": len(raw),
+                })
+                blobs.append(raw)
+                offset += len(raw)
+        else:
+            # host-side leaf (plain scalar / numpy): process 0 owns it
+            data = np.asarray(leaf)
+            leaves_meta[path_str] = {"shape": list(data.shape),
+                                     "dtype": str(data.dtype),
+                                     "host": True}
+            if pid == 0:
+                raw = data.tobytes()
+                entries.append({"leaf": path_str,
+                                "start": [0] * data.ndim,
+                                "shape": list(data.shape),
+                                "offset": offset, "nbytes": len(raw)})
+                blobs.append(raw)
+                offset += len(raw)
+    return ShardSnapshot(entries, leaves_meta, blobs, pid, nproc)
+
+
+def write_snapshot(ckpt_dir: str, snap: ShardSnapshot, step: int,
+                   metadata: dict | None = None, keep: int = 3,
+                   timeout_s: float = 300.0) -> str:
+    """The disk half of the collective save: write one process's snapshot
+    through the full commit protocol (shard file + table + marker fsynced;
+    process 0 gathers markers, records ``proc_bytes``, renames). Pure host
+    work — safe on a background writer thread; each process's writer
+    participates in the same cross-process commit it would on the caller's
+    thread."""
+    pid, nproc = snap.pid, snap.nproc
     final = os.path.join(ckpt_dir, f"step_{step:010d}")
     tmp = final + ".tmp"
+    # Deterministic torn-async drill (DDW_FAULT=ckpt_async_torn): publishes
+    # a torn dir for THIS step, then kills the process mid-write.
+    maybe_fault("ckpt_async", step=step, ckpt_dir=ckpt_dir)
     if pid == 0:
         os.makedirs(ckpt_dir, exist_ok=True)
         shutil.rmtree(tmp, ignore_errors=True)
@@ -109,43 +186,11 @@ def save_sharded(ckpt_dir: str, state, step: int, metadata: dict | None = None,
     else:
         _wait_for(lambda: os.path.isdir(tmp), timeout_s, f"writer to create {tmp}")
 
-    entries: list[dict] = []
-    leaves_meta: dict[str, dict] = {}
+    entries, leaves_meta = snap.entries, snap.leaves_meta
     bin_partial = os.path.join(tmp, f"proc_{pid}.bin.partial")
-    offset = 0
     with open(bin_partial, "wb") as f:
-        for path_str, leaf in _flat_with_paths(state):
-            if isinstance(leaf, jax.Array):
-                leaves_meta[path_str] = {"shape": list(leaf.shape),
-                                         "dtype": str(leaf.dtype)}
-                for sh in leaf.addressable_shards:
-                    if sh.replica_id != 0:
-                        continue  # exactly one replica writes each slice
-                    data = np.asarray(sh.data)
-                    raw = data.tobytes()
-                    entries.append({
-                        "leaf": path_str,
-                        "start": _start_offsets(sh.index, leaf.shape),
-                        "shape": list(data.shape),
-                        "offset": offset,
-                        "nbytes": len(raw),
-                    })
-                    f.write(raw)
-                    offset += len(raw)
-            else:
-                # host-side leaf (plain scalar / numpy): process 0 owns it
-                data = np.asarray(leaf)
-                leaves_meta[path_str] = {"shape": list(data.shape),
-                                         "dtype": str(data.dtype),
-                                         "host": True}
-                if pid == 0:
-                    raw = data.tobytes()
-                    entries.append({"leaf": path_str,
-                                    "start": [0] * data.ndim,
-                                    "shape": list(data.shape),
-                                    "offset": offset, "nbytes": len(raw)})
-                    f.write(raw)
-                    offset += len(raw)
+        for raw in snap.blobs:
+            f.write(raw)
         f.flush()
         os.fsync(f.fileno())  # shard bytes durable before the commit marker
     os.replace(bin_partial, os.path.join(tmp, f"proc_{pid}.bin"))
@@ -182,6 +227,15 @@ def save_sharded(ckpt_dir: str, state, step: int, metadata: dict | None = None,
         _wait_for(lambda: os.path.isdir(final), timeout_s,
                   f"writer to commit {final}")
     return final
+
+
+def save_sharded(ckpt_dir: str, state, step: int, metadata: dict | None = None,
+                 keep: int = 3, timeout_s: float = 300.0) -> str:
+    """Collective save: every process must call this with the same ``step``.
+    Returns the final checkpoint path (once it is committed). Snapshot +
+    write on the caller's thread; the async manager splits the two."""
+    return write_snapshot(ckpt_dir, snapshot_shards(state), step, metadata,
+                          keep, timeout_s)
 
 
 class _ShardReader:
@@ -356,21 +410,78 @@ def read_metadata(ckpt_dir: str, step: int | None = None) -> dict | None:
 class ShardedCheckpointManager:
     """Directory + retention binding for the sharded format, mirroring
     :class:`ddw_tpu.checkpoint.ckpt.CheckpointManager`'s surface. Save is
-    collective (every process calls it); restore reads only local slices."""
+    collective (every process calls it); restore reads only local slices.
 
-    def __init__(self, ckpt_dir: str, keep: int = 3):
+    ``async_write=True``: :meth:`save` copies this process's shards to host
+    synchronously (:func:`snapshot_shards` — a consistent snapshot even
+    under buffer donation) and runs the write + fsync + commit protocol on
+    a per-process background writer thread, bounded at ``max_inflight``
+    outstanding steps. The commit stays collective: every process's writer
+    participates in the same marker/rename protocol, just off the train
+    loop's critical path. Deferred writer errors (including a peer timing
+    out of the commit) surface at the next ``save``/``wait`` — never
+    swallowed. A process killed mid-write leaves an unpublished ``.tmp``
+    (invisible to readers) or a dir that fails the ``proc_bytes``
+    completeness record — :func:`latest_complete_step` quarantines it
+    exactly like the synchronous path."""
+
+    def __init__(self, ckpt_dir: str, keep: int = 3,
+                 async_write: bool = False, max_inflight: int = 1):
         self.ckpt_dir = ckpt_dir
         self.keep = keep
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        self.max_inflight = max_inflight
+        self._executor = None
+        from collections import deque
+
+        self._pending = deque()
+        if async_write:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # every process runs a writer (saves are collective)
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="sharded-ckpt-writer")
+
+    def _reap(self, max_left: int) -> None:
+        while self._pending and (self._pending[0].done()
+                                 or len(self._pending) > max_left):
+            self._pending.popleft().result()
 
     def save(self, state, step: int, metadata: dict | None = None) -> str:
-        return save_sharded(self.ckpt_dir, state, step, metadata, self.keep)
+        if self._executor is None:
+            return save_sharded(self.ckpt_dir, state, step, metadata,
+                                self.keep)
+        self._reap(self.max_inflight - 1)
+        snap = snapshot_shards(state)   # host copy BEFORE buffers mutate
+        import copy
+
+        self._pending.append(self._executor.submit(
+            write_snapshot, self.ckpt_dir, snap, step,
+            copy.deepcopy(metadata), self.keep))
+        return os.path.join(self.ckpt_dir, f"step_{step:010d}")
+
+    def wait(self) -> None:
+        """Drain the write queue; re-raises the oldest background error."""
+        self._reap(0)
+
+    def close(self) -> None:
+        try:
+            self.wait()
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
+                self._executor = None
 
     def restore(self, target, shardings, step: int | None = None):
+        self.wait()
         return restore_sharded(self.ckpt_dir, target, shardings, step)
 
     def latest_step(self) -> int | None:
+        self.wait()
         return latest_complete_step(self.ckpt_dir)
 
     def read_metadata(self, step: int | None = None) -> dict | None:
+        self.wait()
         meta = read_metadata(self.ckpt_dir, step)
         return meta["metadata"] if meta else None

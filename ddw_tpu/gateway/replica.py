@@ -165,6 +165,16 @@ class CircuitBreaker:
                 self._state = CIRCUIT_HALF_OPEN
                 self._probing = False
 
+    def close(self) -> None:
+        """Close the circuit on EXTERNAL evidence of health — the
+        supervisor's shadow warmup probe succeeded against the replica
+        directly, so no live client request has to play guinea pig in the
+        half-open window."""
+        with self._lock:
+            self._state = CIRCUIT_CLOSED
+            self._consecutive = 0
+            self._probing = False
+
     def retry_after_ms(self) -> float:
         """How long until this circuit's next probe window (0 when not
         OPEN) — the honest Retry-After hint for a fleet-wide refusal."""

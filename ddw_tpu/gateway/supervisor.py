@@ -54,14 +54,19 @@ class ReplicaAttempt:
 
     replica: int
     generation: int
-    kind: str                   # crash | stalled | errors | error
-    action: str                 # restarted | replaced | budget_exhausted
+    kind: str                   # crash | stalled | errors | error | degraded
+    action: str                 # restarted | replaced | drained_restarted
+    #                             | drain_timeout | budget_exhausted
     elapsed_s: float            # detection -> serving again (0 if not)
     forensics: dict
+    readmit: str = ""           # probed_closed | probe_failed | half_open
+    #                             (how the replica re-entered routing)
 
     def __str__(self) -> str:
+        via = f" [{self.readmit}]" if self.readmit else ""
         return (f"replica {self.replica} gen {self.generation}: "
-                f"{self.kind} -> {self.action} ({self.elapsed_s:.2f}s)")
+                f"{self.kind} -> {self.action}{via} "
+                f"({self.elapsed_s:.2f}s)")
 
 
 class ReplicaSupervisor:
@@ -78,7 +83,10 @@ class ReplicaSupervisor:
                  backoff_base_s: float = 0.25, backoff_max_s: float = 30.0,
                  jitter: float = 0.25, stall_timeout_s: float = 30.0,
                  poll_interval_s: float = 0.25,
-                 warmup_prompt_lens=(8,), lifecycle=None):
+                 warmup_prompt_lens=(8,), lifecycle=None,
+                 shadow_probe: bool = True, probe_timeout_s: float = 30.0,
+                 recycle_degraded_after_s: float | None = None,
+                 drain_timeout_s: float = 30.0):
         self.rs = replica_set
         self.max_restarts = max_restarts
         self.backoff_base_s = backoff_base_s
@@ -88,8 +96,23 @@ class ReplicaSupervisor:
         self.poll_interval_s = poll_interval_s
         self.warmup_prompt_lens = tuple(warmup_prompt_lens or ())
         self.lifecycle = lifecycle
+        # Shadow probing (docs/serving.md): a replica rejoining behind an
+        # open circuit is verified with a supervisor-issued warmup request
+        # straight against the engine — success CLOSES the circuit, so no
+        # live client request is ever spent as the half-open guinea pig.
+        # Engines without a probe surface fall back to the half-open gate.
+        self.shadow_probe = shadow_probe
+        self.probe_timeout_s = probe_timeout_s
+        # Graceful recycle: a replica continuously degraded for this long is
+        # drained (in-slot requests run to completion, queue preserved) and
+        # restarted in place, instead of waiting for its error budget to
+        # fail it the hard way. None = only explicit recycle() calls.
+        self.recycle_degraded_after_s = recycle_degraded_after_s
+        self.drain_timeout_s = drain_timeout_s
+        self.probes = 0             # shadow probes issued (telemetry)
         self.attempts: list[ReplicaAttempt] = []
         self._next_attempt_at = [0.0] * len(replica_set.replicas)
+        self._degraded_since = [None] * len(replica_set.replicas)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -123,6 +146,7 @@ class ReplicaSupervisor:
         with self._lock:
             return {"max_restarts": self.max_restarts,
                     "restarts": list(self.rs.restarts),
+                    "shadow_probes": self.probes,
                     "attempts": [dataclasses.asdict(a)
                                  for a in self.attempts]}
 
@@ -153,6 +177,21 @@ class ReplicaSupervisor:
                     if (h["state"] == "failed"
                             and now >= self._next_attempt_at[i]):
                         self._recover(i, eng)
+                        continue
+                    # degraded-too-long: graceful recycle BEFORE the error
+                    # budget fails it the hard way — in-slot work completes
+                    # instead of being failed over
+                    if (self.recycle_degraded_after_s is not None
+                            and h["state"] == "degraded" and h["running"]):
+                        if self._degraded_since[i] is None:
+                            self._degraded_since[i] = now
+                        elif (now - self._degraded_since[i]
+                                >= self.recycle_degraded_after_s
+                                and now >= self._next_attempt_at[i]):
+                            self.recycle(i)
+                            self._degraded_since[i] = None
+                    else:
+                        self._degraded_since[i] = None
                 except Exception:
                     continue    # a monitor bug must never kill the monitor
 
@@ -200,12 +239,101 @@ class ReplicaSupervisor:
         self.rs.note_restart(i)
         self._next_attempt_at[i] = time.monotonic() + self._backoff(
             n_prior + 1)
-        self.rs.breakers[i].half_open()     # warmed: admit ONE probe
+        # Record the attempt BEFORE the (blocking) shadow probe, then fill
+        # in how the replica re-entered routing once the probe resolves —
+        # the restart is a fact the moment the engine is serving again.
+        att = ReplicaAttempt(
+            replica=i, generation=getattr(eng, "generation", gen),
+            kind=kind, action=action, elapsed_s=time.monotonic() - t0,
+            forensics=forensics)
         with self._lock:
-            self.attempts.append(ReplicaAttempt(
-                replica=i, generation=getattr(eng, "generation", gen),
-                kind=kind, action=action,
-                elapsed_s=time.monotonic() - t0, forensics=forensics))
+            self.attempts.append(att)
+        att.readmit = self._readmit(i, eng)     # warmed: probe, then admit
+
+    # -- rejoin gate: shadow probe > live half-open probe ---------------------
+    def _readmit(self, i: int, eng) -> str:
+        """Bring a warmed replica back into routing. With shadow probing a
+        supervisor-issued request (never a client's) verifies the replica
+        end to end: success closes the circuit outright; failure re-trips
+        it and the next backoff window applies. Engines without a probe
+        surface keep the classic half-open single-live-probe gate."""
+        probe = None
+        if self.shadow_probe:
+            if getattr(eng, "pool", None) is not None and \
+                    hasattr(eng, "generate"):
+                probe = lambda: eng.generate(  # noqa: E731
+                    [1, 2, 3, 4], 1, timeout_s=self.probe_timeout_s)
+            elif getattr(eng, "_image", None) is not None and \
+                    hasattr(eng, "submit_predict"):
+                import numpy as _np
+
+                h = eng._image
+                probe = lambda: eng.submit_predict(  # noqa: E731
+                    _np.zeros((h.height, h.width, 3), _np.float32),
+                    timeout_s=self.probe_timeout_s).result(
+                        self.probe_timeout_s)
+        if probe is None:
+            self.rs.breakers[i].half_open()
+            return "half_open"
+        self.probes += 1
+        try:
+            probe()
+        except Exception:
+            self.rs.breakers[i].trip()
+            self.rs.failure_event.set()     # revisit after backoff
+            return "probe_failed"
+        self.rs.breakers[i].close()
+        return "probed_closed"
+
+    # -- graceful recycle (drain-then-restart; never fails in-slot work) -----
+    def recycle(self, i: int) -> bool:
+        """Drain replica ``i``'s in-slot requests to completion, restart it
+        in place (queued work preserved, served by the next generation),
+        re-warm, shadow-probe, and readmit. The operator-facing building
+        block for rolling restarts / weight hot-swap, and the automatic
+        path for degraded-too-long replicas. Falls back to ``force_fail``
+        (today's hard path — futures failed over) when the drain times
+        out. Returns True on a clean recycle."""
+        eng = self.rs.replicas[i]
+        if not hasattr(eng, "recycle"):
+            return False
+        t0 = time.monotonic()
+        gen = getattr(eng, "generation", 0)
+        # stop routing new work at it while it drains (honest refusals at
+        # the engine door would spill anyway; the open circuit is cheaper)
+        self.rs.breakers[i].trip()
+        ok = False
+        try:
+            ok = eng.recycle(drain_timeout_s=self.drain_timeout_s)
+        except Exception:
+            ok = False
+        if not ok:
+            with self._lock:
+                self.attempts.append(ReplicaAttempt(
+                    replica=i, generation=gen, kind="degraded",
+                    action="drain_timeout", elapsed_s=time.monotonic() - t0,
+                    forensics={}))
+            try:
+                eng.force_fail("stalled")   # escalate: the hard path
+            except Exception:
+                pass
+            self.rs.failure_event.set()
+            return False
+        try:
+            if self.warmup_prompt_lens:
+                eng.warmup(self.warmup_prompt_lens)
+        except Exception:
+            pass
+        self.rs.note_restart(i)
+        self._next_attempt_at[i] = time.monotonic() + self._backoff(1)
+        att = ReplicaAttempt(
+            replica=i, generation=getattr(eng, "generation", gen),
+            kind="degraded", action="drained_restarted",
+            elapsed_s=time.monotonic() - t0, forensics={})
+        with self._lock:
+            self.attempts.append(att)
+        att.readmit = self._readmit(i, eng)
+        return True
 
     def _backoff(self, nth_restart: int) -> float:
         delay = min(self.backoff_max_s,

@@ -19,7 +19,19 @@ from ddw_tpu.runtime.collectives import (  # noqa: F401
     all_gather_axis,
     ring_all_reduce,
 )
-from ddw_tpu.runtime.launcher import GangError, Launcher  # noqa: F401
+from ddw_tpu.runtime.launcher import (  # noqa: F401
+    ElasticEvent,
+    GangError,
+    Launcher,
+)
+from ddw_tpu.runtime.elastic import (  # noqa: F401
+    ElasticRestart,
+    GangRendezvous,
+    elastic_barrier,
+    elastic_enabled,
+    host_all_reduce,
+    maybe_elastic_restart,
+)
 from ddw_tpu.runtime.faults import (  # noqa: F401
     FaultInjected,
     Preempted,

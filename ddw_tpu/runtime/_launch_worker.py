@@ -18,6 +18,19 @@ Robustness contract (docs/fault_tolerance.md):
   killed mid-write must leave either no result (detected as
   ``result-missing``) or a complete one — never a torn pickle that masks the
   root cause or unpickles as garbage on the success path.
+- Elastic mode (``DDW_RENDEZVOUS_DIR`` set by an elastic
+  :class:`~ddw_tpu.runtime.launcher.Launcher`): the gang's topology is the
+  explicit :class:`~ddw_tpu.runtime.elastic.GangRendezvous`, NOT
+  ``jax.distributed`` (whose coordination service admits each process id
+  exactly once — a respawned rank could never rejoin it), so the
+  distributed init is skipped and cross-rank sync rides the rendezvous
+  control plane. When a peer dies, this process's train fn raises
+  :class:`~ddw_tpu.runtime.elastic.ElasticRestart` at its next chain
+  boundary (or parked barrier); the fn is then re-run *in this same
+  process* at the bumped generation — restoring from the latest durable
+  checkpoint — which is the whole point: survivors keep their pid, imports
+  and compiled programs. Exceptions that land while a recovery is pending
+  are treated as collateral of the dead peer, not application bugs.
 """
 
 from __future__ import annotations
@@ -64,25 +77,34 @@ def main() -> int:
     payload_path, result_path = sys.argv[1], sys.argv[2]
     install_preemption_handler()
     maybe_fault("coord_bind")
+    from ddw_tpu.runtime.elastic import context as elastic_context
     from ddw_tpu.runtime.mesh import initialize_distributed, is_coordinator
 
-    try:
-        initialize_distributed()  # reads DDW_COORDINATOR / DDW_NUM_PROCESSES / DDW_PROCESS_ID
-    except Exception:
-        tb = traceback.format_exc()
-        if (os.environ.get("DDW_PROCESS_ID", "0") == "0"
-                and _looks_like_bind_failure(tb)):
-            # Coordinator lost the spawn-time port race — a distinguished
-            # exit code tells the launcher "respawn on a fresh port", which
-            # a generic crash must not trigger.
-            sys.stderr.write(tb)
-            return EXIT_COORD_BIND
-        raise
-    # jax.distributed's preemption notifier replaces the SIGTERM disposition
-    # during initialize; re-route it to the graceful-preemption flag — the
-    # launcher's gang-wide broadcast must reach the step loop, not XLA's
-    # notifier.
-    install_preemption_handler()
+    rdzv = elastic_context()
+    if rdzv is not None:
+        # Elastic gang: membership/barrier/reduce live in the explicit
+        # rendezvous object; jax.distributed stays out (its coordination
+        # service cannot re-admit a respawned process id). Each process
+        # keeps its own local CPU/TPU devices for jitted compute.
+        rdzv.announce()
+    else:
+        try:
+            initialize_distributed()  # reads DDW_COORDINATOR / DDW_NUM_PROCESSES / DDW_PROCESS_ID
+        except Exception:
+            tb = traceback.format_exc()
+            if (os.environ.get("DDW_PROCESS_ID", "0") == "0"
+                    and _looks_like_bind_failure(tb)):
+                # Coordinator lost the spawn-time port race — a distinguished
+                # exit code tells the launcher "respawn on a fresh port", which
+                # a generic crash must not trigger.
+                sys.stderr.write(tb)
+                return EXIT_COORD_BIND
+            raise
+        # jax.distributed's preemption notifier replaces the SIGTERM
+        # disposition during initialize; re-route it to the graceful-
+        # preemption flag — the launcher's gang-wide broadcast must reach
+        # the step loop, not XLA's notifier.
+        install_preemption_handler()
     with open(payload_path, "rb") as f:
         fn_spec, args, kwargs = pickle.load(f)
     kind, blob, qualname = fn_spec
@@ -98,27 +120,49 @@ def main() -> int:
         fn = mod
         for part in qualname.split("."):
             fn = getattr(fn, part)
-    try:
-        value = fn(*args, **kwargs)
-        status = ("ok", value)
-    except Preempted as e:
-        # Graceful preemption: the step loop already checkpointed. A clean,
-        # distinguished exit lets the supervisor restart outside the crash
-        # budget.
-        status = ("preempted", {"step": e.step})
-    except Exception:
-        from ddw_tpu.runtime.faults import preemption_requested
+    from ddw_tpu.runtime.elastic import ElasticRestart
 
-        if preemption_requested():
-            # SIGTERM already arrived (the launcher forwards it gang-wide on
-            # the first EXIT_PREEMPTED): this exception is almost certainly
-            # the collateral collective error of a preempting peer, not an
-            # application bug — exit as preempted so the restart stays
-            # outside the crash budget.
-            status = ("preempted", {"step": None})
-        else:
-            status = ("error", traceback.format_exc())
-    if is_coordinator():
+    while True:
+        try:
+            value = fn(*args, **kwargs)
+            status = ("ok", value)
+        except ElasticRestart as e:
+            # A peer died and the launcher re-formed the gang: adopt the new
+            # generation and re-run the fn IN THIS PROCESS — it restores
+            # from the latest durable checkpoint exactly as a whole-world
+            # restart would, but the pid/imports/compiled programs survive.
+            rdzv.advance(e.generation)
+            rdzv.announce()
+            continue
+        except Preempted as e:
+            # Graceful preemption: the step loop already checkpointed. A
+            # clean, distinguished exit lets the supervisor restart outside
+            # the crash budget.
+            status = ("preempted", {"step": e.step})
+        except Exception:
+            from ddw_tpu.runtime.faults import preemption_requested
+
+            if preemption_requested():
+                # SIGTERM already arrived (the launcher forwards it
+                # gang-wide on the first EXIT_PREEMPTED): this exception is
+                # almost certainly the collateral collective error of a
+                # preempting peer, not an application bug — exit as
+                # preempted so the restart stays outside the crash budget.
+                status = ("preempted", {"step": None})
+            elif rdzv is not None and rdzv.recovery_pending() is not None:
+                # Collateral of a dead peer (a sync aborted under it while
+                # recovery was being posted): park via the elastic path
+                # instead of dying — consuming the pending record bounds
+                # this to one re-run per generation.
+                rec = rdzv.recovery_pending()
+                rdzv.advance(int(rec["generation"]))
+                rdzv.announce()
+                continue
+            else:
+                status = ("error", traceback.format_exc())
+        break
+    if (os.environ.get("DDW_PROCESS_ID", "0") == "0"
+            if rdzv is not None else is_coordinator()):
         _write_result(result_path, status)
     if status[0] == "ok":
         return 0

@@ -149,3 +149,27 @@ def ring_all_reduce_pallas(x: jax.Array, axis_name: str, **kwargs) -> jax.Array:
     from ddw_tpu.ops.ring_reduce import ring_all_reduce_pallas as _impl
 
     return _impl(x, axis_name, **kwargs)
+
+
+def host_all_reduce(tag, value, op: str = "sum", timeout_s: float = 120.0):
+    """Host-level cross-RANK reduction over the elastic gang's explicit
+    rendezvous topology (:mod:`ddw_tpu.runtime.elastic`) — the MapReduce
+    ``reduce`` primitive of DrJAX's framing (PAPERS.md), living OUTSIDE the
+    XLA program on purpose.
+
+    Everything above in this module is traced into the jitted step and rides
+    the implicit ``jax.distributed`` world: fast, but a dead rank wedges
+    every peer inside the collective and the world can only be rebuilt by
+    restarting it whole. This primitive is the opposite trade: a
+    deterministic, rank-ordered fold over the shared-filesystem control
+    plane that PARKS instead of wedging — a dead peer aborts it with
+    :class:`~ddw_tpu.runtime.elastic.ElasticRestart`, the survivor re-joins
+    the re-formed gang, and a respawned rank participates with no device
+    runtime surgery. Use it for the elastic gang's cross-rank sync
+    (per-chain metrics, small host gradients, agreement values); keep the
+    per-layer hot path on the in-step collectives above. Outside elastic
+    mode it degenerates to the identity, so the same fn body runs under the
+    launcher's ``np=-1`` smoke mode unchanged."""
+    from ddw_tpu.runtime.elastic import host_all_reduce as _impl
+
+    return _impl(tag, value, op=op, timeout_s=timeout_s)

@@ -1,0 +1,374 @@
+"""Elastic gang recovery — re-rendezvous as a first-class topology object.
+
+PR-1's :class:`~ddw_tpu.runtime.supervisor.GangSupervisor` restarts the
+*whole world* on any failure: at N hosts one preempted rank throws away N-1
+healthy processes' warm state (imports, compiled programs, loader position).
+Horovod's elastic mode (arXiv:1802.05799 lineage) showed that single-rank
+recovery is the difference between "fault tolerant" and "fault tolerant at
+scale". The obstacle in JAX is that the gang's membership is an *implicit
+side effect* of ``jax.distributed.initialize``: the coordination service
+admits each process id exactly once, so a respawned rank can never rejoin
+the world it fell out of — the only recovery the implicit topology supports
+IS the whole-world restart.
+
+This module follows DrJAX's MapReduce-primitive framing (arXiv:2403.07128)
+and makes the rendezvous/reduce topology an **explicit object** instead:
+
+- :class:`GangRendezvous` owns membership (who is in the gang, at which
+  *elastic generation*), the re-rendezvous **barrier** ranks park on at
+  chain boundaries, and a deterministic host-level **all-reduce** — the
+  MapReduce ``reduce`` primitive — over the same shared-filesystem control
+  plane (one host in tests, NFS/GCS-style shared storage on a pod). Device
+  compute stays jitted per process; only the *topology* lives here, which
+  is exactly what makes it reshardable: a generation bump re-forms the gang
+  without touching any process's XLA runtime.
+- When the :class:`~ddw_tpu.runtime.launcher.Launcher` (elastic mode)
+  observes a single dead rank it respawns **only that rank** and posts a
+  recovery record. Surviving ranks discover it at their next chain
+  boundary (:func:`maybe_elastic_restart`) or while parked in a
+  barrier/reduce, raise :class:`ElasticRestart`, and the worker entrypoint
+  re-runs the train fn *in the same process* — PID, imports, compiled
+  programs and loader machinery all survive; only the model state is
+  re-read from the latest durable checkpoint, which is the same resume
+  contract the whole-world path already guarantees.
+- Whole-world restart remains the **fallback**: if re-rendezvous itself
+  fails (the respawned rank dies again, a survivor cannot park, the budget
+  is exhausted) the launcher kills the gang and raises the classic
+  ``GangError`` — the supervisor's existing restart-from-checkpoint loop
+  engages unchanged.
+
+Layout of the control directory (``DDW_RENDEZVOUS_DIR``)::
+
+    member_g<gen>_r<rank>.json   # membership: pid + start time, per generation
+    recover_g<gen>.json          # driver-posted recovery record -> generation g
+    arrive_g<gen>_<tag>_r<rank>  # barrier arrival markers
+    reduce_g<gen>_<tag>_r<rank>.json  # host all-reduce contributions
+
+Every file is written atomically (tmp + ``os.replace``) so readers never
+observe a torn record. Each rank deletes its *own* stale markers one
+barrier behind the current one — a rank can be at most one barrier ahead of
+any peer, so the window it keeps is exactly what a slow peer may still
+read.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+__all__ = ["GangRendezvous", "ElasticRestart", "elastic_enabled", "context",
+           "reset_context", "maybe_elastic_restart", "elastic_barrier",
+           "host_all_reduce"]
+
+
+class ElasticRestart(Exception):
+    """A recovery record newer than this rank's generation exists: park,
+    then re-run the train fn at ``generation`` (restoring from the latest
+    durable checkpoint). Raised by the chain-boundary hook, by a parked
+    barrier, or by a host all-reduce that was aborted by a recovery; the
+    worker entrypoint (:mod:`ddw_tpu.runtime._launch_worker`) catches it
+    and re-enters the fn in the same process."""
+
+    def __init__(self, generation: int, record: dict | None = None,
+                 step: int | None = None):
+        self.generation = generation
+        self.record = dict(record or {})
+        self.step = step
+        super().__init__(
+            f"elastic re-rendezvous requested: generation {generation} "
+            f"(dead rank {self.record.get('dead_rank')}, parked at step "
+            f"{step})")
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class GangRendezvous:
+    """The explicit gang topology: membership, barrier, host reduce.
+
+    One instance per rank (and one driver-side instance in the launcher).
+    ``generation`` is the *elastic* generation — 0 at gang launch, bumped by
+    every single-rank recovery; it is independent of the supervisor's
+    whole-world ``DDW_RESTART_GEN`` (a whole-world restart gets a fresh
+    control directory and starts back at elastic generation 0).
+    """
+
+    def __init__(self, root: str, world_size: int, rank: int,
+                 generation: int = 0, poll_s: float = 0.02):
+        self.root = root
+        self.world_size = int(world_size)
+        self.rank = int(rank)
+        self.generation = int(generation)
+        self.poll_s = poll_s
+        os.makedirs(root, exist_ok=True)
+
+    # -- membership ----------------------------------------------------------
+    def announce(self) -> None:
+        """Record this rank's membership for the current generation (pid +
+        start time) — the forensic evidence that elastic recovery kept the
+        survivors' processes alive (their pid is identical across
+        generations) while the dead rank's changed."""
+        _atomic_write_json(
+            os.path.join(self.root,
+                         f"member_g{self.generation}_r{self.rank}.json"),
+            {"pid": os.getpid(), "rank": self.rank,
+             "generation": self.generation, "started_unix": time.time()})
+
+    def member(self, generation: int, rank: int) -> dict | None:
+        path = os.path.join(self.root, f"member_g{generation}_r{rank}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # -- recovery ledger -----------------------------------------------------
+    def post_recovery(self, generation: int, dead_rank: int,
+                      exit_code: int | None = None,
+                      reason: str = "rank-death") -> dict:
+        """Driver side: publish 'the gang re-forms at ``generation``'.
+        Idempotent per generation (one recovery record per bump)."""
+        record = {"generation": int(generation), "dead_rank": int(dead_rank),
+                  "exit_code": exit_code, "reason": reason,
+                  "world_size": self.world_size, "posted_unix": time.time()}
+        _atomic_write_json(
+            os.path.join(self.root, f"recover_g{generation}.json"), record)
+        return record
+
+    def recovery_pending(self) -> dict | None:
+        """The newest recovery record addressing a generation beyond this
+        rank's, or None. One directory scan — cheap at chain granularity."""
+        newest = None
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return None
+        for name in names:
+            if not (name.startswith("recover_g")
+                    and name.endswith(".json")):
+                continue
+            try:
+                gen = int(name[len("recover_g"):-len(".json")])
+            except ValueError:
+                continue
+            if gen > self.generation and (newest is None
+                                          or gen > newest):
+                newest = gen
+        if newest is None:
+            return None
+        try:
+            with open(os.path.join(self.root,
+                                   f"recover_g{newest}.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None     # racing the atomic publish: next check sees it
+
+    def current_generation(self) -> int:
+        """Newest generation named by any recovery record (>= own)."""
+        gen = self.generation
+        rec = self.recovery_pending()
+        if rec is not None:
+            gen = max(gen, int(rec["generation"]))
+        return gen
+
+    def advance(self, generation: int) -> None:
+        """Adopt a new generation (after catching :class:`ElasticRestart`).
+        Also mirrors it into ``DDW_ELASTIC_GEN`` so env-keyed machinery
+        (fault-injection ``egen`` matching) sees the survivor's true
+        generation, not its spawn-time one."""
+        self.generation = int(generation)
+        os.environ["DDW_ELASTIC_GEN"] = str(generation)
+
+    def _check_recovery(self, step: int | None = None) -> None:
+        rec = self.recovery_pending()
+        if rec is not None:
+            raise ElasticRestart(int(rec["generation"]), rec, step=step)
+
+    # -- barrier -------------------------------------------------------------
+    def barrier(self, tag, timeout_s: float = 120.0) -> None:
+        """Park until every rank of this generation arrives at ``tag`` (a
+        step number or a label like ``"start"``). A recovery record
+        addressing a newer generation aborts the park with
+        :class:`ElasticRestart` — this is exactly where survivors sit while
+        the dead rank is respawned. Raises TimeoutError when the gang never
+        forms (the caller should exit and let the launcher fall back to
+        whole-world restart)."""
+        me = os.path.join(
+            self.root, f"arrive_g{self.generation}_{tag}_r{self.rank}")
+        _atomic_write_json(me, {"pid": os.getpid()})
+        deadline = time.monotonic() + timeout_s
+        step = tag if isinstance(tag, int) else None
+        while True:
+            present = sum(
+                1 for r in range(self.world_size)
+                if os.path.exists(os.path.join(
+                    self.root, f"arrive_g{self.generation}_{tag}_r{r}")))
+            if present == self.world_size:
+                break
+            self._check_recovery(step)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"elastic barrier {tag!r} (gen {self.generation}): only "
+                    f"{present}/{self.world_size} ranks arrived within "
+                    f"{timeout_s}s")
+            time.sleep(self.poll_s)
+        self._gc_markers(tag)
+
+    def _gc_markers(self, tag) -> None:
+        """Drop this rank's OWN markers from earlier integer steps (keep the
+        immediately preceding one: a peer can be at most one barrier behind,
+        so older markers are unreadable by anyone)."""
+        if not isinstance(tag, int):
+            return
+        prefix = f"_g{self.generation}_"
+        for kind in ("arrive", "reduce"):
+            try:
+                names = os.listdir(self.root)
+            except OSError:
+                return
+            for name in names:
+                if not name.startswith(kind + prefix):
+                    continue
+                rest = name[len(kind + prefix):]
+                stem = rest.split("_r")[0]
+                if not rest.endswith(f"_r{self.rank}"
+                                     + (".json" if kind == "reduce" else "")):
+                    continue
+                try:
+                    s = int(stem)
+                except ValueError:
+                    continue
+                if s < tag - 1:
+                    try:
+                        os.remove(os.path.join(self.root, name))
+                    except OSError:
+                        pass
+
+    # -- host-level all-reduce (the MapReduce `reduce` primitive) ------------
+    def all_reduce(self, tag, value, op: str = "sum",
+                   timeout_s: float = 120.0) -> np.ndarray:
+        """Deterministic cross-rank reduction over the control plane: each
+        rank publishes its contribution, waits for all peers of the same
+        generation, and folds them in rank order (bit-identical on every
+        rank). This is the gang's *data* barrier in elastic mode — metrics,
+        small gradients, agreement values — and it parks/aborts exactly
+        like :meth:`barrier`, so a dead peer never wedges the gang the way
+        an in-flight XLA collective would."""
+        arr = np.asarray(value, np.float64)
+        me = os.path.join(
+            self.root,
+            f"reduce_g{self.generation}_{tag}_r{self.rank}.json")
+        _atomic_write_json(me, {"shape": list(arr.shape),
+                                "data": arr.reshape(-1).tolist()})
+        deadline = time.monotonic() + timeout_s
+        step = tag if isinstance(tag, int) else None
+        parts: dict[int, np.ndarray] = {}
+        while len(parts) < self.world_size:
+            for r in range(self.world_size):
+                if r in parts:
+                    continue
+                path = os.path.join(
+                    self.root, f"reduce_g{self.generation}_{tag}_r{r}.json")
+                try:
+                    with open(path) as f:
+                        rec = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                parts[r] = np.asarray(rec["data"], np.float64).reshape(
+                    rec["shape"])
+            if len(parts) < self.world_size:
+                self._check_recovery(step)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"elastic all_reduce {tag!r} (gen {self.generation})"
+                        f": only {len(parts)}/{self.world_size} "
+                        f"contributions within {timeout_s}s")
+                time.sleep(self.poll_s)
+        out = parts[0].copy()
+        for r in range(1, self.world_size):
+            out = out + parts[r]    # fixed rank order: deterministic
+        if op == "mean":
+            out = out / self.world_size
+        elif op != "sum":
+            raise ValueError(f"unknown reduce op {op!r} (have sum, mean)")
+        self._gc_markers(tag)
+        return out.astype(np.asarray(value).dtype
+                          if np.asarray(value).dtype.kind == "f"
+                          else np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Process-level context: the worker's own rendezvous, built from the env.
+# ---------------------------------------------------------------------------
+
+_ctx: GangRendezvous | None = None
+
+
+def elastic_enabled() -> bool:
+    """True inside an elastic gang (the launcher exported the control dir)."""
+    return bool(os.environ.get("DDW_RENDEZVOUS_DIR"))
+
+
+def context() -> GangRendezvous | None:
+    """This process's rendezvous (lazily built from ``DDW_RENDEZVOUS_DIR`` /
+    ``DDW_NUM_PROCESSES`` / ``DDW_PROCESS_ID`` / ``DDW_ELASTIC_GEN``), or
+    None outside elastic mode. A respawned rank starts at the generation the
+    driver stamped into its env; survivors advance theirs in-process."""
+    global _ctx
+    root = os.environ.get("DDW_RENDEZVOUS_DIR")
+    if not root:
+        return None
+    if _ctx is None or _ctx.root != root:
+        _ctx = GangRendezvous(
+            root,
+            world_size=int(os.environ.get("DDW_NUM_PROCESSES", "1")),
+            rank=int(os.environ.get("DDW_PROCESS_ID", "0")),
+            generation=int(os.environ.get("DDW_ELASTIC_GEN", "0") or 0))
+    return _ctx
+
+
+def reset_context() -> None:
+    global _ctx
+    _ctx = None
+
+
+def maybe_elastic_restart(step: int | None = None) -> None:
+    """The trainers' chain-boundary hook (free no-op outside elastic mode):
+    if a recovery record addresses a newer generation, raise
+    :class:`ElasticRestart` so the surviving rank parks HERE — at a chain
+    boundary, before it enters another cross-rank operation with a dead
+    peer — and re-runs its train fn from the latest durable checkpoint."""
+    if "DDW_RENDEZVOUS_DIR" not in os.environ:     # fast path
+        return
+    ctx = context()
+    if ctx is not None:
+        ctx._check_recovery(step)
+
+
+def elastic_barrier(tag, timeout_s: float = 120.0) -> None:
+    """Module-level convenience over :meth:`GangRendezvous.barrier`; no-op
+    outside elastic mode. Train fns call ``elastic_barrier("start")`` after
+    restoring so the whole (re-formed) gang resumes in lockstep."""
+    ctx = context()
+    if ctx is not None:
+        ctx.barrier(tag, timeout_s=timeout_s)
+
+
+def host_all_reduce(tag, value, op: str = "sum", timeout_s: float = 120.0):
+    """Module-level convenience over :meth:`GangRendezvous.all_reduce`.
+    Outside elastic mode this degenerates to the identity (world of one) —
+    the same fn body runs under ``np=-1`` smoke mode unchanged."""
+    ctx = context()
+    if ctx is None:
+        arr = np.asarray(value, np.float64)
+        return arr if op in ("sum", "mean") else None
+    return ctx.all_reduce(tag, value, op=op, timeout_s=timeout_s)

@@ -14,6 +14,9 @@ Kinds (and the hook site each fires at):
 kind        site         effect when the spec matches
 ========== ============ ==========================================================
 crash       step         ``os._exit(EXIT_FAULT_CRASH)`` — a hard SIGKILL-like death
+kill        step         ``SIGKILL`` to this process — a true signal death (exit
+                         code ``-9``): the "one rank dies mid-epoch" arm the
+                         elastic drills key on, with signal forensics intact
 raise       step         raise :class:`FaultInjected` — the worker writes an error
                          result and exits nonzero (exercises the rank-0-traceback
                          surfacing path)
@@ -25,6 +28,12 @@ preempt     step         deliver SIGTERM to this process (the cluster-manager
                          the trainers' step loops check
 ckpt_torn   step         drop a torn (partial, non-atomic) step dir into the
                          checkpoint directory, then crash — exercises quarantine
+ckpt_async_torn
+            ckpt_async   fires INSIDE the background checkpoint writer thread:
+                         publishes a torn step dir for the step being written
+                         (as a filesystem that lost the atomic discipline
+                         would), then dies mid-write — exercises async-write
+                         quarantine across restart generations
 bind_fail   coord_bind   ``os._exit(EXIT_COORD_BIND)`` before the coordinator
                          binds — the port-collision (TOCTOU) analog
 ========== ============ ==========================================================
@@ -33,9 +42,12 @@ Match keys (all optional): ``rank=N`` (default: any rank; read from
 ``DDW_PROCESS_ID``), ``step=N`` (default: first check of the site),
 ``gen=N|*`` (restart generation, from ``DDW_RESTART_GEN``; default 0 so a
 fault fires in the first generation only and the restarted gang runs clean),
-``attempt=N|*`` (spawn attempt within one generation, from
-``DDW_SPAWN_ATTEMPT``; default 0 so a bind failure clears on the launcher's
-respawn). ``*`` means "any".
+``egen=N|*`` (ELASTIC generation, from ``DDW_ELASTIC_GEN``; default 0 so the
+single rank an elastic recovery respawned runs clean — ``egen=*`` makes the
+fault chase every respawn, the deterministic "re-rendezvous keeps failing"
+drill that forces the whole-world fallback), ``attempt=N|*`` (spawn attempt
+within one generation, from ``DDW_SPAWN_ATTEMPT``; default 0 so a bind
+failure clears on the launcher's respawn). ``*`` means "any".
 
 Example: ``DDW_FAULT=crash:rank=1:step=3`` kills rank 1 at global step 3 of
 the first generation; every other process/step/generation is untouched. With
@@ -86,10 +98,12 @@ EXIT_FAULT_CRASH = 77   # injected hard crash (deterministic stand-in for SIGKIL
 EXIT_PREEMPTED = 83     # graceful preemption: checkpointed, then clean exit
 EXIT_COORD_BIND = 84    # coordinator could not bind its port (spawn-time race)
 
-KINDS = ("crash", "raise", "stall", "exit0_early", "preempt", "ckpt_torn",
-         "bind_fail")
+KINDS = ("crash", "kill", "raise", "stall", "exit0_early", "preempt",
+         "ckpt_torn", "ckpt_async_torn", "bind_fail")
 
-_SITE_BY_KIND = {k: ("coord_bind" if k == "bind_fail" else "step")
+_SITE_BY_KIND = {k: ("coord_bind" if k == "bind_fail"
+                     else "ckpt_async" if k == "ckpt_async_torn"
+                     else "step")
                  for k in KINDS}
 
 
@@ -111,6 +125,7 @@ class FaultSpec:
     rank: int | None = None
     step: int | None = None
     gen: int | None = 0
+    egen: int | None = 0
     attempt: int | None = 0
 
     @property
@@ -119,13 +134,16 @@ class FaultSpec:
 
     def matches(self, site: str, step: int | None = None,
                 rank: int | None = None, gen: int | None = None,
-                attempt: int | None = None) -> bool:
+                attempt: int | None = None,
+                egen: int | None = None) -> bool:
         """Pure matching logic (env-independent — unit-testable)."""
         if site != self.site:
             return False
         if self.rank is not None and rank != self.rank:
             return False
         if self.gen is not None and gen != self.gen:
+            return False
+        if self.egen is not None and (egen or 0) != self.egen:
             return False
         if self.attempt is not None and attempt != self.attempt:
             return False
@@ -157,13 +175,14 @@ def parse_fault(spec: str) -> FaultSpec | None:
             continue
         key, _, val = part.partition("=")
         key = key.strip()
-        if key not in ("rank", "step", "gen", "attempt"):
+        if key not in ("rank", "step", "gen", "egen", "attempt"):
             raise ValueError(f"unknown DDW_FAULT key {key!r} in {spec!r}")
         val = val.strip()
         fields[key] = None if val == "*" else int(val)
     return FaultSpec(kind=kind, rank=fields.get("rank"),
                      step=fields.get("step"),
                      gen=fields.get("gen", 0),
+                     egen=fields.get("egen", 0),
                      attempt=fields.get("attempt", 0))
 
 
@@ -191,6 +210,7 @@ def maybe_fault(site: str, step: int | None = None,
             site, step=step,
             rank=_env_int("DDW_PROCESS_ID", 0),
             gen=_env_int("DDW_RESTART_GEN", 0),
+            egen=_env_int("DDW_ELASTIC_GEN", 0),
             attempt=_env_int("DDW_SPAWN_ATTEMPT", 0)):
         return
     _fire(spec, step, ckpt_dir)
@@ -201,6 +221,12 @@ def _fire(spec: FaultSpec, step: int | None, ckpt_dir: str | None) -> None:
             f"gen {_env_int('DDW_RESTART_GEN', 0)}"
     if spec.kind == "crash":
         os._exit(EXIT_FAULT_CRASH)
+    if spec.kind == "kill":
+        # A true signal death (waitpid code -SIGKILL): the launcher's
+        # forensics record the signal, and no atexit/finally runs — the
+        # closest CPU-reproducible stand-in for a preempted/OOM-killed host.
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(60.0)    # pending-signal window; never survives it
     if spec.kind == "raise":
         raise FaultInjected(f"injected fault ({where})")
     if spec.kind == "stall":
@@ -219,6 +245,15 @@ def _fire(spec: FaultSpec, step: int | None, ckpt_dir: str | None) -> None:
     if spec.kind == "ckpt_torn":
         if ckpt_dir:
             _write_torn_step_dir(ckpt_dir, (step or 0) + 1000)
+        os._exit(EXIT_FAULT_CRASH)
+    if spec.kind == "ckpt_async_torn":
+        # Fires on the BACKGROUND WRITER THREAD (the ckpt_async site lives
+        # inside the async checkpoint writers): publish a torn dir for the
+        # very step being written — what a non-atomic filesystem could leave
+        # after losing the rename/fsync discipline — then die mid-write.
+        # latest_step()/latest_complete_step() must quarantine it on restart.
+        if ckpt_dir:
+            _write_torn_step_dir(ckpt_dir, step or 0)
         os._exit(EXIT_FAULT_CRASH)
     if spec.kind == "bind_fail":
         os._exit(EXIT_COORD_BIND)

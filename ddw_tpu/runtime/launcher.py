@@ -27,6 +27,7 @@ HPO params as function args, ``02_hyperopt_distributed_model.py:161``).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import pickle
 import signal
@@ -45,6 +46,22 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class ElasticEvent:
+    """One single-rank elastic recovery, as the launcher drove it: which
+    rank died (and how), which elastic generation the gang re-formed at,
+    and the pid of the respawned process. Harvested by the
+    :class:`~ddw_tpu.runtime.supervisor.GangSupervisor` into its
+    ``AttemptReport`` forensics."""
+
+    generation: int             # elastic generation the gang re-formed at
+    dead_rank: int
+    exit_code: int | None       # the dead rank's raw waitpid code
+    exit_signal: int | None     # the signal that killed it (exit_code < 0)
+    respawn_pid: int
+    at_unix: float
 
 
 class GangError(RuntimeError):
@@ -103,7 +120,9 @@ class Launcher:
     def __init__(self, np: int = -1, devices_per_proc: int = 1,
                  timeout_s: float = 600.0, spawn_retries: int = 3,
                  preempt_grace_s: float = 10.0,
-                 forward_sigterm: bool = False):
+                 forward_sigterm: bool = False,
+                 elastic_restarts: int = 0,
+                 rendezvous_dir: str | None = None):
         self.np = np
         self.devices_per_proc = devices_per_proc
         self.timeout_s = timeout_s
@@ -114,6 +133,17 @@ class Launcher:
         self.last_spawn_attempts = 0  # spawns used by the last _run_multiproc
         self.preempt_grace_s = preempt_grace_s
         self.forward_sigterm = forward_sigterm
+        # Elastic mode (docs/fault_tolerance.md "Elastic recovery"): up to
+        # elastic_restarts single-rank respawns per gang launch. The gang's
+        # cross-rank topology becomes the EXPLICIT GangRendezvous object
+        # (runtime/elastic.py) instead of the implicit jax.distributed world
+        # — the coordination service admits each process id exactly once, so
+        # a respawned rank could never rejoin it; workers therefore skip
+        # jax.distributed and sync over the rendezvous control plane.
+        self.elastic_restarts = max(0, elastic_restarts)
+        self.rendezvous_dir = rendezvous_dir
+        self.elastic_events: list[ElasticEvent] = []  # last _run_multiproc
+        self.last_rendezvous_dir: str | None = None
         self._procs: list = []        # live gang (broadcast target)
         self._procs_lock = threading.Lock()
 
@@ -153,6 +183,7 @@ class Launcher:
             fn_spec = ("by_file", os.path.abspath(src), fn.__qualname__)
         else:
             fn_spec = ("pickled", pickle.dumps(fn), None)
+        self.elastic_events = []
         with tempfile.TemporaryDirectory(prefix="ddw_launch_") as tmp:
             payload = os.path.join(tmp, "payload.pkl")
             result = os.path.join(tmp, "result.pkl")
@@ -173,33 +204,50 @@ class Launcher:
                         continue
                     raise
 
+    def _spawn_rank(self, rank: int, payload: str, result: str, port: int,
+                    attempt: int, extra_env: dict | None,
+                    rdzv_dir: str | None, elastic_gen: int = 0):
+        env = dict(os.environ)
+        # Force an isolated CPU backend in workers: disable the axon/TPU
+        # plugin hook and give each process its own virtual device set.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("DDW_WORKER_XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={self.devices_per_proc}"
+        ).strip()
+        env["DDW_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["DDW_NUM_PROCESSES"] = str(self.np)
+        env["DDW_PROCESS_ID"] = str(rank)
+        env["DDW_SPAWN_ATTEMPT"] = str(attempt)
+        if rdzv_dir is not None:
+            env["DDW_RENDEZVOUS_DIR"] = rdzv_dir
+            env["DDW_ELASTIC_GEN"] = str(elastic_gen)
+        if extra_env:
+            env.update({k: str(v) for k, v in extra_env.items()})
+        return subprocess.Popen(
+            [sys.executable, "-m", "ddw_tpu.runtime._launch_worker", payload, result],
+            env=env,
+            stdout=None if rank == 0 else subprocess.DEVNULL,
+            stderr=None,
+        )
+
     def _run_gang(self, payload: str, result: str, attempt: int,
                   extra_env: dict | None) -> Any:
         port = _free_port()
-        procs = []
-        for rank in range(self.np):
-            env = dict(os.environ)
-            # Force an isolated CPU backend in workers: disable the axon/TPU
-            # plugin hook and give each process its own virtual device set.
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (
-                env.get("DDW_WORKER_XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={self.devices_per_proc}"
-            ).strip()
-            env["DDW_COORDINATOR"] = f"127.0.0.1:{port}"
-            env["DDW_NUM_PROCESSES"] = str(self.np)
-            env["DDW_PROCESS_ID"] = str(rank)
-            env["DDW_SPAWN_ATTEMPT"] = str(attempt)
-            if extra_env:
-                env.update({k: str(v) for k, v in extra_env.items()})
-            p = subprocess.Popen(
-                [sys.executable, "-m", "ddw_tpu.runtime._launch_worker", payload, result],
-                env=env,
-                stdout=None if rank == 0 else subprocess.DEVNULL,
-                stderr=None,
-            )
-            procs.append(p)
+        rdzv_dir = None
+        if self.elastic_restarts > 0:
+            # A fresh control directory per gang launch: a whole-world
+            # restart must not inherit the previous world's recovery ledger.
+            if self.rendezvous_dir:
+                os.makedirs(self.rendezvous_dir, exist_ok=True)
+            rdzv_dir = tempfile.mkdtemp(
+                prefix="rdzv_",
+                dir=self.rendezvous_dir or os.path.dirname(payload))
+            self.last_rendezvous_dir = rdzv_dir
+        procs = [self._spawn_rank(rank, payload, result, port, attempt,
+                                  extra_env, rdzv_dir)
+                 for rank in range(self.np)]
         with self._procs_lock:
             self._procs = procs
         prev_handler = None
@@ -225,12 +273,54 @@ class Launcher:
             # killed when the grace runs out).
             deadline = time.monotonic() + self.timeout_s
             grace_end: float | None = None
+            elastic_used = 0
+            elastic_gen = 0
             codes: list[int | None] = [None] * self.np
             while any(c is None for c in codes):
                 for i, p in enumerate(procs):
                     if codes[i] is None:
                         codes[i] = p.poll()
                 if any(c not in (None, 0, EXIT_PREEMPTED) for c in codes):
+                    # Elastic recovery (single dead rank, budget left, every
+                    # peer still running, not a coordinator port race):
+                    # respawn ONLY the dead rank at a bumped generation and
+                    # post the recovery record the survivors park on. Any
+                    # other shape — a second death, an exhausted budget —
+                    # falls through to the gang kill below, and the
+                    # supervisor's whole-world restart takes over.
+                    dead = [i for i, c in enumerate(codes)
+                            if c not in (None, 0, EXIT_PREEMPTED)]
+                    if (rdzv_dir is not None
+                            and elastic_used < self.elastic_restarts
+                            and len(dead) == 1
+                            and codes[dead[0]] != EXIT_COORD_BIND
+                            and all(codes[i] is None for i in range(self.np)
+                                    if i != dead[0])):
+                        r = dead[0]
+                        code = codes[r]
+                        elastic_used += 1
+                        elastic_gen += 1
+                        from ddw_tpu.runtime.elastic import GangRendezvous
+
+                        GangRendezvous(rdzv_dir, self.np, -1).post_recovery(
+                            elastic_gen, dead_rank=r, exit_code=code)
+                        p = self._spawn_rank(r, payload, result, port,
+                                             attempt, extra_env, rdzv_dir,
+                                             elastic_gen=elastic_gen)
+                        procs[r] = p
+                        codes[r] = None
+                        with self._procs_lock:
+                            self._procs = procs
+                        self.elastic_events.append(ElasticEvent(
+                            generation=elastic_gen, dead_rank=r,
+                            exit_code=code,
+                            exit_signal=-code if (code or 0) < 0 else None,
+                            respawn_pid=p.pid, at_unix=time.time()))
+                        # the re-formed gang earns a fresh deadline — the
+                        # recovery consumed wall-clock the healthy steps
+                        # were budgeted for
+                        deadline = time.monotonic() + self.timeout_s
+                        continue
                     for p in procs:
                         if p.poll() is None:
                             p.kill()

@@ -55,16 +55,29 @@ def restart_generation() -> int:
 
 @dataclasses.dataclass
 class AttemptReport:
-    """One failed generation, as the supervisor saw it."""
+    """One recovery-worthy event, as the supervisor saw it: a failed
+    generation (``recovery="whole-world"``) or a single-rank death the
+    launcher healed in place (``recovery="elastic"``). ``dead_rank`` /
+    ``exit_signal`` carry the which-rank-died-and-how forensics (signal
+    deaths — SIGKILL'd / OOM'd hosts — have a negative waitpid code; the
+    positive signal number lands here)."""
 
     generation: int
-    kind: str                       # crash | deadline | preempted | coord-bind | result-missing
+    kind: str                       # crash | deadline | preempted | coord-bind
+    #                                 | result-missing | rank-death
     exit_codes: list
     rank0_traceback: str | None
     elapsed_s: float
+    dead_rank: int | None = None    # first abnormally-exited rank
+    exit_signal: int | None = None  # signal that killed it, if any
+    recovery: str = "whole-world"   # elastic | whole-world
 
     def __str__(self) -> str:
-        return (f"gen {self.generation}: {self.kind}, exit codes "
+        where = (f" (rank {self.dead_rank}"
+                 + (f", signal {self.exit_signal}" if self.exit_signal
+                    else "")
+                 + f", {self.recovery})") if self.dead_rank is not None else ""
+        return (f"gen {self.generation}: {self.kind}{where}, exit codes "
                 f"{self.exit_codes}, after {self.elapsed_s:.1f}s")
 
 
@@ -146,15 +159,20 @@ class GangSupervisor:
                     value = self.launcher._run_multiproc(
                         fn, args, kwargs,
                         extra_env={"DDW_RESTART_GEN": str(gen)})
+                    self._harvest_elastic(gen)
                     self._report("completed", crash_restarts,
                                  preempt_restarts)
                     return value
                 except GangError as e:
+                    self._harvest_elastic(gen)
                     kind = "preempted" if e.is_preemption else e.kind
+                    dead, sig = self._dead_rank(e.exit_codes, kind)
                     self.attempts.append(AttemptReport(
                         generation=gen, kind=kind, exit_codes=e.exit_codes,
                         rank0_traceback=e.rank0_traceback,
-                        elapsed_s=time.monotonic() - t0))
+                        elapsed_s=time.monotonic() - t0,
+                        dead_rank=dead, exit_signal=sig,
+                        recovery="whole-world"))
                     if kind == "preempted":
                         preempt_restarts += 1
                         if preempt_restarts > self.max_preemption_restarts:
@@ -171,6 +189,40 @@ class GangSupervisor:
             self._report("failed", crash_restarts, preempt_restarts)
             raise
 
+    @staticmethod
+    def _dead_rank(exit_codes: list, kind: str) -> tuple[int | None,
+                                                         int | None]:
+        """Forensics for a whole-gang failure: which rank's death is the
+        ROOT CAUSE, and the signal that killed it when the waitpid code
+        says signal death. Peers dying as collective-error collateral exit
+        1 (the worker's generic-error path), so among the abnormal exits a
+        distinguished death — a signal, or any non-1 code — outranks an
+        exit-1 neighbor."""
+        if kind == "preempted":
+            for rank, code in enumerate(exit_codes):
+                if code == EXIT_PREEMPTED:
+                    return rank, None
+            return None, None
+        abnormal = [(r, c) for r, c in enumerate(exit_codes)
+                    if c is not None and c not in (0, EXIT_PREEMPTED)]
+        if not abnormal:
+            return None, None
+        rank, code = next(((r, c) for r, c in abnormal if c != 1),
+                          abnormal[0])
+        return rank, (-code if code < 0 else None)
+
+    def _harvest_elastic(self, gen: int) -> None:
+        """Fold the launcher's single-rank recoveries (ElasticEvent) into
+        the attempt record: same forensic surface as a whole-world restart,
+        tagged ``recovery="elastic"`` — so 'which rank died, how, and what
+        recovery it cost' is one queryable list either way."""
+        for ev in getattr(self.launcher, "elastic_events", []):
+            self.attempts.append(AttemptReport(
+                generation=gen, kind="rank-death",
+                exit_codes=[ev.exit_code], rank0_traceback=None,
+                elapsed_s=0.0, dead_rank=ev.dead_rank,
+                exit_signal=ev.exit_signal, recovery="elastic"))
+
     def _report(self, outcome: str, crash_restarts: int,
                 preempt_restarts: int) -> None:
         """Surface the attempt record into the tracker run (no-op without
@@ -179,19 +231,28 @@ class GangSupervisor:
         if run is None:
             return
         try:
+            elastic = [a for a in self.attempts if a.recovery == "elastic"]
+            failed = [a for a in self.attempts if a.recovery != "elastic"]
             run.log_metrics({
                 "supervisor.generations": float(self.generations),
-                "supervisor.failed_attempts": float(len(self.attempts)),
+                "supervisor.failed_attempts": float(len(failed)),
                 "supervisor.crash_restarts": float(crash_restarts),
                 "supervisor.preemption_restarts": float(preempt_restarts),
+                "supervisor.elastic_recoveries": float(len(elastic)),
             })
-            for a in self.attempts:
+            for a in failed:
                 run.log_metric("supervisor.attempt_elapsed_s", a.elapsed_s,
                                step=a.generation)
                 run.log_metric(
                     "supervisor.attempt_preempted",
                     1.0 if a.kind == "preempted" else 0.0,
                     step=a.generation)
+                if a.dead_rank is not None:
+                    run.log_metric("supervisor.attempt_dead_rank",
+                                   float(a.dead_rank), step=a.generation)
+            for k, a in enumerate(elastic):
+                run.log_metric("supervisor.elastic_dead_rank",
+                               float(a.dead_rank), step=k)
             run.set_tags({"supervisor.outcome": outcome})
             import json
 

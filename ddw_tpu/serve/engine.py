@@ -213,6 +213,8 @@ class ServingEngine:
         self._failure: ReplicaFailed | None = None
         self._fail_lock = threading.Lock()
         self._consecutive_errors = 0
+        self._draining = threading.Event()   # recycle(): admission paused,
+        #                                      in-slot work runs to completion
         self._stopped = False
         self._last_tick = time.monotonic()
         self._fault_n: dict[str, int] = {}   # per-site hook counts (per gen)
@@ -322,6 +324,7 @@ class ServingEngine:
             "consecutive_errors": self._consecutive_errors,
             "queue_depth": self._ctrl.depth(),
             "busy_slots": len(self._slot_req) if self.pool is not None else 0,
+            "draining": self._draining.is_set(),
         }
 
     def load(self) -> dict:
@@ -373,7 +376,55 @@ class ServingEngine:
             self._temps[:] = 0.0
             self.pool.reset()
         self._stopped = False
+        self._draining.clear()
         return self.start()
+
+    # -- graceful recycle (drain, then restart in place) ---------------------
+    def drain_slots(self, timeout_s: float = 30.0) -> bool:
+        """Pause admission and let every in-slot request run to completion
+        (the decode loop keeps ticking; queued requests stay queued and are
+        served by the next generation). Returns False when the slots did
+        not empty in time — the engine is then still draining and the
+        caller should fall back to :meth:`force_fail`."""
+        self._draining.set()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            busy = ((len(self._slot_req) if self.pool is not None else 0)
+                    + len(self._inflight_admit))
+            if busy == 0 and self._failure is None:
+                return True
+            if self._failure is not None:
+                return False        # died while draining: nothing to drain
+            time.sleep(0.01)
+        return False
+
+    def resume_admission(self) -> None:
+        self._draining.clear()
+        with self._cv:
+            self._cv.notify_all()
+
+    def recycle(self, drain_timeout_s: float = 30.0) -> bool:
+        """Graceful in-place restart — the supervisor's answer to a replica
+        that is *degraded but alive*: in-slot requests run to completion
+        (instead of being failed or failed over), the loop quiesces without
+        touching queued futures, and :meth:`restart` brings up the next
+        generation which then serves the preserved queue. Returns False
+        (leaving the engine draining) when the slots would not empty —
+        the caller escalates to :meth:`force_fail` + restart, today's
+        hard path."""
+        if not self.drain_slots(drain_timeout_s):
+            return False
+        # Quiesce WITHOUT stop(): stop() fails every queued future, but a
+        # drained recycle keeps the queue for the next generation.
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=drain_timeout_s)
+            if self._thread.is_alive():
+                return False        # wedged after all: escalate
+        self.restart()
+        return True
 
     def clone_fresh(self) -> "ServingEngine":
         """A replacement replica over the same engine handles and config —
@@ -515,6 +566,13 @@ class ServingEngine:
     def _offer(self, kind: str, req) -> None:
         if self._failure is not None:   # a failed replica refuses instantly
             raise self._refusal()       # (structured — never a hang)
+        if self._draining.is_set():
+            # recycling: an honest load refusal (not a failure — the
+            # breaker stays neutral, routing spills to a sibling)
+            self.metrics.count_overloaded()
+            raise Overloaded(kind, self._ctrl.capacity,
+                             self._ctrl.depth(kind),
+                             retry_after_ms=self._service_ms or 100.0)
         try:
             self._ctrl.offer(kind, req, retry_after_ms=(
                 self._service_ms * (self._ctrl.depth(kind) + 1)
@@ -722,6 +780,8 @@ class ServingEngine:
 
     # LM: continuous batching ------------------------------------------------
     def _admit_lm(self) -> bool:
+        if self._draining.is_set():
+            return False        # draining: finish slots, admit nothing
         free = self.pool.free_slots
         if free == 0:
             return False
@@ -831,6 +891,8 @@ class ServingEngine:
 
     # image: dynamic batching -------------------------------------------------
     def _image_tick(self) -> bool:
+        if self._draining.is_set():
+            return False        # draining: admit no new batch
         depth = self._ctrl.depth("image")
         if depth == 0:
             return False

@@ -30,6 +30,7 @@ import numpy as np
 
 from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.models.lm import build_lm
+from ddw_tpu.runtime.elastic import maybe_elastic_restart
 from ddw_tpu.runtime.faults import Preempted, maybe_fault, preemption_requested
 from ddw_tpu.runtime.mesh import (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MeshSpec,
                                   make_data_mesh, make_mesh)
@@ -76,11 +77,9 @@ class LMTrainer:
                 raise ValueError("train.zero and train.fsdp are mutually "
                                  "exclusive (fsdp already shards the "
                                  "optimizer state) — pick one")
-            if train_cfg.async_checkpoint:
-                raise ValueError(
-                    f"{flag} with async_checkpoint=true is not supported: "
-                    "sharded saves are collective and synchronous — drop "
-                    "one of the flags")
+            # zero/fsdp compose with async_checkpoint: the sharded manager
+            # snapshots shards to host at the boundary and runs the
+            # collective commit protocol on per-process background writers.
             if self.pp:
                 raise ValueError(f"{flag} does not compose with "
                                  f"pipeline_stages — the pipeline step "
@@ -414,11 +413,14 @@ class LMTrainer:
             # ZeRO/FSDP leaves into one host
             from ddw_tpu.train.trainer import _ZeroCheckpointAdapter
 
-            ckpt = _ZeroCheckpointAdapter(cfg.checkpoint_dir, mesh,
-                                          DATA_AXIS, fsdp=cfg.fsdp)
+            ckpt = _ZeroCheckpointAdapter(
+                cfg.checkpoint_dir, mesh, DATA_AXIS, fsdp=cfg.fsdp,
+                async_write=cfg.async_checkpoint,
+                max_inflight=cfg.async_checkpoint_inflight)
         else:
-            ckpt = CheckpointManager(cfg.checkpoint_dir,
-                                     async_write=cfg.async_checkpoint)
+            ckpt = CheckpointManager(
+                cfg.checkpoint_dir, async_write=cfg.async_checkpoint,
+                max_inflight=cfg.async_checkpoint_inflight)
         start_epoch = 0
         restored_meta = None
         if ckpt and resume:
@@ -471,8 +473,9 @@ class LMTrainer:
 
             best = BestCheckpointKeeper(
                 cfg.checkpoint_dir,
-                (lambda d: _ZeroCheckpointAdapter(d, mesh, DATA_AXIS,
-                                                  fsdp=cfg.fsdp, keep=1))
+                (lambda d: _ZeroCheckpointAdapter(
+                    d, mesh, DATA_AXIS, fsdp=cfg.fsdp, keep=1,
+                    async_write=cfg.async_checkpoint))
                 if self.sharded else
                 (lambda d: CheckpointManager(
                     d, keep=1, async_write=cfg.async_checkpoint)))
@@ -514,6 +517,11 @@ class LMTrainer:
                     # the host only regains control every k_chain steps.
                     maybe_fault("step", step=host_step,
                                 ckpt_dir=cfg.checkpoint_dir or None)
+                    # Elastic park point (no-op outside an elastic gang): a
+                    # dead peer re-forms the gang — leave via ElasticRestart
+                    # at the chain boundary and re-enter fit(resume=True)
+                    # in-process from the latest durable checkpoint.
+                    maybe_elastic_restart(step=host_step)
                     if preemption_requested():
                         # Graceful preemption (SIGTERM): checkpoint mid-epoch
                         # and leave via Preempted; the gang worker converts it

@@ -35,6 +35,7 @@ from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.data.loader import ShardedLoader
 from ddw_tpu.data.store import Table
 from ddw_tpu.models.registry import build_model
+from ddw_tpu.runtime.elastic import maybe_elastic_restart
 from ddw_tpu.runtime.faults import Preempted, maybe_fault, preemption_requested
 from ddw_tpu.runtime.mesh import make_data_mesh, make_mesh, MeshSpec, DATA_AXIS
 from ddw_tpu.tracking.tracker import Run
@@ -65,10 +66,13 @@ class _ZeroCheckpointAdapter:
     already calls it on every rank."""
 
     def __init__(self, ckpt_dir: str, mesh, axis: str, fsdp: bool = False,
-                 keep: int = 3):
+                 keep: int = 3, async_write: bool = False,
+                 max_inflight: int = 1):
         from ddw_tpu.checkpoint.sharded import ShardedCheckpointManager
 
-        self._mgr = ShardedCheckpointManager(ckpt_dir, keep=keep)
+        self._mgr = ShardedCheckpointManager(ckpt_dir, keep=keep,
+                                             async_write=async_write,
+                                             max_inflight=max_inflight)
         self._mesh, self._axis, self._fsdp = mesh, axis, fsdp
 
     def save(self, state, step: int, metadata: dict | None = None):
@@ -90,11 +94,11 @@ class _ZeroCheckpointAdapter:
     def latest_step(self):
         return self._mgr.latest_step()
 
-    def wait(self) -> None:  # writes are synchronous in the sharded format
-        pass
+    def wait(self) -> None:
+        self._mgr.wait()
 
     def close(self) -> None:
-        pass
+        self._mgr.close()
 
 
 @dataclasses.dataclass
@@ -214,16 +218,13 @@ class Trainer:
             )
         sharded_state = cfg.zero or cfg.fsdp
         if sharded_state:
-            flag = "train.fsdp" if cfg.fsdp else "train.zero"
             if cfg.zero and cfg.fsdp:
                 raise ValueError("train.zero and train.fsdp are mutually "
                                  "exclusive (fsdp already shards the "
                                  "optimizer state) — pick one")
-            if cfg.async_checkpoint:
-                raise ValueError(
-                    f"{flag} with async_checkpoint=true is not supported: "
-                    "sharded saves are collective and synchronous (every "
-                    "process writes its shards) — drop one of the flags")
+            # zero/fsdp compose with async_checkpoint: the sharded manager
+            # snapshots shards to host at the boundary and runs the
+            # collective commit protocol on per-process background writers.
             from ddw_tpu.parallel.zero import (
                 make_fsdp_train_chain,
                 make_fsdp_train_step,
@@ -259,11 +260,14 @@ class Trainer:
         elif sharded_state:
             # sharded per-process format: saving must NOT all-gather the
             # ZeRO/FSDP-sharded leaves into one host (checkpoint/sharded.py)
-            ckpt = _ZeroCheckpointAdapter(cfg.checkpoint_dir, self.mesh,
-                                          cfg.data_axis, fsdp=cfg.fsdp)
+            ckpt = _ZeroCheckpointAdapter(
+                cfg.checkpoint_dir, self.mesh, cfg.data_axis, fsdp=cfg.fsdp,
+                async_write=cfg.async_checkpoint,
+                max_inflight=cfg.async_checkpoint_inflight)
         else:
-            ckpt = CheckpointManager(cfg.checkpoint_dir,
-                                     async_write=cfg.async_checkpoint)
+            ckpt = CheckpointManager(
+                cfg.checkpoint_dir, async_write=cfg.async_checkpoint,
+                max_inflight=cfg.async_checkpoint_inflight)
         start_epoch = 0
         steps_per_epoch = max(1, train_table.num_records // (cfg.batch_size * world))
         val_steps = max(1, val_table.num_records // (cfg.batch_size * world))
@@ -287,8 +291,9 @@ class Trainer:
 
             best = BestCheckpointKeeper(
                 cfg.checkpoint_dir,
-                (lambda d: _ZeroCheckpointAdapter(d, self.mesh, cfg.data_axis,
-                                                  fsdp=cfg.fsdp, keep=1))
+                (lambda d: _ZeroCheckpointAdapter(
+                    d, self.mesh, cfg.data_axis, fsdp=cfg.fsdp, keep=1,
+                    async_write=cfg.async_checkpoint))
                 if sharded_state else
                 (lambda d: CheckpointManager(
                     d, keep=1, async_write=cfg.async_checkpoint)))
@@ -356,6 +361,15 @@ class Trainer:
                         maybe_fault("step",
                                     step=epoch * steps_per_epoch + step_i,
                                     ckpt_dir=cfg.checkpoint_dir or None)
+                        # Elastic park point (no-op outside an elastic gang):
+                        # a peer rank died and the gang re-formed — raise
+                        # ElasticRestart HERE, at the chain boundary, so this
+                        # surviving process re-enters fit(resume=True) from
+                        # the latest durable checkpoint with its pid/programs
+                        # intact (runtime/elastic.py). The finally block
+                        # below joins the async ckpt writer on the way out.
+                        maybe_elastic_restart(
+                            step=epoch * steps_per_epoch + step_i)
                         if preemption_requested():
                             # Graceful preemption (SIGTERM): checkpoint the
                             # live state mid-epoch, then leave via Preempted —

@@ -165,10 +165,10 @@ class TrainCfg:
                                         # the data axis (parallel/zero.py);
                                         # checkpoints switch to the sharded
                                         # per-process format (no full gather).
-                                        # Composes with grad_accum_steps.
-                                        # Incompatible with async_checkpoint
-                                        # (saves are collective+synchronous)
-                                        # — raises.
+                                        # Composes with grad_accum_steps and
+                                        # with async_checkpoint (per-process
+                                        # background writers run the same
+                                        # collective commit protocol).
     fsdp: bool = False                  # ZeRO-3/FSDP: shard params AND
                                         # optimizer state over the data axis
                                         # (~1/N model residency per device;
@@ -191,7 +191,14 @@ class TrainCfg:
     async_checkpoint: bool = False      # serialize+write checkpoints on a
                                         # background thread (device snapshot is
                                         # still synchronous) so IO overlaps the
-                                        # next epoch's compute
+                                        # next epoch's compute; works for the
+                                        # classic AND the sharded (zero/fsdp)
+                                        # formats
+    async_checkpoint_inflight: int = 2  # bounded async write queue depth: a
+                                        # save blocks only past this many
+                                        # outstanding writes, so one slow
+                                        # fsync never stalls a chain boundary
+                                        # (1 = join-previous-before-new)
     checkpoint_every_epochs: int = 1
     checkpoint_keep_best: bool = False  # also keep the single best-val_loss
                                         # state under <checkpoint_dir>/best

@@ -151,6 +151,83 @@ def test_metadata_records_state_bytes(tmp_path):
     assert meta["state_bytes"] == os.path.getsize(path)
 
 
+def test_async_save_returns_before_write_completes(tmp_path, monkeypatch):
+    """Acceptance pin: the chain boundary (save) returns while the write is
+    still in flight on a deliberately held writer — the train loop never
+    blocks on disk. Event-gated, not clock-gated."""
+    import threading
+
+    import ddw_tpu.checkpoint.ckpt as ckpt_mod
+
+    orig = ckpt_mod._write_host_state
+    started, release = threading.Event(), threading.Event()
+
+    def held(*a, **kw):
+        started.set()
+        assert release.wait(30)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(ckpt_mod, "_write_host_state", held)
+    mgr = CheckpointManager(str(tmp_path), async_write=True, max_inflight=2)
+    mgr.save(_state(1.0), 1)            # returned: write not yet complete
+    assert started.wait(10)
+    assert len(mgr._pending) == 1 and not mgr._pending[0].done()
+    # bounded depth 2: a second boundary ALSO returns while write 1 is held
+    mgr.save(_state(2.0), 2)
+    assert len(mgr._pending) == 2
+    assert not mgr._pending[0].done()
+    release.set()
+    mgr.wait()
+    assert mgr.latest_step() == 2
+    # and the held-writer bytes are identical to a synchronous save
+    sync = CheckpointManager(str(tmp_path / "sync"))
+    monkeypatch.setattr(ckpt_mod, "_write_host_state", orig)
+    sync.save(_state(2.0), 2)
+    with open(os.path.join(str(tmp_path), "step_0000000002",
+                           "state.msgpack"), "rb") as f1, \
+         open(os.path.join(str(tmp_path / "sync"), "step_0000000002",
+                           "state.msgpack"), "rb") as f2:
+        assert f1.read() == f2.read()
+
+
+def test_async_inflight_bound_blocks_at_capacity(tmp_path, monkeypatch):
+    """max_inflight is a hard bound: the save that would put a THIRD write
+    in flight joins the oldest one first (writes retire in order)."""
+    import threading
+
+    import ddw_tpu.checkpoint.ckpt as ckpt_mod
+
+    orig = ckpt_mod._write_host_state
+    release = threading.Event()
+    writes = []
+
+    def held(ckpt_dir, host_state, step, metadata, keep):
+        assert release.wait(30)
+        writes.append(step)
+        return orig(ckpt_dir, host_state, step, metadata, keep)
+
+    monkeypatch.setattr(ckpt_mod, "_write_host_state", held)
+    mgr = CheckpointManager(str(tmp_path), async_write=True, max_inflight=2)
+    mgr.save(_state(1.0), 1)
+    mgr.save(_state(2.0), 2)
+
+    blocked = threading.Event()
+
+    def third():
+        mgr.save(_state(3.0), 3)
+        blocked.set()
+
+    t = threading.Thread(target=third)
+    t.start()
+    assert not blocked.wait(0.3)        # at capacity: save 3 is parked
+    release.set()
+    t.join(timeout=10)
+    assert blocked.is_set()
+    mgr.wait()
+    assert writes == [1, 2, 3]          # order preserved on one writer
+    assert mgr.latest_step() == 3
+
+
 def test_async_write_error_surfaces_on_next_save(tmp_path):
     """Regression (satellite): a failed background write must surface on the
     NEXT save(), not only on an explicit wait() — the trainer's per-epoch

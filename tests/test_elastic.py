@@ -1,0 +1,375 @@
+"""Elastic gang recovery + async-checkpoint fault drills.
+
+Tier-1 keeps the pure rendezvous/topology units (threaded fake gangs — no
+subprocess) plus one fast real-process representative per drill class: the
+single-rank kill-and-respawn drill and the torn-async-checkpoint drill.
+The whole-world-fallback and sharded-async gang variants ride tier-2
+(`slow`), per the ROADMAP's budget practice."""
+
+import functools
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ddw_tpu.runtime.elastic import ElasticRestart, GangRendezvous
+from ddw_tpu.runtime.launcher import GangError, Launcher
+from ddw_tpu.runtime.supervisor import GangFailure, GangSupervisor
+
+TOTAL_STEPS = 6
+
+
+# -- pure topology units (threaded fake gang, no subprocess) -----------------
+
+def _threads(n, fn):
+    errs = []
+
+    def run(r):
+        try:
+            fn(r)
+        except BaseException as e:   # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in ts)
+    return errs
+
+
+def test_rendezvous_barrier_and_reduce(tmp_path):
+    """All ranks meet at the barrier; the host all-reduce folds in rank
+    order (deterministic, bit-identical everywhere)."""
+    root = str(tmp_path)
+    out = {}
+
+    def worker(r):
+        rdzv = GangRendezvous(root, world_size=3, rank=r)
+        rdzv.announce()
+        rdzv.barrier("start")
+        total = rdzv.all_reduce(0, np.full((2,), float(r + 1)))
+        mean = rdzv.all_reduce(1, float(r), op="mean")
+        out[r] = (total, mean)
+
+    assert _threads(3, worker) == []
+    for r in range(3):
+        np.testing.assert_array_equal(out[r][0], np.full((2,), 6.0))
+        assert out[r][1] == pytest.approx(1.0)
+    # membership carries the pid evidence the drills assert on
+    rdzv = GangRendezvous(root, 3, 0)
+    assert rdzv.member(0, 1)["pid"] == os.getpid()
+
+
+def test_barrier_aborts_with_elastic_restart_on_recovery(tmp_path):
+    """Survivors parked at a barrier (a dead peer never arrives) leave via
+    ElasticRestart the moment the driver posts the recovery record — they
+    never wait out the timeout."""
+    root = str(tmp_path)
+    rdzv0 = GangRendezvous(root, world_size=2, rank=0)
+    caught = []
+
+    def survivor(_):
+        try:
+            rdzv0.barrier(3, timeout_s=20.0)
+        except ElasticRestart as e:
+            caught.append(e)
+
+    t = threading.Thread(target=survivor, args=(0,))
+    t.start()
+    time.sleep(0.1)      # park first
+    GangRendezvous(root, 2, -1).post_recovery(1, dead_rank=1, exit_code=-9)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert caught and caught[0].generation == 1
+    assert caught[0].record["dead_rank"] == 1
+    assert caught[0].step == 3
+    # adopting the new generation consumes the record
+    rdzv0.advance(caught[0].generation)
+    assert rdzv0.recovery_pending() is None
+    assert os.environ.pop("DDW_ELASTIC_GEN") == "1"
+
+
+def test_reduce_aborts_and_regenerations_do_not_mix(tmp_path):
+    """A reduce parked under a dead peer aborts; contributions of the old
+    generation are invisible to the re-formed gang."""
+    root = str(tmp_path)
+    r0 = GangRendezvous(root, world_size=2, rank=0)
+    with pytest.raises(ElasticRestart):
+        # contribute, then see the recovery record posted mid-park
+        threading.Timer(
+            0.1, lambda: GangRendezvous(root, 2, -1).post_recovery(
+                1, dead_rank=1)).start()
+        r0.all_reduce(5, 1.0, timeout_s=20.0)
+    # gen 1: both ranks contribute fresh values at the SAME tag
+    out = {}
+
+    def worker(r):
+        rdzv = GangRendezvous(root, 2, r, generation=1)
+        out[r] = float(rdzv.all_reduce(5, float(10 + r)))
+
+    assert _threads(2, worker) == []
+    assert out[0] == out[1] == 21.0   # not polluted by gen-0's value 1.0
+
+
+def test_maybe_elastic_restart_hook(tmp_path, monkeypatch):
+    """The trainers' chain-boundary hook: free no-op outside elastic mode,
+    raises once a newer recovery record exists."""
+    from ddw_tpu.runtime import elastic
+
+    elastic.reset_context()
+    elastic.maybe_elastic_restart(step=0)          # no env: no-op
+    monkeypatch.setenv("DDW_RENDEZVOUS_DIR", str(tmp_path))
+    monkeypatch.setenv("DDW_NUM_PROCESSES", "2")
+    monkeypatch.setenv("DDW_PROCESS_ID", "0")
+    elastic.reset_context()
+    elastic.maybe_elastic_restart(step=1)          # no record yet: no-op
+    GangRendezvous(str(tmp_path), 2, -1).post_recovery(1, dead_rank=1)
+    with pytest.raises(ElasticRestart) as exc:
+        elastic.maybe_elastic_restart(step=7)
+    assert exc.value.generation == 1 and exc.value.step == 7
+    elastic.reset_context()
+
+
+def test_fault_spec_egen_and_new_kinds():
+    from ddw_tpu.runtime.faults import parse_fault
+
+    spec = parse_fault("kill:rank=1:step=3")
+    assert spec.kind == "kill" and spec.site == "step"
+    # default egen=0: the respawned rank (egen 1) runs clean
+    assert spec.matches("step", step=3, rank=1, gen=0, egen=0, attempt=0)
+    assert not spec.matches("step", step=3, rank=1, gen=0, egen=1, attempt=0)
+    # egen=* chases every respawn — the re-rendezvous-keeps-failing drill
+    chase = parse_fault("kill:rank=1:step=3:egen=*")
+    assert chase.matches("step", step=3, rank=1, gen=0, egen=2, attempt=0)
+    assert not chase.matches("step", step=3, rank=1, gen=1, egen=0,
+                             attempt=0)  # gen still defaults to 0
+    torn = parse_fault("ckpt_async_torn:step=4")
+    assert torn.site == "ckpt_async"
+    assert torn.matches("ckpt_async", step=4, rank=0, gen=0, egen=0,
+                        attempt=0)
+    assert not torn.matches("step", step=4, rank=0, gen=0, egen=0, attempt=0)
+
+
+# -- real-process drills ------------------------------------------------------
+
+def _elastic_worker(ckpt_dir: str, total_steps: int) -> dict:
+    """The elastic supervised-worker contract: explicit-topology gang (the
+    launcher's elastic mode skips jax.distributed — a respawned rank could
+    never rejoin its coordination service), checkpoint via the rank-0
+    writer, per-step fault hook + chain-boundary park hook, host all-reduce
+    as the per-step gang data barrier."""
+    import os
+
+    import numpy as np
+
+    from ddw_tpu.checkpoint.ckpt import CheckpointManager
+    from ddw_tpu.runtime import elastic
+    from ddw_tpu.runtime.faults import maybe_fault
+
+    mgr = CheckpointManager(ckpt_dir)
+    state = {"w": np.zeros((4,), np.float32), "step": np.asarray(0, np.int32)}
+    start = 0
+    if mgr.latest_step() is not None:
+        state, start = mgr.restore(state)
+        start = int(start)
+    elastic.elastic_barrier("start")   # the (re-formed) gang resumes in step
+    for step in range(start, total_steps):
+        maybe_fault("step", step=step, ckpt_dir=ckpt_dir)
+        elastic.maybe_elastic_restart(step=step)
+        total = elastic.host_all_reduce(step, np.ones(()))  # gang barrier
+        state = {"w": state["w"] + float(total),
+                 "step": np.asarray(step + 1, np.int32)}
+        mgr.save(state, step + 1)      # env-guarded rank-0 writer
+    mgr.close()
+    ctx = elastic.context()
+    return {"final_step": int(state["step"]), "resume_step": start,
+            "w": float(state["w"][0]), "pid": os.getpid(),
+            "egen": ctx.generation if ctx is not None else 0}
+
+
+def _gang(tmp_path, elastic_restarts=1, timeout_s=120, **kw):
+    return Launcher(np=2, devices_per_proc=1, timeout_s=timeout_s,
+                    elastic_restarts=elastic_restarts,
+                    rendezvous_dir=str(tmp_path / "rdzv"), **kw)
+
+
+@pytest.mark.faults
+def test_elastic_single_rank_respawn(tmp_path, monkeypatch,
+                                     worker_pythonpath):
+    """The tentpole acceptance drill: kill exactly one rank mid-epoch —
+    the gang resumes with ONLY that rank respawned (the survivor's pid is
+    identical across generations), resume semantics match the
+    whole-world restart contract (restore from the latest durable
+    checkpoint), and the forensics land in the supervisor's attempt
+    record tagged elastic."""
+    baseline = Launcher(np=-1).run(functools.partial(
+        _elastic_worker, str(tmp_path / "base"), TOTAL_STEPS))
+    assert baseline["final_step"] == TOTAL_STEPS
+
+    monkeypatch.setenv("DDW_FAULT", "kill:rank=1:step=3")
+    launcher = _gang(tmp_path)
+    sup = GangSupervisor(launcher, max_restarts=0, backoff_base_s=0.05,
+                         jitter=0.0)
+    out = sup.run(functools.partial(_elastic_worker, str(tmp_path / "ck"),
+                                    TOTAL_STEPS))
+    # resumed exactly at the last durable step, completed, and each step
+    # contributed world_size — identical to an uninterrupted run's math
+    assert out["final_step"] == TOTAL_STEPS
+    assert out["resume_step"] == 3
+    assert out["w"] == TOTAL_STEPS * 2
+    assert out["egen"] == 1
+
+    # only rank 1 was respawned: one elastic event, signal death, and the
+    # membership ledger shows rank 0's pid stable across generations
+    assert len(launcher.elastic_events) == 1
+    ev = launcher.elastic_events[0]
+    assert ev.dead_rank == 1 and ev.generation == 1
+    assert ev.exit_signal == 9                      # SIGKILL forensics
+    rdzv = GangRendezvous(launcher.last_rendezvous_dir, 2, -1)
+    assert rdzv.member(0, 0)["pid"] == rdzv.member(1, 0)["pid"]
+    assert rdzv.member(0, 1)["pid"] != rdzv.member(1, 1)["pid"]
+    assert rdzv.member(1, 1)["pid"] == ev.respawn_pid
+    assert out["pid"] == rdzv.member(1, 0)["pid"]   # rank-0 result, same pid
+
+    # supervisor forensics: the recovery is an attempt tagged elastic, and
+    # it consumed NO whole-world budget (max_restarts=0 and we completed)
+    assert [a.recovery for a in sup.attempts] == ["elastic"]
+    assert sup.attempts[0].dead_rank == 1
+    assert sup.attempts[0].exit_signal == 9
+    assert sup.attempts[0].kind == "rank-death"
+
+
+@pytest.mark.faults
+@pytest.mark.slow   # three gang launches of real processes — tier-2 drill
+def test_elastic_budget_exhausted_falls_back_to_whole_world(
+        tmp_path, monkeypatch, worker_pythonpath):
+    """Re-rendezvous failure: egen=* re-kills the respawned rank, the
+    elastic budget (1) exhausts, the launcher kills the gang (classic
+    GangError) and the supervisor's whole-world restart completes the run
+    — the fallback the elastic path must never replace."""
+    monkeypatch.setenv("DDW_FAULT", "kill:rank=1:step=3:egen=*")
+    launcher = _gang(tmp_path, elastic_restarts=1)
+    sup = GangSupervisor(launcher, max_restarts=1, backoff_base_s=0.05,
+                         jitter=0.0)
+    out = sup.run(functools.partial(_elastic_worker, str(tmp_path / "ck"),
+                                    TOTAL_STEPS))
+    assert out["final_step"] == TOTAL_STEPS
+    assert out["resume_step"] == 3          # whole-world restore point
+    assert out["w"] == TOTAL_STEPS * 2
+    # attempt record tells the full story: one elastic recovery, then the
+    # whole-world crash attempt that actually healed the run
+    kinds = [(a.kind, a.recovery) for a in sup.attempts]
+    assert ("rank-death", "elastic") in kinds
+    assert ("crash", "whole-world") in kinds
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_elastic_exhausts_into_gangfailure(tmp_path, monkeypatch,
+                                           worker_pythonpath):
+    """Elastic budget out AND whole-world budget out -> GangFailure with
+    both the elastic events and the gang attempts in the record."""
+    monkeypatch.setenv("DDW_FAULT", "kill:rank=1:step=3:egen=*:gen=*")
+    sup = GangSupervisor(_gang(tmp_path, elastic_restarts=1),
+                         max_restarts=0, backoff_base_s=0.05, jitter=0.0)
+    with pytest.raises(GangFailure) as exc:
+        sup.run(functools.partial(_elastic_worker, str(tmp_path / "ck"),
+                                  TOTAL_STEPS))
+    recs = [a.recovery for a in exc.value.attempts]
+    assert "elastic" in recs and "whole-world" in recs
+
+
+# -- torn ASYNC checkpoint: quarantined across generations -------------------
+
+def _async_ckpt_worker(ckpt_dir: str, total_steps: int,
+                       sharded: bool = False) -> dict:
+    """Supervised worker writing checkpoints through the ASYNC writer
+    (bounded in-flight depth 2). DDW_FAULT=ckpt_async_torn fires on the
+    background writer thread mid-write."""
+    import numpy as np
+
+    if sharded:
+        import jax
+
+        from ddw_tpu.checkpoint.sharded import ShardedCheckpointManager
+
+        class _Mgr:
+            def __init__(self, d):
+                self._m = ShardedCheckpointManager(d, async_write=True,
+                                                   max_inflight=2)
+
+            def latest_step(self):
+                return self._m.latest_step()
+
+            def restore(self, target):
+                # host leaves: any sharding sentinel without device_set
+                sh = jax.tree.map(lambda _: object(), target)
+                return self._m.restore(target, sh)
+
+            def save(self, state, step):
+                self._m.save(state, step)
+
+            def close(self):
+                self._m.close()
+
+        mgr = _Mgr(ckpt_dir)
+    else:
+        from ddw_tpu.checkpoint.ckpt import CheckpointManager
+
+        mgr = CheckpointManager(ckpt_dir, async_write=True, max_inflight=2)
+    state = {"w": np.zeros((4,), np.float32), "step": np.asarray(0, np.int32)}
+    start = 0
+    if mgr.latest_step() is not None:
+        state, start = mgr.restore(state)
+        start = int(start)
+    for step in range(start, total_steps):
+        state = {"w": state["w"] + 1.0,
+                 "step": np.asarray(step + 1, np.int32)}
+        mgr.save(state, step + 1)
+    mgr.close()
+    return {"final_step": int(state["step"]), "resume_step": start}
+
+
+@pytest.mark.faults
+def test_torn_async_write_quarantined_across_generations(
+        tmp_path, monkeypatch, worker_pythonpath):
+    """Satellite pin: the writer process dies mid-async-write of step 3
+    leaving a torn dir; the restarted generation quarantines it and
+    resumes from step 2 — the async path's crash consistency is exactly
+    the synchronous path's."""
+    ckpt_dir = str(tmp_path / "ck")
+    monkeypatch.setenv("DDW_FAULT", "ckpt_async_torn:rank=0:step=3")
+    sup = GangSupervisor(Launcher(np=1, devices_per_proc=1, timeout_s=120),
+                         max_restarts=1, backoff_base_s=0.05, jitter=0.0)
+    out = sup.run(functools.partial(_async_ckpt_worker, ckpt_dir,
+                                    TOTAL_STEPS))
+    assert out["final_step"] == TOTAL_STEPS
+    # writes retire in order on the writer thread: steps 1 and 2 were
+    # durable before the torn step-3 write began -> clean fallback restore
+    assert out["resume_step"] == 2
+    names = os.listdir(ckpt_dir)
+    assert any(n.startswith("step_0000000003.torn") for n in names)
+    assert "step_0000000003" not in [n for n in names if "." not in n]
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_torn_async_sharded_write_quarantined(tmp_path, monkeypatch,
+                                              worker_pythonpath):
+    """The sharded-format twin of the torn-async drill: proc_bytes
+    completeness + quarantine hold when the commit protocol runs on the
+    background writer."""
+    ckpt_dir = str(tmp_path / "ck")
+    monkeypatch.setenv("DDW_FAULT", "ckpt_async_torn:rank=0:step=3")
+    sup = GangSupervisor(Launcher(np=1, devices_per_proc=1, timeout_s=120),
+                         max_restarts=1, backoff_base_s=0.05, jitter=0.0)
+    out = sup.run(functools.partial(_async_ckpt_worker, ckpt_dir,
+                                    TOTAL_STEPS, True))
+    assert out["final_step"] == TOTAL_STEPS
+    assert out["resume_step"] == 2
+    assert any(n.startswith("step_0000000003.torn")
+               for n in os.listdir(ckpt_dir))

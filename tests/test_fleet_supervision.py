@@ -515,11 +515,12 @@ def test_failover_preserves_determinism_after_mid_queue_kill(
     assert engines[0].generation == 1
     assert any(a.kind == "crash" and a.action == "restarted"
                for a in sup.attempts)
-    # the half-open probe readmits it; its success closes the circuit
-    assert rs.breakers[0].state in (CIRCUIT_HALF_OPEN, CIRCUIT_CLOSED)
+    # the SHADOW probe readmits it: the circuit closes without any live
+    # request playing half-open guinea pig, then live traffic serves
+    # token-identical through the restarted generation
+    assert _await(lambda: rs.breakers[0].state == CIRCUIT_CLOSED, 10.0)
     r = rs.generate(prompts[0], steps)
     assert np.array_equal(r.tokens, refs[0])
-    assert _await(lambda: rs.breakers[0].state == CIRCUIT_CLOSED, 5.0)
 
 
 @pytest.mark.faults
@@ -681,3 +682,179 @@ def test_client_reuses_keepalive_connections(gw):
     finally:
         hold.close()
         gw._httpd.max_connections = 256
+
+
+# -- graceful recycle: drain in-slot work, preserve the queue ----------------
+
+def test_recycle_drains_in_slot_to_completion(fleet, pm):
+    """Satellite pin: recycling a replica lets its in-slot requests run to
+    completion (token-identical — never failed or failed over), preserves
+    queued work for the next generation, and readmits through the SHADOW
+    probe — the circuit closes without any live request playing probe."""
+    rs, sup, engines = fleet
+    eng = engines[0]
+    prompts = _prompts([5, 7, 6], seed=9)
+    steps = 24
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+    gen0 = eng.generation
+    # 2 land in slots, the 3rd queues behind them. A deliberately slow
+    # token stream holds the slots open (~5 ms/token) so the drain window
+    # is wide and deterministic on any host; the event fires once BOTH
+    # slotted requests are inserted (request 1 emits after request 0's
+    # insert in the same admission group).
+    in_slots = threading.Event()
+    slow = lambda i, t: time.sleep(0.005)                    # noqa: E731
+    slow_mark = lambda i, t: (time.sleep(0.005),             # noqa: E731
+                              in_slots.set())
+    futs = [eng.submit_generate(prompts[0], steps, on_token=slow),
+            eng.submit_generate(prompts[1], steps, on_token=slow_mark),
+            eng.submit_generate(prompts[2], steps)]
+    assert in_slots.wait(10.0)
+    assert sup.recycle(0) is True
+    for i, (f, ref) in enumerate(zip(futs, refs)):
+        r = f.result(timeout=60)
+        assert np.array_equal(r.tokens, ref), i
+    assert eng.generation == gen0 + 1           # restarted in place
+    rep = sup.report()
+    assert any(a["action"] == "drained_restarted"
+               and a["readmit"] == "probed_closed"
+               for a in rep["attempts"])
+    assert rep["shadow_probes"] >= 1
+    assert rs.breakers[0].state == CIRCUIT_CLOSED
+    # draining refused NEW submissions honestly (Overloaded, not a failure)
+    eng._draining.set()
+    with pytest.raises(Overloaded):
+        eng.submit_generate(prompts[0], 2)
+    eng.resume_admission()
+    r = eng.generate(prompts[0], 6)
+    assert np.array_equal(r.tokens, refs[0][:6])
+
+
+# -- supervisor recycle/probe policy over scripted fakes ---------------------
+
+class _FakeRecyclable(_FakeRestartable):
+    """Restartable fake with a drain/recycle surface and an optional probe
+    surface (pool + generate) for the shadow-probe paths."""
+
+    def __init__(self, drain_ok=True, probe_ok=None):
+        super().__init__()
+        self.drain_ok = drain_ok
+        self.recycles = 0
+        self.probes = 0
+        self._degraded = False
+        if probe_ok is not None:        # expose the probe surface
+            self.pool = object()
+            self.probe_ok = probe_ok
+
+    def generate(self, prompt, num_steps, timeout_s=None):
+        self.probes += 1
+        if not self.probe_ok:
+            raise ReplicaFailed("crash", replica=self.replica_id)
+        return "ok"
+
+    def health(self):
+        h = super().health()
+        if self._failed is None and self._degraded:
+            h["state"] = "degraded"
+            h["consecutive_errors"] = 1
+        return h
+
+    def recycle(self, drain_timeout_s=30.0):
+        if not self.drain_ok:
+            return False
+        self.recycles += 1
+        self.generation += 1
+        self._degraded = False
+        return True
+
+    def force_fail(self, kind="stalled", reason=""):
+        self.fail(kind)
+
+
+def test_degraded_too_long_triggers_graceful_recycle():
+    """A replica continuously degraded past recycle_degraded_after_s is
+    drained + restarted (never force-failed), and rejoins half-open (no
+    probe surface on this fake)."""
+    eng = _FakeRecyclable()
+    rs = ReplicaSet([eng])
+    sup = ReplicaSupervisor(rs, backoff_base_s=0.0, jitter=0.0,
+                            poll_interval_s=0.01,
+                            recycle_degraded_after_s=0.05).start()
+    try:
+        eng._degraded = True
+        deadline = time.monotonic() + 5
+        while eng.recycles < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.recycles == 1
+        assert eng._failed is None          # never force-failed
+        assert rs.restarts[0] == 1
+        assert _await(lambda: any(
+            a["action"] == "drained_restarted"
+            and a["readmit"] == "half_open"
+            for a in sup.report()["attempts"]), 5.0)
+        assert rs.breakers[0].state == CIRCUIT_HALF_OPEN
+    finally:
+        sup.stop()
+
+
+def test_recycle_drain_timeout_escalates_to_force_fail():
+    """When the slots will not drain, recycle() escalates to the hard path
+    (force_fail) and the normal failed-replica recovery takes over."""
+    eng = _FakeRecyclable(drain_ok=False)
+    rs = ReplicaSet([eng])
+    sup = ReplicaSupervisor(rs, max_restarts=1, backoff_base_s=0.0,
+                            jitter=0.0, poll_interval_s=0.01,
+                            drain_timeout_s=0.05)
+    assert sup.recycle(0) is False
+    assert eng._failed is not None          # escalated
+    rep = sup.report()
+    assert any(a["action"] == "drain_timeout" for a in rep["attempts"])
+    sup.start()
+    try:
+        deadline = time.monotonic() + 5
+        while eng.restarts < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.restarts == 1            # hard recovery picked it up
+    finally:
+        sup.stop()
+
+
+def test_shadow_probe_closes_or_retrips_the_circuit():
+    """Satellite pin: the half-open gate is replaced by a supervisor-issued
+    shadow request when the engine exposes a probe surface — success closes
+    the circuit outright; failure re-trips it and no live request was ever
+    at risk."""
+    ok = _FakeRecyclable(probe_ok=True)
+    rs = ReplicaSet([ok])
+    sup = ReplicaSupervisor(rs, backoff_base_s=0.0, jitter=0.0,
+                            poll_interval_s=0.01).start()
+    try:
+        ok.fail()
+        rs.failure_event.set()
+        deadline = time.monotonic() + 5
+        while rs.restarts[0] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert _await(lambda: rs.breakers[0].state == CIRCUIT_CLOSED, 5.0)
+        assert ok.probes == 1
+        assert _await(lambda: any(a.readmit == "probed_closed"
+                                  for a in sup.attempts), 5.0)
+    finally:
+        sup.stop()
+
+    bad = _FakeRecyclable(probe_ok=False)
+    rs2 = ReplicaSet([bad])
+    sup2 = ReplicaSupervisor(rs2, max_restarts=1, backoff_base_s=5.0,
+                             jitter=0.0, poll_interval_s=0.01).start()
+    try:
+        bad.fail()
+        rs2.failure_event.set()
+        deadline = time.monotonic() + 5
+        while rs2.restarts[0] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert _await(
+            lambda: any(a.readmit == "probe_failed" for a in sup2.attempts),
+            5.0)
+        assert rs2.breakers[0].state == CIRCUIT_OPEN    # stayed dark
+        assert bad.probes >= 1
+    finally:
+        sup2.stop()

@@ -95,6 +95,10 @@ def test_crash_restart_resumes_from_checkpoint_and_completes(
     from ddw_tpu.runtime.faults import EXIT_FAULT_CRASH
 
     assert EXIT_FAULT_CRASH in sup.attempts[0].exit_codes
+    # forensics: which rank died, how, and which recovery mode engaged
+    assert sup.attempts[0].dead_rank == 1
+    assert sup.attempts[0].exit_signal is None      # exit(77), not a signal
+    assert sup.attempts[0].recovery == "whole-world"
 
 
 @pytest.mark.faults
@@ -145,6 +149,8 @@ def test_preemption_restarts_outside_crash_budget(tmp_path, monkeypatch,
 
 
 @pytest.mark.faults
+@pytest.mark.slow   # two full gang generations; preemption class keeps
+#                     test_preemption_restarts_outside_crash_budget in tier-1
 def test_preemption_budget_exhaustion_raises(tmp_path, monkeypatch,
                                              worker_pythonpath):
     """A preemption *storm* (every generation preempted) still terminates:
@@ -272,6 +278,8 @@ def test_supervisor_reports_attempts_to_tracker(tmp_path, monkeypatch,
 
 
 @pytest.mark.faults
+@pytest.mark.slow   # tracker-reporting class keeps
+#                     test_supervisor_reports_attempts_to_tracker in tier-1
 def test_supervisor_reports_failed_outcome(tmp_path, monkeypatch,
                                            worker_pythonpath):
     from ddw_tpu.tracking.tracker import Tracker
