@@ -44,6 +44,21 @@ class CausalSelfAttention(nn.Module):
                              # depth — cache_index becomes a [B] vector, K/V
                              # writes scatter per row, and masking/overflow
                              # go per-row (ddw_tpu.serve.slots). S must be 1.
+    paged_decode: bool = False  # paged continuous batching: K/V live in a
+                             # GLOBAL pool of kv_cache_blocks fixed-size
+                             # blocks instead of per-row contiguous strips;
+                             # each call takes per-row block tables (gather
+                             # indices) and start positions as ARGUMENTS, so
+                             # the cache tree is batch-independent — one pool
+                             # serves prefill groups and the decode batch
+                             # alike (ddw_tpu.serve.blocks). Any S works
+                             # (S>1 = chunked/suffix prefill into blocks).
+    kv_cache_blocks: int = 0  # paged mode: usable blocks + 1 null block
+    kv_block_size: int = 0   # paged mode: tokens per block; must divide the
+                             # attention tile so the gathered view is laid
+                             # out exactly like the contiguous cache (that
+                             # layout equality is what makes paged outputs
+                             # bit-identical to the sequential path)
     num_kv_heads: int = 0    # GQA (Ainslie et al. 2305.13245): 0 = num_heads
                              # (MHA); fewer KV heads shrink the k/v params and
                              # the decode cache by H/KV; K/V broadcast to the
@@ -53,7 +68,7 @@ class CausalSelfAttention(nn.Module):
     lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, block_tables=None, start_pos=None):
         from ddw_tpu.models.lora import maybe_lora_dense
 
         b, s, d = x.shape
@@ -95,34 +110,85 @@ class CausalSelfAttention(nn.Module):
             if self.slot_decode and s != 1:
                 raise ValueError(f"slot_decode processes one token per slot "
                                  f"per call, got S={s}")
-            ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, cap, kv_heads, head_dim), k.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, cap, kv_heads, head_dim), v.dtype)
-            idx = self.variable(
-                "cache", "cache_index",
-                lambda: jnp.zeros((b,) if self.slot_decode else (),
-                                  jnp.int32))
             # cumulative count of KV tiles actually computed — observability
             # hook proving the skip logic works (test_lm pins it); costs one
             # scalar add per call.
             tiles = self.variable("cache", "tiles_computed",
                                   lambda: jnp.zeros((), jnp.int32))
-            pos = idx.value
-            if self.slot_decode:
-                # per-row write: each slot appends at its own depth
-                row_write = jax.vmap(
-                    lambda c, t, p: lax.dynamic_update_slice(c, t, (p, 0, 0)))
-                ck.value = row_write(ck.value, k, pos)
-                cv.value = row_write(cv.value, v, pos)
+            if self.paged_decode:
+                # Paged KV (vLLM lineage, arXiv 2309.06180): the cache is a
+                # GLOBAL pool of fixed-size blocks, and this row's K/V lives
+                # wherever its block table points. The table is padded to
+                # cap // block_size entries (unallocated tail -> block 0,
+                # the reserved null block), so gathering blocks back in
+                # table order reconstructs EXACTLY the contiguous [cap]
+                # layout — the tile loop below then runs unchanged on the
+                # gathered view, which is what keeps paged decode
+                # bit-identical to the contiguous path.
+                bs = self.kv_block_size
+                if bs < 1 or tile % bs:
+                    raise ValueError(
+                        f"kv_block_size {bs} must be >= 1 and divide the "
+                        f"attention tile {tile}")
+                if self.kv_cache_blocks < 2:
+                    raise ValueError("paged_decode needs kv_cache_blocks >= 2"
+                                     " (block 0 is the reserved null block)")
+                n_tbl = cap // bs
+                if start_pos is None:
+                    start_pos = jnp.zeros((b,), jnp.int32)
+                if block_tables is None:
+                    block_tables = jnp.zeros((b, n_tbl), jnp.int32)
+                ck = self.variable("cache", "kv_block_key", jnp.zeros,
+                                   (self.kv_cache_blocks, bs, kv_heads,
+                                    head_dim), k.dtype)
+                cv = self.variable("cache", "kv_block_value", jnp.zeros,
+                                   (self.kv_cache_blocks, bs, kv_heads,
+                                    head_dim), v.dtype)
+                pos = start_pos                       # [B] per-row depths
+                p = pos[:, None] + jnp.arange(s)      # [B, S] write positions
+                # out-of-capacity writes (a finished row's chain overshoot)
+                # are routed to the null block instead of clamp-corrupting a
+                # real one; unallocated table entries are already 0
+                safe = p < cap
+                entry = jnp.take_along_axis(
+                    block_tables, jnp.clip(p // bs, 0, n_tbl - 1), axis=1)
+                bt = jnp.where(safe, entry, 0)
+                off = jnp.where(safe, p % bs, 0)
+                ck.value = ck.value.at[bt, off].set(k)
+                cv.value = cv.value.at[bt, off].set(v)
+                # gather-back: [B, n_tbl, bs, ...] -> contiguous [B, cap, ...]
+                src_k = ck.value[block_tables].reshape(
+                    b, cap, kv_heads, head_dim)
+                src_v = cv.value[block_tables].reshape(
+                    b, cap, kv_heads, head_dim)
             else:
-                ck.value = lax.dynamic_update_slice(ck.value, k, (0, pos, 0, 0))
-                cv.value = lax.dynamic_update_slice(cv.value, v, (0, pos, 0, 0))
-            idx.value = pos + s
+                ck = self.variable("cache", "cached_key", jnp.zeros,
+                                   (b, cap, kv_heads, head_dim), k.dtype)
+                cv = self.variable("cache", "cached_value", jnp.zeros,
+                                   (b, cap, kv_heads, head_dim), v.dtype)
+                idx = self.variable(
+                    "cache", "cache_index",
+                    lambda: jnp.zeros((b,) if self.slot_decode else (),
+                                      jnp.int32))
+                pos = idx.value
+                if self.slot_decode:
+                    # per-row write: each slot appends at its own depth
+                    row_write = jax.vmap(
+                        lambda c, t, p: lax.dynamic_update_slice(
+                            c, t, (p, 0, 0)))
+                    ck.value = row_write(ck.value, k, pos)
+                    cv.value = row_write(cv.value, v, pos)
+                else:
+                    ck.value = lax.dynamic_update_slice(
+                        ck.value, k, (0, pos, 0, 0))
+                    cv.value = lax.dynamic_update_slice(
+                        cv.value, v, (0, pos, 0, 0))
+                idx.value = pos + s
+                src_k, src_v = ck.value, cv.value
 
             q32 = (q.astype(jnp.float32) / float(head_dim) ** 0.5
                    ).transpose(0, 2, 1, 3)          # [B, H, S, hd]
-            if self.slot_decode:
+            if self.slot_decode or self.paged_decode:
                 qpos = pos[:, None] + jnp.arange(s)  # [B, S] per-row positions
                 last = jnp.max(pos) + s - 1          # deepest filled position
             else:
@@ -139,9 +205,9 @@ class CausalSelfAttention(nn.Module):
                 def active(c):
                     m, l, o, cnt = c
                     k_t = lax.dynamic_slice_in_dim(
-                        ck.value, start, tile, axis=1).astype(jnp.float32)
+                        src_k, start, tile, axis=1).astype(jnp.float32)
                     v_t = lax.dynamic_slice_in_dim(
-                        cv.value, start, tile, axis=1).astype(jnp.float32)
+                        src_v, start, tile, axis=1).astype(jnp.float32)
                     if groups > 1:  # broadcast KV heads over their query group
                         k_t = jnp.repeat(k_t, groups, axis=2)
                         v_t = jnp.repeat(v_t, groups, axis=2)
@@ -172,9 +238,15 @@ class CausalSelfAttention(nn.Module):
             # the caller cannot miss it (host-side raise is not possible for a
             # traced index). In slot mode only the overflowing ROW is poisoned
             # — other slots keep decoding.
-            overflow = (pos + s) > self.max_len
-            if self.slot_decode:
-                overflow = overflow[:, None, None, None]
+            if self.paged_decode:
+                # per-QUERY poison: a suffix prefill's padded bucket may
+                # overshoot max_len while every real query is in range —
+                # only the out-of-range (pad, discarded) queries go NaN
+                overflow = (qpos >= self.max_len)[:, :, None, None]
+            else:
+                overflow = (pos + s) > self.max_len
+                if self.slot_decode:
+                    overflow = overflow[:, None, None, None]
             out = jnp.where(overflow, jnp.nan, out).astype(x.dtype)
         else:
             if groups > 1:
@@ -216,9 +288,13 @@ class DecoderBlock(nn.Module):
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: tuple[str, ...] = ("query", "value")
+    paged_decode: bool = False
+    kv_cache_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
-    def __call__(self, x, train: bool, positions=None):
+    def __call__(self, x, train: bool, positions=None, block_tables=None,
+                 start_pos=None):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         h = CausalSelfAttention(self.num_heads, self.dtype, self.seq_axis,
                                 self.decode, self.max_len,
@@ -227,7 +303,12 @@ class DecoderBlock(nn.Module):
                                 lora_rank=self.lora_rank,
                                 lora_alpha=self.lora_alpha,
                                 lora_targets=self.lora_targets,
-                                name="attn")(h, positions=positions)
+                                paged_decode=self.paged_decode,
+                                kv_cache_blocks=self.kv_cache_blocks,
+                                kv_block_size=self.kv_block_size,
+                                name="attn")(h, positions=positions,
+                                             block_tables=block_tables,
+                                             start_pos=start_pos)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -279,6 +360,14 @@ class TransformerLM(nn.Module):
                              # a serving slot pool, each row at its own depth
                              # (per-row cache/position indices; see
                              # ddw_tpu.serve.slots.SlotPool). Implies decode.
+    paged_decode: bool = False  # paged continuous batching: K/V in a global
+                             # fixed-size-block pool; per-row block tables
+                             # and start positions are passed as ARGUMENTS
+                             # (__call__(tokens, block_tables=, start_pos=))
+                             # so the cache tree is batch-independent — the
+                             # substrate of ddw_tpu.serve.blocks.BlockPool.
+    kv_cache_blocks: int = 0  # paged: pool size (usable blocks + null)
+    kv_block_size: int = 0   # paged: tokens per block (divides the tile)
     num_experts: int = 0     # >0: MoE MLP blocks (expert parallelism via
     expert_axis: str | None = None  # expert_axis inside shard_map)
     capacity_factor: float = 1.25
@@ -298,7 +387,8 @@ class TransformerLM(nn.Module):
                              # decode mode (no backward there).
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, block_tables=None,
+                 start_pos=None):
         if self.lora_rank:
             from ddw_tpu.models.lora import validate_lora_targets
 
@@ -314,7 +404,15 @@ class TransformerLM(nn.Module):
         if self.pos_encoding == "learned":
             pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
                                    (self.max_len, self.hidden), jnp.float32)
-        if self.decode:
+        if self.decode and self.paged_decode:
+            # paged mode: depth is per-request HOST state (the BlockPool's
+            # stream records), handed in per call — no pos_index variable, so
+            # the same cache tree serves a G-row prefill group and the
+            # R-row decode batch without re-init.
+            if start_pos is None:
+                start_pos = jnp.zeros((b,), jnp.int32)
+            offset = start_pos
+        elif self.decode:
             # position = number of tokens already decoded (the attention layers
             # keep per-layer indices; this top-level one feeds the pos embed).
             # Past max_len the attention layers NaN-poison the output (loud
@@ -342,7 +440,7 @@ class TransformerLM(nn.Module):
         else:
             offset = 0
         if self.pos_encoding == "learned":
-            if self.decode and self.slot_decode:
+            if self.decode and (self.slot_decode or self.paged_decode):
                 # per-row gather: row i reads the table at its own depth
                 # (jnp.take clamps out-of-range rows — harmless, attention
                 # NaN-poisons those rows anyway)
@@ -360,7 +458,7 @@ class TransformerLM(nn.Module):
             # = shard_index * s_local, K rotated before the ring) and decode
             # (offset = tokens already written to the cache; [B]-shaped in
             # slot mode, giving [B, S] per-row positions).
-            if self.decode and self.slot_decode:
+            if self.decode and (self.slot_decode or self.paged_decode):
                 positions = offset[:, None] + jnp.arange(s_local)
             else:
                 positions = offset + jnp.arange(s_local)
@@ -379,6 +477,8 @@ class TransformerLM(nn.Module):
             Block = nn.remat(DecoderBlock, static_argnums=(2,), policy=policy)
         else:
             Block = DecoderBlock
+        paged_kw = (dict(block_tables=block_tables, start_pos=start_pos)
+                    if self.paged_decode else {})
         for i in range(self.depth):
             x = Block(self.num_heads, self.mlp_dim, self.dropout,
                       self.dtype, None if self.decode else self.seq_axis,
@@ -392,7 +492,11 @@ class TransformerLM(nn.Module):
                       lora_rank=self.lora_rank,
                       lora_alpha=self.lora_alpha,
                       lora_targets=self.lora_targets,
-                      name=f"backbone_block{i}")(x, train, positions)
+                      paged_decode=self.paged_decode,
+                      kv_cache_blocks=self.kv_cache_blocks,
+                      kv_block_size=self.kv_block_size,
+                      name=f"backbone_block{i}")(x, train, positions,
+                                                 **paged_kw)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # vocab head in f32: logits feed a softmax CE, keep full precision
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)

@@ -29,4 +29,5 @@ from ddw_tpu.serve.metrics import (  # noqa: F401
     RequestRecord,
     render_prometheus,
 )
+from ddw_tpu.serve.blocks import BlockPool  # noqa: F401
 from ddw_tpu.serve.slots import SlotPool  # noqa: F401

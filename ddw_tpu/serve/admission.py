@@ -154,6 +154,25 @@ class AdmissionController:
                 return None
             return self._clock() - q[0].times.submitted
 
+    def peek(self, kind: str):
+        """The head-of-line request without dequeuing it (None when
+        empty) — the paged engine's admission loop inspects the head's
+        block budget before committing to pop it."""
+        with self._lock:
+            q = self._queues.get(kind)
+            return q[0] if q else None
+
+    def requeue_front(self, kind: str, request) -> None:
+        """Put a request back at the HEAD of its queue, bypassing the
+        capacity bound — the preemption path (a stream evicted mid-decode
+        for blocks was already admitted once; bouncing it off a full door
+        would turn backpressure into data loss). Oldest-first order is
+        preserved: the preempted request re-admits before anything that
+        arrived after it."""
+        with self._lock:
+            self._queues.setdefault(
+                kind, collections.deque()).appendleft(request)
+
     def offer(self, kind: str, request,
               retry_after_ms: float | None = None) -> None:
         """Enqueue or raise :class:`Overloaded`. The capacity bound is

@@ -1,0 +1,556 @@
+"""Paged KV-cache pool — block tables + copy-on-write prefix reuse.
+
+:class:`~ddw_tpu.serve.slots.SlotPool` reserves a contiguous ``max_len``
+strip of K/V per resident stream, so concurrent-stream capacity is bounded
+by the WORST-CASE length even when every live request is short. This module
+is the vLLM-style (arXiv 2309.06180) replacement: K/V lives in ONE global
+pool of fixed ``block_size``-token blocks, each resident stream holds a
+*block table* (gather indices into the pool), and capacity is bounded by
+actual usage — at equal cache memory the pool admits as many streams as
+their true lengths fit, not ``memory / max_len``.
+
+Three device programs over the pool (``TransformerLM(paged_decode=True)``;
+per-row depth and tables are call ARGUMENTS, so the same batch-independent
+cache tree serves them all):
+
+- **prefill**: one bucketed forward of a group of new requests' prompt
+  *suffixes* — a request whose prompt prefix is already cached starts at
+  its hit offset and only computes (and writes) the uncovered tail;
+- **decode**: ONE donated ``lax.scan``-chained program advances every
+  resident row ``steps_per_tick`` tokens per dispatch, gathering each
+  row's K/V through its block table;
+- **copy**: clone one block — the copy-on-write primitive.
+
+Attention gathers a row's blocks back into the contiguous ``[cap]`` layout
+and runs the exact tile loop of the contiguous path, so paged outputs are
+**bit-identical** to sequential :func:`ddw_tpu.models.lm.generate` (pinned
+by tests/test_paged_kv.py for greedy and seeded sampling).
+
+Prefix cache + copy-on-write: prompt blocks are content-addressed by a
+per-block chain hash (block j's key commits to every token before it, so a
+hit can only be a true prefix match at the same positions — and K/V is a
+deterministic function of tokens+positions+params, so hit content is
+bit-identical to recomputation). FULL blocks that the new request will
+never write are shared by refcount; a block the request WILL write into
+(the partial tail, or the last-token recompute slot) is cloned on device
+instead (``cow_copies``) — the invariant is that no stream ever writes a
+block with ``ref > 1``, so divergence after a shared prefix can never
+corrupt a sibling. Finished streams decref their blocks; unreferenced
+registered blocks park in an LRU of idle cached blocks (still hittable,
+reclaimed on allocation pressure), unregistered ones free immediately.
+
+Out-of-blocks mid-decode (only reachable with ``overcommit > 1`` — the
+default admission budget counts every stream's worst-case remaining blocks
+as committed): the tick allocator preempts the YOUNGEST stream(s) by
+recompute — blocks released, request re-queued at the queue head; on
+re-admission its already-picked tokens are folded into the prompt and the
+per-step key schedule resumes at the same index, so the resumed stream is
+token-identical and never re-emits (vLLM's recompute preemption).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ddw_tpu.models.lm import TransformerLM, init_cache
+from ddw_tpu.serve.slots import _pick
+
+
+class OutOfBlocks(RuntimeError):
+    """Internal: the free list AND the idle prefix cache are exhausted."""
+
+
+class _Stream:
+    """One resident request's pool-side state (host bookkeeping only)."""
+
+    __slots__ = ("row", "blocks", "prompt_len", "filled", "total", "seq")
+
+    def __init__(self, row: int, prompt_len: int, total: int, seq: int):
+        self.row = row
+        self.blocks: list[int] = []   # physical block ids, table order
+        self.prompt_len = prompt_len  # effective prompt (incl. resumed toks)
+        self.filled = 0               # cache positions holding valid K/V
+        self.total = total            # positions ever needed: P + steps - 1
+        self.seq = seq                # admission order (preemption victims
+        #                               are picked youngest-first)
+
+
+class BlockPool:
+    """Paged continuous-batching cache pool over a
+    :class:`~ddw_tpu.models.lm.TransformerLM`.
+
+    ``n_blocks`` is the USABLE block count (one extra null block is
+    allocated device-side — unallocated table entries and overshoot writes
+    route there); ``max_resident`` bounds the decode batch dimension (rows
+    are cheap — a row is just host indices — so this is a compute knob,
+    not a memory one). ``overcommit`` scales the admission budget: 1.0
+    (default) is fully conservative — every stream's worst-case remaining
+    blocks are pre-committed, so mid-decode allocation can never fail;
+    > 1.0 oversubscribes and relies on preemption.
+    """
+
+    def __init__(self, model: TransformerLM, params, n_blocks: int,
+                 block_size: int, max_resident: int,
+                 steps_per_tick: int = 4, donate: bool = True,
+                 overcommit: float = 1.0):
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        if max_resident < 1:
+            raise ValueError(
+                f"max_resident must be >= 1, got {max_resident}")
+        if steps_per_tick < 1:
+            raise ValueError(
+                f"steps_per_tick must be >= 1, got {steps_per_tick}")
+        tile = min(256, model.max_len)
+        if block_size < 1 or tile % block_size:
+            raise ValueError(
+                f"block_size {block_size} must divide the attention tile "
+                f"{tile} (= min(256, max_len)) — the gathered block view "
+                f"must reproduce the contiguous cache layout exactly")
+        if overcommit < 1.0:
+            raise ValueError(f"overcommit must be >= 1, got {overcommit}")
+        self.block_size = block_size
+        self.n_blocks = n_blocks          # usable (null excluded)
+        self.max_resident = max_resident
+        self.steps_per_tick = steps_per_tick
+        self.max_len = model.max_len
+        self.overcommit = overcommit
+        self.params = params
+        self._donate = donate
+        cap = -(-model.max_len // tile) * tile
+        self.n_tbl = cap // block_size    # block-table width (cap coverage)
+        self._cap = cap
+        self._model = model.clone(decode=True, slot_decode=False,
+                                  paged_decode=True,
+                                  kv_cache_blocks=n_blocks + 1,
+                                  kv_block_size=block_size,
+                                  seq_axis=None, dropout=0.0)
+        self.cache = init_cache(self._model, 1)
+        self._prefill_jit: dict[tuple, object] = {}   # by (group, suffix len)
+        self._decode_jit: dict[int, object] = {}      # by chain length k
+        don = (0,) if donate else ()
+        self._copy = jax.jit(self._copy_fn, donate_argnums=don)
+        self._reset_host()
+
+    # -- host accounting ------------------------------------------------------
+    def _reset_host(self) -> None:
+        # block 0 is the reserved null block: never allocated, catches
+        # unallocated-table-entry and overshoot writes
+        self._free = list(range(self.n_blocks, 0, -1))   # pop() -> block 1
+        self._ref = np.zeros(self.n_blocks + 1, np.int64)
+        self._free_rows = list(range(self.max_resident - 1, -1, -1))
+        self._streams: dict[int, _Stream] = {}
+        self._committed = 0           # worst-case blocks still owed to
+        #                               resident streams (admission budget)
+        self._seq = 0
+        self._full_map: dict[bytes, int] = {}     # chain hash -> block
+        self._tail_map: dict[tuple, int] = {}     # (chain, tail) -> block
+        self._block_keys: dict[int, list] = {}    # block -> its map keys
+        self._cached: collections.OrderedDict[int, bool] = \
+            collections.OrderedDict()             # idle registered, LRU
+        self.stats = {"prefix_hit_tokens": 0, "prefix_hit_blocks": 0,
+                      "prefix_miss_blocks": 0, "cow_copies": 0,
+                      "preemptions": 0}
+
+    def reset(self) -> None:
+        """Fresh device + host state after an engine failure (the
+        :meth:`SlotPool.reset` contract): compiled programs are kept, so a
+        supervisor restart rejoins warm."""
+        self.cache = init_cache(self._model, 1)
+        self._reset_host()
+
+    @property
+    def free_slots(self) -> int:
+        """Free resident ROWS (the engine health view's slot analogue)."""
+        return len(self._free_rows)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def free_blocks_effective(self) -> int:
+        """Free + idle-cached (reclaimable on pressure)."""
+        return len(self._free) + len(self._cached)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-max(n_tokens, 0) // self.block_size)
+
+    def total_positions(self, prompt_len: int, num_steps: int) -> int:
+        """Cache positions a request ever writes: the prompt plus every
+        generated token EXCEPT the last (picked, never fed back)."""
+        return prompt_len + num_steps - 1
+
+    def can_admit(self, prompt_len: int, num_steps: int) -> bool:
+        """Admission on free BLOCKS, not free rows: conservative — counts
+        the request's worst-case need against free-minus-committed (prefix
+        hits only ever help). ``overcommit`` scales the budget."""
+        if not self._free_rows:
+            return False
+        need = self.blocks_for(self.total_positions(prompt_len, num_steps))
+        budget = self.free_blocks_effective * self.overcommit
+        return budget - self._committed >= need
+
+    def min_remaining_steps(self) -> int | None:
+        """Fewest cache positions any resident stream still needs — the
+        basis of the projected-block-release ``retry_after_ms`` hint."""
+        if not self._streams:
+            return None
+        return min(st.total - st.filled for st in self._streams.values())
+
+    def gauges(self) -> dict[str, float]:
+        used = self.n_blocks - len(self._free) - len(self._cached)
+        toks = sum(st.filled for st in self._streams.values())
+        return {
+            "blocks_total": float(self.n_blocks),
+            "blocks_free": float(len(self._free)),
+            "blocks_cached": float(len(self._cached)),
+            "blocks_used": float(used),
+            "block_tokens_used": float(toks),
+            "block_tokens_capacity": float(used * self.block_size),
+            "resident_streams": float(len(self._streams)),
+        }
+
+    # -- allocator ------------------------------------------------------------
+    def _alloc(self) -> int:
+        if self._free:
+            blk = self._free.pop()
+        elif self._cached:
+            blk, _ = self._cached.popitem(last=False)   # LRU reclaim
+            self._unregister(blk)
+        else:
+            raise OutOfBlocks("block pool exhausted")
+        self._ref[blk] = 1
+        return blk
+
+    def _incref(self, blk: int) -> None:
+        if self._ref[blk] == 0:       # idle cached -> active again
+            self._cached.pop(blk, None)
+        self._ref[blk] += 1
+
+    def _decref(self, blk: int) -> None:
+        self._ref[blk] -= 1
+        if self._ref[blk] < 0:
+            raise AssertionError(f"block {blk} refcount underflow")
+        if self._ref[blk] == 0:
+            if blk in self._block_keys:
+                # still content-addressed: park idle (hittable), reclaim LRU
+                self._cached[blk] = True
+            else:
+                self._free.append(blk)
+
+    def _unregister(self, blk: int) -> None:
+        for kind, key in self._block_keys.pop(blk, ()):
+            m = self._full_map if kind == "full" else self._tail_map
+            if m.get(key) == blk:
+                del m[key]
+
+    # -- prefix cache ---------------------------------------------------------
+    def _chain_hashes(self, prompt: np.ndarray) -> list[bytes]:
+        """Per-full-block chain hashes: ``h[j]`` commits to tokens
+        ``[0, (j+1)*bs)`` — equal hashes mean equal tokens at equal
+        positions, which (K/V being deterministic in tokens+positions+
+        params) means bit-identical block content."""
+        bs = self.block_size
+        out, h = [], b""
+        for j in range(len(prompt) // bs):
+            h = hashlib.sha1(h + prompt[j * bs:(j + 1) * bs].tobytes()
+                             ).digest()
+            out.append(h)
+        return out
+
+    def lookup(self, prompt: np.ndarray) -> int:
+        """Longest cached prefix (tokens) WITHOUT mutating state — capped
+        at ``P - 1`` so at least one real token always prefills (its
+        logits pick the first output token)."""
+        bs = self.block_size
+        p = len(prompt)
+        hashes = self._chain_hashes(prompt)
+        hit = 0
+        for j, h in enumerate(hashes):
+            if self._full_map.get(h) is None:
+                break
+            hit = (j + 1) * bs
+        full = p // bs
+        if hit == full * bs and p % bs:
+            chain = hashes[full - 1] if full else b""
+            if (chain, prompt[full * bs:].tobytes()) in self._tail_map:
+                hit = p
+        return min(hit, p - 1)
+
+    def admit(self, prompt: np.ndarray, num_steps: int,
+              seq_hint: int | None = None) -> tuple[int, int]:
+        """Claim a row and the prompt's blocks for one request. Prefix-hit
+        FULL blocks the request never writes are shared by refcount; the
+        block holding the first written position (``hit`` onward) is cloned
+        (CoW) when hit; the rest allocate fresh. Returns ``(row, hit)`` —
+        the engine prefills only ``prompt[hit:]``. The caller must have
+        checked :meth:`can_admit` (raises :class:`OutOfBlocks` otherwise —
+        a clean unwind, nothing leaked)."""
+        bs = self.block_size
+        p = len(prompt)
+        if p < 1:
+            raise ValueError("empty prompt")
+        if not self._free_rows:
+            raise RuntimeError("no free resident rows")
+        hit = self.lookup(prompt)
+        hashes = self._chain_hashes(prompt)
+        st = _Stream(self._free_rows[-1], p,
+                     self.total_positions(p, num_steps), self._seq)
+        blocks: list[int] = []
+        try:
+            # shared full hit blocks: everything strictly before the first
+            # written position's block
+            n_shared = hit // bs
+            for j in range(n_shared):
+                blk = self._full_map[hashes[j]]
+                self._incref(blk)
+                blocks.append(blk)
+            # the partial tail hit (if any) is WRITTEN from position `hit`
+            # onward -> clone, never share (the no-write-at-ref>1
+            # invariant). hit % bs != 0 implies hit == p - 1 (lookup only
+            # returns block multiples or the clamped p - 1), leaving two
+            # sources: the clamped full-coverage case clones the LAST FULL
+            # block (suffix = the recomputed final token), a tail-map hit
+            # clones the registered partial tail.
+            if hit % bs:
+                j = hit // bs
+                if p % bs == 0:
+                    src = self._full_map[hashes[j]]
+                else:
+                    chain = hashes[j - 1] if j else b""
+                    src = self._tail_map[(chain, prompt[j * bs:].tobytes())]
+                dst = self._alloc()
+                self.cache = self._copy(self.cache, jnp.int32(dst),
+                                        jnp.int32(src))
+                self.stats["cow_copies"] += 1
+                blocks.append(dst)
+            # fresh blocks for the uncovered prompt tail
+            n_prompt = self.blocks_for(p)
+            fresh = n_prompt - len(blocks)
+            for _ in range(fresh):
+                blocks.append(self._alloc())
+        except OutOfBlocks:
+            for blk in blocks:
+                self._decref(blk)
+            raise
+        hit_blocks = n_shared + (1 if hit % bs else 0)
+        self.stats["prefix_hit_tokens"] += hit
+        self.stats["prefix_hit_blocks"] += hit_blocks
+        self.stats["prefix_miss_blocks"] += len(blocks) - hit_blocks
+        st.blocks = blocks
+        row = self._free_rows.pop()
+        assert row == st.row
+        self._seq += 1
+        self._committed += self.blocks_for(st.total) - len(blocks)
+        self._streams[row] = st
+        return row, hit
+
+    def register(self, row: int, prompt: np.ndarray) -> None:
+        """Publish the row's prompt blocks into the prefix cache — call
+        AFTER its prefill fetched (content is on device). Keep-first: a
+        hash already mapped stays mapped (refcounts remain consistent
+        either way; first-writer wins)."""
+        bs = self.block_size
+        st = self._streams[row]
+        hashes = self._chain_hashes(prompt)
+        for j, h in enumerate(hashes):
+            blk = st.blocks[j]
+            if h not in self._full_map:
+                self._full_map[h] = blk
+                self._block_keys.setdefault(blk, []).append(("full", h))
+        t = len(prompt) % bs
+        if t:
+            j = len(prompt) // bs
+            chain = hashes[j - 1] if j else b""
+            key = (chain, prompt[j * bs:].tobytes())
+            blk = st.blocks[j]
+            if key not in self._tail_map:
+                self._tail_map[key] = blk
+                self._block_keys.setdefault(blk, []).append(("tail", key))
+
+    def note_prefilled(self, row: int) -> None:
+        """Prefill wrote the prompt: the row's valid depth is its prompt
+        length (bucket-pad garbage beyond it is overwritten write-before-
+        read by decode, exactly the contiguous path's discipline)."""
+        st = self._streams[row]
+        st.filled = st.prompt_len
+
+    def release(self, row: int, preempted: bool = False) -> None:
+        """Return a finished (or preempted) stream's row and blocks.
+        Unregistered blocks free IMMEDIATELY; registered ones park in the
+        idle prefix cache until allocation pressure reclaims them."""
+        st = self._streams.pop(row)
+        self._committed -= self.blocks_for(st.total) - len(st.blocks)
+        for blk in st.blocks:
+            self._decref(blk)
+        self._free_rows.append(row)
+        if preempted:
+            self.stats["preemptions"] += 1
+
+    # -- decode-tick allocation (+ preemption policy) -------------------------
+    def _extend(self, st: _Stream, k: int) -> None:
+        writes = min(k, st.total - st.filled)
+        if writes <= 0:
+            return
+        need = (st.filled + writes - 1) // self.block_size + 1
+        while len(st.blocks) < need:
+            st.blocks.append(self._alloc())
+            self._committed -= 1
+
+    def prepare_tick(self, k: int) -> list[int]:
+        """On-demand allocation for one decode tick: every resident stream
+        gets blocks covering its next ``min(k, remaining)`` writes. On
+        exhaustion the YOUNGEST stream is preempted (blocks released, row
+        freed) and allocation retries — oldest streams always make
+        progress, so the policy cannot livelock. Returns the preempted
+        rows; the engine re-queues their requests at the queue head."""
+        victims: list[int] = []
+        for st in sorted(self._streams.values(), key=lambda s: s.seq):
+            while st.row in self._streams:
+                try:
+                    self._extend(st, k)
+                    break
+                except OutOfBlocks:
+                    live = [s for s in self._streams.values() if s is not st]
+                    victim = (max(live, key=lambda s: s.seq)
+                              if live else st)
+                    self.release(victim.row, preempted=True)
+                    victims.append(victim.row)
+                    if victim is st:
+                        break
+        return victims
+
+    # -- device programs ------------------------------------------------------
+    def table(self, row: int) -> np.ndarray:
+        out = np.zeros((self.n_tbl,), np.int32)
+        st = self._streams[row]
+        out[:len(st.blocks)] = st.blocks
+        return out
+
+    def _tables_starts(self, rows) -> tuple[np.ndarray, np.ndarray]:
+        tables = np.zeros((len(rows), self.n_tbl), np.int32)
+        starts = np.zeros((len(rows),), np.int32)
+        for i, row in enumerate(rows):
+            st = self._streams.get(row) if row is not None else None
+            if st is not None:
+                tables[i, :len(st.blocks)] = st.blocks
+                starts[i] = st.filled
+        return tables, starts
+
+    def prefill(self, rows, padded_suffixes, true_lens, temps, keys):
+        """One grouped suffix-prefill dispatch: ``padded_suffixes [G, S]``
+        (same suffix-length bucket), ``rows`` the claimed resident rows
+        (``None`` = dummy pad row -> null table), per-row true suffix
+        lengths / temperatures / sample keys. Each row's forward starts at
+        its stream's hit offset and writes straight into its blocks; the
+        returned ``first_tokens [G]`` are picked from the last REAL suffix
+        position's logits (bit-identical to a full prefill — the cached
+        prefix K/V it attends is bit-identical by construction)."""
+        padded_suffixes = jnp.asarray(padded_suffixes, jnp.int32)
+        g, length = padded_suffixes.shape
+        tables, starts = self._tables_starts(rows)
+        # starts for prefill are the HIT offsets, not filled (filled is 0
+        # until note_prefilled); hit = prompt_len - true suffix len
+        for i, row in enumerate(rows):
+            if row is not None:
+                starts[i] = (self._streams[row].prompt_len
+                             - int(true_lens[i]))
+        fn = self._prefill_jit.get((g, length))
+        if fn is None:
+            model = self._model
+
+            def prefill_fn(cache, toks, tables, starts, true_lens, temps,
+                           keys):
+                logits, vars_ = model.apply(
+                    {"params": self.params, "cache": cache}, toks,
+                    block_tables=tables, start_pos=starts,
+                    mutable=["cache"])
+                last = jnp.take_along_axis(
+                    logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+                return vars_["cache"], _pick(last, temps, keys)
+
+            fn = self._prefill_jit[(g, length)] = jax.jit(
+                prefill_fn, donate_argnums=(0,) if self._donate else ())
+        self.cache, toks = fn(self.cache, padded_suffixes,
+                              jnp.asarray(tables), jnp.asarray(starts),
+                              jnp.asarray(true_lens, jnp.int32),
+                              jnp.asarray(temps, jnp.float32),
+                              jnp.asarray(keys))
+        return np.asarray(toks)
+
+    def decode(self, tokens, temperatures, keys) -> np.ndarray:
+        """Advance EVERY resident row ``steps_per_tick`` tokens in one
+        donated chained dispatch (``tokens [R]`` current per-row token,
+        ``temperatures [R]``, ``keys [R, k, 2]``). Free rows decode a
+        dummy token against the null block. Block tables must already
+        cover the tick (:meth:`prepare_tick`). Returns ``[R, k]``."""
+        k = self.steps_per_tick
+        rows = list(range(self.max_resident))
+        tables, starts = self._tables_starts(rows)
+        fn = self._decode_jit.get(k)
+        if fn is None:
+            model = self._model
+
+            def chain(cache, tok, starts, tables, temps, keys_sk):
+                def body(carry, key_s):
+                    cache, tok, pos = carry
+                    logits, vars_ = model.apply(
+                        {"params": self.params, "cache": cache},
+                        tok[:, None], block_tables=tables, start_pos=pos,
+                        mutable=["cache"])
+                    nxt = _pick(logits[:, 0], temps, key_s)
+                    return (vars_["cache"], nxt, pos + 1), nxt
+
+                (cache, _, _), toks = lax.scan(
+                    body, (cache, tok, starts),
+                    jnp.swapaxes(keys_sk, 0, 1))
+                return cache, jnp.swapaxes(toks, 0, 1)   # [R, k]
+
+            fn = self._decode_jit[k] = jax.jit(
+                chain, donate_argnums=(0,) if self._donate else ())
+        self.cache, toks = fn(self.cache, jnp.asarray(tokens, jnp.int32),
+                              jnp.asarray(starts), jnp.asarray(tables),
+                              jnp.asarray(temperatures, jnp.float32),
+                              jnp.asarray(keys))
+        for st in self._streams.values():
+            st.filled = min(st.filled + k, st.total)
+        return np.asarray(toks)
+
+    def warmup(self, buckets, max_group: int = 0) -> None:
+        """Precompile the paged program lattice: one suffix prefill per
+        (bucket, power-of-two group), the decode chain, and the CoW copy.
+        Warmup rows use the null table, so every write lands in the null
+        block — pool state stays clean, no reset needed."""
+        cap_g = max_group or min(8, self.max_resident)
+        for bucket in sorted(set(buckets)):
+            g = 1
+            while True:
+                self.prefill([None] * g, np.zeros((g, bucket), np.int32),
+                             np.ones((g,), np.int32),
+                             np.zeros((g,), np.float32),
+                             np.zeros((g, 2), np.uint32))
+                if g >= cap_g:
+                    break
+                g = min(g * 2, cap_g)
+        self.decode(np.zeros((self.max_resident,), np.int32),
+                    np.zeros((self.max_resident,), np.float32),
+                    np.zeros((self.max_resident, self.steps_per_tick, 2),
+                             np.uint32))
+        self.cache = self._copy(self.cache, jnp.int32(0), jnp.int32(0))
+
+    # -- jitted bodies --------------------------------------------------------
+    @staticmethod
+    def _copy_fn(cache, dst, src):
+        def fix(leaf):
+            if leaf.ndim == 0:
+                return leaf       # tiles_computed counter
+            return leaf.at[dst].set(leaf[src])
+
+        return jax.tree.map(fix, cache)

@@ -6,13 +6,18 @@ at a time. This module is the online half of the capability table — an
 in-process engine that admits concurrent image and LM requests and keeps
 the device busy with a small, fixed set of compiled programs:
 
-- **LM**: continuous batching over a :class:`~ddw_tpu.serve.slots.SlotPool`.
-  New requests prefill into a free slot the moment one exists (bucketed
-  prompt lengths — one program per bucket); every engine tick advances ALL
-  active slots ``steps_per_tick`` tokens in one chained, donated dispatch;
-  finished sequences evict without stalling their neighbors. Outputs are
-  token-identical to the sequential ``generate`` path for any admission
-  interleaving (pinned by tests/test_serve_engine.py).
+- **LM**: continuous batching over a paged
+  :class:`~ddw_tpu.serve.blocks.BlockPool` (default — fixed-size KV
+  blocks, per-stream block tables, prefix reuse with copy-on-write;
+  admission counts free BLOCKS, so capacity follows actual usage) or the
+  contiguous :class:`~ddw_tpu.serve.slots.SlotPool` baseline
+  (``EngineCfg(paged=False)``). New requests prefill the moment capacity
+  exists (bucketed prompt/suffix lengths — one program per bucket); every
+  engine tick advances ALL active streams ``steps_per_tick`` tokens in
+  one chained, donated dispatch; finished sequences evict without
+  stalling their neighbors. Outputs are token-identical to the sequential
+  ``generate`` path for any admission interleaving (pinned by
+  tests/test_serve_engine.py and tests/test_paged_kv.py).
 - **image**: classic dynamic batching — requests coalesce until
   ``max_batch`` are waiting or the oldest has waited ``max_wait_ms``, the
   batch pads to a power-of-two bucket, and one jitted apply serves it.
@@ -73,6 +78,7 @@ import numpy as np
 from ddw_tpu.runtime.faults import ServeCrash, maybe_serve_fault
 from ddw_tpu.serve.admission import (AdmissionController, DeadlineExceeded,
                                      Overloaded, ReplicaFailed)
+from ddw_tpu.serve.blocks import BlockPool, OutOfBlocks
 from ddw_tpu.serve.bucketing import (batch_bucket, bucket_len, pad_to_bucket)
 from ddw_tpu.serve.metrics import EngineMetrics, RequestRecord
 from ddw_tpu.serve.slots import SlotPool
@@ -106,6 +112,21 @@ class EngineCfg:
     max_consecutive_errors: int = 3   # recoverable loop errors in a row
     #                                   before the replica turns terminal
     #                                   FAILED (clean work resets the count)
+    # paged KV cache (ddw_tpu.serve.blocks.BlockPool) — the default pool.
+    # paged=False falls back to the contiguous per-slot pool (the baseline
+    # tools/serving_curve.py measures against).
+    paged: bool = True
+    kv_block_size: int = 16     # tokens per KV block (must divide the
+    #                             attention tile, min(256, max_len))
+    kv_cache_blocks: int = 0    # total usable blocks; 0 = EQUAL KV MEMORY
+    #                             to the slot baseline (n_slots * cache
+    #                             capacity / block_size) — same bytes, more
+    #                             streams
+    max_resident: int = 0       # decode-batch rows; 0 = 2 * n_slots (rows
+    #                             are host indices — compute knob, not
+    #                             memory)
+    block_overcommit: float = 1.0  # >1 oversubscribes the block budget and
+    #                             relies on mid-decode preemption (tests)
 
 
 @dataclasses.dataclass
@@ -140,7 +161,8 @@ class _Times:
 
 class _LMRequest:
     __slots__ = ("prompt", "num_steps", "temperature", "keys", "deadline",
-                 "future", "times", "tokens", "emitted", "on_token")
+                 "future", "times", "tokens", "emitted", "on_token",
+                 "claimed")
 
     def __init__(self, prompt, num_steps, temperature, keys, deadline, now,
                  on_token=None):
@@ -154,6 +176,28 @@ class _LMRequest:
         self.tokens: list[int] = []
         self.emitted = 0
         self.on_token = on_token    # (index, token) -> None, engine thread
+        self.claimed = False        # future transitioned to RUNNING (set
+        #                             once; a preempted-and-requeued request
+        #                             must not re-claim)
+
+    def effective_prompt(self) -> np.ndarray:
+        """The prompt a (re-)prefill must run: the original tokens plus
+        everything already picked EXCEPT the newest pick — that one is
+        re-derived from the prefill logits with its original per-step key,
+        so a preempted stream resumes bit-identically without re-emitting
+        (vLLM-style recompute preemption)."""
+        if not self.emitted:
+            return self.prompt
+        return np.concatenate([
+            self.prompt,
+            np.asarray(self.tokens[:self.emitted - 1], np.int32)])
+
+    def pick_key(self) -> np.ndarray:
+        """Sample key for the prefill-time pick: step 0 for a fresh
+        request, the resumed step's own key after a preemption."""
+        if self.keys is None:
+            return np.zeros((2,), np.uint32)
+        return self.keys[max(self.emitted - 1, 0)]
 
     def emit(self, start: int) -> None:
         """Stream tokens[start:] to the callback; a broken callback stops
@@ -168,13 +212,14 @@ class _LMRequest:
 
 
 class _ImageRequest:
-    __slots__ = ("image", "deadline", "future", "times")
+    __slots__ = ("image", "deadline", "future", "times", "claimed")
 
     def __init__(self, image, deadline, now):
         self.image = image
         self.deadline = deadline
         self.future = concurrent.futures.Future()
         self.times = _Times(now)
+        self.claimed = False
 
 
 class ServingEngine:
@@ -202,6 +247,9 @@ class ServingEngine:
         self._monitor = None
         self._monitor_interval_s = monitor_interval_s
         self._service_ms = 0.0      # decaying per-request service estimate
+        self._per_token_ms = 0.0    # decaying per-generated-token estimate
+        #                             (feeds the projected-block-release
+        #                             retry_after_ms hint on the paged pool)
 
         # failure containment (ReplicaFailed semantics in the module doc)
         self.replica_id = replica_id
@@ -222,16 +270,32 @@ class ServingEngine:
 
         self._lm = lm.engine_handle() if hasattr(lm, "engine_handle") else lm
         if self._lm is not None:
-            self.pool = SlotPool(self._lm.model, self._lm.params,
-                                 self.cfg.n_slots,
-                                 steps_per_tick=self.cfg.steps_per_tick,
-                                 donate=self.cfg.donate)
-            n = self.cfg.n_slots
+            if self.cfg.paged:
+                model = self._lm.model
+                tile = min(256, model.max_len)
+                cap = -(-model.max_len // tile) * tile
+                n_blocks = self.cfg.kv_cache_blocks or (
+                    self.cfg.n_slots * cap // self.cfg.kv_block_size)
+                n = self.cfg.max_resident or 2 * self.cfg.n_slots
+                self.pool = BlockPool(
+                    model, self._lm.params, n_blocks=n_blocks,
+                    block_size=self.cfg.kv_block_size, max_resident=n,
+                    steps_per_tick=self.cfg.steps_per_tick,
+                    donate=self.cfg.donate,
+                    overcommit=self.cfg.block_overcommit)
+            else:
+                self.pool = SlotPool(self._lm.model, self._lm.params,
+                                     self.cfg.n_slots,
+                                     steps_per_tick=self.cfg.steps_per_tick,
+                                     donate=self.cfg.donate)
+                n = self.cfg.n_slots
+            self._n_rows = n
             self._slot_req: dict[int, _LMRequest] = {}
             self._cur = np.zeros((n,), np.int32)
             self._temps = np.zeros((n,), np.float32)
         else:
             self.pool = None
+        self._pool_stats_seen: dict[str, int] = {}
 
         self._image = (image.engine_handle()
                        if hasattr(image, "engine_handle") else image)
@@ -375,6 +439,7 @@ class ServingEngine:
             self._cur[:] = 0
             self._temps[:] = 0.0
             self.pool.reset()
+            self._sync_pool_stats()
         self._stopped = False
         self._draining.clear()
         return self.start()
@@ -498,6 +563,15 @@ class ServingEngine:
             raise ValueError(
                 f"prompt {prompt.size} + steps {num_steps} exceeds max_len "
                 f"{self._lm.cfg.max_len}")
+        if isinstance(self.pool, BlockPool):
+            need = self.pool.blocks_for(
+                self.pool.total_positions(prompt.size, num_steps))
+            if need > self.pool.n_blocks:
+                # would wedge the queue head forever — no release can
+                # ever satisfy it
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool only "
+                    f"has {self.pool.n_blocks}")
         if temperature < 0.0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if temperature > 0.0 and rng is None:
@@ -545,9 +619,13 @@ class ServingEngine:
         so no live request pays XLA compile time. Call before submitting —
         it drives the device from the caller's thread."""
         if self.pool is not None:
-            self.pool.warmup([bucket_len(n, self._lm.cfg.max_len,
-                                         self.cfg.min_bucket)
-                              for n in prompt_lens])
+            buckets = [bucket_len(n, self._lm.cfg.max_len,
+                                  self.cfg.min_bucket) for n in prompt_lens]
+            if isinstance(self.pool, BlockPool):
+                self.pool.warmup(buckets,
+                                 max_group=self.pool.max_resident)
+            else:
+                self.pool.warmup(buckets)
         if self._image is not None:
             h = self._image
             sizes, g = [], 1
@@ -574,14 +652,29 @@ class ServingEngine:
                              self._ctrl.depth(kind),
                              retry_after_ms=self._service_ms or 100.0)
         try:
-            self._ctrl.offer(kind, req, retry_after_ms=(
-                self._service_ms * (self._ctrl.depth(kind) + 1)
-                if self._service_ms else None))
+            self._ctrl.offer(kind, req,
+                             retry_after_ms=self._retry_hint_ms(kind))
         except Overloaded:
             self.metrics.count_overloaded()
             raise
         with self._cv:
             self._cv.notify_all()
+
+    def _retry_hint_ms(self, kind: str) -> float | None:
+        """``Overloaded.retry_after_ms``: on the paged pool the hint is the
+        PROJECTED BLOCK-RELEASE time — the earliest resident stream's
+        remaining steps at the measured per-token rate (blocks free the
+        moment it completes), plus the queue ahead at the per-request
+        rate. The slot pool keeps the coarser depth * service estimate."""
+        depth_ms = (self._service_ms * (self._ctrl.depth(kind) + 1)
+                    if self._service_ms else None)
+        if kind != "lm" or not isinstance(self.pool, BlockPool):
+            return depth_ms
+        remaining = self.pool.min_remaining_steps()
+        if remaining is None or not self._per_token_ms:
+            return depth_ms
+        return (remaining * self._per_token_ms
+                + (self._service_ms * self._ctrl.depth(kind)))
 
     def _fail_pending(self, exc: Exception) -> None:
         for kind in ("lm", "image"):
@@ -608,8 +701,12 @@ class ServingEngine:
     def _claim(self, req) -> bool:
         """Transition a dequeued request to running; a False return means
         the caller cancelled it while queued — drop it here, BEFORE any
-        device work, and count the drop."""
+        device work, and count the drop. A preempted-and-requeued request
+        is already RUNNING (claimed once) and passes straight through."""
+        if getattr(req, "claimed", False):
+            return True
         if req.future.set_running_or_notify_cancel():
+            req.claimed = True
             return True
         self.metrics.count_cancelled()
         return False
@@ -686,6 +783,7 @@ class ServingEngine:
             self._cur[:] = 0
             self._temps[:] = 0.0
             self.pool.reset()
+            self._sync_pool_stats()
         if self._consecutive_errors >= self.cfg.max_consecutive_errors:
             crash = ServeCrash(
                 f"replica {self.replica_id} exhausted its error budget "
@@ -779,9 +877,120 @@ class ServingEngine:
                           should_abort=self._stop.is_set)
 
     # LM: continuous batching ------------------------------------------------
+    def _sync_pool_stats(self) -> None:
+        """Mirror the paged pool's monotonic stats into the engine metrics
+        (delta-based so a pool reset() never rolls a counter back) and push
+        the live block gauges."""
+        pool = self.pool
+        if not isinstance(pool, BlockPool):
+            return
+        for key, val in pool.stats.items():
+            seen = self._pool_stats_seen.get(key, 0)
+            delta = val - seen if val >= seen else val   # reset() rebase
+            if delta > 0:
+                self.metrics.count(key, delta)
+            self._pool_stats_seen[key] = val
+        self.metrics.set_gauges(pool.gauges())
+
+    def _admit_lm_paged(self) -> bool:
+        """Admission on free BLOCKS: pop queued requests head-first while
+        the pool's conservative block budget accepts them (head-of-line
+        blocking is deliberate — skipping ahead would starve long prompts),
+        then prefill each request's uncovered SUFFIX in per-bucket groups.
+        Prefix-hit tokens never touch the device."""
+        pool = self.pool
+        worked = False
+        if self._ctrl.depth("lm") > 0 and pool.free_slots > 0:
+            self._fault("admit")     # admission boundary: nothing claimed
+            #                          yet, queued work stays salvageable
+        picked: list = []            # (req, eff_prompt, row, hit)
+        while pool.free_slots > 0:
+            head = self._ctrl.peek("lm")
+            if head is None:
+                break
+            eff = head.effective_prompt()
+            # a resumed stream re-derives its newest pick from the prefill
+            # logits, so its remaining picks = num_steps - (emitted - 1)
+            ns = head.num_steps - max(head.emitted - 1, 0)
+            if not pool.can_admit(len(eff), ns):
+                break
+            got, expired = self._ctrl.take("lm", 1)
+            for r in expired:
+                self._shed(r, "lm")
+                worked = True
+            if not got:
+                continue
+            req = got[0]
+            if not self._claim(req):
+                worked = True
+                continue
+            try:
+                row, hit = pool.admit(eff, ns)
+            except OutOfBlocks:
+                # overcommitted budget met a physically empty pool —
+                # admit() unwound cleanly; head-of-line waits for releases
+                self._ctrl.requeue_front("lm", req)
+                break
+            picked.append((req, eff, row, hit))
+        if not picked:
+            self._sync_pool_stats()
+            return worked
+        self._inflight_admit = [req for req, *_ in picked]
+        groups: dict[int, list] = {}
+        now = time.monotonic()
+        for item in picked:
+            req, eff, row, hit = item
+            if req.emitted == 0:
+                req.times.admitted = now
+            bucket = bucket_len(len(eff) - hit, self._lm.cfg.max_len,
+                                self.cfg.min_bucket)
+            groups.setdefault(bucket, []).append(item)
+        for bucket, items in groups.items():
+            self._fault("prefill")   # device-work boundary: this group is
+            #                          claimed — a fault here fails it
+            g = batch_bucket(len(items), pool.max_resident)
+            rows: list = [None] * g
+            prompts = np.zeros((g, bucket), np.int32)
+            true_lens = np.ones((g,), np.int32)   # dummy rows: length 1
+            temps = np.zeros((g,), np.float32)
+            keys = np.zeros((g, 2), np.uint32)
+            for i, (req, eff, row, hit) in enumerate(items):
+                suffix = eff[hit:]
+                prompts[i] = pad_to_bucket(suffix[None, :], bucket)[0]
+                true_lens[i] = suffix.size
+                temps[i] = req.temperature
+                keys[i] = req.pick_key()
+                rows[i] = row
+            toks = pool.prefill(rows, prompts, true_lens, temps, keys)
+            first = time.monotonic()
+            self.metrics.count("prefills")
+            for i, (req, eff, row, hit) in enumerate(items):
+                pool.register(row, eff)
+                pool.note_prefilled(row)
+                tok0 = int(toks[i])
+                if req.emitted == 0:
+                    req.times.first_output = first
+                    req.tokens.append(tok0)
+                    req.emitted = 1
+                    req.emit(0)
+                # else: a resumed stream — tok0 is the bit-identical
+                # re-derivation of its newest pick; nothing new to emit
+                if req.emitted >= req.num_steps:
+                    pool.release(row)
+                    self._finish_lm(req)
+                else:
+                    self._slot_req[row] = req
+                    self._cur[row] = tok0
+                    self._temps[row] = req.temperature
+        self._inflight_admit = []
+        self._sync_pool_stats()
+        return True
+
     def _admit_lm(self) -> bool:
         if self._draining.is_set():
             return False        # draining: finish slots, admit nothing
+        if isinstance(self.pool, BlockPool):
+            return self._admit_lm_paged()
         free = self.pool.free_slots
         if free == 0:
             return False
@@ -848,7 +1057,20 @@ class ServingEngine:
             return False
         self._fault("decode")
         k = self.cfg.steps_per_tick
-        n = self.cfg.n_slots
+        if isinstance(self.pool, BlockPool):
+            # on-demand block allocation for this tick; exhaustion (only
+            # reachable with block_overcommit > 1) preempts the YOUNGEST
+            # streams by recompute — their requests go back to the queue
+            # HEAD with tokens intact and resume bit-identically
+            for row in self.pool.prepare_tick(k):
+                req = self._slot_req.pop(row)
+                self._cur[row] = 0
+                self._temps[row] = 0.0
+                self._ctrl.requeue_front("lm", req)
+            if not self._slot_req:
+                self._sync_pool_stats()
+                return True
+        n = self._n_rows
         keys = np.zeros((n, k, 2), np.uint32)
         for slot, req in self._slot_req.items():
             if req.keys is not None:
@@ -872,6 +1094,7 @@ class ServingEngine:
             self._temps[slot] = 0.0
             self._cur[slot] = 0
             self._finish_lm(req)
+        self._sync_pool_stats()
         return True
 
     def _finish_lm(self, req: _LMRequest) -> None:
@@ -882,6 +1105,9 @@ class ServingEngine:
                             t.done, tokens=req.num_steps)
         self.metrics.record(rec)
         self._update_service(rec.total_ms)
+        per_tok = rec.total_ms / max(req.num_steps, 1)
+        self._per_token_ms = (0.8 * self._per_token_ms + 0.2 * per_tok
+                              if self._per_token_ms else per_tok)
         req.future.set_result(GenerateResult(
             tokens=np.asarray(req.tokens[:req.num_steps], np.int32),
             queue_ms=rec.queue_ms, ttft_ms=rec.ttft_ms,

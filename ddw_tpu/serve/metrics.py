@@ -89,6 +89,14 @@ class EngineMetrics:
         self.loop_errors = 0       # recoverable engine-loop errors survived
         self.failovers = 0         # sibling requests adopted after a
         #                            replica death (counted at the adopter)
+        # paged-KV accumulators (ddw_tpu.serve.blocks.BlockPool)
+        self.preemptions = 0       # streams evicted mid-decode for blocks
+        self.cow_copies = 0        # copy-on-write block clones
+        self.prefix_hit_blocks = 0   # prompt blocks served from the cache
+        self.prefix_miss_blocks = 0  # prompt blocks that had to prefill
+        self.prefix_hit_tokens = 0   # prompt tokens whose prefill was skipped
+        self._gauges: dict[str, float] = {}  # live block-pool state, pushed
+        #                            by the engine loop (free/used blocks...)
         self._first_admit: float | None = None
         self._last_done: float | None = None
         self._sink = None          # incremental serve_requests.jsonl stream
@@ -151,6 +159,16 @@ class EngineMetrics:
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
 
+    def set_gauges(self, gauges: dict[str, float]) -> None:
+        """Replace the live gauge set (block-pool free/used/resident state,
+        pushed by the engine loop each tick). Gauges render as
+        ``serve.<name>`` in :meth:`snapshot` and ``ddw_serve_<name>`` in
+        the Prometheus exposition; :func:`merge_metrics` SUMS them across
+        replicas (they are all counts, so fleet totals are meaningful —
+        ratios like fragmentation are derived at render time)."""
+        with self._lock:
+            self._gauges = dict(gauges)
+
     # -- reading -----------------------------------------------------------
     def snapshot(self) -> dict[str, float]:
         """Flat ``serve.*`` metric dict — the SLO view. Keys are stable;
@@ -167,7 +185,25 @@ class EngineMetrics:
                 "serve.image_batches": float(self.image_batches),
                 "serve.loop_errors": float(self.loop_errors),
                 "serve.failovers": float(self.failovers),
+                "serve.preemptions": float(self.preemptions),
+                "serve.cow_copies": float(self.cow_copies),
+                "serve.prefix_hit_blocks": float(self.prefix_hit_blocks),
+                "serve.prefix_miss_blocks": float(self.prefix_miss_blocks),
+                "serve.prefix_hit_tokens": float(self.prefix_hit_tokens),
             }
+            looked = self.prefix_hit_blocks + self.prefix_miss_blocks
+            out["serve.prefix_hit_rate"] = (
+                self.prefix_hit_blocks / looked if looked else 0.0)
+            for name, val in self._gauges.items():
+                out[f"serve.{name}"] = float(val)
+            cap = self._gauges.get("block_tokens_capacity", 0.0)
+            if cap:
+                # internal fragmentation of the blocks in use: capacity
+                # reserved minus tokens actually resident (prefix sharing
+                # can push this negative — clamp; that IS the sharing win)
+                out["serve.block_fragmentation_pct"] = max(
+                    0.0, 100.0 * (1.0 - self._gauges.get(
+                        "block_tokens_used", 0.0) / cap))
             first, last = self._first_admit, self._last_done
         if not recs:
             return out
@@ -229,6 +265,11 @@ _COUNTER_HELP = (
     ("image_batches", "Dynamic-batched image apply dispatches."),
     ("loop_errors", "Recoverable engine-loop errors survived."),
     ("failovers", "Requests adopted from a failed sibling replica."),
+    ("preemptions", "Streams evicted mid-decode for blocks (recomputed)."),
+    ("cow_copies", "Copy-on-write KV block clones."),
+    ("prefix_hit_blocks", "Prompt KV blocks served from the prefix cache."),
+    ("prefix_miss_blocks", "Prompt KV blocks that had to prefill."),
+    ("prefix_hit_tokens", "Prompt tokens whose prefill compute was skipped."),
     ("tokens_out", "Generated LM tokens."),
 )
 _HISTOGRAMS = ("queue_ms", "ttft_ms", "total_ms")
@@ -266,6 +307,13 @@ def merge_metrics(metrics_list) -> "EngineMetrics":
             out.image_batches += m.image_batches
             out.loop_errors += m.loop_errors
             out.failovers += m.failovers
+            out.preemptions += m.preemptions
+            out.cow_copies += m.cow_copies
+            out.prefix_hit_blocks += m.prefix_hit_blocks
+            out.prefix_miss_blocks += m.prefix_miss_blocks
+            out.prefix_hit_tokens += m.prefix_hit_tokens
+            for name, val in m._gauges.items():
+                out._gauges[name] = out._gauges.get(name, 0.0) + val
             if m._first_admit is not None:
                 out._first_admit = (m._first_admit if out._first_admit is None
                                     else min(out._first_admit, m._first_admit))
@@ -284,6 +332,7 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
     add fleet-level gauges like outstanding requests per replica."""
     recs: list[RequestRecord] = []
     counters = {name: 0.0 for name, _ in _COUNTER_HELP}
+    pool_gauges: dict[str, float] = {}
     first, last = None, None
     for m in metrics_list:
         with m._lock:
@@ -296,6 +345,13 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
             counters["image_batches"] += m.image_batches
             counters["loop_errors"] += m.loop_errors
             counters["failovers"] += m.failovers
+            counters["preemptions"] += m.preemptions
+            counters["cow_copies"] += m.cow_copies
+            counters["prefix_hit_blocks"] += m.prefix_hit_blocks
+            counters["prefix_miss_blocks"] += m.prefix_miss_blocks
+            counters["prefix_hit_tokens"] += m.prefix_hit_tokens
+            for name, val in m._gauges.items():
+                pool_gauges[name] = pool_gauges.get(name, 0.0) + val
             if m._first_admit is not None:
                 first = (m._first_admit if first is None
                          else min(first, m._first_admit))
@@ -317,6 +373,18 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
               "over the busy window.",
               "# TYPE ddw_serve_tokens_per_sec gauge",
               f"ddw_serve_tokens_per_sec {tps:g}"]
+    # block-pool gauges (fleet-summed) + derived ratios
+    looked = counters["prefix_hit_blocks"] + counters["prefix_miss_blocks"]
+    pool_gauges["prefix_hit_rate"] = (
+        counters["prefix_hit_blocks"] / looked if looked else 0.0)
+    cap = pool_gauges.get("block_tokens_capacity", 0.0)
+    if cap:
+        pool_gauges["block_fragmentation_pct"] = max(
+            0.0, 100.0 * (1.0 - pool_gauges.get("block_tokens_used", 0.0)
+                          / cap))
+    for name in sorted(pool_gauges):
+        full = f"ddw_serve_{name}"
+        lines += [f"# TYPE {full} gauge", f"{full} {pool_gauges[name]:g}"]
     typed: set[str] = set()     # one TYPE line per family, labels or not
     for key, val in (extra_gauges or {}).items():
         base = key.split("{")[0]

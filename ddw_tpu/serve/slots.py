@@ -28,6 +28,12 @@ token-identical to the sequential path (pinned by tests/test_serve_engine).
 Free slots keep decoding a dummy token (static shapes — design rule 2); the
 waste is bounded by ``n_slots`` and their released rows are index-reset to 0
 so they never force extra attention tiles for live rows.
+
+Since PR 7 this contiguous pool is the measured BASELINE: the engine
+defaults to the paged :class:`~ddw_tpu.serve.blocks.BlockPool`, which
+replaces per-slot ``max_len`` reservation with fixed-size blocks + block
+tables (capacity follows actual usage) and adds prefix reuse. Construct
+the engine with ``EngineCfg(paged=False)`` to serve through this pool.
 """
 
 from __future__ import annotations

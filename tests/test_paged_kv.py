@@ -1,0 +1,363 @@
+"""Paged KV cache (ddw_tpu.serve.blocks): block tables, prefix reuse, CoW.
+
+The tentpole pins, all on the 8-fake-CPU-device backend:
+
+- token identity: the paged engine (default ``EngineCfg.paged``) with
+  prefix reuse and copy-on-write enabled reproduces the sequential
+  ``generate`` path bit-for-bit, greedy AND seeded sampling, including
+  CoW-divergence fuzz around block boundaries and preemption-resume;
+- no leaks: every block returns to free/cached across completion,
+  eviction (failure reset), recycle generations and ``reset()``;
+- admission on blocks: a pool too small for the offered concurrency
+  queues (head-of-line) instead of failing, and every request completes;
+- out-of-blocks mid-decode (``block_overcommit > 1``): the youngest
+  stream preempts by recompute, re-queues at the head, resumes
+  bit-identically and never re-emits a streamed token;
+- block/prefix/CoW observability flows through snapshot, fleet merge and
+  Prometheus rendering;
+- at EQUAL KV memory the paged pool holds strictly more resident streams
+  than the slot baseline (the capacity claim; the serving_curve smoke
+  re-pins it with throughput on the wide package).
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ddw_tpu.models.lm import build_lm
+from ddw_tpu.serve import BlockPool, EngineCfg, ServingEngine
+from ddw_tpu.serve.blocks import OutOfBlocks
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+BS = 16     # kv_block_size under test (divides tile = min(256, 96))
+
+
+def _lm_pkg(out_dir, seed=0, **cfg_kw):
+    kw = dict(vocab_size=VOCAB, max_len=96, hidden=32, depth=2, num_heads=2,
+              mlp_dim=64, dropout=0.0, dtype="float32")
+    kw.update(cfg_kw)
+    cfg = LMCfg(**kw)
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(seed)},
+                        np.zeros((1, 8), np.int32))["params"]
+    d = save_lm_package(str(out_dir), cfg, params, quantize=None)
+    return load_lm_package(d)
+
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    return _lm_pkg(tmp_path_factory.mktemp("paged_pkg") / "pkg")
+
+
+@pytest.fixture(scope="module")
+def eng2(pm):
+    """One shared paged engine (n_slots=2, k=2) for the identity/reuse/
+    metrics pins — its compiled prefill/decode programs and prefix-cache
+    warmth amortize across tests (counter asserts below are monotone, so
+    shared state only ever helps them)."""
+    with ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2, steps_per_tick=2,
+                                            default_timeout_s=600.0)) as e:
+        yield e
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _pool_clean(pool: BlockPool) -> None:
+    """The leak pin: all rows free, no block in use, free + cached spans
+    the whole pool, every refcount zero."""
+    g = pool.gauges()
+    assert g["resident_streams"] == 0
+    assert g["blocks_used"] == 0, g
+    assert g["blocks_free"] + g["blocks_cached"] == g["blocks_total"], g
+    assert int(pool._ref.sum()) == 0
+    assert pool._committed == 0
+    assert pool.free_slots == pool.max_resident
+
+
+# -- prefix reuse + CoW ------------------------------------------------------
+
+def test_prefix_reuse_skips_prefill_and_stays_token_identical(eng2, pm):
+    """Identical prompt -> full + tail hits (CoW clone); shared prefix with
+    a divergent suffix -> full-block hits only; both bit-identical to the
+    sequential path, with the skips visible in the metrics."""
+    (pa,) = _prompts([24], seed=1)
+    pb = pa.copy()
+    pb[20] = (pb[20] + 1) % VOCAB          # diverges inside the tail block
+    ra = pm.generate(pa[None, :], 8)[0]
+    rb = pm.generate(pb[None, :], 8)[0]
+    assert isinstance(eng2.pool, BlockPool)      # paged is the default
+    assert np.array_equal(eng2.generate(pa, 8).tokens, ra)   # seeds cache
+    f1 = eng2.submit_generate(pa, 8)             # exact repeat: tail CoW
+    f2 = eng2.submit_generate(pb, 8)             # shared 16-token prefix
+    assert np.array_equal(f1.result(timeout=120).tokens, ra)
+    assert np.array_equal(f2.result(timeout=120).tokens, rb)
+    snap = eng2.snapshot()
+    assert snap["serve.prefix_hit_tokens"] >= 16 + 16
+    assert snap["serve.prefix_hit_blocks"] >= 2
+    assert snap["serve.cow_copies"] >= 1
+    assert 0.0 < snap["serve.prefix_hit_rate"] <= 1.0
+
+
+def test_cow_divergence_fuzz_around_block_boundaries(eng2, pm):
+    """Prompt pairs sharing prefixes that land on, just before, and just
+    after block boundaries — every divergence point must reproduce the
+    sequential tokens exactly (the CoW clone isolates the writer)."""
+    rng = np.random.RandomState(3)
+    before = eng2.snapshot()
+    for plen in (BS - 1, BS + 1, 2 * BS, 2 * BS + 5):
+        base = rng.randint(0, VOCAB, size=(plen,)).astype(np.int32)
+        for div in sorted({0, plen - 1}):
+            var = base.copy()
+            var[div] = (var[div] + 1) % VOCAB
+            for p in (base, var):
+                ref = pm.generate(p[None, :], 5)[0]
+                got = eng2.generate(p, 5).tokens
+                assert np.array_equal(got, ref), (plen, div)
+    snap = eng2.snapshot()
+    _pool_clean(eng2.pool)
+    assert (snap["serve.prefix_hit_tokens"]
+            > before["serve.prefix_hit_tokens"])   # repeats hit the cache
+    assert snap["serve.cow_copies"] > before["serve.cow_copies"]
+
+
+def test_sampled_and_greedy_neighbors_with_prefix_reuse(eng2, pm):
+    """Seeded sampling through the paged pool (per-request key schedule,
+    prefix hits active) matches the sequential path; greedy neighbors in
+    the same decode batch are unperturbed."""
+    ps, pg = _prompts([19, 23], seed=5)
+    sref = pm.generate(ps[None, :], 10, rng=jax.random.PRNGKey(11),
+                       temperature=0.7)[0]
+    gref = pm.generate(pg[None, :], 10)[0]
+    eng2.generate(ps, 4)                       # seed the prefix cache
+    before = eng2.snapshot()["serve.prefix_hit_tokens"]
+    f1 = eng2.submit_generate(ps, 10, rng=jax.random.PRNGKey(11),
+                              temperature=0.7)
+    f2 = eng2.submit_generate(pg, 10)
+    assert np.array_equal(f1.result(timeout=120).tokens, sref)
+    assert np.array_equal(f2.result(timeout=120).tokens, gref)
+    assert eng2.snapshot()["serve.prefix_hit_tokens"] > before
+
+
+# -- allocator invariants ----------------------------------------------------
+
+def test_block_leak_pin_across_generations(pm):
+    """alloc/free accounting survives completion, a recoverable-error pool
+    reset, restart() generations, and explicit reset() — nothing leaks,
+    nothing double-frees."""
+    prompts = _prompts([5, 21, 33, 9], seed=7)
+    eng = ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2, steps_per_tick=2))
+    with eng:
+        futs = [eng.submit_generate(p, 6) for p in prompts]
+        [f.result(timeout=120) for f in futs]
+        _pool_clean(eng.pool)
+    # generation 1: restart resets the pool; serve again, still clean
+    eng.restart()
+    try:
+        futs = [eng.submit_generate(p, 6) for p in prompts]
+        [f.result(timeout=120) for f in futs]
+        _pool_clean(eng.pool)
+        snap = eng.snapshot()
+        assert snap["serve.blocks_used"] == 0.0
+        assert (snap["serve.blocks_free"] + snap["serve.blocks_cached"]
+                == snap["serve.blocks_total"])
+    finally:
+        eng.stop()
+    # explicit reset(): everything free, prefix cache empty
+    pool = eng.pool
+    pool.reset()
+    _pool_clean(pool)
+    assert pool.free_blocks == pool.n_blocks
+    assert not pool._full_map and not pool._tail_map
+
+
+def test_pool_unit_admit_release_refcounts(pm):
+    """BlockPool unit behavior: shared blocks refcount up/down, the cached
+    LRU is reclaimed under pressure, and a failed admit unwinds cleanly."""
+    pool = BlockPool(pm.model, pm.params, n_blocks=6, block_size=BS,
+                     max_resident=3, steps_per_tick=1)
+    (p,) = _prompts([2 * BS + 4], seed=9)    # 3 prompt blocks
+    row, hit = pool.admit(p, 4)
+    assert hit == 0 and len(pool._streams[row].blocks) == 3
+    pool.prefill([row], p[None, :], np.array([len(p)], np.int32),
+                 np.zeros((1,), np.float32), np.zeros((1, 2), np.uint32))
+    pool.register(row, p)
+    pool.note_prefilled(row)
+    # same prompt again: 2 full blocks shared (ref 2), tail cloned
+    row2, hit2 = pool.admit(p, 4)
+    assert hit2 == len(p) - 1
+    st1, st2 = pool._streams[row], pool._streams[row2]
+    assert st2.blocks[:2] == st1.blocks[:2]          # shared by reference
+    assert st2.blocks[2] != st1.blocks[2]            # CoW clone
+    assert pool._ref[st1.blocks[0]] == 2
+    assert pool.stats["cow_copies"] == 1
+    pool.release(row2)
+    assert pool._ref[st1.blocks[0]] == 1
+    pool.release(row)
+    # registered blocks park in the cached LRU, not the free list
+    assert pool.gauges()["blocks_cached"] == 3
+    # allocation pressure reclaims them (admit needing more than free)
+    (big,) = _prompts([5 * BS], seed=10)
+    row3, hit3 = pool.admit(big, 2)
+    assert hit3 == 0 and len(pool._streams[row3].blocks) == 5
+    pool.release(row3)
+    # over-budget admit raises OutOfBlocks and unwinds
+    pool2 = BlockPool(pm.model, pm.params, n_blocks=2, block_size=BS,
+                      max_resident=2, steps_per_tick=1)
+    with pytest.raises(OutOfBlocks):
+        pool2.admit(_prompts([5 * BS], seed=11)[0], 2)
+    _pool_clean(pool2)
+
+
+# -- admission on blocks -----------------------------------------------------
+
+def test_admission_on_blocks_backpressures_and_completes(pm):
+    """A pool with fewer blocks than the offered concurrency queues the
+    overflow (head-of-line, no failure) and serves everything as releases
+    free blocks; a request that can NEVER fit is refused at submission."""
+    prompts = _prompts([17, 18, 19, 20, 21, 22], seed=13)
+    refs = [pm.generate(p[None, :], 6)[0] for p in prompts]
+    cfg = EngineCfg(n_slots=2, steps_per_tick=2, kv_cache_blocks=4,
+                    max_resident=6)   # each request needs 2 blocks
+    with ServingEngine(lm=pm, cfg=cfg) as eng:
+        with pytest.raises(ValueError, match="KV blocks"):
+            eng.submit_generate(prompts[0], 70)   # needs 6 > 4 blocks
+        futs = [eng.submit_generate(p, 6) for p in prompts]
+        out = [f.result(timeout=120) for f in futs]
+        snap = eng.snapshot()
+        _pool_clean(eng.pool)
+    for i, (r, ref) in enumerate(zip(out, refs)):
+        assert np.array_equal(r.tokens, ref), i
+    assert snap["serve.completed"] == 6.0
+    assert snap["serve.preemptions"] == 0.0   # conservative budget: never
+
+
+def test_overloaded_retry_hint_from_block_release(pm):
+    """Once the paged engine has a service estimate, a queue-full refusal
+    carries a retry_after_ms derived from the earliest stream's projected
+    block release (a finite positive hint)."""
+    from ddw_tpu.serve import Overloaded
+
+    (p,) = _prompts([8], seed=15)
+    cfg = EngineCfg(n_slots=1, steps_per_tick=1, queue_depth=1,
+                    max_resident=1)
+    with ServingEngine(lm=pm, cfg=cfg) as eng:
+        eng.generate(p, 4)                     # learn the service rate
+        slow = []
+        f1 = eng.submit_generate(
+            p, 30, on_token=lambda i, t: time.sleep(0.01))
+        deadline = time.monotonic() + 60
+        while not eng.health()["busy_slots"] and time.monotonic() < deadline:
+            time.sleep(0.002)                  # in a row: queue is empty
+        f2 = eng.submit_generate(p, 4)         # queued (depth 1)
+        with pytest.raises(Overloaded) as exc:
+            eng.submit_generate(p, 4)          # queue full -> structured
+        assert exc.value.retry_after_ms and exc.value.retry_after_ms > 0
+        f1.result(timeout=120), f2.result(timeout=120)
+        assert slow == []
+
+
+# -- out-of-blocks mid-decode: preemption policy -----------------------------
+
+def test_out_of_blocks_preemption_resumes_token_identically(pm):
+    """block_overcommit oversubscribes admission, so decode runs out of
+    blocks mid-flight: the youngest stream is evicted, re-queued at the
+    HEAD, and resumes BIT-identically — streamed tokens are never
+    duplicated, outputs match the sequential path, nothing leaks."""
+    prompts = _prompts([30, 31, 33, 34], seed=17)
+    steps = 40                                 # forces growth past prompts
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+    streamed: dict[int, list] = {i: [] for i in range(len(prompts))}
+    cfg = EngineCfg(n_slots=2, steps_per_tick=4, kv_cache_blocks=12,
+                    max_resident=4, block_overcommit=3.0,
+                    default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg) as eng:
+        futs = [eng.submit_generate(
+            p, steps, on_token=lambda i, t, j=j: streamed[j].append((i, t)))
+            for j, p in enumerate(prompts)]
+        out = [f.result(timeout=300) for f in futs]
+        snap = eng.snapshot()
+        _pool_clean(eng.pool)
+    assert snap["serve.preemptions"] > 0, "overcommit never ran out"
+    for j, (r, ref) in enumerate(zip(out, refs)):
+        assert np.array_equal(r.tokens, ref), j
+        # the stream saw every token exactly once, in order
+        assert [i for i, _ in streamed[j]] == list(range(steps)), j
+        assert [t for _, t in streamed[j]] == list(r.tokens), j
+
+
+# -- capacity: equal memory, more streams ------------------------------------
+
+def test_equal_memory_admits_2x_resident_streams(pm):
+    """Same KV bytes (paged default derives blocks from n_slots * cap):
+    the slot pool tops out at n_slots resident; the paged pool holds the
+    whole burst because short requests only take the blocks they use."""
+    prompts = _prompts([8, 9, 10, 11], seed=19)
+    steps = 24
+    peaks = {}
+    for name, paged in (("slot", False), ("paged", True)):
+        cfg = EngineCfg(n_slots=2, steps_per_tick=2, paged=paged,
+                        default_timeout_s=600.0)
+        with ServingEngine(lm=pm, cfg=cfg) as eng:
+            peak, stop = [0], threading.Event()
+
+            def sampler():
+                while not stop.is_set():
+                    peak[0] = max(peak[0], eng.health()["busy_slots"])
+                    time.sleep(0.001)
+
+            th = threading.Thread(target=sampler)
+            th.start()
+            futs = [eng.submit_generate(p, steps) for p in prompts]
+            [f.result(timeout=300) for f in futs]
+            stop.set()
+            th.join()
+            peaks[name] = peak[0]
+    assert peaks["slot"] <= 2
+    assert peaks["paged"] >= 2 * peaks["slot"], peaks
+    assert peaks["paged"] > 2    # strictly more than n_slots
+
+
+# -- observability -----------------------------------------------------------
+
+def test_paged_metrics_through_snapshot_merge_prometheus(eng2, pm):
+    """Block gauges + prefix/CoW counters flow through the engine
+    snapshot, the fleet merge, and the Prometheus exposition."""
+    from ddw_tpu.serve import EngineMetrics, render_prometheus
+    from ddw_tpu.serve.metrics import merge_metrics
+
+    (p,) = _prompts([20], seed=21)
+    eng = eng2
+    eng.generate(p, 5)
+    eng.generate(p, 5)           # exact repeat -> hits + CoW
+    snap = eng.snapshot()
+    met = eng.metrics
+    for key in ("serve.blocks_total", "serve.blocks_free",
+                "serve.blocks_cached", "serve.blocks_used",
+                "serve.prefix_hit_tokens", "serve.prefix_hit_rate",
+                "serve.cow_copies", "serve.preemptions"):
+        assert key in snap, key
+    assert snap["serve.blocks_total"] > 0
+    assert snap["serve.prefix_hit_tokens"] > 0
+    text = render_prometheus([met])
+    for frag in ("ddw_serve_blocks_free ", "ddw_serve_blocks_total ",
+                 "ddw_serve_prefix_hit_tokens_total ",
+                 "ddw_serve_cow_copies_total ",
+                 "ddw_serve_prefix_hit_rate ",
+                 "ddw_serve_preemptions_total "):
+        assert frag in text, frag
+    # fleet merge SUMS gauges and counters
+    other = EngineMetrics()
+    other.set_gauges({"blocks_free": 3.0, "blocks_total": 4.0})
+    other.count("cow_copies", 2)
+    merged = merge_metrics([met, other]).snapshot()
+    assert merged["serve.blocks_total"] == snap["serve.blocks_total"] + 4.0
+    assert merged["serve.cow_copies"] == snap["serve.cow_copies"] + 2.0

@@ -186,6 +186,11 @@ def test_engine_rejects_invalid_requests(pm):
 
 # -- quantized packages through the engine ----------------------------------
 
+@pytest.mark.slow   # tier-1 budget (PR 7 adds tests/test_paged_kv.py): the
+#                     quantized-ENGINE-parity class keeps the image test
+#                     below as its tier-1 representative; this arm builds a
+#                     second LM package + a full engine program set and
+#                     re-pins the same contract in tier-2
 def test_int8_lm_package_through_engine_matches_direct(pm, tmp_path):
     """serving/quantize.py engine-path coverage: an int8 LM package served
     by the engine is token-identical to its own direct (dequantized) apply,

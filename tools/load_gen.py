@@ -24,7 +24,9 @@ CI smoke (``DDW_BENCH_SMOKE=1``, no args): self-hosts a gateway on a
 throwaway package and runs the fleet-scaling comparison the slow suite
 pins — ONE replica vs TWO replicas (same slots each), closed-loop capacity
 rows plus the deadline-bounded burst rows where the 2-replica win is
-measured.
+measured — and the PREFIX arm: a shared-prefix workload (``--prompt-prefix
+N`` against a live gateway) whose paged-KV prefix-cache hits and CoW
+clones must be visible in ``/stats``.
 
 Chaos arm (``--chaos``, or ``DDW_BENCH_CHAOS=1`` with the smoke): the
 robustness pin rather than the capacity pin — closed-loop clients drive a
@@ -169,15 +171,55 @@ def open_loop(url, prompts, steps, rps, retries=0, timeout_s=None):
 
 # -- self-hosted smoke: the fleet-scaling pin --------------------------------
 
-def _smoke_gateway(pm, n_replicas, n_slots, steps_per_tick, queue_depth):
+def _smoke_gateway(pm, n_replicas, n_slots, steps_per_tick, queue_depth,
+                   paged=True):
     from ddw_tpu.gateway import Gateway, ReplicaSet
     from ddw_tpu.serve import EngineCfg, ServingEngine
 
     engines = [ServingEngine(lm=pm, cfg=EngineCfg(
         n_slots=n_slots, steps_per_tick=steps_per_tick,
-        queue_depth=queue_depth, default_timeout_s=600.0))
+        queue_depth=queue_depth, default_timeout_s=600.0, paged=paged))
         for _ in range(n_replicas)]
     return Gateway(ReplicaSet(engines), grace_s=60.0)
+
+
+def prefix_arm(pm, prompt_len, steps, requests, n_slots, steps_per_tick,
+               shared_len=16, uniq_len=8):
+    """Shared-prefix workload over the real HTTP path: every prompt opens
+    with the same ``shared_len`` tokens (the fleet-wide system-prompt
+    shape). On the paged pool the first request prefills and registers the
+    prefix blocks; every later request's prefill skips them (closed-loop
+    clients stagger naturally, so hits land even at full concurrency).
+    Returns the capacity row plus the engine's prefix/CoW counters from
+    ``/stats`` — the smoke asserts the hits are visible."""
+    from ddw_tpu.gateway import GatewayClient
+
+    conc = 2 * n_slots
+    gw = _smoke_gateway(pm, 1, n_slots, steps_per_tick,
+                        queue_depth=4 * max(conc, requests))
+    gw.start(warmup_prompt_lens=(shared_len + uniq_len, uniq_len, 1))
+    rng = np.random.RandomState(7)
+    shared = rng.randint(0, 256, size=(shared_len,)).astype(np.int32)
+    prompts = [np.concatenate([shared, rng.randint(
+        0, 256, size=(uniq_len,)).astype(np.int32)])
+        for _ in range(requests)]
+    try:
+        closed_loop(gw.url, prompts[:conc], steps, conc)   # warm + seed
+        row = closed_loop(gw.url, prompts, steps, conc)
+        cli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+        stats = cli.stats()
+        row["prefix_hit_tokens"] = int(
+            stats.get("serve.prefix_hit_tokens", 0))
+        row["prefix_hit_rate"] = round(
+            stats.get("serve.prefix_hit_rate", 0.0), 3)
+        row["cow_copies"] = int(stats.get("serve.cow_copies", 0))
+        print(f"[load_gen] prefix: {row['goodput_rps']:.2f} req/s, "
+              f"{row['prefix_hit_tokens']} prefix tokens skipped "
+              f"(hit rate {row['prefix_hit_rate']:.2f}, "
+              f"{row['cow_copies']} CoW)", file=sys.stderr, flush=True)
+    finally:
+        gw.stop()
+    return row
 
 
 def smoke(prompt_len=16, steps=24, steps_burst=48, requests=32, n_slots=4,
@@ -216,8 +258,14 @@ def smoke(prompt_len=16, steps=24, steps_burst=48, requests=32, n_slots=4,
         burst_n = 2 * n_slots
         deadline_s = None
         for name, n_rep in (("single", 1), ("dual", 2)):
+            # the fleet-scaling rows run on the SLOT baseline on purpose:
+            # the burst pin measures slot-capacity scaling across
+            # replicas, and the paged pool (the engine default) removes
+            # that per-replica wall outright — a paged single replica
+            # admits the whole burst at t=0, which is ITS pin
+            # (tools/serving_curve.py paged_capacity + the prefix arm)
             gw = _smoke_gateway(pm, n_rep, n_slots, steps_per_tick,
-                                queue_depth=4 * conc)
+                                queue_depth=4 * conc, paged=False)
             gw.start(warmup_prompt_lens=(prompt_len,))
             url = gw.url
             try:
@@ -257,6 +305,13 @@ def smoke(prompt_len=16, steps=24, steps_burst=48, requests=32, n_slots=4,
                       file=sys.stderr, flush=True)
             finally:
                 gw.stop()
+        out["prefix"] = prefix_arm(pm, prompt_len, steps, requests,
+                                   n_slots, steps_per_tick)
+        if SMOKE:
+            # prefix reuse must be VISIBLE over the wire: every request
+            # after the seed shares 16 prompt tokens with the cache
+            assert out["prefix"]["prefix_hit_tokens"] > 0, out["prefix"]
+            assert out["prefix"]["completed"] == requests, out["prefix"]
     return out
 
 
@@ -336,6 +391,9 @@ def main():
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--steps", type=int, default=32)
     ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--prompt-prefix", type=int, default=0,
+                    help="prepend this many SHARED tokens to every prompt "
+                         "(exercises paged-KV prefix reuse)")
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--rps", type=float, default=None,
                     help="open-loop offered rate (else closed loop)")
@@ -347,9 +405,11 @@ def main():
 
     if args.url:
         rng = np.random.RandomState(0)
-        prompts = [rng.randint(0, args.vocab,
-                               size=(args.prompt_len,)).astype(np.int32)
-                   for _ in range(args.requests)]
+        shared = rng.randint(0, args.vocab,
+                             size=(args.prompt_prefix,)).astype(np.int32)
+        prompts = [np.concatenate([shared, rng.randint(
+            0, args.vocab, size=(args.prompt_len,)).astype(np.int32)])
+            for _ in range(args.requests)]
         if args.rps:
             row = open_loop(args.url, prompts, args.steps, args.rps)
         else:

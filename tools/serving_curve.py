@@ -8,7 +8,10 @@ per-token generation latency with speculative decoding off/on, and the
 ONLINE arm — an offered-load sweep through the continuous-batching engine
 (``ddw_tpu.serve``): closed-loop clients at each concurrency level, reporting
 aggregate tokens/sec, queue time, TTFT, and p99 latency per load point
-against the sequential single-request baseline.
+against the sequential single-request baseline — plus the PAGED-CAPACITY
+arm: resident streams and tok/s for the paged block pool vs the slot
+baseline at equal KV memory on a shared-prefix burst (the smoke pins
+paged residency > n_slots at >= 2x slots' peak with no throughput loss).
 
 Usage (chip): ``DDW_REQUIRE_TPU=1 python tools/serving_curve.py``
 CI smoke:     ``DDW_BENCH_SMOKE=1`` shrinks shapes/batches/steps.
@@ -212,6 +215,91 @@ def engine_load_sweep(levels, hidden, depth, heads, vocab, max_len,
     return out
 
 
+def paged_capacity(hidden, depth, heads, vocab, max_len, prompt_len, steps,
+                   n_slots, steps_per_tick, dtype="float32",
+                   shared_prefix=16):
+    """The paged-KV capacity arm: resident streams + tok/s, paged pool vs
+    the contiguous slot baseline at EQUAL KV-cache memory (the paged
+    engine's default derives its block count from n_slots * cache
+    capacity). The workload is a burst of 2 * n_slots requests whose
+    prompts share a ``shared_prefix``-token head (the fleet-wide
+    system-prompt shape) behind one completed warm request, so the paged
+    run also exercises prefix reuse. The slot pool structurally caps
+    residency at n_slots (the burst runs as two waves); the paged pool
+    admits the whole burst because actual usage — not worst-case length —
+    bounds capacity. DDW_BENCH_SMOKE pins paged residency strictly above
+    n_slots, at >= 2x the slot baseline, with throughput no worse."""
+    import threading
+
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    rng = np.random.RandomState(0)
+    burst = 2 * n_slots
+    prefix = rng.randint(0, vocab, size=(shared_prefix,)).astype(np.int32)
+    prompts = [np.concatenate([prefix, rng.randint(
+        0, vocab, size=(prompt_len - shared_prefix,)).astype(np.int32)])
+        for _ in range(burst)]
+    out = {"n_slots": n_slots, "burst": burst, "steps": steps}
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "paged", hidden, depth, heads, vocab,
+                          max_len, dtype=dtype)
+        for name, paged in (("slot", False), ("paged", True)):
+            cfg = EngineCfg(n_slots=n_slots, steps_per_tick=steps_per_tick,
+                            paged=paged, queue_depth=4 * burst,
+                            default_timeout_s=600.0)
+            with ServingEngine(lm=pm, cfg=cfg) as eng:
+                eng.warmup([prompt_len])
+                eng.generate(prompts[0], steps)   # warm + seed prefix cache
+                eng.metrics = type(eng.metrics)()  # fresh window
+                peak = [0]
+                stop = threading.Event()
+
+                def sampler():
+                    while not stop.is_set():
+                        peak[0] = max(peak[0],
+                                      eng.health()["busy_slots"])
+                        time.sleep(0.002)
+
+                # suffix buckets too: prefix-hit requests prefill only
+                # their uncovered tail, which lands on smaller buckets
+                eng.warmup([max(prompt_len - shared_prefix, 1), 1])
+                th = threading.Thread(target=sampler)
+                th.start()
+                t0 = time.perf_counter()
+                futs = [eng.submit_generate(p, steps) for p in prompts]
+                for f in futs:
+                    f.result(timeout=600)
+                wall = time.perf_counter() - t0
+                stop.set()
+                th.join()
+                snap = eng.snapshot()
+            row = {
+                "resident_peak": peak[0],
+                "tokens_per_sec": round(burst * steps / wall, 1),
+                "ttft_ms_p99": round(snap["serve.ttft_ms_p99"], 2),
+                "total_ms_p99": round(snap["serve.total_ms_p99"], 2),
+                "prefix_hit_tokens": int(
+                    snap.get("serve.prefix_hit_tokens", 0)),
+                "cow_copies": int(snap.get("serve.cow_copies", 0)),
+            }
+            out[name] = row
+            print(f"[curve] capacity {name}: peak {row['resident_peak']} "
+                  f"resident, {row['tokens_per_sec']:.0f} tok/s, "
+                  f"prefix hits {row['prefix_hit_tokens']} tok",
+                  file=sys.stderr, flush=True)
+    if SMOKE:
+        # the acceptance pin: at equal KV memory the paged pool admits
+        # strictly more concurrent streams than n_slots (>= 2x the slot
+        # baseline's peak) without giving up throughput
+        assert out["paged"]["resident_peak"] > n_slots, out
+        assert (out["paged"]["resident_peak"]
+                >= 2 * out["slot"]["resident_peak"]), out
+        assert (out["paged"]["tokens_per_sec"]
+                >= out["slot"]["tokens_per_sec"]), out
+        assert out["paged"]["prefix_hit_tokens"] > 0, out
+    return out
+
+
 def main():
     from ddw_tpu.utils.config import require_tpu_or_exit
 
@@ -232,6 +320,9 @@ def main():
                       vocab=256, max_len=128, prompt_len=16, steps=24,
                       n_slots=8, steps_per_tick=8, requests_per_level=32,
                       dtype="float32")
+        cap_kw = dict(hidden=384, depth=3, heads=4, vocab=256, max_len=128,
+                      prompt_len=24, steps=24, n_slots=8, steps_per_tick=8,
+                      dtype="float32", shared_prefix=16)
     else:
         batches, img = [1, 2, 4, 8, 16, 32, 64, 128, 256], (224, 224, 3)
         lm_kw = dict(hidden=512, depth=6, heads=8, vocab=8192, max_len=2048,
@@ -240,12 +331,16 @@ def main():
                       heads=8, vocab=8192, max_len=2048, prompt_len=64,
                       steps=128, n_slots=16, steps_per_tick=8,
                       requests_per_level=64)
+        cap_kw = dict(hidden=512, depth=6, heads=8, vocab=8192,
+                      max_len=2048, prompt_len=96, steps=128, n_slots=16,
+                      steps_per_tick=8, shared_prefix=64)
 
     result = {
         "device": {"kind": kind, "n": jax.device_count()},
         "image_curve": image_curve(batches, img),
         "lm": lm_latencies(**lm_kw),
         "engine": engine_load_sweep(**eng_kw),
+        "paged_capacity": paged_capacity(**cap_kw),
     }
     print(json.dumps(result))
 
