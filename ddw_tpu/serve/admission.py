@@ -162,6 +162,16 @@ class AdmissionController:
             q = self._queues.get(kind)
             return q[0] if q else None
 
+    def count_claimed(self, kind: str) -> int:
+        """Queued requests whose future already transitioned to RUNNING —
+        preempted streams waiting to re-admit. They are in-flight work,
+        not fresh load: a drain is not complete while any remain."""
+        with self._lock:
+            q = self._queues.get(kind)
+            if not q:
+                return 0
+            return sum(1 for r in q if getattr(r, "claimed", False))
+
     def requeue_front(self, kind: str, request) -> None:
         """Put a request back at the HEAD of its queue, bypassing the
         capacity bound — the preemption path (a stream evicted mid-decode

@@ -71,6 +71,7 @@ import dataclasses
 import threading
 import time
 import traceback
+import warnings
 
 import jax
 import numpy as np
@@ -116,8 +117,10 @@ class EngineCfg:
     # paged=False falls back to the contiguous per-slot pool (the baseline
     # tools/serving_curve.py measures against).
     paged: bool = True
-    kv_block_size: int = 16     # tokens per KV block (must divide the
-    #                             attention tile, min(256, max_len))
+    kv_block_size: int = 16     # tokens per KV block; when it does not
+    #                             divide the attention tile (min(256,
+    #                             max_len)) the engine shrinks it to the
+    #                             largest divisor <= this and warns
     kv_cache_blocks: int = 0    # total usable blocks; 0 = EQUAL KV MEMORY
     #                             to the slot baseline (n_slots * cache
     #                             capacity / block_size) — same bytes, more
@@ -274,12 +277,27 @@ class ServingEngine:
                 model = self._lm.model
                 tile = min(256, model.max_len)
                 cap = -(-model.max_len // tile) * tile
+                block_size = self.cfg.kv_block_size
+                if block_size < 1 or tile % block_size:
+                    # the default (16) need not divide every model's
+                    # attention tile (e.g. max_len=100 -> tile 100):
+                    # shrink to the largest divisor not above the
+                    # configured size rather than failing construction
+                    block_size = max(
+                        d for d in range(1, min(max(block_size, 1),
+                                                tile) + 1)
+                        if tile % d == 0)
+                    warnings.warn(
+                        f"kv_block_size {self.cfg.kv_block_size} does not "
+                        f"divide the attention tile {tile} (= min(256, "
+                        f"max_len {model.max_len})); using {block_size}",
+                        RuntimeWarning, stacklevel=3)
                 n_blocks = self.cfg.kv_cache_blocks or (
-                    self.cfg.n_slots * cap // self.cfg.kv_block_size)
+                    self.cfg.n_slots * cap // block_size)
                 n = self.cfg.max_resident or 2 * self.cfg.n_slots
                 self.pool = BlockPool(
                     model, self._lm.params, n_blocks=n_blocks,
-                    block_size=self.cfg.kv_block_size, max_resident=n,
+                    block_size=block_size, max_resident=n,
                     steps_per_tick=self.cfg.steps_per_tick,
                     donate=self.cfg.donate,
                     overcommit=self.cfg.block_overcommit)
@@ -448,14 +466,18 @@ class ServingEngine:
     def drain_slots(self, timeout_s: float = 30.0) -> bool:
         """Pause admission and let every in-slot request run to completion
         (the decode loop keeps ticking; queued requests stay queued and are
-        served by the next generation). Returns False when the slots did
-        not empty in time — the engine is then still draining and the
-        caller should fall back to :meth:`force_fail`."""
+        served by the next generation). A stream PREEMPTED for blocks
+        mid-drain is already-claimed in-flight work, not fresh load: it
+        counts as busy and keeps re-admitting, so drain only reports clean
+        once it finished too. Returns False when the slots did not empty
+        in time — the engine is then still draining and the caller should
+        fall back to :meth:`force_fail`."""
         self._draining.set()
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             busy = ((len(self._slot_req) if self.pool is not None else 0)
-                    + len(self._inflight_admit))
+                    + len(self._inflight_admit)
+                    + self._ctrl.count_claimed("lm"))
             if busy == 0 and self._failure is None:
                 return True
             if self._failure is not None:
@@ -892,12 +914,15 @@ class ServingEngine:
             self._pool_stats_seen[key] = val
         self.metrics.set_gauges(pool.gauges())
 
-    def _admit_lm_paged(self) -> bool:
+    def _admit_lm_paged(self, drain_only: bool = False) -> bool:
         """Admission on free BLOCKS: pop queued requests head-first while
         the pool's conservative block budget accepts them (head-of-line
         blocking is deliberate — skipping ahead would starve long prompts),
         then prefill each request's uncovered SUFFIX in per-bucket groups.
-        Prefix-hit tokens never touch the device."""
+        Prefix-hit tokens never touch the device. ``drain_only`` (set while
+        draining) admits only already-claimed requests — preempted streams
+        sit at the queue HEAD (requeue_front), so stopping at the first
+        unclaimed head lets all of them finish without taking new work."""
         pool = self.pool
         worked = False
         if self._ctrl.depth("lm") > 0 and pool.free_slots > 0:
@@ -907,6 +932,8 @@ class ServingEngine:
         while pool.free_slots > 0:
             head = self._ctrl.peek("lm")
             if head is None:
+                break
+            if drain_only and not getattr(head, "claimed", False):
                 break
             eff = head.effective_prompt()
             # a resumed stream re-derives its newest pick from the prefill
@@ -921,6 +948,18 @@ class ServingEngine:
             if not got:
                 continue
             req = got[0]
+            if req is not head:
+                # take() skipped expired requests, so the peeked budget
+                # (and prompt!) belong to a shed head — recompute for the
+                # request actually popped, and give back what no longer fits
+                if drain_only and not getattr(req, "claimed", False):
+                    self._ctrl.requeue_front("lm", req)
+                    break
+                eff = req.effective_prompt()
+                ns = req.num_steps - max(req.emitted - 1, 0)
+                if not pool.can_admit(len(eff), ns):
+                    self._ctrl.requeue_front("lm", req)
+                    break
             if not self._claim(req):
                 worked = True
                 continue
@@ -987,10 +1026,13 @@ class ServingEngine:
         return True
 
     def _admit_lm(self) -> bool:
-        if self._draining.is_set():
-            return False        # draining: finish slots, admit nothing
+        draining = self._draining.is_set()
         if isinstance(self.pool, BlockPool):
-            return self._admit_lm_paged()
+            # a drain still re-admits already-claimed (preempted) streams
+            # so their in-flight work can finish; fresh requests stay queued
+            return self._admit_lm_paged(drain_only=draining)
+        if draining:
+            return False        # draining: finish slots, admit nothing
         free = self.pool.free_slots
         if free == 0:
             return False

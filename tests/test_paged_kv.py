@@ -107,6 +107,10 @@ def test_prefix_reuse_skips_prefill_and_stays_token_identical(eng2, pm):
     assert 0.0 < snap["serve.prefix_hit_rate"] <= 1.0
 
 
+@pytest.mark.slow   # tier-1 budget (review adds the expired-head / drain /
+#                     block-size regression pins below): the boundary fuzz is
+#                     the slow sweep of the CoW-identity class whose tier-1
+#                     representative is test_prefix_reuse_... above
 def test_cow_divergence_fuzz_around_block_boundaries(eng2, pm):
     """Prompt pairs sharing prefixes that land on, just before, and just
     after block boundaries — every divergence point must reproduce the
@@ -361,3 +365,81 @@ def test_paged_metrics_through_snapshot_merge_prometheus(eng2, pm):
     merged = merge_metrics([met, other]).snapshot()
     assert merged["serve.blocks_total"] == snap["serve.blocks_total"] + 4.0
     assert merged["serve.cow_copies"] == snap["serve.cow_copies"] + 2.0
+
+
+# -- admission edge cases ----------------------------------------------------
+
+def test_expired_head_admission_prefills_the_popped_request(pm):
+    """The queue head's deadline passes while it waits; take() sheds it
+    and returns the LIVE request behind it. Admission must prefill THAT
+    request's prompt and budget — the regression prefilled the survivor
+    with the dead head's prompt, answering it with the wrong tokens."""
+    from ddw_tpu.serve import DeadlineExceeded
+
+    p1, p2 = _prompts([10, 24], seed=23)
+    steps = 4
+    ref = pm.generate(p2[None, :], steps)[0]
+    eng = ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2, steps_per_tick=2))
+    # engine NOT started: drive admission by hand, so the expired head is
+    # still queued when admission peeks it (the live loop's shed_expired
+    # pass usually hides that window)
+    f1 = eng.submit_generate(p1, steps, timeout_s=0.01)
+    f2 = eng.submit_generate(p2, steps)
+    time.sleep(0.05)                    # head's deadline passes in-queue
+    assert eng._admit_lm()
+    for _ in range(64):
+        if f2.done():
+            break
+        eng._decode_tick()
+    with pytest.raises(DeadlineExceeded):
+        f1.result(timeout=5)
+    assert np.array_equal(f2.result(timeout=5).tokens, ref)
+    _pool_clean(eng.pool)
+
+
+def test_drain_completes_preempted_streams(pm):
+    """A stream preempted for blocks MID-DRAIN (block_overcommit > 1) is
+    already-claimed in-flight work: drain keeps re-admitting it while
+    fresh queued work stays queued, and only reports clean once every
+    claimed stream finished — the regression stranded it in the paused
+    queue and reported a clean drain."""
+    prompts = _prompts([30, 31, 33, 34], seed=25)
+    steps = 40
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+    cfg = EngineCfg(n_slots=2, steps_per_tick=4, kv_cache_blocks=12,
+                    max_resident=4, block_overcommit=3.0,
+                    default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg) as eng:
+        futs = [eng.submit_generate(p, steps) for p in prompts]
+        deadline = time.monotonic() + 60
+        while (eng.health()["busy_slots"] < len(prompts)
+               and time.monotonic() < deadline):
+            time.sleep(0.001)
+        assert eng.health()["busy_slots"] == len(prompts)  # all claimed
+        assert eng.drain_slots(timeout_s=120.0)
+        # clean drain -> every claimed request already finished
+        out = [f.result(timeout=5) for f in futs]
+        snap = eng.snapshot()
+        eng.resume_admission()
+        _pool_clean(eng.pool)
+    assert snap["serve.preemptions"] > 0, "never ran out of blocks"
+    for j, (r, ref) in enumerate(zip(out, refs)):
+        assert np.array_equal(r.tokens, ref), j
+
+
+def test_indivisible_kv_block_size_shrinks_with_warning(tmp_path):
+    """max_len=100 -> attention tile 100, which the default block size 16
+    does not divide: the engine shrinks it to the largest divisor (10)
+    and serves, instead of failing construction where the slot pool
+    worked."""
+    pm100 = _lm_pkg(tmp_path / "pkg100", max_len=100)
+    (p,) = _prompts([12], seed=27)
+    ref = pm100.generate(p[None, :], 4)[0]
+    with pytest.warns(RuntimeWarning, match="kv_block_size"):
+        eng = ServingEngine(lm=pm100,
+                            cfg=EngineCfg(n_slots=2, steps_per_tick=2))
+    assert isinstance(eng.pool, BlockPool)
+    assert eng.pool.block_size == 10
+    with eng:
+        assert np.array_equal(eng.generate(p, 4).tokens, ref)
+        _pool_clean(eng.pool)
