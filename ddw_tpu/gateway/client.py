@@ -246,6 +246,57 @@ class GatewayClient:
             body["return_logits"] = True
         return self._json_call("POST", "/v1/predict", body)
 
+    # -- batch lane ----------------------------------------------------------
+    def submit_batch(self, items, kind: str = "generate",
+                     num_steps: int | None = None, temperature: float = 0.0,
+                     seed: int | None = None, window: int = 0) -> dict:
+        """Submit a batch-lane job; returns ``{"job_id", "kind", "total"}``.
+        The 429/503 backoff of :meth:`_request` applies to the submission
+        itself; item-level retry lives server-side in the job's pump."""
+        body: dict = {"kind": kind,
+                      "items": [np_tolist(x) for x in items],
+                      "temperature": temperature, "window": window}
+        if num_steps is not None:
+            body["num_steps"] = num_steps
+        if seed is not None:
+            body["seed"] = seed
+        return self._json_call("POST", "/v1/batch", body)
+
+    def batch_status(self, job_id: str) -> dict:
+        return self._json_call("GET", f"/v1/batch/{job_id}")
+
+    def batch_cancel(self, job_id: str) -> dict:
+        return self._json_call("DELETE", f"/v1/batch/{job_id}")
+
+    def batch_results(self, job_id: str) -> list[dict]:
+        """Completed rows (NDJSON body parsed), sorted by item index."""
+        status, _h, resp, conn = self._request(
+            "GET", f"/v1/batch/{job_id}/results")
+        try:
+            data = resp.read()
+            self._done(conn, resp)
+        except Exception:
+            conn.close()
+            raise
+        if status != 200:
+            raise GatewayError(status, json.loads(data or b"{}"))
+        return [json.loads(line) for line in data.splitlines() if line]
+
+    def batch_wait(self, job_id: str, timeout_s: float = 600.0,
+                   poll_s: float = 0.25) -> dict:
+        """Poll :meth:`batch_status` until the job is terminal; returns the
+        final progress dict, raises ``TimeoutError`` otherwise."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            st = self.batch_status(job_id)
+            if st["state"] in ("done", "cancelled"):
+                return st
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"batch job {job_id} not terminal after {timeout_s}s: "
+                    f"{st}")
+            time.sleep(poll_s)
+
     # -- control plane -------------------------------------------------------
     def healthz(self) -> dict:
         return self._json_call("GET", "/healthz")

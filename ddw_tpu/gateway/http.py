@@ -26,6 +26,18 @@ forms, never free-text parsing):
 ``POST /v1/predict``  ``{"image": [[[floats]]], "timeout_s": d?,
                       "return_logits": false?}`` → ``{label, index,
                       queue_ms, total_ms}``
+``POST /v1/batch``    ``{"kind": "generate"|"predict", "items": [...],
+                      "num_steps": N?, "temperature": t?, "seed": s?,
+                      "window": w?}`` → ``{"job_id", "kind", "total"}``.
+                      Submits a batch-LANE job: items backfill idle
+                      capacity behind the interactive reserve and are
+                      preempted first under interactive pressure (see
+                      docs/serving.md). The job is tracked host-side in
+                      the gateway's :class:`~ddw_tpu.serve.lanes.
+                      JobLedger` — it survives replica restarts.
+``GET /v1/batch/<id>``            poll: the job's ``progress()`` dict.
+``GET /v1/batch/<id>/results``    completed rows, NDJSON, index order.
+``DELETE /v1/batch/<id>``         cancel (completed rows are kept).
 ``GET /healthz``      process liveness — 200 from listener-up onward.
 ``GET /readyz``       load-balancer readiness — 200 only between warmup
                       completion and drain start, else 503.
@@ -66,6 +78,7 @@ from ddw_tpu.gateway.replica import ReplicaSet
 from ddw_tpu.gateway.supervisor import ReplicaSupervisor
 from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded, Rejected,
                                      ReplicaFailed, Unavailable)
+from ddw_tpu.serve.lanes import JobLedger
 
 __all__ = ["Gateway"]
 
@@ -187,6 +200,11 @@ class _Handler(BaseHTTPRequestHandler):
                                       "state": gw.lifecycle.state})
             elif self.path == "/readyz":
                 ready, body = gw.lifecycle.readiness()
+                try:
+                    body["lanes"] = gw.lane_stats()
+                except Exception:
+                    pass     # readiness must answer even if a replica's
+                #              health probe is mid-death
                 if ready:
                     self._send_json(200, body)
                 else:
@@ -205,10 +223,13 @@ class _Handler(BaseHTTPRequestHandler):
                        "connections": (gw._httpd.active_connections
                                        if gw._httpd else 0),
                        **gw.replica_set.snapshot(),
-                       "replica_health": gw.replica_set.fleet_health()}
+                       "replica_health": gw.replica_set.fleet_health(),
+                       "lanes": gw.lane_stats()}
                 if gw.supervisor is not None:
                     out["supervisor"] = gw.supervisor.report()
                 self._send_json(200, out)
+            elif self.path.startswith("/v1/batch/"):
+                self._batch_get(gw)
             else:
                 self._send_json(404, {"error": "not_found",
                                       "path": self.path})
@@ -218,7 +239,7 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST: the data plane -------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802
         gw = self.server.gateway
-        if self.path not in ("/v1/generate", "/v1/predict"):
+        if self.path not in ("/v1/generate", "/v1/predict", "/v1/batch"):
             self._send_json(404, {"error": "not_found", "path": self.path})
             return
         # admission into the lifecycle ledger FIRST: a draining or not-yet-
@@ -234,6 +255,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if self.path == "/v1/generate":
                 self._generate(gw, body)
+            elif self.path == "/v1/batch":
+                self._batch_submit(gw, body)
             else:
                 self._predict(gw, body)
         except (BrokenPipeError, ConnectionResetError):
@@ -368,6 +391,92 @@ class _Handler(BaseHTTPRequestHandler):
             out["logits"] = [float(x) for x in res.logits]
         self._send_json(200, out)
 
+    # -- batch lane (job submit / poll / results / cancel) --------------------
+    def _batch_submit(self, gw: "Gateway", body: dict) -> None:
+        try:
+            kind = str(body.get("kind", "generate"))
+            raw = body["items"]
+            if not isinstance(raw, list) or not raw:
+                raise ValueError("items must be a non-empty list")
+            if kind == "generate":
+                items = [np.asarray(x, np.int32) for x in raw]
+            else:
+                items = [np.asarray(x, np.float32) for x in raw]
+            kw = {"kind": kind,
+                  "temperature": float(body.get("temperature", 0.0)),
+                  "window": int(body.get("window", 0)),
+                  "timeout_s": float(body.get("timeout_s", 0.0))}
+            if body.get("num_steps") is not None:
+                kw["num_steps"] = int(body["num_steps"])
+            if body.get("seed") is not None:
+                kw["seed"] = int(body["seed"])
+        except (KeyError, TypeError, ValueError) as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": f"bad field: {e}"})
+            return
+        try:
+            job = gw.replica_set.submit_batch(items, ledger=gw.jobs, **kw)
+        except Rejected as e:
+            self._send_rejected(e)
+            return
+        except ValueError as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": str(e)})
+            return
+        self._send_json(200, {"job_id": job.job_id, "kind": job.kind,
+                              "total": job.total})
+
+    def _batch_job(self, gw: "Gateway"):
+        """Resolve ``/v1/batch/<id>[/results]`` → (job, tail) or None after
+        answering 404."""
+        parts = self.path.split("/")          # '', 'v1', 'batch', id[, tail]
+        job_id = parts[3] if len(parts) > 3 else ""
+        tail = parts[4] if len(parts) > 4 else ""
+        job = gw.jobs.get(job_id)
+        if job is None:
+            self._send_json(404, {"error": "not_found", "job_id": job_id})
+            return None
+        return job, tail
+
+    def _batch_get(self, gw: "Gateway") -> None:
+        hit = self._batch_job(gw)
+        if hit is None:
+            return
+        job, tail = hit
+        if tail == "results":
+            # completed rows so far, index order, one JSON object per line
+            data = "".join(json.dumps(r) + "\n"
+                           for r in job.result_rows()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        elif tail == "":
+            self._send_json(200, job.progress())
+        else:
+            self._send_json(404, {"error": "not_found", "path": self.path})
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        gw = self.server.gateway
+        try:
+            if not self.path.startswith("/v1/batch/"):
+                self._send_json(404, {"error": "not_found",
+                                      "path": self.path})
+                return
+            hit = self._batch_job(gw)
+            if hit is None:
+                return
+            job, tail = hit
+            if tail:
+                self._send_json(404, {"error": "not_found",
+                                      "path": self.path})
+                return
+            job.cancel()
+            self._send_json(200, job.progress())
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+
 
 class Gateway:
     """One serving process: HTTP listener + replica fleet + lifecycle.
@@ -399,6 +508,10 @@ class Gateway:
         self._supervise = supervise
         self._supervisor_kw = dict(supervisor_kw or {})
         self.supervisor: ReplicaSupervisor | None = None
+        # batch-lane job registry: host-side, above the replicas, so jobs
+        # survive engine restarts/recycles (the pump resubmits; results
+        # recorded here are never lost)
+        self.jobs = JobLedger()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, warmup_prompt_lens=(8,)) -> "Gateway":
@@ -424,6 +537,22 @@ class Gateway:
         self.lifecycle.mark_ready()
         return self
 
+    def lane_stats(self) -> dict:
+        """Per-lane fleet view for ``/stats`` and ``/readyz``: queue depths
+        summed across replicas, the worst reserve occupancy (one saturated
+        replica is the one a new interactive arrival might land on), and
+        the job ledger's accounting."""
+        interactive = batch = 0
+        occupancy = 0.0
+        for h in self.replica_set.fleet_health():
+            interactive += int(h.get("interactive_depth", 0) or 0)
+            batch += int(h.get("batch_depth", 0) or 0)
+            occupancy = max(occupancy,
+                            float(h.get("reserve_occupancy_pct", 0.0) or 0.0))
+        return {"interactive_depth": interactive, "batch_depth": batch,
+                "reserve_occupancy_pct": round(occupancy, 2),
+                **self.jobs.summary()}
+
     @property
     def port(self) -> int:
         if self._httpd is None:
@@ -443,6 +572,8 @@ class Gateway:
         with self._drain_lock:
             if not self.lifecycle.begin_drain():
                 return bool(self.drained_clean)
+            self.jobs.shutdown()   # stop the batch pumps first — nothing
+            #                        may resubmit into a closing fleet
             clean = self.lifecycle.await_drained(
                 grace_s if grace_s is not None else self.lifecycle.grace_s)
             if self.supervisor is not None:

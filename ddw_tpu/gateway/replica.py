@@ -437,6 +437,24 @@ class ReplicaSet:
     def submit_predict(self, item, **kw):
         return self._submit("submit_predict", (item,), kw)
 
+    def submit_batch_item(self, prompt, num_steps: int, **kw):
+        """Batch-lane generate item, routed like any other submission —
+        per-item routing is what makes a bulk job job-aware at the fleet
+        level: outstanding counts, breakers, and the sideways-429 spill
+        all apply per item, and a dead replica's items fail fast for the
+        job pump to resubmit."""
+        return self._submit("submit_batch_item", (prompt, num_steps), kw)
+
+    def submit_batch_predict(self, item, **kw):
+        return self._submit("submit_batch_predict", (item,), kw)
+
+    def submit_batch(self, items, kind: str = "generate", **kw):
+        """Start a host-side :class:`~ddw_tpu.serve.lanes.BatchJob` whose
+        items route across this set (see :func:`~ddw_tpu.serve.lanes.
+        start_batch_job` for the knobs)."""
+        from ddw_tpu.serve.lanes import start_batch_job
+        return start_batch_job(self, items, kind=kind, **kw)
+
     def generate(self, prompt, num_steps: int, **kw):
         return self.submit_generate(prompt, num_steps, **kw).result()
 

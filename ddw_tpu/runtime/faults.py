@@ -63,8 +63,13 @@ kill an engine loop, not the interpreter, and the match keys are per-replica
 rather than per-rank. A ``serve:``-prefixed spec targets those hooks and is
 invisible to the gang sites (and vice versa):
 
-    DDW_FAULT=serve:<kind>[:site=prefill|decode|admit|*][:replica=N|*]
+    DDW_FAULT=serve:<kind>[:site=prefill|decode|admit|batch|*][:replica=N|*]
                            [:after=N][:gen=N|*]
+
+The ``batch`` site fires at the batch lane's admission boundary (an engine
+about to backfill queued ``lm_batch``/``image_batch`` work into idle
+capacity) — the drill point for killing a replica mid-job and asserting the
+host-side job ledger resumes with no duplicated or lost items.
 
 Serve kinds: ``crash`` (raise :class:`ServeCrash` — the engine loop dies,
 transitions the replica to its terminal FAILED state and fails every pending
@@ -275,7 +280,7 @@ def _write_torn_step_dir(ckpt_dir: str, step: int) -> str:
 # ---------------------------------------------------------------------------
 
 SERVE_KINDS = ("crash", "raise", "stall")
-SERVE_SITES = ("prefill", "decode", "admit")
+SERVE_SITES = ("prefill", "decode", "admit", "batch")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -23,6 +23,11 @@ from ddw_tpu.serve.engine import (  # noqa: F401
     PredictResult,
     ServingEngine,
 )
+from ddw_tpu.serve.lanes import (  # noqa: F401
+    BatchJob,
+    JobLedger,
+    start_batch_job,
+)
 from ddw_tpu.serve.metrics import (  # noqa: F401
     LATENCY_BUCKETS_MS,
     EngineMetrics,

@@ -131,13 +131,24 @@ class AdmissionController:
     """Bounded per-kind FIFOs with deadline-aware dequeue. Thread-safe:
     callers submit from any thread; the engine loop drains from one."""
 
-    def __init__(self, capacity: int, clock=time.monotonic):
+    def __init__(self, capacity: int, clock=time.monotonic,
+                 per_kind: dict[str, int] | None = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        for k, c in (per_kind or {}).items():
+            if c < 1:
+                raise ValueError(
+                    f"per-kind capacity must be >= 1, got {k}={c}")
         self.capacity = capacity
+        self.per_kind = dict(per_kind or {})  # kind -> capacity override
+        #                      (the batch lane queues deeper than the
+        #                      interactive default — backlog is its job)
         self._clock = clock
         self._queues: dict[str, collections.deque] = {}
         self._lock = threading.Lock()
+
+    def capacity_for(self, kind: str) -> int:
+        return self.per_kind.get(kind, self.capacity)
 
     def depth(self, kind: str | None = None) -> int:
         with self._lock:
@@ -189,8 +200,9 @@ class AdmissionController:
         per-kind (an LM burst must not starve image admission)."""
         with self._lock:
             q = self._queues.setdefault(kind, collections.deque())
-            if len(q) >= self.capacity:
-                raise Overloaded(kind, self.capacity, len(q), retry_after_ms)
+            cap = self.per_kind.get(kind, self.capacity)
+            if len(q) >= cap:
+                raise Overloaded(kind, cap, len(q), retry_after_ms)
             q.append(request)
 
     def take(self, kind: str, max_n: int) -> tuple[list, list]:

@@ -69,9 +69,11 @@ class OutOfBlocks(RuntimeError):
 class _Stream:
     """One resident request's pool-side state (host bookkeeping only)."""
 
-    __slots__ = ("row", "blocks", "prompt_len", "filled", "total", "seq")
+    __slots__ = ("row", "blocks", "prompt_len", "filled", "total", "seq",
+                 "lane")
 
-    def __init__(self, row: int, prompt_len: int, total: int, seq: int):
+    def __init__(self, row: int, prompt_len: int, total: int, seq: int,
+                 lane: str = "interactive"):
         self.row = row
         self.blocks: list[int] = []   # physical block ids, table order
         self.prompt_len = prompt_len  # effective prompt (incl. resumed toks)
@@ -79,6 +81,9 @@ class _Stream:
         self.total = total            # positions ever needed: P + steps - 1
         self.seq = seq                # admission order (preemption victims
         #                               are picked youngest-first)
+        self.lane = lane              # "interactive" | "batch" — batch
+        #                               streams are preempted before ANY
+        #                               interactive stream
 
 
 class BlockPool:
@@ -98,9 +103,12 @@ class BlockPool:
     def __init__(self, model: TransformerLM, params, n_blocks: int,
                  block_size: int, max_resident: int,
                  steps_per_tick: int = 4, donate: bool = True,
-                 overcommit: float = 1.0):
+                 overcommit: float = 1.0, interactive_reserve: int = 0):
         if n_blocks < 1:
             raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        if interactive_reserve < 0:
+            raise ValueError(f"interactive_reserve must be >= 0, got "
+                             f"{interactive_reserve}")
         if max_resident < 1:
             raise ValueError(
                 f"max_resident must be >= 1, got {max_resident}")
@@ -121,6 +129,10 @@ class BlockPool:
         self.steps_per_tick = steps_per_tick
         self.max_len = model.max_len
         self.overcommit = overcommit
+        self.interactive_reserve = interactive_reserve  # blocks held back
+        #                             from BATCH-lane admission so an
+        #                             interactive arrival never waits on a
+        #                             batch release (ddw_tpu.serve.lanes)
         self.params = params
         self._donate = donate
         cap = -(-model.max_len // tile) * tile
@@ -156,7 +168,7 @@ class BlockPool:
             collections.OrderedDict()             # idle registered, LRU
         self.stats = {"prefix_hit_tokens": 0, "prefix_hit_blocks": 0,
                       "prefix_miss_blocks": 0, "cow_copies": 0,
-                      "preemptions": 0}
+                      "preemptions": 0, "batch_preemptions": 0}
 
     def reset(self) -> None:
         """Fresh device + host state after an engine failure (the
@@ -187,15 +199,36 @@ class BlockPool:
         generated token EXCEPT the last (picked, never fed back)."""
         return prompt_len + num_steps - 1
 
-    def can_admit(self, prompt_len: int, num_steps: int) -> bool:
+    def can_admit(self, prompt_len: int, num_steps: int,
+                  lane: str = "interactive") -> bool:
         """Admission on free BLOCKS, not free rows: conservative — counts
         the request's worst-case need against free-minus-committed (prefix
-        hits only ever help). ``overcommit`` scales the budget."""
+        hits only ever help). ``overcommit`` scales the budget. The BATCH
+        lane admits only what fits BEHIND the interactive-reserve
+        watermark: its budget is docked ``interactive_reserve`` blocks, so
+        batch backfill can never occupy the headroom an interactive
+        arrival would otherwise have to preempt for."""
         if not self._free_rows:
             return False
         need = self.blocks_for(self.total_positions(prompt_len, num_steps))
         budget = self.free_blocks_effective * self.overcommit
+        if lane == "batch":
+            budget -= self.interactive_reserve
         return budget - self._committed >= need
+
+    @property
+    def reserve_occupancy_pct(self) -> float:
+        """How much of the interactive reserve is currently eaten into:
+        0 means the full reserve sits uncommitted (an interactive arrival
+        needing up to ``interactive_reserve`` blocks admits instantly),
+        100 means interactive traffic itself has consumed it all (batch
+        admission is then fully shut; interactive keeps admitting on the
+        plain budget and, past that, preempts batch residents)."""
+        if not self.interactive_reserve:
+            return 0.0
+        avail = self.free_blocks_effective - self._committed
+        free = max(0, min(self.interactive_reserve, avail))
+        return 100.0 * (1.0 - free / self.interactive_reserve)
 
     def min_remaining_steps(self) -> int | None:
         """Fewest cache positions any resident stream still needs — the
@@ -207,6 +240,11 @@ class BlockPool:
     def gauges(self) -> dict[str, float]:
         used = self.n_blocks - len(self._free) - len(self._cached)
         toks = sum(st.filled for st in self._streams.values())
+        nbatch = sum(1 for st in self._streams.values()
+                     if st.lane == "batch")
+        # reserve gauges are summable across replicas; the occupancy ratio
+        # is derived at snapshot/render time from the summed pair
+        avail = self.free_blocks_effective - self._committed
         return {
             "blocks_total": float(self.n_blocks),
             "blocks_free": float(len(self._free)),
@@ -215,6 +253,10 @@ class BlockPool:
             "block_tokens_used": float(toks),
             "block_tokens_capacity": float(used * self.block_size),
             "resident_streams": float(len(self._streams)),
+            "batch_resident_streams": float(nbatch),
+            "interactive_reserve_blocks": float(self.interactive_reserve),
+            "reserve_free_blocks": float(
+                max(0, min(self.interactive_reserve, avail))),
         }
 
     # -- allocator ------------------------------------------------------------
@@ -285,7 +327,8 @@ class BlockPool:
         return min(hit, p - 1)
 
     def admit(self, prompt: np.ndarray, num_steps: int,
-              seq_hint: int | None = None) -> tuple[int, int]:
+              seq_hint: int | None = None,
+              lane: str = "interactive") -> tuple[int, int]:
         """Claim a row and the prompt's blocks for one request. Prefix-hit
         FULL blocks the request never writes are shared by refcount; the
         block holding the first written position (``hit`` onward) is cloned
@@ -302,7 +345,8 @@ class BlockPool:
         hit = self.lookup(prompt)
         hashes = self._chain_hashes(prompt)
         st = _Stream(self._free_rows[-1], p,
-                     self.total_positions(p, num_steps), self._seq)
+                     self.total_positions(p, num_steps), self._seq,
+                     lane=lane)
         blocks: list[int] = []
         try:
             # shared full hit blocks: everything strictly before the first
@@ -393,6 +437,8 @@ class BlockPool:
         self._free_rows.append(row)
         if preempted:
             self.stats["preemptions"] += 1
+            if st.lane == "batch":
+                self.stats["batch_preemptions"] += 1
 
     # -- decode-tick allocation (+ preemption policy) -------------------------
     def _extend(self, st: _Stream, k: int) -> None:
@@ -406,26 +452,47 @@ class BlockPool:
 
     def prepare_tick(self, k: int) -> list[int]:
         """On-demand allocation for one decode tick: every resident stream
-        gets blocks covering its next ``min(k, remaining)`` writes. On
-        exhaustion the YOUNGEST stream is preempted (blocks released, row
-        freed) and allocation retries — oldest streams always make
-        progress, so the policy cannot livelock. Returns the preempted
-        rows; the engine re-queues their requests at the queue head."""
+        gets blocks covering its next ``min(k, remaining)`` writes —
+        interactive streams first, so on a contended tick the batch lane
+        is the one that goes short. On exhaustion the victim is the
+        YOUNGEST stream of the LOWEST lane: any batch resident is
+        preempted (blocks released, row freed) before any interactive
+        stream — the lane contract — and allocation retries; within a
+        lane, youngest-first means oldest streams always make progress,
+        so the policy cannot livelock. Returns the preempted rows; the
+        engine re-queues their requests at their lane's queue head."""
         victims: list[int] = []
-        for st in sorted(self._streams.values(), key=lambda s: s.seq):
+        order = sorted(self._streams.values(),
+                       key=lambda s: (s.lane == "batch", s.seq))
+        for st in order:
             while st.row in self._streams:
                 try:
                     self._extend(st, k)
                     break
                 except OutOfBlocks:
                     live = [s for s in self._streams.values() if s is not st]
-                    victim = (max(live, key=lambda s: s.seq)
+                    victim = (max(live,
+                                  key=lambda s: (s.lane == "batch", s.seq))
                               if live else st)
                     self.release(victim.row, preempted=True)
                     victims.append(victim.row)
                     if victim is st:
                         break
         return victims
+
+    def preempt_youngest(self, lane: str = "batch") -> int | None:
+        """Preempt the youngest resident stream of ``lane`` outright —
+        the admission-side arm of the lane contract: when an interactive
+        head cannot fit (blocks or rows), batch residents are evicted by
+        recompute BEFORE the head waits on anything interactive. Returns
+        the freed row (the engine re-queues its request) or None when no
+        stream of that lane is resident."""
+        cands = [s for s in self._streams.values() if s.lane == lane]
+        if not cands:
+            return None
+        victim = max(cands, key=lambda s: s.seq)
+        self.release(victim.row, preempted=True)
+        return victim.row
 
     # -- device programs ------------------------------------------------------
     def table(self, row: int) -> np.ndarray:

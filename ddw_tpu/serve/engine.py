@@ -28,6 +28,12 @@ the device busy with a small, fixed set of compiled programs:
   and latency tails per request, exportable into a ``tracking.Run`` (with
   ``utils.sysmon.SystemMonitor`` sampling utilization alongside) so serving
   runs are first-class tracked artifacts.
+- **lanes** (:mod:`ddw_tpu.serve.lanes`): a second, throughput-SLO BATCH
+  lane (``submit_batch`` bulk jobs, ``submit_batch_item`` /
+  ``submit_batch_predict`` per item) backfills idle blocks behind an
+  interactive-reserve watermark; interactive traffic always wins —
+  admission precedence, batch-first preemption — and batch outputs stay
+  bit-identical to the direct offline path (docs/serving.md).
 
 The engine is in-process by design — the same shape as the rest of the
 stack (the Launcher's np=-1 mode, the in-tree tracker): everything behind
@@ -130,6 +136,19 @@ class EngineCfg:
     #                             memory)
     block_overcommit: float = 1.0  # >1 oversubscribes the block budget and
     #                             relies on mid-decode preemption (tests)
+    # dual-lane scheduler (ddw_tpu.serve.lanes): a throughput-SLO batch
+    # lane backfills idle blocks BEHIND an interactive reserve; the
+    # interactive lane always wins (admission precedence + batch-first
+    # preemption).
+    batch_queue_depth: int = 256   # bounded batch-lane queue per kind —
+    #                             deeper than queue_depth on purpose
+    #                             (backlog is the batch lane's job; it
+    #                             yields, so depth never delays interactive)
+    interactive_reserve_blocks: int = -1  # KV blocks held back from batch
+    #                             admission; -1 = auto (n_blocks // 4),
+    #                             0 = no reserve (batch may fill the pool)
+    batch_rows_headroom: int = 1   # resident ROWS a fresh batch admission
+    #                             must leave free for interactive arrivals
 
 
 @dataclasses.dataclass
@@ -165,10 +184,10 @@ class _Times:
 class _LMRequest:
     __slots__ = ("prompt", "num_steps", "temperature", "keys", "deadline",
                  "future", "times", "tokens", "emitted", "on_token",
-                 "claimed")
+                 "claimed", "lane")
 
     def __init__(self, prompt, num_steps, temperature, keys, deadline, now,
-                 on_token=None):
+                 on_token=None, lane="interactive"):
         self.prompt = prompt
         self.num_steps = num_steps
         self.temperature = temperature
@@ -182,6 +201,9 @@ class _LMRequest:
         self.claimed = False        # future transitioned to RUNNING (set
         #                             once; a preempted-and-requeued request
         #                             must not re-claim)
+        self.lane = lane            # "interactive" | "batch" — decides the
+        #                             requeue kind after a preemption and
+        #                             the RequestRecord's lane label
 
     def effective_prompt(self) -> np.ndarray:
         """The prompt a (re-)prefill must run: the original tokens plus
@@ -215,14 +237,15 @@ class _LMRequest:
 
 
 class _ImageRequest:
-    __slots__ = ("image", "deadline", "future", "times", "claimed")
+    __slots__ = ("image", "deadline", "future", "times", "claimed", "lane")
 
-    def __init__(self, image, deadline, now):
+    def __init__(self, image, deadline, now, lane="interactive"):
         self.image = image
         self.deadline = deadline
         self.future = concurrent.futures.Future()
         self.times = _Times(now)
         self.claimed = False
+        self.lane = lane
 
 
 class ServingEngine:
@@ -243,7 +266,10 @@ class ServingEngine:
         self.cfg = cfg or EngineCfg()
         self.run = run
         self.metrics = EngineMetrics()
-        self._ctrl = AdmissionController(self.cfg.queue_depth)
+        self._ctrl = AdmissionController(
+            self.cfg.queue_depth,
+            per_kind={"lm_batch": self.cfg.batch_queue_depth,
+                      "image_batch": self.cfg.batch_queue_depth})
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -295,12 +321,16 @@ class ServingEngine:
                 n_blocks = self.cfg.kv_cache_blocks or (
                     self.cfg.n_slots * cap // block_size)
                 n = self.cfg.max_resident or 2 * self.cfg.n_slots
+                reserve = self.cfg.interactive_reserve_blocks
+                if reserve < 0:
+                    reserve = n_blocks // 4   # auto: a quarter of the pool
                 self.pool = BlockPool(
                     model, self._lm.params, n_blocks=n_blocks,
                     block_size=block_size, max_resident=n,
                     steps_per_tick=self.cfg.steps_per_tick,
                     donate=self.cfg.donate,
-                    overcommit=self.cfg.block_overcommit)
+                    overcommit=self.cfg.block_overcommit,
+                    interactive_reserve=reserve)
             else:
                 self.pool = SlotPool(self._lm.model, self._lm.params,
                                      self.cfg.n_slots,
@@ -405,15 +435,28 @@ class ServingEngine:
                                 if running else 0.0),
             "consecutive_errors": self._consecutive_errors,
             "queue_depth": self._ctrl.depth(),
+            "interactive_depth": (self._ctrl.depth("lm")
+                                  + self._ctrl.depth("image")),
+            "batch_depth": (self._ctrl.depth("lm_batch")
+                            + self._ctrl.depth("image_batch")),
             "busy_slots": len(self._slot_req) if self.pool is not None else 0,
+            "reserve_occupancy_pct": (
+                round(self.pool.reserve_occupancy_pct, 2)
+                if isinstance(self.pool, BlockPool) else 0.0),
             "draining": self._draining.is_set(),
         }
 
     def load(self) -> dict:
         """What admission-aware routing needs: queued + on-device work and
-        the decaying per-request service estimate (ms)."""
-        return {"depth": self._ctrl.depth(),
+        the decaying per-request service estimate (ms). ``depth`` counts
+        the INTERACTIVE lanes only — batch backlog yields to interactive
+        arrivals (admission precedence + batch-first preemption), so it
+        does not project interactive wait; it rides separately as
+        ``batch_depth`` so job-aware accounting stays visible."""
+        return {"depth": self._ctrl.depth("lm") + self._ctrl.depth("image"),
                 "busy": len(self._slot_req) if self.pool is not None else 0,
+                "batch_depth": (self._ctrl.depth("lm_batch")
+                                + self._ctrl.depth("image_batch")),
                 "service_ms": self._service_ms}
 
     def force_fail(self, kind: str = "stalled", reason: str = "") -> None:
@@ -477,7 +520,8 @@ class ServingEngine:
         while time.monotonic() < deadline:
             busy = ((len(self._slot_req) if self.pool is not None else 0)
                     + len(self._inflight_admit)
-                    + self._ctrl.count_claimed("lm"))
+                    + self._ctrl.count_claimed("lm")
+                    + self._ctrl.count_claimed("lm_batch"))
             if busy == 0 and self._failure is None:
                 return True
             if self._failure is not None:
@@ -535,10 +579,12 @@ class ServingEngine:
         if getattr(req, "emitted", 0):
             raise ValueError("cannot adopt a request that already emitted "
                              "tokens")
-        if kind == "lm" and self._lm is None:
+        if kind in ("lm", "lm_batch") and self._lm is None:
             raise ValueError("engine was built without an LM model")
-        if kind == "image" and self._image is None:
+        if kind in ("image", "image_batch") and self._image is None:
             raise ValueError("engine was built without an image model")
+        if kind == "lm_batch" and not isinstance(self.pool, BlockPool):
+            raise ValueError("the batch lane requires the paged pool")
         self._offer(kind, req)
         self.metrics.count("failovers")
 
@@ -568,6 +614,13 @@ class ServingEngine:
         the request is still queued (dropped before any device work,
         counted as ``serve.cancelled``); once admitted to a slot it runs to
         completion."""
+        req = self._make_lm_request(prompt, num_steps, temperature, rng,
+                                    timeout_s, on_token, "interactive")
+        self._offer("lm", req)
+        return req.future
+
+    def _make_lm_request(self, prompt, num_steps, temperature, rng,
+                         timeout_s, on_token, lane) -> "_LMRequest":
         if self._lm is None:
             raise ValueError("engine was built without an LM model")
         prompt = np.asarray(prompt, np.int32)
@@ -588,12 +641,17 @@ class ServingEngine:
         if isinstance(self.pool, BlockPool):
             need = self.pool.blocks_for(
                 self.pool.total_positions(prompt.size, num_steps))
-            if need > self.pool.n_blocks:
+            ceiling = self.pool.n_blocks
+            if lane == "batch":
+                # a batch item must fit BEHIND the reserve watermark —
+                # one that never can would wedge the batch queue head
+                ceiling -= self.pool.interactive_reserve
+            if need > ceiling:
                 # would wedge the queue head forever — no release can
                 # ever satisfy it
                 raise ValueError(
-                    f"request needs {need} KV blocks but the pool only "
-                    f"has {self.pool.n_blocks}")
+                    f"request needs {need} KV blocks but the {lane} lane "
+                    f"only ever has {ceiling}")
         if temperature < 0.0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if temperature > 0.0 and rng is None:
@@ -605,15 +663,58 @@ class ServingEngine:
             keys = np.asarray(jax.random.split(rng, num_steps))
         now = time.monotonic()
         timeout = self.cfg.default_timeout_s if timeout_s is None else timeout_s
-        req = _LMRequest(prompt, num_steps, float(temperature), keys,
-                         now + timeout if timeout else None, now,
-                         on_token=on_token)
-        self._offer("lm", req)
-        return req.future
+        return _LMRequest(prompt, num_steps, float(temperature), keys,
+                          now + timeout if timeout else None, now,
+                          on_token=on_token, lane=lane)
 
     def generate(self, prompt, num_steps: int, **kw) -> GenerateResult:
         """Synchronous :meth:`submit_generate`."""
         return self.submit_generate(prompt, num_steps, **kw).result()
+
+    def submit_batch_item(self, prompt, num_steps: int,
+                          temperature: float = 0.0, rng=None,
+                          timeout_s: float | None = 0.0
+                          ) -> concurrent.futures.Future:
+        """Queue ONE batch-lane LM continuation — the per-item primitive a
+        :class:`~ddw_tpu.serve.lanes.BatchJob` pump feeds. Same contract
+        as :meth:`submit_generate` (bit-identical outputs — the lane only
+        changes WHEN a stream runs, never what it computes) except: it
+        joins the ``lm_batch`` queue, which admits only behind an empty
+        interactive queue and the block reserve, is preempted first, and
+        carries NO default deadline (``timeout_s=0`` — throughput SLO;
+        pass a positive value to impose one). Requires the paged pool."""
+        if self._lm is not None and not isinstance(self.pool, BlockPool):
+            raise ValueError("the batch lane requires the paged pool "
+                             "(EngineCfg(paged=True))")
+        req = self._make_lm_request(prompt, num_steps, temperature, rng,
+                                    timeout_s, None, "batch")
+        self._offer("lm_batch", req)
+        return req.future
+
+    def submit_batch_predict(self, item, timeout_s: float | None = 0.0
+                             ) -> concurrent.futures.Future:
+        """Queue one batch-lane image prediction: served only when no
+        interactive image request is waiting; no default deadline."""
+        if self._image is None:
+            raise ValueError("engine was built without an image model")
+        image = self._image.decode_one(item)
+        now = time.monotonic()
+        timeout = (self.cfg.default_timeout_s if timeout_s is None
+                   else timeout_s)
+        req = _ImageRequest(np.asarray(image, np.float32),
+                            now + timeout if timeout else None, now,
+                            lane="batch")
+        self._offer("image_batch", req)
+        return req.future
+
+    def submit_batch(self, items, kind: str = "generate", **kw):
+        """Submit a bulk job as one :class:`~ddw_tpu.serve.lanes.BatchJob`
+        (returned immediately): per-item futures are pumped through the
+        batch lane with bounded in-flight window, per-item progress, and
+        retry-on-replica-failure — see :mod:`ddw_tpu.serve.lanes`."""
+        from ddw_tpu.serve.lanes import start_batch_job
+
+        return start_batch_job(self, items, kind=kind, **kw)
 
     def submit_predict(self, item, timeout_s: float | None = None
                        ) -> concurrent.futures.Future:
@@ -670,7 +771,7 @@ class ServingEngine:
             # recycling: an honest load refusal (not a failure — the
             # breaker stays neutral, routing spills to a sibling)
             self.metrics.count_overloaded()
-            raise Overloaded(kind, self._ctrl.capacity,
+            raise Overloaded(kind, self._ctrl.capacity_for(kind),
                              self._ctrl.depth(kind),
                              retry_after_ms=self._service_ms or 100.0)
         try:
@@ -690,7 +791,8 @@ class ServingEngine:
         rate. The slot pool keeps the coarser depth * service estimate."""
         depth_ms = (self._service_ms * (self._ctrl.depth(kind) + 1)
                     if self._service_ms else None)
-        if kind != "lm" or not isinstance(self.pool, BlockPool):
+        if (kind not in ("lm", "lm_batch")
+                or not isinstance(self.pool, BlockPool)):
             return depth_ms
         remaining = self.pool.min_remaining_steps()
         if remaining is None or not self._per_token_ms:
@@ -699,8 +801,9 @@ class ServingEngine:
                 + (self._service_ms * self._ctrl.depth(kind)))
 
     def _fail_pending(self, exc: Exception) -> None:
-        for kind in ("lm", "image"):
-            drained, expired = self._ctrl.take(kind, self._ctrl.capacity)
+        for kind in ("lm", "lm_batch", "image", "image_batch"):
+            drained, expired = self._ctrl.take(
+                kind, self._ctrl.depth(kind) + 1)
             for req in drained + expired:
                 if not req.future.done():
                     req.future.set_exception(exc)
@@ -737,7 +840,7 @@ class ServingEngine:
         try:
             while not self._stop.is_set():
                 worked = False
-                for kind in ("lm", "image"):
+                for kind in ("lm", "lm_batch", "image", "image_batch"):
                     for req in self._ctrl.shed_expired(kind):
                         self._shed(req, kind)
                         worked = True
@@ -746,6 +849,7 @@ class ServingEngine:
                     worked |= self._guarded(self._decode_tick)
                 if self._image is not None:
                     worked |= self._guarded(self._image_tick)
+                    worked |= self._guarded(self._image_batch_tick)
                 self._last_tick = time.monotonic()   # the loop heartbeat
                 if not worked:
                     with self._cv:
@@ -864,8 +968,9 @@ class ServingEngine:
         # queued work: cancelled drops, expired sheds, the rest is
         # salvageable (nothing emitted — a sibling can serve it bit-for-bit)
         salvage = []
-        for kind_ in ("lm", "image"):
-            drained, expired = self._ctrl.take(kind_, self._ctrl.capacity)
+        for kind_ in ("lm", "lm_batch", "image", "image_batch"):
+            drained, expired = self._ctrl.take(
+                kind_, self._ctrl.depth(kind_) + 1)
             for req in expired:
                 self._shed(req, kind_)
             for req in drained:
@@ -912,38 +1017,63 @@ class ServingEngine:
             if delta > 0:
                 self.metrics.count(key, delta)
             self._pool_stats_seen[key] = val
-        self.metrics.set_gauges(pool.gauges())
+        gauges = pool.gauges()
+        gauges["batch_backlog"] = float(self._ctrl.depth("lm_batch")
+                                        + self._ctrl.depth("image_batch"))
+        self.metrics.set_gauges(gauges)
 
-    def _admit_lm_paged(self, drain_only: bool = False) -> bool:
-        """Admission on free BLOCKS: pop queued requests head-first while
-        the pool's conservative block budget accepts them (head-of-line
-        blocking is deliberate — skipping ahead would starve long prompts),
-        then prefill each request's uncovered SUFFIX in per-bucket groups.
-        Prefix-hit tokens never touch the device. ``drain_only`` (set while
-        draining) admits only already-claimed requests — preempted streams
-        sit at the queue HEAD (requeue_front), so stopping at the first
-        unclaimed head lets all of them finish without taking new work."""
+    def _preempt_batch_for_interactive(self) -> bool:
+        """Admission-side lane contract: an interactive head under block or
+        row pressure evicts the youngest resident BATCH stream by
+        recompute (before waiting on anything interactive). The victim's
+        request re-queues at the batch queue head with completed tokens
+        intact and resumes bit-identically — nothing is lost, only
+        deferred. Returns False when no batch stream is resident (the
+        head then waits on interactive releases like before lanes)."""
+        row = self.pool.preempt_youngest(lane="batch")
+        if row is None:
+            return False
+        req = self._slot_req.pop(row)
+        self._cur[row] = 0
+        self._temps[row] = 0.0
+        self._ctrl.requeue_front("lm_batch", req)
+        return True
+
+    def _pop_lane_paged(self, kind: str, lane: str, picked: list,
+                        drain_only: bool) -> bool:
+        """Head-first pop loop for one lane's queue into ``picked``.
+        Interactive runs first and may preempt batch residents to fit its
+        head; a FRESH batch head additionally requires an empty
+        interactive queue (strict precedence), the reserve-aware block
+        budget, and ``batch_rows_headroom`` spare rows — an already-
+        claimed (preempted) batch head is in-flight work and re-admits on
+        the plain row bound so drain can finish it."""
         pool = self.pool
         worked = False
-        if self._ctrl.depth("lm") > 0 and pool.free_slots > 0:
-            self._fault("admit")     # admission boundary: nothing claimed
-            #                          yet, queued work stays salvageable
-        picked: list = []            # (req, eff_prompt, row, hit)
-        while pool.free_slots > 0:
-            head = self._ctrl.peek("lm")
+        batch = lane == "batch"
+        while True:
+            head = self._ctrl.peek(kind)
             if head is None:
                 break
             if drain_only and not getattr(head, "claimed", False):
                 break
+            if batch and not head.claimed and self._ctrl.depth("lm") > 0:
+                break               # interactive always wins admission
+            min_rows = (1 if not batch or head.claimed
+                        else 1 + max(self.cfg.batch_rows_headroom, 0))
             eff = head.effective_prompt()
             # a resumed stream re-derives its newest pick from the prefill
             # logits, so its remaining picks = num_steps - (emitted - 1)
             ns = head.num_steps - max(head.emitted - 1, 0)
-            if not pool.can_admit(len(eff), ns):
+            if (pool.free_slots < min_rows
+                    or not pool.can_admit(len(eff), ns, lane=lane)):
+                if not batch and self._preempt_batch_for_interactive():
+                    worked = True
+                    continue        # re-check the head against freed space
                 break
-            got, expired = self._ctrl.take("lm", 1)
+            got, expired = self._ctrl.take(kind, 1)
             for r in expired:
-                self._shed(r, "lm")
+                self._shed(r, kind)
                 worked = True
             if not got:
                 continue
@@ -953,24 +1083,52 @@ class ServingEngine:
                 # (and prompt!) belong to a shed head — recompute for the
                 # request actually popped, and give back what no longer fits
                 if drain_only and not getattr(req, "claimed", False):
-                    self._ctrl.requeue_front("lm", req)
+                    self._ctrl.requeue_front(kind, req)
                     break
                 eff = req.effective_prompt()
                 ns = req.num_steps - max(req.emitted - 1, 0)
-                if not pool.can_admit(len(eff), ns):
-                    self._ctrl.requeue_front("lm", req)
+                if not pool.can_admit(len(eff), ns, lane=lane):
+                    self._ctrl.requeue_front(kind, req)
                     break
             if not self._claim(req):
                 worked = True
                 continue
             try:
-                row, hit = pool.admit(eff, ns)
+                row, hit = pool.admit(eff, ns, lane=lane)
             except OutOfBlocks:
                 # overcommitted budget met a physically empty pool —
                 # admit() unwound cleanly; head-of-line waits for releases
-                self._ctrl.requeue_front("lm", req)
+                self._ctrl.requeue_front(kind, req)
                 break
             picked.append((req, eff, row, hit))
+        return worked
+
+    def _admit_lm_paged(self, drain_only: bool = False) -> bool:
+        """Admission on free BLOCKS: pop queued requests head-first while
+        the pool's conservative block budget accepts them (head-of-line
+        blocking is deliberate — skipping ahead would starve long prompts),
+        then prefill each request's uncovered SUFFIX in per-bucket groups.
+        Prefix-hit tokens never touch the device. Two lanes feed the same
+        prefill groups: interactive first (preempting batch residents on
+        pressure), then batch backfill behind the reserve watermark — one
+        dispatch serves both, so the lane split costs no extra programs.
+        ``drain_only`` (set while draining) admits only already-claimed
+        requests — preempted streams sit at the queue HEAD
+        (requeue_front), so stopping at the first unclaimed head lets all
+        of them finish without taking new work."""
+        pool = self.pool
+        worked = False
+        if self._ctrl.depth("lm") > 0 and pool.free_slots > 0:
+            self._fault("admit")     # admission boundary: nothing claimed
+            #                          yet, queued work stays salvageable
+        if self._ctrl.depth("lm_batch") > 0 and pool.free_slots > 0:
+            self._fault("batch")     # batch admission boundary — the
+            #                          mid-job chaos drill's kill site
+        picked: list = []            # (req, eff_prompt, row, hit)
+        worked |= self._pop_lane_paged("lm", "interactive", picked,
+                                       drain_only)
+        worked |= self._pop_lane_paged("lm_batch", "batch", picked,
+                                       drain_only)
         if not picked:
             self._sync_pool_stats()
             return worked
@@ -1101,14 +1259,16 @@ class ServingEngine:
         k = self.cfg.steps_per_tick
         if isinstance(self.pool, BlockPool):
             # on-demand block allocation for this tick; exhaustion (only
-            # reachable with block_overcommit > 1) preempts the YOUNGEST
-            # streams by recompute — their requests go back to the queue
-            # HEAD with tokens intact and resume bit-identically
+            # reachable with block_overcommit > 1) preempts by recompute —
+            # BATCH streams first, then youngest interactive — requests go
+            # back to their lane's queue HEAD with tokens intact and
+            # resume bit-identically
             for row in self.pool.prepare_tick(k):
                 req = self._slot_req.pop(row)
                 self._cur[row] = 0
                 self._temps[row] = 0.0
-                self._ctrl.requeue_front("lm", req)
+                self._ctrl.requeue_front(
+                    "lm_batch" if req.lane == "batch" else "lm", req)
             if not self._slot_req:
                 self._sync_pool_stats()
                 return True
@@ -1144,7 +1304,7 @@ class ServingEngine:
         t = req.times
         gen_s = max(t.done - t.first_output, 1e-9)
         rec = RequestRecord("lm", t.submitted, t.admitted, t.first_output,
-                            t.done, tokens=req.num_steps)
+                            t.done, tokens=req.num_steps, lane=req.lane)
         self.metrics.record(rec)
         self._update_service(rec.total_ms)
         per_tok = rec.total_ms / max(req.num_steps, 1)
@@ -1170,9 +1330,32 @@ class ServingEngine:
             if waited is None or waited * 1e3 < self.cfg.max_wait_ms:
                 return False
         self._fault("admit")
-        admitted, expired = self._ctrl.take("image", self.cfg.max_batch)
+        return self._serve_image_batch("image")
+
+    def _image_batch_tick(self) -> bool:
+        """Backfill lane for image scoring: forms a batch only when NO
+        interactive image request is waiting (strict lane precedence) and
+        with no formation window — bulk jobs arrive as a standing backlog,
+        so waiting buys nothing a throughput SLO notices."""
+        if self._draining.is_set():
+            return False
+        if self._ctrl.depth("image_batch") == 0:
+            return False
+        if self._ctrl.depth("image") > 0:
+            return False        # interactive always wins the dispatch
+        self._fault("batch")    # batch admission boundary (chaos drills)
+        worked = self._serve_image_batch("image_batch")
+        if not isinstance(self.pool, BlockPool):
+            # image-only engines have no pool gauge push: keep the batch
+            # backlog gauge fresh from here
+            self.metrics.set_gauges({"batch_backlog": float(
+                self._ctrl.depth("image_batch"))})
+        return worked
+
+    def _serve_image_batch(self, kind: str) -> bool:
+        admitted, expired = self._ctrl.take(kind, self.cfg.max_batch)
         for req in expired:
-            self._shed(req, "image")
+            self._shed(req, kind)
         n_taken = len(admitted)
         admitted = [r for r in admitted if self._claim(r)]
         self._inflight_admit = list(admitted)
@@ -1194,7 +1377,8 @@ class ServingEngine:
         for i, req in enumerate(admitted):
             req.times.first_output = req.times.done = done
             rec = RequestRecord("image", req.times.submitted,
-                                req.times.admitted, done, done)
+                                req.times.admitted, done, done,
+                                lane=req.lane)
             self.metrics.record(rec)
             self._update_service(rec.total_ms)
             idx = int(np.argmax(logits[i]))

@@ -1,0 +1,429 @@
+"""Dual-lane scheduler — batch backfill jobs under live interactive traffic.
+
+The serving engine knows two lanes. The INTERACTIVE lane is everything
+``submit_generate`` / ``submit_predict`` always were: a latency SLO,
+bounded queues, deadlines. The BATCH lane is for bulk work — score a whole
+table, generate over a corpus — whose SLO is throughput: finish the job,
+never delay a live user. The contract, enforced engine-side
+(:meth:`~ddw_tpu.serve.engine.ServingEngine._admit_lm_paged`,
+:meth:`~ddw_tpu.serve.blocks.BlockPool.prepare_tick`):
+
+- batch items are admitted only when the interactive queue is EMPTY and
+  the paged pool has free blocks beyond the **interactive reserve**
+  watermark (``EngineCfg.interactive_reserve_blocks``) — backfill fills
+  idle capacity, never the headroom a live arrival would need;
+- on any pressure (an interactive head that cannot fit, a mid-tick block
+  shortage) batch streams are preempted FIRST — before any interactive
+  stream — via the existing bit-identical recompute path, and re-queue at
+  their lane's head with completed tokens intact;
+- the lane changes only WHEN a stream runs, never what it computes: batch
+  outputs are bit-identical to the direct offline ``generate``/``score``
+  path (pinned by tests/test_lanes.py).
+
+This module is the HOST side of that lane: :class:`BatchJob` turns one
+bulk submission into a pumped window of per-item engine futures with
+per-item progress, exactly-once result recording, and retry-on-refusal —
+the properties that make a job *resumable*. The pump lives above the
+engine (or above a whole :class:`~ddw_tpu.gateway.ReplicaSet`), so a
+replica death costs nothing durable: queued items with nothing emitted
+ride the existing salvage → ``adopt`` failover path with their futures
+intact; anything the dead replica actually touched fails with a
+retryable :class:`~ddw_tpu.serve.admission.ReplicaFailed` and the pump
+resubmits it after backoff — results already recorded are keyed by item
+index and written once, so a resumed job never duplicates or loses an
+item. :class:`JobLedger` is the id → job registry the gateway's
+``/v1/batch`` endpoints (submit / poll / NDJSON results / cancel) serve
+from.
+
+Per-item determinism for sampled jobs: item ``i`` draws its keys from
+``jax.random.fold_in(PRNGKey(seed), i)`` — a pure function of (seed,
+index), so any retry, any replica, and the direct offline call all sample
+identically.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+import time
+
+import jax
+
+from ddw_tpu.serve.admission import (Overloaded, Rejected, ReplicaFailed,
+                                     Unavailable)
+
+__all__ = ["BatchJob", "JobLedger", "start_batch_job",
+           "LANE_INTERACTIVE", "LANE_BATCH", "BATCH_KINDS"]
+
+LANE_INTERACTIVE = "interactive"
+LANE_BATCH = "batch"
+# the batch lane's admission-queue kinds engine-side
+BATCH_KINDS = ("lm_batch", "image_batch")
+
+JOB_RUNNING = "running"
+JOB_DONE = "done"
+JOB_CANCELLED = "cancelled"
+
+# refusals the pump absorbs by backoff + resubmit: transient capacity or a
+# replica death. Anything else (a ValueError, a deadline) is a permanent
+# per-item failure — retrying an invalid prompt forever helps nobody.
+_RETRYABLE = (Overloaded, ReplicaFailed, Unavailable)
+
+_job_counter = itertools.count()
+_job_lock = threading.Lock()
+
+
+def _new_job_id() -> str:
+    with _job_lock:
+        n = next(_job_counter)
+    return f"job-{n}-{os.urandom(3).hex()}"
+
+
+class BatchJob:
+    """One bulk job: a window-bounded pump of per-item futures with
+    exactly-once result recording.
+
+    The pump is event-driven — no polling thread. Item completions chain
+    the next submission through future done-callbacks; retryable refusals
+    arm a single shared ``threading.Timer`` (exponential backoff, capped)
+    that re-feeds the window, which is what lets a job ride out a replica
+    restart: every in-flight item fails fast with ``ReplicaFailed``, the
+    timer backs off while the engine is down, and resubmission resumes
+    the moment admission reopens (or a :class:`~ddw_tpu.gateway.ReplicaSet`
+    sibling answers first). ``results`` is keyed by item index and written
+    once — re-running an item that failed mid-flight cannot duplicate a
+    row, and completed rows survive preemption, restart, and ``cancel``.
+    """
+
+    def __init__(self, kind: str, n_items: int, submit_fn, row_fn,
+                 window: int, max_item_retries: int = 64,
+                 retry_base_s: float = 0.05, retry_max_s: float = 2.0,
+                 clock=time.monotonic, job_id: str | None = None):
+        if n_items < 1:
+            raise ValueError(f"a batch job needs >= 1 item, got {n_items}")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.job_id = job_id or _new_job_id()
+        self.kind = kind
+        self.total = n_items
+        self.window = window
+        self.max_item_retries = max_item_retries
+        self.retry_base_s = retry_base_s
+        self.retry_max_s = retry_max_s
+        self._submit_fn = submit_fn       # (index) -> Future
+        self._row_fn = row_fn             # (index, result) -> row dict
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = JOB_RUNNING
+        self._pending: collections.deque[int] = collections.deque(
+            range(n_items))
+        self._inflight: dict[int, object] = {}     # index -> Future
+        self._retries: dict[int, int] = {}
+        self._results: dict[int, dict] = {}        # exactly-once, by index
+        self._failures: dict[int, dict] = {}       # permanent, by index
+        self._requeues = 0
+        self._timer: threading.Timer | None = None
+        self._terminal = threading.Event()
+        self._t0 = clock()
+        self._t_last = self._t0
+
+    # -- pump ----------------------------------------------------------------
+    def _start(self) -> "BatchJob":
+        self._feed()
+        return self
+
+    def _feed(self) -> None:
+        """Fill the in-flight window from the pending deque. Runs on the
+        submitter's thread, a completion callback, or the backoff timer —
+        never holds the lock across a submission (submit can run engine
+        validation and queue locks)."""
+        while True:
+            with self._lock:
+                if self._state != JOB_RUNNING:
+                    return
+                if not self._pending or len(self._inflight) >= self.window:
+                    return
+                idx = self._pending.popleft()
+            try:
+                fut = self._submit_fn(idx)
+            except _RETRYABLE as e:
+                # the door is shut (queue full / replica down): put the
+                # item back at the FRONT and back off — if one submission
+                # bounced, the rest of the window would too
+                self._requeue(idx, e)
+                return
+            except Exception as e:
+                self._fail_item(idx, e)
+                continue
+            with self._lock:
+                if self._state != JOB_RUNNING:
+                    fut.cancel()
+                    return
+                self._inflight[idx] = fut
+            fut.add_done_callback(
+                lambda f, i=idx: self._on_item_done(i, f))
+
+    def _on_item_done(self, idx: int, fut) -> None:
+        with self._lock:
+            self._inflight.pop(idx, None)
+        if fut.cancelled():
+            pass                      # our own cancel() path
+        else:
+            exc = fut.exception()
+            if exc is None:
+                self._record(idx, fut.result())
+            elif (isinstance(exc, _RETRYABLE)
+                  and self._retries.get(idx, 0) < self.max_item_retries):
+                self._requeue(idx, exc)
+            else:
+                self._fail_item(idx, exc)
+        self._maybe_finish()
+        self._feed()
+
+    def _record(self, idx: int, result) -> None:
+        row = self._row_fn(idx, result)
+        with self._lock:
+            if idx not in self._results:      # exactly-once by index
+                self._results[idx] = row
+                self._t_last = self._clock()
+
+    def _fail_item(self, idx: int, exc: Exception) -> None:
+        err = (exc.to_dict() if isinstance(exc, Rejected)
+               else {"error": type(exc).__name__, "message": str(exc)})
+        with self._lock:
+            if idx not in self._results and idx not in self._failures:
+                self._failures[idx] = {"index": idx, **err}
+
+    def _requeue(self, idx: int, exc: Exception) -> None:
+        with self._lock:
+            if self._state != JOB_RUNNING:
+                return
+            n = self._retries.get(idx, 0) + 1
+            self._retries[idx] = n
+            self._requeues += 1
+            self._pending.appendleft(idx)
+            delay = min(self.retry_base_s * (2 ** min(n - 1, 6)),
+                        self.retry_max_s)
+        self._schedule_feed(delay)
+
+    def _schedule_feed(self, delay: float) -> None:
+        with self._lock:
+            if self._timer is not None or self._state != JOB_RUNNING:
+                return            # one armed timer re-feeds the whole window
+            t = threading.Timer(delay, self._timer_fire)
+            t.daemon = True
+            self._timer = t
+        t.start()
+
+    def _timer_fire(self) -> None:
+        with self._lock:
+            self._timer = None
+        self._feed()
+        self._maybe_finish()
+
+    def _maybe_finish(self) -> None:
+        with self._lock:
+            if self._state != JOB_RUNNING:
+                return
+            if (self._pending or self._inflight
+                    or len(self._results) + len(self._failures)
+                    < self.total):
+                return
+            self._state = JOB_DONE
+        self._terminal.set()
+
+    # -- caller API ----------------------------------------------------------
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def done(self) -> bool:
+        return self._terminal.is_set()
+
+    def progress(self) -> dict:
+        """The poll view: counts by disposition plus the throughput the
+        batch SLO is judged by (completed items over the job's busy
+        window)."""
+        with self._lock:
+            ndone = len(self._results)
+            nfail = len(self._failures)
+            elapsed = max(self._t_last - self._t0, 0.0)
+            return {
+                "job_id": self.job_id,
+                "kind": self.kind,
+                "state": self._state,
+                "total": self.total,
+                "completed": ndone,
+                "failed": nfail,
+                "inflight": len(self._inflight),
+                "pending": len(self._pending),
+                "requeues": self._requeues,
+                "items_per_sec": (round(ndone / elapsed, 3)
+                                  if ndone and elapsed > 0 else 0.0),
+                "failures": sorted(self._failures.values(),
+                                   key=lambda r: r["index"])[:8],
+            }
+
+    def wait(self, timeout_s: float | None = None) -> dict:
+        """Block until the job is terminal (done or cancelled); raises
+        ``TimeoutError`` otherwise. Returns :meth:`progress`."""
+        if not self._terminal.wait(timeout=timeout_s):
+            raise TimeoutError(
+                f"batch job {self.job_id} not terminal after {timeout_s}s: "
+                f"{self.progress()}")
+        return self.progress()
+
+    def result_rows(self) -> list[dict]:
+        """Completed rows sorted by item index — the NDJSON body of the
+        gateway's ``/v1/batch/<id>/results``. Available any time; a
+        running (or cancelled) job returns what has completed so far."""
+        with self._lock:
+            return [self._results[i] for i in sorted(self._results)]
+
+    def cancel(self) -> None:
+        """Stop the pump: pending items are dropped, queued in-flight
+        futures are cancelled (engine-side they are discarded before any
+        device work), completed rows are KEPT. Idempotent."""
+        with self._lock:
+            if self._state != JOB_RUNNING:
+                return
+            self._state = JOB_CANCELLED
+            self._pending.clear()
+            timer, self._timer = self._timer, None
+            futs = list(self._inflight.values())
+        if timer is not None:
+            timer.cancel()
+        for f in futs:
+            f.cancel()           # queued -> dropped; admitted -> completes
+        self._terminal.set()
+
+
+class JobLedger:
+    """id → :class:`BatchJob` registry — the gateway's resumable view of
+    every bulk job in flight. The ledger (and each job's pump) lives
+    HOST-side, above the engines: an engine ``restart()``/``recycle()``
+    never touches it, which is what makes a job survive one. Terminal
+    jobs are pruned oldest-first past ``max_jobs`` so a long-lived
+    gateway does not accumulate result sets forever."""
+
+    def __init__(self, max_jobs: int = 256):
+        self.max_jobs = max_jobs
+        self._jobs: collections.OrderedDict[str, BatchJob] = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def add(self, job: BatchJob) -> BatchJob:
+        with self._lock:
+            self._jobs[job.job_id] = job
+            # prune terminal jobs oldest-first; live jobs are never evicted
+            while len(self._jobs) > self.max_jobs:
+                victim = next((jid for jid, j in self._jobs.items()
+                               if j.done), None)
+                if victim is None:
+                    break
+                del self._jobs[victim]
+        return job
+
+    def get(self, job_id: str) -> BatchJob | None:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> list[BatchJob]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def summary(self) -> dict:
+        """Fleet-level job accounting for ``/stats`` and ``/readyz``."""
+        with self._lock:
+            jobs = list(self._jobs.values())
+        states = collections.Counter(j.state for j in jobs)
+        return {
+            "jobs": len(jobs),
+            "running": states.get(JOB_RUNNING, 0),
+            "done": states.get(JOB_DONE, 0),
+            "cancelled": states.get(JOB_CANCELLED, 0),
+            "items_pending": sum(j.progress()["pending"] +
+                                 j.progress()["inflight"]
+                                 for j in jobs if j.state == JOB_RUNNING),
+        }
+
+    def shutdown(self) -> None:
+        """Cancel every live job (gateway drain: stop the pumps before the
+        replicas stop, so nothing resubmits into a closing fleet)."""
+        for job in self.jobs():
+            job.cancel()
+
+
+def _default_window(target, kind: str) -> int:
+    """In-flight items per job: ~2x the fleet's concurrent capacity keeps
+    every idle row/batch slot fed without flooding the bounded batch
+    queue (the pump re-feeds the moment an item completes)."""
+    engines = getattr(target, "replicas", None) or [target]
+    if kind == "generate":
+        caps = [getattr(getattr(e, "pool", None), "max_resident", 0)
+                for e in engines]
+    else:
+        caps = [getattr(getattr(e, "cfg", None), "max_batch", 0)
+                for e in engines]
+    total = sum(c for c in caps if c)
+    return max(2 * total, 8) if total else 16
+
+
+def start_batch_job(target, items, kind: str = "generate",
+                    num_steps: int | None = None, temperature: float = 0.0,
+                    seed: int | None = None, timeout_s: float = 0.0,
+                    window: int = 0, max_item_retries: int = 64,
+                    retry_base_s: float = 0.05, retry_max_s: float = 2.0,
+                    ledger: JobLedger | None = None) -> BatchJob:
+    """Build and start a :class:`BatchJob` over ``target`` — a
+    :class:`~ddw_tpu.serve.engine.ServingEngine` or a
+    :class:`~ddw_tpu.gateway.ReplicaSet` (anything with
+    ``submit_batch_item`` / ``submit_batch_predict``).
+
+    ``kind="generate"``: each item is a token prompt; ``num_steps`` is
+    required; ``seed`` (with ``temperature > 0``) gives item ``i`` the
+    key schedule ``fold_in(PRNGKey(seed), i)`` — the same derivation a
+    direct offline call must use to reproduce the job bit-for-bit.
+    ``kind="predict"``: each item is an image (bytes/path/array).
+    ``timeout_s=0`` (default) means NO per-item deadline — the batch SLO
+    is throughput, and a deadline on backfill work converts yielding
+    into failure."""
+    items = list(items)
+    if kind == "generate":
+        if num_steps is None:
+            raise ValueError("kind='generate' requires num_steps")
+        if temperature > 0.0 and seed is None:
+            raise ValueError("sampled batch jobs require seed (per-item "
+                             "keys derive from fold_in(PRNGKey(seed), i))")
+        base = (jax.random.PRNGKey(seed)
+                if temperature > 0.0 and seed is not None else None)
+
+        def submit(i):
+            rng = jax.random.fold_in(base, i) if base is not None else None
+            return target.submit_batch_item(
+                items[i], num_steps, temperature=temperature, rng=rng,
+                timeout_s=timeout_s)
+
+        def row_of(i, res):
+            return {"index": i, "tokens": [int(t) for t in res.tokens]}
+    elif kind == "predict":
+        def submit(i):
+            return target.submit_batch_predict(items[i],
+                                               timeout_s=timeout_s)
+
+        def row_of(i, res):
+            return {"index": i, "label": res.label,
+                    "class_index": int(res.index)}
+    else:
+        raise ValueError(f"unknown batch kind {kind!r} "
+                         f"(expected 'generate' or 'predict')")
+    job = BatchJob(kind, len(items), submit, row_of,
+                   window=window or _default_window(target, kind),
+                   max_item_retries=max_item_retries,
+                   retry_base_s=retry_base_s, retry_max_s=retry_max_s)
+    if ledger is not None:
+        ledger.add(job)
+    return job._start()

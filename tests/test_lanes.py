@@ -1,0 +1,429 @@
+"""Dual-lane scheduler (ddw_tpu.serve.lanes): batch backfill under live
+interactive traffic.
+
+The acceptance pins, all deterministic on CPU:
+
+- **bit-identity** — batch-lane outputs (greedy AND seeded) equal the
+  direct offline ``generate`` path; the lane changes WHEN a stream runs,
+  never what it computes. Seeded jobs derive item ``i``'s keys from
+  ``fold_in(PRNGKey(seed), i)`` so any retry, any replica, and the
+  offline call sample identically;
+- **interactive always wins** — under a tight paged pool, interactive
+  arrivals preempt batch streams FIRST (``serve.batch_preemptions``) via
+  the existing recompute path, and both lanes still finish bit-identical;
+- **reserve watermark** — batch admission is docked
+  ``interactive_reserve_blocks``; an item that can never fit behind the
+  watermark is refused at submit instead of wedging the queue head;
+- **resumable jobs** — the pump lives host-side: an engine
+  ``force_fail`` + ``restart()`` mid-job (and, over HTTP, a
+  ``DDW_FAULT=serve:crash:site=batch`` replica death under the
+  supervisor) resumes the job with no duplicated and no lost items;
+- **observability** — lane-labeled metrics flow through snapshot, fleet
+  merge and Prometheus rendering; ``/stats`` + ``/readyz`` expose lane
+  depths, reserve occupancy and the job ledger.
+
+Tier-1 cost discipline: the pump and metrics tests never touch jax; the
+engine tests share ONE module-scoped paged engine (the restart drill is
+in-place, so its compiled programs survive); the tight-pool preemption
+test and the 2-replica supervised gateway each compile once. The batch
+throughput/latency numbers ride in tier-2 with the load-generator
+(``tools/load_gen.py --batch``) and serving-curve smokes.
+"""
+
+import time
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+from ddw_tpu.gateway import Gateway, GatewayClient, GatewayError, ReplicaSet
+from ddw_tpu.serve import (
+    BatchJob,
+    EngineCfg,
+    EngineMetrics,
+    JobLedger,
+    Overloaded,
+    RequestRecord,
+    ServingEngine,
+    render_prometheus,
+)
+from ddw_tpu.serve.metrics import merge_metrics
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    cfg = LMCfg(vocab_size=VOCAB, max_len=96, hidden=32, depth=2,
+                num_heads=2, mlp_dim=64, dropout=0.0, dtype="float32")
+    from ddw_tpu.models.lm import build_lm
+
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int32))["params"]
+    out = str(tmp_path_factory.mktemp("lane_pkg") / "pkg")
+    return load_lm_package(save_lm_package(out, cfg, params))
+
+
+@pytest.fixture(scope="module")
+def eng(pm):
+    """One shared paged engine for the identity and restart drills (the
+    in-place restart keeps compiled programs, so sharing stays cheap)."""
+    with ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2, steps_per_tick=2,
+                                            default_timeout_s=600.0)) as e:
+        yield e
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+class _R:
+    """Fake per-item result for the pure pump tests."""
+
+    def __init__(self, tokens):
+        self.tokens = tokens
+
+
+# -- the pump, pure (no jax) -------------------------------------------------
+
+def test_pump_window_retry_exactly_once():
+    """Window-bounded feeding; a retryable refusal re-queues at the front
+    and resubmits after backoff; every row is recorded exactly once, in
+    index order."""
+    subs = []
+
+    def submit(i):
+        f = Future()
+        subs.append((i, f))
+        return f
+
+    job = BatchJob("generate", 5, submit,
+                   lambda i, r: {"index": i, "tokens": list(r.tokens)},
+                   window=2, retry_base_s=0.01, retry_max_s=0.05)._start()
+    assert len(subs) == 2                       # window bounds in-flight
+    subs[0][1].set_result(_R([1, 2]))           # completion chains a feed
+    assert len(subs) == 3
+    subs[1][1].set_exception(Overloaded("lm_batch", 4, 4))  # -> requeue
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:          # backoff timer re-feeds
+        for i, f in subs:
+            if not f.done():
+                f.set_result(_R([i]))
+        if job.done:
+            break
+        time.sleep(0.01)
+    p = job.wait(timeout_s=5.0)
+    assert p["state"] == "done"
+    assert p["completed"] == 5 and p["failed"] == 0
+    assert p["requeues"] >= 1
+    assert [r["index"] for r in job.result_rows()] == [0, 1, 2, 3, 4]
+
+
+def test_pump_permanent_failure_and_cancel():
+    """A non-retryable submit error fails only its item; cancel drops
+    pending work but KEEPS completed rows, and is idempotent."""
+    def submit(i):
+        if i == 1:
+            raise ValueError("bad item")
+        return Future()
+
+    job = BatchJob("generate", 3, submit,
+                   lambda i, r: {"index": i}, window=3)._start()
+    p = job.progress()
+    assert p["failed"] == 1
+    assert p["failures"][0]["index"] == 1
+    assert p["failures"][0]["error"] == "ValueError"
+
+    done_first = []
+
+    def submit2(i):
+        f = Future()
+        if i == 0:
+            f.set_result(_R([7]))
+            done_first.append(f)
+        return f
+
+    job2 = BatchJob("generate", 4, submit2,
+                    lambda i, r: {"index": i, "tokens": list(r.tokens)},
+                    window=2)._start()
+    assert job2.progress()["completed"] == 1
+    job2.cancel()
+    job2.cancel()                              # idempotent
+    p2 = job2.wait(timeout_s=5.0)
+    assert p2["state"] == "cancelled"
+    assert job2.result_rows() == [{"index": 0, "tokens": [7]}]
+
+    led = JobLedger(max_jobs=8)
+    led.add(job2)
+    s = led.summary()
+    assert s["jobs"] == 1 and s["cancelled"] == 1
+
+
+# -- reserve watermark admission ---------------------------------------------
+
+def test_reserve_watermark_admission_math(pm):
+    """BlockPool lane math: the batch budget is docked the interactive
+    reserve, so a request that fits the interactive lane can be refused
+    batch admission; a batch item that can NEVER fit behind the watermark
+    is rejected at submit (it would wedge its queue head forever)."""
+    cfg = EngineCfg(n_slots=2, steps_per_tick=2, kv_cache_blocks=8,
+                    interactive_reserve_blocks=4, default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg) as e:
+        pool = e.pool
+        assert pool.interactive_reserve == 4
+        # needs 3 blocks (37 positions / bs=16) — fits the 8-block
+        # interactive budget, NOT the 8-4 batch budget... and 5 blocks
+        # (> 4 free behind the reserve) fits neither lane's free budget
+        # while staying under the interactive ceiling.
+        assert pool.can_admit(30, 7, lane="interactive")
+        assert pool.can_admit(30, 7, lane="batch")          # 3 <= 4
+        assert pool.can_admit(60, 10, lane="interactive")   # 5 <= 8
+        assert not pool.can_admit(60, 10, lane="batch")     # 5 > 4
+        assert pool.reserve_occupancy_pct == 0.0            # idle: all free
+        g = pool.gauges()
+        assert g["interactive_reserve_blocks"] == 4.0
+        assert g["reserve_free_blocks"] == 4.0
+        # 5 blocks can fit interactive (8) but never batch (8-4): refused
+        # loudly at submit instead of queuing forever
+        p = _prompts([60], seed=1)[0]
+        with pytest.raises(ValueError, match="batch lane"):
+            e.submit_batch_item(p, 10)
+        e.generate(p, 10)                      # interactive lane serves it
+
+
+def test_reserve_auto_default(pm):
+    """interactive_reserve_blocks=-1 auto-sizes to a quarter of the pool."""
+    cfg = EngineCfg(n_slots=2, steps_per_tick=2, kv_cache_blocks=16,
+                    interactive_reserve_blocks=-1, default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg) as e:
+        assert e.pool.interactive_reserve == 4
+
+
+# -- bit-identity (the tentpole pin) -----------------------------------------
+
+def test_batch_matches_direct_greedy_and_seeded(eng, pm):
+    """A batch job's rows are bit-identical to the direct offline
+    ``generate`` path — greedy, and seeded via the per-item fold_in
+    derivation. Lane metrics and health depths flow."""
+    prompts = _prompts([12, 20, 17, 9], seed=7)
+    greedy = [pm.generate(p[None, :], 10)[0] for p in prompts]
+    job = eng.submit_batch(prompts, kind="generate", num_steps=10)
+    p = job.wait(timeout_s=120)
+    assert p["state"] == "done" and p["completed"] == 4
+    for i, r in enumerate(job.result_rows()):
+        assert r["tokens"] == [int(t) for t in greedy[i]], i
+
+    base = jax.random.PRNGKey(11)
+    sampled = [pm.generate(p[None, :], 8, rng=jax.random.fold_in(base, i),
+                           temperature=0.7)[0]
+               for i, p in enumerate(prompts)]
+    job2 = eng.submit_batch(prompts, kind="generate", num_steps=8,
+                            temperature=0.7, seed=11)
+    job2.wait(timeout_s=120)
+    for i, r in enumerate(job2.result_rows()):
+        assert r["tokens"] == [int(t) for t in sampled[i]], i
+
+    snap = eng.snapshot()
+    assert snap["serve.batch_items"] == 8.0
+    assert snap["serve.batch_tokens_out"] == 4 * 10 + 4 * 8
+    h = eng.health()
+    assert h["interactive_depth"] == 0 and h["batch_depth"] == 0
+    assert "reserve_occupancy_pct" in h
+
+
+def test_interactive_preempts_batch_bit_identical(pm):
+    """Under a pool too tight for both lanes, the interactive arrival
+    evicts BATCH streams first (``serve.batch_preemptions``) and both
+    lanes still produce bit-identical tokens — preemption is recompute,
+    not corruption."""
+    cfg = EngineCfg(n_slots=2, steps_per_tick=4, kv_cache_blocks=12,
+                    max_resident=4, block_overcommit=3.0,
+                    interactive_reserve_blocks=2, default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg) as e:
+        bp = _prompts([30, 31, 33, 34], seed=3)
+        ip = _prompts([28], seed=5)[0]
+        bref = [pm.generate(p[None, :], 40)[0] for p in bp]
+        iref = pm.generate(ip[None, :], 40)[0]
+        job = e.submit_batch(bp, kind="generate", num_steps=40)
+        time.sleep(0.3)                  # let batch streams go resident
+        fi = e.submit_generate(ip, 40)
+        assert np.array_equal(fi.result(timeout=120).tokens, iref)
+        p = job.wait(timeout_s=120)
+        assert p["state"] == "done" and p["completed"] == 4
+        for i, r in enumerate(job.result_rows()):
+            assert r["tokens"] == [int(t) for t in bref[i]], i
+        snap = e.snapshot()
+        assert snap["serve.batch_preemptions"] >= 1.0
+        # by contract every preemption under interactive pressure picks a
+        # batch victim first
+        assert snap["serve.batch_preemptions"] == snap["serve.preemptions"]
+
+
+# -- resumable jobs ----------------------------------------------------------
+
+def test_job_resumes_across_engine_restart_exactly_once(eng, pm):
+    """force_fail mid-job + restart(): in-flight items fail with a
+    retryable ReplicaFailed, the pump backs off while the engine is down,
+    and the SAME job finishes after restart with every row exactly once
+    and bit-identical — the ledger/pump live above the engine."""
+    prompts = _prompts([10, 14, 11, 13, 9, 12], seed=17)
+    refs = [pm.generate(p[None, :], 12)[0] for p in prompts]
+    gen_before = eng.generation
+    job = eng.submit_batch(prompts, kind="generate", num_steps=12,
+                           window=2, retry_base_s=0.02, retry_max_s=0.2)
+    deadline = time.monotonic() + 60.0
+    while (job.progress()["completed"] < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert job.progress()["completed"] >= 1    # partial progress exists
+    eng.force_fail("stalled", "lane drill")
+    eng.restart()
+    assert eng.generation == gen_before + 1
+    p = job.wait(timeout_s=120)
+    assert p["state"] == "done"
+    assert p["completed"] == 6 and p["failed"] == 0
+    rows = job.result_rows()
+    assert [r["index"] for r in rows] == list(range(6))   # no dup, no loss
+    for i, r in enumerate(rows):
+        assert r["tokens"] == [int(t) for t in refs[i]], i
+
+
+# -- the HTTP surface + chaos drill (ordered; shared supervised gateway) -----
+
+@pytest.fixture(scope="module")
+def gwx(pm):
+    """One supervised 2-replica gateway: the endpoint tests run clean,
+    the chaos drill (last) kills replica 0 at its batch admission."""
+    engines = [ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2,
+                                                  steps_per_tick=4,
+                                                  default_timeout_s=600.0))
+               for _ in range(2)]
+    g = Gateway(ReplicaSet(engines), grace_s=30.0,
+                supervisor_kw=dict(max_restarts=2, backoff_base_s=0.1,
+                                   backoff_max_s=0.5, jitter=0.0,
+                                   poll_interval_s=0.05))
+    g.start()
+    yield g
+    g.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(gwx):
+    c = GatewayClient("127.0.0.1", gwx.port)
+    assert c.wait_ready(30.0)
+    return c
+
+
+def test_http_batch_endpoints_and_lane_stats(gwx, cli, pm):
+    """/v1/batch submit → poll → NDJSON results → cancel, rows identical
+    to the offline path (seeded, over the wire); lane depths + reserve
+    occupancy + the job ledger show in /stats and /readyz; unknown job
+    ids 404; batch counters reach /metrics."""
+    prompts = _prompts([14, 9, 12], seed=23)
+    base = jax.random.PRNGKey(5)
+    refs = [pm.generate(p[None, :], 8, rng=jax.random.fold_in(base, i),
+                        temperature=0.6)[0]
+            for i, p in enumerate(prompts)]
+    sub = cli.submit_batch(prompts, num_steps=8, temperature=0.6, seed=5)
+    assert sub["total"] == 3
+    st = cli.batch_wait(sub["job_id"], timeout_s=120)
+    assert st["state"] == "done" and st["completed"] == 3
+    rows = cli.batch_results(sub["job_id"])
+    for i, r in enumerate(rows):
+        assert r["tokens"] == [int(t) for t in refs[i]], i
+
+    # a long job we cancel mid-flight: completed rows are kept
+    sub2 = cli.submit_batch(_prompts([10] * 48, seed=3), num_steps=60)
+    st2 = cli.batch_cancel(sub2["job_id"])
+    assert st2["state"] == "cancelled"
+    assert cli.batch_status(sub2["job_id"])["state"] == "cancelled"
+
+    stats = cli.stats()
+    lanes = stats["lanes"]
+    for key in ("interactive_depth", "batch_depth",
+                "reserve_occupancy_pct", "jobs", "running", "done",
+                "cancelled", "items_pending"):
+        assert key in lanes, key
+    assert lanes["done"] >= 1 and lanes["cancelled"] >= 1
+    _, ready = cli.readyz()
+    assert "lanes" in ready
+    with pytest.raises(GatewayError) as ei:
+        cli.batch_status("job-nope")
+    assert ei.value.status == 404
+    text = cli.metrics_text()
+    assert "ddw_serve_batch_items" in text
+    assert "ddw_serve_batch_preemptions" in text
+
+
+@pytest.mark.faults
+def test_chaos_batch_site_resumes_no_dup_no_loss(gwx, cli, pm,
+                                                 monkeypatch):
+    """DDW_FAULT=serve:crash:site=batch kills replica 0 at its 2nd
+    batch-lane admission mid-job: the supervisor restarts it, the
+    host-side ledger's pump resubmits the failed items, and the job
+    finishes with every index exactly once, bit-identical to offline."""
+    monkeypatch.setenv("DDW_FAULT",
+                       "serve:crash:site=batch:replica=0:after=2")
+    prompts = _prompts([14] * 10, seed=13)
+    refs = [pm.generate(p[None, :], 12)[0] for p in prompts]
+    sub = cli.submit_batch(prompts, num_steps=12)
+    st = cli.batch_wait(sub["job_id"], timeout_s=180)
+    assert st["state"] == "done"
+    assert st["completed"] == 10 and st["failed"] == 0
+    rows = cli.batch_results(sub["job_id"])
+    assert [r["index"] for r in rows] == list(range(10))  # exactly once
+    for i, r in enumerate(rows):
+        assert r["tokens"] == [int(t) for t in refs[i]], i
+    stats = cli.stats()
+    assert stats["gateway.replica_failures"] >= 1.0
+
+
+# -- lane observability, pure (no jax) ---------------------------------------
+
+def test_lane_metrics_snapshot_merge_prometheus():
+    """Batch records count toward throughput but never the interactive
+    latency tails; batch counters and the reserve gauge pair flow through
+    snapshot, fleet merge, and Prometheus rendering."""
+    a, b = EngineMetrics(), EngineMetrics()
+    t0 = 100.0
+    # one fast interactive request and one slow batch item on replica a
+    a.record(RequestRecord("lm", t0, t0 + 0.001, t0 + 0.003, t0 + 0.008,
+                           tokens=6))
+    a.record(RequestRecord("lm", t0, t0 + 0.002, t0 + 0.5, t0 + 1.0,
+                           tokens=40, lane="batch"))
+    b.record(RequestRecord("lm", t0, t0 + 0.001, t0 + 0.4, t0 + 0.9,
+                           tokens=30, lane="batch"))
+    a.count("batch_preemptions", 2)
+    a.count("preemptions", 2)
+    a.set_gauges({"interactive_reserve_blocks": 4.0,
+                  "reserve_free_blocks": 1.0})
+
+    snap = a.snapshot()
+    assert snap["serve.batch_items"] == 1.0
+    assert snap["serve.batch_tokens_out"] == 40.0
+    assert snap["serve.tokens_out"] == 46.0       # both lanes: device work
+    # the 1-second batch item must not poison the interactive tail
+    assert snap["serve.total_ms_p99"] == pytest.approx(8.0)
+    assert snap["serve.reserve_occupancy_pct"] == pytest.approx(75.0)
+
+    merged = merge_metrics([a, b]).snapshot()
+    assert merged["serve.batch_items"] == 2.0
+    assert merged["serve.batch_tokens_out"] == 70.0
+    assert merged["serve.batch_preemptions"] == 2.0
+    assert merged["serve.batch_items_per_sec"] > 0.0
+
+    text = render_prometheus([a, b])
+    lines = dict(ln.rsplit(" ", 1) for ln in text.splitlines()
+                 if ln and not ln.startswith("#"))
+    assert lines["ddw_serve_batch_preemptions_total"] == "2"
+    assert lines["ddw_serve_batch_items_total"] == "2"
+    assert lines["ddw_serve_batch_tokens_out_total"] == "70"
+    assert float(lines["ddw_serve_batch_items_per_sec"]) > 0.0
+    assert float(lines["ddw_serve_reserve_occupancy_pct"]) == \
+        pytest.approx(75.0)

@@ -1,0 +1,256 @@
+"""Batch-lane backfill driver — score a data-store table through a LIVE gateway.
+
+The offline answer to "score this table" is :class:`ddw_tpu.serving.batch.
+BatchScorer`: load the packaged model, stream shards, write a predictions
+table. This tool is the ONLINE answer — the workshop's "score the silver
+table" contract served by the fleet that is already up for interactive
+traffic, using its idle capacity instead of a second set of chips:
+
+    table shards  →  decode (the loader's shared scheme)  →  POST /v1/batch
+    (kind=predict, chunked jobs)  →  poll → NDJSON rows  →  predictions table
+
+The batch LANE is what makes this safe to run against a serving fleet: items
+backfill only the blocks/slots interactive traffic is not using (behind the
+interactive-reserve watermark), are preempted first the moment a live request
+needs the capacity, and a replica death mid-job resumes from the gateway's
+job ledger with no duplicated or lost rows. The outputs are the point of the
+contract: the predictions table this tool writes is IDENTICAL, row for row,
+to what the offline ``BatchScorer`` produces from the same table and package
+— the smoke below asserts exactly that.
+
+Decode happens client-side through the same single scheme definition the
+training loader and offline scorer use (``raw_u8`` dequantize or
+``preprocess_image``), so the gateway sees pixels and train/serve skew stays
+impossible by construction.
+
+Against a live gateway:
+    python tools/batch_backfill.py --url http://H:P --store /path/store \
+        --table silver_val --out predictions_online [--chunk 64]
+
+CI smoke (``DDW_BENCH_SMOKE=1``, no args): self-hosts a 2-replica gateway on
+a throwaway image package, writes a small ``raw_u8`` table, backfills it
+through ``/v1/batch``, scores the same table offline with ``BatchScorer``,
+and asserts the two predictions tables carry identical (path → label) rows —
+the bit-identity pin that closes the workshop's batch-scoring contract over
+the online lane. Prints one JSON line.
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from ddw_tpu.utils.config import env_flag
+
+SMOKE = env_flag("DDW_BENCH_SMOKE")
+
+
+def _decode_record(rec, meta: dict, height: int, width: int) -> np.ndarray:
+    """One record's pixels via the shared scheme: ``raw_u8`` tables
+    dequantize (loader's materialized fast path), anything else is JPEG
+    bytes through ``preprocess_image`` — the same dispatch the offline
+    scorer and the training loader run."""
+    if meta.get("encoding") == "raw_u8":
+        from ddw_tpu.data.loader import dequantize_raw_u8, raw_u8_view
+
+        img = raw_u8_view(rec.content, height, width).astype(np.float32)
+        dequantize_raw_u8(img)
+        return img
+    from ddw_tpu.data.loader import preprocess_image
+
+    return preprocess_image(rec.content, height, width)
+
+
+def backfill(client, table, height: int, width: int, chunk: int = 64,
+             window: int = 0, poll_s: float = 0.1,
+             timeout_s: float = 600.0):
+    """Stream ``table`` through ``/v1/batch`` image scoring in ``chunk``-item
+    jobs (one finishes before the next submits — the backlog lives in the
+    store, not in gateway memory). Returns ``([(path, label)], stats)`` in
+    table order."""
+    meta = table.meta
+    if meta.get("encoding") == "raw_u8" and \
+            (meta.get("height"), meta.get("width")) != (height, width):
+        raise ValueError(
+            f"table is {meta.get('height')}x{meta.get('width')} raw_u8 but "
+            f"the serving model expects {height}x{width}")
+    results: list[tuple[str, str]] = []
+    stats = {"jobs": 0, "items": 0, "requeues": 0, "elapsed_s": 0.0}
+    t0 = time.monotonic()
+    paths: list[str] = []
+    imgs: list[np.ndarray] = []
+
+    def flush():
+        if not imgs:
+            return
+        sub = client.submit_batch(imgs, kind="predict", window=window)
+        st = client.batch_wait(sub["job_id"], timeout_s=timeout_s,
+                               poll_s=poll_s)
+        if st["failed"]:
+            raise RuntimeError(f"batch job {sub['job_id']} failed items: "
+                               f"{st['failures']}")
+        rows = client.batch_results(sub["job_id"])
+        # rows come back index-ordered; zip against this chunk's paths
+        results.extend((paths[r["index"]], r["label"]) for r in rows)
+        stats["jobs"] += 1
+        stats["items"] += len(rows)
+        stats["requeues"] += st["requeues"]
+        paths.clear()
+        imgs.clear()
+
+    for rec in table.iter_records():
+        paths.append(rec.path)
+        imgs.append(_decode_record(rec, meta, height, width))
+        if len(imgs) >= chunk:
+            flush()
+    flush()
+    stats["elapsed_s"] = round(time.monotonic() - t0, 3)
+    stats["items_per_sec"] = (round(stats["items"] / stats["elapsed_s"], 2)
+                              if stats["elapsed_s"] > 0 else 0.0)
+    return results, stats
+
+
+def write_predictions(store, out_name: str, results, table,
+                      extra_meta: dict | None = None):
+    """Persist [(path, label)] as a predictions table — the same shape the
+    offline scorer writes, so downstream consumers cannot tell which lane
+    produced it."""
+    from ddw_tpu.data.store import Record
+
+    return store.write(
+        out_name,
+        (Record(path=p, content=b"", label=lab) for p, lab in results),
+        meta={**(extra_meta or {}),
+              "source_table": table.manifest["name"],
+              "source_version": table.manifest["version"],
+              "via": "gateway_batch_lane"})
+
+
+def smoke(n_records=24, classes=5, hw=32, chunk=10, n_replicas=2):
+    """Self-hosted bit-identity pin: online backfill == offline BatchScorer
+    on the same table and package."""
+    import tempfile
+
+    import jax
+
+    from ddw_tpu.data.store import Record, TableStore
+    from ddw_tpu.gateway import Gateway, GatewayClient, ReplicaSet
+    from ddw_tpu.models.registry import build_model
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+    from ddw_tpu.serving.batch import BatchScorer
+    from ddw_tpu.serving.package import (load_packaged_model,
+                                         save_packaged_model)
+    from ddw_tpu.utils.config import ModelCfg
+
+    with tempfile.TemporaryDirectory() as tmp:
+        mcfg = ModelCfg(name="small_cnn", num_classes=classes, dropout=0.0,
+                        dtype="float32")
+        model = build_model(mcfg)
+        rng = np.random.RandomState(0)
+        variables = model.init({"params": jax.random.PRNGKey(0)},
+                               np.zeros((1, hw, hw, 3), np.float32),
+                               train=False)
+        d = save_packaged_model(
+            os.path.join(tmp, "pkg"), mcfg,
+            [f"c{i}" for i in range(classes)], variables["params"],
+            variables.get("batch_stats"), img_height=hw, img_width=hw)
+        pkg = load_packaged_model(d)
+
+        store = TableStore(os.path.join(tmp, "store"))
+        pixels = rng.randint(0, 256, size=(n_records, hw, hw, 3),
+                             ).astype(np.uint8)
+        table = store.write(
+            "silver_val",
+            (Record(path=f"img-{i:03d}.raw", content=pixels[i].tobytes())
+             for i in range(n_records)),
+            meta={"encoding": "raw_u8", "height": hw, "width": hw})
+
+        offline = BatchScorer(pkg, batch_per_device=4).score_table(
+            table, out_store=store, out_name="predictions_offline")
+
+        engines = [ServingEngine(image=pkg,
+                                 cfg=EngineCfg(max_batch=4, max_wait_ms=1.0,
+                                               default_timeout_s=600.0))
+                   for _ in range(n_replicas)]
+        gw = Gateway(ReplicaSet(engines), grace_s=60.0)
+        gw.start(warmup_prompt_lens=())
+        try:
+            cli = GatewayClient("127.0.0.1", gw.port)
+            assert cli.wait_ready(60.0)
+            online, stats = backfill(cli, table, hw, hw, chunk=chunk)
+            out_table = write_predictions(store, "predictions_online",
+                                          online, table,
+                                          {"model_classes": pkg.classes})
+            lanes = cli.stats()["lanes"]
+        finally:
+            gw.stop()
+
+        # THE pin: same table, same package — the online lane's predictions
+        # table is row-identical to the offline scorer's
+        off_rows = dict(offline)
+        on_rows = {r.path: r.label
+                   for r in out_table.iter_records()}
+        assert len(on_rows) == n_records, stats
+        if SMOKE:
+            assert on_rows == off_rows, {
+                p: (on_rows.get(p), off_rows.get(p))
+                for p in set(on_rows) ^ set(off_rows) or list(on_rows)[:3]}
+            assert stats["jobs"] == -(-n_records // chunk), stats
+            assert lanes["done"] == stats["jobs"], lanes
+        return {"records": n_records, "identical": on_rows == off_rows,
+                "backfill": stats, "lanes": lanes}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", default=None, help="target a live gateway")
+    ap.add_argument("--store", default=None, help="TableStore root")
+    ap.add_argument("--table", default="silver_val")
+    ap.add_argument("--out", default="predictions_online")
+    ap.add_argument("--chunk", type=int, default=64)
+    ap.add_argument("--height", type=int, default=None,
+                    help="model input height (raw_u8 tables default to "
+                         "their own meta)")
+    ap.add_argument("--width", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.url:
+        if not args.store:
+            ap.error("--store is required with --url")
+        from urllib.parse import urlparse
+
+        from ddw_tpu.data.store import TableStore
+        from ddw_tpu.gateway import GatewayClient
+
+        store = TableStore(args.store)
+        table = store.table(args.table)
+        h = args.height or table.meta.get("height")
+        w = args.width or table.meta.get("width")
+        if not (h and w):
+            ap.error("--height/--width required for non-raw_u8 tables")
+        u = urlparse(args.url)
+        cli = GatewayClient(u.hostname, u.port)
+        results, stats = backfill(cli, table, int(h), int(w),
+                                  chunk=args.chunk)
+        out = write_predictions(store, args.out, results, table)
+        print(json.dumps({"out_table": out.version_dir, **stats}))
+        return
+
+    # self-hosted smoke
+    import jax
+
+    from ddw_tpu.utils.config import require_tpu_or_exit
+
+    kind = require_tpu_or_exit("measure")
+    print(f"device: {kind}", file=sys.stderr, flush=True)
+    result = {"device": {"kind": kind, "n": jax.device_count()},
+              "backfill": smoke()}
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

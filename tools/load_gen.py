@@ -28,6 +28,12 @@ measured — and the PREFIX arm: a shared-prefix workload (``--prompt-prefix
 N`` against a live gateway) whose paged-KV prefix-cache hits and CoW
 clones must be visible in ``/stats``.
 
+Batch arm (``--batch``): the dual-lane pin — a saturating ``/v1/batch``
+bulk job under the same closed-loop interactive workload, reported side by
+side with a no-batch baseline. The smoke asserts interactive goodput holds
+(generous 0.5x floor against 1-core timing noise) while batch items
+complete during the run — backfill fills idle capacity, never steals it.
+
 Chaos arm (``--chaos``, or ``DDW_BENCH_CHAOS=1`` with the smoke): the
 robustness pin rather than the capacity pin — closed-loop clients drive a
 supervised 2-replica fleet while ``DDW_FAULT=serve:crash`` kills replica 0
@@ -384,6 +390,72 @@ def chaos(prompt_len=12, steps=16, requests=32, n_slots=2, steps_per_tick=4,
             gw.stop()
 
 
+def batch_arm(prompt_len=16, steps=24, requests=32, clients=4, n_slots=4,
+              steps_per_tick=8, hidden=64, depth=2, batch_items=96):
+    """Bulk job under live closed-loop traffic — the dual-lane pin.
+
+    Three phases on ONE paged gateway: a no-batch closed-loop baseline,
+    then the same workload with a saturating ``/v1/batch`` job running
+    underneath, reported side by side with the batch lane's own items/s.
+    The pin is the lane contract, not a capacity claim: interactive
+    goodput with the batch lane saturated stays at the no-batch baseline
+    (generous 0.5x floor — 1-core CI timing noise dwarfs the true cost,
+    which is near zero: paged decode always dispatches ``max_resident``
+    rows, so batch streams ride rows that were decoding dummy tokens
+    anyway and only their prefills compete) while batch items complete
+    DURING the interactive run (> 0) — backfill, not starvation."""
+    import tempfile
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.gateway import GatewayClient
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "batcharm", hidden, depth, 2, 128, 96,
+                          dtype="float32")
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 128, size=(prompt_len,)).astype(np.int32)
+                   for _ in range(requests)]
+        bprompts = [rng.randint(0, 128, size=(prompt_len,)).astype(np.int32)
+                    for _ in range(batch_items)]
+        gw = _smoke_gateway(pm, 1, n_slots, steps_per_tick,
+                            queue_depth=4 * max(clients, requests))
+        gw.start(warmup_prompt_lens=(prompt_len,))
+        try:
+            cli = GatewayClient("127.0.0.1", gw.port)
+            closed_loop(gw.url, prompts[:clients], steps, clients)  # warm
+            baseline = closed_loop(gw.url, prompts, steps, clients)
+            sub = cli.submit_batch(bprompts, num_steps=steps)
+            mixed = closed_loop(gw.url, prompts, steps, clients)
+            st = cli.batch_status(sub["job_id"])   # progress DURING the run
+            cli.batch_cancel(sub["job_id"])
+            stats = cli.stats()
+            out = {
+                "baseline": baseline, "mixed": mixed,
+                "batch": {"items_offered": batch_items,
+                          "completed_during_run": st["completed"],
+                          "items_per_sec": st["items_per_sec"],
+                          "requeues": st["requeues"]},
+                "batch_preemptions": stats.get("serve.batch_preemptions"),
+                "reserve_blocks": stats.get(
+                    "serve.interactive_reserve_blocks"),
+            }
+            print(f"[load_gen] batch arm: interactive "
+                  f"{baseline['goodput_rps']:.2f} -> "
+                  f"{mixed['goodput_rps']:.2f} req/s with batch lane at "
+                  f"{st['items_per_sec']:.2f} items/s "
+                  f"({st['completed']}/{batch_items} during the run)",
+                  file=sys.stderr, flush=True)
+            if SMOKE:
+                assert mixed["completed"] == requests, mixed
+                assert (mixed["goodput_rps"]
+                        >= 0.5 * baseline["goodput_rps"]), out
+                assert st["completed"] > 0, out
+            return out
+        finally:
+            gw.stop()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default=None, help="target a live gateway")
@@ -401,6 +473,9 @@ def main():
     ap.add_argument("--chaos", action="store_true",
                     help="self-hosted kill-one-replica drill instead of "
                          "the capacity smoke")
+    ap.add_argument("--batch", action="store_true",
+                    help="self-hosted dual-lane arm: bulk /v1/batch job "
+                         "under closed-loop interactive traffic")
     args = ap.parse_args()
 
     if args.url:
@@ -429,6 +504,9 @@ def main():
     if args.chaos or env_flag("DDW_BENCH_CHAOS"):
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "chaos": chaos()}
+    elif args.batch:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "batch": batch_arm()}
     else:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   **smoke()}
