@@ -1,0 +1,21 @@
+"""Process-isolated serving fleet + zero-downtime rolling deploys.
+
+Three pieces, layered on the gateway's existing replica contracts:
+
+- :class:`ProcessReplica` — an :class:`~ddw_tpu.serve.ServingEngine` living
+  in its own OS process (``_serve_worker`` child), driven over a keep-alive
+  HTTP client but presenting the SAME duck-typed EngineReplica surface the
+  in-thread engine does, so :class:`~ddw_tpu.gateway.ReplicaSet` routes to
+  both transparently and :class:`~ddw_tpu.gateway.ReplicaSupervisor`
+  restarts both through the one backoff/half-open/shadow-probe path.
+- :mod:`~ddw_tpu.deploy._serve_worker` — the child entrypoint (one engine,
+  one single-replica gateway, port-file handshake, SIGTERM → drain).
+- :class:`DeployController` — rolling weight hot-swap under live traffic:
+  drain → restart on the new checkpoint → warmup-gate → shadow-probe
+  rejoin → advance, with abort-and-rollback on a failed step.
+"""
+
+from ddw_tpu.deploy.controller import DeployController, DeployStep
+from ddw_tpu.deploy.process_replica import ProcessReplica
+
+__all__ = ["DeployController", "DeployStep", "ProcessReplica"]

@@ -1,0 +1,200 @@
+"""Rolling weight hot-swap across a live replica fleet.
+
+One replica at a time: stage the new checkpoint on the replica
+(:meth:`set_checkpoint` — applied at its next restart), hand it to the
+supervisor's :meth:`~ddw_tpu.gateway.ReplicaSupervisor.recycle` path
+(circuit tripped → drain in-flight work to completion → restart on the
+new weights → re-warm → shadow-probe → readmit), verify the replica
+actually came back serving the TARGET checkpoint with a CLOSED circuit,
+then advance. Siblings carry the interactive load the whole time — zero
+dropped requests is the contract the tier-1 drill pins.
+
+Verification is digest-based: the first successfully-rolled replica
+reports the package's content digest through its health (the engine's
+``checkpoint_id``), and every later replica must match it. A replica that
+fails to drain, fails its warmup probe, or comes back on the wrong digest
+ABORTS the rollout: no further replicas are touched, and (with
+``rollback=True``, the default) the failed replica is re-staged on its
+OLD checkpoint and recycled back. Replicas that already completed the
+roll KEEP the new weights — a half-rolled fleet serves both checkpoints
+correctly (requests are checkpoint-agnostic), and re-running the deploy
+resumes the roll; rolling the winners back would double the disruption to
+un-break nothing.
+
+Forensics: every step lands in the shared status dict (the gateway's
+``/stats`` ``deploy`` block and ``deploy_view``) tagged with the
+replica's new generation, and the supervisor's attempt ledger carries the
+same steps under ``kind="deploy"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+__all__ = ["DeployController", "DeployStep"]
+
+
+@dataclasses.dataclass
+class DeployStep:
+    """One replica's roll, as recorded in the deploy forensics."""
+
+    replica: int
+    action: str          # recycled | verify_failed | drain_failed |
+    #                      rolled_back | rollback_failed
+    ok: bool
+    generation: int = 0
+    checkpoint: str | None = None
+    detail: str = ""
+    elapsed_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class DeployController:
+    """Drives one rolling deploy; built per-rollout (the gateway's
+    ``start_deploy`` spawns it on a control thread). ``status`` is the
+    externally-visible dict it mutates under ``status_lock`` — the
+    gateway shares its own so ``/stats`` reads live progress."""
+
+    def __init__(self, replica_set, supervisor, model_dir: str,
+                 rollback: bool = True, status: dict | None = None,
+                 status_lock: threading.Lock | None = None,
+                 settle_timeout_s: float = 60.0):
+        self.rs = replica_set
+        self.supervisor = supervisor
+        self.model_dir = model_dir
+        self.rollback = rollback
+        self.settle_timeout_s = settle_timeout_s
+        self.status = status if status is not None else {
+            "deploying": False, "status": "idle", "fleet_generation": 0,
+            "steps": []}
+        self._status_lock = status_lock or threading.Lock()
+        self.steps: list[DeployStep] = []
+
+    # -- status plumbing -----------------------------------------------------
+    def _set(self, **kw) -> None:
+        with self._status_lock:
+            self.status.update(kw)
+
+    def _record(self, step: DeployStep) -> None:
+        self.steps.append(step)
+        with self._status_lock:
+            self.status.setdefault("steps", []).append(step.to_dict())
+
+    # -- the roll ------------------------------------------------------------
+    def _health(self, i: int) -> dict:
+        try:
+            return self.rs.replicas[i].health()
+        except Exception:
+            return {}
+
+    def _settled(self, i: int, want_digest: str | None) -> tuple[bool, str]:
+        """A rolled replica counts only when it is alive on a CLOSED
+        circuit AND reports the target digest (when one is known yet)."""
+        deadline = time.monotonic() + self.settle_timeout_s
+        last = ""
+        while time.monotonic() < deadline:
+            h = self._health(i)
+            circuit = self.rs.breakers[i].state
+            ck = h.get("checkpoint")
+            if (h.get("state") in ("alive", "degraded")
+                    and circuit == "closed"
+                    and ck is not None
+                    and (want_digest is None or ck == want_digest)):
+                return True, ck
+            last = (f"state={h.get('state')} circuit={circuit} "
+                    f"checkpoint={ck}")
+            time.sleep(0.05)
+        return False, last
+
+    def run(self) -> dict:
+        """Roll the fleet; returns the final status dict. Never raises —
+        a deploy is an operator action whose failure mode is a recorded
+        abort, not a crashed control thread."""
+        self._set(deploying=True, status="rolling",
+                  target_dir=self.model_dir)
+        want_digest: str | None = None
+        try:
+            for i in range(len(self.rs.replicas)):
+                eng = self.rs.replicas[i]
+                t0 = time.monotonic()
+                old_dir = getattr(eng, "model_dir", None)
+                try:
+                    eng.set_checkpoint(self.model_dir)
+                except AttributeError:
+                    self._record(DeployStep(
+                        replica=i, action="verify_failed", ok=False,
+                        detail="replica has no set_checkpoint hook"))
+                    self._abort(i, old_dir)
+                    return self.status
+                try:
+                    ok = self.supervisor.recycle(i, kind="deploy")
+                except Exception:            # recycle never should, but a
+                    ok = False               # deploy must not crash on it
+                if not ok:
+                    # recycle already escalated to force_fail + the
+                    # supervisor's crash-restart path; the replica will
+                    # come back, but NOT via the drain contract — abort
+                    eng = self.rs.replicas[i]   # may have been replaced
+                    self._record(DeployStep(
+                        replica=i, action="drain_failed", ok=False,
+                        generation=getattr(eng, "generation", 0),
+                        detail="recycle did not complete in budget",
+                        elapsed_s=time.monotonic() - t0))
+                    self._abort(i, old_dir)
+                    return self.status
+                eng = self.rs.replicas[i]
+                settled, got = self._settled(i, want_digest)
+                if not settled:
+                    self._record(DeployStep(
+                        replica=i, action="verify_failed", ok=False,
+                        generation=getattr(eng, "generation", 0),
+                        detail=got, elapsed_s=time.monotonic() - t0))
+                    self._abort(i, old_dir)
+                    return self.status
+                if want_digest is None:
+                    want_digest = got   # the first roll names the target
+                    self._set(target_checkpoint=want_digest)
+                self._record(DeployStep(
+                    replica=i, action="recycled", ok=True,
+                    generation=getattr(eng, "generation", 0),
+                    checkpoint=got, elapsed_s=time.monotonic() - t0))
+            with self._status_lock:
+                self.status["fleet_generation"] = \
+                    self.status.get("fleet_generation", 0) + 1
+                self.status.update(deploying=False, status="done")
+            return self.status
+        except Exception as e:               # belt-and-braces: record, don't
+            self._set(deploying=False,      # leave "deploying" stuck True
+                      status="aborted", error=repr(e))
+            return self.status
+
+    def _abort(self, failed_i: int, old_dir: str | None) -> None:
+        """Stop the roll at the failed replica. With rollback on, re-stage
+        its previous checkpoint and recycle it back; already-rolled
+        replicas keep the new weights (see module docstring)."""
+        if not (self.rollback and old_dir is not None):
+            self._set(deploying=False, status="aborted")
+            return
+        self._set(status="rolling_back")
+        eng = self.rs.replicas[failed_i]
+        t0 = time.monotonic()
+        ok = False
+        try:
+            eng.set_checkpoint(old_dir)
+            ok = self.supervisor.recycle(failed_i, kind="rollback")
+            if ok:
+                ok, _ = self._settled(failed_i, None)
+        except Exception:
+            ok = False
+        self._record(DeployStep(
+            replica=failed_i, action="rolled_back" if ok
+            else "rollback_failed", ok=ok,
+            generation=getattr(self.rs.replicas[failed_i],
+                               "generation", 0),
+            detail=f"restaged {old_dir}", elapsed_s=time.monotonic() - t0))
+        self._set(deploying=False,
+                  status="rolled_back" if ok else "aborted")

@@ -1,0 +1,657 @@
+"""An engine replica living in its own OS process.
+
+:class:`ProcessReplica` presents the same duck-typed EngineReplica surface
+:class:`~ddw_tpu.serve.ServingEngine` does — ``submit_generate`` /
+``submit_predict`` / batch-lane submits returning futures, ``health()`` /
+``load()`` for routing, ``restart`` / ``clone_fresh`` / ``force_fail`` /
+``recycle`` for supervision — but the engine behind it runs in a child
+process (:mod:`ddw_tpu.deploy._serve_worker`), reached over a keep-alive
+:class:`~ddw_tpu.gateway.client.GatewayClient`. :class:`ReplicaSet` routes
+to it like any in-thread engine; :class:`ReplicaSupervisor` restarts it
+through the same backoff / half-open / shadow-probe path. What process
+isolation buys over threads: a segfaulting or wedged XLA computation takes
+down ONE replica's process, not the fleet; weight hot-swaps get a truly
+fresh interpreter; and ``kill -9`` is a recovery primitive that always
+works (an in-thread replica wedged inside device work can only be
+abandoned, never reclaimed).
+
+Lifecycle mapping (thread replica → process replica):
+
+==================  =====================================================
+``start()``         spawn the child (non-blocking; XLA compiles there)
+``warmup()``        await the port-file handshake, then ``/readyz`` —
+                    the child gates its own readiness on warmup, so this
+                    IS warmup gating, observed from outside
+``force_fail()``    SIGKILL — the stall path's unconditional hammer
+``recycle()``       SIGTERM (child drains in flight work, exits 0), then
+                    respawn — on the staged checkpoint when one is pending
+``restart()``       kill whatever remains, respawn, generation += 1
+``stop()``          SIGTERM, bounded wait, SIGKILL
+==================  =====================================================
+
+Failure detection is two-pronged: a watcher thread blocks in ``wait()``
+on the child and fires ``on_failure`` the moment it dies (exit code kept
+as forensics), and ``health()`` converts an unreachable-or-silent child
+into a growing ``last_tick_age_s`` so the supervisor's stall detector
+fires for a wedged-but-alive process exactly as for a wedged thread.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from ddw_tpu.gateway.client import (GatewayClient, GatewayDeadline,
+                                    GatewayError, GatewayOverloaded,
+                                    GatewayUnavailable)
+from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded, Rejected,
+                                     ReplicaFailed, Unavailable)
+from ddw_tpu.serve.engine import GenerateResult, PredictResult
+from ddw_tpu.serve.metrics import EngineMetrics
+
+__all__ = ["ProcessReplica"]
+
+_HEALTH_CACHE_S = 0.2       # /stats polls under this age are coalesced
+
+
+def _key_words(rng) -> list[int]:
+    """A JAX PRNG key as raw uint32 words for the wire (``key_data``)."""
+    try:
+        import jax
+        arr = np.asarray(jax.random.key_data(rng))
+    except Exception:
+        arr = np.asarray(rng)
+    return [int(w) for w in arr.reshape(-1)]
+
+
+def _error_to_exc(err: dict) -> Rejected:
+    """Rebuild the structured refusal a child serialized (``to_dict``
+    inverted) so pump retry classification survives the process hop."""
+    kind = err.get("error")
+    if kind == "overloaded":
+        return Overloaded(err.get("kind", "interactive"),
+                          err.get("capacity", 0), err.get("depth", 0),
+                          err.get("retry_after_ms"))
+    if kind == "deadline_exceeded":
+        return DeadlineExceeded(err.get("kind", "interactive"),
+                                err.get("waited_ms", 0.0),
+                                err.get("timeout_ms", 0.0))
+    if kind == "unavailable":
+        return Unavailable(err.get("reason", "child"),
+                           err.get("retry_after_ms"))
+    return ReplicaFailed(err.get("kind", "child_error"),
+                         replica=err.get("replica", 0),
+                         generation=err.get("generation", 0),
+                         phase=err.get("phase", "submitted"),
+                         emitted=err.get("emitted", 0),
+                         forensics=err.get("forensics"))
+
+
+class ProcessReplica:
+    """One ServingEngine in a child process, behind the EngineReplica
+    duck type. ``engine_cfg`` is a plain dict of
+    :class:`~ddw_tpu.serve.EngineCfg` overrides (it crosses the process
+    boundary as JSON)."""
+
+    def __init__(self, model_dir: str, replica_id: int = 0,
+                 engine_cfg: dict | None = None, host: str = "127.0.0.1",
+                 workdir: str | None = None, grace_s: float = 10.0,
+                 spawn_timeout_s: float = 180.0,
+                 request_timeout_s: float = 120.0, max_workers: int = 16,
+                 warmup_lens=(8,)):
+        self.model_dir = model_dir
+        self.replica_id = replica_id
+        self.generation = 0
+        self.engine_cfg = dict(engine_cfg or {})
+        self.warmup_lens = tuple(warmup_lens)
+        self.host = host
+        self.grace_s = grace_s
+        self.spawn_timeout_s = spawn_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self.failure: ReplicaFailed | None = None
+        self.on_failure = None               # set by ReplicaSet._wire
+        self.metrics = EngineMetrics()       # parent-side placeholder; the
+        #                                      child keeps the real numbers
+        #                                      (its /stats), merged empty
+        self.last_exit_code: int | None = None
+        self._pending_checkpoint: str | None = None
+        self._workdir = workdir or tempfile.mkdtemp(
+            prefix=f"ddw-replica{replica_id}-")
+        self._proc: subprocess.Popen | None = None
+        self._client: GatewayClient | None = None
+        self._port: int | None = None
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix=f"ddw-preplica{replica_id}")
+        self._lock = threading.Lock()
+        self._draining = threading.Event()
+        self._stopping = threading.Event()   # expected exits: no on_failure
+        self._ready = False
+        self._service_ms = 50.0              # decaying estimate, parent-side
+        self._health_cache: dict | None = None
+        self._health_at = 0.0
+        self._last_alive = time.monotonic()  # last proof the child answered
+
+    # -- spawn plumbing ------------------------------------------------------
+    def _port_file(self) -> str:
+        return os.path.join(self._workdir,
+                            f"port.gen{self.generation}.json")
+
+    def _spawn(self) -> None:
+        """Launch the child (non-blocking — it compiles while we return).
+        The launcher's env discipline: inherited environ (``DDW_FAULT``
+        rides along so ``serve:*:replica=N`` specs land in the child),
+        pallas pool pointers stripped, CPU platform pinned for the host."""
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # a serving child wants ONE device — drop an inherited forced-host
+        # device-count (the test suite's 8-device mesh) from XLA_FLAGS
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        if flags:
+            env["XLA_FLAGS"] = " ".join(flags)
+        else:
+            env.pop("XLA_FLAGS", None)
+        port_file = self._port_file()
+        try:
+            os.unlink(port_file)
+        except FileNotFoundError:
+            pass
+        cmd = [sys.executable, "-m", "ddw_tpu.deploy._serve_worker",
+               "--model-dir", self.model_dir,
+               "--port-file", port_file,
+               "--replica-id", str(self.replica_id),
+               "--host", self.host,
+               "--grace-s", str(self.grace_s),
+               "--warmup", json.dumps(list(self.warmup_lens))]
+        if self.engine_cfg:
+            cmd += ["--engine-cfg", json.dumps(self.engine_cfg)]
+        self._ready = False
+        self._port = None
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        self._stopping.clear()
+        self._draining.clear()
+        self._last_alive = time.monotonic()
+        self._health_cache, self._health_at = None, 0.0
+        self.log_path = os.path.join(self._workdir,
+                                     f"child.gen{self.generation}.log")
+        with open(self.log_path, "ab") as log:
+            self._proc = subprocess.Popen(cmd, env=env, stdout=log,
+                                          stderr=log)
+        threading.Thread(target=self._watch, args=(self._proc,),
+                         name=f"ddw-preplica{self.replica_id}-watch",
+                         daemon=True).start()
+
+    def _watch(self, proc: subprocess.Popen) -> None:
+        """Block on the child; an UNEXPECTED death becomes the one-shot
+        ``on_failure`` that wakes the supervisor immediately (no poll lag),
+        exactly like an in-thread engine loop crash."""
+        code = proc.wait()
+        with self._lock:
+            if proc is not self._proc:        # superseded by a respawn
+                return
+            self.last_exit_code = code
+            if self._stopping.is_set():
+                return
+            kind = ("engine_failed" if code == 13 else
+                    "killed" if code < 0 else f"exit_{code}")
+            failure = ReplicaFailed(
+                kind, replica=self.replica_id, generation=self.generation,
+                phase="process", forensics={"exit_code": code,
+                                            "pid": proc.pid})
+            self.failure = failure
+            cb = self.on_failure
+        if cb is not None:
+            try:
+                cb(failure, [])     # nothing to salvage: in-flight HTTP
+            except Exception:       # calls fail their own futures
+                pass
+
+    def _await_port(self, timeout_s: float) -> int:
+        deadline = time.monotonic() + timeout_s
+        port_file = self._port_file()
+        while time.monotonic() < deadline:
+            proc = self._proc
+            if proc is None or proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {self.replica_id} child died during startup "
+                    f"(exit {proc.poll() if proc else None})")
+            try:
+                with open(port_file) as f:
+                    return int(json.load(f)["port"])
+            except (FileNotFoundError, ValueError, KeyError):
+                time.sleep(0.02)
+        raise RuntimeError(f"replica {self.replica_id} child never wrote "
+                           f"its port file (waited {timeout_s:.0f}s)")
+
+    def _ensure_client(self) -> GatewayClient:
+        cli = self._client
+        if cli is None:
+            self._port = self._await_port(self.spawn_timeout_s)
+            # max_retries=0: backpressure policy lives ABOVE this replica
+            # (ReplicaSet spill, pump requeue) — the transport must report
+            # a 429 as Overloaded, not eat it in a local sleep
+            cli = GatewayClient(self.host, self._port,
+                                timeout_s=self.request_timeout_s,
+                                max_retries=0)
+            self._client = cli
+        return cli
+
+    # -- EngineReplica lifecycle --------------------------------------------
+    def start(self) -> "ProcessReplica":
+        if self._proc is None or self._proc.poll() is not None:
+            self._spawn()
+        return self
+
+    def warmup(self, prompt_lens=(8,)) -> None:
+        """Wait out the child's own warmup: its ``/readyz`` flips only
+        after the engine compiled every bucketed program — readiness
+        gating by construction, observed through the load-balancer API."""
+        cli = self._ensure_client()
+        if not cli.wait_ready(self.spawn_timeout_s):
+            raise RuntimeError(
+                f"replica {self.replica_id} child (pid "
+                f"{self._proc.pid if self._proc else '?'}) not ready after "
+                f"{self.spawn_timeout_s:.0f}s")
+        self._ready = True
+        self._last_alive = time.monotonic()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=self.grace_s + 5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # -- supervision hooks ---------------------------------------------------
+    def force_fail(self, kind: str = "stalled", reason: str = "") -> None:
+        """The supervisor's stall hammer: SIGKILL, which — unlike the
+        in-thread path — reclaims a replica wedged ANYWHERE, device work
+        included."""
+        proc = self._proc
+        with self._lock:
+            self._stopping.set()    # the watcher must not double-report
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        with self._lock:
+            self.last_exit_code = proc.poll() if proc else None
+            self.failure = ReplicaFailed(
+                kind, replica=self.replica_id, generation=self.generation,
+                phase="process",
+                forensics={"reason": reason,
+                           "exit_code": self.last_exit_code})
+            failure, cb = self.failure, self.on_failure
+        if cb is not None:
+            try:
+                cb(failure, [])
+            except Exception:
+                pass
+
+    def restart(self) -> None:
+        """Respawn — on the staged checkpoint when a deploy set one.
+        Raises ``RuntimeError`` if the spawn itself fails, which sends the
+        supervisor down its clone_fresh path."""
+        proc = self._proc
+        self._stopping.set()
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        self._apply_pending_checkpoint()
+        self.failure = None
+        self.generation += 1
+        try:
+            self._spawn()
+        except OSError as e:
+            raise RuntimeError(
+                f"replica {self.replica_id} respawn failed: {e}") from e
+
+    def clone_fresh(self) -> "ProcessReplica":
+        """A replacement with this replica's identity and NEXT generation
+        (the supervisor swaps it in via ``ReplicaSet.replace``)."""
+        self._apply_pending_checkpoint()
+        eng = ProcessReplica(self.model_dir, replica_id=self.replica_id,
+                             engine_cfg=self.engine_cfg, host=self.host,
+                             grace_s=self.grace_s,
+                             spawn_timeout_s=self.spawn_timeout_s,
+                             request_timeout_s=self.request_timeout_s,
+                             warmup_lens=self.warmup_lens)
+        eng.generation = self.generation + 1
+        eng.on_failure = self.on_failure
+        return eng
+
+    def recycle(self, drain_timeout_s: float = 30.0) -> bool:
+        """Drain-then-restart, the rolling-deploy primitive: stop taking
+        work, SIGTERM the child (its gateway drains in-flight requests to
+        completion and exits 0), then respawn — on the staged checkpoint
+        when one is pending. False = the drain did not complete in budget
+        (caller escalates to force_fail, same contract as the in-thread
+        engine)."""
+        self._draining.set()
+        proc = self._proc
+        self._stopping.set()
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=drain_timeout_s + self.grace_s)
+            except subprocess.TimeoutExpired:
+                return False
+            if proc.returncode != 0:
+                return False        # the drain crashed, not completed
+        self.last_exit_code = proc.returncode if proc else None
+        self._apply_pending_checkpoint()
+        self.failure = None
+        self.generation += 1
+        self._spawn()
+        return True
+
+    # -- checkpoint hot-swap --------------------------------------------------
+    @property
+    def checkpoint_id(self) -> str | None:
+        h = self.health()
+        return h.get("checkpoint")
+
+    def set_checkpoint(self, model_dir: str | None) -> None:
+        """Stage a weight swap: the NEXT restart/recycle spawns the child
+        on this package (same contract as the in-thread engine)."""
+        self._pending_checkpoint = model_dir
+
+    def _apply_pending_checkpoint(self) -> None:
+        model_dir, self._pending_checkpoint = self._pending_checkpoint, None
+        if model_dir is not None:
+            self.model_dir = model_dir
+
+    # -- health / load -------------------------------------------------------
+    def _poll_child(self) -> dict | None:
+        """One cached /stats poll; None when the child can't answer."""
+        now = time.monotonic()
+        with self._lock:
+            if (self._health_cache is not None
+                    and now - self._health_at < _HEALTH_CACHE_S):
+                return self._health_cache
+        cli = self._client
+        if cli is None or not self._ready:
+            return None
+        try:
+            stats = cli.stats()
+            h = (stats.get("replica_health") or [{}])[0]
+        except Exception:
+            return None
+        with self._lock:
+            self._health_cache, self._health_at = h, now
+            self._last_alive = now
+        return h
+
+    def health(self) -> dict:
+        proc = self._proc
+        if self.failure is not None or proc is None \
+                or (proc.poll() is not None and not self._stopping.is_set()):
+            return {"state": "failed", "replica": self.replica_id,
+                    "generation": self.generation, "running": False,
+                    "last_tick_age_s": time.monotonic() - self._last_alive,
+                    "consecutive_errors": 0, "queue_depth": 0,
+                    "interactive_depth": 0, "batch_depth": 0,
+                    "busy_slots": 0, "reserve_occupancy_pct": 0.0,
+                    "draining": False, "checkpoint": None,
+                    "process": {"pid": proc.pid if proc else None,
+                                "exit_code": self.last_exit_code}}
+        h = self._poll_child()
+        if h is None:
+            # starting (compile in flight) or wedged: a fresh heartbeat
+            # while the handshake is young, a growing one after — the
+            # supervisor's stall clock runs off this number
+            age = 0.0 if not self._ready \
+                else time.monotonic() - self._last_alive
+            return {"state": "alive", "replica": self.replica_id,
+                    "generation": self.generation, "running": True,
+                    "last_tick_age_s": age, "consecutive_errors": 0,
+                    "queue_depth": 0, "interactive_depth": 0,
+                    "batch_depth": 0, "busy_slots": 0,
+                    "reserve_occupancy_pct": 0.0,
+                    "draining": self._draining.is_set(),
+                    "checkpoint": None, "starting": not self._ready,
+                    "process": {"pid": proc.pid}}
+        h = dict(h)
+        # parent-side identity wins: the fleet slot + respawn count, not
+        # the child's own view (a child is always its replica 0, gen 0)
+        h["replica"] = self.replica_id
+        h["generation"] = self.generation
+        h["last_tick_age_s"] = max(float(h.get("last_tick_age_s", 0.0)),
+                                   time.monotonic() - self._last_alive
+                                   - _HEALTH_CACHE_S)
+        h["draining"] = h.get("draining", False) or self._draining.is_set()
+        h["process"] = {"pid": proc.pid}
+        h.pop("circuit", None)      # the PARENT's breaker owns this slot
+        h.pop("restarts", None)
+        h.pop("outstanding", None)
+        return h
+
+    def load(self) -> dict:
+        h = self._health_cache if (self._health_cache is not None) else {}
+        return {"depth": int(h.get("interactive_depth",
+                                   h.get("queue_depth", 0))),
+                "busy": int(h.get("busy_slots", 0)),
+                "batch_depth": int(h.get("batch_depth", 0)),
+                "service_ms": self._service_ms}
+
+    @property
+    def state(self) -> str:
+        return self.health()["state"]
+
+    # -- shadow probe ---------------------------------------------------------
+    def probe(self, timeout_s: float = 30.0) -> None:
+        """The supervisor's readmission gate: one real request against the
+        child, off the routed path (the breaker is still open). Raises on
+        any failure."""
+        cli = self._ensure_client()
+        res = cli.generate([1, 2, 3, 4], 1, temperature=0.0,
+                           timeout_s=timeout_s)
+        if not res.get("tokens"):
+            raise RuntimeError(f"replica {self.replica_id} probe returned "
+                               f"no tokens: {res}")
+
+    # -- submission -----------------------------------------------------------
+    def _admission_gate(self, kind: str) -> None:
+        """Synchronous refusals, matching the in-thread engine's contract:
+        a failed replica raises ReplicaFailed AT SUBMIT (the ReplicaSet
+        records it and walks on), a draining or still-compiling one
+        raises Overloaded (spill to a sibling, don't punish the breaker)."""
+        if self.failure is not None:
+            raise ReplicaFailed(self.failure.kind, replica=self.replica_id,
+                                generation=self.generation, phase="queued",
+                                forensics=self.failure.forensics)
+        proc = self._proc
+        if proc is None or proc.poll() is not None:
+            raise ReplicaFailed("process_dead", replica=self.replica_id,
+                                generation=self.generation, phase="queued")
+        if self._draining.is_set():
+            raise Overloaded(kind, 0, 0, retry_after_ms=250.0)
+        if not self._ready:
+            raise Overloaded(kind, 0, 0, retry_after_ms=500.0)
+
+    def _note_service(self, total_ms: float) -> None:
+        self._service_ms += 0.2 * (total_ms - self._service_ms)
+
+    def _map_exc(self, e: Exception) -> Rejected:
+        if isinstance(e, GatewayOverloaded):
+            return _error_to_exc(e.body)
+        if isinstance(e, GatewayDeadline):
+            return _error_to_exc(e.body)
+        if isinstance(e, GatewayUnavailable):
+            body = e.body if isinstance(e.body, dict) else {}
+            if body.get("error") in ("replica_failed", "unavailable"):
+                exc = _error_to_exc(body)
+                if isinstance(exc, ReplicaFailed):
+                    exc.replica = self.replica_id
+                    exc.generation = self.generation
+                return exc
+            return Unavailable(body.get("state", "child_unavailable"))
+        if isinstance(e, (OSError, GatewayError)):
+            return ReplicaFailed(
+                "transport", replica=self.replica_id,
+                generation=self.generation, phase="submitted",
+                forensics={"exc": repr(e)})
+        return ReplicaFailed("child_error", replica=self.replica_id,
+                             generation=self.generation,
+                             forensics={"exc": repr(e)})
+
+    def submit_generate(self, prompt, num_steps: int,
+                        temperature: float = 0.0, rng=None,
+                        timeout_s: float = 0.0, on_token=None
+                        ) -> concurrent.futures.Future:
+        self._admission_gate("interactive")
+        cli = self._ensure_client()
+        key_data = _key_words(rng) if rng is not None else None
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+
+        def call():
+            t0 = time.monotonic()
+            try:
+                res = cli.generate(prompt, num_steps,
+                                   temperature=temperature,
+                                   key_data=key_data,
+                                   timeout_s=timeout_s or None,
+                                   stream=on_token is not None,
+                                   on_token=on_token)
+            except Exception as e:
+                raise self._map_exc(e) from e
+            self._note_service(res.get("total_ms",
+                                       (time.monotonic() - t0) * 1e3))
+            return GenerateResult(
+                tokens=np.asarray(res["tokens"], dtype=np.int32),
+                queue_ms=float(res.get("queue_ms", 0.0)),
+                ttft_ms=float(res.get("ttft_ms", 0.0)),
+                total_ms=float(res.get("total_ms", 0.0)),
+                tokens_per_sec=float(res.get("tokens_per_sec", 0.0)))
+
+        return self._pool.submit(call)
+
+    def submit_predict(self, item, timeout_s: float = 0.0
+                       ) -> concurrent.futures.Future:
+        self._admission_gate("image")
+        cli = self._ensure_client()
+        payload = np.asarray(item).tolist()
+
+        def call():
+            t0 = time.monotonic()
+            try:
+                res = cli.predict(payload, timeout_s=timeout_s or None,
+                                  return_logits=True)
+            except Exception as e:
+                raise self._map_exc(e) from e
+            self._note_service(res.get("total_ms",
+                                       (time.monotonic() - t0) * 1e3))
+            return PredictResult(
+                logits=np.asarray(res.get("logits", []), dtype=np.float32),
+                label=res.get("label", ""),
+                index=int(res.get("index", -1)),
+                queue_ms=float(res.get("queue_ms", 0.0)),
+                total_ms=float(res.get("total_ms", 0.0)))
+
+        return self._pool.submit(call)
+
+    # -- batch lane -----------------------------------------------------------
+    def submit_batch_item(self, prompt, num_steps: int,
+                          temperature: float = 0.0, rng=None,
+                          timeout_s: float = 0.0
+                          ) -> concurrent.futures.Future:
+        futs = self.submit_batch_items(
+            [np.asarray(prompt).reshape(-1)], [0], kind="generate",
+            num_steps=num_steps, temperature=temperature,
+            key_data=[_key_words(rng)] if rng is not None else None,
+            timeout_s=timeout_s)
+        return futs[0]
+
+    def submit_batch_predict(self, item, timeout_s: float = 0.0
+                             ) -> concurrent.futures.Future:
+        futs = self.submit_batch_items([np.asarray(item)], [0],
+                                       kind="predict", timeout_s=timeout_s)
+        return futs[0]
+
+    def submit_batch_items(self, items, indices, kind: str = "generate",
+                           num_steps: int | None = None,
+                           temperature: float = 0.0,
+                           seed: int | None = None, key_data=None,
+                           timeout_s: float = 0.0
+                           ) -> list[concurrent.futures.Future]:
+        """Grouped batch-lane submission: the WHOLE group crosses the wire
+        in one ``POST /v1/batch/items`` and fans back out into one future
+        per item, each resolving to the engine-result type or raising the
+        item's own structured refusal — so a single refused item requeues
+        alone while its groupmates land."""
+        self._admission_gate("lm_batch" if kind == "generate"
+                             else "image_batch")
+        cli = self._ensure_client()
+        items = [np.asarray(x).tolist() for x in items]
+        indices = [int(i) for i in indices]
+        futs: list[concurrent.futures.Future] = [
+            concurrent.futures.Future() for _ in items]
+        for f in futs:
+            f.set_running_or_notify_cancel()
+
+        def call():
+            try:
+                body: dict = {"kind": kind, "items": items,
+                              "indices": indices,
+                              "temperature": temperature}
+                if num_steps is not None:
+                    body["num_steps"] = num_steps
+                if seed is not None:
+                    body["seed"] = seed
+                if key_data is not None:
+                    body["key_data"] = key_data
+                if timeout_s:
+                    body["timeout_s"] = timeout_s
+                rows = cli._json_call("POST", "/v1/batch/items",
+                                      body)["rows"]
+            except Exception as e:
+                exc = self._map_exc(e)
+                for f in futs:
+                    f.set_exception(exc)
+                return
+            by_index = {r["index"]: r for r in rows}
+            for pos, idx in enumerate(indices):
+                row = by_index.get(idx)
+                if row is None:
+                    futs[pos].set_exception(ReplicaFailed(
+                        "row_missing", replica=self.replica_id,
+                        generation=self.generation))
+                elif not row.get("ok"):
+                    futs[pos].set_exception(_error_to_exc(
+                        row.get("error", {})))
+                elif kind == "generate":
+                    futs[pos].set_result(GenerateResult(
+                        tokens=np.asarray(row["row"]["tokens"],
+                                          dtype=np.int32),
+                        queue_ms=0.0, ttft_ms=0.0, total_ms=0.0,
+                        tokens_per_sec=0.0))
+                else:
+                    futs[pos].set_result(PredictResult(
+                        logits=np.asarray(row["row"].get("logits", []),
+                                          dtype=np.float32),
+                        label=row["row"].get("label", ""),
+                        index=int(row["row"].get("class_index", -1)),
+                        queue_ms=0.0, total_ms=0.0))
+
+        self._pool.submit(call)
+        return futs

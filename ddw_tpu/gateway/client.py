@@ -189,16 +189,23 @@ class GatewayClient:
     # -- data plane ----------------------------------------------------------
     def generate(self, prompt, num_steps: int, temperature: float = 0.0,
                  seed: int | None = None, timeout_s: float | None = None,
-                 stream: bool = False, on_token=None) -> dict:
+                 stream: bool = False, on_token=None,
+                 key_data=None) -> dict:
         """One LM continuation. Returns the final reply dict (``tokens``
         plus the SLO numbers). ``stream=True`` reads the chunked NDJSON
         reply line by line, invoking ``on_token(index, token)`` as each
         arrives — the tokens list in the return value is assembled from
-        the stream and identical to the non-streaming reply."""
+        the stream and identical to the non-streaming reply.
+        ``key_data`` carries a pre-split PRNG key as raw uint32 words, so a
+        caller that already folded its own key (the batch pump, a process
+        replica relaying an in-thread submission) gets bit-identical
+        sampling across the HTTP hop."""
         body = {"prompt": [int(t) for t in prompt], "num_steps": num_steps,
                 "temperature": temperature}
         if seed is not None:
             body["seed"] = seed
+        if key_data is not None:
+            body["key_data"] = [int(w) for w in key_data]
         if timeout_s is not None:
             body["timeout_s"] = timeout_s
         if not stream:
@@ -262,6 +269,31 @@ class GatewayClient:
             body["seed"] = seed
         return self._json_call("POST", "/v1/batch", body)
 
+    def batch_items(self, items, indices=None, kind: str = "generate",
+                    num_steps: int | None = None, temperature: float = 0.0,
+                    seed: int | None = None,
+                    timeout_s: float | None = None) -> list[dict]:
+        """Synchronous grouped submission (``POST /v1/batch/items``): the
+        whole group runs on the ONE engine behind this gateway and the
+        reply carries a per-row verdict — ``{"index", "ok": True, "row"}``
+        or ``{"index", "ok": False, "error"}`` — so one refused item does
+        not poison its groupmates. This is the wire form of the batch
+        pump's per-replica grouping; ``indices`` are the caller's item
+        indices (for rng folding and result placement), defaulting to
+        ``0..n-1``."""
+        body: dict = {"kind": kind,
+                      "items": [np_tolist(x) for x in items],
+                      "temperature": temperature}
+        if indices is not None:
+            body["indices"] = [int(i) for i in indices]
+        if num_steps is not None:
+            body["num_steps"] = num_steps
+        if seed is not None:
+            body["seed"] = seed
+        if timeout_s is not None:
+            body["timeout_s"] = timeout_s
+        return self._json_call("POST", "/v1/batch/items", body)["rows"]
+
     def batch_status(self, job_id: str) -> dict:
         return self._json_call("GET", f"/v1/batch/{job_id}")
 
@@ -300,6 +332,15 @@ class GatewayClient:
     # -- control plane -------------------------------------------------------
     def healthz(self) -> dict:
         return self._json_call("GET", "/healthz")
+
+    def deploy(self, model_dir: str, rollback: bool = True) -> dict:
+        """Kick off a rolling weight hot-swap (``POST /admin/deploy``).
+        Returns the initial deploy view; 409 (a rollout is already in
+        flight) surfaces as :class:`GatewayError` with the live view in
+        the body. Poll :meth:`stats` (the ``deploy`` block) for progress."""
+        return self._json_call("POST", "/admin/deploy",
+                               {"model_dir": model_dir,
+                                "rollback": rollback})
 
     def readyz(self) -> tuple[int, dict]:
         status, _h, resp, conn = self._request("GET", "/readyz",

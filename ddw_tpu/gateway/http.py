@@ -205,6 +205,9 @@ class _Handler(BaseHTTPRequestHandler):
                 except Exception:
                     pass     # readiness must answer even if a replica's
                 #              health probe is mid-death
+                dep = gw.deploy_view()
+                body["deploying"] = dep["deploying"]
+                body["fleet_generation"] = dep["fleet_generation"]
                 if ready:
                     self._send_json(200, body)
                 else:
@@ -224,7 +227,8 @@ class _Handler(BaseHTTPRequestHandler):
                                        if gw._httpd else 0),
                        **gw.replica_set.snapshot(),
                        "replica_health": gw.replica_set.fleet_health(),
-                       "lanes": gw.lane_stats()}
+                       "lanes": gw.lane_stats(),
+                       "deploy": gw.deploy_view()}
                 if gw.supervisor is not None:
                     out["supervisor"] = gw.supervisor.report()
                 self._send_json(200, out)
@@ -239,7 +243,11 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST: the data plane -------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802
         gw = self.server.gateway
-        if self.path not in ("/v1/generate", "/v1/predict", "/v1/batch"):
+        if self.path == "/admin/deploy":
+            self._admin_deploy(gw)
+            return
+        if self.path not in ("/v1/generate", "/v1/predict", "/v1/batch",
+                             "/v1/batch/items"):
             self._send_json(404, {"error": "not_found", "path": self.path})
             return
         # admission into the lifecycle ledger FIRST: a draining or not-yet-
@@ -257,6 +265,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._generate(gw, body)
             elif self.path == "/v1/batch":
                 self._batch_submit(gw, body)
+            elif self.path == "/v1/batch/items":
+                self._batch_items(gw, body)
             else:
                 self._predict(gw, body)
         except (BrokenPipeError, ConnectionResetError):
@@ -276,6 +286,14 @@ class _Handler(BaseHTTPRequestHandler):
                 import jax
 
                 kw["rng"] = jax.random.PRNGKey(int(body["seed"]))
+            elif body.get("key_data") is not None:
+                # a raw PRNG key relayed by a parent-process proxy (the
+                # ProcessReplica transport): the same uint32 words the
+                # in-thread path would pass, so sampling stays bit-identical
+                # across the process hop
+                import jax.numpy as jnp
+
+                kw["rng"] = jnp.asarray(body["key_data"], dtype=jnp.uint32)
         except (KeyError, TypeError, ValueError) as e:
             self._send_json(400, {"error": "invalid_request",
                                   "message": f"bad field: {e}"})
@@ -410,6 +428,8 @@ class _Handler(BaseHTTPRequestHandler):
                 kw["num_steps"] = int(body["num_steps"])
             if body.get("seed") is not None:
                 kw["seed"] = int(body["seed"])
+            if body.get("group_size") is not None:
+                kw["group_size"] = int(body["group_size"])
         except (KeyError, TypeError, ValueError) as e:
             self._send_json(400, {"error": "invalid_request",
                                   "message": f"bad field: {e}"})
@@ -425,6 +445,119 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, {"job_id": job.job_id, "kind": job.kind,
                               "total": job.total})
+
+    def _batch_items(self, gw: "Gateway", body: dict) -> None:
+        """One POST, N batch-lane items — the per-replica grouped
+        submission a parent-process pump uses to cut per-item HTTP
+        overhead. All items are submitted first (the engine pipelines the
+        group), then awaited; each row answers individually (``ok`` +
+        result, or the structured refusal), so one refused item never
+        poisons its groupmates."""
+        try:
+            kind = str(body.get("kind", "generate"))
+            raw = body["items"]
+            indices = [int(i) for i in body.get("indices",
+                                                range(len(raw)))]
+            if not isinstance(raw, list) or not raw:
+                raise ValueError("items must be a non-empty list")
+            if len(indices) != len(raw):
+                raise ValueError("indices must match items 1:1")
+            temperature = float(body.get("temperature", 0.0))
+            timeout_s = float(body.get("timeout_s", 0.0))
+            num_steps = (int(body["num_steps"])
+                         if body.get("num_steps") is not None else None)
+            seed = (int(body["seed"])
+                    if body.get("seed") is not None else None)
+            key_data = body.get("key_data")   # pre-split keys, one per item
+            if key_data is not None and len(key_data) != len(raw):
+                raise ValueError("key_data must match items 1:1")
+            if kind == "generate":
+                items = [np.asarray(x, np.int32) for x in raw]
+            else:
+                items = [np.asarray(x, np.float32) for x in raw]
+        except (KeyError, TypeError, ValueError) as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": f"bad field: {e}"})
+            return
+        base = None
+        if kind == "generate" and temperature > 0.0 and seed is not None \
+                and key_data is None:
+            import jax
+
+            base = jax.random.PRNGKey(seed)
+        futs: list = []
+        for pos, (item, idx) in enumerate(zip(items, indices)):
+            try:
+                if kind == "generate":
+                    import jax
+                    import jax.numpy as jnp
+
+                    if key_data is not None:
+                        rng = jnp.asarray(key_data[pos], dtype=jnp.uint32)
+                    else:
+                        rng = (jax.random.fold_in(base, idx)
+                               if base is not None else None)
+                    fut = gw.replica_set.submit_batch_item(
+                        item, num_steps, temperature=temperature, rng=rng,
+                        timeout_s=timeout_s)
+                else:
+                    fut = gw.replica_set.submit_batch_predict(
+                        item, timeout_s=timeout_s)
+            except Rejected as e:
+                futs.append((idx, None, e.to_dict()))
+                continue
+            except ValueError as e:
+                futs.append((idx, None, {"error": "invalid_request",
+                                         "message": str(e)}))
+                continue
+            futs.append((idx, fut, None))
+        rows = []
+        for idx, fut, err in futs:
+            if fut is None:
+                rows.append({"index": idx, "ok": False, "error": err})
+                continue
+            try:
+                res = fut.result()
+            except Rejected as e:
+                rows.append({"index": idx, "ok": False,
+                             "error": e.to_dict()})
+                continue
+            except Exception as e:
+                rows.append({"index": idx, "ok": False,
+                             "error": {"error": "internal",
+                                       "message": repr(e)}})
+                continue
+            if kind == "generate":
+                row = {"tokens": [int(t) for t in res.tokens]}
+            else:
+                row = {"label": res.label, "class_index": int(res.index)}
+            rows.append({"index": idx, "ok": True, "row": row})
+        self._send_json(200, {"rows": rows})
+
+    def _admin_deploy(self, gw: "Gateway") -> None:
+        """Kick a rolling weight hot-swap across this gateway's fleet —
+        the ``tools/rolling_deploy.py`` control plane. The rollout runs on
+        its own thread; progress is read back from ``/stats``."""
+        body = self._read_body()
+        if body is None:
+            return
+        model_dir = body.get("model_dir")
+        if not model_dir or not isinstance(model_dir, str):
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": "model_dir (str) is required"})
+            return
+        try:
+            started = gw.start_deploy(model_dir,
+                                      rollback=bool(body.get("rollback",
+                                                             True)))
+        except Exception as e:
+            self._send_json(500, {"error": "internal", "message": repr(e)})
+            return
+        if not started:
+            self._send_json(409, {"error": "deploy_in_progress",
+                                  **gw.deploy_view()})
+            return
+        self._send_json(200, gw.deploy_view())
 
     def _batch_job(self, gw: "Gateway"):
         """Resolve ``/v1/batch/<id>[/results]`` → (job, tail) or None after
@@ -495,7 +628,8 @@ class Gateway:
 
     def __init__(self, replicas, host: str = "127.0.0.1", port: int = 0,
                  grace_s: float | None = None, supervise: bool = True,
-                 supervisor_kw: dict | None = None):
+                 supervisor_kw: dict | None = None,
+                 job_ledger_dir: str | None = None):
         self.replica_set = (replicas if isinstance(replicas, ReplicaSet)
                             else ReplicaSet(replicas))
         self.lifecycle = ServerLifecycle(grace_s)
@@ -510,14 +644,25 @@ class Gateway:
         self.supervisor: ReplicaSupervisor | None = None
         # batch-lane job registry: host-side, above the replicas, so jobs
         # survive engine restarts/recycles (the pump resubmits; results
-        # recorded here are never lost)
-        self.jobs = JobLedger()
+        # recorded here are never lost). With ``job_ledger_dir`` the ledger
+        # is DURABLE: specs and completed rows persist to disk and a
+        # restarted gateway resumes every unfinished job mid-flight.
+        self.jobs = JobLedger(ledger_dir=job_ledger_dir)
+        # rolling-deploy state, surfaced through /stats and /readyz; the
+        # DeployController thread (start_deploy) mutates it under the lock
+        self._deploy_lock = threading.Lock()
+        self._deploy_thread: threading.Thread | None = None
+        self.deploy_status: dict = {"deploying": False, "status": "idle",
+                                    "fleet_generation": 0, "steps": []}
 
     # -- lifecycle -----------------------------------------------------------
-    def start(self, warmup_prompt_lens=(8,)) -> "Gateway":
+    def start(self, warmup_prompt_lens=(8,), on_listening=None) -> "Gateway":
         """Bring the listener up FIRST (``/healthz`` answers while XLA
         compiles), then warm every replica's program lattice, then flip
-        ``/readyz`` — readiness is gated on warmup by construction."""
+        ``/readyz`` — readiness is gated on warmup by construction.
+        ``on_listening(port)`` fires the moment the socket is bound (before
+        warmup) — the process-replica child uses it to hand its port to the
+        parent so health is observable through the compile."""
         if self._httpd is not None:
             return self
         self.replica_set.start()
@@ -526,6 +671,8 @@ class Gateway:
             target=self._httpd.serve_forever, name="ddw-gateway-http",
             daemon=True)
         self._http_thread.start()
+        if on_listening is not None:
+            on_listening(self.port)
         if warmup_prompt_lens:
             self.replica_set.warmup(warmup_prompt_lens)
         if self._supervise and self.supervisor is None:
@@ -534,8 +681,46 @@ class Gateway:
             kw.update(self._supervisor_kw)
             self.supervisor = ReplicaSupervisor(self.replica_set,
                                                 **kw).start()
+        self.jobs.resume(self.replica_set)   # durable ledger: restart any
+        #                                      job a dead gateway left behind
         self.lifecycle.mark_ready()
         return self
+
+    # -- rolling deploys ------------------------------------------------------
+    def deploy_view(self) -> dict:
+        """The /stats deploy block: rollout state + per-replica checkpoint
+        ids (what a load balancer or drill needs to observe a rollout)."""
+        with self._deploy_lock:
+            out = {k: (list(v) if isinstance(v, list) else v)
+                   for k, v in self.deploy_status.items()}
+        out["checkpoints"] = [h.get("checkpoint")
+                              for h in self.replica_set.fleet_health()]
+        return out
+
+    def start_deploy(self, model_dir: str, rollback: bool = True,
+                     **kw) -> bool:
+        """Launch a rolling weight hot-swap across the fleet on a control
+        thread (the ``POST /admin/deploy`` implementation). Returns False
+        when a rollout is already in flight. Requires the supervisor (its
+        recycle path IS the per-replica roll)."""
+        from ddw_tpu.deploy.controller import DeployController
+
+        if self.supervisor is None:
+            raise RuntimeError("rolling deploy needs supervise=True "
+                               "(the supervisor owns the recycle path)")
+        with self._deploy_lock:
+            if self.deploy_status.get("deploying"):
+                return False
+            self.deploy_status.update(deploying=True, status="starting",
+                                      target_dir=model_dir, steps=[])
+        ctrl = DeployController(self.replica_set, self.supervisor,
+                                model_dir, rollback=rollback,
+                                status=self.deploy_status,
+                                status_lock=self._deploy_lock, **kw)
+        self._deploy_thread = threading.Thread(
+            target=ctrl.run, name="ddw-deploy", daemon=True)
+        self._deploy_thread.start()
+        return True
 
     def lane_stats(self) -> dict:
         """Per-lane fleet view for ``/stats`` and ``/readyz``: queue depths

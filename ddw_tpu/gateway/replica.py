@@ -49,6 +49,7 @@ ReplicaSupervisor` watches the same health surface and owns recovery.
 
 from __future__ import annotations
 
+import concurrent.futures
 import threading
 import time
 
@@ -447,6 +448,103 @@ class ReplicaSet:
 
     def submit_batch_predict(self, item, **kw):
         return self._submit("submit_batch_predict", (item,), kw)
+
+    def submit_batch_items(self, items, indices, kind: str = "generate",
+                           num_steps: int | None = None,
+                           temperature: float = 0.0,
+                           seed: int | None = None,
+                           timeout_s: float = 0.0) -> list:
+        """Route a GROUP of batch-lane items to ONE replica — the pump's
+        per-replica batching: a process replica takes the whole group in a
+        single HTTP exchange (``submit_batch_items`` on the engine), an
+        in-thread engine takes a per-item loop. Returns one future per
+        item, every one registered with this set's accounting + breaker
+        feed. Items a mid-group refusal strands come back as pre-failed
+        futures carrying the refusal (the pump requeues them); the
+        group-level spill budget matches ``_submit``'s."""
+        indices = list(indices)
+        order = self._order()
+        if not order:
+            raise Unavailable("all replica circuits open",
+                              retry_after_ms=self._min_retry_ms())
+        last: Exception | None = None
+        overloads = 0
+        for i in order:
+            if overloads >= 2:
+                break
+            eng = self.replicas[i]
+            try:
+                if hasattr(eng, "submit_batch_items"):
+                    futs = eng.submit_batch_items(
+                        items, indices, kind=kind, num_steps=num_steps,
+                        temperature=temperature, seed=seed,
+                        timeout_s=timeout_s)
+                else:
+                    futs = self._batch_item_loop(
+                        eng, items, indices, kind, num_steps, temperature,
+                        seed, timeout_s)
+            except Overloaded as e:
+                last = e
+                overloads += 1
+                if overloads < 2 and i != order[-1]:
+                    with self._lock:
+                        self.retried_429 += 1
+                continue
+            except ReplicaFailed as e:
+                last = e
+                self.breakers[i].record_failure()
+                continue
+            self.breakers[i].begin_probe()
+            with self._lock:
+                for fut in futs:
+                    if not fut.done():      # pre-failed stragglers stay
+                        self._outstanding[i] += 1   # out of the breaker
+                        self._where[fut] = i        # feed — the replica
+            #                                         never saw them
+            for fut in futs:
+                if not fut.done():
+                    fut.add_done_callback(self._on_done)
+            return futs
+        raise last
+
+    def _batch_item_loop(self, eng, items, indices, kind, num_steps,
+                         temperature, seed, timeout_s) -> list:
+        """Per-item submission of a group against ONE in-thread engine.
+        The first item's refusal propagates (the group spills sideways);
+        a refusal mid-group pre-fails the REMAINING items' futures locally
+        so the landed prefix keeps its engine slots."""
+        base = None
+        if kind == "generate" and temperature > 0.0 and seed is not None:
+            import jax
+
+            base = jax.random.PRNGKey(seed)
+        futs: list = []
+        pending_exc: Exception | None = None
+        for pos, (item, idx) in enumerate(zip(items, indices)):
+            if pending_exc is None:
+                try:
+                    if kind == "generate":
+                        import jax
+
+                        rng = (jax.random.fold_in(base, idx)
+                               if base is not None else None)
+                        fut = eng.submit_batch_item(
+                            item, num_steps, temperature=temperature,
+                            rng=rng, timeout_s=timeout_s)
+                    else:
+                        fut = eng.submit_batch_predict(
+                            item, timeout_s=timeout_s)
+                    futs.append(fut)
+                    continue
+                except (Overloaded, ReplicaFailed) as e:
+                    if pos == 0:
+                        raise
+                    pending_exc = e
+            fut = concurrent.futures.Future()
+            fut.set_running_or_notify_cancel()
+            fut.set_exception(pending_exc)
+            futs.append(fut)
+        return futs
 
     def submit_batch(self, items, kind: str = "generate", **kw):
         """Start a host-side :class:`~ddw_tpu.serve.lanes.BatchJob` whose

@@ -259,7 +259,13 @@ class ReplicaSupervisor:
         surface keep the classic half-open single-live-probe gate."""
         probe = None
         if self.shadow_probe:
-            if getattr(eng, "pool", None) is not None and \
+            if hasattr(eng, "probe"):
+                # an explicit probe surface (process replicas: one real
+                # request through the child's own HTTP door) beats guessing
+                # from engine internals
+                probe = lambda: eng.probe(  # noqa: E731
+                    timeout_s=self.probe_timeout_s)
+            elif getattr(eng, "pool", None) is not None and \
                     hasattr(eng, "generate"):
                 probe = lambda: eng.generate(  # noqa: E731
                     [1, 2, 3, 4], 1, timeout_s=self.probe_timeout_s)
@@ -286,14 +292,16 @@ class ReplicaSupervisor:
         return "probed_closed"
 
     # -- graceful recycle (drain-then-restart; never fails in-slot work) -----
-    def recycle(self, i: int) -> bool:
+    def recycle(self, i: int, kind: str = "degraded") -> bool:
         """Drain replica ``i``'s in-slot requests to completion, restart it
         in place (queued work preserved, served by the next generation),
         re-warm, shadow-probe, and readmit. The operator-facing building
-        block for rolling restarts / weight hot-swap, and the automatic
-        path for degraded-too-long replicas. Falls back to ``force_fail``
-        (today's hard path — futures failed over) when the drain times
-        out. Returns True on a clean recycle."""
+        block for rolling restarts / weight hot-swap (the
+        :class:`~ddw_tpu.deploy.DeployController` calls this with
+        ``kind="deploy"`` after staging a checkpoint swap), and the
+        automatic path for degraded-too-long replicas. Falls back to
+        ``force_fail`` (today's hard path — futures failed over) when the
+        drain times out. Returns True on a clean recycle."""
         eng = self.rs.replicas[i]
         if not hasattr(eng, "recycle"):
             return False
@@ -310,7 +318,7 @@ class ReplicaSupervisor:
         if not ok:
             with self._lock:
                 self.attempts.append(ReplicaAttempt(
-                    replica=i, generation=gen, kind="degraded",
+                    replica=i, generation=gen, kind=kind,
                     action="drain_timeout", elapsed_s=time.monotonic() - t0,
                     forensics={}))
             try:
@@ -328,7 +336,7 @@ class ReplicaSupervisor:
         self._next_attempt_at[i] = time.monotonic() + self._backoff(1)
         att = ReplicaAttempt(
             replica=i, generation=getattr(eng, "generation", gen),
-            kind="degraded", action="drained_restarted",
+            kind=kind, action="drained_restarted",
             elapsed_s=time.monotonic() - t0, forensics={})
         with self._lock:
             self.attempts.append(att)

@@ -297,6 +297,32 @@ class ServingEngine:
         self._fault_n: dict[str, int] = {}   # per-site hook counts (per gen)
         self._inflight_admit: list = []      # claimed reqs mid-device-work
 
+        self.model_dir: str | None = None    # checkpoint dir behind _lm,
+        #                                      when loaded from a package
+        self._pending_checkpoint: str | None = None   # applied at restart()
+        self._init_lm(lm)
+        self._pool_stats_seen: dict[str, int] = {}
+
+        self._image = (image.engine_handle()
+                       if hasattr(image, "engine_handle") else image)
+        if self._image is not None:
+            h = self._image
+
+            def make_apply():
+                variables = {"params": h.params}
+                if h.batch_stats:
+                    variables["batch_stats"] = h.batch_stats
+                return jax.jit(
+                    lambda imgs: h.model.apply(variables, imgs, train=False))
+
+            self._image_apply = make_apply()  # one callable; jit caches per
+            #                                   padded batch-bucket shape
+
+    def _init_lm(self, lm) -> None:
+        """Build (or rebuild) the LM handle + KV pool. Called at
+        construction and by :meth:`restart` when a pending checkpoint swap
+        (:meth:`set_checkpoint`) replaces the weights — the pool compiles
+        against the new params inside the warmup gate, never on traffic."""
         self._lm = lm.engine_handle() if hasattr(lm, "engine_handle") else lm
         if self._lm is not None:
             if self.cfg.paged:
@@ -343,22 +369,37 @@ class ServingEngine:
             self._temps = np.zeros((n,), np.float32)
         else:
             self.pool = None
-        self._pool_stats_seen: dict[str, int] = {}
 
-        self._image = (image.engine_handle()
-                       if hasattr(image, "engine_handle") else image)
-        if self._image is not None:
-            h = self._image
+    # -- checkpoint hot-swap (the deploy layer's weight-reload hook) ---------
+    @property
+    def checkpoint_id(self) -> str | None:
+        """Content digest of the serving LM package, when known — the
+        identity the deploy layer pins a rollout on (``/stats`` per-replica
+        checkpoint id)."""
+        digest = getattr(self._lm, "content_digest", None)
+        return digest or None
 
-            def make_apply():
-                variables = {"params": h.params}
-                if h.batch_stats:
-                    variables["batch_stats"] = h.batch_stats
-                return jax.jit(
-                    lambda imgs: h.model.apply(variables, imgs, train=False))
+    def set_checkpoint(self, model_dir: str | None) -> None:
+        """Stage a weight swap: the NEXT :meth:`restart` (so also
+        :meth:`recycle`) loads the LM package at ``model_dir`` and rebuilds
+        the pool over its params. Nothing changes until then — in-slot work
+        keeps decoding against the current weights, which is exactly what a
+        drain-then-restart rolling deploy needs. ``None`` clears a staged
+        swap."""
+        self._pending_checkpoint = model_dir
 
-            self._image_apply = make_apply()  # one callable; jit caches per
-            #                                   padded batch-bucket shape
+    def _apply_pending_checkpoint(self) -> None:
+        """Inside restart(): swap the staged package in. Raises on a bad
+        package — the caller (supervisor recycle / DeployController) treats
+        that as a failed step and rolls back."""
+        model_dir, self._pending_checkpoint = self._pending_checkpoint, None
+        if model_dir is None:
+            return
+        from ddw_tpu.serving.lm_package import load_lm_package
+
+        pkg = load_lm_package(model_dir)
+        self._init_lm(pkg)
+        self.model_dir = model_dir
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -444,6 +485,7 @@ class ServingEngine:
                 round(self.pool.reserve_occupancy_pct, 2)
                 if isinstance(self.pool, BlockPool) else 0.0),
             "draining": self._draining.is_set(),
+            "checkpoint": self.checkpoint_id,
         }
 
     def load(self) -> dict:
@@ -495,7 +537,13 @@ class ServingEngine:
         self.generation += 1
         self._fault_n = {}
         self._inflight_admit = []
-        if self.pool is not None:
+        if self._pending_checkpoint is not None:
+            # staged weight swap (set_checkpoint): rebuild the handle and
+            # pool over the new package — a fresh pool, so no reset; the
+            # stats baseline starts over with it
+            self._apply_pending_checkpoint()
+            self._pool_stats_seen = {}
+        elif self.pool is not None:
             self._slot_req.clear()
             self._cur[:] = 0
             self._temps[:] = 0.0
@@ -567,6 +615,7 @@ class ServingEngine:
                             replica_id=self.replica_id)
         eng.generation = self.generation + 1
         eng.on_failure = self.on_failure
+        eng.model_dir = self.model_dir
         return eng
 
     def adopt(self, kind: str, req) -> None:

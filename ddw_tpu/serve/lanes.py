@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import json
 import os
 import threading
 import time
@@ -100,7 +101,9 @@ class BatchJob:
     def __init__(self, kind: str, n_items: int, submit_fn, row_fn,
                  window: int, max_item_retries: int = 64,
                  retry_base_s: float = 0.05, retry_max_s: float = 2.0,
-                 clock=time.monotonic, job_id: str | None = None):
+                 clock=time.monotonic, job_id: str | None = None,
+                 submit_many_fn=None, group_size: int = 1,
+                 completed: dict | None = None):
         if n_items < 1:
             raise ValueError(f"a batch job needs >= 1 item, got {n_items}")
         if window < 1:
@@ -113,15 +116,25 @@ class BatchJob:
         self.retry_base_s = retry_base_s
         self.retry_max_s = retry_max_s
         self._submit_fn = submit_fn       # (index) -> Future
+        self._submit_many_fn = submit_many_fn   # (indices) -> [Future]
+        self.group_size = max(1, int(group_size))
         self._row_fn = row_fn             # (index, result) -> row dict
         self._clock = clock
         self._lock = threading.Lock()
         self._state = JOB_RUNNING
+        # durable-ledger hooks (None = in-memory job): on_row(idx, row)
+        # fires exactly once per newly-recorded row; on_state(state) on
+        # terminal transitions the ledger should remember
+        self.on_row = None
+        self.on_state = None
+        completed = completed or {}
         self._pending: collections.deque[int] = collections.deque(
-            range(n_items))
+            i for i in range(n_items) if i not in completed)
         self._inflight: dict[int, object] = {}     # index -> Future
         self._retries: dict[int, int] = {}
-        self._results: dict[int, dict] = {}        # exactly-once, by index
+        # exactly-once, by index; a resumed job pre-seeds the rows its
+        # previous life already landed — they are never re-run
+        self._results: dict[int, dict] = dict(completed)
         self._failures: dict[int, dict] = {}       # permanent, by index
         self._requeues = 0
         self._timer: threading.Timer | None = None
@@ -131,6 +144,7 @@ class BatchJob:
 
     # -- pump ----------------------------------------------------------------
     def _start(self) -> "BatchJob":
+        self._maybe_finish()    # a resumed job may have nothing left to do
         self._feed()
         return self
 
@@ -138,14 +152,26 @@ class BatchJob:
         """Fill the in-flight window from the pending deque. Runs on the
         submitter's thread, a completion callback, or the backoff timer —
         never holds the lock across a submission (submit can run engine
-        validation and queue locks)."""
+        validation and queue locks). With a grouped submitter
+        (``submit_many_fn`` + ``group_size > 1``) the window fills a
+        GROUP at a time — one wire exchange per group on a process
+        replica."""
+        grouped = self._submit_many_fn is not None and self.group_size > 1
         while True:
             with self._lock:
                 if self._state != JOB_RUNNING:
                     return
-                if not self._pending or len(self._inflight) >= self.window:
+                room = self.window - len(self._inflight)
+                if not self._pending or room < 1:
                     return
-                idx = self._pending.popleft()
+                n = (min(room, self.group_size, len(self._pending))
+                     if grouped else 1)
+                idxs = [self._pending.popleft() for _ in range(n)]
+            if grouped:
+                if self._feed_group(idxs):
+                    continue
+                return
+            idx = idxs[0]
             try:
                 fut = self._submit_fn(idx)
             except _RETRYABLE as e:
@@ -156,6 +182,7 @@ class BatchJob:
                 return
             except Exception as e:
                 self._fail_item(idx, e)
+                self._maybe_finish()
                 continue
             with self._lock:
                 if self._state != JOB_RUNNING:
@@ -164,6 +191,35 @@ class BatchJob:
                 self._inflight[idx] = fut
             fut.add_done_callback(
                 lambda f, i=idx: self._on_item_done(i, f))
+
+    def _feed_group(self, idxs: list[int]) -> bool:
+        """Submit one group; True = keep feeding, False = backed off."""
+        try:
+            futs = self._submit_many_fn(idxs)
+        except _RETRYABLE as e:
+            for idx in reversed(idxs):      # FRONT, original order kept
+                self._requeue(idx, e, schedule=False)
+            self._schedule_feed(min(
+                self.retry_base_s * (2 ** min(
+                    self._retries.get(idxs[0], 1) - 1, 6)),
+                self.retry_max_s))
+            return False
+        except Exception as e:
+            for idx in idxs:
+                self._fail_item(idx, e)
+            self._maybe_finish()
+            return True
+        with self._lock:
+            if self._state != JOB_RUNNING:
+                for f in futs:
+                    f.cancel()
+                return False
+            for idx, fut in zip(idxs, futs):
+                self._inflight[idx] = fut
+        for idx, fut in zip(idxs, futs):
+            fut.add_done_callback(
+                lambda f, i=idx: self._on_item_done(i, f))
+        return True
 
     def _on_item_done(self, idx: int, fut) -> None:
         with self._lock:
@@ -185,9 +241,15 @@ class BatchJob:
     def _record(self, idx: int, result) -> None:
         row = self._row_fn(idx, result)
         with self._lock:
-            if idx not in self._results:      # exactly-once by index
+            new = idx not in self._results
+            if new:                           # exactly-once by index
                 self._results[idx] = row
                 self._t_last = self._clock()
+        if new and self.on_row is not None:
+            try:
+                self.on_row(idx, row)         # durable append (fsync'd);
+            except OSError:                   # a full disk must not kill
+                pass                          # the in-memory job
 
     def _fail_item(self, idx: int, exc: Exception) -> None:
         err = (exc.to_dict() if isinstance(exc, Rejected)
@@ -196,7 +258,8 @@ class BatchJob:
             if idx not in self._results and idx not in self._failures:
                 self._failures[idx] = {"index": idx, **err}
 
-    def _requeue(self, idx: int, exc: Exception) -> None:
+    def _requeue(self, idx: int, exc: Exception,
+                 schedule: bool = True) -> None:
         with self._lock:
             if self._state != JOB_RUNNING:
                 return
@@ -206,7 +269,8 @@ class BatchJob:
             self._pending.appendleft(idx)
             delay = min(self.retry_base_s * (2 ** min(n - 1, 6)),
                         self.retry_max_s)
-        self._schedule_feed(delay)
+        if schedule:
+            self._schedule_feed(delay)
 
     def _schedule_feed(self, delay: float) -> None:
         with self._lock:
@@ -233,6 +297,11 @@ class BatchJob:
                 return
             self._state = JOB_DONE
         self._terminal.set()
+        if self.on_state is not None:
+            try:
+                self.on_state(JOB_DONE)
+            except OSError:
+                pass
 
     # -- caller API ----------------------------------------------------------
     @property
@@ -283,10 +352,16 @@ class BatchJob:
         with self._lock:
             return [self._results[i] for i in sorted(self._results)]
 
-    def cancel(self) -> None:
+    def cancel(self, durable: bool = True) -> None:
         """Stop the pump: pending items are dropped, queued in-flight
         futures are cancelled (engine-side they are discarded before any
-        device work), completed rows are KEPT. Idempotent."""
+        device work), completed rows are KEPT. Idempotent.
+
+        ``durable=False`` (the gateway's DRAIN path) stops this process's
+        pump without recording the cancellation in a durable ledger — the
+        job's meta stays ``running`` on disk, so a restarted gateway
+        RESUMES it. A user-initiated cancel is durable: the job stays
+        cancelled across restarts."""
         with self._lock:
             if self._state != JOB_RUNNING:
                 return
@@ -299,6 +374,20 @@ class BatchJob:
         for f in futs:
             f.cancel()           # queued -> dropped; admitted -> completes
         self._terminal.set()
+        if durable and self.on_state is not None:
+            try:
+                self.on_state(JOB_CANCELLED)
+            except OSError:
+                pass
+
+
+def _write_json_atomic(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 class JobLedger:
@@ -307,15 +396,38 @@ class JobLedger:
     HOST-side, above the engines: an engine ``restart()``/``recycle()``
     never touches it, which is what makes a job survive one. Terminal
     jobs are pruned oldest-first past ``max_jobs`` so a long-lived
-    gateway does not accumulate result sets forever."""
+    gateway does not accumulate result sets forever.
 
-    def __init__(self, max_jobs: int = 256):
+    With ``ledger_dir`` the ledger is DURABLE — jobs survive the GATEWAY
+    process dying, not just a replica. Per job, on disk::
+
+        <ledger_dir>/<job_id>/meta.json     spec + state (atomic rewrite)
+        <ledger_dir>/<job_id>/rows.jsonl    completed rows, appended +
+                                            fsync'd as each item lands
+
+    ``rows.jsonl`` is the exactly-once set made durable: a restarted
+    gateway's :meth:`resume` re-pumps every ``running`` job with its
+    completed rows pre-seeded, so no finished item is ever recomputed and
+    no item is lost — a kill -9 between the append and the next item
+    costs at most the re-run of rows whose append never landed."""
+
+    def __init__(self, max_jobs: int = 256,
+                 ledger_dir: str | None = None):
         self.max_jobs = max_jobs
+        self.dir = ledger_dir
+        if self.dir:
+            os.makedirs(self.dir, exist_ok=True)
         self._jobs: collections.OrderedDict[str, BatchJob] = \
             collections.OrderedDict()
         self._lock = threading.Lock()
 
-    def add(self, job: BatchJob) -> BatchJob:
+    def add(self, job: BatchJob, spec: dict | None = None) -> BatchJob:
+        if self.dir:
+            try:
+                self._attach_durable(job, spec)
+            except OSError:
+                pass                 # a read-only disk degrades to the
+            #                          in-memory ledger, not a dead job
         with self._lock:
             self._jobs[job.job_id] = job
             # prune terminal jobs oldest-first; live jobs are never evicted
@@ -326,6 +438,79 @@ class JobLedger:
                     break
                 del self._jobs[victim]
         return job
+
+    def _attach_durable(self, job: BatchJob, spec: dict | None) -> None:
+        d = os.path.join(self.dir, job.job_id)
+        os.makedirs(d, exist_ok=True)
+        meta_path = os.path.join(d, "meta.json")
+        meta = {"job_id": job.job_id, "kind": job.kind,
+                "total": job.total, "state": JOB_RUNNING, "spec": spec}
+        try:
+            _write_json_atomic(meta_path, meta)
+        except TypeError:            # a spec that can't cross to JSON
+            meta["spec"] = None      # (array prompts do; exotic items
+            _write_json_atomic(meta_path, meta)   # don't) → not resumable,
+        #                                           rows still durable
+        rows_f = open(os.path.join(d, "rows.jsonl"), "a")
+        io_lock = threading.Lock()
+
+        def on_row(idx: int, row: dict) -> None:
+            with io_lock:
+                rows_f.write(json.dumps(row) + "\n")
+                rows_f.flush()
+                os.fsync(rows_f.fileno())
+
+        def on_state(state: str) -> None:
+            meta["state"] = state
+            _write_json_atomic(meta_path, meta)
+            if state != JOB_RUNNING:
+                with io_lock:
+                    rows_f.close()
+
+        job.on_row = on_row
+        job.on_state = on_state
+
+    def resume(self, target) -> list[BatchJob]:
+        """Restart every durable job a previous gateway life left
+        ``running`` — completed rows pre-seeded, only the remainder
+        pumped. Called by ``Gateway.start()`` after warmup (the fleet
+        must be able to take the resubmissions)."""
+        if not self.dir:
+            return []
+        out: list[BatchJob] = []
+        for name in sorted(os.listdir(self.dir)):
+            meta_path = os.path.join(self.dir, name, "meta.json")
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+            except (FileNotFoundError, NotADirectoryError, ValueError):
+                continue
+            job_id = meta.get("job_id", name)
+            spec = meta.get("spec")
+            if (meta.get("state") != JOB_RUNNING or not spec
+                    or self.get(job_id) is not None):
+                continue
+            completed: dict[int, dict] = {}
+            try:
+                with open(os.path.join(self.dir, name, "rows.jsonl")) as f:
+                    for line in f:
+                        try:
+                            row = json.loads(line)
+                            completed[int(row["index"])] = row
+                        except (ValueError, KeyError, TypeError):
+                            pass     # a torn final append: re-run that item
+            except FileNotFoundError:
+                pass
+            out.append(start_batch_job(
+                target, spec["items"], kind=spec.get("kind", "generate"),
+                num_steps=spec.get("num_steps"),
+                temperature=spec.get("temperature", 0.0),
+                seed=spec.get("seed"),
+                timeout_s=spec.get("timeout_s", 0.0),
+                window=spec.get("window", 0),
+                group_size=spec.get("group_size", 0),
+                job_id=job_id, completed=completed, ledger=self))
+        return out
 
     def get(self, job_id: str) -> BatchJob | None:
         with self._lock:
@@ -352,9 +537,12 @@ class JobLedger:
 
     def shutdown(self) -> None:
         """Cancel every live job (gateway drain: stop the pumps before the
-        replicas stop, so nothing resubmits into a closing fleet)."""
+        replicas stop, so nothing resubmits into a closing fleet). The
+        cancellations are NON-durable: on disk the jobs stay ``running``,
+        so the next gateway life resumes them — a restart is not a
+        user's cancel."""
         for job in self.jobs():
-            job.cancel()
+            job.cancel(durable=False)
 
 
 def _default_window(target, kind: str) -> int:
@@ -377,7 +565,9 @@ def start_batch_job(target, items, kind: str = "generate",
                     seed: int | None = None, timeout_s: float = 0.0,
                     window: int = 0, max_item_retries: int = 64,
                     retry_base_s: float = 0.05, retry_max_s: float = 2.0,
-                    ledger: JobLedger | None = None) -> BatchJob:
+                    ledger: JobLedger | None = None,
+                    group_size: int = 0, job_id: str | None = None,
+                    completed: dict | None = None) -> BatchJob:
     """Build and start a :class:`BatchJob` over ``target`` — a
     :class:`~ddw_tpu.serve.engine.ServingEngine` or a
     :class:`~ddw_tpu.gateway.ReplicaSet` (anything with
@@ -390,7 +580,17 @@ def start_batch_job(target, items, kind: str = "generate",
     ``kind="predict"``: each item is an image (bytes/path/array).
     ``timeout_s=0`` (default) means NO per-item deadline — the batch SLO
     is throughput, and a deadline on backfill work converts yielding
-    into failure."""
+    into failure.
+
+    ``group_size`` controls per-replica submission batching: groups of
+    items cross to ONE replica per wire exchange through the target's
+    ``submit_batch_items`` (one HTTP POST for a whole group on a
+    :class:`~ddw_tpu.deploy.ProcessReplica` fleet). 0 = auto — grouped
+    (8) only when an engine in the fleet actually takes groups; in-thread
+    fleets keep per-item routing, where spreading beats batching.
+    ``job_id`` + ``completed`` are the resume path (see
+    :meth:`JobLedger.resume`): rows already landed are pre-seeded and
+    never re-run."""
     items = list(items)
     if kind == "generate":
         if num_steps is None:
@@ -420,10 +620,30 @@ def start_batch_job(target, items, kind: str = "generate",
     else:
         raise ValueError(f"unknown batch kind {kind!r} "
                          f"(expected 'generate' or 'predict')")
+    submit_many = None
+    if hasattr(target, "submit_batch_items"):
+        if not group_size:
+            engines = getattr(target, "replicas", None) or [target]
+            group_size = (8 if any(hasattr(e, "submit_batch_items")
+                                   for e in engines) else 1)
+
+        def submit_many(idxs):
+            return target.submit_batch_items(
+                [items[i] for i in idxs], idxs, kind=kind,
+                num_steps=num_steps, temperature=temperature, seed=seed,
+                timeout_s=timeout_s)
     job = BatchJob(kind, len(items), submit, row_of,
                    window=window or _default_window(target, kind),
                    max_item_retries=max_item_retries,
-                   retry_base_s=retry_base_s, retry_max_s=retry_max_s)
+                   retry_base_s=retry_base_s, retry_max_s=retry_max_s,
+                   job_id=job_id, submit_many_fn=submit_many,
+                   group_size=group_size, completed=completed)
     if ledger is not None:
-        ledger.add(job)
+        spec = {"kind": kind,
+                "items": [x.tolist() if hasattr(x, "tolist") else x
+                          for x in items],
+                "num_steps": num_steps, "temperature": temperature,
+                "seed": seed, "timeout_s": timeout_s, "window": window,
+                "group_size": group_size}
+        ledger.add(job, spec=spec)
     return job._start()

@@ -193,6 +193,8 @@ def test_decode_path_matches_full_forward():
                                np.asarray(full_logits), atol=2e-4)
 
 
+@pytest.mark.slow  # ~12s; learn pin stays in test_lm_learns_fixed_sequence,
+#                    generate identity in test_decode_path_matches_full_forward
 def test_generate_continues_memorized_pattern():
     """Train on the arange successor pattern, then greedy-generate continues it."""
     from ddw_tpu.models.lm import generate

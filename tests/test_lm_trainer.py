@@ -44,6 +44,9 @@ def test_fit_dpxsp_mesh():
     assert res.epochs_run == 3 and np.isfinite(res.val_loss)
 
 
+@pytest.mark.slow  # ~14s; ckpt-resume keeps tier-1 reps in
+#                    test_fit_pipeline_gpipe_and_resume,
+#                    test_fit_sharded_state_and_resume and test_resume.py
 def test_checkpoint_resume_continues(tmp_path):
     lm, tr = _cfgs(num_devices=4, checkpoint_dir=str(tmp_path / "ck"),
                    checkpoint_every_epochs=1)

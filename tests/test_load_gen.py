@@ -81,6 +81,26 @@ def test_load_gen_chaos_kill_one_replica_mid_run():
     assert d["circuits"][1] == "closed"
 
 
+def test_load_gen_deploy_arm_zero_downtime_rollout():
+    """The deploy-arm pin (tier-2; tests/test_deploy.py carries the
+    tier-1 representative): a rolling weight hot-swap across a 2-process
+    fleet under closed-loop load completes with goodput > 0 mid-rollout,
+    zero failed requests, and every replica on the new digest."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/load_gen.py"),
+         "--deploy"],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])["deploy"]
+    assert d["completed_during_rollout"] > 0 and d["failed"] == 0
+    assert d["rollout_s"] > 0
+    dv = d["deploy"]
+    assert dv["status"] == "done" and dv["fleet_generation"] == 1
+    assert dv["checkpoints"] == [d["digest_b"]] * 2
+    assert dv["steps"] == [[0, "recycled"], [1, "recycled"]]
+    assert d["digest_a"] != d["digest_b"]
+
+
 def test_load_gen_refuses_cpu_fallback():
     env = dict(_env(), DDW_REQUIRE_TPU="1")
     out = subprocess.run(

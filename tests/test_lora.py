@@ -177,6 +177,8 @@ def test_lm_step_applies_lora_mask_automatically():
     assert moved_trainable > 0
 
 
+@pytest.mark.slow  # ~11s; trainer-side masking keeps its tier-1 rep in
+#                    test_lm_step_applies_lora_mask_automatically
 def test_vit_lora_through_trainer_path():
     """ViT LoRA rides the standard vision stack: build_model + init_state
     apply the mask (plain TrainCfg optimizer), only adapters+head move."""

@@ -258,6 +258,8 @@ def test_top2_moe_lm_ep_matches_dense():
                                np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # ~8s; top2 keeps tier-1 reps in routing invariants +
+#                    EP-matches-dense, the MoE train pin in test_moe_lm_learns
 def test_top2_lm_trains_and_validates():
     model = TransformerLM(vocab_size=VOCAB, max_len=64, hidden=32, depth=2,
                           num_heads=2, mlp_dim=64, dropout=0.0,

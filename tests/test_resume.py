@@ -73,10 +73,13 @@ def test_resume_matches_uninterrupted(small_cfgs, silver, tmp_path):
         part2.state.params, straight.state.params)
 
 
+@pytest.mark.slow
 def test_resume_restores_plateau_counter(small_cfgs, silver, tmp_path):
     """The patience counter survives the restart: with patience=2 and a stuck
     metric, interrupting after epoch 1 must not reset the countdown (straight
-    and resumed runs cut the LR at the same epoch)."""
+    and resumed runs cut the LR at the same epoch). Tier-2: the resume
+    bit-identity pin rides in test_resume_matches_uninterrupted; this
+    drill only adds the scheduler-state angle at ~30s of wall clock."""
     kw = dict(plateau_patience=2, plateau_factor=0.5, warmup_epochs=0,
               learning_rate=0.0)  # LR=0: metrics exactly frozen => the plateau
                                   # counter ticks every epoch after the first

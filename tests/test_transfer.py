@@ -140,6 +140,8 @@ def _frozen_cfg(**kw):
     return ModelCfg(**base)
 
 
+@pytest.mark.slow  # ~14s; the feature-cache tier-1 rep is
+#                    test_feature_cache_roundtrip_reuse_and_stale_rejection
 def test_feature_cache_convnext_stats_free(tmp_path):
     """The cached-feature path for a BN-free family: ConvNeXt has no
     batch_stats, so the backbone surgery, fingerprint, and cache must work

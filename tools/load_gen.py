@@ -456,6 +456,107 @@ def batch_arm(prompt_len=16, steps=24, requests=32, clients=4, n_slots=4,
             gw.stop()
 
 
+def deploy_arm(prompt_len=8, steps=8, n_slots=2, clients=3, hidden=32,
+               depth=1, tail_requests=8):
+    """Rolling weight hot-swap under live closed-loop traffic — the
+    zero-downtime pin over the real process-isolated path.
+
+    Self-hosts a 2-PROCESS-replica fleet (one engine + HTTP door per OS
+    process) on package A, drives closed-loop clients against the parent
+    gateway, and mid-run POSTs ``/admin/deploy`` switching the fleet to
+    package B. The clients' ``Retry-After`` backoff is in the loop — a 429
+    while one replica drains is the expected path, absorbed by its
+    sibling. Asserts the deployment contract: goodput stays above zero
+    WHILE the rollout runs (requests completed between deploy-start and
+    deploy-done > 0), not one request fails, every replica finishes on
+    package B's digest, and the fleet generation advances."""
+    import tempfile
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.deploy import ProcessReplica
+    from ddw_tpu.gateway import Gateway, GatewayClient, GatewayError
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pkg_a = _make_lm_pkg(tmp, "pkg_a", hidden, depth, 2, 64, 64,
+                             dtype="float32", seed=0)
+        pkg_b = _make_lm_pkg(tmp, "pkg_b", hidden, depth, 2, 64, 64,
+                             dtype="float32", seed=1)
+        dir_a, dir_b = os.path.join(tmp, "pkg_a"), os.path.join(tmp, "pkg_b")
+        cfgd = {"n_slots": n_slots, "min_bucket": prompt_len,
+                "default_timeout_s": 600.0}
+        gw = Gateway([ProcessReplica(dir_a, replica_id=i, engine_cfg=cfgd,
+                                     warmup_lens=(prompt_len,))
+                      for i in range(2)],
+                     grace_s=60.0,
+                     supervisor_kw=dict(poll_interval_s=0.1,
+                                        backoff_base_s=0.1, jitter=0.0))
+        gw.start(warmup_prompt_lens=(prompt_len,))
+        rng = np.random.RandomState(0)
+        stop = threading.Event()
+        lock = threading.Lock()
+        done, failures = [0], []
+
+        def worker():
+            cli = _client(gw.url, retries=8)
+            while not stop.is_set():
+                p = rng.randint(0, 64, size=(prompt_len,)).astype(np.int32)
+                try:
+                    cli.generate(p, steps)
+                    with lock:
+                        done[0] += 1
+                except (GatewayError, OSError) as e:
+                    with lock:
+                        failures.append(repr(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(clients)]
+        try:
+            for t in threads:
+                t.start()
+            cli = GatewayClient("127.0.0.1", gw.port, max_retries=2)
+            while done[0] < clients:       # traffic demonstrably flowing
+                time.sleep(0.05)
+            before = done[0]
+            t0 = time.perf_counter()
+            cli.deploy(dir_b)
+            while cli.stats()["deploy"]["deploying"]:
+                time.sleep(0.25)
+            roll_s = time.perf_counter() - t0
+            during = done[0] - before
+            # a short tail proves the post-rollout fleet serves
+            tail_target = done[0] + tail_requests
+            deadline = time.time() + 60
+            while done[0] < tail_target and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+            dv = cli.stats()["deploy"]
+            gw.stop()
+        out = {"rollout_s": round(roll_s, 2),
+               "completed_during_rollout": during,
+               "completed_total": done[0], "failed": len(failures),
+               "failures": failures[:4], "deploy": {
+                   "status": dv["status"],
+                   "fleet_generation": dv["fleet_generation"],
+                   "checkpoints": dv["checkpoints"],
+                   "steps": [(s["replica"], s["action"]) for s in
+                             dv["steps"]]},
+               "digest_a": pkg_a.content_digest,
+               "digest_b": pkg_b.content_digest}
+        print(f"[load_gen] deploy: rollout {roll_s:.1f}s, "
+              f"{during} completed mid-rollout, {len(failures)} failed, "
+              f"fleet on {dv['checkpoints']}", file=sys.stderr, flush=True)
+        assert during > 0, out                     # goodput mid-rollout
+        assert not failures, out                   # zero failed requests
+        assert dv["status"] == "done", out
+        assert dv["fleet_generation"] == 1, out
+        assert all(c == pkg_b.content_digest
+                   for c in dv["checkpoints"]), out
+        return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default=None, help="target a live gateway")
@@ -476,6 +577,11 @@ def main():
     ap.add_argument("--batch", action="store_true",
                     help="self-hosted dual-lane arm: bulk /v1/batch job "
                          "under closed-loop interactive traffic")
+    ap.add_argument("--deploy", action="store_true",
+                    help="self-hosted rolling-deploy arm: weight hot-swap "
+                         "across a 2-process-replica fleet under live "
+                         "closed-loop load (asserts zero failures and "
+                         "goodput > 0 mid-rollout)")
     args = ap.parse_args()
 
     if args.url:
@@ -504,6 +610,9 @@ def main():
     if args.chaos or env_flag("DDW_BENCH_CHAOS"):
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "chaos": chaos()}
+    elif args.deploy:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "deploy": deploy_arm()}
     elif args.batch:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "batch": batch_arm()}

@@ -1,0 +1,98 @@
+"""Operator CLI for zero-downtime weight hot-swaps on a live gateway.
+
+``python tools/rolling_deploy.py --url http://HOST:PORT --model-dir DIR``
+POSTs ``/admin/deploy`` and tails the rollout from ``/stats``: one line
+per replica step as it lands (drain → restart on the new checkpoint →
+warmup → shadow-probe readmit), then a final JSON line with the full
+deploy record. Exit code 0 = every replica finished on the new
+checkpoint; 1 = the rollout aborted (or rolled back — see
+``--no-rollback``); 2 = could not reach the gateway / rollout already in
+flight.
+
+The gateway enforces one rollout at a time (409 on a second POST while
+one runs) and the controller never leaves ``deploying`` stuck on — a
+crashed step records an abort. Watch live from another terminal with
+``curl .../stats | jq .deploy``.
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import argparse
+import json
+import time
+
+TERMINAL = ("done", "aborted", "rolled_back")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", required=True, help="gateway, http://HOST:PORT")
+    ap.add_argument("--model-dir", required=True,
+                    help="LM package directory to roll out (must be "
+                         "readable by every replica process)")
+    ap.add_argument("--no-rollback", action="store_true",
+                    help="on a failed step, leave the failed replica "
+                         "as-is instead of re-staging its old checkpoint")
+    ap.add_argument("--poll-s", type=float, default=0.5)
+    ap.add_argument("--timeout-s", type=float, default=600.0)
+    args = ap.parse_args()
+
+    from ddw_tpu.gateway import GatewayClient, GatewayError
+
+    host, port = args.url.rsplit("://", 1)[-1].rsplit(":", 1)
+    cli = GatewayClient(host, int(port), max_retries=2)
+    try:
+        view = cli.deploy(args.model_dir, rollback=not args.no_rollback)
+    except GatewayError as e:
+        print(f"deploy refused ({e.status}): {e.body}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"gateway unreachable: {e}", file=sys.stderr)
+        return 2
+    print(f"[deploy] rolling {args.model_dir} across "
+          f"{len(view.get('checkpoints', []))} replica(s)",
+          file=sys.stderr, flush=True)
+
+    seen = 0
+    deadline = time.monotonic() + args.timeout_s
+    while True:
+        try:
+            view = cli.stats()["deploy"]
+        except (GatewayError, OSError) as e:
+            print(f"[deploy] stats poll failed: {e}", file=sys.stderr)
+            time.sleep(args.poll_s)
+            if time.monotonic() > deadline:
+                return 2
+            continue
+        for step in view.get("steps", [])[seen:]:
+            ok = "ok" if step.get("ok") else "FAILED"
+            print(f"[deploy] replica {step['replica']}: {step['action']} "
+                  f"({ok}, gen {step.get('generation')}, "
+                  f"{step.get('elapsed_s', 0):.1f}s"
+                  + (f", checkpoint {step['checkpoint']}"
+                     if step.get("checkpoint") else "")
+                  + (f", {step['detail']}" if step.get("detail") else "")
+                  + ")", file=sys.stderr, flush=True)
+        seen = len(view.get("steps", []))
+        if not view.get("deploying") and view.get("status") in TERMINAL:
+            break
+        if time.monotonic() > deadline:
+            print(f"[deploy] timed out after {args.timeout_s:.0f}s: {view}",
+                  file=sys.stderr)
+            return 2
+        time.sleep(args.poll_s)
+
+    print(json.dumps(view))
+    if view.get("status") == "done":
+        print(f"[deploy] done: fleet generation "
+              f"{view.get('fleet_generation')}, checkpoints "
+              f"{view.get('checkpoints')}", file=sys.stderr)
+        return 0
+    print(f"[deploy] {view.get('status')}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

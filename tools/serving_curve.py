@@ -79,7 +79,8 @@ def image_curve(batches, img):
     return rows
 
 
-def _make_lm_pkg(tmp, name, h, d, heads, vocab, max_len, dtype="bfloat16"):
+def _make_lm_pkg(tmp, name, h, d, heads, vocab, max_len, dtype="bfloat16",
+                 seed=0):
     from ddw_tpu.models.lm import TransformerLM
     from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
     from ddw_tpu.train.lm_step import init_lm_state
@@ -92,7 +93,9 @@ def _make_lm_pkg(tmp, name, h, d, heads, vocab, max_len, dtype="bfloat16"):
     model = TransformerLM(vocab_size=vocab, max_len=max_len, hidden=h,
                           depth=d, num_heads=heads, mlp_dim=4 * h,
                           dropout=0.0, dtype=dtype)
-    state = init_lm_state(model, optax.sgd(0.0), jax.random.PRNGKey(0))
+    # seed varies the WEIGHTS: two packages from different seeds have
+    # different content digests (the deploy drills hot-swap between them)
+    state = init_lm_state(model, optax.sgd(0.0), jax.random.PRNGKey(seed))
     out = os.path.join(tmp, name)
     save_lm_package(out, cfg, state.params)
     return load_lm_package(out)
