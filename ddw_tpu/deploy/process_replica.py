@@ -452,7 +452,9 @@ class ProcessReplica:
                                    h.get("queue_depth", 0))),
                 "busy": int(h.get("busy_slots", 0)),
                 "batch_depth": int(h.get("batch_depth", 0)),
-                "service_ms": self._service_ms}
+                "service_ms": self._service_ms,
+                "prefill_token_ms": float(
+                    h.get("prefill_token_ms", 0.0) or 0.0)}
 
     @property
     def state(self) -> str:
@@ -469,6 +471,24 @@ class ProcessReplica:
         if not res.get("tokens"):
             raise RuntimeError(f"replica {self.replica_id} probe returned "
                                f"no tokens: {res}")
+
+    # -- fleet prefix-index feed ----------------------------------------------
+    def prefix_events(self, since: int = 0) -> dict:
+        """The fleet prefix index's per-replica feed, relayed from the
+        child in one HTTP delta fetch (``GET /v1/prefix/events`` on the
+        child's own gateway). An unreachable, dead, or still-compiling
+        child answers a no-op delta — the index just stays stale for this
+        slot until the next poll. A respawned child's sequence restarts at
+        zero, which trips the feed's reset protocol and replaces whatever
+        the index believed about this slot."""
+        cli = self._client
+        if cli is None or not self._ready or self.failure is not None:
+            return {"seq": int(since), "reset": False, "events": []}
+        try:
+            return cli._json_call(
+                "GET", f"/v1/prefix/events?since={int(since)}&replica=0")
+        except Exception:
+            return {"seq": int(since), "reset": False, "events": []}
 
     # -- submission -----------------------------------------------------------
     def _admission_gate(self, kind: str) -> None:

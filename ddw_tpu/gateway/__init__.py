@@ -6,10 +6,14 @@ Layering (docs/serving.md "The HTTP gateway"):
   JSON API with chunked per-token streaming, keep-alive with a bounded
   connection guard, 429/503/504 mapping from the engine's structured
   refusals;
-- :mod:`~ddw_tpu.gateway.replica` — ``ReplicaSet``: admission-aware
-  routing across N engine replicas behind per-replica circuit breakers,
-  one sideways retry on a full queue, failover of a dead replica's queued
-  work, fleet-merged metrics;
+- :mod:`~ddw_tpu.gateway.replica` — ``ReplicaSet``: admission- and
+  cache-aware routing across N engine replicas behind per-replica circuit
+  breakers, one sideways retry on a full queue, failover of a dead
+  replica's queued work, fleet-merged metrics;
+- :mod:`~ddw_tpu.gateway.prefix_index` — ``PrefixIndex``: fleet-wide
+  content-hash map of which replica holds which prompt prefix warm, fed
+  by the pools' register/evict event logs; drives cache-aware routing and
+  the supervisor's warm replay after recycle/deploy;
 - :mod:`~ddw_tpu.gateway.supervisor` — ``ReplicaSupervisor``: bounded
   auto-restart of failed/stalled replicas with warmup-gated rejoin;
 - :mod:`~ddw_tpu.gateway.lifecycle` — ``ServerLifecycle``: readiness gated
@@ -34,6 +38,10 @@ from ddw_tpu.gateway.lifecycle import (  # noqa: F401
     STOPPED,
     ServerLifecycle,
     runtime_grace_s,
+)
+from ddw_tpu.gateway.prefix_index import (  # noqa: F401
+    PrefixIndex,
+    chain_hash_hexes,
 )
 from ddw_tpu.gateway.replica import (  # noqa: F401
     CIRCUIT_CLOSED,

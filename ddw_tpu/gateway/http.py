@@ -42,7 +42,12 @@ forms, never free-text parsing):
 ``GET /readyz``       load-balancer readiness — 200 only between warmup
                       completion and drain start, else 503.
 ``GET /metrics``      Prometheus text exposition, merged across replicas.
-``GET /stats``        the fleet SLO snapshot as JSON.
+``GET /stats``        the fleet SLO snapshot as JSON (includes the fleet
+                      ``prefix_index`` summary: keys, holders, hot list).
+``GET /v1/prefix/events``  one replica's prefix-cache register/evict delta
+                      feed (``?since=N&replica=R``) — the relay a parent
+                      gateway's fleet index polls to follow a process
+                      replica's child pool.
 ====================  ======================================================
 
 Status-code mapping (docs/serving.md has the full table): ``Overloaded`` →
@@ -229,9 +234,17 @@ class _Handler(BaseHTTPRequestHandler):
                        "replica_health": gw.replica_set.fleet_health(),
                        "lanes": gw.lane_stats(),
                        "deploy": gw.deploy_view()}
+                try:
+                    out["prefix_index"] = \
+                        gw.replica_set.prefix_index.summary()
+                except Exception:
+                    pass     # plain engine sets without an index still
+                #              answer /stats
                 if gw.supervisor is not None:
                     out["supervisor"] = gw.supervisor.report()
                 self._send_json(200, out)
+            elif self.path.startswith("/v1/prefix/events"):
+                self._prefix_events(gw)
             elif self.path.startswith("/v1/batch/"):
                 self._batch_get(gw)
             else:
@@ -533,6 +546,33 @@ class _Handler(BaseHTTPRequestHandler):
                 row = {"label": res.label, "class_index": int(res.index)}
             rows.append({"index": idx, "ok": True, "row": row})
         self._send_json(200, {"rows": rows})
+
+    def _prefix_events(self, gw: "Gateway") -> None:
+        """``GET /v1/prefix/events?since=N&replica=R`` — one replica's
+        prefix-cache register/evict delta feed (:meth:`~ddw_tpu.serve.
+        ServingEngine.prefix_events`). This is how a parent gateway's
+        fleet index follows a :class:`~ddw_tpu.deploy.ProcessReplica`
+        child: the child's own single-replica gateway serves this path,
+        the parent polls it with the last sequence number it applied."""
+        import urllib.parse
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        try:
+            since = int(q.get("since", ["0"])[0])
+            r = int(q.get("replica", ["0"])[0])
+        except ValueError:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": "since/replica must be ints"})
+            return
+        replicas = gw.replica_set.replicas
+        if not 0 <= r < len(replicas):
+            self._send_json(404, {"error": "not_found", "replica": r})
+            return
+        fetch = getattr(replicas[r], "prefix_events", None)
+        if fetch is None:
+            self._send_json(200, {"seq": since, "reset": False,
+                                  "events": []})
+            return
+        self._send_json(200, fetch(since))
 
     def _admin_deploy(self, gw: "Gateway") -> None:
         """Kick a rolling weight hot-swap across this gateway's fleet —

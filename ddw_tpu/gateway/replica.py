@@ -6,13 +6,21 @@ fleet answer is horizontal — more engine replicas, each with its own
 compiled programs and KV pool — and this class is the piece that makes N
 replicas look like one engine to the transport layer above it:
 
-- **routing is admission-aware**: every submission goes to the replica with
-  the lowest projected wait — queue depth + busy slots weighted by the
-  engine's own decaying per-request service estimate (``ServingEngine.
-  load()``), falling back to the outstanding-futures count for replicas
-  that don't expose load. Outstanding counts are kept here, incremented at
-  submit and decremented by a future done-callback, so routing needs no
-  cross-thread peeking into engine internals;
+- **routing is admission-aware and cache-aware**: every submission goes to
+  the replica with the lowest projected wait — queue depth + busy slots
+  weighted by the engine's own decaying per-request service estimate
+  (``ServingEngine.load()``), falling back to the outstanding-futures
+  count for replicas that don't expose load. Generate submissions also
+  credit expected prefill savings: the fleet prefix index
+  (:class:`~ddw_tpu.gateway.prefix_index.PrefixIndex`) reports each
+  replica's longest cached prefix of the prompt, and matched tokens x that
+  replica's per-prefilled-token EWMA are subtracted from its projected
+  wait — requests chase their warm prefix only while the holder's queue
+  stays cheaper than a cold prefill elsewhere. Routing never changes
+  results, only placement: every replica computes bit-identical tokens.
+  Outstanding counts are kept here, incremented at submit and decremented
+  by a future done-callback, so routing needs no cross-thread peeking
+  into engine internals;
 - **every replica sits behind a circuit breaker**
   (:class:`CircuitBreaker`): consecutive :class:`~ddw_tpu.serve.admission.
   ReplicaFailed` outcomes — or the engine's own death report — open the
@@ -53,6 +61,7 @@ import concurrent.futures
 import threading
 import time
 
+from ddw_tpu.gateway.prefix_index import PrefixIndex
 from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded,
                                      ReplicaFailed, Unavailable)
 from ddw_tpu.serve.metrics import merge_metrics, render_prometheus
@@ -190,7 +199,7 @@ class ReplicaSet:
     """Admission-aware, circuit-breaking router over engine replicas."""
 
     def __init__(self, replicas, failure_threshold: int = 3,
-                 cooldown_s: float = 5.0):
+                 cooldown_s: float = 5.0, route_by_prefix: bool = True):
         if hasattr(replicas, "submit_generate"):   # a bare engine
             replicas = [replicas]
         self.replicas = list(replicas)
@@ -208,6 +217,15 @@ class ReplicaSet:
         self.failed_over = 0        # requests adopted by a sibling
         self.retried_429 = 0        # refusals absorbed by a sibling retry
         self.failure_event = threading.Event()   # supervisor wake-up
+        self.prefix_index = PrefixIndex()   # fleet prefix map: fed from
+        #                                     the pools' event logs on the
+        #                                     routing path, read by the
+        #                                     supervisor's warm replay
+        self.route_by_prefix = route_by_prefix   # False = pure projected-
+        #                                          wait (least-outstanding)
+        #                                          routing, the A/B baseline
+        #                                          tools/serving_curve.py
+        #                                          measures against
         for i, eng in enumerate(self.replicas):
             self._wire(i, eng)
 
@@ -247,6 +265,7 @@ class ReplicaSet:
         accounting — they resolve through the same done-callback."""
         self._wire(i, eng)
         self.replicas[i] = eng
+        self.prefix_index.drop_replica(i)   # a fresh engine holds nothing
 
     def note_restart(self, i: int) -> None:
         with self._lock:
@@ -270,28 +289,39 @@ class ReplicaSet:
             out.append(h)
         return out
 
-    def _score(self, i: int, outstanding: int):
+    def _score(self, i: int, outstanding: int, saved_tokens: int = 0):
         """Projected-wait routing key: (estimated wait ms, pending work,
         index). Engines exposing ``load()`` are scored on queue depth +
         busy slots x their own EWMA service estimate — the ROADMAP's
         admission-aware routing; anything else falls back to the
-        outstanding-futures count (ties by index keep it deterministic)."""
+        outstanding-futures count (ties by index keep it deterministic).
+        ``saved_tokens`` is this replica's cached-prefix match for the
+        prompt being routed: matched tokens x its per-prefilled-token EWMA
+        are credited against the wait, so a warm replica wins exactly
+        until its queue costs more than the cold prefill elsewhere."""
         eng = self.replicas[i]
         if hasattr(eng, "load"):
             try:
                 ld = eng.load()
                 pending = float(ld["depth"] + ld["busy"])
-                return (pending * float(ld.get("service_ms") or 0.0),
-                        pending, i)
+                wait = pending * float(ld.get("service_ms") or 0.0)
+                if saved_tokens:
+                    wait -= (saved_tokens
+                             * float(ld.get("prefill_token_ms") or 0.0))
+                return (wait, pending, i)
             except Exception:
                 pass
-        return (0.0, float(outstanding), i)
+        return (0.0 if not saved_tokens else -float(saved_tokens),
+                float(outstanding), i)
 
-    def _order(self, exclude=()) -> list[int]:
-        """Healthy replica indices, best candidate first."""
+    def _order(self, exclude=(), matched=None) -> list[int]:
+        """Healthy replica indices, best candidate first. ``matched`` is
+        the prefix index's slot -> matched-prefix-tokens map for the
+        prompt being routed (None for non-generate submissions)."""
         with self._lock:
             outs = list(self._outstanding)
-        scored = [self._score(i, outs[i])
+        scored = [self._score(i, outs[i],
+                              matched.get(i, 0) if matched else 0)
                   for i in range(len(self.replicas))
                   if i not in exclude and self.breakers[i].available()]
         scored.sort()
@@ -330,8 +360,15 @@ class ReplicaSet:
             # must not leak
             self.breakers[i].abort_probe()
 
-    def _submit(self, method: str, args, kwargs):
-        order = self._order()
+    def _submit(self, method: str, args, kwargs, prompt=None):
+        matched = None
+        if prompt is not None and self.route_by_prefix:
+            try:        # index staleness/unavailability must never block
+                self.prefix_index.poll(self.replicas)
+                matched = self.prefix_index.match(prompt) or None
+            except Exception:
+                matched = None
+        order = self._order(matched=matched)
         if not order:
             raise Unavailable("all replica circuits open",
                               retry_after_ms=self._min_retry_ms())
@@ -360,12 +397,29 @@ class ReplicaSet:
             except BaseException:
                 self._dec(i)     # validation errors etc. must not leak
                 raise            # an outstanding count into the router
+            if matched:
+                self._count_routing(i, matched)
             self.breakers[i].begin_probe()
             with self._lock:
                 self._where[fut] = i
             fut.add_done_callback(self._on_done)
             return fut
         raise last
+
+    def _count_routing(self, i: int, matched: dict[int, int]) -> None:
+        """Feed the routing counters on the replica that took the request:
+        a cache hit when it held any prefix of the prompt, a wait override
+        when the longest holder's queue priced it out of its own prefix
+        and the request prefilled cold (or colder) elsewhere."""
+        best = max(matched.values())
+        try:
+            m = self.replicas[i].metrics
+            if matched.get(i, 0) > 0:
+                m.count("routed_cache_hit")
+            if matched.get(i, 0) < best:
+                m.count("routed_wait_override")
+        except Exception:
+            pass        # fakes without metrics still route
 
     # -- failover (the dead replica's on_failure hook) -----------------------
     def _on_replica_failure(self, i: int, failure: ReplicaFailed,
@@ -433,7 +487,8 @@ class ReplicaSet:
 
     # -- submission (engine surface) ----------------------------------------
     def submit_generate(self, prompt, num_steps: int, **kw):
-        return self._submit("submit_generate", (prompt, num_steps), kw)
+        return self._submit("submit_generate", (prompt, num_steps), kw,
+                            prompt=prompt)
 
     def submit_predict(self, item, **kw):
         return self._submit("submit_predict", (item,), kw)

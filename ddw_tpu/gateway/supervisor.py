@@ -30,7 +30,14 @@ keep serving on the rest):
   pays a cold path behind a circuit that claimed the replica was back. A
   thread wedged in real device work cannot be joined; that replica is
   **replaced** (``clone_fresh`` + ``ReplicaSet.replace``) and the
-  replacement pays its compile inside the warmup gate, not on traffic.
+  replacement pays its compile inside the warmup gate, not on traffic;
+- the rejoin is also **cache-warmed**: before the shadow probe, the fleet
+  prefix index's top-K hot prefixes (:meth:`~ddw_tpu.gateway.
+  prefix_index.PrefixIndex.hot`) are replayed through the restarted
+  replica's normal prefill path — one-step greedy generates, bit-identical
+  by construction, no KV shipping — so a recycled or hot-swapped replica
+  rejoins holding the fleet's hot set instead of re-prefilling it on live
+  traffic (``warm_replay_k`` sizes the replay; 0 disables).
 
 Per-attempt records (:class:`ReplicaAttempt`) mirror ``AttemptReport``:
 which replica, which generation, what killed it, how recovery went —
@@ -86,7 +93,8 @@ class ReplicaSupervisor:
                  warmup_prompt_lens=(8,), lifecycle=None,
                  shadow_probe: bool = True, probe_timeout_s: float = 30.0,
                  recycle_degraded_after_s: float | None = None,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0,
+                 warm_replay_k: int = 8):
         self.rs = replica_set
         self.max_restarts = max_restarts
         self.backoff_base_s = backoff_base_s
@@ -109,6 +117,9 @@ class ReplicaSupervisor:
         # fail it the hard way. None = only explicit recycle() calls.
         self.recycle_degraded_after_s = recycle_degraded_after_s
         self.drain_timeout_s = drain_timeout_s
+        # Warm replay: how many of the fleet's hottest prefixes a restarted
+        # replica replays (through its normal prefill) before readmission.
+        self.warm_replay_k = warm_replay_k
         self.probes = 0             # shadow probes issued (telemetry)
         self.attempts: list[ReplicaAttempt] = []
         self._next_attempt_at = [0.0] * len(replica_set.replicas)
@@ -248,7 +259,39 @@ class ReplicaSupervisor:
             forensics=forensics)
         with self._lock:
             self.attempts.append(att)
+        self._warm_replay(i, eng)
         att.readmit = self._readmit(i, eng)     # warmed: probe, then admit
+
+    # -- warm replay: rejoin holding the fleet's hot prefixes -----------------
+    def _warm_replay(self, i: int, eng) -> int:
+        """Replay the fleet prefix index's top-K hot prefixes through a
+        restarted replica's NORMAL prefill path (one-step greedy generates
+        — bit-identical by construction, no KV shipping) so it rejoins
+        holding the fleet's hot set instead of re-prefilling it on live
+        traffic. Runs behind the still-open circuit, before the shadow
+        probe. Best effort: a failed replay leaves the replica cold,
+        never dark."""
+        if not self.warm_replay_k:
+            return 0
+        idx = getattr(self.rs, "prefix_index", None)
+        if idx is None or not hasattr(eng, "submit_generate"):
+            return 0
+        n = 0
+        for toks in idx.hot(self.warm_replay_k):
+            try:
+                eng.submit_generate(
+                    toks, 1, temperature=0.0,
+                    timeout_s=self.probe_timeout_s).result(
+                        self.probe_timeout_s)
+                n += 1
+            except Exception:
+                break       # a cold rejoin beats blocking recovery
+        if n:
+            try:
+                eng.metrics.count("warm_replays", n)
+            except Exception:
+                pass        # fakes without metrics still recycle
+        return n
 
     # -- rejoin gate: shadow probe > live half-open probe ---------------------
     def _readmit(self, i: int, eng) -> str:
@@ -340,6 +383,7 @@ class ReplicaSupervisor:
             elapsed_s=time.monotonic() - t0, forensics={})
         with self._lock:
             self.attempts.append(att)
+        self._warm_replay(i, eng)
         att.readmit = self._readmit(i, eng)
         return True
 

@@ -18,7 +18,9 @@ cache tree serves them all):
   its hit offset and only computes (and writes) the uncovered tail;
 - **decode**: ONE donated ``lax.scan``-chained program advances every
   resident row ``steps_per_tick`` tokens per dispatch, gathering each
-  row's K/V through its block table;
+  row's K/V through its block table — the dispatch width shrinks to the
+  smallest pow2 row bucket covering live rows (``decode_buckets``), so a
+  partially occupied engine never pays full ``max_resident`` compute;
 - **copy**: clone one block — the copy-on-write primitive.
 
 Attention gathers a row's blocks back into the contiguous ``[cap]`` layout
@@ -52,6 +54,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +62,7 @@ import numpy as np
 from jax import lax
 
 from ddw_tpu.models.lm import TransformerLM, init_cache
+from ddw_tpu.serve.bucketing import batch_bucket
 from ddw_tpu.serve.slots import _pick
 
 
@@ -103,7 +107,8 @@ class BlockPool:
     def __init__(self, model: TransformerLM, params, n_blocks: int,
                  block_size: int, max_resident: int,
                  steps_per_tick: int = 4, donate: bool = True,
-                 overcommit: float = 1.0, interactive_reserve: int = 0):
+                 overcommit: float = 1.0, interactive_reserve: int = 0,
+                 decode_buckets: bool = True):
         if n_blocks < 1:
             raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
         if interactive_reserve < 0:
@@ -133,6 +138,10 @@ class BlockPool:
         #                             from BATCH-lane admission so an
         #                             interactive arrival never waits on a
         #                             batch release (ddw_tpu.serve.lanes)
+        self.decode_buckets = decode_buckets  # shrink each decode tick to
+        #                             the smallest pow2 row bucket covering
+        #                             live rows instead of dispatching all
+        #                             max_resident rows every tick
         self.params = params
         self._donate = donate
         cap = -(-model.max_len // tile) * tile
@@ -145,9 +154,12 @@ class BlockPool:
                                   seq_axis=None, dropout=0.0)
         self.cache = init_cache(self._model, 1)
         self._prefill_jit: dict[tuple, object] = {}   # by (group, suffix len)
-        self._decode_jit: dict[int, object] = {}      # by chain length k
+        self._decode_jit: dict[int, object] = {}      # by chain length k;
+        #                             the jitted chain itself retraces per
+        #                             row-bucket width (decode_buckets)
         don = (0,) if donate else ()
         self._copy = jax.jit(self._copy_fn, donate_argnums=don)
+        self._ev_lock = threading.Lock()   # event log is read off-thread
         self._reset_host()
 
     # -- host accounting ------------------------------------------------------
@@ -168,7 +180,19 @@ class BlockPool:
             collections.OrderedDict()             # idle registered, LRU
         self.stats = {"prefix_hit_tokens": 0, "prefix_hit_blocks": 0,
                       "prefix_miss_blocks": 0, "cow_copies": 0,
-                      "preemptions": 0, "batch_preemptions": 0}
+                      "preemptions": 0, "batch_preemptions": 0,
+                      "decode_rows_skipped": 0}
+        self.last_decode_bucket = 0   # rows the last decode tick dispatched
+        # fleet prefix-index feed (gateway/prefix_index.py): a bounded
+        # register/evict event log polled through the engine, plus the
+        # token prefix behind every registered full-block chain — token
+        # replay through normal prefill is how a restarted sibling
+        # re-warms, so the tokens themselves must survive here
+        with self._ev_lock:
+            self._prefix_tokens: dict[bytes, tuple] = {}
+            self._events: list[tuple] = []   # (seq, kind, key hex, tokens)
+            self._event_seq = 0
+            self._event_floor = 0            # seqs <= floor were compacted
 
     def reset(self) -> None:
         """Fresh device + host state after an engine failure (the
@@ -257,6 +281,8 @@ class BlockPool:
             "interactive_reserve_blocks": float(self.interactive_reserve),
             "reserve_free_blocks": float(
                 max(0, min(self.interactive_reserve, avail))),
+            "prefix_cache_keys": float(len(self._full_map)),
+            "decode_bucket": float(self.last_decode_bucket),
         }
 
     # -- allocator ------------------------------------------------------------
@@ -292,6 +318,48 @@ class BlockPool:
             m = self._full_map if kind == "full" else self._tail_map
             if m.get(key) == blk:
                 del m[key]
+                if kind == "full":
+                    with self._ev_lock:
+                        self._prefix_tokens.pop(key, None)
+                    self._emit("evict", key)
+
+    # -- fleet prefix-index feed ----------------------------------------------
+    _EVENT_CAP = 4096             # retained register/evict events
+
+    def _emit(self, kind: str, key: bytes, tokens: tuple | None = None
+              ) -> None:
+        with self._ev_lock:
+            self._event_seq += 1
+            self._events.append((self._event_seq, kind, key.hex(),
+                                 None if tokens is None else list(tokens)))
+            if len(self._events) > self._EVENT_CAP:
+                drop = len(self._events) - self._EVENT_CAP
+                self._event_floor = self._events[drop - 1][0]
+                del self._events[:drop]
+
+    def prefix_summary(self) -> dict:
+        """The cheap health-view summary: the event-log head seq (pollers
+        fetch deltas only when it moved) and the registered key count."""
+        with self._ev_lock:
+            return {"seq": self._event_seq, "keys": len(self._full_map)}
+
+    def prefix_events(self, since: int = 0) -> dict:
+        """Register/evict events with seq > ``since`` — the fleet prefix
+        index's delta feed (JSON-clean: hex keys, int token lists). A
+        ``since`` outside the retained window — the log was compacted, or
+        the pool reset under the poller — returns a full snapshot of the
+        currently registered prefixes with ``reset`` set, so the poller
+        simply replaces everything it believed about this replica."""
+        with self._ev_lock:
+            if since < self._event_floor or since > self._event_seq:
+                return {"seq": self._event_seq, "reset": True,
+                        "events": [["register", h.hex(), list(toks)]
+                                   for h, toks in
+                                   self._prefix_tokens.items()]}
+            return {"seq": self._event_seq, "reset": False,
+                    "events": [[kind, key, toks]
+                               for s, kind, key, toks in self._events
+                               if s > since]}
 
     # -- prefix cache ---------------------------------------------------------
     def _chain_hashes(self, prompt: np.ndarray) -> list[bytes]:
@@ -409,6 +477,10 @@ class BlockPool:
             if h not in self._full_map:
                 self._full_map[h] = blk
                 self._block_keys.setdefault(blk, []).append(("full", h))
+                toks = tuple(int(t) for t in prompt[:(j + 1) * bs])
+                with self._ev_lock:
+                    self._prefix_tokens[h] = toks
+                self._emit("register", h, toks)
         t = len(prompt) % bs
         if t:
             j = len(prompt) // bs
@@ -553,15 +625,44 @@ class BlockPool:
         return np.asarray(toks)
 
     def decode(self, tokens, temperatures, keys) -> np.ndarray:
-        """Advance EVERY resident row ``steps_per_tick`` tokens in one
+        """Advance every LIVE resident row ``steps_per_tick`` tokens in one
         donated chained dispatch (``tokens [R]`` current per-row token,
-        ``temperatures [R]``, ``keys [R, k, 2]``). Free rows decode a
-        dummy token against the null block. Block tables must already
-        cover the tick (:meth:`prepare_tick`). Returns ``[R, k]``."""
+        ``temperatures [R]``, ``keys [R, k, 2]``). With ``decode_buckets``
+        the dispatch shrinks to the smallest pow2 row bucket covering live
+        rows — rows allocate lowest-first, so live rows sit low — instead
+        of always paying for ``max_resident``. Each row's chain depends
+        only on its own table/start/key columns, so per-row results are
+        bit-identical at every bucket width; skipped rows would only have
+        decoded a dummy token against the null block (free rows INSIDE the
+        bucket still do). Block tables must already cover the tick
+        (:meth:`prepare_tick`). Returns ``[R, k]`` (rows beyond the bucket
+        read 0 — no stream lives there)."""
         k = self.steps_per_tick
-        rows = list(range(self.max_resident))
+        r = self.max_resident
+        nb = r
+        if self.decode_buckets:
+            top = 1 + (max(self._streams) if self._streams else 0)
+            nb = batch_bucket(top, r)
+        toks = self._decode_dispatch(
+            np.asarray(tokens)[:nb], np.asarray(temperatures)[:nb],
+            np.asarray(keys)[:nb], list(range(nb)))
+        self.last_decode_bucket = nb
+        if nb < r:
+            self.stats["decode_rows_skipped"] += r - nb
+            out = np.zeros((r, k), toks.dtype)
+            out[:nb] = toks
+            toks = out
+        for st in self._streams.values():
+            st.filled = min(st.filled + k, st.total)
+        return toks
+
+    def _decode_dispatch(self, tokens, temps, keys, rows) -> np.ndarray:
+        """One decode-chain dispatch over ``rows`` (``None`` = null-table
+        warmup row). The jitted chain is batch-width polymorphic — jit
+        retraces per row-bucket width, so the ladder compiles one
+        executable per (steps, bucket) pair."""
         tables, starts = self._tables_starts(rows)
-        fn = self._decode_jit.get(k)
+        fn = self._decode_jit.get(self.steps_per_tick)
         if fn is None:
             model = self._model
 
@@ -578,23 +679,35 @@ class BlockPool:
                 (cache, _, _), toks = lax.scan(
                     body, (cache, tok, starts),
                     jnp.swapaxes(keys_sk, 0, 1))
-                return cache, jnp.swapaxes(toks, 0, 1)   # [R, k]
+                return cache, jnp.swapaxes(toks, 0, 1)   # [rows, k]
 
-            fn = self._decode_jit[k] = jax.jit(
+            fn = self._decode_jit[self.steps_per_tick] = jax.jit(
                 chain, donate_argnums=(0,) if self._donate else ())
         self.cache, toks = fn(self.cache, jnp.asarray(tokens, jnp.int32),
                               jnp.asarray(starts), jnp.asarray(tables),
-                              jnp.asarray(temperatures, jnp.float32),
+                              jnp.asarray(temps, jnp.float32),
                               jnp.asarray(keys))
-        for st in self._streams.values():
-            st.filled = min(st.filled + k, st.total)
         return np.asarray(toks)
+
+    def resident_ladder(self) -> tuple[int, ...]:
+        """Decode-batch bucket ladder: pow2 row counts up to
+        ``max_resident`` (always included, so full width stays exact).
+        One entry when bucketing is off."""
+        if not self.decode_buckets:
+            return (self.max_resident,)
+        out, b = [], 1
+        while b < self.max_resident:
+            out.append(b)
+            b *= 2
+        out.append(self.max_resident)
+        return tuple(out)
 
     def warmup(self, buckets, max_group: int = 0) -> None:
         """Precompile the paged program lattice: one suffix prefill per
-        (bucket, power-of-two group), the decode chain, and the CoW copy.
-        Warmup rows use the null table, so every write lands in the null
-        block — pool state stays clean, no reset needed."""
+        (bucket, power-of-two group), the decode chain at every resident
+        bucket of the ladder, and the CoW copy. Warmup rows use the null
+        table, so every write lands in the null block — pool state stays
+        clean, no reset needed."""
         cap_g = max_group or min(8, self.max_resident)
         for bucket in sorted(set(buckets)):
             g = 1
@@ -606,10 +719,12 @@ class BlockPool:
                 if g >= cap_g:
                     break
                 g = min(g * 2, cap_g)
-        self.decode(np.zeros((self.max_resident,), np.int32),
-                    np.zeros((self.max_resident,), np.float32),
-                    np.zeros((self.max_resident, self.steps_per_tick, 2),
-                             np.uint32))
+        k = self.steps_per_tick
+        for nb in self.resident_ladder():
+            self._decode_dispatch(np.zeros((nb,), np.int32),
+                                  np.zeros((nb,), np.float32),
+                                  np.zeros((nb, k, 2), np.uint32),
+                                  [None] * nb)
         self.cache = self._copy(self.cache, jnp.int32(0), jnp.int32(0))
 
     # -- jitted bodies --------------------------------------------------------

@@ -134,6 +134,10 @@ class EngineCfg:
     max_resident: int = 0       # decode-batch rows; 0 = 2 * n_slots (rows
     #                             are host indices — compute knob, not
     #                             memory)
+    decode_buckets: bool = True  # shrink each decode tick to the smallest
+    #                             pow2 row bucket covering live rows (the
+    #                             pool compiles the ladder at warmup);
+    #                             False always dispatches max_resident
     block_overcommit: float = 1.0  # >1 oversubscribes the block budget and
     #                             relies on mid-decode preemption (tests)
     # dual-lane scheduler (ddw_tpu.serve.lanes): a throughput-SLO batch
@@ -279,6 +283,10 @@ class ServingEngine:
         self._per_token_ms = 0.0    # decaying per-generated-token estimate
         #                             (feeds the projected-block-release
         #                             retry_after_ms hint on the paged pool)
+        self._prefill_token_ms = 0.0  # decaying per-PREFILLED-token
+        #                             estimate (cache-aware routing weighs
+        #                             matched prefix tokens against wait
+        #                             with it — gateway/prefix_index)
 
         # failure containment (ReplicaFailed semantics in the module doc)
         self.replica_id = replica_id
@@ -356,7 +364,8 @@ class ServingEngine:
                     steps_per_tick=self.cfg.steps_per_tick,
                     donate=self.cfg.donate,
                     overcommit=self.cfg.block_overcommit,
-                    interactive_reserve=reserve)
+                    interactive_reserve=reserve,
+                    decode_buckets=self.cfg.decode_buckets)
             else:
                 self.pool = SlotPool(self._lm.model, self._lm.params,
                                      self.cfg.n_slots,
@@ -486,6 +495,12 @@ class ServingEngine:
                 if isinstance(self.pool, BlockPool) else 0.0),
             "draining": self._draining.is_set(),
             "checkpoint": self.checkpoint_id,
+            # relayed by ProcessReplica.load() so cache-aware routing can
+            # price a child's prefill without an extra round trip
+            "prefill_token_ms": self._prefill_token_ms,
+            "prefix_cache": (self.pool.prefix_summary()
+                             if isinstance(self.pool, BlockPool)
+                             else {"seq": 0, "keys": 0}),
         }
 
     def load(self) -> dict:
@@ -499,7 +514,18 @@ class ServingEngine:
                 "busy": len(self._slot_req) if self.pool is not None else 0,
                 "batch_depth": (self._ctrl.depth("lm_batch")
                                 + self._ctrl.depth("image_batch")),
-                "service_ms": self._service_ms}
+                "service_ms": self._service_ms,
+                "prefill_token_ms": self._prefill_token_ms}
+
+    def prefix_events(self, since: int = 0) -> dict:
+        """Fleet prefix-index feed: the paged pool's register/evict event
+        log past ``since`` (:meth:`BlockPool.prefix_events` — snapshot
+        with ``reset`` when ``since`` fell out of the retained window).
+        Engines without a paged pool report an empty, never-advancing
+        log."""
+        if isinstance(self.pool, BlockPool):
+            return self.pool.prefix_events(since)
+        return {"seq": 0, "reset": False, "events": []}
 
     def force_fail(self, kind: str = "stalled", reason: str = "") -> None:
         """Declare this replica dead from OUTSIDE the engine thread — the
@@ -1207,9 +1233,16 @@ class ServingEngine:
                 temps[i] = req.temperature
                 keys[i] = req.pick_key()
                 rows[i] = row
+            t_pf = time.monotonic()
             toks = pool.prefill(rows, prompts, true_lens, temps, keys)
             first = time.monotonic()
             self.metrics.count("prefills")
+            n_real = int(sum(int(t) for t in true_lens[:len(items)]))
+            if n_real:
+                per = (first - t_pf) * 1e3 / n_real
+                self._prefill_token_ms = (
+                    0.8 * self._prefill_token_ms + 0.2 * per
+                    if self._prefill_token_ms else per)
             for i, (req, eff, row, hit) in enumerate(items):
                 pool.register(row, eff)
                 pool.note_prefilled(row)

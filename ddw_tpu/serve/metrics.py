@@ -103,6 +103,14 @@ class EngineMetrics:
         self.prefix_hit_blocks = 0   # prompt blocks served from the cache
         self.prefix_miss_blocks = 0  # prompt blocks that had to prefill
         self.prefix_hit_tokens = 0   # prompt tokens whose prefill was skipped
+        self.decode_rows_skipped = 0  # resident rows a bucketed decode tick
+        #                            did NOT dispatch (pow2 live-row bucket)
+        # fleet prefix cache (ddw_tpu.gateway.prefix_index)
+        self.routed_cache_hit = 0    # requests routed to a prefix holder
+        self.routed_wait_override = 0  # holder skipped: projected wait made
+        #                            a cold prefill elsewhere cheaper
+        self.warm_replays = 0        # hot prefixes replayed into a recycled
+        #                            replica before readmission
         self._gauges: dict[str, float] = {}  # live block-pool state, pushed
         #                            by the engine loop (free/used blocks...)
         self._first_admit: float | None = None
@@ -199,6 +207,11 @@ class EngineMetrics:
                 "serve.prefix_hit_blocks": float(self.prefix_hit_blocks),
                 "serve.prefix_miss_blocks": float(self.prefix_miss_blocks),
                 "serve.prefix_hit_tokens": float(self.prefix_hit_tokens),
+                "serve.decode_rows_skipped": float(self.decode_rows_skipped),
+                "serve.routed_cache_hit": float(self.routed_cache_hit),
+                "serve.routed_wait_override": float(
+                    self.routed_wait_override),
+                "serve.warm_replays": float(self.warm_replays),
             }
             looked = self.prefix_hit_blocks + self.prefix_miss_blocks
             out["serve.prefix_hit_rate"] = (
@@ -301,6 +314,14 @@ _COUNTER_HELP = (
     ("prefix_hit_blocks", "Prompt KV blocks served from the prefix cache."),
     ("prefix_miss_blocks", "Prompt KV blocks that had to prefill."),
     ("prefix_hit_tokens", "Prompt tokens whose prefill compute was skipped."),
+    ("decode_rows_skipped", "Resident rows bucketed decode ticks did not "
+     "dispatch (pow2 live-row bucket)."),
+    ("routed_cache_hit", "Requests routed to the replica holding their "
+     "longest cached prefix."),
+    ("routed_wait_override", "Prefix-holder routes overridden because "
+     "projected wait made a cold prefill elsewhere cheaper."),
+    ("warm_replays", "Hot prefixes replayed into a recycled replica before "
+     "readmission."),
     ("tokens_out", "Generated LM tokens (both lanes)."),
     ("batch_items", "Batch-lane items completed."),
     ("batch_tokens_out", "Generated LM tokens on the batch lane."),
@@ -346,6 +367,10 @@ def merge_metrics(metrics_list) -> "EngineMetrics":
             out.prefix_hit_blocks += m.prefix_hit_blocks
             out.prefix_miss_blocks += m.prefix_miss_blocks
             out.prefix_hit_tokens += m.prefix_hit_tokens
+            out.decode_rows_skipped += m.decode_rows_skipped
+            out.routed_cache_hit += m.routed_cache_hit
+            out.routed_wait_override += m.routed_wait_override
+            out.warm_replays += m.warm_replays
             for name, val in m._gauges.items():
                 out._gauges[name] = out._gauges.get(name, 0.0) + val
             if m._first_admit is not None:
@@ -385,6 +410,10 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
             counters["prefix_hit_blocks"] += m.prefix_hit_blocks
             counters["prefix_miss_blocks"] += m.prefix_miss_blocks
             counters["prefix_hit_tokens"] += m.prefix_hit_tokens
+            counters["decode_rows_skipped"] += m.decode_rows_skipped
+            counters["routed_cache_hit"] += m.routed_cache_hit
+            counters["routed_wait_override"] += m.routed_wait_override
+            counters["warm_replays"] += m.warm_replays
             for name, val in m._gauges.items():
                 pool_gauges[name] = pool_gauges.get(name, 0.0) + val
             if m._first_admit is not None:

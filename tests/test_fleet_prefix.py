@@ -1,0 +1,462 @@
+"""Fleet-wide prefix cache (ddw_tpu.gateway.prefix_index + cache-aware
+routing + warm replay) and live-row bucketed decode.
+
+The acceptance pins, all deterministic on CPU:
+
+1. **index units over fakes** — register/evict/holder-loss/reset feeds
+   update holders correctly; token prefixes survive total holder loss
+   (that is what warm replay restores); the hot list dedups covered
+   prefixes and the key bound drops the coldest entries;
+2. **routing picks the holder until projected wait flips it** — over
+   scripted load() fakes: equal wait routes to the longest-prefix holder
+   (``routed_cache_hit``), piling wait onto the holder flips the route to
+   a cold sibling (``routed_wait_override``);
+3. **bit-identity is routing-independent** — routed answers AND a forced
+   cold generate on the non-holder reproduce the sequential path
+   bit-for-bit (routing changes WHERE a request runs, never WHAT it
+   computes);
+4. **live-row bucketed decode** — staggered admissions/evictions on one
+   engine dispatch pow2 row buckets (``decode_rows_skipped`` > 0, bucket
+   within the ladder) and stay token-identical to both the sequential
+   path and the same engine re-run with ``decode_buckets`` off (the
+   full-``max_resident`` path). Preemption identity under buckets rides
+   the existing overcommit drills in tests/test_paged_kv.py, which now
+   run with the bucketed default;
+5. **recycle warm replay** — after shared-prefix traffic, a drained+
+   restarted replica rejoins holding a non-empty prefix cache
+   (``warm_replays`` > 0) and serves the hot prompt with prefix hits from
+   its first request. The process-replica variant (child pools followed
+   over the ``/v1/prefix/events`` relay, recycle = full respawn) rides
+   tier-2.
+
+Tier-1 cost discipline: the pure index/routing tests never touch jax; the
+jax tests share ONE module-scoped package and ONE 2-replica thread fleet
+(the recycle drill restarts in place, keeping compiled programs).
+"""
+
+import concurrent.futures
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ddw_tpu.gateway import (
+    Gateway,
+    GatewayClient,
+    PrefixIndex,
+    ReplicaSet,
+    ReplicaSupervisor,
+    chain_hash_hexes,
+)
+from ddw_tpu.serve import EngineCfg, ServingEngine
+from ddw_tpu.serve.metrics import EngineMetrics
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+
+
+def _reg(key, toks):
+    return ["register", key, list(toks)]
+
+
+def _ev(key):
+    return ["evict", key]
+
+
+def _feed(seq, *events, reset=False):
+    return {"seq": seq, "reset": reset, "events": list(events)}
+
+
+# -- index units over scripted feeds (pure) ----------------------------------
+
+def test_chain_hash_hexes_prefix_property():
+    """The helper's hashes chain: a longer prompt's per-block keys extend
+    the shorter prefix's keys unchanged — the property the whole index
+    keys on."""
+    short, long = list(range(1, 9)), list(range(1, 17))
+    hs, hl = chain_hash_hexes(short, 4), chain_hash_hexes(long, 4)
+    assert len(hs) == 2 and len(hl) == 4
+    assert hl[:2] == hs
+    # int32 content-addressed: same tokens, same keys, different run
+    assert chain_hash_hexes(np.asarray(long, np.int32), 4) == hl
+    # a single diverging token changes every key from its block on
+    div = list(long)
+    div[5] = 63
+    hd = chain_hash_hexes(div, 4)
+    assert hd[0] == hl[0] and all(a != b for a, b in zip(hd[1:], hl[1:]))
+
+
+def test_index_register_evict_holder_loss_reset():
+    idx = PrefixIndex(hot_k=4)
+    toks = [1, 2, 3, 4]
+    key = chain_hash_hexes(toks, 4)[0]
+    idx.observe(0, _feed(1, _reg(key, toks)))
+    idx.observe(1, _feed(1, _reg(key, toks)))
+    assert idx.match([1, 2, 3, 4, 9], count_hit=False) == {0: 4, 1: 4}
+    # savings are capped at p-1: the pool always prefills one real token
+    assert idx.match(toks, count_hit=False) == {0: 3, 1: 3}
+    # one holder evicts: the other keeps serving the key
+    idx.observe(0, _feed(2, _ev(key)))
+    assert idx.match([1, 2, 3, 4, 9], count_hit=False) == {1: 4}
+    # TOTAL holder loss: no routing match, but the tokens survive — that
+    # is exactly what warm replay restores into a recycled replica
+    idx.observe(1, _feed(2, _ev(key)))
+    assert idx.match([1, 2, 3, 4, 9], count_hit=False) == {}
+    assert idx.hot() == [toks]
+    # a reset feed replaces everything believed about the slot
+    toks_b = [7, 8, 9, 10]
+    key_b = chain_hash_hexes(toks_b, 4)[0]
+    idx.observe(0, _feed(1, _reg(key_b, toks_b), reset=True))
+    assert idx.match([7, 8, 9, 10, 1], count_hit=False) == {0: 4}
+    assert idx.summary()["keys"] == 2
+    # drop_replica forgets holdings (replacement replica starts cold)
+    idx.drop_replica(0)
+    assert idx.match([7, 8, 9, 10, 1], count_hit=False) == {}
+
+
+def test_index_hot_list_dedup_hit_order_and_bound():
+    # a two-block chain collapses to its longest retained prefix
+    idx = PrefixIndex(hot_k=8)
+    long = list(range(1, 9))
+    hexes = chain_hash_hexes(long, 4)
+    idx.observe(0, _feed(2, _reg(hexes[0], long[:4]), _reg(hexes[1], long)))
+    assert idx.hot() == [long]
+    # match credits reorder the hot list: the chased prefix rises
+    idx2 = PrefixIndex(hot_k=8)
+    a, b = [1, 2, 3, 4], [5, 6, 7, 8]
+    idx2.observe(0, _feed(2, _reg(chain_hash_hexes(a, 4)[0], a),
+                          _reg(chain_hash_hexes(b, 4)[0], b)))
+    for _ in range(3):
+        idx2.match([5, 6, 7, 8, 1])
+    assert idx2.hot()[0] == b
+    # bounded: past MAX_KEYS the coldest (fewest hits, oldest) key drops
+    idx2.MAX_KEYS = 2
+    c = [9, 10, 11, 12]
+    idx2.observe(0, _feed(3, _reg(chain_hash_hexes(c, 4)[0], c)))
+    assert idx2.summary()["keys"] == 2
+    assert a not in idx2.hot()
+    assert idx2.match([1, 2, 3, 4, 9], count_hit=False) == {}
+
+
+def test_index_summary_shape():
+    idx = PrefixIndex(hot_k=2)
+    toks = list(range(1, 9))
+    hexes = chain_hash_hexes(toks, 4)
+    idx.observe(1, _feed(2, _reg(hexes[0], toks[:4]), _reg(hexes[1], toks)))
+    s = idx.summary()
+    assert s["keys"] == 2 and s["block_size"] == 4
+    assert s["holders"] == {"1": 2}
+    assert len(s["hot"]) == 2
+    assert {"key", "tokens", "hits", "holders"} <= set(s["hot"][0])
+
+
+# -- cache-aware routing over scripted load() fakes (pure) --------------------
+
+class _FakeLoadEngine:
+    """Replica with a scriptable load() — depth/service/prefill EWMAs are
+    set by the test, so the routing arithmetic is exact."""
+
+    def __init__(self, depth=0, service_ms=10.0, prefill_token_ms=1.0):
+        self.depth = depth
+        self.service_ms = service_ms
+        self.prefill_token_ms = prefill_token_ms
+        self.metrics = EngineMetrics()
+        self.futures = []
+
+    def start(self):
+        return self
+
+    def stop(self):
+        pass
+
+    def load(self):
+        return {"depth": self.depth, "busy": 0,
+                "service_ms": self.service_ms,
+                "prefill_token_ms": self.prefill_token_ms}
+
+    def submit_generate(self, prompt, num_steps, **kw):
+        f = concurrent.futures.Future()
+        self.futures.append(f)
+        return f
+
+
+def test_routing_picks_holder_until_wait_flips():
+    cold, warm = _FakeLoadEngine(), _FakeLoadEngine()
+    rs = ReplicaSet([cold, warm])
+    toks = list(range(1, 9))
+    hexes = chain_hash_hexes(toks, 4)
+    rs.prefix_index.observe(
+        1, _feed(2, _reg(hexes[0], toks[:4]), _reg(hexes[1], toks)))
+    prompt = toks + [42]
+    # equal projected wait: the 8-token holder wins on the prefill credit
+    fut = rs.submit_generate(prompt, 4)
+    assert fut in warm.futures
+    assert warm.metrics.routed_cache_hit == 1
+    assert warm.metrics.routed_wait_override == 0
+    fut.set_result(None)
+    # pile wait onto the holder: 3 deep x 10 ms = 30 ms against an
+    # 8-token x 1 ms/token credit — a cold prefill elsewhere is cheaper
+    warm.depth = 3
+    fut = rs.submit_generate(prompt, 4)
+    assert fut in cold.futures
+    assert cold.metrics.routed_cache_hit == 0
+    assert cold.metrics.routed_wait_override == 1
+    fut.set_result(None)
+    # an empty index routes purely on projected wait, and non-generate
+    # submissions never consult it
+    assert rs.outstanding() == [0, 0]
+
+
+# -- jax fixtures -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    cfg = LMCfg(vocab_size=VOCAB, max_len=96, hidden=32, depth=2,
+                num_heads=2, mlp_dim=64, dropout=0.0, dtype="float32")
+    from ddw_tpu.models.lm import build_lm
+
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int32))["params"]
+    out = str(tmp_path_factory.mktemp("fleet_prefix_pkg") / "pkg")
+    return load_lm_package(save_lm_package(out, cfg, params))
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+@pytest.fixture(scope="module")
+def fleet(pm):
+    """One 2-replica thread fleet shared by the routed-identity, ladder
+    and recycle drills (in-place restarts keep compiled programs)."""
+    engines = [ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2,
+                                                  steps_per_tick=2,
+                                                  default_timeout_s=600.0))
+               for _ in range(2)]
+    rs = ReplicaSet(engines, cooldown_s=30.0)
+    rs.prefix_index.poll_interval_s = 0.0   # poll on every submit: the
+    #                                         drills below are deterministic
+    rs.start()
+    yield rs, engines
+    rs.stop()
+
+
+# -- feed + routing + bit-identity over real engines --------------------------
+
+def test_fleet_feed_and_routed_bit_identity(fleet, pm):
+    """Traffic teaches the index who holds what (the keys are exactly the
+    pool's own chain hashes); the repeat request chases its prefix and the
+    answer stays bit-identical to the sequential path."""
+    rs, engines = fleet
+    (pa,) = _prompts([24], seed=3)
+    ref = np.asarray(pm.generate(pa[None, :], 8))[0]
+    assert np.array_equal(rs.generate(pa, 8, timeout_s=120.0).tokens, ref)
+    assert np.array_equal(rs.generate(pa, 8, timeout_s=120.0).tokens, ref)
+    m = rs.prefix_index.match(pa, count_hit=False)
+    assert m, "feed never reached the index"
+    holder = max(m, key=m.get)
+    # index keys ARE the holder pool's full-block hashes (bit-compat pin)
+    pool = engines[holder].pool
+    hexes = chain_hash_hexes(pa, pool.block_size)
+    assert set(hexes) <= {h.hex() for h in pool._full_map}
+    assert rs.snapshot()["serve.routed_cache_hit"] >= 1
+    # /stats-shaped summary reflects the holdings
+    s = rs.prefix_index.summary()
+    assert s["keys"] >= 1 and s["block_size"] == pool.block_size
+    assert str(holder) in s["holders"]
+
+
+def test_routed_vs_forced_cold_identity(fleet, pm):
+    """Routing changes WHERE, never WHAT: a forced cold generate on the
+    non-holder reproduces the routed (warm) answer bit-for-bit."""
+    rs, engines = fleet
+    (pb,) = _prompts([20], seed=7)
+    ref = np.asarray(pm.generate(pb[None, :], 6))[0]
+    warm = rs.generate(pb, 6, timeout_s=120.0).tokens
+    rs.prefix_index.poll(rs.replicas)   # pick up pb's registration now
+    m = rs.prefix_index.match(pb, count_hit=False)
+    assert m
+    holder = max(m, key=m.get)
+    cold = engines[1 - holder].generate(pb, 6, timeout_s=120.0).tokens
+    assert np.array_equal(warm, ref)
+    assert np.array_equal(cold, ref)
+
+
+# -- live-row bucketed decode -------------------------------------------------
+
+def test_bucketed_decode_ladder_token_identity(fleet, pm):
+    """Staggered admissions/evictions ride the pow2 bucket ladder and stay
+    token-identical to the sequential path AND to the same engine re-run
+    with buckets off (the always-max_resident path)."""
+    rs, engines = fleet
+    eng = engines[0]
+    pool = eng.pool
+    assert pool.decode_buckets
+    ladder = pool.resident_ladder()
+    assert ladder[-1] == pool.max_resident
+    assert all(b & (b - 1) == 0 for b in ladder[:-1])   # pow2 rungs
+    assert list(ladder) == sorted(set(ladder))
+    prompts = _prompts([8, 12, 16, 20, 24], seed=11)
+    refs = [np.asarray(pm.generate(p[None, :], 6))[0] for p in prompts]
+    futs = [eng.submit_generate(p, 6) for p in prompts]   # churn: rows
+    for f, r in zip(futs, refs):                          # come and go
+        assert np.array_equal(f.result(timeout=120).tokens, r)
+    assert pool.last_decode_bucket in ladder
+    # the control: same engine, buckets off -> always max_resident, same
+    # tokens (bucketed decode is a dispatch-shape change, not a math one)
+    pool.decode_buckets = False
+    try:
+        futs = [eng.submit_generate(p, 6) for p in prompts]
+        for f, r in zip(futs, refs):
+            assert np.array_equal(f.result(timeout=120).tokens, r)
+        assert pool.last_decode_bucket == pool.max_resident
+    finally:
+        pool.decode_buckets = True
+
+
+def test_bucket_ladder_shrinks_and_regrows_deterministically(pm):
+    """Pool-level bucket arithmetic across admissions/releases: the tick
+    dispatches exactly the smallest pow2 bucket covering the highest live
+    row, skips the rest, and regrows as freed rows are recycled."""
+    from ddw_tpu.serve.blocks import BlockPool
+
+    pool = BlockPool(pm.model, pm.params, n_blocks=16, block_size=16,
+                     max_resident=4, steps_per_tick=1)
+    assert tuple(pool.resident_ladder()) == (1, 2, 4)
+
+    def _admit(p):
+        r, _hit = pool.admit(p, 4)
+        pool.prefill([r], p[None, :], np.array([len(p)], np.int32),
+                     np.zeros((1,), np.float32),
+                     np.zeros((1, 2), np.uint32))
+        pool.register(r, p)
+        pool.note_prefilled(r)
+        return r
+
+    def _tick():
+        out = pool.decode(np.ones((4,), np.int32),
+                          np.zeros((4,), np.float32),
+                          np.zeros((4, 1, 2), np.uint32))
+        assert out.shape == (4, 1)      # engine view never changes shape
+
+    assert [_admit(p) for p in _prompts([17, 18, 19], seed=21)] == [0, 1, 2]
+    _tick()
+    assert pool.last_decode_bucket == 4         # 3 live rows -> pow2 4
+    assert pool.stats["decode_rows_skipped"] == 0
+    pool.release(1)
+    pool.release(2)
+    _tick()                                     # row 0 alone -> bucket 1
+    assert pool.last_decode_bucket == 1
+    assert pool.stats["decode_rows_skipped"] == 3
+    # re-admission recycles the last-freed row (2) and regrows the bucket
+    r = _admit(_prompts([20], seed=22)[0])
+    assert r == 2
+    _tick()
+    assert pool.last_decode_bucket == 4
+    assert pool.stats["decode_rows_skipped"] == 3   # dense again: no skip
+
+
+# -- recycle warm replay ------------------------------------------------------
+
+def test_recycle_warm_replay_rejoins_with_warm_cache(fleet, pm):
+    """The drill: shared-prefix traffic, then drain+restart replica 0 —
+    it must rejoin holding a non-empty prefix cache (warm_replays > 0)
+    and serve the hot prompt with prefix hits from its first request."""
+    rs, engines = fleet
+    e0 = engines[0]
+    sup = ReplicaSupervisor(rs, warmup_prompt_lens=(8,), warm_replay_k=4,
+                            backoff_base_s=0.05, jitter=0.0)
+    (pc,) = _prompts([24], seed=13)
+    ref = np.asarray(pm.generate(pc[None, :], 6))[0]
+    for _ in range(2):      # traffic teaches the index its hot set
+        assert np.array_equal(rs.generate(pc, 6, timeout_s=120.0).tokens,
+                              ref)
+    assert rs.prefix_index.hot(), "hot set empty before the drill"
+    assert sup.recycle(0, kind="drill")
+    att = sup.attempts[-1]
+    assert att.action == "drained_restarted"
+    assert att.readmit == "probed_closed"
+    # non-empty prefix cache at rejoin — the acceptance pin
+    assert e0.health()["prefix_cache"]["keys"] > 0
+    assert rs.snapshot()["serve.warm_replays"] > 0
+    # the replayed blocks are REAL: the hot prompt's first post-recycle
+    # request on this replica prefills with hits, bit-identically
+    hits0 = e0.snapshot()["serve.prefix_hit_tokens"]
+    assert np.array_equal(e0.generate(pc, 6, timeout_s=120.0).tokens, ref)
+    assert e0.snapshot()["serve.prefix_hit_tokens"] > hits0
+
+
+# -- process-replica variant (tier-2: two child boots + a respawn) ------------
+
+@pytest.mark.slow
+def test_process_fleet_prefix_relay_and_recycle_warm(tmp_path_factory):
+    """The same story across process boundaries: the parent's index
+    follows child pools over the /v1/prefix/events relay, /stats carries
+    the prefix_index summary, and a recycled (respawned) child rejoins
+    with a warm, non-empty prefix cache."""
+    import optax
+
+    from ddw_tpu.deploy import ProcessReplica
+    from ddw_tpu.models.lm import TransformerLM
+    from ddw_tpu.train.lm_step import init_lm_state
+
+    cfg = LMCfg(vocab_size=VOCAB, max_len=64, hidden=32, depth=1,
+                num_heads=2, mlp_dim=128, dropout=0.0, dtype="float32")
+    model = TransformerLM(vocab_size=VOCAB, max_len=64, hidden=32, depth=1,
+                          num_heads=2, mlp_dim=128, dropout=0.0,
+                          dtype="float32")
+    state = init_lm_state(model, optax.sgd(0.0), jax.random.PRNGKey(0))
+    out = str(tmp_path_factory.mktemp("fleet_prefix_proc") / "pkg")
+    save_lm_package(out, cfg, state.params)
+    pkg = load_lm_package(out)
+    prompt = list(range(1, 25))
+    ref = [int(t) for t in
+           np.asarray(pkg.generate(np.asarray(prompt)[None, :], 4))[0]]
+    reps = [ProcessReplica(out, replica_id=i,
+                           engine_cfg={"n_slots": 2, "kv_block_size": 8,
+                                       "default_timeout_s": 600.0},
+                           warmup_lens=(4,), spawn_timeout_s=150.0)
+            for i in range(2)]
+    gw = Gateway(reps, supervisor_kw={"poll_interval_s": 0.1,
+                                      "backoff_base_s": 0.1,
+                                      "backoff_max_s": 0.5, "jitter": 0.0,
+                                      "warm_replay_k": 4})
+    gw.start(warmup_prompt_lens=(4,))
+    rs = gw.replica_set
+    rs.prefix_index.poll_interval_s = 0.0
+    cli = GatewayClient("127.0.0.1", gw.port, timeout_s=90.0, max_retries=8)
+    try:
+        for _ in range(4):
+            assert cli.generate(prompt, 4)["tokens"] == ref
+        deadline = time.monotonic() + 30.0
+        while (not rs.prefix_index.match(prompt, count_hit=False)
+               and time.monotonic() < deadline):
+            cli.generate(prompt, 4)     # each submit polls the relay
+            time.sleep(0.1)
+        assert rs.prefix_index.match(prompt, count_hit=False), \
+            "relay never fed the parent index"
+        stats = cli.stats()
+        assert stats["prefix_index"]["keys"] >= 1
+        assert stats["prefix_index"]["holders"]
+        # recycle = SIGTERM + respawn; warm replay runs against the new
+        # child before the shadow probe readmits it
+        assert gw.supervisor.recycle(0, kind="drill")
+        # the parent's child-health cache (0.2s) may still hold a
+        # pre-replay snapshot right after recycle returns — let it lapse
+        deadline = time.monotonic() + 10.0
+        h0 = rs.fleet_health()[0]
+        while (h0.get("prefix_cache", {}).get("keys", 0) == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+            h0 = rs.fleet_health()[0]
+        assert h0["state"] == "alive" and h0["circuit"] == "closed"
+        assert h0["prefix_cache"]["keys"] > 0
+        assert rs.snapshot()["serve.warm_replays"] > 0
+        assert cli.generate(prompt, 4)["tokens"] == ref
+    finally:
+        gw.drain(grace_s=10.0)

@@ -294,6 +294,10 @@ def test_decode_overflow_poisons_output():
     assert np.isnan(np.asarray(logits)).all()
 
 
+@pytest.mark.slow  # ~12s; filter-edge pins (top_k=1==greedy etc.) move to
+# the slow tier; seeded/greedy sampling identity keeps tier-1 reps in
+# test_lanes.py::test_batch_matches_direct_greedy_and_seeded and
+# tests/test_paged_kv.py's sampled+greedy neighbor test
 def test_generate_top_k_top_p():
     """top_k=1 (or a vanishing nucleus) at ANY temperature must reproduce the
     greedy continuation; top_k/top_p compose with sampling and error-check."""

@@ -38,6 +38,9 @@ def test_fit_learns_dp():
     assert res.history[-1]["lr"] > 0
 
 
+@pytest.mark.slow  # ~9s; the dpxsp numeric pin stays tier-1 in
+# test_lm.py::test_dpxsp_train_step_matches_pure_dp; fit-path reps:
+# test_fit_learns_dp / test_fit_pipeline_gpipe_and_resume
 def test_fit_dpxsp_mesh():
     lm, tr = _cfgs(num_devices=8)
     res = LMTrainer(lm, tr, seq_devices=2).fit(_tokens(seq=16))

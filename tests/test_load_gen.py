@@ -101,6 +101,37 @@ def test_load_gen_deploy_arm_zero_downtime_rollout():
     assert d["digest_a"] != d["digest_b"]
 
 
+def test_load_gen_fleet_prefix_arm_warm_across_recycle():
+    """The fleet prefix-cache pin (tier-2; tests/test_fleet_prefix.py
+    carries the tier-1 representatives): over the real HTTP path a
+    2-replica fleet on a shared-prefix workload shows cross-replica cache
+    hits in /stats (the index fed through the routing path, with
+    routed_cache_hit counting the router using it), and a recycle fired
+    while phase-B clients are live rejoins replica 0 warm via the
+    supervisor's top-K prefix replay — hit tokens keep growing and a
+    pinned greedy probe answers bit-identically across the restart."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/load_gen.py"),
+         "--fleet-prefix"],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])["fleet_prefix"]
+    for row in (d["phase_a"], d["phase_b"]):
+        assert row["completed"] == 24
+        assert sum(row["errors"].values()) == 0
+    assert d["hit_tokens_a"] > 0
+    assert d["routed_cache_hit"] > 0
+    assert d["prefix_index"]["keys"] >= 1
+    # the shared head is the hot key, and after the drill BOTH replicas
+    # hold it (replica 0 re-learned it from the warm replay)
+    assert d["recycled"] and d["recycle"]["action"] == "drained_restarted"
+    assert d["recycle"]["readmit"] == "probed_closed"
+    assert d["warm_replays"] > 0
+    assert d["replica_cache_keys"][0] > 0
+    assert d["hit_tokens_b"] > d["hit_tokens_a"]
+    assert d["identity_preserved"] is True
+
+
 def test_load_gen_refuses_cpu_fallback():
     env = dict(_env(), DDW_REQUIRE_TPU="1")
     out = subprocess.run(

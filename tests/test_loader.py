@@ -147,6 +147,8 @@ def test_materialized_table_size_mismatch_raises(silver, store):
         ShardedLoader(gold, batch_size=8, image_size=(64, 64))
 
 
+@pytest.mark.slow  # ~8s; tier-1 reps: materialized_table_matches_silver
+# (pixel identity) + raw_u8_device_dequant (device path) cover the cache
 def test_materialized_training_is_drop_in(silver, store):
     """Trainer.fit on the materialized table tracks silver-table training
     epoch-for-epoch (the cache is a drop-in: same stream order, pixels within

@@ -173,6 +173,8 @@ def test_moe_step_rejects_foreign_expert_axis():
                            seq_axis=None)
 
 
+@pytest.mark.slow  # ~9s; tier-1 reps: test_moe_lm_learns (moe training)
+# + test_lm.py::test_decode_path_matches_full_forward (decode identity)
 def test_moe_decode_path_matches_full_forward():
     """KV-cached decode of an MoE LM (dense experts, per-call routing) ==
     full-sequence forward at no-drop capacity — prefill and per-token both."""

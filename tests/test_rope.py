@@ -152,6 +152,8 @@ def test_rope_sp_ring_matches_single_device():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # ~8s composition drill like the sp-ring one above;
+# tier-1 reps: rope units here + test_pipeline's gpipe equivalence arm
 def test_rope_pp_step_matches_single_device():
     """The pipeline step threads RoPE positions through its stages: one
     4-stage PP step == one plain step, loss and params (the rope analog of

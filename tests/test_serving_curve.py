@@ -42,6 +42,18 @@ def test_serving_curve_smoke():
         assert r["total_ms_p99"] >= r["ttft_ms_p50"] > 0
     by_c = {r["concurrency"]: r for r in eng["sweep"]}
     assert by_c[8]["tokens_per_sec"] > eng["sequential_tokens_per_sec"]
+    # routing A/B arm: cache-aware vs least-outstanding on the same
+    # shared-prefix workload — the fleet prefix-cache acceptance pin
+    # (the arm's own SMOKE asserts enforce the strict inequality; the
+    # contract here is the reported rows stay coherent)
+    ab = d["routing_ab"]
+    ca, lo = ab["cache_aware"], ab["least_outstanding"]
+    for row in (ca, lo):
+        assert row["completed"] == ab["families"] * ab["rounds"]
+        assert (row["prefill_tokens_computed"] + row["prefix_hit_tokens"]
+                == ab["offered_prefill_tokens"])
+    assert ca["prefill_tokens_computed"] < lo["prefill_tokens_computed"]
+    assert ca["routed_cache_hit"] > 0 and lo["routed_cache_hit"] == 0
 
 
 def test_serving_curve_refuses_cpu_fallback():

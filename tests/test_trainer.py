@@ -118,6 +118,8 @@ def test_on_epoch_hook(small_cfgs, silver, tmp_path):
             train_tbl, val_tbl)
 
 
+@pytest.mark.slow  # ~17s; artifact-presence check (no numeric pin) —
+# the profiler-trace drill moves wholesale to the slow tier
 def test_profiler_trace_writes_files(small_cfgs, silver, tmp_path):
     """TrainCfg.trace_dir (Horovod-Timeline role): the first epoch runs under
     jax.profiler and a trace lands on disk, openable in TensorBoard/Perfetto."""

@@ -34,6 +34,13 @@ side with a no-batch baseline. The smoke asserts interactive goodput holds
 (generous 0.5x floor against 1-core timing noise) while batch items
 complete during the run — backfill fills idle capacity, never steals it.
 
+Fleet-prefix arm (``--fleet-prefix``): the fleet-wide prefix-cache pin —
+a supervised 2-replica fleet under the shared-prefix workload, asserting
+cross-replica cache hits are visible in ``/stats`` (the prefix index fed
+over the routing path, ``serve.routed_cache_hit`` > 0) and that a
+mid-run recycle rejoins warm via the supervisor's top-K prefix replay
+(``serve.warm_replays`` > 0, bit-identical probe answers).
+
 Chaos arm (``--chaos``, or ``DDW_BENCH_CHAOS=1`` with the smoke): the
 robustness pin rather than the capacity pin — closed-loop clients drive a
 supervised 2-replica fleet while ``DDW_FAULT=serve:crash`` kills replica 0
@@ -321,6 +328,121 @@ def smoke(prompt_len=16, steps=24, steps_burst=48, requests=32, n_slots=4,
     return out
 
 
+def fleet_prefix_arm(steps=16, requests=24, n_slots=4, steps_per_tick=8,
+                     hidden=64, depth=2, clients=4, shared_len=16,
+                     uniq_len=8):
+    """Fleet-wide prefix cache over the real HTTP path — the PR-11 pin.
+
+    A supervised 2-replica fleet serves the ``--prompt-prefix`` workload
+    (every prompt opens with the same ``shared_len`` tokens). Phase A
+    proves the fleet index works over the wire: the pools' register
+    events reach ``PrefixIndex`` through the routing path, requests chase
+    their prefix ACROSS replicas (``serve.routed_cache_hit``), and the
+    fleet-merged hit tokens are visible in ``/stats``. Then replica 0 is
+    recycled WHILE phase B's closed-loop clients are firing — the drill
+    asserts the supervisor's warm replay (top-K hot prefixes through the
+    normal prefill path) rejoined it with a non-empty prefix cache, the
+    fleet hit count kept growing, and a pinned greedy probe request
+    returns bit-identical tokens before and after the recycle."""
+    import tempfile
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.gateway import Gateway, GatewayClient, ReplicaSet
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "fleetpfx", hidden, depth, 2, 128, 96,
+                          dtype="float32")
+        engines = [ServingEngine(lm=pm, cfg=EngineCfg(
+            n_slots=n_slots, steps_per_tick=steps_per_tick,
+            kv_block_size=8, queue_depth=4 * max(clients, requests),
+            default_timeout_s=600.0)) for _ in range(2)]
+        gw = Gateway(ReplicaSet(engines), grace_s=60.0,
+                     supervisor_kw=dict(poll_interval_s=0.1,
+                                        backoff_base_s=0.1, jitter=0.0,
+                                        warm_replay_k=4))
+        gw.replica_set.prefix_index.poll_interval_s = 0.05
+        gw.start(warmup_prompt_lens=(shared_len + uniq_len, uniq_len, 1))
+        rng = np.random.RandomState(11)
+        shared = rng.randint(0, 128, size=(shared_len,)).astype(np.int32)
+
+        def mk_prompts(n):
+            return [np.concatenate([shared, rng.randint(
+                0, 128, size=(uniq_len,)).astype(np.int32)])
+                for _ in range(n)]
+
+        probe = mk_prompts(1)[0]
+        try:
+            cli = GatewayClient("127.0.0.1", gw.port, max_retries=2)
+            ref = cli.generate(probe, steps)["tokens"]   # seeds the prefix
+            row_a = closed_loop(gw.url, mk_prompts(requests), steps,
+                                clients)
+            stats_a = cli.stats()
+            # phase B fires WHILE the recycle drill runs — retries absorb
+            # the drained replica's refusals, its sibling serves through
+            box = {}
+
+            def phase_b():
+                box["row"] = closed_loop(gw.url, mk_prompts(requests),
+                                         steps, clients, retries=6)
+
+            th = threading.Thread(target=phase_b)
+            th.start()
+            time.sleep(0.05)                      # demonstrably mid-run:
+            #                                       phase B walls ~0.3s, so
+            #                                       the drain/replay/probe
+            #                                       runs under live load
+            recycled = gw.supervisor.recycle(0, kind="drill")
+            att = gw.supervisor.attempts[-1]
+            th.join()
+            row_b = box["row"]
+            after = cli.generate(probe, steps)["tokens"]
+            stats_b = cli.stats()
+        finally:
+            gw.stop()
+        out = {
+            "phase_a": row_a, "phase_b": row_b,
+            "recycled": bool(recycled),
+            "recycle": {"action": att.action, "readmit": att.readmit},
+            "hit_tokens_a": int(stats_a.get("serve.prefix_hit_tokens", 0)),
+            "hit_tokens_b": int(stats_b.get("serve.prefix_hit_tokens", 0)),
+            "routed_cache_hit": int(stats_b.get("serve.routed_cache_hit",
+                                                0)),
+            "warm_replays": int(stats_b.get("serve.warm_replays", 0)),
+            "prefix_index": stats_b.get("prefix_index", {}),
+            "replica_cache_keys": [
+                int(h.get("prefix_cache", {}).get("keys", 0))
+                for h in stats_b.get("replica_health", [])],
+            "identity_preserved": list(ref) == list(after),
+        }
+        print(f"[load_gen] fleet prefix: hits {out['hit_tokens_a']} -> "
+              f"{out['hit_tokens_b']} tok, routed hits "
+              f"{out['routed_cache_hit']}, warm replays "
+              f"{out['warm_replays']}, recycle {out['recycle']}",
+              file=sys.stderr, flush=True)
+        if SMOKE:
+            for row in (row_a, row_b):
+                assert row["completed"] == requests, out
+                assert sum(row["errors"].values()) == 0, out
+            # the fleet index worked over the wire: cross-replica hit
+            # tokens visible in /stats, and routing actually used them
+            assert out["hit_tokens_a"] > 0, out
+            assert out["routed_cache_hit"] > 0, out
+            assert out["prefix_index"].get("keys", 0) >= 1, out
+            # the mid-run recycle kept the fleet warm: clean drill, warm
+            # replay visible, replica 0 back with a non-empty cache, and
+            # the hit count still growing through phase B
+            assert out["recycled"], out
+            assert out["recycle"]["action"] == "drained_restarted", out
+            assert out["recycle"]["readmit"] == "probed_closed", out
+            assert out["warm_replays"] > 0, out
+            assert out["replica_cache_keys"][0] > 0, out
+            assert out["hit_tokens_b"] > out["hit_tokens_a"], out
+            assert out["identity_preserved"], out
+        return out
+
+
 def chaos(prompt_len=12, steps=16, requests=32, n_slots=2, steps_per_tick=4,
           hidden=64, depth=2, clients=4, kill_after_ticks=6):
     """Kill-one-replica-mid-run drill over the real HTTP path.
@@ -582,6 +704,11 @@ def main():
                          "across a 2-process-replica fleet under live "
                          "closed-loop load (asserts zero failures and "
                          "goodput > 0 mid-rollout)")
+    ap.add_argument("--fleet-prefix", action="store_true",
+                    help="self-hosted fleet prefix-cache arm: 2-replica "
+                         "shared-prefix workload with a mid-run recycle "
+                         "(asserts cross-replica hits in /stats and a "
+                         "warm-replayed rejoin)")
     args = ap.parse_args()
 
     if args.url:
@@ -613,6 +740,9 @@ def main():
     elif args.deploy:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "deploy": deploy_arm()}
+    elif args.fleet_prefix:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "fleet_prefix": fleet_prefix_arm()}
     elif args.batch:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "batch": batch_arm()}

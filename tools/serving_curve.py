@@ -15,7 +15,11 @@ paged residency > n_slots at >= 2x slots' peak with no throughput loss) —
 plus the BATCH-LANE arm: interactive-only vs batch-only vs mixed rows on
 one paged engine at equal KV memory (the smoke pins mixed interactive
 TTFT p99 within a generous bound of interactive-only while batch items
-complete during the run — the dual-lane headline).
+complete during the run — the dual-lane headline) — plus the ROUTING-A/B
+arm: cache-aware routing vs the least-outstanding baseline on the same
+shared-prefix workload over a 2-replica fleet (the smoke pins strictly
+fewer prefill tokens computed with TTFT p99 no worse — the fleet
+prefix-cache headline).
 
 Usage (chip): ``DDW_REQUIRE_TPU=1 python tools/serving_curve.py``
 CI smoke:     ``DDW_BENCH_SMOKE=1`` shrinks shapes/batches/steps.
@@ -413,6 +417,106 @@ def batch_lane_curve(hidden, depth, heads, vocab, max_len, prompt_len,
     return out
 
 
+def routing_ab(hidden, depth, heads, vocab, max_len, n_slots,
+               steps_per_tick, dtype="float32", families=6, shared_len=64,
+               tail_len=8, rounds=3, steps=4):
+    """The fleet-routing A/B arm: cache-aware routing vs the
+    least-outstanding baseline on the SAME shared-prefix workload over a
+    2-replica fleet, from identical starting states.
+
+    Setup per arm: a fresh 2-engine :class:`ReplicaSet` (cache-aware =
+    default; baseline = ``route_by_prefix=False``), then ``families``
+    distinct prefix heads are seeded DIRECTLY onto replica 1 — the
+    worst-case placement for an index-blind router, whose projected-wait
+    tie-break lands every idle-fleet request on slot 0. The measured
+    window replays ``rounds`` requests per family (fresh random tails)
+    through the router one at a time, so queues stay drained and the A/B
+    isolates the routing decision itself: by design the prefix credit
+    only ever breaks WAIT ties (a replica's service estimate always
+    exceeds its own prefill-savings credit, so affinity never beats a
+    genuinely shorter queue — docs/serving.md). The baseline prefills
+    each family cold on replica 0 once before its local cache kicks in;
+    the cache-aware router sends every request to the holder.
+
+    Prefill tokens computed = prompt tokens - fleet prefix-cache hit
+    tokens (offered tokens are identical across arms by construction).
+    DDW_BENCH_SMOKE pins the acceptance number: cache-aware computes
+    STRICTLY fewer prefill tokens than least-outstanding, with TTFT p99
+    no worse (a small bound absorbs 1-core scheduler noise — the
+    structural gap, six cold 72-token prefills in the baseline's tail, is
+    far larger)."""
+    from ddw_tpu.gateway import ReplicaSet
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+    from ddw_tpu.serve.metrics import merge_metrics
+
+    rng = np.random.RandomState(3)
+    heads_tok = [rng.randint(0, vocab, size=(shared_len,)).astype(np.int32)
+                 for _ in range(families)]
+    # rounds x families prompts, families interleaved — identical token
+    # streams for both arms, fresh tails so only the PREFIX can hit
+    prompts = [np.concatenate([heads_tok[f], rng.randint(
+        0, vocab, size=(tail_len,)).astype(np.int32)])
+        for _ in range(rounds) for f in range(families)]
+    offered_tokens = sum(len(p) for p in prompts)
+    out = {"families": families, "shared_len": shared_len,
+           "rounds": rounds, "offered_prefill_tokens": offered_tokens}
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "routing", hidden, depth, heads, vocab,
+                          max_len, dtype=dtype)
+        for name, by_prefix in (("least_outstanding", False),
+                                ("cache_aware", True)):
+            engines = [ServingEngine(lm=pm, cfg=EngineCfg(
+                n_slots=n_slots, steps_per_tick=steps_per_tick,
+                queue_depth=4 * n_slots, default_timeout_s=600.0))
+                for _ in range(2)]
+            rs = ReplicaSet(engines, route_by_prefix=by_prefix)
+            rs.prefix_index.poll_interval_s = 0.0   # fresh on every route
+            with rs:
+                rs.warmup([shared_len + tail_len, tail_len, 1])
+                for h in heads_tok:   # seed replica 1, router unseen
+                    engines[1].generate(
+                        np.concatenate([h, h[:tail_len]]), steps)
+                for eng in engines:   # measured window starts clean
+                    eng.metrics = type(eng.metrics)()
+                t0 = time.perf_counter()
+                for p in prompts:
+                    rs.generate(p, steps)
+                wall = time.perf_counter() - t0
+                snap = merge_metrics(
+                    [e.metrics for e in engines]).snapshot()
+            hit = int(snap.get("serve.prefix_hit_tokens", 0))
+            row = {
+                "prefill_tokens_computed": offered_tokens - hit,
+                "prefix_hit_tokens": hit,
+                "routed_cache_hit": int(
+                    snap.get("serve.routed_cache_hit", 0)),
+                "routed_wait_override": int(
+                    snap.get("serve.routed_wait_override", 0)),
+                "ttft_ms_p99": round(snap["serve.ttft_ms_p99"], 2),
+                "tokens_per_sec": round(
+                    len(prompts) * steps / wall, 1),
+                "completed": int(snap["serve.completed"]),
+            }
+            out[name] = row
+            print(f"[curve] routing {name}: "
+                  f"{row['prefill_tokens_computed']} prefill tok computed "
+                  f"({row['prefix_hit_tokens']} hit), ttft p99 "
+                  f"{row['ttft_ms_p99']:.1f} ms", file=sys.stderr,
+                  flush=True)
+    if SMOKE:
+        ca, lo = out["cache_aware"], out["least_outstanding"]
+        assert ca["completed"] == lo["completed"] == len(prompts), out
+        # THE acceptance pin: strictly fewer prefill tokens computed...
+        assert (ca["prefill_tokens_computed"]
+                < lo["prefill_tokens_computed"]), out
+        # ...with TTFT p99 no worse (generous-noise bound; the real gap
+        # is the baseline's cold-prefill tail, several times larger)
+        assert (ca["ttft_ms_p99"]
+                <= 1.1 * lo["ttft_ms_p99"] + 5.0), out
+        assert ca["routed_cache_hit"] > 0, out
+    return out
+
+
 def main():
     from ddw_tpu.utils.config import require_tpu_or_exit
 
@@ -440,6 +544,10 @@ def main():
                        prompt_len=16, steps=24, n_slots=4,
                        steps_per_tick=8, dtype="float32", requests=24,
                        clients=4, batch_items=48)
+        ab_kw = dict(hidden=384, depth=3, heads=4, vocab=256, max_len=128,
+                     n_slots=4, steps_per_tick=4, dtype="float32",
+                     families=6, shared_len=64, tail_len=8, rounds=3,
+                     steps=4)
     else:
         batches, img = [1, 2, 4, 8, 16, 32, 64, 128, 256], (224, 224, 3)
         lm_kw = dict(hidden=512, depth=6, heads=8, vocab=8192, max_len=2048,
@@ -455,6 +563,10 @@ def main():
                        max_len=2048, prompt_len=64, steps=128, n_slots=16,
                        steps_per_tick=8, requests=64, clients=8,
                        batch_items=256)
+        ab_kw = dict(hidden=512, depth=6, heads=8, vocab=8192,
+                     max_len=2048, n_slots=16, steps_per_tick=8,
+                     families=8, shared_len=512, tail_len=32, rounds=4,
+                     steps=16)
 
     result = {
         "device": {"kind": kind, "n": jax.device_count()},
@@ -463,6 +575,7 @@ def main():
         "engine": engine_load_sweep(**eng_kw),
         "paged_capacity": paged_capacity(**cap_kw),
         "batch_lanes": batch_lane_curve(**lane_kw),
+        "routing_ab": routing_ab(**ab_kw),
     }
     print(json.dumps(result))
 
