@@ -46,6 +46,9 @@ def _write_atomic(path: str, text: str) -> None:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ddw-serve-worker")
     p.add_argument("--model-dir", required=True)
+    p.add_argument("--draft-dir", default=None,
+                   help="draft LM package for speculative decode "
+                        "(pair with spec_k>0 in --engine-cfg)")
     p.add_argument("--port-file", required=True)
     p.add_argument("--replica-id", type=int, default=0)
     p.add_argument("--host", default="127.0.0.1")
@@ -63,9 +66,12 @@ def main(argv=None) -> int:
     from ddw_tpu.serving.lm_package import load_lm_package
 
     pkg = load_lm_package(args.model_dir)
+    draft = load_lm_package(args.draft_dir) if args.draft_dir else None
     cfg = EngineCfg(**json.loads(args.engine_cfg or "{}"))
-    eng = ServingEngine(lm=pkg, cfg=cfg, replica_id=args.replica_id)
+    eng = ServingEngine(lm=pkg, cfg=cfg, replica_id=args.replica_id,
+                        draft=draft)
     eng.model_dir = args.model_dir
+    eng.draft_dir = args.draft_dir
     gw = Gateway(eng, host=args.host, port=args.port,
                  grace_s=args.grace_s, supervise=False)
     gw.install_sigterm()                    # SIGTERM → drain-to-completion

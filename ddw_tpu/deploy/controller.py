@@ -35,6 +35,8 @@ import time
 
 __all__ = ["DeployController", "DeployStep"]
 
+_UNSET = object()       # "this deploy does not touch the draft package"
+
 
 @dataclasses.dataclass
 class DeployStep:
@@ -62,10 +64,13 @@ class DeployController:
     def __init__(self, replica_set, supervisor, model_dir: str,
                  rollback: bool = True, status: dict | None = None,
                  status_lock: threading.Lock | None = None,
-                 settle_timeout_s: float = 60.0):
+                 settle_timeout_s: float = 60.0, draft_dir=_UNSET):
         self.rs = replica_set
         self.supervisor = supervisor
         self.model_dir = model_dir
+        self.draft_dir = draft_dir   # speculative-decode draft staged
+        #                              alongside the target; _UNSET = the
+        #                              deploy leaves the draft alone
         self.rollback = rollback
         self.settle_timeout_s = settle_timeout_s
         self.status = status if status is not None else {
@@ -122,13 +127,18 @@ class DeployController:
                 eng = self.rs.replicas[i]
                 t0 = time.monotonic()
                 old_dir = getattr(eng, "model_dir", None)
+                old_draft = getattr(eng, "draft_dir", None)
                 try:
-                    eng.set_checkpoint(self.model_dir)
+                    if self.draft_dir is _UNSET:
+                        eng.set_checkpoint(self.model_dir)
+                    else:
+                        eng.set_checkpoint(self.model_dir,
+                                           draft_dir=self.draft_dir)
                 except AttributeError:
                     self._record(DeployStep(
                         replica=i, action="verify_failed", ok=False,
                         detail="replica has no set_checkpoint hook"))
-                    self._abort(i, old_dir)
+                    self._abort(i, old_dir, old_draft)
                     return self.status
                 try:
                     ok = self.supervisor.recycle(i, kind="deploy")
@@ -144,7 +154,7 @@ class DeployController:
                         generation=getattr(eng, "generation", 0),
                         detail="recycle did not complete in budget",
                         elapsed_s=time.monotonic() - t0))
-                    self._abort(i, old_dir)
+                    self._abort(i, old_dir, old_draft)
                     return self.status
                 eng = self.rs.replicas[i]
                 settled, got = self._settled(i, want_digest)
@@ -153,7 +163,7 @@ class DeployController:
                         replica=i, action="verify_failed", ok=False,
                         generation=getattr(eng, "generation", 0),
                         detail=got, elapsed_s=time.monotonic() - t0))
-                    self._abort(i, old_dir)
+                    self._abort(i, old_dir, old_draft)
                     return self.status
                 if want_digest is None:
                     want_digest = got   # the first roll names the target
@@ -172,7 +182,8 @@ class DeployController:
                       status="aborted", error=repr(e))
             return self.status
 
-    def _abort(self, failed_i: int, old_dir: str | None) -> None:
+    def _abort(self, failed_i: int, old_dir: str | None,
+               old_draft: str | None = None) -> None:
         """Stop the roll at the failed replica. With rollback on, re-stage
         its previous checkpoint and recycle it back; already-rolled
         replicas keep the new weights (see module docstring)."""
@@ -184,7 +195,10 @@ class DeployController:
         t0 = time.monotonic()
         ok = False
         try:
-            eng.set_checkpoint(old_dir)
+            if self.draft_dir is _UNSET:
+                eng.set_checkpoint(old_dir)
+            else:
+                eng.set_checkpoint(old_dir, draft_dir=old_draft)
             ok = self.supervisor.recycle(failed_i, kind="rollback")
             if ok:
                 ok, _ = self._settled(failed_i, None)

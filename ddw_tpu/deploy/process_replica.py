@@ -62,6 +62,8 @@ __all__ = ["ProcessReplica"]
 
 _HEALTH_CACHE_S = 0.2       # /stats polls under this age are coalesced
 
+_UNSET = object()           # "keep the current draft" for set_checkpoint
+
 
 def _key_words(rng) -> list[int]:
     """A JAX PRNG key as raw uint32 words for the wire (``key_data``)."""
@@ -107,8 +109,9 @@ class ProcessReplica:
                  workdir: str | None = None, grace_s: float = 10.0,
                  spawn_timeout_s: float = 180.0,
                  request_timeout_s: float = 120.0, max_workers: int = 16,
-                 warmup_lens=(8,)):
+                 warmup_lens=(8,), draft_dir: str | None = None):
         self.model_dir = model_dir
+        self.draft_dir = draft_dir
         self.replica_id = replica_id
         self.generation = 0
         self.engine_cfg = dict(engine_cfg or {})
@@ -124,6 +127,7 @@ class ProcessReplica:
         #                                      (its /stats), merged empty
         self.last_exit_code: int | None = None
         self._pending_checkpoint: str | None = None
+        self._pending_draft: object = _UNSET
         self._workdir = workdir or tempfile.mkdtemp(
             prefix=f"ddw-replica{replica_id}-")
         self._proc: subprocess.Popen | None = None
@@ -174,6 +178,8 @@ class ProcessReplica:
                "--host", self.host,
                "--grace-s", str(self.grace_s),
                "--warmup", json.dumps(list(self.warmup_lens))]
+        if self.draft_dir:
+            cmd += ["--draft-dir", self.draft_dir]
         if self.engine_cfg:
             cmd += ["--engine-cfg", json.dumps(self.engine_cfg)]
         self._ready = False
@@ -335,7 +341,8 @@ class ProcessReplica:
                              grace_s=self.grace_s,
                              spawn_timeout_s=self.spawn_timeout_s,
                              request_timeout_s=self.request_timeout_s,
-                             warmup_lens=self.warmup_lens)
+                             warmup_lens=self.warmup_lens,
+                             draft_dir=self.draft_dir)
         eng.generation = self.generation + 1
         eng.on_failure = self.on_failure
         return eng
@@ -371,15 +378,22 @@ class ProcessReplica:
         h = self.health()
         return h.get("checkpoint")
 
-    def set_checkpoint(self, model_dir: str | None) -> None:
+    def set_checkpoint(self, model_dir: str | None,
+                       draft_dir=_UNSET) -> None:
         """Stage a weight swap: the NEXT restart/recycle spawns the child
-        on this package (same contract as the in-thread engine)."""
+        on this package (same contract as the in-thread engine).
+        ``draft_dir`` stages the speculative-decode draft alongside it —
+        omitted keeps the current draft, ``None`` drops it."""
         self._pending_checkpoint = model_dir
+        self._pending_draft = _UNSET if model_dir is None else draft_dir
 
     def _apply_pending_checkpoint(self) -> None:
         model_dir, self._pending_checkpoint = self._pending_checkpoint, None
+        draft_dir, self._pending_draft = self._pending_draft, _UNSET
         if model_dir is not None:
             self.model_dir = model_dir
+            if draft_dir is not _UNSET:
+                self.draft_dir = draft_dir
 
     # -- health / load -------------------------------------------------------
     def _poll_child(self) -> dict | None:

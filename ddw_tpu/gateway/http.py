@@ -586,10 +586,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": "invalid_request",
                                   "message": "model_dir (str) is required"})
             return
+        # "draft_dir" absent = leave the spec-decode draft alone;
+        # present (a path, or null to drop it) = stage it with the target
+        kw = {}
+        if "draft_dir" in body:
+            draft_dir = body["draft_dir"]
+            if draft_dir is not None and not isinstance(draft_dir, str):
+                self._send_json(400, {"error": "invalid_request",
+                                      "message": "draft_dir must be a "
+                                                 "string or null"})
+                return
+            kw["draft_dir"] = draft_dir
         try:
             started = gw.start_deploy(model_dir,
                                       rollback=bool(body.get("rollback",
-                                                             True)))
+                                                             True)), **kw)
         except Exception as e:
             self._send_json(500, {"error": "internal", "message": repr(e)})
             return

@@ -52,7 +52,10 @@ class CausalSelfAttention(nn.Module):
                              # the cache tree is batch-independent — one pool
                              # serves prefill groups and the decode batch
                              # alike (ddw_tpu.serve.blocks). Any S works
-                             # (S>1 = chunked/suffix prefill into blocks).
+                             # (S>1 = chunked/suffix prefill into blocks;
+                             # speculative verify rides this same path — one
+                             # S=k+1 call scores a row's draft block with
+                             # intra-block causality, BlockPool.spec_verify).
     kv_cache_blocks: int = 0  # paged mode: usable blocks + 1 null block
     kv_block_size: int = 0   # paged mode: tokens per block; must divide the
                              # attention tile so the gathered view is laid

@@ -20,6 +20,13 @@ and are rewound over rejected positions by resetting the ``cache_index`` /
 ``pos_index`` scalars (stale K/V rows beyond the index are never attended —
 the decode mask bounds keys by query position — and are overwritten by the
 next write at that position).
+
+This module is the OFFLINE kernel (one sequence, dense cache). The live
+batched serving graft — per-tick draft/verify over paged KV with block-level
+rollback — lives in :meth:`ddw_tpu.serve.ServingEngine._spec_tick` +
+:class:`ddw_tpu.serve.BlockPool` (``spec_draft`` / ``spec_verify`` /
+``commit_spec``); both share the :func:`match_length` acceptance rule, which
+is what makes spec-on output bit-identical to spec-off.
 """
 
 from __future__ import annotations
@@ -34,6 +41,21 @@ from jax import lax
 from ddw_tpu.models.lm import TransformerLM, init_cache
 
 _REWIND_KEYS = ("cache_index", "pos_index")
+
+
+def match_length(drafts, picks) -> int:
+    """Exact-match acceptance: the number of leading draft proposals that
+    equal the verifier's own picks at the same positions. Position ``j``'s
+    pick is conditioned on drafts ``0..j-1`` all having been accepted, so
+    the emitted block ``drafts[:m] + [picks[m]]`` is — by induction —
+    exactly what step-by-step decode with the same picker (argmax, or
+    seeded sampling keyed per step) would have produced. Shared by the
+    offline kernel below and the serving engine's ``_spec_tick``."""
+    m = 0
+    k = min(len(drafts), len(picks))
+    while m < k and int(picks[m]) == int(drafts[m]):
+        m += 1
+    return m
 
 
 def _rewind(cache, n: int):
@@ -152,9 +174,7 @@ def generate_speculative(model: TransformerLM, params,
         block = jnp.asarray([[H[-1]] + drafts], jnp.int32)
         cache_t, tlogits = run_t(params, cache_t, block)
         preds = np.asarray(jnp.argmax(tlogits[0], axis=-1))  # [k+1]
-        m = 0
-        while m < k and preds[m] == drafts[m]:
-            m += 1
+        m = match_length(drafts, preds)
         t_new = int(preds[m])
 
         # -- bookkeeping + rewinds ----------------------------------------

@@ -23,6 +23,19 @@ cache tree serves them all):
   partially occupied engine never pays full ``max_resident`` compute;
 - **copy**: clone one block — the copy-on-write primitive.
 
+Two more programs back the engine's speculative tick (``spec_k > 0``; see
+docs/serving.md "Speculative decoding"): **spec_draft** runs the draft
+model's pool one lagged S=2 step plus ``k - 1`` single-token steps to
+propose ``k`` tokens per row, and **spec_verify** scores all ``k + 1``
+positions (current token + k drafts) on the target pool in ONE multi-token
+pass — the same suffix-prefill machinery as prefill. Neither advances the
+stream write pointer: the engine compares drafts against the verify picks
+and calls :meth:`commit_spec`, which advances ``filled`` by only the
+ACCEPTED positions and frees any block allocated solely for rejected ones
+(the rollback contract — rejected K/V is garbage beyond the write pointer,
+overwritten write-before-read, and never reachable by the prefix cache,
+which only ever registers prompt blocks).
+
 Attention gathers a row's blocks back into the contiguous ``[cap]`` layout
 and runs the exact tile loop of the contiguous path, so paged outputs are
 **bit-identical** to sequential :func:`ddw_tpu.models.lm.generate` (pinned
@@ -154,6 +167,7 @@ class BlockPool:
                                   seq_axis=None, dropout=0.0)
         self.cache = init_cache(self._model, 1)
         self._prefill_jit: dict[tuple, object] = {}   # by (group, suffix len)
+        self._spec_jit: dict[tuple, object] = {}      # by ("draft"|"verify", k)
         self._decode_jit: dict[int, object] = {}      # by chain length k;
         #                             the jitted chain itself retraces per
         #                             row-bucket width (decode_buckets)
@@ -498,6 +512,13 @@ class BlockPool:
         st = self._streams[row]
         st.filled = st.prompt_len
 
+    def set_filled(self, row: int, n: int) -> None:
+        """Pin a row's valid-K/V depth explicitly. The draft pool's P == 1
+        edge: nothing prefills (the lone prompt token is written by the
+        first lagged draft step itself), so the engine rewinds the pointer
+        that :meth:`admit`'s ``prompt_len`` bookkeeping would imply."""
+        self._streams[row].filled = n
+
     def release(self, row: int, preempted: bool = False) -> None:
         """Return a finished (or preempted) stream's row and blocks.
         Unregistered blocks free IMMEDIATELY; registered ones park in the
@@ -566,6 +587,46 @@ class BlockPool:
         self.release(victim.row, preempted=True)
         return victim.row
 
+    # -- speculative tick (draft/verify + rollback) ---------------------------
+    def extend_row(self, row: int, k: int) -> None:
+        """Allocate blocks covering one row's next ``min(k, remaining)``
+        writes (raises :class:`OutOfBlocks`; nothing to unwind — blocks
+        already granted stay on the stream and are reclaimed at release or
+        by :meth:`commit_spec`). The engine's speculative tick drives this
+        directly instead of :meth:`prepare_tick` because a victim must be
+        released from the TARGET and DRAFT pools together."""
+        self._extend(self._streams[row], k)
+
+    def stream_order(self, row: int) -> tuple[bool, int]:
+        """Preemption sort key for a resident row — ``(is_batch, seq)``:
+        max() over live rows reproduces :meth:`prepare_tick`'s victim
+        policy (batch before interactive, youngest first) at the engine
+        level, where the two spec pools pick ONE joint victim."""
+        st = self._streams[row]
+        return (st.lane == "batch", st.seq)
+
+    def commit_spec(self, row: int, advance: int) -> None:
+        """Advance a row's write pointer by the ACCEPTED positions of a
+        speculative tick and roll back the rest: ``spec_draft`` /
+        ``spec_verify`` wrote up to ``k + 1`` positions past ``filled``
+        without advancing it, so moving ``filled`` forward ``advance``
+        rewinds the pointer inside the partially-filled tail block
+        (rejected K/V beyond it is garbage, overwritten write-before-read
+        next tick) and any block allocated ONLY for rejected positions is
+        freed here — ``_committed`` re-grows by each freed block, exactly
+        reversing ``_extend``'s decrement, so the admission budget stays
+        worst-case-correct. Prompt blocks (the only ones the prefix cache
+        ever registers) are never freed: ``need`` floors at
+        ``blocks_for(prompt_len)``, so no stale registration can outlive
+        its content."""
+        st = self._streams[row]
+        st.filled = min(st.filled + advance, st.total)
+        need = max(self.blocks_for(st.filled),
+                   self.blocks_for(st.prompt_len))
+        while len(st.blocks) > need:
+            self._decref(st.blocks.pop())
+            self._committed += 1
+
     # -- device programs ------------------------------------------------------
     def table(self, row: int) -> np.ndarray:
         out = np.zeros((self.n_tbl,), np.int32)
@@ -624,6 +685,15 @@ class BlockPool:
                               jnp.asarray(keys))
         return np.asarray(toks)
 
+    def _live_bucket(self) -> int:
+        """Smallest pow2 row bucket covering live rows (rows allocate
+        lowest-first, so live rows sit low); ``max_resident`` when
+        bucketing is off."""
+        if not self.decode_buckets:
+            return self.max_resident
+        top = 1 + (max(self._streams) if self._streams else 0)
+        return batch_bucket(top, self.max_resident)
+
     def decode(self, tokens, temperatures, keys) -> np.ndarray:
         """Advance every LIVE resident row ``steps_per_tick`` tokens in one
         donated chained dispatch (``tokens [R]`` current per-row token,
@@ -639,10 +709,7 @@ class BlockPool:
         read 0 — no stream lives there)."""
         k = self.steps_per_tick
         r = self.max_resident
-        nb = r
-        if self.decode_buckets:
-            top = 1 + (max(self._streams) if self._streams else 0)
-            nb = batch_bucket(top, r)
+        nb = self._live_bucket()
         toks = self._decode_dispatch(
             np.asarray(tokens)[:nb], np.asarray(temperatures)[:nb],
             np.asarray(keys)[:nb], list(range(nb)))
@@ -688,6 +755,138 @@ class BlockPool:
                               jnp.asarray(temps, jnp.float32),
                               jnp.asarray(keys))
         return np.asarray(toks)
+
+    def spec_draft(self, prev_tokens, cur_tokens, temps, keys) -> np.ndarray:
+        """Draft-model proposal round (called on the DRAFT pool): the pool
+        invariant is that a live draft row has processed the picked history
+        H up to ``H[:-2]`` (it lags the target one position), so the round
+        first feeds the lag pair ``[H[-2], H[-1]]`` as one S=2 step — its
+        second logit position proposes draft 1 — then chains ``k - 1``
+        single-token steps for drafts 2..k (``keys [R, k, 2]`` — the
+        ORIGINAL per-step sample keys, so a self-draft reproduces the
+        target's own picks and acceptance ≈ 1). Writes ``k + 1`` positions
+        past ``filled`` WITHOUT advancing it; the engine advances via
+        :meth:`commit_spec` after verification. Returns ``[R, k]``."""
+        r = self.max_resident
+        k = np.asarray(keys).shape[1]
+        nb = self._live_bucket()
+        drafts = self._spec_draft_dispatch(
+            np.asarray(prev_tokens)[:nb], np.asarray(cur_tokens)[:nb],
+            np.asarray(temps)[:nb], np.asarray(keys)[:nb], list(range(nb)))
+        if nb < r:
+            out = np.zeros((r, k), drafts.dtype)
+            out[:nb] = drafts
+            drafts = out
+        return drafts
+
+    def _spec_draft_dispatch(self, prev, cur, temps, keys, rows
+                             ) -> np.ndarray:
+        tables, starts = self._tables_starts(rows)
+        k = keys.shape[1]
+        fn = self._spec_jit.get(("draft", k))
+        if fn is None:
+            model = self._model
+
+            def draft_fn(cache, prev, cur, tables, starts, temps, keys_sk):
+                logits, vars_ = model.apply(
+                    {"params": self.params, "cache": cache},
+                    jnp.stack([prev, cur], axis=1), block_tables=tables,
+                    start_pos=starts, mutable=["cache"])
+                cache = vars_["cache"]
+                d1 = _pick(logits[:, 1], temps, keys_sk[:, 0])
+                if k == 1:
+                    return cache, d1[:, None]
+
+                def body(carry, key_s):
+                    cache, tok, pos = carry
+                    logits, vars_ = model.apply(
+                        {"params": self.params, "cache": cache},
+                        tok[:, None], block_tables=tables, start_pos=pos,
+                        mutable=["cache"])
+                    nxt = _pick(logits[:, 0], temps, key_s)
+                    return (vars_["cache"], nxt, pos + 1), nxt
+
+                (cache, _, _), rest = lax.scan(
+                    body, (cache, d1, starts + 2),
+                    jnp.swapaxes(keys_sk[:, 1:], 0, 1))
+                drafts = jnp.concatenate(
+                    [d1[:, None], jnp.swapaxes(rest, 0, 1)], axis=1)
+                return cache, drafts
+
+            fn = self._spec_jit[("draft", k)] = jax.jit(
+                draft_fn, donate_argnums=(0,) if self._donate else ())
+        self.cache, drafts = fn(self.cache, jnp.asarray(prev, jnp.int32),
+                                jnp.asarray(cur, jnp.int32),
+                                jnp.asarray(tables), jnp.asarray(starts),
+                                jnp.asarray(temps, jnp.float32),
+                                jnp.asarray(keys))
+        return np.asarray(drafts)
+
+    def spec_verify(self, tokens, temps, keys) -> np.ndarray:
+        """Target verification (called on the TARGET pool): score all
+        ``k + 1`` positions — ``tokens [R, k+1]`` = current token + the k
+        drafts — in ONE multi-token pass (the S>1 suffix-prefill machinery
+        of ``models/lm.py``'s paged branch), picking position ``j`` with
+        the ORIGINAL step key ``keys[:, j]``. The engine accepts drafts
+        while they match the picks, so every emitted token is by induction
+        the token sequential decode would have picked — bit-identity for
+        greedy AND seeded sampling. Writes without advancing ``filled``
+        (:meth:`commit_spec` advances/rolls back); positions past a row's
+        allocated blocks route to the null block and only ever back picks
+        the engine discards. Returns picks ``[R, k+1]``."""
+        r = self.max_resident
+        s = np.asarray(tokens).shape[1]
+        nb = self._live_bucket()
+        picks = self._spec_verify_dispatch(
+            np.asarray(tokens)[:nb], np.asarray(temps)[:nb],
+            np.asarray(keys)[:nb], list(range(nb)))
+        self.last_decode_bucket = nb
+        if nb < r:
+            self.stats["decode_rows_skipped"] += r - nb
+            out = np.zeros((r, s), picks.dtype)
+            out[:nb] = picks
+            picks = out
+        return picks
+
+    def _spec_verify_dispatch(self, tokens, temps, keys, rows) -> np.ndarray:
+        tables, starts = self._tables_starts(rows)
+        s = tokens.shape[1]
+        fn = self._spec_jit.get(("verify", s))
+        if fn is None:
+            model = self._model
+
+            def verify_fn(cache, toks, tables, starts, temps, keys_sk):
+                logits, vars_ = model.apply(
+                    {"params": self.params, "cache": cache}, toks,
+                    block_tables=tables, start_pos=starts,
+                    mutable=["cache"])
+                picks = jax.vmap(lambda lg, key: _pick(lg, temps, key),
+                                 in_axes=1, out_axes=1)(logits, keys_sk)
+                return vars_["cache"], picks
+
+            fn = self._spec_jit[("verify", s)] = jax.jit(
+                verify_fn, donate_argnums=(0,) if self._donate else ())
+        self.cache, picks = fn(self.cache, jnp.asarray(tokens, jnp.int32),
+                               jnp.asarray(tables), jnp.asarray(starts),
+                               jnp.asarray(temps, jnp.float32),
+                               jnp.asarray(keys))
+        return np.asarray(picks)
+
+    def warmup_spec(self, spec_k: int, role: str) -> None:
+        """Precompile one spec program per resident bucket of the ladder
+        (null-table rows, like :meth:`warmup`): the verify pass on the
+        target pool, the lagged draft chain on the draft pool."""
+        for nb in self.resident_ladder():
+            if role == "verify":
+                self._spec_verify_dispatch(
+                    np.zeros((nb, spec_k + 1), np.int32),
+                    np.zeros((nb,), np.float32),
+                    np.zeros((nb, spec_k + 1, 2), np.uint32), [None] * nb)
+            else:
+                self._spec_draft_dispatch(
+                    np.zeros((nb,), np.int32), np.zeros((nb,), np.int32),
+                    np.zeros((nb,), np.float32),
+                    np.zeros((nb, spec_k, 2), np.uint32), [None] * nb)
 
     def resident_ladder(self) -> tuple[int, ...]:
         """Decode-batch bucket ladder: pow2 row counts up to

@@ -82,6 +82,7 @@ import warnings
 import jax
 import numpy as np
 
+from ddw_tpu.models.spec_decode import match_length
 from ddw_tpu.runtime.faults import ServeCrash, maybe_serve_fault
 from ddw_tpu.serve.admission import (AdmissionController, DeadlineExceeded,
                                      Overloaded, ReplicaFailed)
@@ -99,6 +100,9 @@ DEGRADED = "degraded"    # loop running, but the consecutive-error count > 0
 FAILED = "failed"        # terminal: loop dead, futures failed, submissions
 #                          refused — restart()/clone_fresh() to recover
 STOPPED = "stopped"      # clean stop()
+
+_UNSET = object()        # set_checkpoint(draft_dir=...) sentinel: "leave
+#                          the currently staged/serving draft alone"
 
 
 @dataclasses.dataclass
@@ -153,6 +157,13 @@ class EngineCfg:
     #                             0 = no reserve (batch may fill the pool)
     batch_rows_headroom: int = 1   # resident ROWS a fresh batch admission
     #                             must leave free for interactive arrivals
+    # speculative decoding (docs/serving.md "Speculative decoding"): a
+    # small draft model proposes spec_k tokens per stream per tick and the
+    # target verifies all k+1 positions in ONE multi-token pass — every
+    # emitted token is the token sequential decode would have picked
+    # (greedy AND seeded sampling), so outputs stay bit-identical to
+    # spec_k=0. Requires paged=True and ServingEngine(draft=...).
+    spec_k: int = 0             # draft tokens proposed per tick; 0 = off
 
 
 @dataclasses.dataclass
@@ -257,14 +268,16 @@ class ServingEngine:
 
     ``lm`` / ``image`` accept a packaged model (anything with an
     ``engine_handle()``) or the handle itself; at least one is required.
-    With ``run`` set, SLO metrics land in the tracker on :meth:`stop` and a
+    ``draft`` (same duck-type as ``lm``) is the speculative-decoding draft
+    model — required when ``cfg.spec_k > 0``, ignored otherwise. With
+    ``run`` set, SLO metrics land in the tracker on :meth:`stop` and a
     :class:`~ddw_tpu.utils.sysmon.SystemMonitor` samples utilization while
     the engine is live (``monitor_interval_s > 0``).
     """
 
     def __init__(self, lm=None, image=None, cfg: EngineCfg | None = None,
                  run=None, monitor_interval_s: float = 0.0,
-                 replica_id: int = 0):
+                 replica_id: int = 0, draft=None):
         if lm is None and image is None:
             raise ValueError("engine needs an lm and/or image model")
         self.cfg = cfg or EngineCfg()
@@ -307,8 +320,10 @@ class ServingEngine:
 
         self.model_dir: str | None = None    # checkpoint dir behind _lm,
         #                                      when loaded from a package
+        self.draft_dir: str | None = None    # checkpoint dir behind _draft
         self._pending_checkpoint: str | None = None   # applied at restart()
-        self._init_lm(lm)
+        self._pending_draft: object = _UNSET          # staged draft swap
+        self._init_lm(lm, draft=draft)
         self._pool_stats_seen: dict[str, int] = {}
 
         self._image = (image.engine_handle()
@@ -326,46 +341,50 @@ class ServingEngine:
             self._image_apply = make_apply()  # one callable; jit caches per
             #                                   padded batch-bucket shape
 
-    def _init_lm(self, lm) -> None:
-        """Build (or rebuild) the LM handle + KV pool. Called at
+    def _init_lm(self, lm, draft=_UNSET) -> None:
+        """Build (or rebuild) the LM handle + KV pool(s). Called at
         construction and by :meth:`restart` when a pending checkpoint swap
         (:meth:`set_checkpoint`) replaces the weights — the pool compiles
-        against the new params inside the warmup gate, never on traffic."""
+        against the new params inside the warmup gate, never on traffic.
+        ``draft`` left unset keeps the current draft handle (a target-only
+        weight swap re-pools the existing draft)."""
         self._lm = lm.engine_handle() if hasattr(lm, "engine_handle") else lm
+        if draft is _UNSET:
+            draft = getattr(self, "_draft", None)
+        else:
+            draft = (draft.engine_handle()
+                     if hasattr(draft, "engine_handle") else draft)
+        self._draft = draft
+        self._draft_pool: BlockPool | None = None
         if self._lm is not None:
+            spec = self.cfg.spec_k > 0
+            if self.cfg.spec_k < 0:
+                raise ValueError(f"spec_k must be >= 0, got "
+                                 f"{self.cfg.spec_k}")
+            if spec and not self.cfg.paged:
+                raise ValueError("speculative decoding (spec_k > 0) "
+                                 "requires the paged pool "
+                                 "(EngineCfg(paged=True))")
+            if spec and draft is None:
+                raise ValueError("spec_k > 0 requires a draft model "
+                                 "(ServingEngine(draft=...))")
+            if spec and draft.cfg.vocab_size != self._lm.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {draft.cfg.vocab_size} != target "
+                    f"vocab_size {self._lm.cfg.vocab_size} — draft "
+                    f"proposals must be target tokens")
             if self.cfg.paged:
-                model = self._lm.model
-                tile = min(256, model.max_len)
-                cap = -(-model.max_len // tile) * tile
-                block_size = self.cfg.kv_block_size
-                if block_size < 1 or tile % block_size:
-                    # the default (16) need not divide every model's
-                    # attention tile (e.g. max_len=100 -> tile 100):
-                    # shrink to the largest divisor not above the
-                    # configured size rather than failing construction
-                    block_size = max(
-                        d for d in range(1, min(max(block_size, 1),
-                                                tile) + 1)
-                        if tile % d == 0)
-                    warnings.warn(
-                        f"kv_block_size {self.cfg.kv_block_size} does not "
-                        f"divide the attention tile {tile} (= min(256, "
-                        f"max_len {model.max_len})); using {block_size}",
-                        RuntimeWarning, stacklevel=3)
-                n_blocks = self.cfg.kv_cache_blocks or (
-                    self.cfg.n_slots * cap // block_size)
-                n = self.cfg.max_resident or 2 * self.cfg.n_slots
-                reserve = self.cfg.interactive_reserve_blocks
-                if reserve < 0:
-                    reserve = n_blocks // 4   # auto: a quarter of the pool
-                self.pool = BlockPool(
-                    model, self._lm.params, n_blocks=n_blocks,
-                    block_size=block_size, max_resident=n,
-                    steps_per_tick=self.cfg.steps_per_tick,
-                    donate=self.cfg.donate,
-                    overcommit=self.cfg.block_overcommit,
-                    interactive_reserve=reserve,
-                    decode_buckets=self.cfg.decode_buckets)
+                self.pool = self._build_block_pool(
+                    self._lm, self.cfg.steps_per_tick)
+                n = self.pool.max_resident
+                if spec:
+                    # the draft's OWN paged pool: rows mirror the target
+                    # pool one-for-one (identical admit/release order over
+                    # identical LIFO free lists), but it never registers
+                    # prefixes — draft K/V is throwaway scaffolding, not a
+                    # shareable cache
+                    self._draft_pool = self._build_block_pool(
+                        draft, max(self.cfg.spec_k, 1))
             else:
                 self.pool = SlotPool(self._lm.model, self._lm.params,
                                      self.cfg.n_slots,
@@ -375,9 +394,48 @@ class ServingEngine:
             self._n_rows = n
             self._slot_req: dict[int, _LMRequest] = {}
             self._cur = np.zeros((n,), np.int32)
+            self._prev = np.zeros((n,), np.int32)   # H[-2] per row — the
+            #                             draft's lagged entry token (the
+            #                             draft pool has processed H[:-2])
             self._temps = np.zeros((n,), np.float32)
         else:
             self.pool = None
+
+    def _build_block_pool(self, handle, steps_per_tick: int) -> BlockPool:
+        """One paged pool over ``handle`` with the engine's geometry knobs
+        (block size shrinks to the model's own tile divisor; block count
+        defaults to equal-KV-memory scaled by the model's own capacity)."""
+        model = handle.model
+        tile = min(256, model.max_len)
+        cap = -(-model.max_len // tile) * tile
+        block_size = self.cfg.kv_block_size
+        if block_size < 1 or tile % block_size:
+            # the default (16) need not divide every model's attention
+            # tile (e.g. max_len=100 -> tile 100): shrink to the largest
+            # divisor not above the configured size rather than failing
+            # construction
+            block_size = max(
+                d for d in range(1, min(max(block_size, 1), tile) + 1)
+                if tile % d == 0)
+            warnings.warn(
+                f"kv_block_size {self.cfg.kv_block_size} does not "
+                f"divide the attention tile {tile} (= min(256, "
+                f"max_len {model.max_len})); using {block_size}",
+                RuntimeWarning, stacklevel=3)
+        n_blocks = self.cfg.kv_cache_blocks or (
+            self.cfg.n_slots * cap // block_size)
+        n = self.cfg.max_resident or 2 * self.cfg.n_slots
+        reserve = self.cfg.interactive_reserve_blocks
+        if reserve < 0:
+            reserve = n_blocks // 4   # auto: a quarter of the pool
+        return BlockPool(
+            model, handle.params, n_blocks=n_blocks,
+            block_size=block_size, max_resident=n,
+            steps_per_tick=steps_per_tick,
+            donate=self.cfg.donate,
+            overcommit=self.cfg.block_overcommit,
+            interactive_reserve=reserve,
+            decode_buckets=self.cfg.decode_buckets)
 
     # -- checkpoint hot-swap (the deploy layer's weight-reload hook) ---------
     @property
@@ -388,26 +446,44 @@ class ServingEngine:
         digest = getattr(self._lm, "content_digest", None)
         return digest or None
 
-    def set_checkpoint(self, model_dir: str | None) -> None:
+    def set_checkpoint(self, model_dir: str | None,
+                       draft_dir: object = _UNSET) -> None:
         """Stage a weight swap: the NEXT :meth:`restart` (so also
         :meth:`recycle`) loads the LM package at ``model_dir`` and rebuilds
         the pool over its params. Nothing changes until then — in-slot work
         keeps decoding against the current weights, which is exactly what a
         drain-then-restart rolling deploy needs. ``None`` clears a staged
-        swap."""
+        swap.
+
+        ``draft_dir`` (keyword) stages the speculative DRAFT package
+        alongside: a path swaps the draft at the same restart, ``None``
+        drops it (restart then fails fast if ``spec_k > 0`` still demands
+        one — the deploy layer's rollback path), and leaving it unset keeps
+        the currently serving draft."""
         self._pending_checkpoint = model_dir
+        if model_dir is None:
+            self._pending_draft = _UNSET
+        if draft_dir is not _UNSET:
+            self._pending_draft = draft_dir
 
     def _apply_pending_checkpoint(self) -> None:
-        """Inside restart(): swap the staged package in. Raises on a bad
+        """Inside restart(): swap the staged package(s) in. Raises on a bad
         package — the caller (supervisor recycle / DeployController) treats
         that as a failed step and rolls back."""
         model_dir, self._pending_checkpoint = self._pending_checkpoint, None
+        draft_dir, self._pending_draft = self._pending_draft, _UNSET
         if model_dir is None:
             return
         from ddw_tpu.serving.lm_package import load_lm_package
 
         pkg = load_lm_package(model_dir)
-        self._init_lm(pkg)
+        if draft_dir is _UNSET:
+            self._init_lm(pkg)          # keeps the current draft handle
+        else:
+            dpkg = (load_lm_package(draft_dir)
+                    if draft_dir is not None else None)
+            self._init_lm(pkg, draft=dpkg)
+            self.draft_dir = draft_dir
         self.model_dir = model_dir
 
     # -- lifecycle ----------------------------------------------------------
@@ -572,8 +648,11 @@ class ServingEngine:
         elif self.pool is not None:
             self._slot_req.clear()
             self._cur[:] = 0
+            self._prev[:] = 0
             self._temps[:] = 0.0
             self.pool.reset()
+            if self._draft_pool is not None:
+                self._draft_pool.reset()
             self._sync_pool_stats()
         self._stopped = False
         self._draining.clear()
@@ -638,10 +717,11 @@ class ServingEngine:
         it, so the clone re-compiles). Carries the replica identity, the
         next generation, and the failover hook."""
         eng = ServingEngine(lm=self._lm, image=self._image, cfg=self.cfg,
-                            replica_id=self.replica_id)
+                            replica_id=self.replica_id, draft=self._draft)
         eng.generation = self.generation + 1
         eng.on_failure = self.on_failure
         eng.model_dir = self.model_dir
+        eng.draft_dir = self.draft_dir
         return eng
 
     def adopt(self, kind: str, req) -> None:
@@ -727,6 +807,23 @@ class ServingEngine:
                 raise ValueError(
                     f"request needs {need} KV blocks but the {lane} lane "
                     f"only ever has {ceiling}")
+        if self._draft_pool is not None:
+            if (prompt.size + num_steps + self.cfg.spec_k
+                    > self._draft.cfg.max_len):
+                raise ValueError(
+                    f"prompt {prompt.size} + steps {num_steps} + spec_k "
+                    f"{self.cfg.spec_k} exceeds the draft model's max_len "
+                    f"{self._draft.cfg.max_len}")
+            dpool = self._draft_pool
+            dp, dns = self._draft_admit_shape(prompt.size, num_steps)
+            dneed = dpool.blocks_for(dpool.total_positions(dp, dns))
+            dceil = dpool.n_blocks
+            if lane == "batch":
+                dceil -= dpool.interactive_reserve
+            if dneed > dceil:
+                raise ValueError(
+                    f"request needs {dneed} draft KV blocks but the "
+                    f"{lane} lane only ever has {dceil}")
         if temperature < 0.0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if temperature > 0.0 and rng is None:
@@ -822,6 +919,8 @@ class ServingEngine:
             if isinstance(self.pool, BlockPool):
                 self.pool.warmup(buckets,
                                  max_group=self.pool.max_resident)
+                if self._draft_pool is not None:
+                    self._warmup_spec(prompt_lens)
             else:
                 self.pool.warmup(buckets)
         if self._image is not None:
@@ -834,6 +933,30 @@ class ServingEngine:
             for g in sizes:
                 self._image_apply(
                     np.zeros((g, h.height, h.width, 3), np.float32))
+
+    def _warmup_spec(self, prompt_lens) -> None:
+        """Precompile the speculative program lattice: the draft pool's
+        prefill buckets (it prefills ``len(eff) - 1`` tokens, so warm the
+        shifted buckets too), the lagged draft chain, and the target's
+        multi-token verify pass — each across the resident-bucket ladder.
+        The draft pool's decode chain and CoW copy are never dispatched,
+        so they are deliberately NOT compiled here."""
+        dpool = self._draft_pool
+        dlens = {max(n - 1, 1) for n in prompt_lens} | set(prompt_lens)
+        dbuckets = sorted({bucket_len(n, self._draft.cfg.max_len,
+                                      self.cfg.min_bucket) for n in dlens})
+        for bucket in dbuckets:
+            g = 1
+            while True:
+                dpool.prefill([None] * g, np.zeros((g, bucket), np.int32),
+                              np.ones((g,), np.int32),
+                              np.zeros((g,), np.float32),
+                              np.zeros((g, 2), np.uint32))
+                if g >= dpool.max_resident:
+                    break
+                g = min(g * 2, dpool.max_resident)
+        dpool.warmup_spec(self.cfg.spec_k, "draft")
+        self.pool.warmup_spec(self.cfg.spec_k, "verify")
 
     def snapshot(self) -> dict[str, float]:
         return self.metrics.snapshot()
@@ -982,8 +1105,11 @@ class ServingEngine:
                     emitted=req.emitted, forensics=fail.forensics))
             self._slot_req.clear()
             self._cur[:] = 0
+            self._prev[:] = 0
             self._temps[:] = 0.0
             self.pool.reset()
+            if self._draft_pool is not None:
+                self._draft_pool.reset()
             self._sync_pool_stats()
         if self._consecutive_errors >= self.cfg.max_consecutive_errors:
             crash = ServeCrash(
@@ -1108,8 +1234,11 @@ class ServingEngine:
         row = self.pool.preempt_youngest(lane="batch")
         if row is None:
             return False
+        if self._draft_pool is not None:
+            self._draft_pool.release(row, preempted=True)
         req = self._slot_req.pop(row)
         self._cur[row] = 0
+        self._prev[row] = 0
         self._temps[row] = 0.0
         self._ctrl.requeue_front("lm_batch", req)
         return True
@@ -1141,7 +1270,8 @@ class ServingEngine:
             # logits, so its remaining picks = num_steps - (emitted - 1)
             ns = head.num_steps - max(head.emitted - 1, 0)
             if (pool.free_slots < min_rows
-                    or not pool.can_admit(len(eff), ns, lane=lane)):
+                    or not pool.can_admit(len(eff), ns, lane=lane)
+                    or not self._draft_can_admit(len(eff), ns, lane)):
                 if not batch and self._preempt_batch_for_interactive():
                     worked = True
                     continue        # re-check the head against freed space
@@ -1162,7 +1292,8 @@ class ServingEngine:
                     break
                 eff = req.effective_prompt()
                 ns = req.num_steps - max(req.emitted - 1, 0)
-                if not pool.can_admit(len(eff), ns, lane=lane):
+                if (not pool.can_admit(len(eff), ns, lane=lane)
+                        or not self._draft_can_admit(len(eff), ns, lane)):
                     self._ctrl.requeue_front(kind, req)
                     break
             if not self._claim(req):
@@ -1175,8 +1306,38 @@ class ServingEngine:
                 # admit() unwound cleanly; head-of-line waits for releases
                 self._ctrl.requeue_front(kind, req)
                 break
+            if self._draft_pool is not None:
+                dp, dns = self._draft_admit_shape(len(eff), ns)
+                try:
+                    drow, _ = self._draft_pool.admit(eff[:dp], dns,
+                                                     lane=lane)
+                except OutOfBlocks:
+                    pool.release(row)   # clean unwind: mirror preserved
+                    self._ctrl.requeue_front(kind, req)
+                    break
+                assert drow == row, "draft rows diverged from target rows"
             picked.append((req, eff, row, hit))
         return worked
+
+    def _draft_admit_shape(self, p: int, ns: int) -> tuple[int, int]:
+        """Draft-pool admission geometry for an effective prompt of length
+        ``p``: the draft lags the target one position (it has processed
+        ``H[:-2]``), so it prefills ``eff[:-1]`` and needs positions for
+        ``ns + spec_k + 1`` lag-pair + draft writes per stream. The
+        ``p == 1`` edge prefills nothing — the lone prompt token's K/V is
+        written by the first lagged S=2 draft step itself (the pool row is
+        admitted over the full prompt and its write pointer rewound to 0
+        via :meth:`BlockPool.set_filled`)."""
+        k = self.cfg.spec_k
+        if p >= 2:
+            return p - 1, ns + k + 1
+        return p, ns + k
+
+    def _draft_can_admit(self, p: int, ns: int, lane: str) -> bool:
+        if self._draft_pool is None:
+            return True
+        dp, dns = self._draft_admit_shape(p, ns)
+        return self._draft_pool.can_admit(dp, dns, lane=lane)
 
     def _admit_lm_paged(self, drain_only: bool = False) -> bool:
         """Admission on free BLOCKS: pop queued requests head-first while
@@ -1208,6 +1369,8 @@ class ServingEngine:
             self._sync_pool_stats()
             return worked
         self._inflight_admit = [req for req, *_ in picked]
+        if self._draft_pool is not None:
+            self._prefill_draft(picked)
         groups: dict[int, list] = {}
         now = time.monotonic()
         for item in picked:
@@ -1256,14 +1419,50 @@ class ServingEngine:
                 # re-derivation of its newest pick; nothing new to emit
                 if req.emitted >= req.num_steps:
                     pool.release(row)
+                    if self._draft_pool is not None:
+                        self._draft_pool.release(row)
                     self._finish_lm(req)
                 else:
                     self._slot_req[row] = req
                     self._cur[row] = tok0
+                    if self._draft_pool is not None:
+                        # H = eff + [tok0]: the draft's lagged entry pair
+                        # next tick is [eff[-1], tok0]
+                        self._prev[row] = int(eff[-1])
                     self._temps[row] = req.temperature
         self._inflight_admit = []
         self._sync_pool_stats()
         return True
+
+    def _prefill_draft(self, picked: list) -> None:
+        """Mirror admissions into the draft pool: prefill each stream's
+        ``eff[:-1]`` (grouped by suffix bucket like the target prefill —
+        the draft never prefix-hits, so the whole shifted prompt is the
+        suffix) and pin the lag invariant ``filled = len(eff) - 1``. The
+        picked first tokens are discarded — only the K/V matters."""
+        dpool = self._draft_pool
+        dgroups: dict[int, list] = {}
+        for req, eff, row, hit in picked:
+            if len(eff) < 2:
+                dpool.set_filled(row, 0)    # P == 1: nothing to prefill
+                continue
+            bucket = bucket_len(len(eff) - 1, self._draft.cfg.max_len,
+                                self.cfg.min_bucket)
+            dgroups.setdefault(bucket, []).append((eff, row))
+        for bucket, items in dgroups.items():
+            g = batch_bucket(len(items), dpool.max_resident)
+            rows: list = [None] * g
+            prompts = np.zeros((g, bucket), np.int32)
+            true_lens = np.ones((g,), np.int32)
+            for i, (eff, row) in enumerate(items):
+                prompts[i] = pad_to_bucket(eff[None, :-1], bucket)[0]
+                true_lens[i] = eff.size - 1
+                rows[i] = row
+            dpool.prefill(rows, prompts, true_lens,
+                          np.zeros((g,), np.float32),
+                          np.zeros((g, 2), np.uint32))
+            for _, row in items:
+                dpool.note_prefilled(row)
 
     def _admit_lm(self) -> bool:
         draining = self._draining.is_set()
@@ -1335,6 +1534,8 @@ class ServingEngine:
         return True
 
     def _decode_tick(self) -> bool:
+        if self._draft_pool is not None:
+            return self._spec_tick()
         if not self._slot_req:
             return False
         self._fault("decode")
@@ -1377,6 +1578,121 @@ class ServingEngine:
             self.pool.release(slot)
             self._temps[slot] = 0.0
             self._cur[slot] = 0
+            self._finish_lm(req)
+        self._sync_pool_stats()
+        return True
+
+    def _spec_prepare(self, k1: int) -> list[int]:
+        """Joint tick allocation across the TARGET and DRAFT pools: both
+        write up to ``k1 = spec_k + 1`` positions this tick, and a victim
+        must vacate BOTH (the row mirror), so the engine drives
+        :meth:`BlockPool.extend_row` itself instead of each pool's own
+        :meth:`prepare_tick`. Victim policy is identical (batch before
+        interactive, youngest first) via :meth:`BlockPool.stream_order`;
+        exhaustion is only reachable with ``block_overcommit > 1``.
+        Returns the preempted rows for requeue."""
+        pool, dpool = self.pool, self._draft_pool
+        order = {row: pool.stream_order(row) for row in self._slot_req}
+        victims: list[int] = []
+        vset: set[int] = set()
+        for row in sorted(order, key=order.get):
+            if row in vset:
+                continue
+            while True:
+                try:
+                    pool.extend_row(row, k1)
+                    dpool.extend_row(row, k1)
+                    break
+                except OutOfBlocks:
+                    victim = max((r for r in order if r not in vset),
+                                 key=order.get)
+                    pool.release(victim, preempted=True)
+                    dpool.release(victim, preempted=True)
+                    victims.append(victim)
+                    vset.add(victim)
+                    if victim == row:
+                        break
+        return victims
+
+    def _spec_tick(self) -> bool:
+        """One speculative decode tick (``spec_k > 0``): the draft pool
+        proposes k tokens per live stream (one lagged S=2 step + k-1
+        single steps), the target pool verifies all k+1 positions in ONE
+        multi-token pass, and drafts are accepted while they match the
+        target's own picks under the ORIGINAL per-step keys — so every
+        emitted token is by induction exactly what sequential (spec-off)
+        decode would have picked, for greedy and seeded sampling alike.
+        Both pools then advance by only the accepted positions
+        (:meth:`BlockPool.commit_spec` rolls the rejected writes back and
+        frees their blocks). Streaming (``req.emit``) sees each accepted
+        token exactly once, same as the plain tick."""
+        if not self._slot_req:
+            return False
+        self._fault("decode")
+        k = self.cfg.spec_k
+        pool, dpool = self.pool, self._draft_pool
+        for row in self._spec_prepare(k + 1):
+            req = self._slot_req.pop(row)
+            self._cur[row] = 0
+            self._prev[row] = 0
+            self._temps[row] = 0.0
+            self._ctrl.requeue_front(
+                "lm_batch" if req.lane == "batch" else "lm", req)
+        if not self._slot_req:
+            self._sync_pool_stats()
+            return True
+        n = self._n_rows
+        vkeys = np.zeros((n, k + 1, 2), np.uint32)
+        for row, req in self._slot_req.items():
+            if req.keys is not None:
+                ks = req.keys[req.emitted:req.emitted + k + 1]
+                vkeys[row, :len(ks)] = ks
+        # draft proposal j is the candidate for step emitted+j, so it
+        # samples with THAT step's key — a self-draft then reproduces the
+        # target's own picks and acceptance is ~1 (the spec_ab pin)
+        drafts = dpool.spec_draft(self._prev, self._cur, self._temps,
+                                  vkeys[:, :k])
+        vtoks = np.concatenate(
+            [self._cur[:, None], drafts.astype(np.int32)], axis=1)
+        picks = pool.spec_verify(vtoks, self._temps, vkeys)
+        self.metrics.count("decode_ticks")
+        finished = []
+        for row, req in self._slot_req.items():
+            m = match_length(drafts[row], picks[row])
+            # m accepted drafts + the target's own pick for position m
+            # (the "bonus" — a free correction/extension either way)
+            remaining = req.num_steps - req.emitted
+            take = min(m + 1, remaining)
+            start = req.emitted
+            req.tokens.extend(int(t) for t in picks[row, :take])
+            req.emitted += take
+            req.emit(start)
+            # proposals past the request's horizon were never candidates —
+            # they are clipped, not rejected (a matching self-draft keeps
+            # acceptance at exactly 1.0 through its final short tick)
+            usable = min(k, remaining)
+            accepted = min(m, take)
+            self.metrics.count("spec_proposed", usable)
+            self.metrics.count("spec_accepted", accepted)
+            self.metrics.count("spec_rejected", usable - accepted)
+            if take == m + 1:
+                self.metrics.count("spec_bonus")
+            pool.commit_spec(row, take)
+            dpool.commit_spec(row, take)
+            if req.emitted >= req.num_steps:
+                finished.append(row)
+            else:
+                # picked history grew by take: H' = H + picks[:take]
+                self._prev[row] = (int(picks[row, take - 2])
+                                   if take >= 2 else self._cur[row])
+                self._cur[row] = int(picks[row, take - 1])
+        for row in finished:
+            req = self._slot_req.pop(row)
+            pool.release(row)
+            dpool.release(row)
+            self._temps[row] = 0.0
+            self._cur[row] = 0
+            self._prev[row] = 0
             self._finish_lm(req)
         self._sync_pool_stats()
         return True

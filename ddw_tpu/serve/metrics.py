@@ -105,6 +105,17 @@ class EngineMetrics:
         self.prefix_hit_tokens = 0   # prompt tokens whose prefill was skipped
         self.decode_rows_skipped = 0  # resident rows a bucketed decode tick
         #                            did NOT dispatch (pow2 live-row bucket)
+        # speculative decoding (ddw_tpu.serve.engine._spec_tick): with
+        # spec_k > 0 every decode tick is one draft+verify dispatch pair,
+        # so tokens-per-tick derives as (accepted + bonus) / decode_ticks
+        self.spec_proposed = 0     # draft tokens proposed (spec_k / stream
+        #                            / tick)
+        self.spec_accepted = 0     # proposals that matched the target's
+        #                            own pick and were emitted
+        self.spec_rejected = 0     # proposals rolled back (KV freed)
+        self.spec_bonus = 0        # target-pick tokens emitted by verify
+        #                            passes — the free k+1-th token on full
+        #                            acceptance, the correction otherwise
         # fleet prefix cache (ddw_tpu.gateway.prefix_index)
         self.routed_cache_hit = 0    # requests routed to a prefix holder
         self.routed_wait_override = 0  # holder skipped: projected wait made
@@ -208,6 +219,10 @@ class EngineMetrics:
                 "serve.prefix_miss_blocks": float(self.prefix_miss_blocks),
                 "serve.prefix_hit_tokens": float(self.prefix_hit_tokens),
                 "serve.decode_rows_skipped": float(self.decode_rows_skipped),
+                "serve.spec_proposed": float(self.spec_proposed),
+                "serve.spec_accepted": float(self.spec_accepted),
+                "serve.spec_rejected": float(self.spec_rejected),
+                "serve.spec_bonus": float(self.spec_bonus),
                 "serve.routed_cache_hit": float(self.routed_cache_hit),
                 "serve.routed_wait_override": float(
                     self.routed_wait_override),
@@ -216,6 +231,12 @@ class EngineMetrics:
             looked = self.prefix_hit_blocks + self.prefix_miss_blocks
             out["serve.prefix_hit_rate"] = (
                 self.prefix_hit_blocks / looked if looked else 0.0)
+            out["serve.spec_acceptance_rate"] = (
+                self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else 0.0)
+            out["serve.spec_tokens_per_tick"] = (
+                (self.spec_accepted + self.spec_bonus) / self.decode_ticks
+                if self.spec_proposed and self.decode_ticks else 0.0)
             for name, val in self._gauges.items():
                 out[f"serve.{name}"] = float(val)
             cap = self._gauges.get("block_tokens_capacity", 0.0)
@@ -316,6 +337,13 @@ _COUNTER_HELP = (
     ("prefix_hit_tokens", "Prompt tokens whose prefill compute was skipped."),
     ("decode_rows_skipped", "Resident rows bucketed decode ticks did not "
      "dispatch (pow2 live-row bucket)."),
+    ("spec_proposed", "Draft tokens proposed by speculative decode ticks."),
+    ("spec_accepted", "Draft proposals accepted (matched the target's own "
+     "pick) and emitted."),
+    ("spec_rejected", "Draft proposals rejected — their KV writes rolled "
+     "back and blocks freed."),
+    ("spec_bonus", "Target-pick tokens emitted by verify passes (the free "
+     "k+1-th token on full acceptance, the correction otherwise)."),
     ("routed_cache_hit", "Requests routed to the replica holding their "
      "longest cached prefix."),
     ("routed_wait_override", "Prefix-holder routes overridden because "
@@ -368,6 +396,10 @@ def merge_metrics(metrics_list) -> "EngineMetrics":
             out.prefix_miss_blocks += m.prefix_miss_blocks
             out.prefix_hit_tokens += m.prefix_hit_tokens
             out.decode_rows_skipped += m.decode_rows_skipped
+            out.spec_proposed += m.spec_proposed
+            out.spec_accepted += m.spec_accepted
+            out.spec_rejected += m.spec_rejected
+            out.spec_bonus += m.spec_bonus
             out.routed_cache_hit += m.routed_cache_hit
             out.routed_wait_override += m.routed_wait_override
             out.warm_replays += m.warm_replays
@@ -411,6 +443,10 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
             counters["prefix_miss_blocks"] += m.prefix_miss_blocks
             counters["prefix_hit_tokens"] += m.prefix_hit_tokens
             counters["decode_rows_skipped"] += m.decode_rows_skipped
+            counters["spec_proposed"] += m.spec_proposed
+            counters["spec_accepted"] += m.spec_accepted
+            counters["spec_rejected"] += m.spec_rejected
+            counters["spec_bonus"] += m.spec_bonus
             counters["routed_cache_hit"] += m.routed_cache_hit
             counters["routed_wait_override"] += m.routed_wait_override
             counters["warm_replays"] += m.warm_replays
@@ -454,6 +490,13 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
     looked = counters["prefix_hit_blocks"] + counters["prefix_miss_blocks"]
     pool_gauges["prefix_hit_rate"] = (
         counters["prefix_hit_blocks"] / looked if looked else 0.0)
+    pool_gauges["spec_acceptance_rate"] = (
+        counters["spec_accepted"] / counters["spec_proposed"]
+        if counters["spec_proposed"] else 0.0)
+    pool_gauges["spec_tokens_per_tick"] = (
+        (counters["spec_accepted"] + counters["spec_bonus"])
+        / counters["decode_ticks"]
+        if counters["spec_proposed"] and counters["decode_ticks"] else 0.0)
     cap = pool_gauges.get("block_tokens_capacity", 0.0)
     if cap:
         pool_gauges["block_fragmentation_pct"] = max(

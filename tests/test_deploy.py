@@ -413,6 +413,12 @@ def test_kill_process_replica_supervisor_restarts_with_identity(fleet, pkgs):
     assert gw.replica_set.replicas[0].generation >= 1
 
 
+@pytest.mark.slow   # tier-1 budget (PR 12): the rollout machinery keeps
+#                     its tier-1 reps above (controller roll/abort logic,
+#                     process-fleet bit-identity + deploy state, SIGKILL
+#                     restart with identity); this CLI-under-closed-loop
+#                     soak rides tier-2 next to the load_gen --deploy arm
+#                     that pins the same zero-dropped-requests claim
 def test_rolling_deploy_cli_zero_dropped_requests_under_load(fleet, pkgs):
     """THE acceptance pin: tools/rolling_deploy.py hot-swaps the 2-process
     fleet from pkg_a to pkg_b while closed-loop clients hammer the

@@ -207,6 +207,14 @@ def test_reserve_auto_default(pm):
 
 # -- bit-identity (the tentpole pin) -----------------------------------------
 
+@pytest.mark.slow   # tier-1 budget (PR 12): batch-rows == offline
+#                     bit-identity keeps tier-1 reps —
+#                     test_interactive_preempts_batch_bit_identical below
+#                     (greedy, plus preemption pressure) and
+#                     test_http_batch_endpoints_and_lane_stats (the seeded
+#                     per-item fold_in derivation vs direct generate);
+#                     this direct-engine greedy+seeded sweep rides tier-2
+#                     next to the batch_backfill row-identity arm
 def test_batch_matches_direct_greedy_and_seeded(eng, pm):
     """A batch job's rows are bit-identical to the direct offline
     ``generate`` path — greedy, and seeded via the per-item fold_in

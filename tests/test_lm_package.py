@@ -103,6 +103,11 @@ def test_format_guards(tmp_path):
         pm.score(_tokens(1, 128))
 
 
+@pytest.mark.slow   # tier-1 budget (PR 12): bucket-padding correctness
+#                     keeps test_score_bucketing_matches_unpadded below
+#                     and the engine-side compile-ladder counts are pinned
+#                     in tests/test_fleet_prefix.py; this generate-path
+#                     program-count sweep rides tier-2
 def test_generate_bucketing_no_per_length_programs(tmp_path):
     """Prompt lengths sharing a bucket share ONE jitted program (the
     engine's bucketing applied to the single-request path), and the padded

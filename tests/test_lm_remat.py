@@ -63,6 +63,12 @@ def test_remat_invalid_mode_raises():
                                np.zeros((1, 4), np.int32))
 
 
+@pytest.mark.slow   # tier-1 budget (PR 12): remat grad-equality keeps its
+#                     tier-1 rep ([dots] above) and SP equivalence keeps
+#                     ring_attention matches/gradients in
+#                     tests/test_ops_parallel.py; the remat x parallelism
+#                     COMPOSITION sweeps ride tier-2 (rope-pp composition
+#                     moved there in PR 11 with the same rationale)
 def test_remat_composes_with_sp_train_step():
     """Full remat under the DPxSP shard_map step: one step == the no-remat
     step (the ring hops recompute cleanly inside the checkpointed block)."""

@@ -83,9 +83,13 @@ def test_bucketing_ladder():
 
 # -- determinism: engine == sequential generate -----------------------------
 
-@pytest.mark.slow   # the staggered-admissions test below is the tier-1
-#                     determinism pin; this matrix re-pins it across slot
-#                     counts / chain lengths / eviction orders in tier-2
+@pytest.mark.slow   # tier-1 determinism reps for the engine==sequential
+#                     class live in tests/test_paged_kv.py (greedy +
+#                     seeded on the default paged pool) and
+#                     tests/test_spec_engine.py (same pins through the
+#                     speculative tick); this matrix re-pins it across
+#                     slot counts / chain lengths / eviction orders in
+#                     tier-2
 @pytest.mark.parametrize("n_slots,steps_per_tick", [(1, 1), (2, 4), (4, 3)])
 def test_engine_matches_sequential_across_slot_counts(pm, n_slots,
                                                       steps_per_tick):
@@ -104,6 +108,12 @@ def test_engine_matches_sequential_across_slot_counts(pm, n_slots,
         assert r.ttft_ms >= 0 and r.total_ms >= r.ttft_ms
 
 
+@pytest.mark.slow   # tier-1 budget (PR 12): mid-decode admission with
+#                     mixed greedy/sampled neighbors is pinned tier-1 by
+#                     tests/test_paged_kv.py and the spec drills in
+#                     tests/test_spec_engine.py (requests admitted while
+#                     residents decode on the default paged pool); this
+#                     staggered two-phase sweep rides tier-2
 def test_engine_matches_sequential_with_staggered_admissions(pm):
     """Admissions arriving WHILE other slots decode (the continuous-batching
     case) — greedy requests interleaved with per-request temperature

@@ -41,7 +41,12 @@ def test_serving_curve_smoke():
         assert r["queue_ms_p50"] >= 0
         assert r["total_ms_p99"] >= r["ttft_ms_p50"] > 0
     by_c = {r["concurrency"]: r for r in eng["sweep"]}
-    assert by_c[8]["tokens_per_sec"] > eng["sequential_tokens_per_sec"]
+    # the continuous-batching win needs real parallelism between the
+    # engine loop and its clients — on a 1-core box the closed-loop
+    # clients serialize against the decode thread and the comparison
+    # measures the scheduler, not the engine (ROADMAP Health)
+    if os.cpu_count() > 1:
+        assert by_c[8]["tokens_per_sec"] > eng["sequential_tokens_per_sec"]
     # routing A/B arm: cache-aware vs least-outstanding on the same
     # shared-prefix workload — the fleet prefix-cache acceptance pin
     # (the arm's own SMOKE asserts enforce the strict inequality; the
@@ -54,6 +59,20 @@ def test_serving_curve_smoke():
                 == ab["offered_prefill_tokens"])
     assert ca["prefill_tokens_computed"] < lo["prefill_tokens_computed"]
     assert ca["routed_cache_hit"] > 0 and lo["routed_cache_hit"] == 0
+    # spec A/B arm: spec-on vs spec-off at equal config (the arm's own
+    # SMOKE asserts pin bit-identical completions; the contract here is
+    # the reported rows stay coherent and the self-draft actually
+    # multiplied tokens per target dispatch)
+    sp = d["spec_ab"]
+    assert sp["k"] == 4
+    assert sp["spec_off"]["decode_ticks"] > sp["spec_on"]["decode_ticks"]
+    assert sp["ticks_saved"] == (sp["spec_off"]["decode_ticks"]
+                                 - sp["spec_on"]["decode_ticks"])
+    assert sp["spec_on"]["spec_tokens_per_tick"] > 1.0
+    assert sp["spec_on"]["spec_acceptance_rate"] == 1.0
+    assert sp["spec_off"]["spec_tokens_per_tick"] == 0.0
+    for arm in ("spec_off", "spec_on"):
+        assert sp[arm]["tokens_per_sec"] > 0
 
 
 def test_serving_curve_refuses_cpu_fallback():

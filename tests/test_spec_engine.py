@@ -1,0 +1,264 @@
+"""Speculative decoding in the paged serving engine (ServingEngine spec_k).
+
+The tentpole pins — all engine-level, on the CPU backend:
+
+- token identity: greedy decode with ``spec_k > 0`` (a DIFFERENT-weights
+  draft, so real rejections happen every tick) is bit-identical to the
+  sequential package path, i.e. to ``spec_k = 0``; seeded stochastic
+  decode preserves the per-step key discipline (draft proposal j samples
+  with step ``emitted+j``'s key, verify re-picks with the same keys) and
+  is bit-identical too;
+- rollback: rejected speculative KV writes are rewound and their blocks
+  freed — nothing leaks from either pool (target or draft) across
+  completions, preemptions, and restart generations, and the prefix
+  cache sees only prompt-content registrations (hit/CoW counters are
+  identical across spec modes on the same workload);
+- preempt-by-recompute under speculation folds only ACCEPTED tokens into
+  the requeued prompt: resumes are bit-identical and ``on_token``
+  streaming sees each token exactly once, in order;
+- config plumbing: spec_k needs the paged pool and a draft with the
+  target's vocabulary — violations are structured ValueErrors at
+  construction, not decode-time surprises.
+
+The offline kernel's own pins live in test_spec_decode.py; this file is
+the live batched path (``BlockPool.spec_draft/spec_verify/commit_spec``
++ ``ServingEngine._spec_tick``).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ddw_tpu.models.lm import build_lm
+from ddw_tpu.serve import BlockPool, EngineCfg, ServingEngine
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+
+
+def _lm_pkg(out_dir, seed=0, **cfg_kw):
+    kw = dict(vocab_size=VOCAB, max_len=96, hidden=32, depth=2, num_heads=2,
+              mlp_dim=64, dropout=0.0, dtype="float32")
+    kw.update(cfg_kw)
+    cfg = LMCfg(**kw)
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(seed)},
+                        np.zeros((1, 8), np.int32))["params"]
+    d = save_lm_package(str(out_dir), cfg, params, quantize=None)
+    return load_lm_package(d)
+
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    return _lm_pkg(tmp_path_factory.mktemp("spec_target") / "pkg", seed=0)
+
+
+@pytest.fixture(scope="module")
+def dm(tmp_path_factory):
+    # different seed = different weights: proposals genuinely diverge from
+    # the target's picks, so every tick exercises rollback
+    return _lm_pkg(tmp_path_factory.mktemp("spec_draft") / "pkg", seed=7)
+
+
+@pytest.fixture(scope="module")
+def eng3(pm, dm):
+    """One shared spec-on engine (different-weights draft) for the
+    identity pins — its compiled draft/verify programs amortize across
+    tests, and the leak asserts are checked after each test's requests
+    complete (monotone, so sharing only ever helps)."""
+    cfg = EngineCfg(n_slots=3, steps_per_tick=2, spec_k=3,
+                    decode_buckets=False, default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg, draft=dm) as e:
+        yield e
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _pool_clean(pool: BlockPool) -> None:
+    """The leak pin (test_paged_kv idiom), applied to BOTH pools here:
+    rejected-speculation rollback must leave no block behind."""
+    g = pool.gauges()
+    assert g["resident_streams"] == 0
+    assert g["blocks_used"] == 0, g
+    assert g["blocks_free"] + g["blocks_cached"] == g["blocks_total"], g
+    assert int(pool._ref.sum()) == 0
+    assert pool._committed == 0
+    assert pool.free_slots == pool.max_resident
+
+
+# -- token identity ----------------------------------------------------------
+
+def test_greedy_spec_on_bit_identical_to_spec_off(eng3, pm):
+    """THE acceptance pin: a low-agreement draft changes latency only,
+    never content — including 1- and 2-token prompts (the draft-lag edge
+    cases) and requests whose final tick is clipped short."""
+    prompts = _prompts([5, 17, 1, 2], seed=2)
+    steps = [6, 9, 5, 7]
+    refs = [pm.generate(p[None, :], n)[0] for p, n in zip(prompts, steps)]
+    futs = [eng3.submit_generate(p, n) for p, n in zip(prompts, steps)]
+    for i, f in enumerate(futs):
+        assert np.array_equal(f.result(timeout=120).tokens, refs[i]), i
+    snap = eng3.snapshot()
+    # speculation actually ran, and the accounting identity holds:
+    # every spec-tick token is an accepted draft or the verify pick
+    assert snap["serve.spec_proposed"] > 0
+    assert (snap["serve.spec_accepted"] + snap["serve.spec_rejected"]
+            == snap["serve.spec_proposed"])
+    _pool_clean(eng3.pool)
+    _pool_clean(eng3._draft_pool)
+
+
+def test_seeded_sampling_spec_on_bit_identical(eng3, pm):
+    """Stochastic decode: per-request key schedules survive the graft —
+    draft proposal j and verify position j both use step emitted+j's key,
+    so acceptance-then-emission reproduces step-by-step sampling."""
+    prompts = _prompts([5, 17], seed=4)
+    steps = [6, 9]
+    keys = [jax.random.PRNGKey(100 + i) for i in range(len(prompts))]
+    refs = [pm.generate(p[None, :], n, temperature=0.9, rng=k)[0]
+            for p, n, k in zip(prompts, steps, keys)]
+    futs = [eng3.submit_generate(p, n, temperature=0.9, rng=k)
+            for p, n, k in zip(prompts, steps, keys)]
+    for i, f in enumerate(futs):
+        assert np.array_equal(f.result(timeout=120).tokens, refs[i]), i
+
+
+def test_self_draft_acceptance_is_exactly_one(pm):
+    """Draft == target: greedy proposals always match the verifier's own
+    picks, so acceptance is exactly 1.0 and every spec tick advances k+1
+    tokens per stream (clipped proposals at a request's horizon are not
+    counted as rejections) — the spec_ab smoke's mechanism, pinned at the
+    engine level."""
+    prompts = _prompts([5, 17], seed=2)
+    steps = [6, 9]
+    refs = [pm.generate(p[None, :], n)[0] for p, n in zip(prompts, steps)]
+    cfg = EngineCfg(n_slots=3, steps_per_tick=2, spec_k=3,
+                    decode_buckets=False, default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg, draft=pm) as eng:
+        futs = [eng.submit_generate(p, n) for p, n in zip(prompts, steps)]
+        for i, f in enumerate(futs):
+            assert np.array_equal(f.result(timeout=120).tokens, refs[i]), i
+        snap = eng.snapshot()
+    assert snap["serve.spec_acceptance_rate"] == 1.0
+    assert snap["serve.spec_rejected"] == 0
+    assert snap["serve.spec_tokens_per_tick"] > 1.0
+
+
+# -- preemption under speculation --------------------------------------------
+
+def test_spec_preempt_resume_bit_identical_exactly_once(pm, dm):
+    """Out-of-blocks mid-speculation: the youngest stream is evicted from
+    BOTH pools, re-queued at the head with only ACCEPTED tokens folded
+    into its recompute prompt, and resumes bit-identically — streamed
+    tokens are never duplicated, nothing leaks. (Per-class rep note: this
+    is the tier-1 representative of the preempt-by-recompute identity
+    class; the spec-off variant,
+    test_paged_kv.py::test_out_of_blocks_preemption_resumes_token_identically,
+    moved to tier-2 — both drive the same requeue-front + fold-emitted
+    machinery, this one through the stricter rollback path.)"""
+    prompts = _prompts([30, 31, 33, 34], seed=17)
+    steps = 36
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+    streamed = {i: [] for i in range(len(prompts))}
+    cfg = EngineCfg(n_slots=2, steps_per_tick=4, kv_cache_blocks=12,
+                    max_resident=4, block_overcommit=3.0, spec_k=3,
+                    decode_buckets=False, default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg, draft=dm) as eng:
+        futs = [eng.submit_generate(
+            p, steps, on_token=lambda i, t, j=j: streamed[j].append((i, t)))
+            for j, p in enumerate(prompts)]
+        out = [f.result(timeout=300) for f in futs]
+        snap = eng.snapshot()
+        _pool_clean(eng.pool)
+        _pool_clean(eng._draft_pool)
+    assert snap["serve.preemptions"] > 0, "overcommit never ran out"
+    for j, (r, ref) in enumerate(zip(out, refs)):
+        assert np.array_equal(r.tokens, ref), j
+        assert [i for i, _ in streamed[j]] == list(range(steps)), j
+        assert [t for _, t in streamed[j]] == list(r.tokens), j
+
+
+# -- prefix cache neutrality -------------------------------------------------
+
+def test_prefix_hit_and_cow_counters_identical_across_spec_modes(pm, dm):
+    """Speculation must not perturb what the prefix cache sees: only
+    fully-accepted prompt-content blocks are chain-hash-registered, so
+    the SAME workload produces the SAME hit/CoW counters with spec on and
+    off (stale registrations from rejected speculations would diverge
+    them — the chain-hash staleness pin)."""
+    (pa,) = _prompts([24], seed=1)
+    pb = pa.copy()
+    pb[20] = (pb[20] + 1) % VOCAB          # diverges inside the tail block
+    counters = {}
+    for mode, k in (("off", 0), ("on", 3)):
+        cfg = EngineCfg(n_slots=3, steps_per_tick=2, spec_k=k,
+                        decode_buckets=False, default_timeout_s=600.0)
+        with ServingEngine(lm=pm, cfg=cfg,
+                           draft=dm if k else None) as eng:
+            eng.generate(pa, 5)                  # seeds the prefix cache
+            f1 = eng.submit_generate(pa, 5)      # exact repeat: tail CoW
+            f2 = eng.submit_generate(pb, 5)      # shared full-block prefix
+            f1.result(timeout=120), f2.result(timeout=120)
+            snap = eng.snapshot()
+        counters[mode] = {kk: snap[f"serve.{kk}"] for kk in
+                          ("prefix_hit_blocks", "prefix_miss_blocks",
+                           "prefix_hit_tokens", "cow_copies")}
+    assert counters["on"] == counters["off"], counters
+    assert counters["on"]["prefix_hit_blocks"] > 0      # the cache worked
+    assert counters["on"]["cow_copies"] > 0
+
+
+# -- restart generations + config plumbing -----------------------------------
+
+@pytest.mark.slow   # tier-1 budget: every tier-1 spec drill above already
+#                     asserts BOTH pools drain to zero, and restart/recycle
+#                     generations are pinned tier-1 by test_deploy.py /
+#                     test_fleet_supervision.py; this spec-specific restart
+#                     sweep rides tier-2
+def test_spec_restart_generation_serves_clean(pm, dm):
+    """restart() resets BOTH pools; the next generation serves
+    bit-identically and leaks nothing."""
+    prompts = _prompts([9, 13], seed=23)
+    cfg = EngineCfg(n_slots=3, steps_per_tick=2, spec_k=3,
+                    decode_buckets=False, default_timeout_s=600.0)
+    eng = ServingEngine(lm=pm, cfg=cfg, draft=dm)
+    with eng:
+        eng.generate(prompts[0], 6)
+    eng.restart()
+    try:
+        got = eng.generate(prompts[1], 6)
+        assert np.array_equal(got.tokens,
+                              pm.generate(prompts[1][None, :], 6)[0])
+        _pool_clean(eng.pool)
+        _pool_clean(eng._draft_pool)
+    finally:
+        eng.stop()
+
+
+def test_spec_config_validation_is_structured(pm, dm):
+    with pytest.raises(ValueError, match="spec_k"):
+        ServingEngine(lm=pm, cfg=EngineCfg(spec_k=-1), draft=dm)
+    with pytest.raises(ValueError, match="draft"):
+        ServingEngine(lm=pm, cfg=EngineCfg(spec_k=2))       # no draft
+    with pytest.raises(ValueError, match="paged"):
+        ServingEngine(lm=pm, cfg=EngineCfg(spec_k=2, paged=False),
+                      draft=dm)
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        other = _lm_pkg(tmp + "/v", vocab_size=32)
+        with pytest.raises(ValueError, match="vocab"):
+            ServingEngine(lm=pm, cfg=EngineCfg(spec_k=2), draft=other)
+    # a draft request must fit the DRAFT's max_len too (k-token lookahead)
+    short = None
+    with tempfile.TemporaryDirectory() as tmp:
+        short = _lm_pkg(tmp + "/s", max_len=32)
+        eng = ServingEngine(lm=pm, cfg=EngineCfg(spec_k=4), draft=short)
+        (p,) = _prompts([24], seed=3)
+        with pytest.raises(ValueError, match="max_len"):
+            eng.submit_generate(p, 8)           # 24 + 8 + 4 > 32
+        eng.stop()

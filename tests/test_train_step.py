@@ -90,6 +90,11 @@ def test_dynamic_lr_get_set():
     assert get_lr(state) == pytest.approx(5e-4)  # survives a step
 
 
+@pytest.mark.slow   # tier-1 budget (PR 12): optimizer leaf-masking keeps
+#                     its tier-1 reps — test_lora.py's lora-mask step/
+#                     graft pins and test_transfer.py's frozen-base
+#                     end-to-end training path; this unit sweep rides
+#                     tier-2
 def test_frozen_base_masking():
     """freeze_base: backbone params must not change; head must (Keras
     trainable=False semantics, reference 02_model_training_single_node.py:169)."""

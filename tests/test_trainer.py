@@ -43,6 +43,11 @@ def test_lr_warmup_schedule(small_cfgs, silver, tmp_path):
     assert lrs[1] == pytest.approx(1e-3 * world, rel=1e-5)
 
 
+@pytest.mark.slow   # tier-1 budget (PR 12): sync-resume keeps its
+#                     bit-identity rep (test_resume.py
+#                     test_resume_matches_uninterrupted) and the async
+#                     writer keeps test_async_checkpoint_resume below;
+#                     this epochs-continue bookkeeping sweep rides tier-2
 def test_checkpoint_resume(small_cfgs, silver, tmp_path):
     train_tbl, val_tbl, _ = silver
     tr = _mk_trainer(small_cfgs, silver, tmp_path, epochs=2)

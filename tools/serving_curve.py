@@ -19,7 +19,10 @@ complete during the run — the dual-lane headline) — plus the ROUTING-A/B
 arm: cache-aware routing vs the least-outstanding baseline on the same
 shared-prefix workload over a 2-replica fleet (the smoke pins strictly
 fewer prefill tokens computed with TTFT p99 no worse — the fleet
-prefix-cache headline).
+prefix-cache headline) — plus the SPEC-A/B arm: speculative decoding on
+vs off at equal engine config on the same workload with a self-draft (the
+smoke pins bit-identical completions, acceptance exactly 1.0, >1 tokens
+per target dispatch, and strictly fewer decode ticks).
 
 Usage (chip): ``DDW_REQUIRE_TPU=1 python tools/serving_curve.py``
 CI smoke:     ``DDW_BENCH_SMOKE=1`` shrinks shapes/batches/steps.
@@ -517,6 +520,72 @@ def routing_ab(hidden, depth, heads, vocab, max_len, n_slots,
     return out
 
 
+def spec_ab(hidden, depth, heads, vocab, max_len, prompt_len, steps,
+            n_slots, steps_per_tick, spec_k, dtype="float32", requests=8):
+    """The engine speculative-decode A/B arm: spec-on vs spec-off at EQUAL
+    engine config on the SAME workload through the paged engine. The draft
+    is the target itself (self-draft) — greedy proposals then always match
+    the verifier's own picks, so acceptance is exactly 1.0 and every tick
+    advances k+1 tokens per stream: the arm isolates the dispatch-count
+    mechanics (ticks saved) from draft quality, which random weights cannot
+    represent (a trained draft/target pair sits between the two arms).
+    DDW_BENCH_SMOKE pins bit-identical completions across arms, >1
+    accepted tokens per target dispatch, and strictly fewer decode ticks;
+    tok/s is reported for both arms without a pin — on CPU the self-draft
+    pays target-sized drafting compute, so the wall-clock win needs a
+    genuinely small draft."""
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, vocab, size=(prompt_len,)).astype(np.int32)
+               for _ in range(requests)]
+    out = {"k": spec_k, "requests": requests, "steps": steps}
+    completions = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "spec_ab", hidden, depth, heads, vocab,
+                          max_len, dtype=dtype)
+        for name, k in (("spec_off", 0), ("spec_on", spec_k)):
+            cfg = EngineCfg(n_slots=n_slots, steps_per_tick=steps_per_tick,
+                            spec_k=k, queue_depth=4 * requests,
+                            default_timeout_s=600.0)
+            with ServingEngine(lm=pm, cfg=cfg,
+                               draft=pm if k else None) as eng:
+                eng.warmup([prompt_len])
+                eng.generate(prompts[0], steps)     # compile + warm cache
+                eng.metrics = type(eng.metrics)()   # fresh window
+                t0 = time.perf_counter()
+                futs = [eng.submit_generate(p, steps) for p in prompts]
+                completions[name] = [f.result(timeout=600).tokens
+                                     for f in futs]
+                wall = time.perf_counter() - t0
+                snap = eng.snapshot()
+            row = {
+                "tokens_per_sec": round(requests * steps / wall, 1),
+                "decode_ticks": int(snap["serve.decode_ticks"]),
+                "spec_acceptance_rate": round(
+                    snap.get("serve.spec_acceptance_rate", 0.0), 4),
+                "spec_tokens_per_tick": round(
+                    snap.get("serve.spec_tokens_per_tick", 0.0), 3),
+            }
+            out[name] = row
+            print(f"[curve] spec_ab {name}: {row['decode_ticks']} decode "
+                  f"ticks, {row['tokens_per_sec']:.0f} tok/s"
+                  + (f", {row['spec_tokens_per_tick']:.2f} tok/tick at "
+                     f"acceptance {row['spec_acceptance_rate']:.2f}"
+                     if k else ""), file=sys.stderr, flush=True)
+    out["ticks_saved"] = (out["spec_off"]["decode_ticks"]
+                          - out["spec_on"]["decode_ticks"])
+    if SMOKE:
+        # the acceptance pins: content is UNTOUCHED by speculation while
+        # each target dispatch yields more than one token
+        for a, b in zip(completions["spec_off"], completions["spec_on"]):
+            assert np.array_equal(a, b), out
+        assert out["spec_on"]["spec_tokens_per_tick"] > 1.0, out
+        assert out["spec_on"]["spec_acceptance_rate"] == 1.0, out
+        assert out["ticks_saved"] > 0, out
+    return out
+
+
 def main():
     from ddw_tpu.utils.config import require_tpu_or_exit
 
@@ -548,6 +617,12 @@ def main():
                      n_slots=4, steps_per_tick=4, dtype="float32",
                      families=6, shared_len=64, tail_len=8, rounds=3,
                      steps=4)
+        # steps_per_tick=1 so one decode tick == one target dispatch in
+        # BOTH arms: ticks saved then reads directly as dispatches saved
+        spec_kw = dict(hidden=64, depth=2, heads=4, vocab=256, max_len=128,
+                       prompt_len=16, steps=24, n_slots=4,
+                       steps_per_tick=1, spec_k=4, dtype="float32",
+                       requests=8)
     else:
         batches, img = [1, 2, 4, 8, 16, 32, 64, 128, 256], (224, 224, 3)
         lm_kw = dict(hidden=512, depth=6, heads=8, vocab=8192, max_len=2048,
@@ -567,6 +642,9 @@ def main():
                      max_len=2048, n_slots=16, steps_per_tick=8,
                      families=8, shared_len=512, tail_len=32, rounds=4,
                      steps=16)
+        spec_kw = dict(hidden=512, depth=6, heads=8, vocab=8192,
+                       max_len=2048, prompt_len=64, steps=128, n_slots=16,
+                       steps_per_tick=1, spec_k=4, requests=32)
 
     result = {
         "device": {"kind": kind, "n": jax.device_count()},
@@ -576,6 +654,7 @@ def main():
         "paged_capacity": paged_capacity(**cap_kw),
         "batch_lanes": batch_lane_curve(**lane_kw),
         "routing_ab": routing_ab(**ab_kw),
+        "spec_ab": spec_ab(**spec_kw),
     }
     print(json.dumps(result))
 
