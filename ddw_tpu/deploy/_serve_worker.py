@@ -43,6 +43,22 @@ def _write_atomic(path: str, text: str) -> None:
     os.replace(tmp, path)
 
 
+def _dump_flight(eng, port_file: str) -> None:
+    """Engine went terminal while this process is still alive enough to
+    write: drop the tracer's ring as ``flight.gen<N>.json`` next to the
+    port file (the replica workdir). The generation rides the port-file
+    name (``port.gen<N>.json``) — no extra flag needed. A SIGKILLed child
+    never reaches here; the parent's relay cache covers that path."""
+    tracer = getattr(eng, "tracer", None)
+    if tracer is None or not getattr(eng, "_tracing", False):
+        return
+    base = os.path.basename(port_file)
+    gen = base[len("port."):-len(".json")] if (
+        base.startswith("port.") and base.endswith(".json")) else "gen0"
+    tracer.dump_flight(os.path.join(os.path.dirname(port_file) or ".",
+                                    f"flight.{gen}.json"))
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ddw-serve-worker")
     p.add_argument("--model-dir", required=True)
@@ -87,6 +103,7 @@ def main(argv=None) -> int:
         if state == "stopped":
             return 0
         if eng.state == "failed":
+            _dump_flight(eng, args.port_file)
             gw.drain(grace_s=1.0)           # 503 stragglers, close listener
             return EXIT_ENGINE_FAILED
         time.sleep(0.05)

@@ -64,7 +64,8 @@ class DeployController:
     def __init__(self, replica_set, supervisor, model_dir: str,
                  rollback: bool = True, status: dict | None = None,
                  status_lock: threading.Lock | None = None,
-                 settle_timeout_s: float = 60.0, draft_dir=_UNSET):
+                 settle_timeout_s: float = 60.0, draft_dir=_UNSET,
+                 tracer=None):
         self.rs = replica_set
         self.supervisor = supervisor
         self.model_dir = model_dir
@@ -78,6 +79,12 @@ class DeployController:
             "steps": []}
         self._status_lock = status_lock or threading.Lock()
         self.steps: list[DeployStep] = []
+        self.tracer = tracer         # the gateway's, when it traces: every
+        self._trace_id = None        # rollout step lands on one trace id
+        self._root_span = None       # so Perfetto shows the whole roll
+        if tracer is not None:
+            from ddw_tpu.obs.trace import gen_id
+            self._trace_id = f"deploy-{gen_id()[:8]}"
 
     # -- status plumbing -----------------------------------------------------
     def _set(self, **kw) -> None:
@@ -88,6 +95,19 @@ class DeployController:
         self.steps.append(step)
         with self._status_lock:
             self.status.setdefault("steps", []).append(step.to_dict())
+        if self.tracer is not None:
+            # one span per rollout step, reconstructed from the step's own
+            # clock (t1 = now, t0 = t1 - elapsed) — the forensics dict and
+            # the trace can never disagree about duration
+            t1 = time.monotonic()
+            self.tracer.record_span(
+                f"deploy.{step.action}", "deploy",
+                t1 - step.elapsed_s, t1, trace=self._trace_id,
+                parent=self._root_span, tid="deploy",
+                args={"replica": step.replica, "ok": step.ok,
+                      "generation": step.generation,
+                      "checkpoint": step.checkpoint,
+                      "detail": step.detail})
 
     # -- the roll ------------------------------------------------------------
     def _health(self, i: int) -> dict:
@@ -121,6 +141,10 @@ class DeployController:
         abort, not a crashed control thread."""
         self._set(deploying=True, status="rolling",
                   target_dir=self.model_dir)
+        t_roll = time.monotonic()
+        if self.tracer is not None:
+            # pre-allocated so step spans can parent on it before it lands
+            self._root_span = self.tracer._next_span_id()
         want_digest: str | None = None
         try:
             for i in range(len(self.rs.replicas)):
@@ -181,6 +205,15 @@ class DeployController:
             self._set(deploying=False,      # leave "deploying" stuck True
                       status="aborted", error=repr(e))
             return self.status
+        finally:
+            if self.tracer is not None:
+                self.tracer.record_span(
+                    "deploy", "deploy", t_roll, time.monotonic(),
+                    trace=self._trace_id, tid="deploy",
+                    span=self._root_span,
+                    args={"target": self.model_dir,
+                          "status": self.status.get("status"),
+                          "steps": len(self.steps)})
 
     def _abort(self, failed_i: int, old_dir: str | None,
                old_draft: str | None = None) -> None:

@@ -144,6 +144,11 @@ class ProcessReplica:
         self._health_cache: dict | None = None
         self._health_at = 0.0
         self._last_alive = time.monotonic()  # last proof the child answered
+        # parent-side flight cache: the child's last trace events, kept
+        # across the HTTP relay so a SIGKILLed child (which can dump
+        # nothing itself) still leaves flight.<gen>.json behind
+        self._trace_cache: list[dict] = []
+        self._trace_seq = 0
 
     # -- spawn plumbing ------------------------------------------------------
     def _port_file(self) -> str:
@@ -191,6 +196,7 @@ class ProcessReplica:
         self._draining.clear()
         self._last_alive = time.monotonic()
         self._health_cache, self._health_at = None, 0.0
+        self._trace_cache, self._trace_seq = [], 0   # new child, new ring
         self.log_path = os.path.join(self._workdir,
                                      f"child.gen{self.generation}.log")
         with open(self.log_path, "ab") as log:
@@ -213,12 +219,18 @@ class ProcessReplica:
                 return
             kind = ("engine_failed" if code == 13 else
                     "killed" if code < 0 else f"exit_{code}")
+            forensics = {"exit_code": code, "pid": proc.pid}
+            if self._trace_cache:
+                # the flight recorder, parent-side: a reaped child dumped
+                # nothing — attach the relayed ring's tail instead
+                forensics["flight"] = list(self._trace_cache[-64:])
             failure = ReplicaFailed(
                 kind, replica=self.replica_id, generation=self.generation,
-                phase="process", forensics={"exit_code": code,
-                                            "pid": proc.pid})
+                phase="process", forensics=forensics)
             self.failure = failure
             cb = self.on_failure
+        if code < 0:
+            self._dump_flight_cache()
         if cb is not None:
             try:
                 cb(failure, [])     # nothing to salvage: in-flight HTTP
@@ -300,13 +312,16 @@ class ProcessReplica:
         if proc is not None and proc.poll() is None:
             proc.kill()
             proc.wait()
+        self._dump_flight_cache()   # the child can't — it just got SIGKILL
         with self._lock:
             self.last_exit_code = proc.poll() if proc else None
+            forensics = {"reason": reason,
+                         "exit_code": self.last_exit_code}
+            if self._trace_cache:
+                forensics["flight"] = list(self._trace_cache[-64:])
             self.failure = ReplicaFailed(
                 kind, replica=self.replica_id, generation=self.generation,
-                phase="process",
-                forensics={"reason": reason,
-                           "exit_code": self.last_exit_code})
+                phase="process", forensics=forensics)
             failure, cb = self.failure, self.on_failure
         if cb is not None:
             try:
@@ -504,6 +519,63 @@ class ProcessReplica:
         except Exception:
             return {"seq": int(since), "reset": False, "events": []}
 
+    # -- trace relay (the fleet's merged Perfetto view) -----------------------
+    def trace_events(self, since: int = 0) -> dict:
+        """The child engine's trace ring, relayed in one HTTP fetch
+        (``GET /v1/trace?replica=0`` on the child's own gateway) — the
+        same duck-type as :meth:`~ddw_tpu.serve.ServingEngine.
+        trace_events`, so the parent gateway's ``/v1/trace`` merge sees
+        process replicas like in-thread ones. Every relay refreshes the
+        parent-side flight cache; a dead or unreachable child answers its
+        CACHED tail (``since=0`` only) so the merged trace still shows a
+        killed replica's last moments."""
+        cli = self._client
+        if cli is None or not self._ready or self.failure is not None \
+                or self._proc is None or self._proc.poll() is not None:
+            with self._lock:
+                cached = list(self._trace_cache) if since == 0 else []
+            return {"replica": self.replica_id,
+                    "generation": self.generation, "dropped": 0,
+                    "cached": True, "events": cached}
+        try:
+            d = cli.trace(replica=0, since=int(since))
+        except Exception:
+            with self._lock:
+                cached = list(self._trace_cache) if since == 0 else []
+            return {"replica": self.replica_id,
+                    "generation": self.generation, "dropped": 0,
+                    "cached": True, "events": cached}
+        d["replica"] = self.replica_id       # parent-side identity wins
+        d["generation"] = self.generation
+        evs = d.get("events", [])
+        if evs:
+            with self._lock:
+                fresh = [e for e in evs
+                         if e.get("seq", 0) > self._trace_seq]
+                if fresh:
+                    self._trace_cache.extend(fresh)
+                    self._trace_seq = max(e.get("seq", 0) for e in fresh)
+                    del self._trace_cache[:-256]
+        return d
+
+    def _dump_flight_cache(self) -> None:
+        """Write the parent-side trace cache as ``flight.gen<N>.json`` in
+        the workdir — the flight recorder for children that died without
+        the chance to dump their own (SIGKILL). Best-effort."""
+        with self._lock:
+            events = list(self._trace_cache)
+        if not events:
+            return
+        path = os.path.join(self._workdir,
+                            f"flight.gen{self.generation}.json")
+        try:
+            with open(path, "w") as f:
+                json.dump({"process": f"replica{self.replica_id}",
+                           "source": "parent_cache", "dropped": 0,
+                           "events": events}, f)
+        except OSError:
+            pass
+
     # -- submission -----------------------------------------------------------
     def _admission_gate(self, kind: str) -> None:
         """Synchronous refusals, matching the in-thread engine's contract:
@@ -551,7 +623,9 @@ class ProcessReplica:
 
     def submit_generate(self, prompt, num_steps: int,
                         temperature: float = 0.0, rng=None,
-                        timeout_s: float = 0.0, on_token=None
+                        timeout_s: float = 0.0, on_token=None,
+                        trace_id: str | None = None,
+                        parent_span: str | None = None
                         ) -> concurrent.futures.Future:
         self._admission_gate("interactive")
         cli = self._ensure_client()
@@ -566,7 +640,9 @@ class ProcessReplica:
                                    key_data=key_data,
                                    timeout_s=timeout_s or None,
                                    stream=on_token is not None,
-                                   on_token=on_token)
+                                   on_token=on_token,
+                                   trace_id=trace_id,
+                                   parent_span=parent_span)
             except Exception as e:
                 raise self._map_exc(e) from e
             self._note_service(res.get("total_ms",

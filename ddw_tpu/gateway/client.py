@@ -131,17 +131,19 @@ class GatewayClient:
         return min(self.backoff_s * (2 ** attempt), self.max_backoff_s)
 
     def _request(self, method: str, path: str, body: dict | None = None,
-                 retry: bool = True):
+                 retry: bool = True, headers: dict | None = None):
         """One exchange with retry-on-backpressure. Returns
         ``(status, headers, response, connection)``; the caller reads the
         body and closes the connection."""
         payload = json.dumps(body).encode() if body is not None else None
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
         attempt = 0
         while True:
             conn, pooled = self._acquire()
             try:
-                conn.request(method, path, body=payload,
-                             headers={"Content-Type": "application/json"})
+                conn.request(method, path, body=payload, headers=hdrs)
                 resp = conn.getresponse()
             except (OSError, http.client.BadStatusLine,
                     http.client.CannotSendRequest) as e:
@@ -167,9 +169,10 @@ class GatewayClient:
                 conn.close()
                 raise
 
-    def _json_call(self, method: str, path: str, body: dict | None = None
-                   ) -> dict:
-        status, _headers, resp, conn = self._request(method, path, body)
+    def _json_call(self, method: str, path: str, body: dict | None = None,
+                   headers: dict | None = None) -> dict:
+        status, _headers, resp, conn = self._request(method, path, body,
+                                                     headers=headers)
         try:
             parsed = json.loads(resp.read() or b"{}")
             self._done(conn, resp)
@@ -190,7 +193,8 @@ class GatewayClient:
     def generate(self, prompt, num_steps: int, temperature: float = 0.0,
                  seed: int | None = None, timeout_s: float | None = None,
                  stream: bool = False, on_token=None,
-                 key_data=None) -> dict:
+                 key_data=None, trace_id: str | None = None,
+                 parent_span: str | None = None) -> dict:
         """One LM continuation. Returns the final reply dict (``tokens``
         plus the SLO numbers). ``stream=True`` reads the chunked NDJSON
         reply line by line, invoking ``on_token(index, token)`` as each
@@ -199,7 +203,9 @@ class GatewayClient:
         ``key_data`` carries a pre-split PRNG key as raw uint32 words, so a
         caller that already folded its own key (the batch pump, a process
         replica relaying an in-thread submission) gets bit-identical
-        sampling across the HTTP hop."""
+        sampling across the HTTP hop. ``trace_id`` rides the
+        ``x-ddw-trace-id`` header — the server honors it (or mints one
+        when tracing) and echoes it back in the reply."""
         body = {"prompt": [int(t) for t in prompt], "num_steps": num_steps,
                 "temperature": temperature}
         if seed is not None:
@@ -208,11 +214,16 @@ class GatewayClient:
             body["key_data"] = [int(w) for w in key_data]
         if timeout_s is not None:
             body["timeout_s"] = timeout_s
+        hdrs = {"x-ddw-trace-id": trace_id} if trace_id else None
+        if parent_span:
+            hdrs = dict(hdrs or {})
+            hdrs["x-ddw-parent-span"] = parent_span
         if not stream:
-            return self._json_call("POST", "/v1/generate", body)
+            return self._json_call("POST", "/v1/generate", body,
+                                   headers=hdrs)
         body["stream"] = True
         status, _headers, resp, conn = self._request(
-            "POST", "/v1/generate", body)
+            "POST", "/v1/generate", body, headers=hdrs)
         try:
             if status != 200:       # refused before the stream began
                 parsed = json.loads(resp.read() or b"{}")
@@ -355,6 +366,18 @@ class GatewayClient:
 
     def stats(self) -> dict:
         return self._json_call("GET", "/stats")
+
+    def trace(self, replica: int | None = None, since: int = 0,
+              chrome: bool = False) -> dict:
+        """Fetch ``GET /v1/trace``: the merged fleet trace (default), the
+        Perfetto-loadable Chrome form (``chrome=True``), or one replica's
+        incremental relay feed (``replica=R, since=N`` — what a parent
+        gateway polls on a child's gateway)."""
+        if replica is not None:
+            return self._json_call(
+                "GET", f"/v1/trace?replica={replica}&since={since}")
+        path = "/v1/trace?format=chrome" if chrome else "/v1/trace"
+        return self._json_call("GET", path)
 
     def metrics_text(self) -> str:
         status, _h, resp, conn = self._request("GET", "/metrics")

@@ -74,6 +74,7 @@ import json
 import math
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -81,6 +82,7 @@ import numpy as np
 from ddw_tpu.gateway.lifecycle import ServerLifecycle
 from ddw_tpu.gateway.replica import ReplicaSet
 from ddw_tpu.gateway.supervisor import ReplicaSupervisor
+from ddw_tpu.obs.trace import Tracer, gen_id
 from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded, Rejected,
                                      ReplicaFailed, Unavailable)
 from ddw_tpu.serve.lanes import JobLedger
@@ -242,7 +244,12 @@ class _Handler(BaseHTTPRequestHandler):
                 #              answer /stats
                 if gw.supervisor is not None:
                     out["supervisor"] = gw.supervisor.report()
+                ts = gw.trace_summary()
+                if ts is not None:
+                    out["trace"] = ts
                 self._send_json(200, out)
+            elif self.path.startswith("/v1/trace"):
+                self._trace_get(gw)
             elif self.path.startswith("/v1/prefix/events"):
                 self._prefix_events(gw)
             elif self.path.startswith("/v1/batch/"):
@@ -288,6 +295,18 @@ class _Handler(BaseHTTPRequestHandler):
             gw.lifecycle.end_request()
 
     def _generate(self, gw: "Gateway", body: dict) -> None:
+        # trace identity: honor an incoming x-ddw-trace-id (the caller —
+        # a client or a parent gateway — owns the id), mint one only when
+        # this gateway traces; the id rides the response either way so
+        # jsonl forensics and traces stay joinable
+        tracer = gw.tracer
+        trace_id = self.headers.get("x-ddw-trace-id") or None
+        hspan = None
+        t_http = 0.0
+        if tracer is not None:
+            trace_id = trace_id or gen_id()
+            hspan = tracer._next_span_id()
+            t_http = time.monotonic()
         try:
             prompt = np.asarray(body["prompt"], np.int32)
             num_steps = int(body["num_steps"])
@@ -295,6 +314,17 @@ class _Handler(BaseHTTPRequestHandler):
             kw = {"temperature": float(body.get("temperature", 0.0)),
                   "timeout_s": None if timeout_s is None
                   else float(timeout_s)}
+            if trace_id is not None:
+                kw["trace_id"] = trace_id
+                if hspan is not None:
+                    kw["parent_span"] = hspan
+                else:
+                    # relayed hop: a parent gateway's route span id rides
+                    # x-ddw-parent-span so the child engine chain parents
+                    # onto the fleet-level route decision
+                    parent_hdr = self.headers.get("x-ddw-parent-span")
+                    if parent_hdr:
+                        kw["parent_span"] = parent_hdr
             if body.get("seed") is not None:
                 import jax
 
@@ -316,34 +346,54 @@ class _Handler(BaseHTTPRequestHandler):
         if stream:
             toks_q = queue.SimpleQueue()
             kw["on_token"] = lambda i, t: toks_q.put((i, t))
+        def _finish_http(status: int) -> None:
+            if hspan is not None:
+                tracer.record_span(
+                    "http", "gateway", t_http, time.monotonic(),
+                    trace=trace_id, tid="http", span=hspan,
+                    args={"path": "/v1/generate", "num_steps": num_steps,
+                          "status": status, "stream": stream})
+
         try:
             fut = gw.replica_set.submit_generate(prompt, num_steps, **kw)
         except Rejected as e:       # Overloaded / Unavailable / ReplicaFailed
             self._send_rejected(e)
+            _finish_http(0)
             return
         except ValueError as e:
             self._send_json(400, {"error": "invalid_request",
                                   "message": str(e)})
+            _finish_http(400)
             return
         if not stream:
             try:
                 res = fut.result()
             except Rejected as e:
                 self._send_rejected(e)
+                _finish_http(0)
                 return
             except Exception as e:
                 self._send_json(500, {"error": "internal",
                                       "message": repr(e)})
+                _finish_http(500)
                 return
-            self._send_json(200, {
+            out = {
                 "tokens": [int(t) for t in res.tokens],
                 "queue_ms": res.queue_ms, "ttft_ms": res.ttft_ms,
                 "total_ms": res.total_ms,
-                "tokens_per_sec": res.tokens_per_sec})
+                "tokens_per_sec": res.tokens_per_sec}
+            hdrs = None
+            if trace_id is not None:
+                out["trace_id"] = trace_id
+                hdrs = {"x-ddw-trace-id": trace_id}
+            self._send_json(200, out, hdrs)
+            _finish_http(200)
             return
-        self._stream_generate(fut, toks_q)
+        self._stream_generate(fut, toks_q, trace_id=trace_id)
+        _finish_http(200)
 
-    def _stream_generate(self, fut, toks_q: queue.SimpleQueue) -> None:
+    def _stream_generate(self, fut, toks_q: queue.SimpleQueue,
+                         trace_id: str | None = None) -> None:
         """Relay the engine's on_token stream as chunked NDJSON. Headers are
         deferred until the first token (or terminal error), so a request
         shed before any device work still gets its proper status code."""
@@ -372,6 +422,8 @@ class _Handler(BaseHTTPRequestHandler):
                      "queue_ms": res.queue_ms, "ttft_ms": res.ttft_ms,
                      "total_ms": res.total_ms,
                      "tokens_per_sec": res.tokens_per_sec}
+            if trace_id is not None:
+                final["trace_id"] = trace_id
             if not started:                # num_steps >= 1 makes this rare,
                 started = True             # but a zero-token reply is still
                 self._start_stream()       # a well-formed stream
@@ -547,6 +599,42 @@ class _Handler(BaseHTTPRequestHandler):
             rows.append({"index": idx, "ok": True, "row": row})
         self._send_json(200, {"rows": rows})
 
+    def _trace_get(self, gw: "Gateway") -> None:
+        """``GET /v1/trace`` — the fleet's merged trace (gateway ring +
+        every replica's drained ring; process replicas relay their child's
+        over HTTP). ``?format=chrome`` renders Perfetto-loadable Chrome
+        trace JSON directly. ``?replica=R&since=N`` is the single-replica
+        relay form a PARENT gateway polls on a child's own gateway —
+        mirrors ``/v1/prefix/events``."""
+        import urllib.parse
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        if "replica" in q:
+            try:
+                since = int(q.get("since", ["0"])[0])
+                r = int(q["replica"][0])
+            except ValueError:
+                self._send_json(400, {"error": "invalid_request",
+                                      "message": "since/replica must be "
+                                                 "ints"})
+                return
+            replicas = gw.replica_set.replicas
+            if not 0 <= r < len(replicas):
+                self._send_json(404, {"error": "not_found", "replica": r})
+                return
+            fetch = getattr(replicas[r], "trace_events", None)
+            if fetch is None:
+                self._send_json(200, {"replica": r, "dropped": 0,
+                                      "events": []})
+                return
+            self._send_json(200, fetch(since))
+            return
+        dump = gw.trace_dump()
+        if q.get("format", [""])[0] == "chrome":
+            from ddw_tpu.obs.trace import chrome_trace
+            self._send_json(200, chrome_trace(dump["events"]))
+            return
+        self._send_json(200, dump)
+
     def _prefix_events(self, gw: "Gateway") -> None:
         """``GET /v1/prefix/events?since=N&replica=R`` — one replica's
         prefix-cache register/evict delta feed (:meth:`~ddw_tpu.serve.
@@ -680,9 +768,16 @@ class Gateway:
     def __init__(self, replicas, host: str = "127.0.0.1", port: int = 0,
                  grace_s: float | None = None, supervise: bool = True,
                  supervisor_kw: dict | None = None,
-                 job_ledger_dir: str | None = None):
+                 job_ledger_dir: str | None = None, trace: bool = False,
+                 trace_capacity: int = 8192):
         self.replica_set = (replicas if isinstance(replicas, ReplicaSet)
                             else ReplicaSet(replicas))
+        # end-to-end tracing (docs/observability.md): the gateway mints
+        # trace ids, records http + routing spans, and /v1/trace merges
+        # its ring with every replica's into one Perfetto file
+        self.tracer = (Tracer(capacity=trace_capacity, process="gateway")
+                       if trace else None)
+        self.replica_set.tracer = self.tracer
         self.lifecycle = ServerLifecycle(grace_s)
         self.lifecycle.health_fn = self.replica_set.fleet_health
         self._host, self._want_port = host, port
@@ -767,11 +862,61 @@ class Gateway:
         ctrl = DeployController(self.replica_set, self.supervisor,
                                 model_dir, rollback=rollback,
                                 status=self.deploy_status,
-                                status_lock=self._deploy_lock, **kw)
+                                status_lock=self._deploy_lock,
+                                tracer=self.tracer, **kw)
         self._deploy_thread = threading.Thread(
             target=ctrl.run, name="ddw-deploy", daemon=True)
         self._deploy_thread.start()
         return True
+
+    # -- tracing --------------------------------------------------------------
+    def trace_summary(self) -> dict | None:
+        """The /stats trace block: gateway-ring summary + per-replica ring
+        summaries, with fleet-total ``spans_dropped`` (truncation is never
+        silent). None when this gateway does not trace."""
+        if self.tracer is None:
+            return None
+        out = {"gateway": self.tracer.summary(), "replicas": [],
+               "spans_dropped": self.tracer.spans_dropped}
+        for i, eng in enumerate(self.replica_set.replicas):
+            fetch = getattr(eng, "trace_summary", None)
+            if fetch is None:
+                h = (eng.health() if hasattr(eng, "health") else {})
+                s = h.get("trace")
+            else:
+                s = fetch()
+            if s:
+                out["replicas"].append({"replica": i, **s})
+                out["spans_dropped"] += int(s.get("dropped", 0) or 0)
+        return out
+
+    def trace_dump(self) -> dict:
+        """Merged fleet trace — the gateway's ring plus every replica's
+        drained ring (a :class:`~ddw_tpu.deploy.ProcessReplica` relays its
+        child's over HTTP), events in timestamp order on the shared
+        epoch-anchored timeline."""
+        events: list[dict] = []
+        dropped = 0
+        sources: list[str] = []
+        if self.tracer is not None:
+            events.extend(self.tracer.drain())
+            dropped += self.tracer.spans_dropped
+            sources.append(self.tracer.process)
+        for i, eng in enumerate(self.replica_set.replicas):
+            fetch = getattr(eng, "trace_events", None)
+            if fetch is None:
+                continue
+            try:
+                d = fetch(0)
+            except Exception:
+                continue    # a mid-death replica must not break the dump
+            evs = d.get("events", [])
+            if evs:
+                events.extend(evs)
+                sources.append(f"replica{i}")
+            dropped += int(d.get("dropped", 0) or 0)
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        return {"events": events, "dropped": dropped, "sources": sources}
 
     def lane_stats(self) -> dict:
         """Per-lane fleet view for ``/stats`` and ``/readyz``: queue depths

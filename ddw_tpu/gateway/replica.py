@@ -226,6 +226,10 @@ class ReplicaSet:
         #                                          routing, the A/B baseline
         #                                          tools/serving_curve.py
         #                                          measures against
+        self.tracer = None          # obs.Tracer installed by the Gateway
+        #                             when tracing: routing decisions become
+        #                             spans (projected wait, prefix credit,
+        #                             chosen replica, spill/failover)
         for i, eng in enumerate(self.replicas):
             self._wire(i, eng)
 
@@ -314,10 +318,7 @@ class ReplicaSet:
         return (0.0 if not saved_tokens else -float(saved_tokens),
                 float(outstanding), i)
 
-    def _order(self, exclude=(), matched=None) -> list[int]:
-        """Healthy replica indices, best candidate first. ``matched`` is
-        the prefix index's slot -> matched-prefix-tokens map for the
-        prompt being routed (None for non-generate submissions)."""
+    def _scored(self, exclude=(), matched=None) -> list:
         with self._lock:
             outs = list(self._outstanding)
         scored = [self._score(i, outs[i],
@@ -325,7 +326,13 @@ class ReplicaSet:
                   for i in range(len(self.replicas))
                   if i not in exclude and self.breakers[i].available()]
         scored.sort()
-        return [s[-1] for s in scored]
+        return scored
+
+    def _order(self, exclude=(), matched=None) -> list[int]:
+        """Healthy replica indices, best candidate first. ``matched`` is
+        the prefix index's slot -> matched-prefix-tokens map for the
+        prompt being routed (None for non-generate submissions)."""
+        return [s[-1] for s in self._scored(exclude, matched)]
 
     def _min_retry_ms(self) -> float:
         hints = [b.retry_after_ms() for b in self.breakers]
@@ -361,6 +368,8 @@ class ReplicaSet:
             self.breakers[i].abort_probe()
 
     def _submit(self, method: str, args, kwargs, prompt=None):
+        tracer = self.tracer
+        t_route = time.monotonic() if tracer is not None else 0.0
         matched = None
         if prompt is not None and self.route_by_prefix:
             try:        # index staleness/unavailability must never block
@@ -368,10 +377,18 @@ class ReplicaSet:
                 matched = self.prefix_index.match(prompt) or None
             except Exception:
                 matched = None
-        order = self._order(matched=matched)
+        scored = self._scored(matched=matched)
+        order = [s[-1] for s in scored]
         if not order:
             raise Unavailable("all replica circuits open",
                               retry_after_ms=self._min_retry_ms())
+        # the routing span is allocated up front so the engine's own chain
+        # (queue -> prefill -> decode) can parent on it across the hop
+        route_sid = None
+        if tracer is not None and "trace_id" in kwargs:
+            route_sid = tracer._next_span_id()
+            parent = kwargs.get("parent_span")
+            kwargs = dict(kwargs, parent_span=route_sid)
         last = None
         overloads = 0
         for i in order:
@@ -399,6 +416,16 @@ class ReplicaSet:
                 raise            # an outstanding count into the router
             if matched:
                 self._count_routing(i, matched)
+            if route_sid is not None:
+                wait, pending, _ = next(s for s in scored if s[-1] == i)
+                tracer.record_span(
+                    "route", "gateway", t_route, time.monotonic(),
+                    trace=kwargs.get("trace_id"), parent=parent,
+                    tid="router", span=route_sid,
+                    args={"replica": i, "projected_wait_ms": round(wait, 3),
+                          "prefix_tokens": (matched.get(i, 0)
+                                            if matched else 0),
+                          "spills": overloads})
             self.breakers[i].begin_probe()
             with self._lock:
                 self._where[fut] = i
@@ -472,6 +499,11 @@ class ReplicaSet:
                     self._outstanding[j] += 1
                     self._where[fut] = j
                 self.failed_over += 1
+            if self.tracer is not None:
+                self.tracer.instant(
+                    "failover", "gateway",
+                    trace=getattr(req, "trace_id", None), tid="router",
+                    args={"from": src, "to": j, "kind": kind})
             return
         self._complete(req, Unavailable(
             "no sibling could adopt the request before its deadline",

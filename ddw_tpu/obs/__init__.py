@@ -1,0 +1,19 @@
+"""Observability — end-to-end request tracing and the engine flight recorder.
+
+One :class:`~ddw_tpu.obs.trace.Tracer` per process component (gateway,
+replica engine, deploy controller, trainer) appends finished spans into a
+bounded drop-oldest ring; exporters render the union as a Perfetto-loadable
+Chrome trace (one track per replica/thread, flow events chaining each
+request's spans across the fleet) or NDJSON for programmatic assertion.
+See docs/observability.md.
+"""
+
+from ddw_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    chrome_trace,
+    gen_id,
+    load_events,
+    to_ndjson,
+)
+
+__all__ = ["Tracer", "chrome_trace", "gen_id", "load_events", "to_ndjson"]

@@ -86,9 +86,14 @@ class GangFailure(RuntimeError):
     disabled). Carries every attempt's exit codes and the most recent rank-0
     traceback, so the root cause survives N failed generations."""
 
-    def __init__(self, attempts: list[AttemptReport], max_restarts: int):
+    def __init__(self, attempts: list[AttemptReport], max_restarts: int,
+                 flight: list | None = None):
         self.attempts = list(attempts)
         self.max_restarts = max_restarts
+        # flight recorder: the supervising process's last trace events
+        # (attempt spans, restart instants) — same shape as the serving
+        # side's ReplicaFailed forensics["flight"]
+        self.flight = list(flight) if flight else []
         self.exit_codes = [a.exit_codes for a in attempts]
         self.rank0_traceback = next(
             (a.rank0_traceback for a in reversed(attempts)
@@ -133,7 +138,7 @@ class GangSupervisor:
     def __init__(self, launcher: Launcher, max_restarts: int = 2,
                  max_preemption_restarts: int = 8,
                  backoff_base_s: float = 1.0, backoff_max_s: float = 30.0,
-                 jitter: float = 0.25, tracker_run=None):
+                 jitter: float = 0.25, tracker_run=None, tracer=None):
         self.launcher = launcher
         self.max_restarts = max_restarts
         self.max_preemption_restarts = max_preemption_restarts
@@ -141,8 +146,14 @@ class GangSupervisor:
         self.backoff_max_s = backoff_max_s
         self.jitter = jitter
         self.tracker_run = tracker_run
+        self.tracer = tracer    # optional obs.Tracer: attempt spans + the
+        #                         ring's tail attached to GangFailure.flight
         self.attempts: list[AttemptReport] = []  # failed attempts, last run()
         self.generations = 0                     # gangs launched, last run()
+
+    def _fail(self) -> GangFailure:
+        flight = self.tracer.tail(64) if self.tracer is not None else None
+        return GangFailure(self.attempts, self.max_restarts, flight=flight)
 
     def run(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         if self.launcher.np == -1:
@@ -160,6 +171,11 @@ class GangSupervisor:
                         fn, args, kwargs,
                         extra_env={"DDW_RESTART_GEN": str(gen)})
                     self._harvest_elastic(gen)
+                    if self.tracer is not None:
+                        self.tracer.record_span(
+                            "gang_attempt", "supervisor", t0,
+                            time.monotonic(), tid="supervisor",
+                            args={"generation": gen, "outcome": "completed"})
                     self._report("completed", crash_restarts,
                                  preempt_restarts)
                     return value
@@ -173,16 +189,21 @@ class GangSupervisor:
                         elapsed_s=time.monotonic() - t0,
                         dead_rank=dead, exit_signal=sig,
                         recovery="whole-world"))
+                    if self.tracer is not None:
+                        self.tracer.record_span(
+                            "gang_attempt", "supervisor", t0,
+                            time.monotonic(), tid="supervisor",
+                            args={"generation": gen, "outcome": kind,
+                                  "dead_rank": dead,
+                                  "exit_codes": list(e.exit_codes)})
                     if kind == "preempted":
                         preempt_restarts += 1
                         if preempt_restarts > self.max_preemption_restarts:
-                            raise GangFailure(self.attempts,
-                                              self.max_restarts) from e
+                            raise self._fail() from e
                     else:
                         crash_restarts += 1
                         if crash_restarts > self.max_restarts:
-                            raise GangFailure(self.attempts,
-                                              self.max_restarts) from e
+                            raise self._fail() from e
                 self._backoff(crash_restarts + preempt_restarts)
                 gen += 1
         except GangFailure:

@@ -83,6 +83,7 @@ import jax
 import numpy as np
 
 from ddw_tpu.models.spec_decode import match_length
+from ddw_tpu.obs.trace import Tracer
 from ddw_tpu.runtime.faults import ServeCrash, maybe_serve_fault
 from ddw_tpu.serve.admission import (AdmissionController, DeadlineExceeded,
                                      Overloaded, ReplicaFailed)
@@ -164,6 +165,14 @@ class EngineCfg:
     # (greedy AND seeded sampling), so outputs stay bit-identical to
     # spec_k=0. Requires paged=True and ServingEngine(draft=...).
     spec_k: int = 0             # draft tokens proposed per tick; 0 = off
+    # end-to-end tracing (ddw_tpu.obs, docs/observability.md): True threads
+    # spans through admit/queue-wait, grouped prefill, every decode/spec
+    # tick, preemption, and block-pool pressure — one ring append per
+    # event, via the tick loop. False (the default) leaves the hot tick
+    # path entirely free of tracer calls (tests/test_trace.py pins it).
+    trace: bool = False
+    trace_capacity: int = 8192  # flight-recorder ring bound (drop-oldest;
+    #                             truncation counted, never silent)
 
 
 @dataclasses.dataclass
@@ -199,10 +208,12 @@ class _Times:
 class _LMRequest:
     __slots__ = ("prompt", "num_steps", "temperature", "keys", "deadline",
                  "future", "times", "tokens", "emitted", "on_token",
-                 "claimed", "lane")
+                 "claimed", "lane", "trace_id", "parent_span", "last_span",
+                 "ticks")
 
     def __init__(self, prompt, num_steps, temperature, keys, deadline, now,
-                 on_token=None, lane="interactive"):
+                 on_token=None, lane="interactive", trace_id=None,
+                 parent_span=None):
         self.prompt = prompt
         self.num_steps = num_steps
         self.temperature = temperature
@@ -219,6 +230,11 @@ class _LMRequest:
         self.lane = lane            # "interactive" | "batch" — decides the
         #                             requeue kind after a preemption and
         #                             the RequestRecord's lane label
+        self.trace_id = trace_id    # end-to-end trace id (None = untraced)
+        self.parent_span = parent_span  # the gateway's http span, when any
+        self.last_span = parent_span    # newest span in this request's
+        #                             chain — the next span's parent
+        self.ticks = 0              # decode ticks this request rode
 
     def effective_prompt(self) -> np.ndarray:
         """The prompt a (re-)prefill must run: the original tokens plus
@@ -283,6 +299,12 @@ class ServingEngine:
         self.cfg = cfg or EngineCfg()
         self.run = run
         self.metrics = EngineMetrics()
+        # tracing: the tracer object always exists (drains/summaries stay
+        # cheap no-ops on an empty ring) but the HOT PATH branches on the
+        # plain bool — trace=False must mean zero tracer calls per tick
+        self.tracer = Tracer(capacity=self.cfg.trace_capacity,
+                             process=f"replica{replica_id}")
+        self._tracing = bool(self.cfg.trace)
         self._ctrl = AdmissionController(
             self.cfg.queue_depth,
             per_kind={"lm_batch": self.cfg.batch_queue_depth,
@@ -577,6 +599,7 @@ class ServingEngine:
             "prefix_cache": (self.pool.prefix_summary()
                              if isinstance(self.pool, BlockPool)
                              else {"seq": 0, "keys": 0}),
+            "trace": (self.tracer.summary() if self._tracing else None),
         }
 
     def load(self) -> dict:
@@ -592,6 +615,15 @@ class ServingEngine:
                                 + self._ctrl.depth("image_batch")),
                 "service_ms": self._service_ms,
                 "prefill_token_ms": self._prefill_token_ms}
+
+    def trace_events(self, since: int = 0) -> dict:
+        """Drain the trace ring past ``since`` (a ``seq`` watermark) — the
+        ``GET /v1/trace`` feed. Same duck-type as
+        :meth:`~ddw_tpu.deploy.ProcessReplica.trace_events`, which relays
+        this over HTTP so one merged file shows the whole fleet."""
+        return {"replica": self.replica_id, "generation": self.generation,
+                "dropped": self.tracer.spans_dropped,
+                "events": self.tracer.drain(since)}
 
     def prefix_events(self, since: int = 0) -> dict:
         """Fleet prefix-index feed: the paged pool's register/evict event
@@ -656,6 +688,9 @@ class ServingEngine:
             self._sync_pool_stats()
         self._stopped = False
         self._draining.clear()
+        if self._tracing:
+            self.tracer.instant("restart", "serve", tid="engine",
+                                args={"generation": self.generation})
         return self.start()
 
     # -- graceful recycle (drain, then restart in place) ---------------------
@@ -755,7 +790,9 @@ class ServingEngine:
     def submit_generate(self, prompt, num_steps: int,
                         temperature: float = 0.0, rng=None,
                         timeout_s: float | None = None,
-                        on_token=None) -> concurrent.futures.Future:
+                        on_token=None, trace_id: str | None = None,
+                        parent_span: str | None = None
+                        ) -> concurrent.futures.Future:
         """Queue one LM continuation; returns a future resolving to a
         :class:`GenerateResult` (or raising ``Overloaded`` here /
         ``DeadlineExceeded`` on the future). ``prompt`` is 1-D ``[P]`` or
@@ -768,14 +805,22 @@ class ServingEngine:
         stream, never the request. The future supports ``cancel()`` while
         the request is still queued (dropped before any device work,
         counted as ``serve.cancelled``); once admitted to a slot it runs to
-        completion."""
+        completion.
+
+        ``trace_id`` / ``parent_span`` thread end-to-end tracing through
+        (the gateway's request id and its http span) — recorded on the
+        engine's spans and in the request's jsonl row when tracing is on,
+        ignored otherwise."""
         req = self._make_lm_request(prompt, num_steps, temperature, rng,
-                                    timeout_s, on_token, "interactive")
+                                    timeout_s, on_token, "interactive",
+                                    trace_id=trace_id,
+                                    parent_span=parent_span)
         self._offer("lm", req)
         return req.future
 
     def _make_lm_request(self, prompt, num_steps, temperature, rng,
-                         timeout_s, on_token, lane) -> "_LMRequest":
+                         timeout_s, on_token, lane, trace_id=None,
+                         parent_span=None) -> "_LMRequest":
         if self._lm is None:
             raise ValueError("engine was built without an LM model")
         prompt = np.asarray(prompt, np.int32)
@@ -837,7 +882,8 @@ class ServingEngine:
         timeout = self.cfg.default_timeout_s if timeout_s is None else timeout_s
         return _LMRequest(prompt, num_steps, float(temperature), keys,
                           now + timeout if timeout else None, now,
-                          on_token=on_token, lane=lane)
+                          on_token=on_token, lane=lane, trace_id=trace_id,
+                          parent_span=parent_span)
 
     def generate(self, prompt, num_steps: int, **kw) -> GenerateResult:
         """Synchronous :meth:`submit_generate`."""
@@ -1128,7 +1174,7 @@ class ServingEngine:
 
     def _forensics(self, exc: BaseException) -> dict:
         """The GangFailure-style record that rides every ReplicaFailed."""
-        return {
+        out = {
             "error": repr(exc),
             "traceback": traceback.format_exc(limit=12),
             "consecutive_errors": self._consecutive_errors,
@@ -1136,6 +1182,12 @@ class ServingEngine:
             "busy_slots": len(self._slot_req) if self.pool is not None else 0,
             "queue_depth": self._ctrl.depth(),
         }
+        if self._tracing:
+            # the flight recorder: the ring's tail rides the failure so
+            # "what was the engine doing" survives the engine
+            out["flight"] = self.tracer.tail(64)
+            out["spans_dropped"] = self.tracer.spans_dropped
+        return out
 
     def _enter_failed(self, kind: str, exc: BaseException) -> None:
         """Terminal transition (engine thread or supervisor thread):
@@ -1204,6 +1256,24 @@ class ServingEngine:
                           gen=self.generation,
                           should_abort=self._stop.is_set)
 
+    # -- tracing helpers (every call site guards on self._tracing) -----------
+    def _trace_req(self, req, name: str, t0: float, t1: float,
+                   **args) -> None:
+        """One span in a request's causal chain (queue → prefill → decode),
+        parented on the previous one; the request's deadline rides in the
+        args so an SLO miss is readable off the trace alone."""
+        if req.deadline is not None:
+            args["deadline_ms"] = round((req.deadline - t1) * 1e3, 1)
+        req.last_span = self.tracer.record_span(
+            name, "serve", t0, t1, trace=req.trace_id,
+            parent=req.last_span, tid="engine", args=args)
+
+    def _trace_preempt(self, req, row: int, reason: str) -> None:
+        self.tracer.instant(
+            "preempt", "serve", trace=req.trace_id, parent=req.last_span,
+            tid="engine", args={"row": row, "lane": req.lane,
+                                "emitted": req.emitted, "reason": reason})
+
     # LM: continuous batching ------------------------------------------------
     def _sync_pool_stats(self) -> None:
         """Mirror the paged pool's monotonic stats into the engine metrics
@@ -1217,8 +1287,19 @@ class ServingEngine:
             delta = val - seen if val >= seen else val   # reset() rebase
             if delta > 0:
                 self.metrics.count(key, delta)
+                if self._tracing and key in ("cow_copies",
+                                             "prefix_hit_tokens"):
+                    self.tracer.instant(f"pool.{key}", "pool", tid="pool",
+                                        args={"n": delta})
             self._pool_stats_seen[key] = val
         gauges = pool.gauges()
+        if self._tracing:
+            free = gauges.get("blocks_free", 0.0)
+            total = gauges.get("blocks_total", 0.0)
+            if total and free / total < 0.1:
+                self.tracer.instant(
+                    "pool.alloc_pressure", "pool", tid="pool",
+                    args={"free": int(free), "total": int(total)})
         gauges["batch_backlog"] = float(self._ctrl.depth("lm_batch")
                                         + self._ctrl.depth("image_batch"))
         self.metrics.set_gauges(gauges)
@@ -1240,6 +1321,8 @@ class ServingEngine:
         self._cur[row] = 0
         self._prev[row] = 0
         self._temps[row] = 0.0
+        if self._tracing:
+            self._trace_preempt(req, row, "interactive_pressure")
         self._ctrl.requeue_front("lm_batch", req)
         return True
 
@@ -1377,6 +1460,10 @@ class ServingEngine:
             req, eff, row, hit = item
             if req.emitted == 0:
                 req.times.admitted = now
+                if self._tracing:
+                    self._trace_req(req, "queue", req.times.submitted, now,
+                                    lane=req.lane, row=row,
+                                    prefix_hit_tokens=int(hit))
             bucket = bucket_len(len(eff) - hit, self._lm.cfg.max_len,
                                 self.cfg.min_bucket)
             groups.setdefault(bucket, []).append(item)
@@ -1400,6 +1487,12 @@ class ServingEngine:
             toks = pool.prefill(rows, prompts, true_lens, temps, keys)
             first = time.monotonic()
             self.metrics.count("prefills")
+            if self._tracing:
+                self.tracer.record_span(
+                    "prefill_group", "serve", t_pf, first, tid="engine",
+                    args={"bucket": bucket, "n": len(items),
+                          "suffix_lens": [int(t) for t in
+                                          true_lens[:len(items)]]})
             n_real = int(sum(int(t) for t in true_lens[:len(items)]))
             if n_real:
                 per = (first - t_pf) * 1e3 / n_real
@@ -1410,6 +1503,12 @@ class ServingEngine:
                 pool.register(row, eff)
                 pool.note_prefilled(row)
                 tok0 = int(toks[i])
+                if self._tracing:
+                    self._trace_req(req, "prefill", t_pf, first,
+                                    bucket=bucket,
+                                    suffix_len=int(eff.size - hit),
+                                    prefix_hit_tokens=int(hit),
+                                    resumed=req.emitted > 0)
                 if req.emitted == 0:
                     req.times.first_output = first
                     req.tokens.append(tok0)
@@ -1493,6 +1592,9 @@ class ServingEngine:
         now = time.monotonic()
         for req in admitted:
             req.times.admitted = now
+            if self._tracing:
+                self._trace_req(req, "queue", req.times.submitted, now,
+                                lane=req.lane)
             bucket = bucket_len(req.prompt.size, self._lm.cfg.max_len,
                                 self.cfg.min_bucket)
             groups.setdefault(bucket, []).append(req)
@@ -1510,14 +1612,23 @@ class ServingEngine:
                 temps[i] = req.temperature
                 if req.keys is not None:
                     keys[i] = req.keys[0]
+            t_pf = time.monotonic()
             cache_g, toks = self.pool.prefill(prompts, true_lens, temps,
                                               keys)
             toks = np.asarray(toks)               # fetch = the TTFT barrier
             first = time.monotonic()
             self.metrics.count("prefills")
+            if self._tracing:
+                self.tracer.record_span(
+                    "prefill_group", "serve", t_pf, first, tid="engine",
+                    args={"bucket": bucket, "n": len(reqs)})
             for i, req in enumerate(reqs):
                 slot = self.pool.acquire()
                 self.pool.insert(slot, cache_g, req.prompt.size, row=i)
+                if self._tracing:
+                    self._trace_req(req, "prefill", t_pf, first,
+                                    bucket=bucket,
+                                    suffix_len=int(req.prompt.size))
                 req.times.first_output = first
                 tok0 = int(toks[i])
                 req.tokens.append(tok0)
@@ -1539,6 +1650,7 @@ class ServingEngine:
         if not self._slot_req:
             return False
         self._fault("decode")
+        t_tick = time.monotonic() if self._tracing else 0.0
         k = self.cfg.steps_per_tick
         if isinstance(self.pool, BlockPool):
             # on-demand block allocation for this tick; exhaustion (only
@@ -1550,6 +1662,8 @@ class ServingEngine:
                 req = self._slot_req.pop(row)
                 self._cur[row] = 0
                 self._temps[row] = 0.0
+                if self._tracing:
+                    self._trace_preempt(req, row, "blocks")
                 self._ctrl.requeue_front(
                     "lm_batch" if req.lane == "batch" else "lm", req)
             if not self._slot_req:
@@ -1564,11 +1678,13 @@ class ServingEngine:
         toks = self.pool.decode(self._cur, self._temps, keys)  # [S, k]
         self.metrics.count("decode_ticks")
         finished = []
+        rows_live = len(self._slot_req)
         for slot, req in self._slot_req.items():
             take = min(k, req.num_steps - req.emitted)
             start = req.emitted
             req.tokens.extend(int(t) for t in toks[slot, :take])
             req.emitted += take
+            req.ticks += 1
             req.emit(start)
             if req.emitted >= req.num_steps:
                 finished.append(slot)
@@ -1579,6 +1695,12 @@ class ServingEngine:
             self._temps[slot] = 0.0
             self._cur[slot] = 0
             self._finish_lm(req)
+        if self._tracing:
+            self.tracer.record_span(
+                "tick", "serve", t_tick, time.monotonic(), tid="engine",
+                args={"rows": rows_live, "steps": k,
+                      "bucket": int(getattr(self.pool,
+                                            "last_decode_bucket", 0))})
         self._sync_pool_stats()
         return True
 
@@ -1629,6 +1751,7 @@ class ServingEngine:
         if not self._slot_req:
             return False
         self._fault("decode")
+        t_tick = time.monotonic() if self._tracing else 0.0
         k = self.cfg.spec_k
         pool, dpool = self.pool, self._draft_pool
         for row in self._spec_prepare(k + 1):
@@ -1636,6 +1759,8 @@ class ServingEngine:
             self._cur[row] = 0
             self._prev[row] = 0
             self._temps[row] = 0.0
+            if self._tracing:
+                self._trace_preempt(req, row, "blocks")
             self._ctrl.requeue_front(
                 "lm_batch" if req.lane == "batch" else "lm", req)
         if not self._slot_req:
@@ -1657,6 +1782,8 @@ class ServingEngine:
         picks = pool.spec_verify(vtoks, self._temps, vkeys)
         self.metrics.count("decode_ticks")
         finished = []
+        rows_live = len(self._slot_req)
+        t_proposed = t_accepted = t_bonus = 0
         for row, req in self._slot_req.items():
             m = match_length(drafts[row], picks[row])
             # m accepted drafts + the target's own pick for position m
@@ -1666,6 +1793,7 @@ class ServingEngine:
             start = req.emitted
             req.tokens.extend(int(t) for t in picks[row, :take])
             req.emitted += take
+            req.ticks += 1
             req.emit(start)
             # proposals past the request's horizon were never candidates —
             # they are clipped, not rejected (a matching self-draft keeps
@@ -1675,8 +1803,11 @@ class ServingEngine:
             self.metrics.count("spec_proposed", usable)
             self.metrics.count("spec_accepted", accepted)
             self.metrics.count("spec_rejected", usable - accepted)
+            t_proposed += usable
+            t_accepted += accepted
             if take == m + 1:
                 self.metrics.count("spec_bonus")
+                t_bonus += 1
             pool.commit_spec(row, take)
             dpool.commit_spec(row, take)
             if req.emitted >= req.num_steps:
@@ -1694,6 +1825,12 @@ class ServingEngine:
             self._cur[row] = 0
             self._prev[row] = 0
             self._finish_lm(req)
+        if self._tracing:
+            self.tracer.record_span(
+                "spec_tick", "serve", t_tick, time.monotonic(),
+                tid="engine",
+                args={"rows": rows_live, "proposed": t_proposed,
+                      "accepted": t_accepted, "bonus": t_bonus})
         self._sync_pool_stats()
         return True
 
@@ -1702,8 +1839,13 @@ class ServingEngine:
         t = req.times
         gen_s = max(t.done - t.first_output, 1e-9)
         rec = RequestRecord("lm", t.submitted, t.admitted, t.first_output,
-                            t.done, tokens=req.num_steps, lane=req.lane)
+                            t.done, tokens=req.num_steps, lane=req.lane,
+                            trace_id=req.trace_id or "")
         self.metrics.record(rec)
+        if self._tracing:
+            self._trace_req(req, "decode", t.first_output, t.done,
+                            tokens=req.num_steps, ticks=req.ticks,
+                            lane=req.lane)
         self._update_service(rec.total_ms)
         per_tok = rec.total_ms / max(req.num_steps, 1)
         self._per_token_ms = (0.8 * self._per_token_ms + 0.2 * per_tok

@@ -59,6 +59,9 @@ class RequestRecord:
     #                            (batch has a throughput SLO, not a latency
     #                            one; folding its queue time into the tails
     #                            would poison the interactive pin)
+    trace_id: str = ""         # joins this row to its spans in the obs
+    #                            trace (docs/observability.md, "joined
+    #                            schema"); "" when tracing was off
 
     @property
     def queue_ms(self) -> float:
@@ -76,7 +79,8 @@ class RequestRecord:
         return {"kind": self.kind, "lane": self.lane,
                 "queue_ms": round(self.queue_ms, 3),
                 "ttft_ms": round(self.ttft_ms, 3),
-                "total_ms": round(self.total_ms, 3), "tokens": self.tokens}
+                "total_ms": round(self.total_ms, 3), "tokens": self.tokens,
+                "trace_id": self.trace_id}
 
 
 class EngineMetrics:
@@ -122,11 +126,16 @@ class EngineMetrics:
         #                            a cold prefill elsewhere cheaper
         self.warm_replays = 0        # hot prefixes replayed into a recycled
         #                            replica before readmission
+        self.export_errors = 0     # serve_requests.jsonl write failures —
+        #                            the stream re-arms on the next record,
+        #                            so this counts rows at risk, not a
+        #                            permanently dead exporter
         self._gauges: dict[str, float] = {}  # live block-pool state, pushed
         #                            by the engine loop (free/used blocks...)
         self._first_admit: float | None = None
         self._last_done: float | None = None
         self._sink = None          # incremental serve_requests.jsonl stream
+        self._sink_path: str | None = None  # re-arm target after an error
 
     # -- recording (engine side) -------------------------------------------
     def record(self, rec: RequestRecord) -> None:
@@ -136,12 +145,26 @@ class EngineMetrics:
                 self._first_admit = rec.admitted
             if self._last_done is None or rec.done > self._last_done:
                 self._last_done = rec.done
+            if self._sink is None and self._sink_path is not None:
+                # a previous write failed: re-arm on this record (append
+                # mode — rows written before the error are kept) instead
+                # of silently dropping every row for the rest of the run
+                try:
+                    self._sink = open(self._sink_path, "a")
+                except OSError:
+                    self.export_errors += 1
             if self._sink is not None:
                 try:
                     self._sink.write(json.dumps(rec.to_dict()) + "\n")
                     self._sink.flush()
                 except OSError:
-                    self._sink = None   # disk went away; keep serving
+                    self.export_errors += 1
+                    try:
+                        self._sink.close()
+                    except OSError:
+                        pass
+                    self._sink = None   # disk hiccup; keep serving and
+                    #                     retry on the next record
 
     def count_overloaded(self) -> None:
         with self._lock:
@@ -172,9 +195,11 @@ class EngineMetrics:
             except OSError:
                 return              # non-writable ranks keep the path only
             self._sink = sink
+            self._sink_path = path  # re-arm target after a mid-run error
 
     def close_stream(self) -> None:
         with self._lock:
+            self._sink_path = None  # intentional close must not re-arm
             if self._sink is not None:
                 try:
                     self._sink.close()
@@ -227,6 +252,7 @@ class EngineMetrics:
                 "serve.routed_wait_override": float(
                     self.routed_wait_override),
                 "serve.warm_replays": float(self.warm_replays),
+                "serve.export_errors": float(self.export_errors),
             }
             looked = self.prefix_hit_blocks + self.prefix_miss_blocks
             out["serve.prefix_hit_rate"] = (
@@ -350,6 +376,8 @@ _COUNTER_HELP = (
      "projected wait made a cold prefill elsewhere cheaper."),
     ("warm_replays", "Hot prefixes replayed into a recycled replica before "
      "readmission."),
+    ("export_errors", "serve_requests.jsonl rows whose write failed (the "
+     "stream re-arms on the next record)."),
     ("tokens_out", "Generated LM tokens (both lanes)."),
     ("batch_items", "Batch-lane items completed."),
     ("batch_tokens_out", "Generated LM tokens on the batch lane."),
@@ -403,6 +431,7 @@ def merge_metrics(metrics_list) -> "EngineMetrics":
             out.routed_cache_hit += m.routed_cache_hit
             out.routed_wait_override += m.routed_wait_override
             out.warm_replays += m.warm_replays
+            out.export_errors += m.export_errors
             for name, val in m._gauges.items():
                 out._gauges[name] = out._gauges.get(name, 0.0) + val
             if m._first_admit is not None:
@@ -450,6 +479,7 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
             counters["routed_cache_hit"] += m.routed_cache_hit
             counters["routed_wait_override"] += m.routed_wait_override
             counters["warm_replays"] += m.warm_replays
+            counters["export_errors"] += m.export_errors
             for name, val in m._gauges.items():
                 pool_gauges[name] = pool_gauges.get(name, 0.0) + val
             if m._first_admit is not None:

@@ -23,6 +23,7 @@ seeded permutation, mirroring the reference's seed-42 split discipline.
 from __future__ import annotations
 
 import dataclasses
+import time
 import warnings
 
 import jax
@@ -60,8 +61,9 @@ class LMTrainer:
     """``fit(tokens)`` for :class:`ddw_tpu.models.lm.TransformerLM`."""
 
     def __init__(self, lm_cfg: LMCfg, train_cfg: TrainCfg,
-                 mesh=None, seq_devices: int = 1, run=None):
+                 mesh=None, seq_devices: int = 1, run=None, tracer=None):
         self.lm_cfg, self.train_cfg, self.run = lm_cfg, train_cfg, run
+        self.tracer = tracer   # optional obs.Tracer: chain-boundary spans
         self.pp = train_cfg.pipeline_stages > 0
         self.sharded = train_cfg.zero or train_cfg.fsdp
         if train_cfg.ema_decay and getattr(lm_cfg, "lora_rank", 0):
@@ -509,6 +511,8 @@ class LMTrainer:
                 batch_it = train_batches(epoch)
                 step_i = 0
                 for k_chain in plan:
+                    t_chain = (time.monotonic()
+                               if self.tracer is not None else 0.0)
                     inputs, targets = next(batch_it)
                     # Fault-injection hook (runtime.faults): free no-op
                     # unless DDW_FAULT targets this rank/step/generation.
@@ -548,6 +552,14 @@ class LMTrainer:
                         state, m = step(state, inputs, targets,
                                         jax.random.fold_in(step_rng,
                                                            host_step))
+                    if self.tracer is not None:
+                        # chain-boundary span: the host-side dispatch window
+                        # (device per-op time is tools/step_trace.py's job)
+                        self.tracer.record_span(
+                            "train_chain", "train", t_chain,
+                            time.monotonic(), tid="train",
+                            args={"epoch": epoch, "step": host_step,
+                                  "k": k_chain, "chained": bool(chained)})
                     host_step += k_chain
                     step_i += k_chain
                     tlosses.append(m["loss"])

@@ -121,6 +121,7 @@ class Trainer:
         model=None,
         initial=None,
         on_epoch=None,
+        tracer=None,
     ):
         """``model`` overrides the registry module (e.g. a
         :class:`ddw_tpu.train.transfer.TransferHead` trained on a cached-feature
@@ -146,6 +147,10 @@ class Trainer:
         self.model = model if model is not None else build_model(model_cfg)
         self._initial = initial
         self._on_epoch = on_epoch
+        # optional obs.Tracer: chain-boundary spans on the shared timeline
+        # (the per-op device story stays with tools/step_trace.py; this is
+        # the host-side control-flow record)
+        self.tracer = tracer
 
     # -- sizing ---------------------------------------------------------------
     @property
@@ -352,6 +357,8 @@ class Trainer:
                     losses, accs = [], []
                     step_i = 0
                     for k_chain in plan:
+                        t_chain = (time.monotonic()
+                                   if self.tracer is not None else 0.0)
                         # Fault-injection hook (runtime.faults): free no-op
                         # unless DDW_FAULT targets this rank/step/generation.
                         # Under chained dispatch it (like the preemption check
@@ -405,6 +412,16 @@ class Trainer:
                                                         step_rng)
                         losses.append(metrics["loss"])
                         accs.append(metrics["accuracy"])
+                        if self.tracer is not None:
+                            # one span per chain BOUNDARY (the host-side
+                            # dispatch window — device time for the chain
+                            # lives in the jax.profiler trace, not here)
+                            self.tracer.record_span(
+                                "train_chain", "train", t_chain,
+                                time.monotonic(), tid="train",
+                                args={"epoch": epoch, "step": step_i,
+                                      "k": k_chain,
+                                      "chained": bool(chained)})
                         step_i += k_chain
                     # ONE device reduction + fetch for the whole epoch
                     # (fetch_metrics_mean) instead of a device_get per scalar.

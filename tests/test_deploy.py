@@ -306,7 +306,10 @@ def test_job_ledger_durable_cancel_stays_cancelled(tmp_path):
 VOCAB = 64
 ENGINE_CFG = {"n_slots": 2, "queue_depth": 16, "kv_block_size": 8,
               "max_resident": 2, "min_bucket": 4,
-              "default_timeout_s": 600.0}
+              "default_timeout_s": 600.0,
+              # child engines trace (crosses as JSON via --engine-cfg) so the
+              # propagation + flight drills ride this one shared boot
+              "trace": True}
 
 
 def _mk_pkg(out, seed):
@@ -352,7 +355,7 @@ def fleet(pkgs, tmp_path_factory):
     reps = [ProcessReplica(dir_a, replica_id=i, engine_cfg=ENGINE_CFG,
                            warmup_lens=(4,), spawn_timeout_s=150.0)
             for i in range(2)]
-    gw = Gateway(reps, job_ledger_dir=ledger_dir,
+    gw = Gateway(reps, job_ledger_dir=ledger_dir, trace=True,
                  supervisor_kw={"poll_interval_s": 0.1,
                                 "backoff_base_s": 0.1,
                                 "backoff_max_s": 0.5, "jitter": 0.0})
@@ -387,6 +390,37 @@ def test_process_fleet_serves_bit_identical_and_reports_deploy_state(
     assert len(pids) == 2 and os.getpid() not in pids
 
 
+def test_trace_propagates_through_process_fleet_and_v1_trace_drain(fleet):
+    """End-to-end tracing across a REAL process boundary: the caller's
+    ``x-ddw-trace-id`` rides the HTTP hop into the child, the child
+    engine's spans relay back through ``/v1/trace``, and the merged drain
+    shows one causal chain — http → route on the gateway track, queue →
+    prefill → decode on the child replica's track, linked by parent
+    pointers across the hop (the route span's id crossed in
+    ``x-ddw-parent-span``)."""
+    gw, cli = fleet
+    r = cli.generate([1, 2, 3], 4, trace_id="proc-hop-drill")
+    assert r["trace_id"] == "proc-hop-drill"
+
+    d = cli.trace()
+    assert "gateway" in d["sources"]
+    chain = [e for e in d["events"] if e.get("trace") == "proc-hop-drill"]
+    by = {e["name"]: e for e in chain}
+    assert {"http", "route", "queue", "prefill", "decode"} <= set(by)
+    for child, parent in (("route", "http"), ("queue", "route"),
+                          ("prefill", "queue"), ("decode", "prefill")):
+        assert by[child]["parent"] == by[parent]["span"], (child, parent)
+    assert by["http"]["pid"] == "gateway"
+    assert by["queue"]["pid"].startswith("replica")   # the child's track
+    # Perfetto form straight off the live fleet, flow arrows included
+    ch = cli.trace(chrome=True)
+    phs = {e["ph"] for e in ch["traceEvents"]}
+    assert {"M", "X", "s"} <= phs
+    # /stats summary: per-source rings, fleet-total drop counter
+    tb = cli.stats()["trace"]
+    assert tb["spans_dropped"] == 0 and tb["replicas"]
+
+
 def test_kill_process_replica_supervisor_restarts_with_identity(fleet, pkgs):
     """SIGKILL a child: the exit-watcher surfaces a ReplicaFailed, the
     breaker trips, the supervisor restarts the process and the shadow
@@ -396,6 +430,12 @@ def test_kill_process_replica_supervisor_restarts_with_identity(fleet, pkgs):
     ref_a = pkgs["a"][2]
     victim = gw.replica_set.replicas[0]
     base_restarts = gw.replica_set.restarts[0]
+    # arm the parent-side flight cache: a traced request + one /v1/trace
+    # relay leave the child's last spans with the PARENT, which a SIGKILLed
+    # child (it can dump nothing itself) needs for flight.gen<N>.json
+    cli.generate([1, 2, 3], 4, trace_id="pre-kill-drill")
+    cli.trace()
+    gen_at_death = victim.generation
     victim._proc.kill()
     deadline = time.monotonic() + 90.0
     while time.monotonic() < deadline:
@@ -411,6 +451,15 @@ def test_kill_process_replica_supervisor_restarts_with_identity(fleet, pkgs):
     kinds = [(a.replica, a.kind, a.action) for a in gw.supervisor.attempts]
     assert (0, "killed", "restarted") in kinds
     assert gw.replica_set.replicas[0].generation >= 1
+    # the flight recorder outlived the SIGKILL: the parent dumped its
+    # cached copy of the child's ring next to the child's log
+    flight_path = os.path.join(victim._workdir,
+                               f"flight.gen{gen_at_death}.json")
+    with open(flight_path) as f:
+        flight = json.load(f)
+    assert flight["process"] == "replica0"
+    assert flight["source"] == "parent_cache"
+    assert any(e.get("trace") == "pre-kill-drill" for e in flight["events"])
 
 
 @pytest.mark.slow   # tier-1 budget (PR 12): the rollout machinery keeps

@@ -245,6 +245,13 @@ def test_batch_matches_direct_greedy_and_seeded(eng, pm):
     assert "reserve_occupancy_pct" in h
 
 
+@pytest.mark.slow   # tier-1 budget (PR 13): the preempt-by-recompute
+#                     bit-identity class keeps its tier-1 rep in
+#                     tests/test_spec_engine.py (spec preempt drill under
+#                     overcommit), lane-failure/resume keeps the chaos
+#                     batch-site drill and the reserve-watermark admission
+#                     math above; this tight-pool both-lanes soak rides
+#                     tier-2 next to the load_gen batch arm
 def test_interactive_preempts_batch_bit_identical(pm):
     """Under a pool too tight for both lanes, the interactive arrival
     evicts BATCH streams first (``serve.batch_preemptions``) and both

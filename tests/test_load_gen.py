@@ -132,6 +132,38 @@ def test_load_gen_fleet_prefix_arm_warm_across_recycle():
     assert d["identity_preserved"] is True
 
 
+def test_load_gen_trace_arm_covers_every_request_once(tmp_path):
+    """The tracing acceptance pin (tier-2; tests/test_trace.py and
+    tests/test_deploy.py carry the tier-1 representatives): one command
+    against a 2-process fleet produces a single Perfetto-loadable JSON in
+    which EVERY completed request is covered by exactly one trace, a
+    sampled request shows causally-linked spans across gateway routing,
+    child admit/prefill and >= 2 decode ticks, and nothing was dropped
+    from any ring. The arm's own DDW_BENCH_SMOKE assertions enforce the
+    linkage; this test pins the wire contract on top."""
+    trace_out = str(tmp_path / "fleet_trace.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/load_gen.py"),
+         "--trace", "--trace-out", trace_out],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])["trace"]
+    assert d["completed"] == 12
+    assert d["traced"] == 12 and d["unique"] == 12
+    assert d["covered_once"] == [1] * 12        # each request, exactly once
+    assert d["sampled"]["linked"] is True       # parent POINTERS, not names
+    assert d["sampled"]["ticks"] >= 2
+    assert d["sampled"]["replica"].startswith("replica")
+    assert d["dropped"] == 0
+    # the Perfetto file really landed and is self-describing
+    with open(trace_out) as f:
+        ch = json.load(f)
+    names = {e["args"]["name"] for e in ch["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert "gateway" in names
+    assert any(n.startswith("replica") for n in names)
+
+
 def test_load_gen_refuses_cpu_fallback():
     env = dict(_env(), DDW_REQUIRE_TPU="1")
     out = subprocess.run(

@@ -73,6 +73,16 @@ def test_serving_curve_smoke():
     assert sp["spec_off"]["spec_tokens_per_tick"] == 0.0
     for arm in ("spec_off", "spec_on"):
         assert sp[arm]["tokens_per_sec"] > 0
+    # trace A/B arm: trace-on vs trace-off at equal config, interleaved
+    # sweeps (the arm's own SMOKE asserts pin overhead <= 3% tok/s; the
+    # contract here is the rows stay coherent and tracing really was on
+    # in exactly one arm)
+    tr = d["trace_ab"]
+    assert tr["overhead_pct"] <= 3.0
+    assert tr["trace_on"]["tokens_per_sec"] > 0
+    assert tr["trace_off"]["tokens_per_sec"] > 0
+    assert tr["trace_on"]["trace_events"] > 0
+    assert tr["trace_off"]["trace_events"] == 0
 
 
 def test_serving_curve_refuses_cpu_fallback():

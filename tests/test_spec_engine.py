@@ -113,6 +113,12 @@ def test_greedy_spec_on_bit_identical_to_spec_off(eng3, pm):
     _pool_clean(eng3._draft_pool)
 
 
+@pytest.mark.slow   # tier-1 budget (PR 13): spec-vs-off identity keeps its
+#                     tier-1 rep in the greedy A/B above and in the preempt
+#                     drill below; seeded fold_in determinism keeps its
+#                     tier-1 reps in the HTTP seeded drill and the paged-kv
+#                     sampled-neighbors pin; this seeded spec variant rides
+#                     tier-2 with the spec_ab smoke
 def test_seeded_sampling_spec_on_bit_identical(eng3, pm):
     """Stochastic decode: per-request key schedules survive the graft —
     draft proposal j and verify position j both use step emitted+j's key,
@@ -128,6 +134,11 @@ def test_seeded_sampling_spec_on_bit_identical(eng3, pm):
         assert np.array_equal(f.result(timeout=120).tokens, refs[i]), i
 
 
+@pytest.mark.slow   # tier-1 budget (PR 13): the acceptance==1.0 self-draft
+#                     pin is asserted end-to-end by the tier-2 spec_ab smoke
+#                     (test_serving_curve), and the accepted+rejected==
+#                     proposed accounting identity stays tier-1 in the
+#                     greedy A/B above; this standalone sweep rides tier-2
 def test_self_draft_acceptance_is_exactly_one(pm):
     """Draft == target: greedy proposals always match the verifier's own
     picks, so acceptance is exactly 1.0 and every spec tick advances k+1
@@ -185,6 +196,11 @@ def test_spec_preempt_resume_bit_identical_exactly_once(pm, dm):
 
 # -- prefix cache neutrality -------------------------------------------------
 
+@pytest.mark.slow   # tier-1 budget (PR 13): prefix-hit/CoW counters keep
+#                     tier-1 reps in test_paged_kv + test_fleet_prefix, and
+#                     spec-mode neutrality keeps the greedy A/B + preempt
+#                     identity drills tier-1 above; this cross-mode counter
+#                     sweep rides tier-2
 def test_prefix_hit_and_cow_counters_identical_across_spec_modes(pm, dm):
     """Speculation must not perturb what the prefix cache sees: only
     fully-accepted prompt-content blocks are chain-hash-registered, so

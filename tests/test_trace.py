@@ -1,0 +1,335 @@
+"""End-to-end tracing + flight recorder (``ddw_tpu.obs``, PR 13).
+
+What this module pins, per docs/observability.md:
+
+- **ring accounting** — the drop-oldest ring never truncates silently:
+  every overwrite bumps ``spans_dropped``, and drain/tail/summary agree;
+- **exporters** — NDJSON and Chrome trace JSON round-trip through
+  :func:`load_events`/:func:`span_index` (numeric pids invert back to
+  process names, folded identity back to top level), and the merged
+  Chrome export carries metadata + flow rows Perfetto needs;
+- **trace_view golden merge** — ``tools/trace_view.py`` merges a gateway
+  drain + a flight dump + an overlapping replica drain against checked-in
+  fixtures (no engine, pure tier-1): dedup on (pid, seq, ts), phase
+  breakdown, slowest-first ordering;
+- **causal parentage** — on an in-process 2-replica fleet, one traced
+  request shows http → route → queue → prefill → decode linked by parent
+  POINTERS (not just name order), the ``serve_requests.jsonl`` row joins
+  on the same trace id, and ``/stats`` exposes the fleet ring summary;
+- **flight recorder** — a ``DDW_FAULT=serve:crash`` death attaches the
+  ring's tail to the ``ReplicaFailed`` forensics;
+- **trace=False is free** — a counting stub in place of ``eng.tracer``
+  observes ZERO attribute touches across admit/prefill/decode, pinning
+  that the hot tick path stays a plain-bool branch when tracing is off.
+
+The real cross-PROCESS propagation drill (``x-ddw-trace-id`` over HTTP
+through a ProcessReplica child + ``/v1/trace`` relay drain) rides the
+module-scoped process fleet in tests/test_deploy.py — same fixture, no
+second spawn. Tier-2 carries the load-generator arm
+(``tools/load_gen.py --trace``) and the trace-on/off overhead A/B
+(``tools/serving_curve.py`` ``trace_ab``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from ddw_tpu.gateway import Gateway, GatewayClient
+from ddw_tpu.obs.trace import (Tracer, chrome_trace, load_events, span_index,
+                               to_ndjson)
+from ddw_tpu.serve import EngineCfg, ReplicaFailed, ServingEngine
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "data", "trace_golden")
+
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    cfg = LMCfg(vocab_size=VOCAB, max_len=96, hidden=32, depth=2,
+                num_heads=2, mlp_dim=64, dropout=0.0, dtype="float32")
+    from ddw_tpu.models.lm import build_lm
+
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int32))["params"]
+    out = str(tmp_path_factory.mktemp("trace_pkg") / "pkg")
+    return load_lm_package(save_lm_package(out, cfg, params))
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+# -- the ring, pure (no jax) --------------------------------------------------
+
+def test_ring_drop_oldest_counts_every_overwrite():
+    """capacity-4 ring + 10 appends: the 6 oldest fall out, spans_dropped
+    says exactly 6, and summary/tail/drain agree on what is left."""
+    tr = Tracer(capacity=4, process="unit")
+    for i in range(10):
+        tr.instant(f"ev{i}", "test")
+    assert tr.spans_dropped == 6
+    names = [e["name"] for e in tr.drain()]
+    assert names == ["ev6", "ev7", "ev8", "ev9"]      # oldest dropped first
+    s = tr.summary()
+    assert s["events"] == 4 and s["dropped"] == 6
+    assert s["capacity"] == 4 and s["last_seq"] == 10
+    assert [e["name"] for e in tr.tail(2)] == ["ev8", "ev9"]
+    # incremental drain: seq watermark skips what a prior relay saw
+    assert [e["name"] for e in tr.drain(since=8)] == ["ev8", "ev9"]
+
+
+def test_span_ids_and_parentage_primitives():
+    """record_span/span-ctx: pre-allocated ids let a child parent on a
+    span recorded LATER (the gateway's http span pattern); monotonic t0/t1
+    land as epoch-anchored microseconds with non-negative durations."""
+    tr = Tracer(capacity=64, process="unit")
+    with tr.span("outer", "test", trace="t1", args={"k": 1}) as sp:
+        child = tr.record_span("inner", "test", 1.0, 2.0, trace="t1",
+                               parent=sp.id)
+        sp.set(routed=3)
+    evs = tr.drain()
+    inner = next(e for e in evs if e["name"] == "inner")
+    outer = next(e for e in evs if e["name"] == "outer")
+    assert inner["parent"] == outer["span"] == sp.id
+    assert inner["span"] == child and inner["dur"] == pytest.approx(1e6)
+    assert outer["args"] == {"k": 1, "routed": 3}
+    # ids are unique fleet-wide: a second tracer never collides
+    other = Tracer(capacity=4, process="unit2")
+    assert other._next_span_id() != tr._next_span_id()
+
+
+def test_exporters_round_trip(tmp_path):
+    """NDJSON and Chrome exports both reload through load_events with
+    identity intact; the Chrome export carries the M metadata and flow
+    rows (s/t arrows) that make Perfetto draw one causal chain."""
+    tr = Tracer(capacity=64, process="replica0")
+    a = tr.record_span("queue", "serve", 1.0, 1.1, trace="tr-1", tid="serve")
+    tr.record_span("decode", "serve", 1.1, 1.5, trace="tr-1", parent=a,
+                   tid="serve", args={"tokens": 4})
+    tr.instant("pool_low", "serve", args={"free_blocks": 1})
+    evs = tr.drain()
+
+    nd = tmp_path / "ring.ndjson"
+    nd.write_text(to_ndjson(evs))
+    back = load_events(str(nd))
+    assert [e["name"] for e in back] == ["queue", "decode", "pool_low"]
+    assert back[1]["parent"] == a and back[1]["trace"] == "tr-1"
+
+    ch = chrome_trace(evs)
+    phs = [e["ph"] for e in ch["traceEvents"]]
+    assert phs.count("M") == 3     # process_name + 2 thread tracks (serve/main)
+    assert "s" in phs and "f" in phs           # flow stitch for tr-1
+    cj = tmp_path / "ring.chrome.json"
+    cj.write_text(json.dumps(ch))
+    back2 = load_events(str(cj))               # inverse mapping
+    assert {e["name"] for e in back2} == {"queue", "decode", "pool_low"}
+    dec = next(e for e in back2 if e["name"] == "decode")
+    assert dec["pid"] == "replica0" and dec["tid"] == "serve"
+    assert dec["trace"] == "tr-1" and dec["parent"] == a
+    assert dec["args"] == {"tokens": 4}
+    # flight dump is a third loadable shape
+    fp = tmp_path / "flight.gen0.json"
+    assert tr.dump_flight(str(fp))
+    assert len(load_events(str(fp))) == 3
+
+
+def test_flight_dump_best_effort(tmp_path):
+    """A failed dump returns False instead of raising — the process is
+    already dying and the dump must not mask the real error."""
+    tr = Tracer(capacity=4, process="unit")
+    tr.instant("ev", "test")
+    assert tr.dump_flight(str(tmp_path / "nope" / "flight.json")) is False
+
+
+# -- trace_view golden merge (checked-in fixtures, no engine) -----------------
+
+def test_trace_view_merges_golden_fixtures():
+    """Gateway drain + flight dump + an overlapping replica drain merge to
+    one deduped timeline: 4 + 9 events with 3 duplicates collapsed on
+    (pid, seq, ts); per-request rows read slowest-first with the right
+    phase breakdown and replica attribution."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import trace_view
+    finally:
+        sys.path.pop(0)
+    paths = [os.path.join(GOLDEN, f) for f in
+             ("gateway.ndjson", "flight.gen0.json", "replica0.ndjson")]
+    events = trace_view.merge(paths)
+    assert len(events) == 13                   # 16 loaded - 3 dupes
+    assert [e["ts"] for e in events] == sorted(e["ts"] for e in events)
+
+    rows = trace_view.request_rows(events)
+    assert [r["trace"] for r in rows] == ["req-aa", "req-bb"]   # slowest 1st
+    aa, bb = rows
+    assert aa["total_ms"] == pytest.approx(50.0)
+    assert (aa["queue_ms"], aa["prefill_ms"], aa["decode_ms"]) == (2.0, 8.0,
+                                                                   30.0)
+    assert aa["replica"] == "replica0" and aa["spans"] == 5
+    assert aa["tokens"] == 8 and aa["ticks"] == 4
+    assert bb["total_ms"] == pytest.approx(20.0) and bb["spec_ms"] == 0.0
+
+    # parentage tree: one root (http), decode nested 4 deep under it
+    tree = trace_view._tree_lines(span_index(events)["req-aa"])
+    assert tree[0].lstrip().startswith("http")
+    assert any(ln.lstrip().startswith("decode") and ln.startswith(" " * 10)
+               for ln in tree)
+
+
+def test_trace_view_cli_writes_perfetto_json(tmp_path):
+    """The CLI end of the golden merge: --out writes Chrome JSON whose
+    every X/i row resolves to a named process track, --json emits the
+    machine summary on stdout."""
+    out = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"),
+         os.path.join(GOLDEN, "gateway.ndjson"),
+         os.path.join(GOLDEN, "flight.gen0.json"),
+         "--out", str(out), "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["events"] == 13
+    assert {row["trace"] for row in summary["requests"]} == {"req-aa",
+                                                             "req-bb"}
+    ch = json.loads(out.read_text())
+    meta = [e for e in ch["traceEvents"] if e["ph"] == "M"
+            and e["name"] == "process_name"]
+    named = {e["args"]["name"] for e in meta}
+    assert named == {"gateway", "replica0"}
+    pids = {e["pid"] for e in meta}
+    assert all(e["pid"] in pids for e in ch["traceEvents"]
+               if e["ph"] in ("X", "i"))
+
+
+# -- causal parentage on an in-process fleet ----------------------------------
+
+@pytest.fixture(scope="module")
+def traced_fleet(pm):
+    """2 in-process traced engines behind a traced gateway — the shared
+    boot for the parentage, jsonl-join, and /stats drills."""
+    engines = [ServingEngine(lm=pm, replica_id=i, cfg=EngineCfg(
+        n_slots=2, steps_per_tick=2, default_timeout_s=600.0,
+        trace=True, trace_capacity=512)) for i in range(2)]
+    gw = Gateway(engines, trace=True, supervise=False)
+    with gw:
+        cli = GatewayClient("127.0.0.1", gw.port, timeout_s=90.0)
+        assert cli.wait_ready(60.0)
+        yield gw, cli, engines
+
+
+def test_request_spans_link_gateway_to_decode(traced_fleet):
+    """One traced request reads as a single causal chain — each hop
+    parents on the previous hop's span ID (pointer equality, not name
+    order), the engine ticked >= 2 times, and the caller's trace id is
+    honored end to end."""
+    gw, cli, engines = traced_fleet
+    p = _prompts([8])[0]
+    r = cli.generate(p, 6, trace_id="parentage-drill")
+    assert r["trace_id"] == "parentage-drill"
+    assert len(r["tokens"]) == 6
+
+    dump = gw.trace_dump()
+    chain = span_index(dump["events"]).get("parentage-drill", [])
+    by = {e["name"]: e for e in chain}
+    assert {"http", "route", "queue", "prefill", "decode"} <= set(by)
+    for child, parent in (("route", "http"), ("queue", "route"),
+                          ("prefill", "queue"), ("decode", "prefill")):
+        assert by[child]["parent"] == by[parent]["span"], (child, parent)
+    assert by["http"]["pid"] == "gateway"
+    assert by["decode"]["pid"].startswith("replica")
+    assert by["decode"]["args"]["ticks"] >= 2
+    # deadline propagation: the engine's queue span records the budget
+    assert "deadline_ms" in by["queue"]["args"]
+    assert dump["dropped"] == 0 and "gateway" in dump["sources"]
+
+
+def test_trace_id_joins_serve_requests_jsonl(traced_fleet, tmp_path):
+    """The per-request jsonl row and the trace share one id — the join
+    documented in docs/observability.md."""
+    gw, cli, engines = traced_fleet
+    r = cli.generate(_prompts([6], seed=3)[0], 4, trace_id="join-drill")
+    assert r["trace_id"] == "join-drill"
+    recs = []
+    for eng in engines:
+        recs.extend(rec.to_dict() for rec in eng.metrics._records)
+    mine = [rec for rec in recs if rec.get("trace_id") == "join-drill"]
+    assert len(mine) == 1 and mine[0]["tokens"] == 4
+    # the traced engine ring has the same id
+    evs = gw.trace_dump()["events"]
+    assert any(e.get("trace") == "join-drill" and e["name"] == "decode"
+               for e in evs)
+
+
+def test_stats_exposes_fleet_ring_summary(traced_fleet):
+    """/stats carries the trace block: per-source ring summaries and the
+    fleet-total spans_dropped (truncation is never silent)."""
+    gw, cli, engines = traced_fleet
+    st = cli.stats()
+    tb = st.get("trace")
+    assert tb is not None
+    assert tb["spans_dropped"] == 0
+    assert tb["gateway"]["events"] > 0
+    assert tb["replicas"] and all("events" in s for s in tb["replicas"])
+
+
+# -- flight recorder + trace=False is free ------------------------------------
+
+def test_serve_crash_forensics_carry_flight(pm, monkeypatch):
+    """DDW_FAULT=serve:crash mid-decode: the ReplicaFailed future's
+    forensics attach the ring's tail — prefill/tick spans from the doomed
+    generation — plus the drop counter, same shape the process fleet
+    relays parent-side."""
+    monkeypatch.setenv("DDW_FAULT", "serve:crash:site=decode:after=1")
+    with ServingEngine(lm=pm, cfg=EngineCfg(
+            n_slots=1, steps_per_tick=2, default_timeout_s=600.0,
+            trace=True)) as eng:
+        fut = eng.submit_generate(_prompts([8], seed=5)[0], 8)
+        with pytest.raises(ReplicaFailed) as ei:
+            fut.result(timeout=120)
+    flight = ei.value.forensics.get("flight")
+    assert flight, "flight recorder missing from crash forensics"
+    names = {e["name"] for e in flight}
+    assert "prefill" in names            # what the engine was doing
+    assert ei.value.forensics["spans_dropped"] == 0
+    assert all(e["pid"] == "replica0" for e in flight)
+
+
+class _CountingTracer:
+    """Records every attribute touch — replaces eng.tracer to pin that
+    trace=False leaves the hot path free of tracer calls entirely."""
+
+    def __init__(self):
+        object.__setattr__(self, "touches", [])
+
+    def __getattr__(self, name):
+        self.touches.append(name)
+        return lambda *a, **k: None
+
+
+def test_trace_off_hot_path_never_touches_tracer(pm):
+    """trace=False compiles to a plain-bool branch: a full admit → prefill
+    → decode → complete lifecycle (plus a second request re-using the
+    warm path) makes ZERO tracer attribute touches."""
+    with ServingEngine(lm=pm, cfg=EngineCfg(
+            n_slots=2, steps_per_tick=2, default_timeout_s=600.0)) as eng:
+        stub = _CountingTracer()
+        eng.tracer = stub
+        assert eng._tracing is False
+        r1 = eng.submit_generate(_prompts([8], seed=7)[0], 6).result(120)
+        r2 = eng.submit_generate(_prompts([12], seed=8)[0], 4).result(120)
+        assert len(r1.tokens) == 6 and len(r2.tokens) == 4
+        assert stub.touches == []

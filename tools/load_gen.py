@@ -97,6 +97,7 @@ def closed_loop(url, prompts, steps, clients, retries=3, stream=False):
     lock = threading.Lock()
     lat, errors = [], {"429": 0, "503": 0, "504": 0, "other": 0}
     tokens = [0]
+    trace_ids = []          # ids echoed by a tracing gateway (else empty)
 
     def worker():
         cli = _client(url, retries)
@@ -111,6 +112,8 @@ def closed_loop(url, prompts, steps, clients, retries=3, stream=False):
                 with lock:
                     lat.append((time.perf_counter() - t0) * 1e3)
                     tokens[0] += len(r["tokens"])
+                    if r.get("trace_id"):
+                        trace_ids.append(r["trace_id"])
             except GatewayError as e:
                 key = str(e.status) if e.status in (429, 503, 504) else "other"
                 with lock:
@@ -123,11 +126,14 @@ def closed_loop(url, prompts, steps, clients, retries=3, stream=False):
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
-    return {"mode": "closed", "clients": clients, "offered": len(prompts),
-            "completed": len(lat), "errors": errors,
-            "goodput_rps": round(len(lat) / wall, 2),
-            "tokens_per_sec": round(tokens[0] / wall, 1),
-            "wall_s": round(wall, 2), **_percentiles(lat)}
+    row = {"mode": "closed", "clients": clients, "offered": len(prompts),
+           "completed": len(lat), "errors": errors,
+           "goodput_rps": round(len(lat) / wall, 2),
+           "tokens_per_sec": round(tokens[0] / wall, 1),
+           "wall_s": round(wall, 2), **_percentiles(lat)}
+    if trace_ids:
+        row["trace_ids"] = trace_ids
+    return row
 
 
 def open_loop(url, prompts, steps, rps, retries=0, timeout_s=None):
@@ -679,6 +685,112 @@ def deploy_arm(prompt_len=8, steps=8, n_slots=2, clients=3, hidden=32,
         return out
 
 
+def trace_arm(prompt_len=8, steps=8, requests=12, n_slots=2, clients=3,
+              hidden=32, depth=1, out_path=None):
+    """End-to-end tracing over the real 2-PROCESS fleet — the PR-13 pin.
+
+    Self-hosts two :class:`~ddw_tpu.deploy.ProcessReplica` children behind
+    a tracing parent gateway (``trace=True`` on the gateway AND in each
+    child's engine cfg), drives closed-loop clients, then drains
+    ``GET /v1/trace`` into ONE Perfetto-loadable Chrome JSON. The smoke
+    asserts the coverage contract: the merged trace covers every completed
+    request EXACTLY once (one ``http`` span per echoed trace id, no
+    duplicates, none missing), and a sampled request shows the causal
+    chain across the process boundary — gateway ``http`` -> ``route`` ->
+    child ``queue`` -> ``prefill`` -> ``decode`` with >= 2 decode ticks
+    behind it."""
+    import tempfile
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.deploy import ProcessReplica
+    from ddw_tpu.gateway import Gateway, GatewayClient
+    from ddw_tpu.obs.trace import span_index
+
+    with tempfile.TemporaryDirectory() as tmp:
+        _make_lm_pkg(tmp, "tracearm", hidden, depth, 2, 64, 64,
+                     dtype="float32")
+        pkg_dir = os.path.join(tmp, "tracearm")
+        cfgd = {"n_slots": n_slots, "min_bucket": prompt_len,
+                "trace": True, "default_timeout_s": 600.0}
+        gw = Gateway([ProcessReplica(pkg_dir, replica_id=i, engine_cfg=cfgd,
+                                     warmup_lens=(prompt_len,))
+                      for i in range(2)],
+                     grace_s=60.0, trace=True,
+                     supervisor_kw=dict(poll_interval_s=0.1,
+                                        backoff_base_s=0.1, jitter=0.0))
+        gw.start(warmup_prompt_lens=(prompt_len,))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 64, size=(prompt_len,)).astype(np.int32)
+                   for _ in range(requests)]
+        try:
+            row = closed_loop(gw.url, prompts, steps, clients, retries=4)
+            cli = GatewayClient("127.0.0.1", gw.port, max_retries=2)
+            merged = cli.trace()              # epoch-anchored event dump
+            chrome = cli.trace(chrome=True)   # the Perfetto file
+        finally:
+            gw.stop()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(chrome, f)
+    tids = row.pop("trace_ids", [])
+    idx = span_index(merged["events"])
+    http_per_trace = {
+        t: sum(1 for s in spans if s.get("name") == "http")
+        for t, spans in idx.items() if t}
+    sampled = {}
+    for t in tids:
+        spans = idx.get(t, [])
+        by_name = {s["name"]: s for s in spans}
+        if not {"http", "route", "queue", "prefill",
+                "decode"} <= set(by_name):
+            continue
+        # the causal chain, by parent POINTERS not just presence:
+        # decode -> prefill -> queue -> route -> http across the hop
+        linked = all(
+            by_name[child].get("parent") == by_name[parent].get("span")
+            for child, parent in (("decode", "prefill"),
+                                  ("prefill", "queue"),
+                                  ("queue", "route"),
+                                  ("route", "http")))
+        dec = by_name["decode"]
+        sampled = {"trace": t, "spans": sorted(by_name),
+                   "linked": linked, "replica": dec.get("pid"),
+                   "ticks": dec.get("args", {}).get("ticks")}
+        if linked:
+            break
+    out = {"row": row, "completed": row["completed"],
+           "traced": len(tids),
+           "unique": len(set(tids)),
+           "covered_once": sorted(http_per_trace.get(t, 0)
+                                  for t in tids),
+           "events": len(merged["events"]),
+           "dropped": merged.get("dropped", 0),
+           "sources": merged.get("sources"),
+           "sampled": sampled,
+           "perfetto_events": len(chrome.get("traceEvents", [])),
+           "out": out_path}
+    print(f"[load_gen] trace arm: {out['completed']} completed, "
+          f"{out['events']} events from {out['sources']}, sampled "
+          f"{sampled.get('trace')} ticks={sampled.get('ticks')}"
+          + (f" -> {out_path}" if out_path else ""),
+          file=sys.stderr, flush=True)
+    if SMOKE:
+        # coverage: every completed request in the merged trace EXACTLY
+        # once — one http span per echoed id, no misses, no double-counts
+        assert row["completed"] > 0, out
+        assert len(tids) == row["completed"], out
+        assert len(set(tids)) == len(tids), out
+        assert all(n == 1 for n in out["covered_once"]), out
+        # causality across the process boundary, >= 2 ticks behind decode
+        assert sampled and sampled["linked"], out
+        assert str(sampled["replica"]).startswith("replica"), out
+        assert (sampled["ticks"] or 0) >= 2, out
+        assert out["dropped"] == 0, out
+        assert out["perfetto_events"] > len(merged["events"]), out
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default=None, help="target a live gateway")
@@ -709,6 +821,15 @@ def main():
                          "shared-prefix workload with a mid-run recycle "
                          "(asserts cross-replica hits in /stats and a "
                          "warm-replayed rejoin)")
+    ap.add_argument("--trace", action="store_true",
+                    help="self-hosted tracing arm: 2-process fleet with "
+                         "tracing on; drains /v1/trace into one Perfetto "
+                         "JSON and asserts it covers every completed "
+                         "request exactly once, causally linked across "
+                         "the process boundary")
+    ap.add_argument("--trace-out", default="fleet_trace.json",
+                    help="where the --trace arm writes the merged "
+                         "Perfetto JSON")
     args = ap.parse_args()
 
     if args.url:
@@ -743,6 +864,9 @@ def main():
     elif args.fleet_prefix:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "fleet_prefix": fleet_prefix_arm()}
+    elif args.trace:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "trace": trace_arm(out_path=args.trace_out)}
     elif args.batch:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "batch": batch_arm()}

@@ -1,0 +1,163 @@
+"""Merge fleet trace files into one Perfetto JSON + per-request summary.
+
+The obs tracer (:mod:`ddw_tpu.obs.trace`) writes one ring per process —
+the gateway's, each replica engine's, the parent-side flight caches. Each
+drains to its own file (NDJSON from ``Tracer.drain``/``to_ndjson``, flight
+``flight.gen<N>.json`` dumps, or an already-exported Chrome JSON). This
+tool merges any mix of those into ONE Perfetto-loadable timeline — event
+timestamps are epoch-anchored microseconds, so files from different
+processes land on a shared clock without adjustment — and prints the
+per-request span-tree summary: queue / prefill / decode / spec breakdown
+per trace id, slowest first.
+
+Usage::
+
+    python tools/trace_view.py gw.ndjson flight.gen0.json --out merged.json
+    python tools/trace_view.py traces/*.ndjson --top 10
+
+``--out`` writes the merged Chrome trace (load it at https://ui.perfetto.dev
+or chrome://tracing); without it the tool only prints the summary. A live
+fleet needs no files at all: ``GET /v1/trace?format=chrome`` on the parent
+gateway serves the same merged JSON directly.
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import argparse
+import json
+
+from ddw_tpu.obs.trace import chrome_trace, load_events, span_index
+
+# phase buckets for the per-request breakdown: span name -> summary column
+_PHASES = ("queue", "prefill", "decode", "spec")
+_PHASE_OF = {"queue": "queue", "prefill": "prefill", "prefill_group": None,
+             "decode": "decode", "spec_tick": "spec", "tick": None}
+
+
+def merge(paths) -> list[dict]:
+    """Load every file and return one ts-sorted event list. Events carry
+    their source process in ``pid`` already; a duplicate (same pid + seq,
+    e.g. a flight dump overlapping a drain of the same ring) collapses to
+    one."""
+    events, seen = [], set()
+    for p in paths:
+        for ev in load_events(p):
+            key = (ev.get("pid"), ev.get("seq"), ev.get("ts"))
+            if ev.get("seq") is not None and key in seen:
+                continue
+            seen.add(key)
+            events.append(ev)
+    events.sort(key=lambda e: e.get("ts", 0))
+    return events
+
+
+def request_rows(events) -> list[dict]:
+    """One row per trace id: phase breakdown (ms), span count, the
+    replica that served it, end-to-end wall from the outermost span.
+    Slowest first."""
+    rows = []
+    for trace, spans in span_index(events).items():
+        if not trace:
+            continue        # untraced engine-level events (ticks, pool)
+        phases = {k: 0.0 for k in _PHASES}
+        replica = None
+        args = {}
+        for s in spans:
+            ph = _PHASE_OF.get(s.get("name"))
+            if ph is not None:
+                phases[ph] += s.get("dur", 0) / 1e3
+            if s.get("name") in ("queue", "prefill", "decode") \
+                    and str(s.get("pid", "")).startswith("replica"):
+                replica = s["pid"]
+            if s.get("name") == "decode":
+                args = s.get("args", {})
+        t0 = min(s["ts"] for s in spans)
+        t1 = max(s["ts"] + s.get("dur", 0) for s in spans)
+        rows.append({"trace": trace, "total_ms": round((t1 - t0) / 1e3, 2),
+                     "replica": replica, "spans": len(spans),
+                     "tokens": args.get("tokens"),
+                     "ticks": args.get("ticks"),
+                     **{f"{k}_ms": round(v, 2) for k, v in phases.items()}})
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def _tree_lines(spans) -> list[str]:
+    """Indent-by-parentage rendering of one request's spans."""
+    by_id = {s.get("span"): s for s in spans if s.get("span")}
+    kids = {}
+    roots = []
+    for s in sorted(spans, key=lambda s: s.get("ts", 0)):
+        parent = s.get("parent")
+        if parent in by_id:
+            kids.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    lines = []
+
+    def walk(s, depth):
+        dur = s.get("dur", 0) / 1e3
+        extra = ""
+        if s.get("args"):
+            keys = ("bucket", "rows", "tokens", "ticks", "replica",
+                    "projected_wait_ms", "prefix_tokens", "lane")
+            kv = {k: s["args"][k] for k in keys if k in s["args"]}
+            if kv:
+                extra = "  " + json.dumps(kv, separators=(",", ":"))
+        lines.append(f"  {'  ' * depth}{s['name']:<12s} "
+                     f"{dur:9.2f} ms  [{s.get('pid', '?')}]{extra}")
+        for c in kids.get(s.get("span"), []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return lines
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("files", nargs="+",
+                    help="trace files: NDJSON drains, flight.*.json dumps, "
+                         "or Chrome JSON exports — any mix")
+    ap.add_argument("--out", default=None,
+                    help="write the merged Perfetto/Chrome JSON here")
+    ap.add_argument("--top", type=int, default=5,
+                    help="span trees printed for the N slowest requests")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary rows as one JSON line instead "
+                         "of the human table")
+    args = ap.parse_args()
+
+    events = merge(args.files)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(chrome_trace(events), f)
+        print(f"[trace_view] {len(events)} events from {len(args.files)} "
+              f"file(s) -> {args.out}", file=sys.stderr, flush=True)
+
+    rows = request_rows(events)
+    if args.json:
+        print(json.dumps({"events": len(events), "requests": rows}))
+        return
+    if not rows:
+        print("no traced requests found", file=sys.stderr)
+        return
+    hdr = (f"{'trace':<18s} {'total':>9s} {'queue':>8s} {'prefill':>8s} "
+           f"{'decode':>8s} {'spec':>8s}  replica")
+    print(hdr)
+    for r in rows:
+        print(f"{r['trace']:<18s} {r['total_ms']:>7.1f}ms "
+              f"{r['queue_ms']:>6.1f}ms {r['prefill_ms']:>6.1f}ms "
+              f"{r['decode_ms']:>6.1f}ms {r['spec_ms']:>6.1f}ms  "
+              f"{r['replica'] or '-'}")
+    idx = span_index(events)
+    for r in rows[:args.top]:
+        print(f"\n{r['trace']} ({r['total_ms']:.1f} ms, "
+              f"{r['spans']} spans):")
+        for ln in _tree_lines(idx[r["trace"]]):
+            print(ln)
+
+
+if __name__ == "__main__":
+    main()
