@@ -149,6 +149,14 @@ class ProcessReplica:
         # nothing itself) still leaves flight.<gen>.json behind
         self._trace_cache: list[dict] = []
         self._trace_seq = 0
+        # telemetry relay: the child's sample seqs restart at 1 on respawn,
+        # so the parent re-sequences every relayed sample onto its OWN
+        # monotone counter (_telem_pseq survives respawns — the fleet
+        # store's watermark never goes backwards for this slot) and keeps
+        # a child-side watermark (_telem_child_seq, reset per spawn)
+        self._telem_cache: list[dict] = []
+        self._telem_pseq = 0
+        self._telem_child_seq = 0
 
     # -- spawn plumbing ------------------------------------------------------
     def _port_file(self) -> str:
@@ -197,6 +205,7 @@ class ProcessReplica:
         self._last_alive = time.monotonic()
         self._health_cache, self._health_at = None, 0.0
         self._trace_cache, self._trace_seq = [], 0   # new child, new ring
+        self._telem_child_seq = 0    # fresh child hub counts from 1 again
         self.log_path = os.path.join(self._workdir,
                                      f"child.gen{self.generation}.log")
         with open(self.log_path, "ab") as log:
@@ -557,6 +566,49 @@ class ProcessReplica:
                     self._trace_seq = max(e.get("seq", 0) for e in fresh)
                     del self._trace_cache[:-256]
         return d
+
+    # -- telemetry relay (the fleet's merged windowed series) -----------------
+    def telemetry_events(self, since: int = 0) -> dict:
+        """The child engine's telemetry ring, relayed in one HTTP fetch
+        (``GET /v1/telemetry?replica=0`` on the child's own gateway) —
+        the same duck-type as :meth:`~ddw_tpu.serve.ServingEngine.
+        telemetry_events`, so the parent gateway's fleet merge sees
+        process replicas like in-thread ones. Relayed samples are
+        RE-SEQUENCED onto the parent's own monotone counter: a respawned
+        child's hub restarts at seq 1, but this slot's feed never goes
+        backwards, so the fleet store's watermark protocol just works.
+        A dead or unreachable child answers the cached tail — its series
+        freezes mid-window instead of vanishing."""
+        cli = self._client
+        alive = (cli is not None and self._ready and self.failure is None
+                 and self._proc is not None and self._proc.poll() is None)
+        if alive:
+            try:
+                d = cli.telemetry(replica=0, since=self._telem_child_seq)
+            except Exception:
+                alive = False
+            else:
+                samples = d.get("samples", [])
+                with self._lock:
+                    if samples:
+                        self._telem_child_seq = max(
+                            self._telem_child_seq,
+                            int(d.get("last_seq", 0) or 0),
+                            max(s.get("seq", 0) for s in samples))
+                        for s in samples:
+                            self._telem_pseq += 1
+                            s = dict(s)
+                            s["seq"] = self._telem_pseq
+                            self._telem_cache.append(s)
+                        del self._telem_cache[:-4096]
+        with self._lock:
+            out = [s for s in self._telem_cache
+                   if s.get("seq", 0) > int(since)]
+            last = self._telem_pseq
+        return {"source": f"replica{self.replica_id}",
+                "replica": self.replica_id, "generation": self.generation,
+                "dropped": 0, "cached": not alive, "samples": out,
+                "last_seq": last if out else int(since)}
 
     def _dump_flight_cache(self) -> None:
         """Write the parent-side trace cache as ``flight.gen<N>.json`` in

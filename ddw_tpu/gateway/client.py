@@ -379,6 +379,16 @@ class GatewayClient:
         path = "/v1/trace?format=chrome" if chrome else "/v1/trace"
         return self._json_call("GET", path)
 
+    def telemetry(self, replica: int | None = None, since: int = 0) -> dict:
+        """Fetch ``GET /v1/telemetry``: the fleet's merged windowed
+        aggregates + SLO status (default), or one replica's incremental
+        sample feed (``replica=R, since=N`` — what a parent gateway's
+        fleet store polls on a child's gateway)."""
+        if replica is not None:
+            return self._json_call(
+                "GET", f"/v1/telemetry?replica={replica}&since={since}")
+        return self._json_call("GET", "/v1/telemetry")
+
     def metrics_text(self) -> str:
         status, _h, resp, conn = self._request("GET", "/metrics")
         try:

@@ -82,6 +82,8 @@ import numpy as np
 from ddw_tpu.gateway.lifecycle import ServerLifecycle
 from ddw_tpu.gateway.replica import ReplicaSet
 from ddw_tpu.gateway.supervisor import ReplicaSupervisor
+from ddw_tpu.obs.slo import SLOMonitor
+from ddw_tpu.obs.telemetry import FleetTelemetry, TelemetryHub
 from ddw_tpu.obs.trace import Tracer, gen_id
 from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded, Rejected,
                                      ReplicaFailed, Unavailable)
@@ -215,12 +217,22 @@ class _Handler(BaseHTTPRequestHandler):
                 dep = gw.deploy_view()
                 body["deploying"] = dep["deploying"]
                 body["fleet_generation"] = dep["fleet_generation"]
+                # SLO degradation detail: a burning objective flips the
+                # "degraded" flag and names itself, but the gateway stays
+                # ready (200) — load balancers weight it down, they don't
+                # eject it; only replica loss flips readiness itself
+                if gw.slo_monitor is not None:
+                    deg = gw.slo_monitor.degraded()
+                    if deg:
+                        body["degraded"] = True
+                        body["slo_degraded"] = deg
                 if ready:
                     self._send_json(200, body)
                 else:
                     self._send_json(503, body, {"Retry-After": "1"})
             elif self.path == "/metrics":
-                text = gw.replica_set.prometheus().encode()
+                text = (gw.replica_set.prometheus()
+                        + gw.slo_prometheus()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -247,7 +259,14 @@ class _Handler(BaseHTTPRequestHandler):
                 ts = gw.trace_summary()
                 if ts is not None:
                     out["trace"] = ts
+                tm = gw.telemetry_summary()
+                if tm is not None:
+                    out["telemetry"] = tm
+                if gw.slo_monitor is not None:
+                    out["slo"] = gw.slo_monitor.status()
                 self._send_json(200, out)
+            elif self.path.startswith("/v1/telemetry"):
+                self._telemetry_get(gw)
             elif self.path.startswith("/v1/trace"):
                 self._trace_get(gw)
             elif self.path.startswith("/v1/prefix/events"):
@@ -635,6 +654,42 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, dump)
 
+    def _telemetry_get(self, gw: "Gateway") -> None:
+        """``GET /v1/telemetry`` — the fleet's merged windowed time-series
+        plus SLO status. ``?replica=R&since=N`` is the single-replica relay
+        form a PARENT gateway polls on a child's own gateway (a process
+        replica's child serves its engine's feed here regardless of the
+        child gateway's own telemetry flag) — mirrors ``/v1/trace``."""
+        import urllib.parse
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        if "replica" in q:
+            try:
+                since = int(q.get("since", ["0"])[0])
+                r = int(q["replica"][0])
+            except ValueError:
+                self._send_json(400, {"error": "invalid_request",
+                                      "message": "since/replica must be "
+                                                 "ints"})
+                return
+            replicas = gw.replica_set.replicas
+            if not 0 <= r < len(replicas):
+                self._send_json(404, {"error": "not_found", "replica": r})
+                return
+            fetch = getattr(replicas[r], "telemetry_events", None)
+            if fetch is None:
+                self._send_json(200, {"source": f"replica{r}", "replica": r,
+                                      "dropped": 0, "samples": [],
+                                      "last_seq": since})
+                return
+            self._send_json(200, fetch(since))
+            return
+        if not gw._telemetry:
+            self._send_json(404, {"error": "not_found",
+                                  "message": "gateway telemetry disabled "
+                                             "(Gateway(telemetry=True))"})
+            return
+        self._send_json(200, gw.telemetry_view())
+
     def _prefix_events(self, gw: "Gateway") -> None:
         """``GET /v1/prefix/events?since=N&replica=R`` — one replica's
         prefix-cache register/evict delta feed (:meth:`~ddw_tpu.serve.
@@ -769,7 +824,11 @@ class Gateway:
                  grace_s: float | None = None, supervise: bool = True,
                  supervisor_kw: dict | None = None,
                  job_ledger_dir: str | None = None, trace: bool = False,
-                 trace_capacity: int = 8192):
+                 trace_capacity: int = 8192, telemetry: bool = False,
+                 telemetry_interval_s: float = 0.25,
+                 telemetry_capacity: int = 4096, slos=None,
+                 slo_kw: dict | None = None,
+                 degradation_dir: str | None = None):
         self.replica_set = (replicas if isinstance(replicas, ReplicaSet)
                             else ReplicaSet(replicas))
         # end-to-end tracing (docs/observability.md): the gateway mints
@@ -794,6 +853,26 @@ class Gateway:
         # is DURABLE: specs and completed rows persist to disk and a
         # restarted gateway resumes every unfinished job mid-flight.
         self.jobs = JobLedger(ledger_dir=job_ledger_dir)
+        # live telemetry plane (docs/observability.md): the gateway samples
+        # its own routing state into a hub, polls every replica's feed, and
+        # merges the fleet into aligned windows the SLO monitor evaluates
+        self._telemetry = bool(telemetry)
+        self.telem = (TelemetryHub(capacity=telemetry_capacity,
+                                   interval_s=telemetry_interval_s,
+                                   source="gateway")
+                      if telemetry else None)
+        self.fleet_telemetry = FleetTelemetry() if telemetry else None
+        self.replica_set.telemetry = self.fleet_telemetry
+        self._telemetry_interval_s = float(telemetry_interval_s)
+        self._telemetry_thread: threading.Thread | None = None
+        self._telemetry_stop = threading.Event()
+        self.slo_monitor: SLOMonitor | None = None
+        if telemetry:
+            self.telem.add_collector(self._telemetry_collector)
+            if slos:
+                self.slo_monitor = SLOMonitor(
+                    slos, tracer=self.tracer, dump_dir=degradation_dir,
+                    flight_fn=self._flight_tail, **(slo_kw or {}))
         # rolling-deploy state, surfaced through /stats and /readyz; the
         # DeployController thread (start_deploy) mutates it under the lock
         self._deploy_lock = threading.Lock()
@@ -829,6 +908,13 @@ class Gateway:
                                                 **kw).start()
         self.jobs.resume(self.replica_set)   # durable ledger: restart any
         #                                      job a dead gateway left behind
+        if self._telemetry and self._telemetry_thread is None:
+            self.telem.start()
+            self._telemetry_stop.clear()
+            self._telemetry_thread = threading.Thread(
+                target=self._telemetry_loop, name="ddw-gateway-telemetry",
+                daemon=True)
+            self._telemetry_thread.start()
         self.lifecycle.mark_ready()
         return self
 
@@ -934,6 +1020,151 @@ class Gateway:
                 "reserve_occupancy_pct": round(occupancy, 2),
                 **self.jobs.summary()}
 
+    # -- telemetry / SLOs -----------------------------------------------------
+    def _telemetry_collector(self) -> dict:
+        """The gateway's own signals, sampled each hub tick: connection and
+        in-flight load, routing state (outstanding work, open breakers, the
+        best replica's projected wait — what :meth:`ReplicaSet._score`
+        ranks on), and the retry/failover counters."""
+        rs = self.replica_set
+        out = {
+            "gateway.connections": ("gauge", float(
+                self._httpd.active_connections if self._httpd else 0)),
+            "gateway.inflight": ("gauge", float(self.lifecycle.inflight)),
+            "gateway.outstanding": ("gauge", float(sum(rs._outstanding))),
+            "gateway.breaker_open": ("gauge", float(sum(
+                1 for b in rs.breakers if b.state != "closed"))),
+            "gateway.retried_429": ("counter", float(rs.retried_429)),
+            "gateway.replica_failures": ("counter",
+                                         float(rs.replica_failures)),
+            "gateway.failed_over": ("counter", float(rs.failed_over)),
+        }
+        try:
+            scored = rs._scored()
+            if scored:
+                out["gateway.projected_wait_ms"] = ("gauge",
+                                                    float(scored[0][0]))
+        except Exception:
+            pass    # a mid-death replica must not kill the sampler tick
+        return out
+
+    def _flight_tail(self) -> list:
+        """Last trace events across the fleet — the flight-recorder tail a
+        degradation dump freezes alongside the offending windows."""
+        if self.tracer is None:
+            return []
+        try:
+            return self.trace_dump()["events"][-64:]
+        except Exception:
+            return []
+
+    def _telemetry_loop(self) -> None:
+        while not self._telemetry_stop.wait(self._telemetry_interval_s):
+            try:
+                self._telemetry_tick()
+            except Exception:
+                pass    # the plane observes the fleet; it never takes it down
+
+    def _telemetry_tick(self) -> None:
+        """One merge cycle: ingest the gateway hub's fresh samples plus
+        every replica's drained feed into the fleet store (seq-watermarked,
+        like the trace relay), hand the fresh samples to the SLO monitor's
+        budget accounting, then evaluate burn rates over the merged
+        windows."""
+        fleet = self.fleet_telemetry
+        fresh_by_src = {}
+        fresh = fleet.ingest("gateway",
+                             self.telem.drain(fleet.watermark("gateway")))
+        if fresh:
+            fresh_by_src["gateway"] = fresh
+        for i, eng in enumerate(self.replica_set.replicas):
+            fetch = getattr(eng, "telemetry_events", None)
+            if fetch is None:
+                continue
+            src = f"replica{i}"
+            try:
+                feed = fetch(fleet.watermark(src))
+            except Exception:
+                continue    # a mid-death replica freezes, it doesn't break
+            fresh = fleet.ingest(src, feed)
+            if fresh:
+                fresh_by_src[src] = fresh
+        if self.slo_monitor is not None:
+            for src, samples in fresh_by_src.items():
+                self.slo_monitor.ingest(src, samples)
+            self.slo_monitor.evaluate(fleet.feeds())
+
+    def telemetry_summary(self) -> dict | None:
+        """The /stats telemetry block: gateway-hub summary + per-source
+        sample counts in the fleet store, with fleet-total
+        ``samples_dropped`` (truncation is never silent). None when this
+        gateway does not sample."""
+        if not self._telemetry:
+            return None
+        out = {"gateway": self.telem.summary(),
+               "sources": self.fleet_telemetry.sources(),
+               "samples_dropped": self.telem.samples_dropped}
+        for i, eng in enumerate(self.replica_set.replicas):
+            fetch = getattr(eng, "health", None)
+            if fetch is None:
+                continue
+            try:
+                s = fetch().get("telemetry")
+            except Exception:
+                continue
+            if s:
+                out["samples_dropped"] += int(s.get("dropped", 0) or 0)
+        return out
+
+    def telemetry_view(self) -> dict:
+        """The bare ``GET /v1/telemetry`` body: the fleet's merged windowed
+        aggregates plus the SLO monitor's status (objectives, states, error
+        budgets, recent transitions)."""
+        merged = self.fleet_telemetry.merged()
+        out = {"now": merged["now"], "sources": merged["sources"],
+               "windows": merged["windows"]}
+        if self.slo_monitor is not None:
+            out["slo"] = self.slo_monitor.status()
+        return out
+
+    def slo_prometheus(self) -> str:
+        """Prometheus exposition lines appended to ``/metrics`` when the
+        telemetry plane is on: per-objective alert state (0 ok / 1 warning
+        / 2 page), budget consumption, attainment, and the hub's dropped-
+        sample counter. Empty string otherwise — the base exposition is
+        untouched for a telemetry-off gateway."""
+        if not self._telemetry:
+            return ""
+        lines = [
+            "# HELP ddw_telemetry_samples_dropped Telemetry samples lost "
+            "to ring overflow (gateway hub).",
+            "# TYPE ddw_telemetry_samples_dropped counter",
+            f"ddw_telemetry_samples_dropped {self.telem.samples_dropped}",
+        ]
+        if self.slo_monitor is not None:
+            from ddw_tpu.obs.slo import _LEVEL
+            st = self.slo_monitor.status()
+            lines += ["# HELP ddw_slo_state Alert FSM level per objective "
+                      "(0 ok, 1 warning, 2 page).",
+                      "# TYPE ddw_slo_state gauge"]
+            for name, obj in st["objectives"].items():
+                lines.append(f'ddw_slo_state{{objective="{name}"}} '
+                             f'{_LEVEL[obj["state"]]}')
+            lines += ["# HELP ddw_slo_budget_consumed_pct Error budget "
+                      "consumed per objective (cumulative, percent).",
+                      "# TYPE ddw_slo_budget_consumed_pct gauge"]
+            for name, obj in st["objectives"].items():
+                lines.append(
+                    f'ddw_slo_budget_consumed_pct{{objective="{name}"}} '
+                    f'{obj["budget"]["budget_consumed_pct"]}')
+            lines += ["# HELP ddw_slo_attainment Fraction of events that "
+                      "met the objective (cumulative).",
+                      "# TYPE ddw_slo_attainment gauge"]
+            for name, obj in st["objectives"].items():
+                lines.append(f'ddw_slo_attainment{{objective="{name}"}} '
+                             f'{obj["budget"]["attainment"]}')
+        return "\n".join(lines) + "\n"
+
     @property
     def port(self) -> int:
         if self._httpd is None:
@@ -960,6 +1191,12 @@ class Gateway:
             if self.supervisor is not None:
                 self.supervisor.stop()   # no resurrections during teardown
                 self.supervisor = None
+            if self._telemetry_thread is not None:
+                self._telemetry_stop.set()
+                self._telemetry_thread.join(timeout=5.0)
+                self._telemetry_thread = None
+            if self.telem is not None:
+                self.telem.stop()
             self.replica_set.stop()   # stragglers' futures fail loudly here
             if self._httpd is not None:
                 self._httpd.shutdown()

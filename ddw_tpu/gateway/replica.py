@@ -230,6 +230,10 @@ class ReplicaSet:
         #                             when tracing: routing decisions become
         #                             spans (projected wait, prefix credit,
         #                             chosen replica, spill/failover)
+        self.telemetry = None       # obs.FleetTelemetry installed by the
+        #                             Gateway when sampling: replace() must
+        #                             clear the dead engine's cached series
+        #                             so merged windows don't mix epochs
         for i, eng in enumerate(self.replicas):
             self._wire(i, eng)
 
@@ -270,6 +274,8 @@ class ReplicaSet:
         self._wire(i, eng)
         self.replicas[i] = eng
         self.prefix_index.drop_replica(i)   # a fresh engine holds nothing
+        if self.telemetry is not None:
+            self.telemetry.drop_replica(f"replica{i}")
 
     def note_restart(self, i: int) -> None:
         with self._lock:

@@ -1,13 +1,30 @@
-"""Observability — end-to-end request tracing and the engine flight recorder.
+"""Observability — tracing, the flight recorder, and the live telemetry plane.
 
 One :class:`~ddw_tpu.obs.trace.Tracer` per process component (gateway,
 replica engine, deploy controller, trainer) appends finished spans into a
 bounded drop-oldest ring; exporters render the union as a Perfetto-loadable
 Chrome trace (one track per replica/thread, flow events chaining each
 request's spans across the fleet) or NDJSON for programmatic assertion.
-See docs/observability.md.
+
+The same components each hold a :class:`~ddw_tpu.obs.telemetry.
+TelemetryHub` sampling counters/gauges/latency observations into windowed
+time series (fleet-merged by the gateway), which the
+:class:`~ddw_tpu.obs.slo.SLOMonitor` evaluates into error budgets,
+burn-rate alerts, and degradation forensics dumps. See
+docs/observability.md.
 """
 
+from ddw_tpu.obs.slo import (  # noqa: F401
+    SLOMonitor,
+    SLOObjective,
+)
+from ddw_tpu.obs.telemetry import (  # noqa: F401
+    FleetTelemetry,
+    TelemetryHub,
+    merge_feeds,
+    signal_registry,
+    tee_run,
+)
 from ddw_tpu.obs.trace import (  # noqa: F401
     Tracer,
     chrome_trace,
@@ -16,4 +33,6 @@ from ddw_tpu.obs.trace import (  # noqa: F401
     to_ndjson,
 )
 
-__all__ = ["Tracer", "chrome_trace", "gen_id", "load_events", "to_ndjson"]
+__all__ = ["Tracer", "chrome_trace", "gen_id", "load_events", "to_ndjson",
+           "TelemetryHub", "FleetTelemetry", "merge_feeds",
+           "signal_registry", "tee_run", "SLOMonitor", "SLOObjective"]

@@ -83,6 +83,7 @@ import jax
 import numpy as np
 
 from ddw_tpu.models.spec_decode import match_length
+from ddw_tpu.obs.telemetry import TelemetryHub
 from ddw_tpu.obs.trace import Tracer
 from ddw_tpu.runtime.faults import ServeCrash, maybe_serve_fault
 from ddw_tpu.serve.admission import (AdmissionController, DeadlineExceeded,
@@ -173,6 +174,16 @@ class EngineCfg:
     trace: bool = False
     trace_capacity: int = 8192  # flight-recorder ring bound (drop-oldest;
     #                             truncation counted, never silent)
+    # live telemetry (ddw_tpu.obs.telemetry, docs/observability.md): True
+    # runs a sampler thread snapshotting counters/gauges/pool occupancy on
+    # ``telemetry_interval_s`` cadence and records one latency observation
+    # per completed interactive request — the windowed time-series feed
+    # SLO burn-rate alerting reads. False (the default) leaves the hot
+    # path entirely free of hub calls (tests/test_telemetry.py pins it).
+    telemetry: bool = False
+    telemetry_interval_s: float = 0.25
+    telemetry_capacity: int = 4096  # sample ring bound (drop-oldest;
+    #                                 truncation counted, never silent)
 
 
 @dataclasses.dataclass
@@ -305,6 +316,16 @@ class ServingEngine:
         self.tracer = Tracer(capacity=self.cfg.trace_capacity,
                              process=f"replica{replica_id}")
         self._tracing = bool(self.cfg.trace)
+        # telemetry mirrors the tracing guard discipline: the hub exists
+        # only when enabled, and the hot path branches on the plain bool —
+        # telemetry=False must mean zero hub attribute touches per request
+        self.telem = (TelemetryHub(capacity=self.cfg.telemetry_capacity,
+                                   interval_s=self.cfg.telemetry_interval_s,
+                                   source=f"replica{replica_id}")
+                      if self.cfg.telemetry else None)
+        self._telemetry = bool(self.cfg.telemetry)
+        if self.telem is not None:
+            self.telem.add_collector(self._telemetry_collector)
         self._ctrl = AdmissionController(
             self.cfg.queue_depth,
             per_kind={"lm_batch": self.cfg.batch_queue_depth,
@@ -524,6 +545,8 @@ class ServingEngine:
             self._thread = threading.Thread(target=self._loop,
                                             name="ddw-serve", daemon=True)
             self._thread.start()
+            if self.telem is not None:
+                self.telem.start()
             if self.run is not None and self._monitor_interval_s > 0:
                 from ddw_tpu.utils.sysmon import SystemMonitor
 
@@ -540,6 +563,8 @@ class ServingEngine:
             self._thread = None
         self._stopped = True
         self._fail_pending(RuntimeError("engine stopped"))
+        if self.telem is not None:
+            self.telem.stop()
         if self._monitor is not None:
             self._monitor.stop()
             self._monitor = None
@@ -600,6 +625,7 @@ class ServingEngine:
                              if isinstance(self.pool, BlockPool)
                              else {"seq": 0, "keys": 0}),
             "trace": (self.tracer.summary() if self._tracing else None),
+            "telemetry": (self.telem.summary() if self._telemetry else None),
         }
 
     def load(self) -> dict:
@@ -624,6 +650,45 @@ class ServingEngine:
         return {"replica": self.replica_id, "generation": self.generation,
                 "dropped": self.tracer.spans_dropped,
                 "events": self.tracer.drain(since)}
+
+    def telemetry_events(self, since: int = 0) -> dict:
+        """Drain the telemetry ring past ``since`` (a ``seq`` watermark) —
+        the ``GET /v1/telemetry`` feed the gateway's
+        :class:`~ddw_tpu.obs.telemetry.FleetTelemetry` merges into aligned
+        windows. Same duck-type as
+        :meth:`~ddw_tpu.deploy.ProcessReplica.telemetry_events`. A
+        telemetry-off engine reports an empty, never-advancing feed."""
+        if self.telem is None:
+            return {"source": f"replica{self.replica_id}",
+                    "replica": self.replica_id,
+                    "generation": self.generation,
+                    "dropped": 0, "samples": [], "last_seq": int(since)}
+        d = self.telem.drain(since)
+        d["replica"] = self.replica_id
+        d["generation"] = self.generation
+        return d
+
+    def _telemetry_collector(self) -> dict:
+        """One sampler tick's worth of engine state for the hub: every
+        accumulated counter from :class:`EngineMetrics` (cheap reads — no
+        percentile math), the admission-lane depths, and the pool/backlog
+        gauges ``_sync_pool_stats`` mirrors. Runs on the hub's sampler
+        thread; everything read here is either lock-guarded or a plain
+        attribute read that tolerates a torn sample."""
+        out = {f"serve.{k}": ("counter", v)
+               for k, v in self.metrics.counters_view().items()}
+        out["serve.queue_depth"] = ("gauge", float(self._ctrl.depth()))
+        out["serve.interactive_depth"] = (
+            "gauge", float(self._ctrl.depth("lm") + self._ctrl.depth("image")))
+        out["serve.batch_depth"] = (
+            "gauge", float(self._ctrl.depth("lm_batch")
+                           + self._ctrl.depth("image_batch")))
+        out["serve.busy_slots"] = (
+            "gauge", float(len(self._slot_req) if self.pool is not None
+                           else 0))
+        for name, v in self.metrics.gauges_view().items():
+            out[f"serve.{name}"] = ("gauge", float(v))
+        return out
 
     def prefix_events(self, since: int = 0) -> dict:
         """Fleet prefix-index feed: the paged pool's register/evict event
@@ -1842,6 +1907,10 @@ class ServingEngine:
                             t.done, tokens=req.num_steps, lane=req.lane,
                             trace_id=req.trace_id or "")
         self.metrics.record(rec)
+        if self._telemetry and req.lane != "batch":
+            self.telem.observe("serve.ttft_ms", rec.ttft_ms)
+            self.telem.observe("serve.queue_ms", rec.queue_ms)
+            self.telem.observe("serve.total_ms", rec.total_ms)
         if self._tracing:
             self._trace_req(req, "decode", t.first_output, t.done,
                             tokens=req.num_steps, ticks=req.ticks,
@@ -1920,6 +1989,10 @@ class ServingEngine:
                                 req.times.admitted, done, done,
                                 lane=req.lane)
             self.metrics.record(rec)
+            if self._telemetry and req.lane != "batch":
+                self.telem.observe("serve.ttft_ms", rec.ttft_ms)
+                self.telem.observe("serve.queue_ms", rec.queue_ms)
+                self.telem.observe("serve.total_ms", rec.total_ms)
             self._update_service(rec.total_ms)
             idx = int(np.argmax(logits[i]))
             req.future.set_result(PredictResult(

@@ -28,6 +28,7 @@ Two consumers beyond the tracker share this module:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import os
@@ -35,6 +36,8 @@ import threading
 import time
 
 import numpy as np
+
+from ddw_tpu.obs.telemetry import bucket_index, bucket_quantile
 
 QUANTILES = (50, 95, 99)
 
@@ -84,12 +87,37 @@ class RequestRecord:
 
 
 class EngineMetrics:
-    """Thread-safe accumulator: the engine loop records, any thread reads."""
+    """Thread-safe accumulator: the engine loop records, any thread reads.
 
-    def __init__(self, clock=time.monotonic):
+    Memory is BOUNDED for week-long runs: raw :class:`RequestRecord` rows
+    live in a drop-oldest deque of ``max_records`` (evictions counted in
+    ``records_evicted``, never silent), while totals (``completed``,
+    ``tokens_out``, ...) and the fixed-ladder latency histograms
+    accumulate exactly forever. While nothing has been evicted,
+    percentiles interpolate over the raw rows (``np.percentile``); after
+    the first eviction they fall back to histogram interpolation over the
+    whole run's ladder counts — tests pin the fallback p99 within one
+    ladder bucket of the exact value.
+    """
+
+    def __init__(self, clock=time.monotonic, max_records: int | None = 4096):
         self._clock = clock
         self._lock = threading.Lock()
-        self._records: list[RequestRecord] = []
+        self._records: collections.deque = collections.deque(
+            maxlen=max_records)
+        self.completed = 0         # requests finished (both lanes)
+        self.tokens_out = 0        # generated LM tokens (both lanes)
+        self.batch_items = 0       # batch-lane requests finished
+        self.batch_tokens_out = 0  # generated LM tokens, batch lane
+        self.records_evicted = 0   # raw rows dropped from the bounded deque
+        # accumulated fixed-ladder histograms, one per latency family per
+        # lane class — exact count/sum/max ride along so means and the
+        # Prometheus exposition stay exact under eviction
+        self._hists = {(name, lane): [0] * (len(LATENCY_BUCKETS_MS) + 1)
+                       for name in _HISTOGRAMS
+                       for lane in ("interactive", "batch")}
+        self._hist_sum = {k: 0.0 for k in self._hists}
+        self._hist_max = {k: 0.0 for k in self._hists}
         self.shed_overloaded = 0
         self.shed_deadline = 0
         self.cancelled = 0         # dropped via Future.cancel() while queued
@@ -140,7 +168,23 @@ class EngineMetrics:
     # -- recording (engine side) -------------------------------------------
     def record(self, rec: RequestRecord) -> None:
         with self._lock:
+            if (self._records.maxlen is not None
+                    and len(self._records) == self._records.maxlen):
+                self.records_evicted += 1
             self._records.append(rec)
+            self.completed += 1
+            self.tokens_out += rec.tokens
+            lane = "batch" if rec.lane == "batch" else "interactive"
+            if lane == "batch":
+                self.batch_items += 1
+                self.batch_tokens_out += rec.tokens
+            for name in _HISTOGRAMS:
+                v = getattr(rec, name)
+                key = (name, lane)
+                self._hists[key][bucket_index(v, LATENCY_BUCKETS_MS)] += 1
+                self._hist_sum[key] += v
+                if v > self._hist_max[key]:
+                    self._hist_max[key] = v
             if self._first_admit is None or rec.admitted < self._first_admit:
                 self._first_admit = rec.admitted
             if self._last_done is None or rec.done > self._last_done:
@@ -227,8 +271,10 @@ class EngineMetrics:
         latency keys appear only once at least one request completed."""
         with self._lock:
             recs = list(self._records)
+            evicted = self.records_evicted
             out: dict[str, float] = {
-                "serve.completed": float(len(recs)),
+                "serve.completed": float(self.completed),
+                "serve.records_evicted": float(evicted),
                 "serve.shed_overloaded": float(self.shed_overloaded),
                 "serve.shed_deadline": float(self.shed_deadline),
                 "serve.cancelled": float(self.cancelled),
@@ -281,35 +327,68 @@ class EngineMetrics:
                     1.0 - self._gauges.get("reserve_free_blocks", 0.0)
                     / reserve)
             first, last = self._first_admit, self._last_done
-        if not recs:
+            tokens = self.tokens_out
+            n_done = self.completed
+            ihists = {name: (list(self._hists[(name, "interactive")]),
+                             self._hist_sum[(name, "interactive")])
+                      for name in _HISTOGRAMS}
+        if not n_done:
             return out
         # latency tails are an INTERACTIVE SLO (see RequestRecord.lane)
         irecs = [r for r in recs if r.lane != "batch"]
         brecs = [r for r in recs if r.lane == "batch"]
-        if irecs:
-            for name, vals in (("queue_ms", [r.queue_ms for r in irecs]),
-                               ("ttft_ms", [r.ttft_ms for r in irecs]),
-                               ("total_ms", [r.total_ms for r in irecs])):
-                arr = np.asarray(vals, np.float64)
+        if evicted == 0:
+            if irecs:
+                for name, vals in (("queue_ms", [r.queue_ms for r in irecs]),
+                                   ("ttft_ms", [r.ttft_ms for r in irecs]),
+                                   ("total_ms", [r.total_ms for r in irecs])):
+                    arr = np.asarray(vals, np.float64)
+                    for q in QUANTILES:
+                        out[f"serve.{name}_p{q}"] = float(
+                            np.percentile(arr, q))
+                    out[f"serve.{name}_mean"] = float(arr.mean())
+        else:
+            # rows were evicted: the retained deque is only a suffix of
+            # the run — tails come from the accumulated whole-run ladder
+            # (p99 pinned within one bucket of exact), means stay exact
+            for name, (counts, total_sum) in ihists.items():
+                total = sum(counts)
+                if not total:
+                    continue
                 for q in QUANTILES:
-                    out[f"serve.{name}_p{q}"] = float(np.percentile(arr, q))
-                out[f"serve.{name}_mean"] = float(arr.mean())
-        tokens = sum(r.tokens for r in recs)
+                    out[f"serve.{name}_p{q}"] = bucket_quantile(
+                        counts, q, LATENCY_BUCKETS_MS)
+                out[f"serve.{name}_mean"] = total_sum / total
         out["serve.tokens_out"] = float(tokens)
         if tokens and last is not None and last > first:
             # aggregate decode throughput over the busy window — the number
             # the continuous-batching claim is judged by. Includes BOTH
             # lanes: device tokens are device tokens.
             out["serve.tokens_per_sec"] = tokens / (last - first)
-        out["serve.batch_items"] = float(len(brecs))
+        out["serve.batch_items"] = float(self.batch_items)
+        if self.batch_items:
+            out["serve.batch_tokens_out"] = float(self.batch_tokens_out)
         if brecs:
-            out["serve.batch_tokens_out"] = float(
-                sum(r.tokens for r in brecs))
+            # items/sec spans the RETAINED batch rows' busy window — under
+            # eviction this is the recent window, which is what a live
+            # throughput SLO wants anyway
             b0 = min(r.admitted for r in brecs)
             b1 = max(r.done for r in brecs)
             if b1 > b0:
                 out["serve.batch_items_per_sec"] = len(brecs) / (b1 - b0)
         return out
+
+    def counters_view(self) -> dict[str, float]:
+        """Every counter in one cheap read (no percentile math) — the
+        telemetry sampler's feed; names match :data:`_COUNTER_HELP`."""
+        with self._lock:
+            return {name: float(getattr(self, name))
+                    for name, _ in _COUNTER_HELP}
+
+    def gauges_view(self) -> dict[str, float]:
+        """The live gauge set as last pushed by the engine loop."""
+        with self._lock:
+            return dict(self._gauges)
 
     def records(self) -> list[RequestRecord]:
         with self._lock:
@@ -381,21 +460,27 @@ _COUNTER_HELP = (
     ("tokens_out", "Generated LM tokens (both lanes)."),
     ("batch_items", "Batch-lane items completed."),
     ("batch_tokens_out", "Generated LM tokens on the batch lane."),
+    ("records_evicted", "Raw request rows dropped from the bounded record "
+     "deque (totals and histograms keep accumulating exactly)."),
 )
 _HISTOGRAMS = ("queue_ms", "ttft_ms", "total_ms")
 
 
-def _histogram_lines(name: str, values: np.ndarray) -> list[str]:
+def _histogram_lines(name: str, counts: list[int],
+                     total_sum: float) -> list[str]:
+    """Exposition lines from ACCUMULATED ladder counts (+Inf last) —
+    exact over the whole run regardless of raw-record eviction."""
     full = f"ddw_serve_{name}"
     lines = [f"# HELP {full} Request {name.replace('_', ' ')} histogram.",
              f"# TYPE {full} histogram"]
     acc = 0
-    for le in LATENCY_BUCKETS_MS:
-        acc = int((values <= le).sum())
+    for i, le in enumerate(LATENCY_BUCKETS_MS):
+        acc += counts[i]
         lines.append(f'{full}_bucket{{le="{le:g}"}} {acc}')
-    lines.append(f'{full}_bucket{{le="+Inf"}} {values.size}')
-    lines.append(f"{full}_sum {float(values.sum()):g}")
-    lines.append(f"{full}_count {values.size}")
+    total = acc + counts[-1]
+    lines.append(f'{full}_bucket{{le="+Inf"}} {total}')
+    lines.append(f"{full}_sum {total_sum:g}")
+    lines.append(f"{full}_count {total}")
     return lines
 
 
@@ -405,33 +490,20 @@ def merge_metrics(metrics_list) -> "EngineMetrics":
     the gateway ``/metrics`` endpoint are built on. Counters sum, records
     concatenate (so percentiles are over the union), and the busy window
     spans first admission to last completion across every replica."""
-    out = EngineMetrics()
+    out = EngineMetrics(max_records=None)   # a merged VIEW never evicts —
+    #                                         per-replica deques already bound
     for m in metrics_list:
         with m._lock:
             out._records.extend(m._records)
-            out.shed_overloaded += m.shed_overloaded
-            out.shed_deadline += m.shed_deadline
-            out.cancelled += m.cancelled
-            out.decode_ticks += m.decode_ticks
-            out.prefills += m.prefills
-            out.image_batches += m.image_batches
-            out.loop_errors += m.loop_errors
-            out.failovers += m.failovers
-            out.preemptions += m.preemptions
-            out.batch_preemptions += m.batch_preemptions
-            out.cow_copies += m.cow_copies
-            out.prefix_hit_blocks += m.prefix_hit_blocks
-            out.prefix_miss_blocks += m.prefix_miss_blocks
-            out.prefix_hit_tokens += m.prefix_hit_tokens
-            out.decode_rows_skipped += m.decode_rows_skipped
-            out.spec_proposed += m.spec_proposed
-            out.spec_accepted += m.spec_accepted
-            out.spec_rejected += m.spec_rejected
-            out.spec_bonus += m.spec_bonus
-            out.routed_cache_hit += m.routed_cache_hit
-            out.routed_wait_override += m.routed_wait_override
-            out.warm_replays += m.warm_replays
-            out.export_errors += m.export_errors
+            for name, _ in _COUNTER_HELP:
+                setattr(out, name, getattr(out, name) + getattr(m, name))
+            for key, counts in m._hists.items():
+                dst = out._hists[key]
+                for i, c in enumerate(counts):
+                    dst[i] += c
+                out._hist_sum[key] += m._hist_sum[key]
+                if m._hist_max[key] > out._hist_max[key]:
+                    out._hist_max[key] = m._hist_max[key]
             for name, val in m._gauges.items():
                 out._gauges[name] = out._gauges.get(name, 0.0) + val
             if m._first_admit is not None:
@@ -452,34 +524,21 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
     add fleet-level gauges like outstanding requests per replica."""
     recs: list[RequestRecord] = []
     counters = {name: 0.0 for name, _ in _COUNTER_HELP}
+    hists = {name: [0] * (len(LATENCY_BUCKETS_MS) + 1)
+             for name in _HISTOGRAMS}
+    hist_sums = {name: 0.0 for name in _HISTOGRAMS}
     pool_gauges: dict[str, float] = {}
     first, last = None, None
     for m in metrics_list:
         with m._lock:
             recs.extend(m._records)
-            counters["shed_overloaded"] += m.shed_overloaded
-            counters["shed_deadline"] += m.shed_deadline
-            counters["cancelled"] += m.cancelled
-            counters["prefills"] += m.prefills
-            counters["decode_ticks"] += m.decode_ticks
-            counters["image_batches"] += m.image_batches
-            counters["loop_errors"] += m.loop_errors
-            counters["failovers"] += m.failovers
-            counters["preemptions"] += m.preemptions
-            counters["batch_preemptions"] += m.batch_preemptions
-            counters["cow_copies"] += m.cow_copies
-            counters["prefix_hit_blocks"] += m.prefix_hit_blocks
-            counters["prefix_miss_blocks"] += m.prefix_miss_blocks
-            counters["prefix_hit_tokens"] += m.prefix_hit_tokens
-            counters["decode_rows_skipped"] += m.decode_rows_skipped
-            counters["spec_proposed"] += m.spec_proposed
-            counters["spec_accepted"] += m.spec_accepted
-            counters["spec_rejected"] += m.spec_rejected
-            counters["spec_bonus"] += m.spec_bonus
-            counters["routed_cache_hit"] += m.routed_cache_hit
-            counters["routed_wait_override"] += m.routed_wait_override
-            counters["warm_replays"] += m.warm_replays
-            counters["export_errors"] += m.export_errors
+            for name, _ in _COUNTER_HELP:
+                counters[name] += float(getattr(m, name))
+            for (name, lane), counts in m._hists.items():
+                dst = hists[name]
+                for i, c in enumerate(counts):
+                    dst[i] += c
+                hist_sums[name] += m._hist_sum[(name, lane)]
             for name, val in m._gauges.items():
                 pool_gauges[name] = pool_gauges.get(name, 0.0) + val
             if m._first_admit is not None:
@@ -488,12 +547,8 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
             if m._last_done is not None:
                 last = (m._last_done if last is None
                         else max(last, m._last_done))
-    counters["completed"] = float(len(recs))
-    tokens = sum(r.tokens for r in recs)
-    counters["tokens_out"] = float(tokens)
+    tokens = counters["tokens_out"]
     brecs = [r for r in recs if r.lane == "batch"]
-    counters["batch_items"] = float(len(brecs))
-    counters["batch_tokens_out"] = float(sum(r.tokens for r in brecs))
 
     lines: list[str] = []
     for name, help_ in _COUNTER_HELP:
@@ -547,6 +602,5 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
             lines.append(f"# TYPE {base} gauge")
         lines.append(f"{key} {val:g}")
     for name in _HISTOGRAMS:
-        vals = np.asarray([getattr(r, name) for r in recs], np.float64)
-        lines += _histogram_lines(name, vals)
+        lines += _histogram_lines(name, hists[name], hist_sums[name])
     return "\n".join(lines) + "\n"

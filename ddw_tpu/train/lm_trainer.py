@@ -499,6 +499,11 @@ class LMTrainer:
         history: list[dict[str, float]] = []
         step_rng = jax.random.PRNGKey(cfg.seed + 1)
         epochs_run = start_epoch
+        # telemetry plane: a Run wrapped by obs.telemetry.tee_run exposes
+        # its hub — chain dispatch and checkpoint-write latencies become
+        # windowed dist series beside the serving fleet's (same ladder)
+        hub = (getattr(self.run, "telemetry_hub", None)
+               if self.run is not None else None)
         resumed = ckpt is not None and resume and start_epoch > 0
         state = sched.initial_state(state, start_epoch, resumed)
         # Host-side step counter: folding the device counter into the rng
@@ -512,7 +517,8 @@ class LMTrainer:
                 step_i = 0
                 for k_chain in plan:
                     t_chain = (time.monotonic()
-                               if self.tracer is not None else 0.0)
+                               if self.tracer is not None or hub is not None
+                               else 0.0)
                     inputs, targets = next(batch_it)
                     # Fault-injection hook (runtime.faults): free no-op
                     # unless DDW_FAULT targets this rank/step/generation.
@@ -560,6 +566,9 @@ class LMTrainer:
                             time.monotonic(), tid="train",
                             args={"epoch": epoch, "step": host_step,
                                   "k": k_chain, "chained": bool(chained)})
+                    if hub is not None:
+                        hub.observe("train.chain_ms",
+                                    (time.monotonic() - t_chain) * 1e3)
                     host_step += k_chain
                     step_i += k_chain
                     tlosses.append(m["loss"])
@@ -606,10 +615,14 @@ class LMTrainer:
                 # continuation (ScheduleSuite holds the ordering rules).
                 state, stop = sched.epoch_end(state, row["val_loss"], epoch)
                 if ckpt and (epoch + 1) % cfg.checkpoint_every_epochs == 0:
+                    t_ck = time.monotonic()
                     ckpt.save(state, host_step,
                               metadata={"epoch": epoch,
                                         "callbacks": sched.state_dicts(),
                                         "metrics": row})
+                    if hub is not None:
+                        hub.observe("train.ckpt_write_ms",
+                                    (time.monotonic() - t_ck) * 1e3)
                 if best is not None:
                     best.maybe_save(state, host_step, row, {"epoch": epoch})
                 if stop:

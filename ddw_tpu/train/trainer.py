@@ -341,6 +341,11 @@ class Trainer:
             val_loss = val_acc = float("nan")
             epochs_run = 0
             tracing = False
+            # telemetry plane: a Run wrapped by obs.telemetry.tee_run
+            # exposes its hub — chain dispatch and checkpoint-write
+            # latencies become windowed dist series (docs/observability.md)
+            hub = (getattr(self.run, "telemetry_hub", None)
+                   if self.run is not None else None)
             resumed = ckpt is not None and resume and start_epoch > 0
             state = sched.initial_state(state, start_epoch, resumed)
             try:
@@ -358,7 +363,8 @@ class Trainer:
                     step_i = 0
                     for k_chain in plan:
                         t_chain = (time.monotonic()
-                                   if self.tracer is not None else 0.0)
+                                   if self.tracer is not None
+                                   or hub is not None else 0.0)
                         # Fault-injection hook (runtime.faults): free no-op
                         # unless DDW_FAULT targets this rank/step/generation.
                         # Under chained dispatch it (like the preemption check
@@ -422,6 +428,9 @@ class Trainer:
                                 args={"epoch": epoch, "step": step_i,
                                       "k": k_chain,
                                       "chained": bool(chained)})
+                        if hub is not None:
+                            hub.observe("train.chain_ms",
+                                        (time.monotonic() - t_chain) * 1e3)
                         step_i += k_chain
                     # ONE device reduction + fetch for the whole epoch
                     # (fetch_metrics_mean) instead of a device_get per scalar.
@@ -480,10 +489,14 @@ class Trainer:
                     # so the saved counters (and any plateau LR cut) are exactly the
                     # state the next epoch starts from — resume = continuation.
                     if ckpt and ((epoch + 1) % cfg.checkpoint_every_epochs == 0):
+                        t_ck = time.monotonic()
                         ckpt.save(state, int(jax.device_get(state.step)),
                                   metadata={"epoch": epoch, "val_loss": val_loss,
                                             "val_accuracy": val_acc,
                                             "callbacks": sched.state_dicts()})
+                        if hub is not None:
+                            hub.observe("train.ckpt_write_ms",
+                                        (time.monotonic() - t_ck) * 1e3)
                     if best is not None:
                         best.maybe_save(state, int(jax.device_get(state.step)),
                                         row, {"epoch": epoch})

@@ -142,7 +142,13 @@ def test_fit_pipeline_interleaved():
     assert res.history[0]["pp_bubble_fraction"] == pytest.approx(5 / 9)
 
 
-@pytest.mark.parametrize("flag", ["zero", "fsdp"])
+@pytest.mark.parametrize("flag", [
+    "zero",
+    # tier-1 budget (PR 14): the zero arm keeps the trainer
+    # sharded-resume rep; FSDP sharding/equivalence math keeps its own
+    # tier-1 reps in test_fsdp + test_zero
+    pytest.param("fsdp", marks=pytest.mark.slow),
+])
 def test_fit_sharded_state_and_resume(flag, tmp_path):
     """train.zero / train.fsdp through LMTrainer: the GSPMD sharded-state
     step, per-process sharded checkpoints, exact resume continuation — the
@@ -223,6 +229,11 @@ def test_cosine_schedule_and_early_stop():
     assert res.history[-1]["lr"] < res.history[0]["lr"] or res.epochs_run == 1
 
 
+@pytest.mark.slow  # tier-1 budget (PR 14): trainer→tracker wiring keeps
+#                    its tier-1 rep in test_trainer's
+#                    test_tracker_records_run (vision twin), and the Run
+#                    metric surface is additionally pinned by
+#                    test_telemetry's tee_run delegation test
 def test_tracker_logging(tmp_path):
     from ddw_tpu.tracking.tracker import Tracker
 

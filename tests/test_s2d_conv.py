@@ -79,7 +79,13 @@ def test_module_matches_nn_conv_param_format():
                                rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("name", ["mobilenet_v2", "resnet18"])
+@pytest.mark.parametrize("name", [
+    "mobilenet_v2",
+    # tier-1 budget (PR 14): second model of the same flag-preservation
+    # invariant — the mobilenet_v2 arm keeps the tier-1 rep (it is the
+    # family s2d stems exist for)
+    pytest.param("resnet18", marks=pytest.mark.slow),
+])
 def test_model_flag_preserves_function_and_checkpoint(name):
     """Same ModelCfg except stem_s2d: identical param tree, matching logits."""
     from ddw_tpu.models.registry import build_model

@@ -1,0 +1,820 @@
+"""Live telemetry plane (ddw_tpu.obs.telemetry + ddw_tpu.obs.slo):
+windowed time-series over bounded sample rings, fleet merge with the
+seq-watermark/seq-reset protocol, SLO error budgets with multi-window
+burn-rate alerting, and the degradation sentinel.
+
+Tier-1 discipline (the 870s budget): the suite is dominated by pure-python
+unit tests over hand-built feeds and an injected clock (no jax, no
+sleeps); ONE module-scoped two-replica telemetry fleet over the shared
+tiny LM package serves the endpoint-contract test AND the degradation
+drill (the drill ends with the FSM recovered, so intra-module order only
+matters for determinism, which ``-p no:randomly`` provides). Every
+in-fleet request uses prompt length 8 / 6 steps so the whole module
+compiles one program lattice. The telemetry-overhead A/B arm rides in
+tools/serving_curve.py SMOKE and the live-vs-offline SLO attainment
+cross-check in tools/load_gen.py --slo (tier-2, with the sweeps).
+"""
+
+import glob
+import json
+import os
+import re
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from ddw_tpu.gateway import Gateway, GatewayClient
+from ddw_tpu.gateway.client import GatewayError
+from ddw_tpu.obs.slo import OK, PAGE, WARNING, SLOMonitor, SLOObjective
+from ddw_tpu.obs.telemetry import (
+    DIST_BUCKETS,
+    FleetTelemetry,
+    TelemetryHub,
+    bucket_counts,
+    bucket_index,
+    bucket_quantile,
+    merge_feeds,
+    signal_registry,
+    tee_run,
+    window_stats,
+)
+from ddw_tpu.serve import EngineCfg, ServingEngine
+from ddw_tpu.serve.metrics import (
+    LATENCY_BUCKETS_MS,
+    EngineMetrics,
+    RequestRecord,
+    render_prometheus,
+)
+
+VOCAB = 64
+
+
+class _Clock:
+    """Injected wall clock — SLO/hub unit tests never sleep."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _samples(name, kind, pairs, seq0=1):
+    """Hand-built drained samples: ``pairs`` is [(ts, value), ...]."""
+    return [{"seq": seq0 + i, "ts": float(ts), "name": name, "kind": kind,
+             "value": float(v)} for i, (ts, v) in enumerate(pairs)]
+
+
+# -- TelemetryHub: ring, watermark drain, dropped accounting ------------------
+
+def test_hub_record_drain_watermark():
+    hub = TelemetryHub(capacity=16, source="t", clock=_Clock(50.0))
+    hub.record("c", 1.0, kind="counter")
+    hub.observe("lat_ms", 5.0)
+    d = hub.drain(0)
+    assert d["source"] == "t" and d["dropped"] == 0
+    assert [s["name"] for s in d["samples"]] == ["c", "lat_ms"]
+    assert d["samples"][0]["ts"] == 50.0
+    assert d["samples"][1]["kind"] == "dist"    # observe() is the dist path
+    assert d["last_seq"] == 2
+    # an empty incremental drain does not advance the watermark
+    d2 = hub.drain(d["last_seq"])
+    assert d2["samples"] == [] and d2["last_seq"] == 2
+    hub.record("c", 2.0, kind="counter")
+    d3 = hub.drain(d["last_seq"])
+    assert [s["seq"] for s in d3["samples"]] == [3] and d3["last_seq"] == 3
+    assert hub.signals() == {"c": "counter", "lat_ms": "dist"}
+
+
+def test_hub_drop_oldest_is_counted_never_silent():
+    hub = TelemetryHub(capacity=4, clock=_Clock())
+    for i in range(10):
+        hub.record("g", float(i))
+    assert hub.samples_dropped == 6
+    d = hub.drain(0)
+    assert [s["value"] for s in d["samples"]] == [6.0, 7.0, 8.0, 9.0]
+    s = hub.summary()
+    assert s["samples"] == 4 and s["dropped"] == 6 and s["last_seq"] == 10
+    with pytest.raises(ValueError):
+        TelemetryHub(capacity=0)
+
+
+def test_hub_faulty_collector_skipped():
+    hub = TelemetryHub(clock=_Clock(7.0))
+    hub.add_collector(lambda: {"q": ("gauge", 3.0), "c": ("counter", 7.0)})
+
+    def boom():
+        raise RuntimeError("sampling must never take down the component")
+
+    hub.add_collector(boom)
+    hub.collect_once()
+    hub.collect_once()
+    d = hub.drain(0)
+    assert len(d["samples"]) == 4           # two ticks x two signals
+    assert all(s["ts"] == 7.0 for s in d["samples"])
+    assert hub.signals() == {"q": "gauge", "c": "counter"}
+
+
+def test_hub_sampler_thread_stops_and_restarts():
+    hub = TelemetryHub(interval_s=0.01, source="t")
+    hub.add_collector(lambda: {"tick": ("counter", 1.0)})
+    hub.start()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and not hub.summary()["last_seq"]:
+        time.sleep(0.01)
+    assert hub.summary()["last_seq"] > 0
+    hub.stop()
+    n = hub.summary()["last_seq"]
+    time.sleep(0.05)
+    assert hub.summary()["last_seq"] == n   # really stopped
+    hub.start()                             # restartable (engine recycle)
+    deadline = time.time() + 5.0
+    while time.time() < deadline and hub.summary()["last_seq"] == n:
+        time.sleep(0.01)
+    assert hub.summary()["last_seq"] > n
+    hub.stop()
+
+
+# -- histogram ladder ---------------------------------------------------------
+
+def test_bucket_quantile_interpolates_and_clamps():
+    counts = bucket_counts([0.5, 2.0, 3.0, 8.0, 40.0])
+    assert sum(counts) == 5
+    # the p50 rank lands in the (2.5, 5] bucket and interpolates inside it
+    p50 = bucket_quantile(counts, 50)
+    assert 2.5 < p50 <= 5.0
+    # observations past the last finite bound report that bound (the
+    # ladder's honest resolution limit), never +Inf
+    assert bucket_quantile(bucket_counts([1e9] * 4), 99) == DIST_BUCKETS[-1]
+    assert bucket_quantile([0] * (len(DIST_BUCKETS) + 1), 99) == 0.0
+
+
+# -- windowed aggregation -----------------------------------------------------
+
+def test_window_counter_rate_anchored_and_reset_rebased():
+    now = 1000.0
+    # the sample at-or-before the window start anchors the first in-window
+    # increment — a fixed cadence never loses the boundary delta
+    feed = {"source": "r0", "samples": _samples(
+        "c", "counter", [(now - 15, 10.0), (now - 8, 12.0), (now - 4, 16.0)])}
+    sig = window_stats(feed, widths=(10.0,), now=now)["windows"]["10s"][
+        "signals"]["c"]
+    assert sig["kind"] == "counter" and sig["n"] == 2
+    assert sig["delta"] == pytest.approx(6.0)
+    assert sig["rate"] == pytest.approx(0.6)
+    # a respawned source rebases at zero: the new absolute value IS the
+    # increment — the delta never goes negative
+    feed = {"source": "r0", "samples": _samples(
+        "c", "counter", [(now - 8, 100.0), (now - 4, 3.0)])}
+    sig = window_stats(feed, widths=(10.0,), now=now)["windows"]["10s"][
+        "signals"]["c"]
+    assert sig["delta"] == pytest.approx(3.0)
+
+
+def test_merge_feeds_gauges_and_dists_across_sources():
+    now = 2000.0
+    f0 = {"source": "r0", "samples": _samples(
+        "depth", "gauge", [(now - 5, 2.0), (now - 1, 4.0)])}
+    f1 = {"source": "r1", "samples":
+          _samples("depth", "gauge", [(now - 3, 6.0)])
+          + _samples("lat_ms", "dist",
+                     [(now - 2, 3.0), (now - 2, 30.0)], seq0=10)}
+    m = merge_feeds([f0, f1], widths=(10.0,), now=now)
+    assert m["sources"] == ["r0", "r1"]
+    d = m["windows"]["10s"]["signals"]["depth"]
+    assert d["kind"] == "gauge" and d["n"] == 3
+    assert d["mean"] == pytest.approx((2 + 4 + 6) / 3)
+    assert d["max"] == 6.0
+    # last_sum = fleet total of each source's LATEST level — the "how deep
+    # are the queues right now" number
+    assert d["last_sum"] == pytest.approx(4.0 + 6.0)
+    lat = m["windows"]["10s"]["signals"]["lat_ms"]
+    assert lat["n"] == 2 and lat["max"] == 30.0
+    assert 2.5 < lat["p50"] <= 30.0 and lat["p99"] <= 50.0
+
+
+# -- FleetTelemetry (satellite: fleet merge under skew/death/replace) ---------
+
+def test_fleet_merge_skewed_clocks_share_one_cut():
+    # r1's wall clock runs 0.4s ahead of r0's — both sources' "same
+    # instant" samples land in the SAME aligned window because every
+    # source is cut at the one merge-side ``now``
+    now, skew = 3000.0, 0.4
+    ft = FleetTelemetry(widths=(1.0,))
+    ft.ingest("r0", {"source": "r0", "samples": _samples(
+        "depth", "gauge", [(now - 0.5, 1.0)])})
+    ft.ingest("r1", {"source": "r1", "samples": _samples(
+        "depth", "gauge", [(now - 0.5 + skew, 5.0)])})
+    sig = ft.merged(now=now + skew)["windows"]["1s"]["signals"]["depth"]
+    assert sig["n"] == 2 and sig["last_sum"] == pytest.approx(6.0)
+
+
+def test_fleet_dead_replica_freezes_and_ages_out():
+    now = 4000.0
+    ft = FleetTelemetry(widths=(1.0, 60.0))
+    ft.ingest("r0", {"source": "r0", "samples": _samples(
+        "depth", "gauge", [(now - 30, 3.0), (now - 0.2, 2.0)])})
+    # r1 died mid-window: its series simply stops 30s ago
+    ft.ingest("r1", {"source": "r1", "samples": _samples(
+        "depth", "gauge", [(now - 30, 7.0)])})
+    m = ft.merged(now=now)
+    w1 = m["windows"]["1s"]["signals"]["depth"]
+    assert w1["n"] == 1 and w1["last_sum"] == 2.0   # frozen source aged out
+    w60 = m["windows"]["60s"]["signals"]["depth"]
+    assert w60["n"] == 3 and w60["max"] == 7.0      # still in the long view
+    assert m["sources"] == ["r0", "r1"]             # merge stays well-formed
+
+
+def test_fleet_ingest_watermark_dedupe_and_seq_reset_protocol():
+    ft = FleetTelemetry()
+    feed = {"source": "r0", "samples": _samples(
+        "c", "counter", [(1.0, 5.0), (2.0, 6.0)])}          # seqs 1, 2
+    assert len(ft.ingest("r0", feed)) == 2
+    assert ft.watermark("r0") == 2
+    assert ft.ingest("r0", feed) == []                      # seq dedupe
+    # a dead child's CACHED tail replaying old seqs must not trigger the
+    # reset protocol (it is the same ring, not a fresh one)
+    cached = {"source": "r0", "cached": True,
+              "samples": _samples("c", "counter", [(1.0, 5.0)])}
+    assert ft.ingest("r0", cached) == []
+    assert len(ft.feeds()[0]["samples"]) == 2
+    # a LIVE feed restarting below the watermark is a respawned child with
+    # a fresh ring: the slot's cache is replaced, nothing double-counts
+    reborn = {"source": "r0", "samples": _samples(
+        "c", "counter", [(3.0, 1.0)])}                      # seq 1 again
+    fresh = ft.ingest("r0", reborn)
+    assert [s["value"] for s in fresh] == [1.0]
+    assert [s["value"] for s in ft.feeds()[0]["samples"]] == [1.0]
+    assert ft.watermark("r0") == 1
+
+
+def test_fleet_drop_replica_forgets_series():
+    now = 5000.0
+    ft = FleetTelemetry(widths=(10.0,))
+    ft.ingest("r0", {"source": "r0", "samples": _samples(
+        "depth", "gauge", [(now - 1, 9.0)])})
+    ft.ingest("r1", {"source": "r1", "samples": _samples(
+        "depth", "gauge", [(now - 1, 2.0)])})
+    ft.drop_replica("r0")
+    assert ft.sources() == ["r1"]
+    assert ft.watermark("r0") == 0
+    m = ft.merged(now=now)
+    assert m["sources"] == ["r1"]
+    assert m["windows"]["10s"]["signals"]["depth"]["last_sum"] == 2.0
+
+
+# -- SLOMonitor: burn math, the alert FSM, budgets, the sentinel --------------
+
+def _mon(**kw):
+    obj = SLOObjective(name="ttft", kind="latency", signal="serve.ttft_ms",
+                       threshold=50.0, target=0.9)
+    kw.setdefault("fast", (10.0, 5.0))
+    kw.setdefault("slow", (40.0, 20.0))
+    kw.setdefault("page_burn", 2.0)
+    kw.setdefault("warn_burn", 1.0)
+    kw.setdefault("clock", _Clock(5000.0))
+    return SLOMonitor([obj], **kw)
+
+
+def _bad_feed(now, n_bad=4, n_good=0):
+    pairs = ([(now - 1.0, 500.0)] * n_bad + [(now - 1.0, 1.0)] * n_good)
+    return [{"source": "r0",
+             "samples": _samples("serve.ttft_ms", "dist", pairs)}]
+
+
+class _FakeTracer:
+    def __init__(self):
+        self.events = []
+
+    def instant(self, name, cat, tid=None, args=None):
+        self.events.append({"name": name, "cat": cat, "tid": tid,
+                            "args": args})
+
+
+def test_slo_objective_validation():
+    with pytest.raises(ValueError):
+        SLOObjective(name="x", kind="latency", signal="s", target=1.0)
+    with pytest.raises(ValueError):
+        SLOObjective(name="x", kind="bogus", signal="s")
+
+
+def test_slo_fsm_escalates_one_step_per_eval_and_traces_transitions():
+    tracer = _FakeTracer()
+    mon = _mon(tracer=tracer)
+    now = 5000.0
+    feeds = _bad_feed(now)
+    # all-bad window burns at 1/(1-0.9) = 10x — page-worthy immediately,
+    # but warning-before-page ordering is structural
+    assert mon.evaluate(feeds, now=now) == {"ttft": WARNING}
+    assert mon.evaluate(feeds, now=now + 0.1) == {"ttft": PAGE}
+    assert [(h["from"], h["to"]) for h in mon.history] == [
+        (OK, WARNING), (WARNING, PAGE)]
+    st = mon.status()["objectives"]["ttft"]
+    assert st["state"] == PAGE
+    assert st["burn"]["fast_short"]["burn"] >= mon.page_burn
+    deg = mon.degraded()
+    assert deg and deg[0]["objective"] == "ttft" and deg[0]["state"] == PAGE
+    # transitions landed on the trace timeline, category "slo"
+    assert [(e["args"]["from"], e["args"]["to"])
+            for e in tracer.events] == [(OK, WARNING), (WARNING, PAGE)]
+    assert all(e["name"] == "slo.ttft" and e["cat"] == "slo"
+               for e in tracer.events)
+
+
+def test_slo_page_requires_both_fast_windows():
+    # burn high enough to page, but only in the LONG fast window: the bad
+    # samples are 8s old — inside 10s, outside 5s. The short window proves
+    # "still happening"; without it the monitor must not page.
+    mon = _mon(warn_burn=100.0)     # isolate the page pair
+    now = 5000.0
+    feeds = [{"source": "r0", "samples": _samples(
+        "serve.ttft_ms", "dist", [(now - 8.0, 500.0)] * 6)}]
+    assert mon.evaluate(feeds, now=now) == {"ttft": OK}
+    b = mon.status()["objectives"]["ttft"]["burn"]
+    assert b["fast_long"]["burn"] >= mon.page_burn
+    assert b["fast_short"]["n"] == 0 and b["fast_short"]["burn"] == 0.0
+
+
+def test_slo_quiet_fleet_never_pages():
+    mon = _mon()
+    assert mon.evaluate([], now=5000.0) == {"ttft": OK}
+    b = mon.status()["objectives"]["ttft"]["burn"]
+    assert all(w["bad_fraction"] is None and w["burn"] == 0.0
+               for w in b.values())
+
+
+def test_slo_hysteresis_needs_clear_evals_per_step_down():
+    mon = _mon(clear_evals=2)
+    now = 5000.0
+    feeds = _bad_feed(now)
+    mon.evaluate(feeds, now=now)
+    mon.evaluate(feeds, now=now + 0.1)
+    assert mon.state("ttft") == PAGE
+    good = [{"source": "r0", "samples": _samples(
+        "serve.ttft_ms", "dist", [(now + 99.0, 1.0)] * 8)}]
+    # one healthy evaluation cannot silence a page
+    assert mon.evaluate(good, now=now + 100.0)["ttft"] == PAGE
+    assert mon.evaluate(good, now=now + 100.1)["ttft"] == WARNING
+    assert mon.evaluate(good, now=now + 100.2)["ttft"] == WARNING
+    assert mon.evaluate(good, now=now + 100.3)["ttft"] == OK
+    assert [(h["from"], h["to"]) for h in mon.history] == [
+        (OK, WARNING), (WARNING, PAGE), (PAGE, WARNING), (WARNING, OK)]
+
+
+def test_slo_availability_counts_bad_over_good_plus_bad():
+    obj = SLOObjective(name="avail", kind="availability",
+                       signal="serve.completed",
+                       bad_signals=("serve.shed_overloaded",
+                                    "serve.loop_errors"), target=0.9)
+    mon = SLOMonitor([obj], fast=(10.0, 5.0), slow=(40.0, 20.0),
+                     page_burn=2.0, warn_burn=100.0, clock=_Clock(100.0))
+    now = 100.0
+    samples = (_samples("serve.completed", "counter",
+                        [(now - 4, 10.0), (now - 1, 18.0)])        # +8 good
+               + _samples("serve.shed_overloaded", "counter",
+                          [(now - 4, 0.0), (now - 1, 2.0)], seq0=10))  # +2
+    feeds = [{"source": "r0", "samples": samples}]
+    mon.evaluate(feeds, now=now)
+    b = mon.status()["objectives"]["avail"]["burn"]["fast_short"]
+    assert b["bad_fraction"] == pytest.approx(0.2)   # 2 / (8 + 2)
+    assert b["burn"] == pytest.approx(2.0)
+    # the cumulative ledger ingests each counter increment once; a
+    # source's first-sighted absolute value is its epoch increment
+    mon.ingest("r0", samples)
+    budget = mon.status()["objectives"]["avail"]["budget"]
+    assert budget["events_total"] == 20 and budget["events_bad"] == 2
+    assert budget["attainment"] == pytest.approx(0.9)
+
+
+def test_slo_throughput_floor_flags_window_and_accrues_budget():
+    obj = SLOObjective(name="tps", kind="throughput",
+                       signal="serve.tokens_out", threshold=5.0, target=0.9)
+    mon = SLOMonitor([obj], fast=(10.0, 5.0), slow=(40.0, 20.0),
+                     page_burn=1e9, warn_burn=1e9, clock=_Clock(100.0))
+    now = 100.0
+    # 20 tokens over the 5s fast-short window = 4 tok/s < the 5.0 floor
+    low = [{"source": "r0", "samples": _samples(
+        "serve.tokens_out", "counter", [(now - 4, 100.0), (now - 1, 120.0)])}]
+    mon.evaluate(low, now=now)
+    st = mon.status()["objectives"]["tps"]
+    assert st["burn"]["fast_short"]["bad_fraction"] == 1.0   # all-bad window
+    assert st["budget"]["events_total"] == 1
+    assert st["budget"]["events_bad"] == 1
+    # 60 tokens over 5s = 12 tok/s clears the floor
+    now2 = now + 50.0
+    ok = [{"source": "r0", "samples": _samples(
+        "serve.tokens_out", "counter",
+        [(now2 - 4, 200.0), (now2 - 1, 260.0)], seq0=10)}]
+    mon.evaluate(ok, now=now2)
+    budget = mon.status()["objectives"]["tps"]["budget"]
+    assert budget["events_total"] == 2 and budget["events_bad"] == 1
+    assert budget["budget_consumed_pct"] == pytest.approx(500.0)
+
+
+def test_slo_latency_budget_counts_each_sample_exactly_once():
+    mon = _mon()
+    ft = FleetTelemetry()
+    feed = {"source": "r0", "samples": _samples(
+        "serve.ttft_ms", "dist", [(1.0, 10.0), (2.0, 80.0)])}
+    # the gateway hands the monitor exactly FleetTelemetry.ingest's
+    # fresh-sample return — re-polling the same drained feed is a no-op
+    mon.ingest("r0", ft.ingest("r0", feed))
+    mon.ingest("r0", ft.ingest("r0", feed))
+    b = mon.status()["objectives"]["ttft"]["budget"]
+    assert b["events_total"] == 2 and b["events_bad"] == 1
+    assert b["attainment"] == pytest.approx(0.5)
+    assert b["budget_consumed_pct"] == pytest.approx(500.0)
+
+
+def test_slo_sentinel_writes_atomic_postmortem(tmp_path):
+    mon = _mon(dump_dir=str(tmp_path),
+               flight_fn=lambda: [{"name": "tick", "cat": "serve"}])
+    now = 5000.0
+    feeds = _bad_feed(now)
+    mon.evaluate(feeds, now=now)
+    assert mon.dumps == []                  # a warning is not a page
+    mon.evaluate(feeds, now=now + 0.5)
+    assert len(mon.dumps) == 1
+    path = mon.dumps[0]
+    assert os.path.basename(path) == (
+        f"degradation.{int((now + 0.5) * 1000)}.json")
+    with open(path) as f:
+        payload = json.load(f)
+    assert set(payload) == {"objective", "transition", "burn_windows",
+                            "windows", "budget", "history", "flight"}
+    assert payload["objective"]["name"] == "ttft"
+    assert payload["transition"]["to"] == PAGE
+    assert payload["flight"] == [{"name": "tick", "cat": "serve"}]
+    assert payload["windows"]["windows"]    # the offending merged windows
+    assert payload["budget"]["events_total"] >= 0
+    assert not glob.glob(str(tmp_path / "*.tmp"))   # atomic os.replace
+    # a flight recorder that raises must not mask the degradation dump
+    mon2 = _mon(dump_dir=str(tmp_path),
+                flight_fn=lambda: 1 / 0, clock=_Clock(6000.0))
+    mon2.evaluate(_bad_feed(6000.0), now=6000.0)
+    mon2.evaluate(_bad_feed(6000.0), now=6000.5)
+    assert len(mon2.dumps) == 1
+    with open(mon2.dumps[0]) as f:
+        assert json.load(f)["flight"] == []
+
+
+# -- RunTee: the trainer-side feed -------------------------------------------
+
+def test_run_tee_feeds_hub_and_delegates():
+    class _Run:
+        def __init__(self):
+            self.logged = []
+            self.finished = False
+
+        def log_metric(self, key, value, step=0):
+            self.logged.append((key, value, step))
+
+        def log_metrics(self, metrics, step=0):
+            for k, v in metrics.items():
+                self.logged.append((k, v, step))
+
+        def finish(self):
+            self.finished = True
+
+    hub = TelemetryHub(clock=_Clock())
+    run = _Run()
+    tee = tee_run(run, hub)
+    tee.log_metric("chain_ms", 12.0, step=3)
+    tee.log_metrics({"images_per_sec": 55.0, "note": "text"}, step=4)
+    tee.finish()                            # everything else delegates
+    assert run.finished
+    assert ("chain_ms", 12.0, 3) in run.logged
+    assert ("note", "text", 4) in run.logged
+    # _ms keys become dist observations, numerics gauges, text is skipped
+    assert hub.signals() == {"chain_ms": "dist", "images_per_sec": "gauge"}
+    assert len(hub.drain(0)["samples"]) == 2
+    assert tee.telemetry_hub is hub         # trainers find the hub here
+
+
+# -- satellite: bounded records + histogram-fallback percentiles --------------
+
+def _rec(ttft_ms, t0=0.0):
+    return RequestRecord(kind="lm", submitted=t0, admitted=t0 + 1e-4,
+                         first_output=t0 + ttft_ms / 1e3,
+                         done=t0 + ttft_ms / 1e3 + 1e-3, tokens=4)
+
+
+def test_metrics_bounded_records_p99_within_one_bucket_of_exact():
+    rng = np.random.RandomState(0)
+    vals = rng.lognormal(mean=3.0, sigma=1.0, size=600)     # long-tailed ms
+    m = EngineMetrics(max_records=128)
+    for i, v in enumerate(vals):
+        m.record(_rec(float(v), t0=float(i)))
+    assert m.records_evicted == len(vals) - 128             # counted, never
+    snap = m.snapshot()                                     # silent
+    assert snap["serve.completed"] == 600.0
+    assert snap["serve.records_evicted"] == float(len(vals) - 128)
+    exact = float(np.percentile(vals, 99))
+    est = snap["serve.ttft_ms_p99"]
+    # the whole-run ladder fallback lands within ONE bucket of exact
+    assert abs(bucket_index(est, LATENCY_BUCKETS_MS)
+               - bucket_index(exact, LATENCY_BUCKETS_MS)) <= 1
+    # the mean comes from the exact accumulated sum, not the ladder
+    assert snap["serve.ttft_ms_mean"] == pytest.approx(
+        float(np.mean(vals)), rel=1e-6)
+    # while nothing has been evicted, percentiles are exact
+    m2 = EngineMetrics(max_records=4096)
+    for i, v in enumerate(vals[:50]):
+        m2.record(_rec(float(v), t0=float(i)))
+    assert m2.records_evicted == 0
+    assert m2.snapshot()["serve.ttft_ms_p99"] == pytest.approx(
+        float(np.percentile(vals[:50], 99)))
+
+
+# -- satellite: static counter-name consistency -------------------------------
+
+def test_every_incremented_counter_is_exported_and_registered():
+    """Every counter name incremented anywhere in serve/, obs/, or
+    gateway/ source appears in the Prometheus exposition AND in
+    signal_registry — a new counter that skips either fails the suite,
+    not the operator staring at a dashboard with a hole in it."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    srcs = []
+    for pkg in ("ddw_tpu/serve", "ddw_tpu/obs", "ddw_tpu/gateway"):
+        srcs += glob.glob(os.path.join(root, pkg, "*.py"))
+    assert srcs
+    count_re = re.compile(r'\.count\(\s*"([a-z0-9_]+)"')
+    method_re = re.compile(r"\.count_(overloaded|deadline|cancelled)\(")
+    stats_re = re.compile(r'self\.stats\["([a-z0-9_]+)"\]')
+    method_map = {"overloaded": "shed_overloaded",
+                  "deadline": "shed_deadline", "cancelled": "cancelled"}
+    names = set()
+    for path in srcs:
+        with open(path) as f:
+            text = f.read()
+        names.update(count_re.findall(text))
+        names.update(method_map[m] for m in method_re.findall(text))
+        if path.endswith("blocks.py"):
+            # BlockPool.stats keys mirror into engine counters each tick
+            names.update(stats_re.findall(text))
+    # regex sanity: the landscape must include the known landmarks
+    assert {"prefills", "decode_ticks", "shed_overloaded",
+            "routed_cache_hit", "warm_replays",
+            "prefix_hit_tokens"} <= names
+    reg = signal_registry()
+    exposition = render_prometheus([EngineMetrics()])
+    for name in sorted(names):
+        assert f"ddw_serve_{name}_total" in exposition, name
+        assert reg.get(f"serve.{name}") == "counter", name
+
+
+# -- the module fleet (shared tiny LM package) --------------------------------
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    import jax
+
+    from ddw_tpu.models.lm import build_lm
+    from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+    from ddw_tpu.utils.config import LMCfg
+
+    cfg = LMCfg(vocab_size=VOCAB, max_len=96, hidden=32, depth=2,
+                num_heads=2, mlp_dim=64, dropout=0.0, dtype="float32")
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int32))["params"]
+    out = str(tmp_path_factory.mktemp("telem_pkg") / "pkg")
+    return load_lm_package(save_lm_package(out, cfg, params))
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+THRESHOLD_MS = 150.0
+
+
+@pytest.fixture(scope="module")
+def fleet(pm, tmp_path_factory):
+    """Two telemetry-on replicas behind a telemetry-on gateway with a TTFT
+    SLO whose windows are drill-compressed (fast pair 1s/0.5s) so a
+    half-second stall pages within seconds."""
+    dump_dir = str(tmp_path_factory.mktemp("degradation"))
+    engs = [ServingEngine(lm=pm, cfg=EngineCfg(
+        n_slots=4, steps_per_tick=8, telemetry=True,
+        telemetry_interval_s=0.05, trace=True, default_timeout_s=600.0))
+        for _ in range(2)]
+    slos = [SLOObjective(name="ttft", kind="latency",
+                         signal="serve.ttft_ms", threshold=THRESHOLD_MS,
+                         target=0.9)]
+    gw = Gateway(engs, grace_s=60.0, supervise=False, trace=True,
+                 telemetry=True, telemetry_interval_s=0.05, slos=slos,
+                 slo_kw=dict(fast=(1.0, 0.5), slow=(4.0, 1.0),
+                             page_burn=2.0, warn_burn=1.0, clear_evals=3),
+                 degradation_dir=dump_dir)
+    gw.start(warmup_prompt_lens=(8,))
+    cli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+    assert cli.wait_ready(120.0)
+    yield gw, cli, dump_dir
+    os.environ.pop("DDW_FAULT", None)
+    cli.close()
+    gw.stop()
+
+
+# -- zero-touch pin: telemetry-off means ZERO hub touches on the hot path ----
+
+class _CountingHub:
+    """Records every attribute touch — replaces eng.telem to pin that
+    telemetry=False leaves the hot path free of hub calls entirely (the
+    EngineCfg.trace guard discipline)."""
+
+    def __init__(self):
+        object.__setattr__(self, "touches", [])
+
+    def __getattr__(self, name):
+        self.touches.append(name)
+        return lambda *a, **k: None
+
+
+def test_telemetry_off_hot_path_never_touches_hub(pm):
+    """telemetry=False compiles to a plain-bool branch: two full admit →
+    prefill → decode → complete lifecycles make ZERO hub attribute
+    touches, and the telemetry feed stays empty and never advances."""
+    with ServingEngine(lm=pm, cfg=EngineCfg(
+            n_slots=4, steps_per_tick=8, default_timeout_s=600.0)) as eng:
+        stub = _CountingHub()
+        eng.telem = stub
+        assert eng._telemetry is False
+        r1 = eng.submit_generate(_prompts([8], seed=7)[0], 6).result(120)
+        r2 = eng.submit_generate(_prompts([8], seed=8)[0], 6).result(120)
+        assert len(r1.tokens) == 6 and len(r2.tokens) == 6
+        assert stub.touches == []
+        eng.telem = None
+        feed = eng.telemetry_events(since=5)
+        assert feed["samples"] == [] and feed["last_seq"] == 5
+        assert eng.health()["telemetry"] is None
+
+
+# -- endpoint contracts -------------------------------------------------------
+
+def test_fleet_endpoints_expose_telemetry_and_slo(fleet):
+    gw, cli, _ = fleet
+    for seed in (3, 4):
+        cli.generate(_prompts([8], seed=seed)[0], 6)
+    time.sleep(0.3)                 # a few sampler + fleet-merge ticks
+    # /stats: hub summary with fleet-total drop accounting + SLO status
+    st = cli.stats()
+    tm = st["telemetry"]
+    assert tm["gateway"]["source"] == "gateway"
+    assert tm["gateway"]["samples"] > 0
+    assert set(tm["sources"]) >= {"gateway", "replica0", "replica1"}
+    assert tm["samples_dropped"] >= 0
+    slo = st["slo"]
+    assert slo["evals"] > 0 and "ttft" in slo["objectives"]
+    assert slo["objectives"]["ttft"]["threshold"] == THRESHOLD_MS
+    # bare /v1/telemetry: the merged aligned-window fleet view
+    tv = cli.telemetry()
+    assert set(tv["windows"]) == {"1s", "10s", "60s"}
+    sig = tv["windows"]["60s"]["signals"]
+    ttft = sig["serve.ttft_ms"]
+    assert ttft["kind"] == "dist" and ttft["n"] >= 2
+    assert ttft["p50"] <= ttft["p95"] <= ttft["p99"]
+    assert sig["serve.completed"]["kind"] == "counter"
+    assert sig["gateway.inflight"]["kind"] == "gauge"
+    assert "slo" in tv
+    # the single-replica relay form (what a parent gateway's fleet store
+    # polls): incremental by seq watermark
+    feed = cli.telemetry(replica=0, since=0)
+    assert feed["source"] == "replica0" and feed["replica"] == 0
+    seqs = [s["seq"] for s in feed["samples"]]
+    assert seqs and seqs == sorted(seqs)
+    again = cli.telemetry(replica=0, since=feed["last_seq"])
+    assert all(s["seq"] > feed["last_seq"] for s in again["samples"])
+    # /metrics: SLO exposition appended to the base Prometheus text
+    text = cli.metrics_text()
+    assert "ddw_serve_completed_total" in text
+    assert "ddw_telemetry_samples_dropped" in text
+    assert 'ddw_slo_state{objective="ttft"}' in text
+    assert 'ddw_slo_budget_consumed_pct{objective="ttft"}' in text
+    assert 'ddw_slo_attainment{objective="ttft"}' in text
+
+
+def test_telemetry_off_gateway_404s_but_relays_children():
+    """The bare fleet view 404s on a telemetry-off gateway, but the
+    ``?replica=R`` relay form still serves a child's feed — a process
+    replica's child answers its parent regardless of its own flag."""
+    class _FakeEngine:
+        def __init__(self):
+            self.metrics = EngineMetrics()
+
+        def start(self):
+            return self
+
+        def stop(self):
+            pass
+
+        def warmup(self, *a, **kw):
+            pass
+
+        def telemetry_events(self, since=0):
+            return {"source": "replica0", "replica": 0, "dropped": 0,
+                    "samples": _samples("serve.queue_depth", "gauge",
+                                        [(1.0, 3.0)], seq0=since + 1),
+                    "last_seq": since + 1}
+
+    gw = Gateway([_FakeEngine()], grace_s=1.0, supervise=False)
+    gw.start(warmup_prompt_lens=())
+    try:
+        cli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+        with pytest.raises(GatewayError) as exc:
+            cli.telemetry()
+        assert exc.value.status == 404
+        feed = cli.telemetry(replica=0, since=7)
+        assert feed["source"] == "replica0"
+        assert feed["samples"][0]["seq"] == 8
+        assert "ddw_telemetry_samples_dropped" not in cli.metrics_text()
+        cli.close()
+    finally:
+        gw.stop()
+
+
+# -- the degradation drill ----------------------------------------------------
+
+def test_degradation_drill_pages_dumps_and_recovers(fleet):
+    """A prefill stall on replica 0 of the live two-replica fleet drives
+    the TTFT objective ok → warning → page; the sentinel leaves a
+    self-contained post-mortem (offending windows + flight tail); healthy
+    traffic walks the FSM back to ok with the budget showing the burn."""
+    gw, cli, dump_dir = fleet
+    mon = gw.slo_monitor
+    cli.generate(_prompts([8], seed=5)[0], 6)           # warm path
+    base_dumps = len(mon.dumps)
+
+    def _gen(p):
+        c = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+        try:
+            return c.generate(p, 6)
+        finally:
+            c.close()
+
+    # stall at site=prefill: a held prefill tick means queued requests get
+    # no first token until release — the TTFT-visible stall (a decode
+    # stall fires after the first output and leaves TTFT untouched)
+    ex = ThreadPoolExecutor(max_workers=8)
+    os.environ["DDW_FAULT"] = "serve:stall:site=prefill"
+    try:
+        futs = [ex.submit(_gen, p) for p in _prompts([8] * 8, seed=6)]
+        time.sleep(0.5)
+    finally:
+        # clear BEFORE joining the workers — the stall loop holds the
+        # prefill tick for as long as the spec stays in the environment
+        os.environ.pop("DDW_FAULT", None)
+    ttfts = [float(f.result(120)["ttft_ms"]) for f in futs]
+    ex.shutdown()
+    assert max(ttfts) > THRESHOLD_MS        # the stall drove bad TTFTs
+
+    deadline = time.time() + 10.0
+    while time.time() < deadline and mon.state("ttft") != PAGE:
+        time.sleep(0.02)
+    assert mon.state("ttft") == PAGE
+    # /readyz stays 200 but carries the degradation detail (load
+    # balancers weight a paging fleet down; they do not eject it)
+    code, body = cli.readyz()
+    assert code == 200 and body.get("degraded") is True
+    assert body["slo_degraded"][0]["objective"] == "ttft"
+
+    trans = [(h["from"], h["to"]) for h in mon.status()["history"]]
+    assert (OK, WARNING) in trans and (WARNING, PAGE) in trans
+    assert trans.index((OK, WARNING)) < trans.index((WARNING, PAGE))
+
+    # the sentinel's post-mortem: offending windows + flight tail, atomic.
+    # state() flips inside the lock but the dump is a side effect AFTER it
+    # (it must never block a concurrent /stats read) — poll briefly.
+    deadline = time.time() + 10.0
+    while time.time() < deadline and len(mon.dumps) <= base_dumps:
+        time.sleep(0.02)
+    assert len(mon.dumps) > base_dumps, mon.dump_errors
+    with open(mon.dumps[-1]) as f:
+        payload = json.load(f)
+    assert set(payload) == {"objective", "transition", "burn_windows",
+                            "windows", "budget", "history", "flight"}
+    assert payload["objective"]["name"] == "ttft"
+    assert payload["transition"]["to"] == PAGE
+    assert payload["flight"]                # the flight tail rode along
+    assert payload["windows"]["windows"]
+    assert payload["burn_windows"]["fast_short"]["burn"] > 0
+    assert not glob.glob(os.path.join(dump_dir, "*.tmp"))
+
+    # recovery: healthy traffic + window ageout + hysteresis → ok
+    deadline = time.time() + 30.0
+    while time.time() < deadline and mon.state("ttft") != OK:
+        cli.generate(_prompts([8], seed=9)[0], 6)
+        time.sleep(0.1)
+    assert mon.state("ttft") == OK
+    budget = mon.status()["objectives"]["ttft"]["budget"]
+    assert budget["events_bad"] >= 1        # the drill burned real budget
+    assert budget["budget_consumed_pct"] > 0
+    # the whole episode is on the trace timeline, category "slo"
+    slo_events = [e for e in gw.trace_dump()["events"]
+                  if e.get("cat") == "slo"]
+    assert any(e["args"]["to"] == PAGE for e in slo_events)
+    assert any(e["args"]["to"] == OK for e in slo_events)

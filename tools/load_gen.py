@@ -791,6 +791,111 @@ def trace_arm(prompt_len=8, steps=8, requests=12, n_slots=2, clients=3,
     return out
 
 
+def slo_arm(prompt_len=12, steps=12, requests=24, n_slots=4, clients=4,
+            steps_per_tick=8, hidden=48, depth=2, threshold_ms=250.0,
+            target=0.9):
+    """Live-vs-offline SLO attainment cross-check — the telemetry plane's
+    accounting pin.
+
+    Self-hosts a 2-replica in-process fleet with telemetry + one TTFT
+    latency objective, drives a closed-loop run that keeps every
+    SERVER-reported ``ttft_ms`` from the response JSON, then compares the
+    gateway's live attainment (``/stats`` -> ``slo.objectives[...]
+    .budget``, the monitor's cumulative error-budget accounting over
+    ingested samples) against the offline recount over the same numbers:
+    ``1 - #(ttft > threshold) / completed``. The smoke asserts the two
+    agree within one event — the live plane and the client's own ledger
+    must tell the same story or one of them is lying."""
+    import tempfile
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.gateway import Gateway, GatewayClient, GatewayError
+    from ddw_tpu.obs.slo import SLOObjective
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "sloarm", hidden, depth, 2, 64, 64,
+                          dtype="float32")
+        cfg = EngineCfg(n_slots=n_slots, steps_per_tick=steps_per_tick,
+                        telemetry=True, telemetry_interval_s=0.05,
+                        queue_depth=4 * requests, default_timeout_s=600.0)
+        engines = [ServingEngine(lm=pm, cfg=cfg) for _ in range(2)]
+        gw = Gateway(engines, grace_s=60.0, supervise=False, telemetry=True,
+                     telemetry_interval_s=0.05,
+                     slos=[SLOObjective(name="ttft_p", kind="latency",
+                                        signal="serve.ttft_ms",
+                                        threshold=threshold_ms,
+                                        target=target),
+                           # an impossible objective (0 ms) pins the
+                           # BAD-event path deterministically: every
+                           # request must land in events_bad on both the
+                           # live and the offline ledger
+                           SLOObjective(name="ttft_strict", kind="latency",
+                                        signal="serve.ttft_ms",
+                                        threshold=0.0, target=target)])
+        gw.start(warmup_prompt_lens=(prompt_len,))
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 64, size=(prompt_len,)).astype(np.int32)
+                   for _ in range(requests)]
+        it = iter(prompts)
+        lock = threading.Lock()
+        ttfts, errors = [], [0]
+
+        def worker():
+            cli = _client(gw.url, 3)
+            while True:
+                with lock:
+                    p = next(it, None)
+                if p is None:
+                    return
+                try:
+                    r = cli.generate(p, steps)
+                    with lock:
+                        ttfts.append(float(r["ttft_ms"]))
+                except GatewayError:
+                    with lock:
+                        errors[0] += 1
+
+        try:
+            threads = [threading.Thread(target=worker)
+                       for _ in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            time.sleep(0.4)      # > 2 sampler+merge intervals: the monitor
+            #                      has ingested every finished request
+            cli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+            objs = cli.stats()["slo"]["objectives"]
+            budget = objs["ttft_p"]["budget"]
+            strict = objs["ttft_strict"]["budget"]
+        finally:
+            gw.stop()
+    offline_bad = sum(1 for t in ttfts if t > threshold_ms)
+    offline = round(1.0 - offline_bad / max(len(ttfts), 1), 6)
+    out = {"completed": len(ttfts), "errors": errors[0],
+           "threshold_ms": threshold_ms,
+           "offline_bad": offline_bad, "offline_attainment": offline,
+           "live_budget": budget, "strict_budget": strict,
+           "delta": round(abs(budget["attainment"] - offline), 6)}
+    print(f"[load_gen] slo arm: {out['completed']} completed, live "
+          f"attainment {budget['attainment']} vs offline {offline} "
+          f"(delta {out['delta']}, {offline_bad} offline-bad, live "
+          f"events {budget['events_total']})", file=sys.stderr, flush=True)
+    if SMOKE:
+        assert out["completed"] == requests and errors[0] == 0, out
+        # the live plane saw every completed request, and both ledgers
+        # agree within ONE event (a request finishing inside the final
+        # sampler window is the only legal slack)
+        assert abs(budget["events_total"] - len(ttfts)) <= 1, out
+        assert out["delta"] <= 1.0 / max(len(ttfts), 1) + 1e-9, out
+        # the impossible objective counted every event as bad, exactly
+        assert strict["events_bad"] == strict["events_total"], out
+        assert strict["attainment"] == 0.0, out
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default=None, help="target a live gateway")
@@ -830,6 +935,12 @@ def main():
     ap.add_argument("--trace-out", default="fleet_trace.json",
                     help="where the --trace arm writes the merged "
                          "Perfetto JSON")
+    ap.add_argument("--slo", action="store_true",
+                    help="self-hosted SLO cross-check arm: 2-replica "
+                         "telemetry fleet; asserts the gateway's live "
+                         "attainment (/stats error budget) matches the "
+                         "offline recount over the same server-reported "
+                         "TTFTs within one event")
     args = ap.parse_args()
 
     if args.url:
@@ -867,6 +978,9 @@ def main():
     elif args.trace:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "trace": trace_arm(out_path=args.trace_out)}
+    elif args.slo:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "slo": slo_arm()}
     elif args.batch:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "batch": batch_arm()}

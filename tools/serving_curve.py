@@ -22,7 +22,12 @@ fewer prefill tokens computed with TTFT p99 no worse — the fleet
 prefix-cache headline) — plus the SPEC-A/B arm: speculative decoding on
 vs off at equal engine config on the same workload with a self-draft (the
 smoke pins bit-identical completions, acceptance exactly 1.0, >1 tokens
-per target dispatch, and strictly fewer decode ticks).
+per target dispatch, and strictly fewer decode ticks) — plus the
+observability A/B arms: TRACE-A/B and TELEMETRY-A/B, each on-vs-off at
+equal engine config on the same workload with interleaved sweeps and
+best-of per arm (the smoke pins both overheads within 3% — the
+"observability is cheap enough to leave on" contract, numbers in
+docs/observability.md).
 
 Usage (chip): ``DDW_REQUIRE_TPU=1 python tools/serving_curve.py``
 CI smoke:     ``DDW_BENCH_SMOKE=1`` shrinks shapes/batches/steps.
@@ -658,6 +663,80 @@ def trace_ab(hidden, depth, heads, vocab, max_len, prompt_len, steps,
     return out
 
 
+def telemetry_ab(hidden, depth, heads, vocab, max_len, prompt_len, steps,
+                 n_slots, steps_per_tick, dtype="float32", requests=32,
+                 repeats=3, interval_s=0.05):
+    """The telemetry-overhead A/B arm: telemetry-on vs telemetry-off at
+    EQUAL engine config on the SAME workload — the trace_ab methodology
+    verbatim (interleaved sweeps, best-of per arm, both engines live the
+    whole run). Telemetry's hot-path cost is one plain-bool branch per
+    finished request plus (when on) three ring appends; the sampler runs
+    on its own thread off the request path, so the honest claim is the
+    same "within noise". DDW_BENCH_SMOKE pins telemetry-on tok/s within
+    3% of telemetry-off and that the off engine recorded ZERO samples
+    (docs/observability.md carries the measured numbers)."""
+    import contextlib
+
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, vocab, size=(prompt_len,)).astype(np.int32)
+               for _ in range(requests)]
+    out = {"requests": requests, "steps": steps, "repeats": repeats}
+    walls = {"telemetry_off": [], "telemetry_on": []}
+    samples = {"telemetry_off": 0, "telemetry_on": 0}
+    with tempfile.TemporaryDirectory() as tmp, contextlib.ExitStack() as st:
+        pm = _make_lm_pkg(tmp, "telemetry_ab", hidden, depth, heads, vocab,
+                          max_len, dtype=dtype)
+        engines = {}
+        for name, tl in (("telemetry_off", False), ("telemetry_on", True)):
+            cfg = EngineCfg(n_slots=n_slots, steps_per_tick=steps_per_tick,
+                            telemetry=tl, telemetry_interval_s=interval_s,
+                            queue_depth=4 * requests,
+                            default_timeout_s=600.0)
+            eng = st.enter_context(ServingEngine(lm=pm, cfg=cfg))
+            eng.warmup([prompt_len])
+            eng.generate(prompts[0], steps)         # compile + warm cache
+            engines[name] = eng
+
+        def sweep(eng):
+            t0 = time.perf_counter()
+            futs = [eng.submit_generate(p, steps) for p in prompts]
+            for f in futs:
+                f.result(timeout=600)
+            return time.perf_counter() - t0
+
+        for _ in range(2):                          # warm residency, untimed
+            for name, eng in engines.items():
+                sweep(eng)
+        for _ in range(repeats):
+            for name, eng in engines.items():
+                walls[name].append(sweep(eng))
+        for name, eng in engines.items():
+            samples[name] = (eng.telem.summary()["samples"]
+                             + eng.telem.samples_dropped
+                             if eng.telem is not None else 0)
+    for name in walls:
+        best = min(walls[name])
+        out[name] = {
+            "tokens_per_sec": round(requests * steps / best, 1),
+            "walls_s": [round(w, 4) for w in walls[name]],
+            "telemetry_samples": samples[name]}
+    off, on = out["telemetry_off"], out["telemetry_on"]
+    out["overhead_pct"] = round(
+        100.0 * (1.0 - on["tokens_per_sec"] / off["tokens_per_sec"]), 2)
+    print(f"[curve] telemetry_ab: off {off['tokens_per_sec']:.0f} tok/s, "
+          f"on {on['tokens_per_sec']:.0f} tok/s ({out['overhead_pct']:+.1f}%"
+          f" overhead, {on['telemetry_samples']} samples recorded)",
+          file=sys.stderr, flush=True)
+    if SMOKE:
+        # the observability contract: sampling is cheap enough to leave on
+        assert out["overhead_pct"] <= 3.0, out
+        assert on["telemetry_samples"] > 0, out
+        assert off["telemetry_samples"] == 0, out  # telemetry=False: nothing
+    return out
+
+
 def main():
     from ddw_tpu.utils.config import require_tpu_or_exit
 
@@ -702,6 +781,7 @@ def main():
                         max_len=128, prompt_len=16, steps=24, n_slots=8,
                         steps_per_tick=8, dtype="float32", requests=32,
                         repeats=5)
+        telem_kw = dict(trace_kw)   # same regime, same noise-margin logic
     else:
         batches, img = [1, 2, 4, 8, 16, 32, 64, 128, 256], (224, 224, 3)
         lm_kw = dict(hidden=512, depth=6, heads=8, vocab=8192, max_len=2048,
@@ -727,6 +807,7 @@ def main():
         trace_kw = dict(hidden=512, depth=6, heads=8, vocab=8192,
                         max_len=2048, prompt_len=64, steps=128, n_slots=16,
                         steps_per_tick=8, requests=64, repeats=3)
+        telem_kw = dict(trace_kw)
 
     result = {
         "device": {"kind": kind, "n": jax.device_count()},
@@ -738,6 +819,7 @@ def main():
         "routing_ab": routing_ab(**ab_kw),
         "spec_ab": spec_ab(**spec_kw),
         "trace_ab": trace_ab(**trace_kw),
+        "telemetry_ab": telemetry_ab(**telem_kw),
     }
     print(json.dumps(result))
 
