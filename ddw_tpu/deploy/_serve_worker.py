@@ -74,7 +74,21 @@ def main(argv=None) -> int:
     p.add_argument("--warmup", default="[8]",
                    help="JSON list of warmup prompt lengths")
     p.add_argument("--grace-s", type=float, default=None)
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree: this replica spans a "
+                        "tp-wide model-axis mesh slice (folded into "
+                        "EngineCfg.tp; on the CPU host platform the flag "
+                        "also forces tp fake devices before jax loads)")
     args = p.parse_args(argv)
+
+    if args.tp > 1 and os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+        # must land before ANY jax import: the host platform mints its
+        # device count at backend init, so a TP slice of fake CPU devices
+        # (tests, laptops) exists only if the flag precedes the import
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={args.tp}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
 
     # imports AFTER argparse: a bad flag should not pay the jax import
     from ddw_tpu.gateway.http import Gateway
@@ -83,7 +97,10 @@ def main(argv=None) -> int:
 
     pkg = load_lm_package(args.model_dir)
     draft = load_lm_package(args.draft_dir) if args.draft_dir else None
-    cfg = EngineCfg(**json.loads(args.engine_cfg or "{}"))
+    overrides = json.loads(args.engine_cfg or "{}")
+    if args.tp > 1:
+        overrides["tp"] = args.tp
+    cfg = EngineCfg(**overrides)
     eng = ServingEngine(lm=pkg, cfg=cfg, replica_id=args.replica_id,
                         draft=draft)
     eng.model_dir = args.model_dir

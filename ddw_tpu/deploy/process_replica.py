@@ -109,12 +109,17 @@ class ProcessReplica:
                  workdir: str | None = None, grace_s: float = 10.0,
                  spawn_timeout_s: float = 180.0,
                  request_timeout_s: float = 120.0, max_workers: int = 16,
-                 warmup_lens=(8,), draft_dir: str | None = None):
+                 warmup_lens=(8,), draft_dir: str | None = None,
+                 tp: int = 1):
         self.model_dir = model_dir
         self.draft_dir = draft_dir
         self.replica_id = replica_id
         self.generation = 0
         self.engine_cfg = dict(engine_cfg or {})
+        # tensor parallelism: the child spans a tp-wide mesh slice. The
+        # degree may arrive as the explicit kwarg or ride the engine_cfg
+        # dict (it's an EngineCfg field); the kwarg wins when both are set.
+        self.tp = int(tp if tp != 1 else self.engine_cfg.get("tp", 1))
         self.warmup_lens = tuple(warmup_lens)
         self.host = host
         self.grace_s = grace_s
@@ -171,10 +176,16 @@ class ProcessReplica:
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env.setdefault("JAX_PLATFORMS", "cpu")
-        # a serving child wants ONE device — drop an inherited forced-host
-        # device-count (the test suite's 8-device mesh) from XLA_FLAGS
+        # device discipline: a tp=1 child wants ONE device — drop an
+        # inherited forced-host device-count (the test suite's 8-device
+        # mesh) from XLA_FLAGS; a tp>1 child instead forces EXACTLY its
+        # mesh-slice width of fake CPU devices (the worker re-asserts this
+        # before importing jax, so manual launches behave the same)
         flags = [f for f in env.get("XLA_FLAGS", "").split()
                  if "xla_force_host_platform_device_count" not in f]
+        if self.tp > 1:
+            flags.append(
+                f"--xla_force_host_platform_device_count={self.tp}")
         if flags:
             env["XLA_FLAGS"] = " ".join(flags)
         else:
@@ -195,6 +206,8 @@ class ProcessReplica:
             cmd += ["--draft-dir", self.draft_dir]
         if self.engine_cfg:
             cmd += ["--engine-cfg", json.dumps(self.engine_cfg)]
+        if self.tp > 1:
+            cmd += ["--tp", str(self.tp)]
         self._ready = False
         self._port = None
         if self._client is not None:
@@ -366,7 +379,7 @@ class ProcessReplica:
                              spawn_timeout_s=self.spawn_timeout_s,
                              request_timeout_s=self.request_timeout_s,
                              warmup_lens=self.warmup_lens,
-                             draft_dir=self.draft_dir)
+                             draft_dir=self.draft_dir, tp=self.tp)
         eng.generation = self.generation + 1
         eng.on_failure = self.on_failure
         return eng

@@ -426,7 +426,8 @@ def signal_registry() -> dict[str, str]:
                  "serve.resident_streams", "serve.batch_resident_streams",
                  "serve.interactive_reserve_blocks",
                  "serve.reserve_free_blocks", "serve.prefix_cache_keys",
-                 "serve.decode_bucket", "serve.batch_backlog"):
+                 "serve.decode_bucket", "serve.batch_backlog",
+                 "serve.tp_degree"):
         reg[name] = "gauge"
     # gateway routing state
     for name in ("gateway.connections", "gateway.inflight",

@@ -17,6 +17,7 @@ projection row-parallel; embeddings and head replicated.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Callable, Sequence
 
 import jax
@@ -71,6 +72,73 @@ LM_TP_RULES = PartitionRules([
     (r"head/kernel", P(None, MODEL_AXIS)),
     (r"head/bias", P(MODEL_AXIS)),
 ])
+
+# GQA fallback layout: q stays head-sharded, k/v replicate. Correct for any
+# num_kv_heads because the grouped-query broadcast (jnp.repeat over the head
+# axis at compute time) then happens per-shard on a full KV copy.
+LM_TP_RULES_REPLICATED_KV = PartitionRules([
+    (r"attn/query/kernel", P(None, MODEL_AXIS, None)),
+    (r"attn/query/bias", P(MODEL_AXIS, None)),
+    (r"attn/(key|value)/(kernel|bias)", P()),
+    (r"attn/out/kernel", P(MODEL_AXIS, None, None)),
+    (r"fc1/kernel", P(None, MODEL_AXIS)),
+    (r"fc1/bias", P(MODEL_AXIS)),
+    (r"fc2/kernel", P(MODEL_AXIS, None)),
+    (r"tok_embed/embedding", P(MODEL_AXIS, None)),
+    (r"head/kernel", P(None, MODEL_AXIS)),
+    (r"head/bias", P(MODEL_AXIS)),
+])
+
+
+def lm_tp_rules_for(num_heads: int, num_kv_heads: int,
+                    tp: int) -> tuple[PartitionRules, bool]:
+    """Resolve the serving-time TP layout for a TransformerLM.
+
+    Returns ``(rules, kv_sharded)``. Query heads MUST divide by ``tp`` (the
+    caller validates and raises before any program compiles); KV heads that
+    don't divide fall back to replicated k/v params + a replicated KV cache
+    with a RuntimeWarning — the same degrade-loudly posture as the
+    ``kv_block_size`` divisor shrink in ``ServingEngine._build_block_pool``.
+    """
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    kv_heads = num_kv_heads or num_heads
+    if num_heads % tp:
+        raise ValueError(
+            f"tp {tp} does not divide num_heads {num_heads}: the attention "
+            f"head axis is the TP shard axis, so the head count must be a "
+            f"multiple of the model-axis size")
+    if kv_heads % tp:
+        warnings.warn(
+            f"num_kv_heads {kv_heads} not divisible by tp {tp} (GQA/MQA): "
+            f"replicating k/v params and the KV block pool instead of "
+            f"sharding them on the heads axis — correct but forfeits the "
+            f"KV-memory split across the mesh slice",
+            RuntimeWarning, stacklevel=2)
+        return LM_TP_RULES_REPLICATED_KV, False
+    return LM_TP_RULES, True
+
+
+def decode_cache_shardings(cache, mesh: Mesh, kv_sharded: bool = True):
+    """NamedShardings for a paged-decode cache tree.
+
+    The KV block pool leaves (``kv_block_key``/``kv_block_value``, shape
+    ``[n_blocks+1, block_size, KV, head_dim]``) shard on the heads axis —
+    block ids and offsets stay host/replicated so the allocator, prefix
+    cache, CoW and preemption logic never see the mesh. Everything else
+    (the ``tiles_computed`` scalar, contiguous-path leaves) replicates.
+    With ``kv_sharded=False`` (GQA fallback) the whole cache replicates.
+    """
+    def to_sharding(path, leaf):
+        key = _path_key(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if kv_sharded and "kv_block_" in key and len(shape) == 4:
+            spec = P(None, None, MODEL_AXIS, None)
+            check_spec_divisibility(key, shape, spec, mesh)
+            return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(to_sharding, cache)
 
 
 def _path_key(path) -> str:

@@ -68,6 +68,7 @@ from __future__ import annotations
 import collections
 import hashlib
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -121,7 +122,7 @@ class BlockPool:
                  block_size: int, max_resident: int,
                  steps_per_tick: int = 4, donate: bool = True,
                  overcommit: float = 1.0, interactive_reserve: int = 0,
-                 decode_buckets: bool = True):
+                 decode_buckets: bool = True, mesh=None):
         if n_blocks < 1:
             raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
         if interactive_reserve < 0:
@@ -165,7 +166,27 @@ class BlockPool:
                                   kv_cache_blocks=n_blocks + 1,
                                   kv_block_size=block_size,
                                   seq_axis=None, dropout=0.0)
-        self.cache = init_cache(self._model, 1)
+        # Tensor parallelism: with a mesh, params shard per LM_TP_RULES over
+        # the model axis and the KV block pool shards on the heads axis; the
+        # device programs below compile under GSPMD unchanged (XLA inserts
+        # the collectives). Every host-side structure — block tables, the
+        # allocator, prefix cache, CoW, preemption — is layout-blind.
+        self._mesh = mesh
+        self._kv_sharded = False
+        self._repl_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ddw_tpu.parallel.sharding import (
+                lm_tp_rules_for, shardings_for_params)
+            from ddw_tpu.runtime.mesh import MODEL_AXIS
+
+            tp = mesh.shape[MODEL_AXIS]
+            rules, self._kv_sharded = lm_tp_rules_for(
+                model.num_heads, model.num_kv_heads, tp)
+            self.params = jax.device_put(
+                params, shardings_for_params(params, mesh, rules))
+            self._repl_sharding = NamedSharding(mesh, PartitionSpec())
+        self.cache = self._init_cache()
         self._prefill_jit: dict[tuple, object] = {}   # by (group, suffix len)
         self._spec_jit: dict[tuple, object] = {}      # by ("draft"|"verify", k)
         self._decode_jit: dict[int, object] = {}      # by chain length k;
@@ -175,6 +196,24 @@ class BlockPool:
         self._copy = jax.jit(self._copy_fn, donate_argnums=don)
         self._ev_lock = threading.Lock()   # event log is read off-thread
         self._reset_host()
+
+    def _init_cache(self):
+        cache = init_cache(self._model, 1)
+        if self._mesh is not None:
+            from ddw_tpu.parallel.sharding import decode_cache_shardings
+            cache = jax.device_put(
+                cache, decode_cache_shardings(cache, self._mesh,
+                                              self._kv_sharded))
+        return cache
+
+    def _replicate(self, x):
+        """Inside a device program: pin ``x`` fully replicated. The sampling
+        folds in ``_pick`` must see byte-identical logits on every shard —
+        the head kernel is vocab-sharded, so without this constraint the
+        argmax/categorical would run over a sharded vocab axis."""
+        if self._mesh is None:
+            return x
+        return lax.with_sharding_constraint(x, self._repl_sharding)
 
     # -- host accounting ------------------------------------------------------
     def _reset_host(self) -> None:
@@ -195,7 +234,12 @@ class BlockPool:
         self.stats = {"prefix_hit_tokens": 0, "prefix_hit_blocks": 0,
                       "prefix_miss_blocks": 0, "cow_copies": 0,
                       "preemptions": 0, "batch_preemptions": 0,
-                      "decode_rows_skipped": 0}
+                      "decode_rows_skipped": 0,
+                      # tensor-parallel dispatch accounting (mesh mode only;
+                      # stays 0 at tp=1): count + accumulated wall-µs of the
+                      # sharded device dispatches, so per-dispatch collective
+                      # cost is tp_dispatch_us / tp_dispatches
+                      "tp_dispatches": 0, "tp_dispatch_us": 0}
         self.last_decode_bucket = 0   # rows the last decode tick dispatched
         # fleet prefix-index feed (gateway/prefix_index.py): a bounded
         # register/evict event log polled through the engine, plus the
@@ -212,7 +256,7 @@ class BlockPool:
         """Fresh device + host state after an engine failure (the
         :meth:`SlotPool.reset` contract): compiled programs are kept, so a
         supervisor restart rejoins warm."""
-        self.cache = init_cache(self._model, 1)
+        self.cache = self._init_cache()
         self._reset_host()
 
     @property
@@ -297,7 +341,17 @@ class BlockPool:
                 max(0, min(self.interactive_reserve, avail))),
             "prefix_cache_keys": float(len(self._full_map)),
             "decode_bucket": float(self.last_decode_bucket),
+            "tp_degree": float(self.tp_degree),
         }
+
+    @property
+    def tp_degree(self) -> int:
+        """Model-axis size of the mesh this pool's programs shard over (1 =
+        single-device, the pre-TP behaviour)."""
+        if self._mesh is None:
+            return 1
+        from ddw_tpu.runtime.mesh import MODEL_AXIS
+        return int(self._mesh.shape[MODEL_AXIS])
 
     # -- allocator ------------------------------------------------------------
     def _alloc(self) -> int:
@@ -644,6 +698,20 @@ class BlockPool:
                 starts[i] = st.filled
         return tables, starts
 
+    def _dispatch(self, fn, cache, *args):
+        """Run one device program. In mesh mode the dispatch is metered
+        (wall-µs through the result barrier, so the TP collectives are in
+        the measurement) — ``serve.tp_dispatch_us / serve.tp_dispatches``
+        is the per-dispatch collective cost the A/B harness surfaces."""
+        if self._mesh is None:
+            return fn(cache, *args)
+        t0 = time.perf_counter()
+        out = fn(cache, *args)
+        jax.block_until_ready(out)
+        self.stats["tp_dispatches"] += 1
+        self.stats["tp_dispatch_us"] += int((time.perf_counter() - t0) * 1e6)
+        return out
+
     def prefill(self, rows, padded_suffixes, true_lens, temps, keys):
         """One grouped suffix-prefill dispatch: ``padded_suffixes [G, S]``
         (same suffix-length bucket), ``rows`` the claimed resident rows
@@ -674,15 +742,17 @@ class BlockPool:
                     mutable=["cache"])
                 last = jnp.take_along_axis(
                     logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
-                return vars_["cache"], _pick(last, temps, keys)
+                return vars_["cache"], _pick(self._replicate(last), temps,
+                                             keys)
 
             fn = self._prefill_jit[(g, length)] = jax.jit(
                 prefill_fn, donate_argnums=(0,) if self._donate else ())
-        self.cache, toks = fn(self.cache, padded_suffixes,
-                              jnp.asarray(tables), jnp.asarray(starts),
-                              jnp.asarray(true_lens, jnp.int32),
-                              jnp.asarray(temps, jnp.float32),
-                              jnp.asarray(keys))
+        self.cache, toks = self._dispatch(
+            fn, self.cache, padded_suffixes,
+            jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(true_lens, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(keys))
         return np.asarray(toks)
 
     def _live_bucket(self) -> int:
@@ -740,7 +810,7 @@ class BlockPool:
                         {"params": self.params, "cache": cache},
                         tok[:, None], block_tables=tables, start_pos=pos,
                         mutable=["cache"])
-                    nxt = _pick(logits[:, 0], temps, key_s)
+                    nxt = _pick(self._replicate(logits[:, 0]), temps, key_s)
                     return (vars_["cache"], nxt, pos + 1), nxt
 
                 (cache, _, _), toks = lax.scan(
@@ -750,10 +820,11 @@ class BlockPool:
 
             fn = self._decode_jit[self.steps_per_tick] = jax.jit(
                 chain, donate_argnums=(0,) if self._donate else ())
-        self.cache, toks = fn(self.cache, jnp.asarray(tokens, jnp.int32),
-                              jnp.asarray(starts), jnp.asarray(tables),
-                              jnp.asarray(temps, jnp.float32),
-                              jnp.asarray(keys))
+        self.cache, toks = self._dispatch(
+            fn, self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(starts), jnp.asarray(tables),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(keys))
         return np.asarray(toks)
 
     def spec_draft(self, prev_tokens, cur_tokens, temps, keys) -> np.ndarray:
@@ -793,7 +864,8 @@ class BlockPool:
                     jnp.stack([prev, cur], axis=1), block_tables=tables,
                     start_pos=starts, mutable=["cache"])
                 cache = vars_["cache"]
-                d1 = _pick(logits[:, 1], temps, keys_sk[:, 0])
+                d1 = _pick(self._replicate(logits[:, 1]), temps,
+                           keys_sk[:, 0])
                 if k == 1:
                     return cache, d1[:, None]
 
@@ -803,7 +875,7 @@ class BlockPool:
                         {"params": self.params, "cache": cache},
                         tok[:, None], block_tables=tables, start_pos=pos,
                         mutable=["cache"])
-                    nxt = _pick(logits[:, 0], temps, key_s)
+                    nxt = _pick(self._replicate(logits[:, 0]), temps, key_s)
                     return (vars_["cache"], nxt, pos + 1), nxt
 
                 (cache, _, _), rest = lax.scan(
@@ -815,11 +887,12 @@ class BlockPool:
 
             fn = self._spec_jit[("draft", k)] = jax.jit(
                 draft_fn, donate_argnums=(0,) if self._donate else ())
-        self.cache, drafts = fn(self.cache, jnp.asarray(prev, jnp.int32),
-                                jnp.asarray(cur, jnp.int32),
-                                jnp.asarray(tables), jnp.asarray(starts),
-                                jnp.asarray(temps, jnp.float32),
-                                jnp.asarray(keys))
+        self.cache, drafts = self._dispatch(
+            fn, self.cache, jnp.asarray(prev, jnp.int32),
+            jnp.asarray(cur, jnp.int32),
+            jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(keys))
         return np.asarray(drafts)
 
     def spec_verify(self, tokens, temps, keys) -> np.ndarray:
@@ -861,15 +934,17 @@ class BlockPool:
                     block_tables=tables, start_pos=starts,
                     mutable=["cache"])
                 picks = jax.vmap(lambda lg, key: _pick(lg, temps, key),
-                                 in_axes=1, out_axes=1)(logits, keys_sk)
+                                 in_axes=1, out_axes=1)(
+                    self._replicate(logits), keys_sk)
                 return vars_["cache"], picks
 
             fn = self._spec_jit[("verify", s)] = jax.jit(
                 verify_fn, donate_argnums=(0,) if self._donate else ())
-        self.cache, picks = fn(self.cache, jnp.asarray(tokens, jnp.int32),
-                               jnp.asarray(tables), jnp.asarray(starts),
-                               jnp.asarray(temps, jnp.float32),
-                               jnp.asarray(keys))
+        self.cache, picks = self._dispatch(
+            fn, self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(tables), jnp.asarray(starts),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(keys))
         return np.asarray(picks)
 
     def warmup_spec(self, spec_k: int, role: str) -> None:

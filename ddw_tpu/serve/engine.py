@@ -86,6 +86,7 @@ from ddw_tpu.models.spec_decode import match_length
 from ddw_tpu.obs.telemetry import TelemetryHub
 from ddw_tpu.obs.trace import Tracer
 from ddw_tpu.runtime.faults import ServeCrash, maybe_serve_fault
+from ddw_tpu.runtime.mesh import MODEL_AXIS
 from ddw_tpu.serve.admission import (AdmissionController, DeadlineExceeded,
                                      Overloaded, ReplicaFailed)
 from ddw_tpu.serve.blocks import BlockPool, OutOfBlocks
@@ -184,6 +185,26 @@ class EngineCfg:
     telemetry_interval_s: float = 0.25
     telemetry_capacity: int = 4096  # sample ring bound (drop-oldest;
     #                                 truncation counted, never silent)
+    # tensor parallelism (docs/serving.md "Tensor-parallel serving"): one
+    # replica spans a tp-wide mesh slice — params shard per LM_TP_RULES,
+    # the KV block pool shards on the heads axis, every device program
+    # compiles under GSPMD, and outputs stay bit-identical to tp=1 (greedy
+    # AND seeded; the sampling folds run on fully-replicated logits).
+    # Requires paged=True; the head count must divide by tp.
+    tp: int = 1
+
+    def __post_init__(self):
+        # model-independent TP validation lives here so a bad config fails
+        # at CONSTRUCTION with a structured error, not as an XLA shape
+        # error mid-warmup; the model/device-dependent checks (head
+        # divisibility, local device count) run in ServingEngine._init_lm.
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if self.tp > 1 and not self.paged:
+            raise ValueError(
+                f"tp {self.tp} requires the paged pool (paged=True): only "
+                f"the BlockPool programs compile under a mesh — the "
+                f"contiguous slot pool is single-device")
 
 
 @dataclasses.dataclass
@@ -304,10 +325,11 @@ class ServingEngine:
 
     def __init__(self, lm=None, image=None, cfg: EngineCfg | None = None,
                  run=None, monitor_interval_s: float = 0.0,
-                 replica_id: int = 0, draft=None):
+                 replica_id: int = 0, draft=None, mesh=None):
         if lm is None and image is None:
             raise ValueError("engine needs an lm and/or image model")
         self.cfg = cfg or EngineCfg()
+        self.mesh = self._resolve_mesh(mesh)
         self.run = run
         self.metrics = EngineMetrics()
         # tracing: the tracer object always exists (drains/summaries stay
@@ -384,6 +406,45 @@ class ServingEngine:
             self._image_apply = make_apply()  # one callable; jit caches per
             #                                   padded batch-bucket shape
 
+    def _resolve_mesh(self, mesh):
+        """Reconcile ``EngineCfg.tp`` with an explicit mesh. ``tp > 1``
+        without a mesh builds the default 1-D model-axis slice over the
+        first ``tp`` local devices; an explicit mesh with ``tp`` left at 1
+        is adopted as-is (its model-axis size IS the degree). Conflicts and
+        impossible degrees are structured errors at construction — never an
+        XLA shape error mid-warmup."""
+        tp = self.cfg.tp
+        if mesh is not None:
+            if MODEL_AXIS not in mesh.shape:
+                raise ValueError(
+                    f"serving mesh must carry a '{MODEL_AXIS}' axis, got "
+                    f"axes {tuple(mesh.shape)}")
+            size = int(mesh.shape[MODEL_AXIS])
+            if tp > 1 and size != tp:
+                raise ValueError(
+                    f"EngineCfg(tp={tp}) conflicts with the mesh's "
+                    f"'{MODEL_AXIS}' axis size {size}")
+            if size == 1 and tp == 1:
+                return None        # degenerate slice: keep the tp=1 path
+            return mesh
+        if tp == 1:
+            return None
+        ndev = len(jax.devices())
+        if tp > ndev:
+            raise ValueError(
+                f"tp {tp} exceeds the local device count {ndev}: a "
+                f"tensor-parallel replica needs its whole mesh slice on "
+                f"this host")
+        from jax.sharding import Mesh
+        return Mesh(np.asarray(jax.devices()[:tp]), (MODEL_AXIS,))
+
+    @property
+    def tp_degree(self) -> int:
+        """Model-axis width this replica's programs shard over (1 = the
+        single-device path)."""
+        return int(self.mesh.shape[MODEL_AXIS]) if self.mesh is not None \
+            else 1
+
     def _init_lm(self, lm, draft=_UNSET) -> None:
         """Build (or rebuild) the LM handle + KV pool(s). Called at
         construction and by :meth:`restart` when a pending checkpoint swap
@@ -416,6 +477,22 @@ class ServingEngine:
                     f"draft vocab_size {draft.cfg.vocab_size} != target "
                     f"vocab_size {self._lm.cfg.vocab_size} — draft "
                     f"proposals must be target tokens")
+            tp = self.tp_degree
+            if tp > 1:
+                # the attention head axis is the TP shard axis: a head
+                # count the mesh can't split is a config error, caught
+                # HERE (construction) rather than as an XLA shape error
+                # when warmup compiles the first sharded program
+                roles = [("target", self._lm)]
+                if spec:
+                    roles.append(("draft", draft))
+                for role, h in roles:
+                    heads = h.model.num_heads
+                    if heads % tp:
+                        raise ValueError(
+                            f"tp {tp} does not divide the {role} model's "
+                            f"num_heads {heads}: attention heads are the "
+                            f"tensor-parallel shard axis")
             if self.cfg.paged:
                 self.pool = self._build_block_pool(
                     self._lm, self.cfg.steps_per_tick)
@@ -478,7 +555,8 @@ class ServingEngine:
             donate=self.cfg.donate,
             overcommit=self.cfg.block_overcommit,
             interactive_reserve=reserve,
-            decode_buckets=self.cfg.decode_buckets)
+            decode_buckets=self.cfg.decode_buckets,
+            mesh=self.mesh)
 
     # -- checkpoint hot-swap (the deploy layer's weight-reload hook) ---------
     @property
@@ -817,7 +895,8 @@ class ServingEngine:
         it, so the clone re-compiles). Carries the replica identity, the
         next generation, and the failover hook."""
         eng = ServingEngine(lm=self._lm, image=self._image, cfg=self.cfg,
-                            replica_id=self.replica_id, draft=self._draft)
+                            replica_id=self.replica_id, draft=self._draft,
+                            mesh=self.mesh)
         eng.generation = self.generation + 1
         eng.on_failure = self.on_failure
         eng.model_dir = self.model_dir

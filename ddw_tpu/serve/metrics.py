@@ -158,6 +158,13 @@ class EngineMetrics:
         #                            the stream re-arms on the next record,
         #                            so this counts rows at risk, not a
         #                            permanently dead exporter
+        # tensor-parallel serving (EngineCfg.tp > 1; both stay 0 at tp=1)
+        self.tp_dispatches = 0     # sharded device dispatches (prefill /
+        #                            decode chain / spec draft / verify)
+        self.tp_dispatch_us = 0    # accumulated wall-µs of those dispatches
+        #                            through the result barrier — ÷
+        #                            tp_dispatches = per-dispatch collective
+        #                            cost (the spec×TP amortization number)
         self._gauges: dict[str, float] = {}  # live block-pool state, pushed
         #                            by the engine loop (free/used blocks...)
         self._first_admit: float | None = None
@@ -299,6 +306,8 @@ class EngineMetrics:
                     self.routed_wait_override),
                 "serve.warm_replays": float(self.warm_replays),
                 "serve.export_errors": float(self.export_errors),
+                "serve.tp_dispatches": float(self.tp_dispatches),
+                "serve.tp_dispatch_us": float(self.tp_dispatch_us),
             }
             looked = self.prefix_hit_blocks + self.prefix_miss_blocks
             out["serve.prefix_hit_rate"] = (
@@ -309,6 +318,9 @@ class EngineMetrics:
             out["serve.spec_tokens_per_tick"] = (
                 (self.spec_accepted + self.spec_bonus) / self.decode_ticks
                 if self.spec_proposed and self.decode_ticks else 0.0)
+            out["serve.tp_dispatch_cost_us"] = (
+                self.tp_dispatch_us / self.tp_dispatches
+                if self.tp_dispatches else 0.0)
             for name, val in self._gauges.items():
                 out[f"serve.{name}"] = float(val)
             cap = self._gauges.get("block_tokens_capacity", 0.0)
@@ -457,6 +469,10 @@ _COUNTER_HELP = (
      "readmission."),
     ("export_errors", "serve_requests.jsonl rows whose write failed (the "
      "stream re-arms on the next record)."),
+    ("tp_dispatches", "Tensor-parallel sharded device dispatches (prefill, "
+     "decode chains, spec draft/verify; 0 at tp=1)."),
+    ("tp_dispatch_us", "Accumulated wall-microseconds of tensor-parallel "
+     "dispatches through the result barrier (collectives included)."),
     ("tokens_out", "Generated LM tokens (both lanes)."),
     ("batch_items", "Batch-lane items completed."),
     ("batch_tokens_out", "Generated LM tokens on the batch lane."),
@@ -582,6 +598,9 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
         (counters["spec_accepted"] + counters["spec_bonus"])
         / counters["decode_ticks"]
         if counters["spec_proposed"] and counters["decode_ticks"] else 0.0)
+    pool_gauges["tp_dispatch_cost_us"] = (
+        counters["tp_dispatch_us"] / counters["tp_dispatches"]
+        if counters["tp_dispatches"] else 0.0)
     cap = pool_gauges.get("block_tokens_capacity", 0.0)
     if cap:
         pool_gauges["block_fragmentation_pct"] = max(

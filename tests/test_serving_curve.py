@@ -73,6 +73,19 @@ def test_serving_curve_smoke():
     assert sp["spec_off"]["spec_tokens_per_tick"] == 0.0
     for arm in ("spec_off", "spec_on"):
         assert sp[arm]["tokens_per_sec"] > 0
+    # TP A/B arm: tp=2 vs tp=1 at equal config (the arm's own SMOKE
+    # asserts pin bit-identical completions across all three arms and
+    # equal dispatch schedules; the contract here is the rows stay
+    # coherent and the tp counters flow only under a mesh)
+    tp = d["tp_ab"]
+    assert tp["tp1"]["tp_dispatches"] == 0
+    assert tp["tp2"]["tp_dispatches"] > 0
+    assert tp["tp2"]["tp_dispatch_cost_us"] > 0
+    assert tp["tp2"]["decode_ticks"] == tp["tp1"]["decode_ticks"]
+    assert tp["tp2"]["prefills"] == tp["tp1"]["prefills"]
+    assert tp["tp2_spec"]["spec_acceptance_rate"] == 1.0
+    for arm in ("tp1", "tp2", "tp2_spec"):
+        assert tp[arm]["tokens_per_sec"] > 0
     # trace A/B arm: trace-on vs trace-off at equal config, interleaved
     # sweeps (the arm's own SMOKE asserts pin overhead <= 3% tok/s; the
     # contract here is the rows stay coherent and tracing really was on

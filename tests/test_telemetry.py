@@ -557,7 +557,7 @@ def test_every_incremented_counter_is_exported_and_registered():
     # regex sanity: the landscape must include the known landmarks
     assert {"prefills", "decode_ticks", "shed_overloaded",
             "routed_cache_hit", "warm_replays",
-            "prefix_hit_tokens"} <= names
+            "prefix_hit_tokens", "tp_dispatches"} <= names
     reg = signal_registry()
     exposition = render_prometheus([EngineMetrics()])
     for name in sorted(names):

@@ -1,0 +1,349 @@
+"""Tensor-parallel paged serving (EngineCfg.tp / ServingEngine(mesh=...)).
+
+One replica spans a tp-wide model-axis mesh slice — params shard per
+LM_TP_RULES, the KV block pool shards on the heads axis, every BlockPool
+device program compiles under GSPMD — and the engine-level pins are:
+
+- **bit identity**: TP=2 output equals TP=1 for greedy AND seeded
+  sampling (the sampling folds run on fully-replicated logits), THROUGH
+  out-of-blocks preemption, a real rejecting spec tick, and a warm
+  restart; the host-side allocator/prefix-cache/CoW logic never sees the
+  mesh, so both pools drain to zero exactly as at tp=1;
+- **structured config errors**: tp that can't split the head axis, tp
+  wider than the local device pool, tp without the paged pool, and a
+  mesh that contradicts cfg.tp all fail at CONSTRUCTION with a message,
+  never as an XLA shape error mid-warmup;
+- **spec resolution** (parallel/sharding.py): LM_TP_RULES head-shards
+  q/k/v, the GQA fallback replicates k/v (params + KV pool) with a
+  RuntimeWarning when num_kv_heads % tp != 0, and the decode-cache specs
+  shard exactly the block-pool leaves;
+- **telemetry**: serve.tp_dispatches / serve.tp_dispatch_us flow only
+  under a mesh, serve.tp_degree gauges the slice width;
+- **fleet** (slow): a 2-process tp=2 fleet serves parent-identical
+  tokens, and a SIGKILLed TP replica is restarted by the supervisor and
+  serves the same tokens again — the spawn env forced exactly its slice
+  of fake CPU devices both times.
+
+Tier-1 cost discipline: the in-process tests share tiny packages and
+one module-scoped TP=2 engine; decode_buckets=False everywhere keeps
+the compiled ladder to one width per program. The process-fleet drill
+rides tier-2 (slow) with the other fleet boots.
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ddw_tpu.models.lm import build_lm
+from ddw_tpu.parallel.sharding import (LM_TP_RULES, check_spec_divisibility,
+                                       decode_cache_shardings,
+                                       lm_tp_rules_for)
+from ddw_tpu.runtime.mesh import MODEL_AXIS
+from ddw_tpu.serve import BlockPool, EngineCfg, ServingEngine
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+
+
+def _lm_pkg(out_dir, seed=0, **cfg_kw):
+    # every TP-sharded dim divides by 2: heads 4, mlp 64, vocab 64
+    kw = dict(vocab_size=VOCAB, max_len=96, hidden=32, depth=2, num_heads=4,
+              mlp_dim=64, dropout=0.0, dtype="float32")
+    kw.update(cfg_kw)
+    cfg = LMCfg(**kw)
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(seed)},
+                        np.zeros((1, 8), np.int32))["params"]
+    d = save_lm_package(str(out_dir), cfg, params, quantize=None)
+    return load_lm_package(d)
+
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    return _lm_pkg(tmp_path_factory.mktemp("tp_target") / "pkg", seed=0)
+
+
+@pytest.fixture(scope="module")
+def dm(tmp_path_factory):
+    # different weights: draft proposals genuinely diverge, so the
+    # sharded spec tick exercises real rejections + rollback
+    return _lm_pkg(tmp_path_factory.mktemp("tp_draft") / "pkg", seed=7)
+
+
+@pytest.fixture(scope="module")
+def eng_tp2(pm):
+    """The shared TP=2 engine — its compiled sharded programs amortize
+    over the greedy/seeded identity pins and the telemetry asserts."""
+    cfg = EngineCfg(n_slots=2, steps_per_tick=2, tp=2,
+                    decode_buckets=False, default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg) as e:
+        yield e
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _pool_clean(pool: BlockPool) -> None:
+    """The leak pin (test_paged_kv idiom): the mesh changes array LAYOUT
+    only — host accounting must drain to zero exactly as at tp=1."""
+    g = pool.gauges()
+    assert g["resident_streams"] == 0
+    assert g["blocks_used"] == 0, g
+    assert g["blocks_free"] + g["blocks_cached"] == g["blocks_total"], g
+    assert int(pool._ref.sum()) == 0
+    assert pool._committed == 0
+    assert pool.free_slots == pool.max_resident
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), (MODEL_AXIS,))
+
+
+# -- structured config errors (satellite: EngineCfg validation) --------------
+
+def test_tp_validation_messages(pm):
+    with pytest.raises(ValueError, match="tp must be >= 1"):
+        EngineCfg(tp=0)
+    with pytest.raises(ValueError, match="requires the paged pool"):
+        EngineCfg(tp=2, paged=False)
+    with pytest.raises(ValueError,
+                       match="does not divide the target model's num_heads"):
+        ServingEngine(lm=pm, cfg=EngineCfg(tp=3))
+    with pytest.raises(ValueError, match="exceeds the local device count"):
+        ServingEngine(lm=pm, cfg=EngineCfg(tp=1024))
+    with pytest.raises(ValueError, match="conflicts with the mesh"):
+        ServingEngine(lm=pm, cfg=EngineCfg(tp=2), mesh=_mesh(4))
+    with pytest.raises(ValueError, match="must carry a"):
+        ServingEngine(lm=pm, cfg=EngineCfg(tp=2),
+                      mesh=Mesh(np.asarray(jax.devices()[:2]), ("data",)))
+
+
+def test_explicit_mesh_sets_the_degree(pm):
+    """ServingEngine(mesh=...) with cfg.tp left at 1 adopts the mesh —
+    the mesh's model-axis size IS the degree."""
+    with ServingEngine(lm=pm, cfg=EngineCfg(n_slots=2, steps_per_tick=2,
+                                            decode_buckets=False,
+                                            default_timeout_s=600.0),
+                       mesh=_mesh(2)) as eng:
+        assert eng.tp_degree == 2
+        assert eng.pool.tp_degree == 2
+        clone = eng.clone_fresh()
+        assert clone.tp_degree == 2        # recovery path keeps the slice
+
+
+# -- spec resolution (satellite: parallel/sharding.py) -----------------------
+
+def test_lm_tp_rules_resolution_and_gqa_fallback():
+    rules, kv_sharded = lm_tp_rules_for(4, 0, 2)
+    assert kv_sharded and rules is LM_TP_RULES
+    assert (rules.spec_for("layers_0/attn/key/kernel", 3)
+            == P(None, MODEL_AXIS, None))
+    assert rules.spec_for("layers_0/head/kernel", 2) == P(None, MODEL_AXIS)
+    # GQA that can't split: q stays sharded, k/v replicate, loudly
+    with pytest.warns(RuntimeWarning, match="num_kv_heads 3 not divisible"):
+        rules, kv_sharded = lm_tp_rules_for(6, 3, 2)
+    assert not kv_sharded
+    assert rules.spec_for("layers_0/attn/key/kernel", 3) == P()
+    assert rules.spec_for("layers_0/attn/value/bias", 2) == P()
+    assert (rules.spec_for("layers_0/attn/query/kernel", 3)
+            == P(None, MODEL_AXIS, None))
+    # the head axis itself not dividing is an error, not a fallback
+    with pytest.raises(ValueError, match="does not divide num_heads 5"):
+        lm_tp_rules_for(5, 0, 2)
+
+
+def test_decode_cache_shardings_shard_exactly_the_kv_pool(pm):
+    model = pm.model.clone(decode=True, slot_decode=False, paged_decode=True,
+                           kv_cache_blocks=9, kv_block_size=8,
+                           seq_axis=None, dropout=0.0)
+    from ddw_tpu.models.lm import init_cache
+    cache = init_cache(model, 1)
+    mesh = _mesh(2)
+    sh = decode_cache_shardings(cache, mesh, kv_sharded=True)
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path): s
+            for path, s in jax.tree_util.tree_leaves_with_path(sh)}
+    kv_keys = [k for k in flat if "kv_block_" in k]
+    assert kv_keys, flat
+    for k, s in flat.items():
+        want = (P(None, None, MODEL_AXIS, None) if "kv_block_" in k
+                else P())
+        assert s.spec == want, (k, s.spec)
+    # GQA fallback replicates the pool wholesale
+    sh = decode_cache_shardings(cache, mesh, kv_sharded=False)
+    for path, s in jax.tree_util.tree_leaves_with_path(sh):
+        assert s.spec == P(), path
+    # indivisible sharded dims refuse loudly (the GSPMD-opaque failure)
+    with pytest.raises(ValueError, match="not divisible"):
+        check_spec_divisibility("kv_block_key", (9, 8, 3, 8),
+                                P(None, None, MODEL_AXIS, None), mesh)
+
+
+# -- bit identity: tp=2 equals tp=1 ------------------------------------------
+
+def test_tp2_greedy_bit_identical_with_tp_telemetry(eng_tp2, pm):
+    """THE acceptance pin: sharding is a pure layout change — the TP=2
+    engine emits exactly the sequential package's greedy tokens, and the
+    dispatch meter proves the programs really ran under the mesh."""
+    prompts = _prompts([5, 12, 3, 17], seed=2)
+    steps = [8, 6, 9, 7]
+    refs = [pm.generate(p[None, :], n)[0] for p, n in zip(prompts, steps)]
+    futs = [eng_tp2.submit_generate(p, n) for p, n in zip(prompts, steps)]
+    for i, f in enumerate(futs):
+        assert np.array_equal(f.result(timeout=300).tokens, refs[i]), i
+    snap = eng_tp2.snapshot()
+    assert snap["serve.tp_dispatches"] > 0
+    assert snap["serve.tp_dispatch_us"] > 0
+    assert snap["serve.tp_dispatch_cost_us"] > 0
+    assert snap["serve.tp_degree"] == 2.0
+    _pool_clean(eng_tp2.pool)
+
+
+def test_tp2_seeded_bit_identical_to_tp1(eng_tp2, pm):
+    """Seeded sampling folds must see byte-identical logits on every
+    shard (the replication constraint before _pick) — same keys, same
+    temperature, same tokens as a TP=1 engine."""
+    prompts = _prompts([6, 11, 4], seed=5)
+    steps = 8
+    cfg = EngineCfg(n_slots=2, steps_per_tick=2, decode_buckets=False,
+                    default_timeout_s=600.0)
+    outs = {}
+    for name, eng in (("tp1", None), ("tp2", eng_tp2)):
+        if eng is None:
+            with ServingEngine(lm=pm, cfg=cfg) as e1:
+                futs = [e1.submit_generate(
+                    p, steps, temperature=0.9,
+                    rng=jax.random.PRNGKey(100 + i))
+                    for i, p in enumerate(prompts)]
+                outs[name] = [f.result(timeout=300).tokens for f in futs]
+        else:
+            futs = [eng.submit_generate(
+                p, steps, temperature=0.9, rng=jax.random.PRNGKey(100 + i))
+                for i, p in enumerate(prompts)]
+            outs[name] = [f.result(timeout=300).tokens for f in futs]
+    for i, (a, b) in enumerate(zip(outs["tp1"], outs["tp2"])):
+        assert np.array_equal(a, b), i
+    _pool_clean(eng_tp2.pool)
+
+
+def test_tp2_identity_through_out_of_blocks_preemption(pm):
+    """block_overcommit starves the TP=2 pool mid-decode: preempt-by-
+    recompute re-queues and resumes BIT-identically, streams see every
+    token exactly once, and the sharded pool drains like the tp=1 one."""
+    prompts = _prompts([30, 31, 33, 34], seed=17)
+    steps = 40
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts]
+    streamed: dict[int, list] = {i: [] for i in range(len(prompts))}
+    cfg = EngineCfg(n_slots=2, steps_per_tick=4, kv_cache_blocks=12,
+                    max_resident=4, block_overcommit=3.0, tp=2,
+                    decode_buckets=False, default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg) as eng:
+        futs = [eng.submit_generate(
+            p, steps, on_token=lambda i, t, j=j: streamed[j].append((i, t)))
+            for j, p in enumerate(prompts)]
+        out = [f.result(timeout=600) for f in futs]
+        snap = eng.snapshot()
+        _pool_clean(eng.pool)
+    assert snap["serve.preemptions"] > 0, "overcommit never ran out"
+    assert snap["serve.tp_dispatches"] > 0
+    for j, (r, ref) in enumerate(zip(out, refs)):
+        assert np.array_equal(r.tokens, ref), j
+        assert [i for i, _ in streamed[j]] == list(range(steps)), j
+
+
+def test_tp2_identity_through_spec_tick_and_warm_restart(pm, dm):
+    """Speculation under the mesh: a different-weights draft forces real
+    rejections + KV rollback per tick; emitted tokens still match the
+    sequential path, BOTH sharded pools drain to zero, and a restart()
+    (the supervisor's warm-rejoin path) re-shards the fresh caches and
+    serves the same tokens again."""
+    prompts = _prompts([5, 17, 2], seed=3)
+    steps = [6, 9, 7]
+    refs = [pm.generate(p[None, :], n)[0] for p, n in zip(prompts, steps)]
+    cfg = EngineCfg(n_slots=2, steps_per_tick=2, spec_k=3, tp=2,
+                    decode_buckets=False, default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg, draft=dm) as eng:
+        futs = [eng.submit_generate(p, n) for p, n in zip(prompts, steps)]
+        for i, f in enumerate(futs):
+            assert np.array_equal(f.result(timeout=600).tokens, refs[i]), i
+        snap = eng.snapshot()
+        assert snap["serve.spec_proposed"] > 0
+        assert snap["serve.spec_rejected"] > 0, "self-agreeing draft?"
+        _pool_clean(eng.pool)
+        _pool_clean(eng._draft_pool)
+    # the supervisor's warm-rejoin path: compiled sharded programs kept,
+    # device state re-initialized (re-sharded caches), same tokens again
+    eng.restart()
+    try:
+        assert eng.tp_degree == 2
+        f = eng.submit_generate(prompts[0], steps[0])
+        assert np.array_equal(f.result(timeout=600).tokens, refs[0])
+        _pool_clean(eng.pool)
+        _pool_clean(eng._draft_pool)
+    finally:
+        eng.stop()
+
+
+# -- the process fleet (tier-2: shares the deploy drills' boot cost) ---------
+
+@pytest.mark.slow   # tier-1 budget (PR 15): in-process TP identity above
+#                     keeps the tier-1 rep; the process boot + SIGKILL
+#                     mechanics already have tier-1 reps in
+#                     test_deploy.py — this drill composes them WITH the
+#                     tp spawn-env discipline, which only a real child
+#                     process (1 inherited device forced up to 2) can show
+def test_tp_fleet_replica_death_supervisor_restarts_warm(tmp_path_factory):
+    from ddw_tpu.deploy import ProcessReplica
+    from ddw_tpu.gateway import Gateway, GatewayClient
+
+    root = tmp_path_factory.mktemp("tp_fleet")
+    pkg = _lm_pkg(root / "pkg", seed=0, max_len=64)
+    model_dir = str(root / "pkg")
+    ref = [int(t) for t in
+           np.asarray(pkg.generate(np.array([[1, 2, 3]]), 4))[0]]
+    reps = [ProcessReplica(model_dir, replica_id=i,
+                           engine_cfg={"n_slots": 2, "steps_per_tick": 2,
+                                       "queue_depth": 16},
+                           tp=2, warmup_lens=(4,), spawn_timeout_s=150.0)
+            for i in range(2)]
+    assert all(r.tp == 2 for r in reps)
+    gw = Gateway(reps, supervisor_kw={"poll_interval_s": 0.1,
+                                      "backoff_base_s": 0.1,
+                                      "backoff_max_s": 0.5, "jitter": 0.0})
+    gw.start(warmup_prompt_lens=(4,))
+    cli = GatewayClient("127.0.0.1", gw.port, timeout_s=90.0, max_retries=8)
+    try:
+        # identity through the process hop: each child booted tp=2 (its
+        # spawn env forced exactly 2 fake host devices — a child that saw
+        # 1 device would have died at construction, "exceeds the local
+        # device count") and serves the parent's tp=1 sequential tokens
+        for _ in range(4):
+            assert cli.generate([1, 2, 3], 4)["tokens"] == ref
+        victim = gw.replica_set.replicas[0]
+        base_restarts = gw.replica_set.restarts[0]
+        victim._proc.kill()
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            h0 = gw.replica_set.fleet_health()[0]
+            if (gw.replica_set.restarts[0] > base_restarts
+                    and h0["state"] == "alive" and h0["circuit"] == "closed"):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"TP replica 0 not restarted: "
+                        f"{gw.replica_set.fleet_health()[0]}")
+        kinds = [(a.replica, a.kind, a.action)
+                 for a in gw.supervisor.attempts]
+        assert (0, "killed", "restarted") in kinds
+        # the reborn child inherited the SAME tp (clone/respawn carry it)
+        assert gw.replica_set.replicas[0].tp == 2
+        assert cli.generate([1, 2, 3], 4)["tokens"] == ref
+    finally:
+        gw.drain(grace_s=10.0)

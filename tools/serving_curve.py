@@ -591,6 +591,82 @@ def spec_ab(hidden, depth, heads, vocab, max_len, prompt_len, steps,
     return out
 
 
+def tp_ab(hidden, depth, heads, vocab, max_len, prompt_len, steps,
+          n_slots, steps_per_tick, spec_k, dtype="float32", requests=8):
+    """The tensor-parallel A/B arm: tp=2 (a 2-wide model-axis mesh over
+    fake CPU devices) vs tp=1 at EQUAL engine config on the SAME workload,
+    plus a spec×TP composition row (tp=2 AND self-draft speculation). The
+    honest claim on a CPU host is mechanics, not speed — collectives over
+    fake devices cost, they don't amortize — so tok/s is reported without
+    a pin and ``tp_dispatch_cost_us`` surfaces what each sharded dispatch
+    paid. DDW_BENCH_SMOKE pins completions bit-identical across ALL THREE
+    arms, equal prefill/decode dispatch counts tp2-vs-tp1, tp counters
+    flowing only under a mesh, and self-draft acceptance still exactly
+    1.0 when speculation runs sharded."""
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    if jax.device_count() < 2:
+        # standalone invocation without forced host devices: the arm needs
+        # a 2-device slice; the smoke/test harness always provides one
+        print("[curve] tp_ab: skipped (needs >= 2 devices)",
+              file=sys.stderr, flush=True)
+        return {"skipped": "needs >= 2 devices"}
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, vocab, size=(prompt_len,)).astype(np.int32)
+               for _ in range(requests)]
+    out = {"requests": requests, "steps": steps, "k": spec_k}
+    completions = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "tp_ab", hidden, depth, heads, vocab,
+                          max_len, dtype=dtype)
+        arms = (("tp1", 1, 0), ("tp2", 2, 0), ("tp2_spec", 2, spec_k))
+        for name, tp, k in arms:
+            cfg = EngineCfg(n_slots=n_slots, tp=tp, spec_k=k,
+                            steps_per_tick=1 if k else steps_per_tick,
+                            queue_depth=4 * requests,
+                            default_timeout_s=600.0)
+            with ServingEngine(lm=pm, cfg=cfg,
+                               draft=pm if k else None) as eng:
+                eng.warmup([prompt_len])
+                eng.generate(prompts[0], steps)     # compile + warm cache
+                eng.metrics = type(eng.metrics)()   # fresh window
+                t0 = time.perf_counter()
+                futs = [eng.submit_generate(p, steps) for p in prompts]
+                completions[name] = [f.result(timeout=600).tokens
+                                     for f in futs]
+                wall = time.perf_counter() - t0
+                snap = eng.snapshot()
+            row = {
+                "tokens_per_sec": round(requests * steps / wall, 1),
+                "decode_ticks": int(snap["serve.decode_ticks"]),
+                "prefills": int(snap["serve.prefills"]),
+                "tp_dispatches": int(snap["serve.tp_dispatches"]),
+                "tp_dispatch_cost_us": round(
+                    snap.get("serve.tp_dispatch_cost_us", 0.0), 1),
+                "spec_acceptance_rate": round(
+                    snap.get("serve.spec_acceptance_rate", 0.0), 4),
+            }
+            out[name] = row
+            print(f"[curve] tp_ab {name}: {row['tokens_per_sec']:.0f} "
+                  f"tok/s, {row['tp_dispatches']} sharded dispatches at "
+                  f"{row['tp_dispatch_cost_us']:.0f} us each",
+                  file=sys.stderr, flush=True)
+    if SMOKE:
+        # THE pin: one replica spanning a mesh slice is a pure layout
+        # change — same tokens, same dispatch schedule, spec acceptance
+        # untouched by sharding
+        for name in ("tp2", "tp2_spec"):
+            for a, b in zip(completions["tp1"], completions[name]):
+                assert np.array_equal(a, b), (name, out)
+        assert out["tp2"]["decode_ticks"] == out["tp1"]["decode_ticks"], out
+        assert out["tp2"]["prefills"] == out["tp1"]["prefills"], out
+        assert out["tp1"]["tp_dispatches"] == 0, out
+        assert out["tp2"]["tp_dispatches"] > 0, out
+        assert out["tp2"]["tp_dispatch_cost_us"] > 0, out
+        assert out["tp2_spec"]["spec_acceptance_rate"] == 1.0, out
+    return out
+
+
 def trace_ab(hidden, depth, heads, vocab, max_len, prompt_len, steps,
              n_slots, steps_per_tick, dtype="float32", requests=32,
              repeats=3):
@@ -774,6 +850,11 @@ def main():
                        prompt_len=16, steps=24, n_slots=4,
                        steps_per_tick=1, spec_k=4, dtype="float32",
                        requests=8)
+        # small model: the arm pins mechanics (identity + dispatch
+        # counts), not throughput — fake-device collectives only cost
+        tp_kw = dict(hidden=64, depth=2, heads=4, vocab=256, max_len=128,
+                     prompt_len=16, steps=16, n_slots=4, steps_per_tick=4,
+                     spec_k=4, dtype="float32", requests=6)
         # hidden 384 (weight-stream-bound decode) for the same reason as
         # eng_kw: long enough walls that the 3% overhead pin has margin
         # over 1-core timing noise, with best-of-3 de-noising on top
@@ -804,6 +885,9 @@ def main():
         spec_kw = dict(hidden=512, depth=6, heads=8, vocab=8192,
                        max_len=2048, prompt_len=64, steps=128, n_slots=16,
                        steps_per_tick=1, spec_k=4, requests=32)
+        tp_kw = dict(hidden=512, depth=6, heads=8, vocab=8192,
+                     max_len=2048, prompt_len=64, steps=128, n_slots=16,
+                     steps_per_tick=8, spec_k=4, requests=32)
         trace_kw = dict(hidden=512, depth=6, heads=8, vocab=8192,
                         max_len=2048, prompt_len=64, steps=128, n_slots=16,
                         steps_per_tick=8, requests=64, repeats=3)
@@ -818,6 +902,7 @@ def main():
         "batch_lanes": batch_lane_curve(**lane_kw),
         "routing_ab": routing_ab(**ab_kw),
         "spec_ab": spec_ab(**spec_kw),
+        "tp_ab": tp_ab(**tp_kw),
         "trace_ab": trace_ab(**trace_kw),
         "telemetry_ab": telemetry_ab(**telem_kw),
     }
