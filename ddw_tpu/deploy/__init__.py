@@ -1,6 +1,6 @@
-"""Process-isolated serving fleet + zero-downtime rolling deploys.
+"""Process-isolated serving fleet + zero-downtime weight rollouts.
 
-Three pieces, layered on the gateway's existing replica contracts:
+Five pieces, layered on the gateway's existing replica contracts:
 
 - :class:`ProcessReplica` — an :class:`~ddw_tpu.serve.ServingEngine` living
   in its own OS process (``_serve_worker`` child), driven over a keep-alive
@@ -10,12 +10,25 @@ Three pieces, layered on the gateway's existing replica contracts:
   restarts both through the one backoff/half-open/shadow-probe path.
 - :mod:`~ddw_tpu.deploy._serve_worker` — the child entrypoint (one engine,
   one single-replica gateway, port-file handshake, SIGTERM → drain).
-- :class:`DeployController` — rolling weight hot-swap under live traffic:
-  drain → restart on the new checkpoint → warmup-gate → shadow-probe
-  rejoin → advance, with abort-and-rollback on a failed step.
+- :class:`DeployController` — strategy-aware weight rollout under live
+  traffic: ``rolling`` (drain → restart on the new checkpoint →
+  warmup-gate → shadow-probe rejoin → advance, abort-and-rollback on a
+  failed step), ``canary`` (roll one replica, hold it at a traffic
+  fraction, judge it, promote or reject), ``surge`` (spawn the new
+  generation before draining the old — capacity never dips).
+- :class:`CanaryJudge` — compares the canary's SLO tails + error counters
+  to the rest-of-fleet baseline (active probes + the per-replica
+  telemetry relay) and returns the promote/reject verdict forensics.
+- :class:`RolloutJournal` — the fsync'd per-step rollout record (JobLedger
+  discipline) that :func:`resume_rollout` replays on gateway restart so a
+  half-rolled fleet always converges to one digest.
 """
 
-from ddw_tpu.deploy.controller import DeployController, DeployStep
+from ddw_tpu.deploy.canary import CanaryJudge
+from ddw_tpu.deploy.controller import (DeployController, DeployStep,
+                                       resume_rollout)
+from ddw_tpu.deploy.journal import RolloutJournal
 from ddw_tpu.deploy.process_replica import ProcessReplica
 
-__all__ = ["DeployController", "DeployStep", "ProcessReplica"]
+__all__ = ["DeployController", "DeployStep", "ProcessReplica",
+           "CanaryJudge", "RolloutJournal", "resume_rollout"]

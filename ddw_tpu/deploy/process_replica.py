@@ -138,6 +138,7 @@ class ProcessReplica:
         self._proc: subprocess.Popen | None = None
         self._client: GatewayClient | None = None
         self._port: int | None = None
+        self.max_workers = max_workers
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_workers,
             thread_name_prefix=f"ddw-preplica{replica_id}")
@@ -292,6 +293,15 @@ class ProcessReplica:
     # -- EngineReplica lifecycle --------------------------------------------
     def start(self) -> "ProcessReplica":
         if self._proc is None or self._proc.poll() is not None:
+            # A stopped replica is restartable: a NEW gateway life over the
+            # same replica objects (the rollout reconciler's restart path)
+            # calls start() after a previous life's drain shut the pool.
+            if getattr(self._pool, "_shutdown", False):
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self.max_workers,
+                    thread_name_prefix=f"ddw-preplica{self.replica_id}")
+            self.failure = None
+            self._draining.clear()
             self._spawn()
         return self
 

@@ -344,14 +344,24 @@ class GatewayClient:
     def healthz(self) -> dict:
         return self._json_call("GET", "/healthz")
 
-    def deploy(self, model_dir: str, rollback: bool = True) -> dict:
-        """Kick off a rolling weight hot-swap (``POST /admin/deploy``).
+    def deploy(self, model_dir: str, rollback: bool = True,
+               strategy: str | None = None,
+               canary_fraction: float | None = None,
+               judge_window_s: float | None = None) -> dict:
+        """Kick off a weight rollout (``POST /admin/deploy``). ``strategy``
+        picks ``rolling`` (default) / ``canary`` / ``surge``;
+        ``canary_fraction`` and ``judge_window_s`` tune the canary hold.
         Returns the initial deploy view; 409 (a rollout is already in
         flight) surfaces as :class:`GatewayError` with the live view in
         the body. Poll :meth:`stats` (the ``deploy`` block) for progress."""
-        return self._json_call("POST", "/admin/deploy",
-                               {"model_dir": model_dir,
-                                "rollback": rollback})
+        body: dict = {"model_dir": model_dir, "rollback": rollback}
+        if strategy is not None:
+            body["strategy"] = strategy
+        if canary_fraction is not None:
+            body["canary_fraction"] = canary_fraction
+        if judge_window_s is not None:
+            body["judge_window_s"] = judge_window_s
+        return self._json_call("POST", "/admin/deploy", body)
 
     def readyz(self) -> tuple[int, dict]:
         status, _h, resp, conn = self._request("GET", "/readyz",

@@ -70,6 +70,7 @@ admission queues.
 
 from __future__ import annotations
 
+import copy
 import json
 import math
 import queue
@@ -217,6 +218,12 @@ class _Handler(BaseHTTPRequestHandler):
                 dep = gw.deploy_view()
                 body["deploying"] = dep["deploying"]
                 body["fleet_generation"] = dep["fleet_generation"]
+                # the abort asymmetry made visible: True whenever live
+                # replica digests disagree (half-rolled fleet, kept-new
+                # winners after an abort) — the same signal the startup
+                # reconciler keys on
+                live = {c for c in dep.get("checkpoints", ()) if c}
+                body["mixed_checkpoints"] = len(live) > 1
                 # SLO degradation detail: a burning objective flips the
                 # "degraded" flag and names itself, but the gateway stays
                 # ready (200) — load balancers weight it down, they don't
@@ -718,9 +725,13 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, fetch(since))
 
     def _admin_deploy(self, gw: "Gateway") -> None:
-        """Kick a rolling weight hot-swap across this gateway's fleet —
-        the ``tools/rolling_deploy.py`` control plane. The rollout runs on
-        its own thread; progress is read back from ``/stats``."""
+        """Kick a weight rollout across this gateway's fleet — the
+        ``tools/rolling_deploy.py`` control plane. ``strategy`` picks
+        rolling (default) / canary / surge; canary takes
+        ``canary_fraction`` (traffic share the held canary receives) and
+        ``judge_window_s`` (how long the judge compares it to the fleet).
+        The rollout runs on its own thread; progress — including the
+        canary verdict timeline — is read back from ``/stats``."""
         body = self._read_body()
         if body is None:
             return
@@ -740,6 +751,25 @@ class _Handler(BaseHTTPRequestHandler):
                                                  "string or null"})
                 return
             kw["draft_dir"] = draft_dir
+        strategy = body.get("strategy", "rolling")
+        if strategy not in ("rolling", "canary", "surge"):
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": "strategy must be one of "
+                                             "rolling|canary|surge"})
+            return
+        kw["strategy"] = strategy
+        for key, lo, hi in (("canary_fraction", 0.0, 1.0),
+                            ("judge_window_s", 0.0, None)):
+            if key not in body:
+                continue
+            v = body[key]
+            if (not isinstance(v, (int, float)) or isinstance(v, bool)
+                    or v < lo or (hi is not None and v > hi)
+                    or (key == "judge_window_s" and v <= 0)):
+                self._send_json(400, {"error": "invalid_request",
+                                      "message": f"{key} out of range"})
+                return
+            kw[key] = float(v)
         try:
             started = gw.start_deploy(model_dir,
                                       rollback=bool(body.get("rollback",
@@ -828,7 +858,8 @@ class Gateway:
                  telemetry_interval_s: float = 0.25,
                  telemetry_capacity: int = 4096, slos=None,
                  slo_kw: dict | None = None,
-                 degradation_dir: str | None = None):
+                 degradation_dir: str | None = None,
+                 deploy_journal_dir: str | None = None):
         self.replica_set = (replicas if isinstance(replicas, ReplicaSet)
                             else ReplicaSet(replicas))
         # end-to-end tracing (docs/observability.md): the gateway mints
@@ -873,10 +904,14 @@ class Gateway:
                 self.slo_monitor = SLOMonitor(
                     slos, tracer=self.tracer, dump_dir=degradation_dir,
                     flight_fn=self._flight_tail, **(slo_kw or {}))
-        # rolling-deploy state, surfaced through /stats and /readyz; the
-        # DeployController thread (start_deploy) mutates it under the lock
+        # rollout state, surfaced through /stats and /readyz; the
+        # DeployController thread (start_deploy) mutates it under the lock.
+        # With ``deploy_journal_dir`` every rollout journals its plan +
+        # per-step progress there (fsync'd), and start() runs a reconciler
+        # that converges whatever a dead gateway left half-rolled.
         self._deploy_lock = threading.Lock()
         self._deploy_thread: threading.Thread | None = None
+        self._deploy_journal_dir = deploy_journal_dir
         self.deploy_status: dict = {"deploying": False, "status": "idle",
                                     "fleet_generation": 0, "steps": []}
 
@@ -908,6 +943,8 @@ class Gateway:
                                                 **kw).start()
         self.jobs.resume(self.replica_set)   # durable ledger: restart any
         #                                      job a dead gateway left behind
+        self._reconcile_deploy()             # rollout journal: converge a
+        #                                      half-rolled fleet the same way
         if self._telemetry and self._telemetry_thread is None:
             self.telem.start()
             self._telemetry_stop.clear()
@@ -918,24 +955,37 @@ class Gateway:
         self.lifecycle.mark_ready()
         return self
 
-    # -- rolling deploys ------------------------------------------------------
+    # -- weight rollouts ------------------------------------------------------
     def deploy_view(self) -> dict:
         """The /stats deploy block: rollout state + per-replica checkpoint
-        ids (what a load balancer or drill needs to observe a rollout)."""
+        ids (what a load balancer or drill needs to observe a rollout).
+        Nested values (steps, the canary verdict forensics, per-replica
+        end states) are deep-copied so readers never alias the
+        controller's live dicts."""
         with self._deploy_lock:
-            out = {k: (list(v) if isinstance(v, list) else v)
-                   for k, v in self.deploy_status.items()}
+            out = copy.deepcopy(self.deploy_status)
         out["checkpoints"] = [h.get("checkpoint")
                               for h in self.replica_set.fleet_health()]
         return out
 
     def start_deploy(self, model_dir: str, rollback: bool = True,
                      **kw) -> bool:
-        """Launch a rolling weight hot-swap across the fleet on a control
-        thread (the ``POST /admin/deploy`` implementation). Returns False
-        when a rollout is already in flight. Requires the supervisor (its
-        recycle path IS the per-replica roll)."""
+        """Launch a weight rollout across the fleet on a control thread
+        (the ``POST /admin/deploy`` implementation; ``kw`` carries
+        ``strategy`` / ``canary_fraction`` / ``judge_window_s`` /
+        ``draft_dir`` through to the controller). Returns False when a
+        rollout is already in flight. Requires the supervisor (its recycle
+        path IS the per-replica roll).
+
+        The whole check → validate → construct → dispatch sequence holds
+        ONE lock: the guard flag and the strategy dispatch used to be two
+        critical sections, so two concurrent POSTs could both pass the
+        guard — and a constructor that raised (bad strategy) left
+        ``deploying`` stuck True with no controller behind it. Now exactly
+        one caller wins, and a failed construction restores the idle
+        state before re-raising."""
         from ddw_tpu.deploy.controller import DeployController
+        from ddw_tpu.deploy.journal import RolloutJournal
 
         if self.supervisor is None:
             raise RuntimeError("rolling deploy needs supervise=True "
@@ -943,17 +993,58 @@ class Gateway:
         with self._deploy_lock:
             if self.deploy_status.get("deploying"):
                 return False
+            prev = dict(self.deploy_status)
             self.deploy_status.update(deploying=True, status="starting",
                                       target_dir=model_dir, steps=[])
-        ctrl = DeployController(self.replica_set, self.supervisor,
-                                model_dir, rollback=rollback,
-                                status=self.deploy_status,
-                                status_lock=self._deploy_lock,
-                                tracer=self.tracer, **kw)
-        self._deploy_thread = threading.Thread(
-            target=ctrl.run, name="ddw-deploy", daemon=True)
-        self._deploy_thread.start()
+            self.deploy_status.pop("canary", None)
+            self.deploy_status.pop("replica_end_state", None)
+            self.deploy_status.pop("resumed", None)
+            try:
+                journal = (RolloutJournal(self._deploy_journal_dir)
+                           if self._deploy_journal_dir else None)
+                ctrl = DeployController(self.replica_set, self.supervisor,
+                                        model_dir, rollback=rollback,
+                                        status=self.deploy_status,
+                                        status_lock=self._deploy_lock,
+                                        tracer=self.tracer,
+                                        journal=journal, **kw)
+                self._deploy_thread = threading.Thread(
+                    target=ctrl.run, name="ddw-deploy", daemon=True)
+                self._deploy_thread.start()
+            except BaseException:
+                self.deploy_status.clear()
+                self.deploy_status.update(prev)
+                raise
         return True
+
+    def _reconcile_deploy(self) -> None:
+        """Startup reconciler (the journal's read side): an unfinished
+        rollout journal — or a mixed-digest fleet with no journal — from a
+        previous gateway life converges on a deploy thread, exactly as a
+        fresh ``start_deploy`` would run it. Best-effort: reconciliation
+        must never block or kill startup."""
+        if not self._deploy_journal_dir or self.supervisor is None:
+            return
+        from ddw_tpu.deploy.controller import resume_rollout
+
+        try:
+            ctrl = resume_rollout(self.replica_set, self.supervisor,
+                                  self._deploy_journal_dir,
+                                  status=self.deploy_status,
+                                  status_lock=self._deploy_lock,
+                                  tracer=self.tracer)
+        except Exception:
+            return
+        if ctrl is None:
+            return
+        with self._deploy_lock:
+            if self.deploy_status.get("deploying"):
+                return
+            self.deploy_status.update(deploying=True, status="resuming",
+                                      steps=[])
+            self._deploy_thread = threading.Thread(
+                target=ctrl.run, name="ddw-deploy", daemon=True)
+            self._deploy_thread.start()
 
     # -- tracing --------------------------------------------------------------
     def trace_summary(self) -> dict | None:
@@ -1040,7 +1131,7 @@ class Gateway:
             "gateway.failed_over": ("counter", float(rs.failed_over)),
         }
         try:
-            scored = rs._scored()
+            scored = rs._scored(weighted=False)
             if scored:
                 out["gateway.projected_wait_ms"] = ("gauge",
                                                     float(scored[0][0]))

@@ -64,7 +64,8 @@ import time
 from ddw_tpu.gateway.prefix_index import PrefixIndex
 from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded,
                                      ReplicaFailed, Unavailable)
-from ddw_tpu.serve.metrics import merge_metrics, render_prometheus
+from ddw_tpu.serve.metrics import (EngineMetrics, merge_metrics,
+                                   render_prometheus)
 
 __all__ = ["ReplicaSet", "CircuitBreaker",
            "CIRCUIT_CLOSED", "CIRCUIT_HALF_OPEN", "CIRCUIT_OPEN"]
@@ -234,6 +235,16 @@ class ReplicaSet:
         #                             Gateway when sampling: replace() must
         #                             clear the dead engine's cached series
         #                             so merged windows don't mix epochs
+        self.fleet_metrics = EngineMetrics()    # fleet-level counters (the
+        #                             rollout lifecycle: canary verdicts,
+        #                             surge spawns, journal resumes) — owned
+        #                             here, not by a replica, so replace()
+        #                             can't lose them; merged into
+        #                             snapshot()/prometheus() with the rest
+        self._canary = None         # (replica index, traffic fraction)
+        #                             while a canary deploy holds one
+        #                             replica at a weighted share
+        self._canary_count = 0      # deterministic diversion counter
         for i, eng in enumerate(self.replicas):
             self._wire(i, eng)
 
@@ -324,7 +335,9 @@ class ReplicaSet:
         return (0.0 if not saved_tokens else -float(saved_tokens),
                 float(outstanding), i)
 
-    def _scored(self, exclude=(), matched=None) -> list:
+    def _scored(self, exclude=(), matched=None, weighted=True) -> list:
+        """``weighted=False`` skips the canary reorder (and its diversion
+        counter) — the telemetry sampler's read-only view."""
         with self._lock:
             outs = list(self._outstanding)
         scored = [self._score(i, outs[i],
@@ -332,7 +345,46 @@ class ReplicaSet:
                   for i in range(len(self.replicas))
                   if i not in exclude and self.breakers[i].available()]
         scored.sort()
-        return scored
+        return self._canary_reorder(scored) if weighted else scored
+
+    # -- canary weighting ----------------------------------------------------
+    def set_canary(self, i: int, fraction: float) -> None:
+        """Hold replica ``i`` at ``fraction`` of eligible traffic while a
+        canary deploy judges it. ``fraction=0`` is a *dark* canary: no
+        client traffic unless every sibling refuses (the canary stays a
+        last-resort spill target — a 429 to the client would be a worse
+        outcome than a canary-served request)."""
+        with self._lock:
+            self._canary = (i, max(0.0, min(1.0, float(fraction))))
+            self._canary_count = 0
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            self._canary = None
+
+    def _canary_reorder(self, scored: list) -> list:
+        """Weighted canary routing over the projected-wait order: a
+        deterministic counter diverts ≈``fraction`` of eligible requests to
+        the canary; everything else prefers the siblings (canary demoted to
+        last-resort spill). The PR 11 tie-break discipline carries over —
+        a diverted request still loses the canary if its projected wait is
+        GENUINELY longer than the best sibling's, so holding a fraction
+        never queues clients behind a struggling canary."""
+        with self._lock:
+            can = self._canary
+            if can is None:
+                return scored
+            self._canary_count += 1
+            n = self._canary_count
+        ci, frac = can
+        canary = [s for s in scored if s[-1] == ci]
+        rest = [s for s in scored if s[-1] != ci]
+        if not canary or not rest:
+            return scored
+        if (int(n * frac) > int((n - 1) * frac)
+                and canary[0][0] <= rest[0][0]):
+            return canary + rest
+        return rest + canary
 
     def _order(self, exclude=(), matched=None) -> list[int]:
         """Healthy replica indices, best candidate first. ``matched`` is
@@ -655,7 +707,8 @@ class ReplicaSet:
 
     # -- fleet metrics -------------------------------------------------------
     def merged_metrics(self):
-        return merge_metrics([eng.metrics for eng in self.replicas])
+        return merge_metrics([eng.metrics for eng in self.replicas]
+                             + [self.fleet_metrics])
 
     def snapshot(self) -> dict[str, float]:
         """Fleet SLO view: the merged engine snapshot plus the routing
@@ -689,5 +742,6 @@ class ReplicaSet:
             gauges[f'ddw_gateway_circuit_state{{replica="{i}"}}'] = \
                 _CIRCUIT_CODE[b.state]
         gauges["ddw_gateway_replicas"] = float(len(self.replicas))
-        return render_prometheus([eng.metrics for eng in self.replicas],
+        return render_prometheus([eng.metrics for eng in self.replicas]
+                                 + [self.fleet_metrics],
                                  extra_gauges=gauges)

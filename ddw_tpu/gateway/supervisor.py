@@ -387,6 +387,40 @@ class ReplicaSupervisor:
         att.readmit = self._readmit(i, eng)
         return True
 
+    # -- surge swap (spawn-before-drain; capacity never dips) -----------------
+    def surge_swap(self, i: int, new_eng) -> bool:
+        """Swap ``new_eng`` (already started AND warmed by the caller — its
+        compile happened off-traffic) into slot ``i`` and retire the old
+        engine by draining it: :meth:`~ddw_tpu.gateway.ReplicaSet.replace`
+        is the atomic cutover, so the slot serves continuously and fleet
+        capacity never dips below N; the old generation's ``stop()`` lets
+        in-flight work run to completion before the process exits (the
+        Horovod-elastic membership-change framing: grow first, shrink
+        after). The building block :class:`~ddw_tpu.deploy.
+        DeployController` uses per replica with ``strategy="surge"``.
+        Returns False (old engine force-failed, swap still landed) only if
+        the retire path raised."""
+        old = self.rs.replicas[i]
+        gen = getattr(new_eng, "generation", 0)
+        t0 = time.monotonic()
+        self.rs.replace(i, new_eng)
+        ok = True
+        try:
+            old.stop()          # SIGTERM path: drains in-flight, then exits
+        except Exception:
+            ok = False
+            try:
+                old.force_fail("surge_retire")
+            except Exception:
+                pass
+        self.rs.note_restart(i)
+        with self._lock:
+            self.attempts.append(ReplicaAttempt(
+                replica=i, generation=gen, kind="deploy",
+                action="surged" if ok else "surge_retire_failed",
+                elapsed_s=time.monotonic() - t0, forensics={}))
+        return ok
+
     def _backoff(self, nth_restart: int) -> float:
         delay = min(self.backoff_max_s,
                     self.backoff_base_s * (2 ** max(0, nth_restart - 1)))

@@ -87,6 +87,37 @@ reached), ``after=0`` (the first matching check fires), ``gen=0`` (the
 supervisor-restarted replica runs clean). The ``after=N`` key counts
 invocations of the matching site *within one replica generation*, so
 "die mid-stream on the 5th decode tick" is deterministic on CPU.
+
+Deploy scope
+------------
+
+Rollouts (:mod:`ddw_tpu.deploy`) get their own arms — a ``deploy:`` spec
+is invisible to both the gang and the serve sites:
+
+    DDW_FAULT=deploy:degrade_canary[:replica=N|*][:ttft_ms=F][:errors=K]
+    DDW_FAULT=deploy:crash_mid_roll[:after=N]
+
+========= ========== ========================================================
+kind       site       effect when the spec matches
+========= ========== ========================================================
+degrade_   judge      the canary judge's measurement of the new-checkpoint
+canary                replica is degraded exactly as a bad checkpoint would
+                      degrade it: ``ttft_ms`` of real latency is injected
+                      into each judge probe against the canary (the probe IS
+                      a request to that replica) and ``errors`` synthetic
+                      probe failures are charged against it — driving the
+                      reject verdict deterministically with zero client
+                      impact
+crash_     mid_roll   raise :class:`DeployCrash` at the journal boundary
+mid_roll              BEFORE rolling the ``after``-th replica — the control
+                      thread dies without finalizing the rollout journal,
+                      the in-process stand-in for a gateway SIGKILL
+                      mid-rollout (the reconciler drills key on it)
+========= ========== ========================================================
+
+``replica`` defaults to ``*`` (any — the judge passes the canary's index);
+``ttft_ms`` defaults to 250; ``errors`` to 0; ``after`` to 0 (crash before
+the first replica rolls).
 """
 
 from __future__ import annotations
@@ -168,6 +199,9 @@ def parse_fault(spec: str) -> FaultSpec | None:
         return None
     if spec.startswith("serve:"):
         parse_serve_fault(spec)     # validate, then ignore at gang sites
+        return None
+    if spec.startswith("deploy:"):
+        parse_deploy_fault(spec)    # validate, then ignore at gang sites
         return None
     parts = spec.split(":")
     kind = parts[0].strip()
@@ -374,6 +408,105 @@ def maybe_serve_fault(site: str, replica: int, n: int, gen: int,
                 return
             time.sleep(0.01)
         raise ServeCrash(f"injected serve stall aborted ({where})")
+
+
+# ---------------------------------------------------------------------------
+# Deploy scope: deterministic arms for the rollout subsystem (ddw_tpu.deploy).
+# ---------------------------------------------------------------------------
+
+DEPLOY_KINDS = ("degrade_canary", "crash_mid_roll")
+DEPLOY_SITES = ("judge", "mid_roll")
+
+
+class DeployCrash(RuntimeError):
+    """Raised by ``deploy:crash_mid_roll`` — the rollout control thread dies
+    at a journal boundary WITHOUT finalizing the journal, the in-process
+    stand-in for a gateway SIGKILL mid-rollout. The reconciler
+    (``Gateway.start``) must converge the half-rolled fleet on restart."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DeployFaultSpec:
+    """Parsed ``DDW_FAULT=deploy:...`` value. ``None`` fields match anything;
+    a bare ``deploy:degrade_canary`` degrades whichever replica the judge is
+    measuring, and a bare ``deploy:crash_mid_roll`` dies before the first
+    replica rolls."""
+
+    kind: str
+    replica: int | None = None    # degrade target (None = any; the judge
+    #                               passes the canary's index)
+    after: int = 0                # mid_roll: journaled steps completed
+    #                               before the crash; judge: Nth probe
+    ttft_ms: float = 250.0        # degrade: latency injected per judge probe
+    errors: int = 0               # degrade: synthetic probe failures charged
+
+    @property
+    def site(self) -> str:
+        return "judge" if self.kind == "degrade_canary" else "mid_roll"
+
+    def matches(self, site: str, replica: int = 0, n: int = 0) -> bool:
+        """Pure matching logic. ``n`` is the caller's invocation count for
+        the site (journaled steps for ``mid_roll``, probes for ``judge``)."""
+        if site != self.site:
+            return False
+        if self.replica is not None and replica != self.replica:
+            return False
+        return n >= self.after
+
+
+def parse_deploy_fault(spec: str) -> DeployFaultSpec | None:
+    """Parse a ``deploy:``-scoped ``DDW_FAULT`` value; non-deploy specs (and
+    empty) -> None. Malformed deploy specs raise, same rule as
+    :func:`parse_fault`."""
+    if not spec or not spec.startswith("deploy:"):
+        return None
+    parts = spec.split(":")[1:]
+    if not parts or parts[0].strip() not in DEPLOY_KINDS:
+        raise ValueError(f"unknown DDW_FAULT deploy kind "
+                         f"{parts[0].strip() if parts else ''!r}; expected "
+                         f"one of {DEPLOY_KINDS}")
+    kind = parts[0].strip()
+    fields: dict[str, object] = {}
+    for part in parts[1:]:
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key, val = key.strip(), val.strip()
+        if key == "replica":
+            fields[key] = None if val == "*" else int(val)
+        elif key in ("after", "errors"):
+            fields[key] = int(val)
+        elif key == "ttft_ms":
+            fields[key] = float(val)
+        else:
+            raise ValueError(f"unknown DDW_FAULT deploy key {key!r} in "
+                             f"{spec!r}")
+    return DeployFaultSpec(kind=kind, **fields)
+
+
+def active_deploy_fault() -> DeployFaultSpec | None:
+    """The currently configured deploy fault, re-read from the env on every
+    call (tests monkeypatch ``DDW_FAULT`` mid-process)."""
+    return parse_deploy_fault(os.environ.get("DDW_FAULT", ""))
+
+
+def maybe_deploy_fault(site: str, replica: int = 0,
+                       n: int = 0) -> DeployFaultSpec | None:
+    """Rollout hook: at ``mid_roll`` a matching ``crash_mid_roll`` raises
+    :class:`DeployCrash`; at ``judge`` a matching ``degrade_canary`` is
+    RETURNED for the caller to apply (the judge injects ``ttft_ms`` into its
+    canary probe and charges ``errors`` against the canary — the
+    perturbation happens where the measurement happens, so no client request
+    is ever touched). No-op (None) without ``DDW_FAULT``."""
+    if "DDW_FAULT" not in os.environ:   # fast path
+        return None
+    spec = active_deploy_fault()
+    if spec is None or not spec.matches(site, replica=replica, n=n):
+        return None
+    if spec.kind == "crash_mid_roll":
+        raise DeployCrash(f"injected mid-roll crash (step {n}): journal "
+                          f"left unfinalized")
+    return spec
 
 
 # ---------------------------------------------------------------------------

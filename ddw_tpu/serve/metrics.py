@@ -165,6 +165,13 @@ class EngineMetrics:
         #                            through the result barrier — ÷
         #                            tp_dispatches = per-dispatch collective
         #                            cost (the spec×TP amortization number)
+        # rollout lifecycle (ddw_tpu.deploy; incremented on the fleet-level
+        # metrics a ReplicaSet owns, so they survive replica replacement)
+        self.canary_promoted = 0   # canary verdicts that continued the roll
+        self.canary_rejected = 0   # canary verdicts that restaged old weights
+        self.surge_spawns = 0      # spawn-before-drain replacements landed
+        self.journal_resumes = 0   # rollouts resumed from a journal after a
+        #                            gateway restart (reconciler path)
         self._gauges: dict[str, float] = {}  # live block-pool state, pushed
         #                            by the engine loop (free/used blocks...)
         self._first_admit: float | None = None
@@ -308,6 +315,10 @@ class EngineMetrics:
                 "serve.export_errors": float(self.export_errors),
                 "serve.tp_dispatches": float(self.tp_dispatches),
                 "serve.tp_dispatch_us": float(self.tp_dispatch_us),
+                "serve.canary_promoted": float(self.canary_promoted),
+                "serve.canary_rejected": float(self.canary_rejected),
+                "serve.surge_spawns": float(self.surge_spawns),
+                "serve.journal_resumes": float(self.journal_resumes),
             }
             looked = self.prefix_hit_blocks + self.prefix_miss_blocks
             out["serve.prefix_hit_rate"] = (
@@ -478,6 +489,14 @@ _COUNTER_HELP = (
     ("batch_tokens_out", "Generated LM tokens on the batch lane."),
     ("records_evicted", "Raw request rows dropped from the bounded record "
      "deque (totals and histograms keep accumulating exactly)."),
+    ("canary_promoted", "Canary deploy verdicts that promoted the new "
+     "checkpoint fleet-wide."),
+    ("canary_rejected", "Canary deploy verdicts that restaged the old "
+     "checkpoint on the canary."),
+    ("surge_spawns", "Surge-deploy replacements landed (new generation "
+     "spawned and warmed before the old one drained)."),
+    ("journal_resumes", "Rollouts resumed from a durable deploy journal "
+     "after a gateway restart."),
 )
 _HISTOGRAMS = ("queue_ms", "ttft_ms", "total_ms")
 

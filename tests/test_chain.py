@@ -67,6 +67,11 @@ def test_chain_plan():
         chain_plan(0, 4)
 
 
+@pytest.mark.slow   # tier-1 budget (PR 16): chain-vs-sequential identity
+#                     keeps tier-1 reps in the grad-accum variant,
+#                     test_lm_chain_matches_sequential and BOTH
+#                     sharded_chain arms below (same K-step machinery,
+#                     stricter compositions); this base sweep rides tier-2
 def test_chain_matches_sequential_steps():
     """K chained updates == K dispatched updates: same per-step losses, same
     params — including a trailing partial chain through the SAME callable

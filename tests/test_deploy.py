@@ -43,7 +43,7 @@ from concurrent.futures import Future
 import numpy as np
 import pytest
 
-from ddw_tpu.deploy import DeployController, ProcessReplica
+from ddw_tpu.deploy import DeployController, ProcessReplica, RolloutJournal
 from ddw_tpu.gateway import Gateway, GatewayClient, ReplicaSet
 from ddw_tpu.serve import JobLedger, Overloaded
 from ddw_tpu.serve.lanes import start_batch_job
@@ -103,6 +103,12 @@ class _FakeSupervisor:
     def recycle(self, i, kind="degraded"):
         self.recycles.append((i, kind))
         return self.rs.replicas[i].recycle()
+
+    def report(self):
+        return {"attempts": [], "recycles": list(self.recycles)}
+
+    def stop(self):
+        pass
 
 
 def test_controller_rolls_fleet_and_bumps_generation():
@@ -462,6 +468,84 @@ def test_kill_process_replica_supervisor_restarts_with_identity(fleet, pkgs):
     assert any(e.get("trace") == "pre-kill-drill" for e in flight["events"])
 
 
+def test_dark_canary_auto_rejects_with_zero_client_impact(fleet, pkgs):
+    """Drill A: a canary deploy of a checkpoint the judge measures as
+    degraded (``deploy:degrade_canary`` injects real latency into the
+    judge's probes of the canary) auto-rejects WITHIN the judgment window,
+    restages the old weights on the canary, and the clients hammering the
+    gateway the whole time see zero failures and zero candidate tokens —
+    at ``canary_fraction=0`` the candidate is completely dark: every
+    served token is bit-identical to the old generation's."""
+    gw, cli = fleet
+    dir_b = pkgs["b"][0]
+    digest_a, ref_a = pkgs["a"][1], pkgs["a"][2]
+    stop = threading.Event()
+    done, failures = [0], []
+
+    def pound():
+        c = GatewayClient("127.0.0.1", gw.port, timeout_s=90.0,
+                          max_retries=8)
+        while not stop.is_set():
+            try:
+                r = c.generate([1, 2, 3], 4)
+                if r["tokens"] != ref_a:     # a candidate token leaked out
+                    failures.append(f"candidate tokens served: "
+                                    f"{r['tokens']}")
+                done[0] += 1
+            except Exception as e:           # noqa: BLE001 — the pin is
+                failures.append(repr(e))     # "no failures of ANY kind"
+
+    workers = [threading.Thread(target=pound, daemon=True)
+               for _ in range(2)]
+    for w in workers:
+        w.start()
+    deadline = time.monotonic() + 30.0
+    while done[0] < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    before = done[0]
+    assert before >= 3
+    # 700ms injected per canary probe vs a tiny warm model's real baseline:
+    # p99 breaches reject_ratio x max(baseline, floor) within ~3 probes
+    os.environ["DDW_FAULT"] = "deploy:degrade_canary:ttft_ms=700"
+    dv = None
+    try:
+        assert cli.deploy(dir_b, strategy="canary", canary_fraction=0.0,
+                          judge_window_s=60.0)
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            dv = cli.stats()["deploy"]
+            if not dv["deploying"]:
+                break
+            time.sleep(0.2)
+    finally:
+        os.environ.pop("DDW_FAULT", None)
+        during = done[0] - before
+        stop.set()
+        for w in workers:
+            w.join(timeout=30.0)
+    assert dv is not None and dv["deploying"] is False
+    assert dv["status"] == "rejected"
+    v = dv["canary"]
+    assert v["verdict"] == "reject" and v["reason"] == "canary_probe_p99"
+    assert v["samples"]["canary"] >= 3 and v["samples"]["baseline"] >= 3
+    events = [t["event"] for t in v["timeline"]]
+    assert events[0] == "window_open" and "verdict" in events
+    # structured forensics: the canary was restored, the rest never touched
+    assert dv["replica_end_state"] == {"0": "restored_old", "1": "untouched"}
+    assert [(s["replica"], s["action"]) for s in dv["steps"]] == \
+        [(0, "recycled"), (0, "canary_rejected"), (0, "rolled_back")]
+    # the fleet converged back to ONE digest — the old one
+    assert dv["checkpoints"] == [digest_a, digest_a]
+    status, ready = cli.readyz()
+    assert status == 200 and ready["mixed_checkpoints"] is False
+    assert ready["fleet_generation"] == 0    # a rejected canary never bumps
+    # zero client impact, bit-identical tokens, goodput through the drill
+    assert not failures, failures[:5]
+    assert during > 0
+    assert cli.generate([1, 2, 3], 4)["tokens"] == ref_a
+    assert cli.stats()["serve.canary_rejected"] >= 1.0
+
+
 @pytest.mark.slow   # tier-1 budget (PR 12): the rollout machinery keeps
 #                     its tier-1 reps above (controller roll/abort logic,
 #                     process-fleet bit-identity + deploy state, SIGKILL
@@ -523,3 +607,171 @@ def test_rolling_deploy_cli_zero_dropped_requests_under_load(fleet, pkgs):
     # a deploy is idempotent forensics-wise: the record survives in /stats
     dv = cli.stats()["deploy"]
     assert dv["deploying"] is False and dv["target_checkpoint"] == digest_b
+
+
+# -- crash-resumable journal + surge, on REAL process fleets ------------------
+
+
+@pytest.mark.slow   # tier-1 budget: the reconciler's resume/rollback logic
+#                     keeps its tier-1 reps in tests/test_rollout.py (pure
+#                     fakes: crash->resume, verdictless-canary rollback,
+#                     majority-digest convergence, torn journal rows); this
+#                     drill re-runs the same journal machinery across two
+#                     REAL gateway lives over respawned OS processes, so it
+#                     rides tier-2 with the other process soaks
+def test_journal_resumes_half_rolled_process_fleet_across_gateway_lives(
+        pkgs, tmp_path_factory):
+    """Drill B: DDW_FAULT=deploy:crash_mid_roll kills the rollout control
+    thread after replica 0 rolled (the gateway-SIGKILL stand-in; the
+    journal is left unfinalized and the fleet mixed). A SECOND gateway
+    life over the same replicas finds the journal at start(), resumes the
+    roll, and the fleet converges to a uniform NEW digest with
+    ``journal_resumes`` counted and the journal finalized."""
+    dir_a = pkgs["a"][0]
+    dir_b, digest_b, ref_b = pkgs["b"]
+    jdir = str(tmp_path_factory.mktemp("rollout_journal"))
+    reps = [ProcessReplica(dir_a, replica_id=i, engine_cfg=ENGINE_CFG,
+                           warmup_lens=(4,), spawn_timeout_s=150.0)
+            for i in range(2)]
+    sup_kw = {"poll_interval_s": 0.1, "backoff_base_s": 0.1,
+              "backoff_max_s": 0.5, "jitter": 0.0}
+    gw1 = Gateway(reps, supervisor_kw=sup_kw, deploy_journal_dir=jdir)
+    gw1.start(warmup_prompt_lens=(4,))
+    cli1 = GatewayClient("127.0.0.1", gw1.port, timeout_s=90.0,
+                         max_retries=8)
+    os.environ["DDW_FAULT"] = "deploy:crash_mid_roll:after=1"
+    try:
+        assert cli1.deploy(dir_b)
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            dv = cli1.stats()["deploy"]
+            if not dv["deploying"]:
+                break
+            time.sleep(0.2)
+        assert dv["status"] == "crashed"
+        # life 1 died half-rolled: mixed digests, journal NOT finalized
+        assert sorted(dv["checkpoints"]) == sorted([digest_b, pkgs["a"][1]])
+        status, ready = cli1.readyz()
+        assert status == 200 and ready["mixed_checkpoints"] is True
+        left = RolloutJournal.load(jdir)
+        assert left is not None and left["meta"]["status"] == "rolling"
+        assert left["meta"]["target_dir"] == dir_b
+    finally:
+        os.environ.pop("DDW_FAULT", None)
+        gw1.drain(grace_s=10.0)
+
+    # life 2: same replica objects, same journal dir. start() respawns the
+    # children (each on the checkpoint it last held) and the reconciler
+    # resumes the unfinished rollout with no operator action.
+    gw2 = Gateway(reps, supervisor_kw=sup_kw, deploy_journal_dir=jdir)
+    gw2.start(warmup_prompt_lens=(4,))
+    cli2 = GatewayClient("127.0.0.1", gw2.port, timeout_s=90.0,
+                         max_retries=8)
+    try:
+        deadline = time.monotonic() + 240.0
+        dv = cli2.stats()["deploy"]
+        while time.monotonic() < deadline:
+            dv = cli2.stats()["deploy"]
+            if not dv["deploying"] and dv["status"] == "done":
+                break
+            time.sleep(0.2)
+        assert dv["status"] == "done" and dv.get("resumed") is True
+        # the half-rolled fleet converged to ONE digest — the target's
+        assert dv["checkpoints"] == [digest_b, digest_b]
+        status, ready = cli2.readyz()
+        assert status == 200 and ready["mixed_checkpoints"] is False
+        assert cli2.generate([1, 2, 3], 4)["tokens"] == ref_b
+        assert cli2.stats()["serve.journal_resumes"] >= 1.0
+        # replica 0 (already current) was NOT re-recycled; only 1 rolled
+        acts = [(s["replica"], s["action"]) for s in dv["steps"]]
+        assert (0, "already_current") in acts and (1, "recycled") in acts
+        assert RolloutJournal.load(jdir) is None    # finalized: terminal
+    finally:
+        gw2.drain(grace_s=10.0)
+
+
+@pytest.mark.slow   # tier-1 budget: surge's spawn-before-drain semantics
+#                     keep their tier-1 reps in tests/test_rollout.py
+#                     (scripted fakes: swap ordering, spawn-failure abort);
+#                     this drill pins the CAPACITY claim on real OS
+#                     processes — 2 extra child spawns — so it rides tier-2
+def test_surge_deploy_capacity_never_dips_on_process_fleet(
+        pkgs, tmp_path_factory):
+    """Drill C: a surge deploy spawns + warms each new-generation child
+    BEFORE its predecessor drains. Sampled continuously through the roll,
+    the number of alive replicas never drops below the pre-rollout fleet
+    size, clients see zero failures, and every retired child exited 0
+    (drained, not killed)."""
+    dir_a = pkgs["a"][0]
+    dir_b, digest_b, ref_b = pkgs["b"]
+    reps = [ProcessReplica(dir_a, replica_id=i, engine_cfg=ENGINE_CFG,
+                           warmup_lens=(4,), spawn_timeout_s=150.0)
+            for i in range(2)]
+    gw = Gateway(reps, supervisor_kw={"poll_interval_s": 0.1,
+                                      "backoff_base_s": 0.1,
+                                      "backoff_max_s": 0.5, "jitter": 0.0})
+    gw.start(warmup_prompt_lens=(4,))
+    cli = GatewayClient("127.0.0.1", gw.port, timeout_s=90.0, max_retries=8)
+    old_procs = [r._proc for r in gw.replica_set.replicas]
+    stop = threading.Event()
+    done, failures, min_alive = [0], [], [len(reps)]
+
+    def pound():
+        c = GatewayClient("127.0.0.1", gw.port, timeout_s=90.0,
+                          max_retries=8)
+        while not stop.is_set():
+            try:
+                c.generate([1, 2, 3], 4)
+                done[0] += 1
+            except Exception as e:               # noqa: BLE001
+                failures.append(repr(e))
+
+    def watch_capacity():
+        while not stop.is_set():
+            alive = sum(1 for h in gw.replica_set.fleet_health()
+                        if h["state"] == "alive")
+            min_alive[0] = min(min_alive[0], alive)
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=pound, daemon=True)
+               for _ in range(2)]
+    threads.append(threading.Thread(target=watch_capacity, daemon=True))
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 30.0
+        while done[0] < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        before = done[0]
+        assert before >= 3
+        assert cli.deploy(dir_b, strategy="surge")
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            dv = cli.stats()["deploy"]
+            if not dv["deploying"]:
+                break
+            time.sleep(0.2)
+        during = done[0] - before
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+    try:
+        assert dv["status"] == "done"
+        assert min_alive[0] >= len(reps)         # capacity NEVER dipped
+        assert not failures, failures[:5]
+        assert during > 0
+        assert dv["checkpoints"] == [digest_b, digest_b]
+        assert dv["replica_end_state"] == {"0": "kept_new", "1": "kept_new"}
+        assert cli.generate([1, 2, 3], 4)["tokens"] == ref_b
+        assert cli.stats()["serve.surge_spawns"] >= 2.0
+        status, ready = cli.readyz()
+        assert status == 200 and ready["fleet_generation"] == 1
+        # the retired generation DRAINED: SIGTERM-handled clean exits, and
+        # the surged children are genuinely new OS processes
+        for p in old_procs:
+            assert p.wait(timeout=30.0) == 0
+        new_pids = {r._proc.pid for r in gw.replica_set.replicas}
+        assert new_pids.isdisjoint({p.pid for p in old_procs})
+    finally:
+        gw.drain(grace_s=10.0)

@@ -40,7 +40,10 @@ _EXAMPLES = [
                   "train.epochs=1"], "best", marks=_slow),
     pytest.param("05_hyperopt_distributed.py",
                  ["tune.max_evals=2", "train.epochs=1"], "best", marks=_slow),
-    ("06_packaged_inference.py", ["train.epochs=1"], "distributed scoring"),
+    # tier-1 budget (PR 16): packaged-inference coverage keeps tier-1 reps
+    # in test_lm_package's roundtrip + scorer tests; both 06 arms tier-2
+    pytest.param("06_packaged_inference.py", ["train.epochs=1"],
+                 "distributed scoring", marks=_slow),
     pytest.param("06_packaged_inference.py", ["--int8", "train.epochs=1"],
                  "int8 weight-only", marks=_slow),
     pytest.param("08_pretrained_transfer.py",

@@ -319,6 +319,11 @@ def test_bucketed_decode_ladder_token_identity(fleet, pm):
         pool.decode_buckets = True
 
 
+@pytest.mark.slow   # tier-1 budget (PR 16): the bucketed-ladder identity
+#                     class keeps its tier-1 rep in
+#                     test_bucketed_decode_ladder_token_identity above
+#                     (same pow2 dispatch arithmetic under live traffic);
+#                     this shrink/regrow arithmetic sweep rides tier-2
 def test_bucket_ladder_shrinks_and_regrows_deterministically(pm):
     """Pool-level bucket arithmetic across admissions/releases: the tick
     dispatches exactly the smallest pow2 bucket covering the highest live

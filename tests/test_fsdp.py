@@ -296,6 +296,10 @@ def _vit_setup(n_data, n_model, opt="adam"):
     return mesh, m, state, tx
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): FSDPxTP keeps tier-1 reps in
+#                    test_fsdp_tp_lm_2d + test_fsdp_tp_learns_on_2x4 (same
+#                    2d mesh, LM + learning arms); this tiling-equivalence
+#                    sweep rides tier-2
 def test_fsdp_tp_2d_tiling_and_equivalence():
     """2D FSDP x TP: params tile over BOTH mesh axes and one step matches the
     plain DP step on the same global batch."""

@@ -99,6 +99,10 @@ def test_dpxsp_train_step_matches_pure_dp():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): the LM learn pin keeps its
+#                    tier-1 rep in test_lm_trainer.py::test_fit_learns_dp
+#                    (same model through the fit loop); this model-level
+#                    soak rides tier-2
 def test_lm_learns_fixed_sequence():
     """A few steps of the DPxSP step memorize a constant next-token pattern."""
     n = 4
@@ -193,7 +197,8 @@ def test_decode_path_matches_full_forward():
                                np.asarray(full_logits), atol=2e-4)
 
 
-@pytest.mark.slow  # ~12s; learn pin stays in test_lm_learns_fixed_sequence,
+@pytest.mark.slow  # ~12s; learn pin stays tier-1 in
+#                    test_lm_trainer.py::test_fit_learns_dp,
 #                    generate identity in test_decode_path_matches_full_forward
 def test_generate_continues_memorized_pattern():
     """Train on the arange successor pattern, then greedy-generate continues it."""
@@ -341,6 +346,10 @@ def test_generate_top_k_top_p():
         generate(model, params, prompt, 4, top_k=5)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): grad-accum equivalence keeps
+#                    tier-1 reps in test_train_step.py (vision twin),
+#                    test_chain's grad-accum chain arm and test_zero's
+#                    accum-vs-single-shot pin; the LM variant rides tier-2
 def test_lm_grad_accum_equivalence():
     """grad_accum_steps=2 == one full-batch LM step (dropout off, SGD so the
     update is linear in the gradients)."""

@@ -67,6 +67,11 @@ def test_int8_package_close_and_smaller(tmp_path):
     np.testing.assert_allclose(nll8, nll32, rtol=0.05, atol=0.05)
 
 
+@pytest.mark.slow   # tier-1 budget (PR 16): package roundtrip keeps its
+#                     tier-1 rep in test_roundtrip_scores_and_generation_
+#                     match above, and spec-decode identity keeps
+#                     test_spec_engine's greedy A/B; this packaged
+#                     draft+target composition rides tier-2
 def test_speculative_from_packages(tmp_path):
     cfg, model, params = _trained(seed=0)
     dcfg, dmodel, dparams = _trained(seed=7)

@@ -109,6 +109,11 @@ def test_remat_moe_telemetry_still_sown():
     assert leaves, "no sown intermediates under remat"
 
 
+@pytest.mark.slow   # tier-1 budget (PR 16): remat correctness keeps the
+#                     grad-equality params above tier-1, and decode-vs-full
+#                     identity keeps test_lm.py::test_decode_path_matches_
+#                     full_forward; this remat x decode neutrality sweep
+#                     rides tier-2
 def test_decode_ignores_remat():
     """decode=True never wraps blocks (no backward in decode); generation
     from a remat-trained model is exercised via shared params."""

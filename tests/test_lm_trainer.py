@@ -65,6 +65,10 @@ def test_checkpoint_resume_continues(tmp_path):
     assert res4.history[0]["epoch"] == 2
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): ckpt-resume keeps tier-1 reps in
+#                    test_fit_pipeline_gpipe_and_resume,
+#                    test_fit_sharded_state_and_resume and test_resume.py;
+#                    this already-complete bookkeeping edge rides tier-2
 def test_resume_already_complete_returns_checkpointed_metrics(tmp_path):
     """resume=True on a checkpoint that already covers cfg.epochs must not
     silently return NaN: it warns and returns the checkpoint's own last
@@ -106,8 +110,9 @@ def test_fit_pipeline_gpipe_and_resume(tmp_path):
     assert res4.history[0]["epoch"] == 2
 
 
-# tier-2: EMA x pipeline variant drill (EMA shadow-eval pin stays
-# tier-1 in test_ema_evaluates_shadow; pipeline fit in the gpipe arm)
+# tier-2: EMA x pipeline variant drill (EMA shadow pins stay tier-1 in
+# test_ema_composes_with_zero + test_ema_cosine.py's vision end-to-end;
+# pipeline fit in the gpipe arm)
 @pytest.mark.slow
 def test_fit_pipeline_with_ema():
     """pipeline_stages + ema_decay: the shadow is pp-layout opt_state, rides
@@ -220,6 +225,10 @@ def test_pipeline_refusals():
         LMTrainer(dataclasses.replace(lm, depth=4), tr, mesh=no_data)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): cosine-schedule shape + floor
+#                    keep tier-1 reps in test_ema_cosine.py, early-stop in
+#                    test_trainer.py::test_early_stopping (vision twin);
+#                    this LM-side combination rides tier-2
 def test_cosine_schedule_and_early_stop():
     lm, tr = _cfgs(num_devices=4, lr_schedule="cosine", epochs=4,
                    early_stop_patience=1)
@@ -366,6 +375,10 @@ def test_ema_composes_with_zero():
     assert ema_params(res.state) is not None
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): EMA shadow-eval keeps tier-1
+#                    reps in test_ema_cosine.py::test_trainer_ema_and_cosine
+#                    (vision end-to-end) + test_ema_composes_with_zero
+#                    above; this LM shadow-lag pin rides tier-2
 def test_ema_evaluates_shadow():
     """train.ema_decay through LMTrainer: the fit runs, eval reads the
     Polyak shadow, and the shadow differs from the raw params (it lags)."""

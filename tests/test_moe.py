@@ -128,6 +128,10 @@ def test_moe_lm_ep_train_step_matches_dense():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): MoE train math keeps its tier-1
+#                    pin in test_moe_lm_ep_train_step_matches_dense (+ the
+#                    top2 EP-vs-dense arm); this learning soak rides tier-2
+#                    with test_top2_lm_trains_and_validates
 def test_moe_lm_learns():
     """A few MoE LM steps memorize a repeating pattern; aux loss stays near 1
     (balanced) rather than collapsing to one expert."""
@@ -173,7 +177,8 @@ def test_moe_step_rejects_foreign_expert_axis():
                            seq_axis=None)
 
 
-@pytest.mark.slow  # ~9s; tier-1 reps: test_moe_lm_learns (moe training)
+@pytest.mark.slow  # ~9s; tier-1 reps: test_moe_lm_ep_train_step_matches_dense
+# (moe train math)
 # + test_lm.py::test_decode_path_matches_full_forward (decode identity)
 def test_moe_decode_path_matches_full_forward():
     """KV-cached decode of an MoE LM (dense experts, per-call routing) ==
@@ -261,7 +266,8 @@ def test_top2_moe_lm_ep_matches_dense():
 
 
 @pytest.mark.slow  # ~8s; top2 keeps tier-1 reps in routing invariants +
-#                    EP-matches-dense, the MoE train pin in test_moe_lm_learns
+#                    EP-matches-dense, the MoE train-math pin in
+#                    test_moe_lm_ep_train_step_matches_dense
 def test_top2_lm_trains_and_validates():
     model = TransformerLM(vocab_size=VOCAB, max_len=64, hidden=32, depth=2,
                           num_heads=2, mlp_dim=64, dropout=0.0,

@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
@@ -43,6 +45,9 @@ def test_dot_rows_parses_both_forms():
     assert len(a["top_shapes"]) == 2
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): the dot-row parser keeps its
+#                    tier-1 unit above; this end-to-end LM smoke rides
+#                    tier-2 with the bench arms it instruments
 def test_smoke_end_to_end_lm():
     env = dict(os.environ, DDW_BENCH_SMOKE="1", PALLAS_AXON_POOL_IPS="",
                JAX_PLATFORMS="cpu",

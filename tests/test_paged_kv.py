@@ -307,6 +307,11 @@ def test_out_of_blocks_preemption_resumes_token_identically(pm):
 
 # -- capacity: equal memory, more streams ------------------------------------
 
+@pytest.mark.slow   # tier-1 budget (PR 16): block admission keeps its
+#                     tier-1 reps in test_admission_on_blocks_backpressures
+#                     _and_completes + the pool-unit refcount test; this
+#                     equal-memory capacity A/B rides tier-2 with the
+#                     serving-curve capacity arms
 def test_equal_memory_admits_2x_resident_streams(pm):
     """Same KV bytes (paged default derives blocks from n_slots * cap):
     the slot pool tops out at n_slots resident; the paged pool holds the

@@ -185,6 +185,10 @@ def test_pp_learns_fixed_sequence():
     assert float(metrics["accuracy"]) > 0.9
 
 
+@pytest.mark.slow   # tier-1 budget (PR 16): pp-matches-single-device stays
+#                     tier-1 above (both schedules) and dp averaging keeps
+#                     test_lm.py's dpxsp-vs-pure-dp pin; the dp x pp
+#                     COMPOSITION rides tier-2 like the rope-pp arm
 def test_dp_x_pp_matches_pure_pp():
     """(data=2, pipe=4) == (pipe=4) on the same global batch: DP replicas of
     the pipeline average to the same gradients."""

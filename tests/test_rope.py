@@ -97,6 +97,11 @@ def test_rope_position_sensitivity():
                            atol=1e-5)
 
 
+@pytest.mark.slow   # tier-1 budget (PR 16): rope math keeps the unit pins
+#                     above tier-1 and decode-vs-full identity keeps
+#                     test_lm.py::test_decode_path_matches_full_forward
+#                     (learned-pos twin); this rope decode sweep rides
+#                     tier-2 with the sp-ring / pp composition arms
 def test_rope_decode_matches_full_forward():
     """Prefill + per-token decode through the rotated KV cache reproduces the
     full causal forward (the rope analog of

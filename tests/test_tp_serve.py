@@ -233,6 +233,12 @@ def test_tp2_seeded_bit_identical_to_tp1(eng_tp2, pm):
     _pool_clean(eng_tp2.pool)
 
 
+@pytest.mark.slow   # tier-1 budget (PR 16): tp2 identity keeps its tier-1
+#                     reps in the greedy + seeded drills above, and the
+#                     preempt-by-recompute identity class keeps
+#                     test_spec_engine.py::test_spec_preempt_resume_bit_identical_exactly_once;
+#                     this tp x preemption COMPOSITION rides tier-2 (same
+#                     rationale as the rope-pp composition move in PR 11)
 def test_tp2_identity_through_out_of_blocks_preemption(pm):
     """block_overcommit starves the TP=2 pool mid-decode: preempt-by-
     recompute re-queues and resumes BIT-identically, streams see every
@@ -258,6 +264,11 @@ def test_tp2_identity_through_out_of_blocks_preemption(pm):
         assert [i for i, _ in streamed[j]] == list(range(steps)), j
 
 
+@pytest.mark.slow   # tier-1 budget (PR 16): tp2 identity keeps the greedy +
+#                     seeded tier-1 reps above, spec rollback identity keeps
+#                     test_spec_engine's greedy A/B + preempt drills, and
+#                     warm-restart keeps test_fleet_prefix's recycle-warm
+#                     -replay pin; this three-way composition rides tier-2
 def test_tp2_identity_through_spec_tick_and_warm_restart(pm, dm):
     """Speculation under the mesh: a different-weights draft forces real
     rejections + KV rollback per tick; emitted tokens still match the

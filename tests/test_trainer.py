@@ -95,6 +95,10 @@ def test_tracker_records_run(small_cfgs, silver, tmp_path):
     assert "images_per_sec" in got.final_metrics()
 
 
+@pytest.mark.slow  # tier-1 budget (PR 16): the per-epoch callback path
+#                    keeps tier-1 reps in test_early_stopping (epoch-end
+#                    metric plumbing) + test_tracker_records_run (per-epoch
+#                    records); this hook-contract sweep rides tier-2
 def test_on_epoch_hook(small_cfgs, silver, tmp_path):
     """on_epoch sees each history row; returning True stops training — the
     HPO-pruner integration point (ddw_tpu.tune.pruner reports through it)."""

@@ -293,6 +293,11 @@ def test_distributed_featurization_matches_single(tmp_path):
         (HW, HW), worker_index=1, worker_count=2, batch_size=4) is None
 
 
+@pytest.mark.slow   # tier-1 budget (PR 16): the features-path equivalence
+#                     keeps tier-1 reps in test_distributed_featurization_
+#                     matches_single and the end-to-end
+#                     test_train_frozen_via_features_end_to_end below;
+#                     this single-step loss/params pin rides tier-2
 def test_head_on_features_matches_frozen_full_step(tmp_path):
     """One head-only train step on cached features == one frozen full-model
     step: same loss, same updated head params (dropout ACTIVE — both paths

@@ -49,7 +49,11 @@ goodput stays above zero through the death (the circuit opens and routes
 around the corpse; failed requests surface as structured 503s the client's
 backoff absorbs), the supervisor restarts the replica within budget, and
 it is serving again (generation bumped, circuit re-closed) by the end of
-the run. Prints one JSON line with the load row + the recovery record. The burst is the honest 1-core framing: replicas sharing a core
+the run. Prints one JSON line with the load row + the recovery record.
+The ``--canary`` arm is the rollout-safety sibling: a dark-canary deploy
+with ``DDW_FAULT=deploy:degrade_canary`` armed must auto-reject and
+restage the old weights with zero failed client requests and
+bit-identical tokens throughout. The burst is the honest 1-core framing: replicas sharing a core
 cannot exceed its service rate (the closed rows prove that), but doubling
 slot capacity halves queue wait for a burst, so strictly more requests
 complete within their SLO — and the shed ones cost no device time. On a
@@ -685,6 +689,118 @@ def deploy_arm(prompt_len=8, steps=8, n_slots=2, clients=3, hidden=32,
         return out
 
 
+def canary_arm(prompt_len=8, steps=8, n_slots=2, clients=3, hidden=32,
+               depth=1, window_s=6.0, degrade_ttft_ms=400.0):
+    """Rejected canary under live closed-loop load — the safe-rollout pin.
+
+    Same 2-process fleet and worker loop as :func:`deploy_arm`, but the
+    rollout is a DARK canary (``canary_fraction=0.0`` — the judge's
+    active probes are the only traffic the new checkpoint sees) and
+    ``DDW_FAULT=deploy:degrade_canary`` injects ``degrade_ttft_ms`` of
+    latency into each judge probe against it. The judge must reject
+    within the window, the controller must restage package A on the
+    canary, and — the pin — not ONE client request fails and a pinned
+    greedy probe returns bit-identical tokens before, during, and after:
+    a bad checkpoint burned zero client requests."""
+    import tempfile
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.deploy import ProcessReplica
+    from ddw_tpu.gateway import Gateway, GatewayClient, GatewayError
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pkg_a = _make_lm_pkg(tmp, "pkg_a", hidden, depth, 2, 64, 64,
+                             dtype="float32", seed=0)
+        _make_lm_pkg(tmp, "pkg_b", hidden, depth, 2, 64, 64,
+                     dtype="float32", seed=1)
+        dir_a, dir_b = os.path.join(tmp, "pkg_a"), os.path.join(tmp, "pkg_b")
+        cfgd = {"n_slots": n_slots, "min_bucket": prompt_len,
+                "default_timeout_s": 600.0}
+        gw = Gateway([ProcessReplica(dir_a, replica_id=i, engine_cfg=cfgd,
+                                     warmup_lens=(prompt_len,))
+                      for i in range(2)],
+                     grace_s=60.0,
+                     deploy_journal_dir=os.path.join(tmp, "journal"),
+                     supervisor_kw=dict(poll_interval_s=0.1,
+                                        backoff_base_s=0.1, jitter=0.0))
+        gw.start(warmup_prompt_lens=(prompt_len,))
+        rng = np.random.RandomState(0)
+        probe = rng.randint(0, 64, size=(prompt_len,)).astype(np.int32)
+        stop = threading.Event()
+        lock = threading.Lock()
+        done, failures = [0], []
+
+        def worker():
+            cli = _client(gw.url, retries=8)
+            while not stop.is_set():
+                p = rng.randint(0, 64, size=(prompt_len,)).astype(np.int32)
+                try:
+                    cli.generate(p, steps)
+                    with lock:
+                        done[0] += 1
+                except (GatewayError, OSError) as e:
+                    with lock:
+                        failures.append(repr(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(clients)]
+        prev_fault = os.environ.get("DDW_FAULT")
+        os.environ["DDW_FAULT"] = (
+            f"deploy:degrade_canary:ttft_ms={degrade_ttft_ms:g}")
+        try:
+            for t in threads:
+                t.start()
+            cli = GatewayClient("127.0.0.1", gw.port, max_retries=2)
+            ref = cli.generate(probe, steps)["tokens"]   # old-gen identity
+            while done[0] < clients:       # traffic demonstrably flowing
+                time.sleep(0.05)
+            before = done[0]
+            t0 = time.perf_counter()
+            cli.deploy(dir_b, strategy="canary", canary_fraction=0.0,
+                       judge_window_s=window_s)
+            while cli.stats()["deploy"]["deploying"]:
+                time.sleep(0.25)
+            roll_s = time.perf_counter() - t0
+            during = done[0] - before
+            after = cli.generate(probe, steps)["tokens"]
+            stats = cli.stats()
+            dv = stats["deploy"]
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+            if prev_fault is None:
+                os.environ.pop("DDW_FAULT", None)
+            else:
+                os.environ["DDW_FAULT"] = prev_fault
+            gw.stop()
+        out = {"reject_s": round(roll_s, 2),
+               "completed_during_rollout": during,
+               "completed_total": done[0], "failed": len(failures),
+               "failures": failures[:4],
+               "deploy": {"status": dv["status"],
+                          "checkpoints": dv["checkpoints"],
+                          "replica_end_state": dv.get("replica_end_state"),
+                          "verdict": dv.get("canary", {}).get("verdict"),
+                          "reason": dv.get("canary", {}).get("reason")},
+               "canary_rejected": int(stats.get("serve.canary_rejected",
+                                                0)),
+               "identity_preserved": list(ref) == list(after)}
+        print(f"[load_gen] canary: {dv.get('canary', {}).get('verdict')} "
+              f"({dv.get('canary', {}).get('reason')}) in {roll_s:.1f}s, "
+              f"{during} completed mid-rollout, {len(failures)} failed, "
+              f"fleet on {dv['checkpoints']}", file=sys.stderr, flush=True)
+        assert during > 0, out                     # goodput mid-rollout
+        assert not failures, out                   # zero failed requests
+        assert dv["status"] == "rejected", out
+        assert dv.get("canary", {}).get("verdict") == "reject", out
+        assert all(c == pkg_a.content_digest
+                   for c in dv["checkpoints"]), out   # old weights restaged
+        assert out["canary_rejected"] >= 1, out
+        assert out["identity_preserved"], out
+        return out
+
+
 def trace_arm(prompt_len=8, steps=8, requests=12, n_slots=2, clients=3,
               hidden=32, depth=1, out_path=None):
     """End-to-end tracing over the real 2-PROCESS fleet — the PR-13 pin.
@@ -921,6 +1037,12 @@ def main():
                          "across a 2-process-replica fleet under live "
                          "closed-loop load (asserts zero failures and "
                          "goodput > 0 mid-rollout)")
+    ap.add_argument("--canary", action="store_true",
+                    help="self-hosted canary-reject arm: dark canary "
+                         "rollout on a 2-process-replica fleet with an "
+                         "injected degrade fault; asserts auto-reject, "
+                         "old weights restaged, zero failed client "
+                         "requests, bit-identical tokens throughout")
     ap.add_argument("--fleet-prefix", action="store_true",
                     help="self-hosted fleet prefix-cache arm: 2-replica "
                          "shared-prefix workload with a mid-run recycle "
@@ -972,6 +1094,9 @@ def main():
     elif args.deploy:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "deploy": deploy_arm()}
+    elif args.canary:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "canary": canary_arm()}
     elif args.fleet_prefix:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "fleet_prefix": fleet_prefix_arm()}

@@ -1,18 +1,22 @@
-"""Operator CLI for zero-downtime weight hot-swaps on a live gateway.
+"""Operator CLI for zero-downtime weight rollouts on a live gateway.
 
 ``python tools/rolling_deploy.py --url http://HOST:PORT --model-dir DIR``
 POSTs ``/admin/deploy`` and tails the rollout from ``/stats``: one line
 per replica step as it lands (drain → restart on the new checkpoint →
 warmup → shadow-probe readmit), then a final JSON line with the full
-deploy record. Exit code 0 = every replica finished on the new
-checkpoint; 1 = the rollout aborted (or rolled back — see
-``--no-rollback``); 2 = could not reach the gateway / rollout already in
-flight.
+deploy record. ``--strategy canary`` additionally tails the judge's
+verdict timeline (per-probe canary-vs-baseline latency samples) while
+the canary holds; ``--strategy surge`` spawns the new generation before
+draining the old so capacity never dips. Exit code 0 = every replica
+finished on the new checkpoint; 1 = the rollout aborted, rolled back, or
+the canary was rejected (old weights restaged — see ``--no-rollback``);
+2 = could not reach the gateway / rollout already in flight.
 
 The gateway enforces one rollout at a time (409 on a second POST while
 one runs) and the controller never leaves ``deploying`` stuck on — a
-crashed step records an abort. Watch live from another terminal with
-``curl .../stats | jq .deploy``.
+crashed step records an abort, and a gateway that crashes mid-roll
+resumes from its rollout journal on restart. Watch live from another
+terminal with ``curl .../stats | jq .deploy``.
 """
 
 import sys, os
@@ -23,7 +27,7 @@ import argparse
 import json
 import time
 
-TERMINAL = ("done", "aborted", "rolled_back")
+TERMINAL = ("done", "aborted", "rolled_back", "rejected")
 
 
 def main():
@@ -32,6 +36,17 @@ def main():
     ap.add_argument("--model-dir", required=True,
                     help="LM package directory to roll out (must be "
                          "readable by every replica process)")
+    ap.add_argument("--strategy", choices=("rolling", "canary", "surge"),
+                    default="rolling",
+                    help="rolling: drain+restart one at a time; canary: "
+                         "roll one replica, judge it against the fleet, "
+                         "promote or reject; surge: spawn-before-drain")
+    ap.add_argument("--canary-fraction", type=float, default=None,
+                    help="share of traffic diverted to the held canary "
+                         "(0.0 = dark canary, judge probes only)")
+    ap.add_argument("--judge-window-s", type=float, default=None,
+                    help="how long the canary holds before the judge's "
+                         "final promote verdict (rejects fire earlier)")
     ap.add_argument("--no-rollback", action="store_true",
                     help="on a failed step, leave the failed replica "
                          "as-is instead of re-staging its old checkpoint")
@@ -44,18 +59,22 @@ def main():
     host, port = args.url.rsplit("://", 1)[-1].rsplit(":", 1)
     cli = GatewayClient(host, int(port), max_retries=2)
     try:
-        view = cli.deploy(args.model_dir, rollback=not args.no_rollback)
+        view = cli.deploy(args.model_dir, rollback=not args.no_rollback,
+                          strategy=args.strategy,
+                          canary_fraction=args.canary_fraction,
+                          judge_window_s=args.judge_window_s)
     except GatewayError as e:
         print(f"deploy refused ({e.status}): {e.body}", file=sys.stderr)
         return 2
     except OSError as e:
         print(f"gateway unreachable: {e}", file=sys.stderr)
         return 2
-    print(f"[deploy] rolling {args.model_dir} across "
+    print(f"[deploy] {args.strategy} {args.model_dir} across "
           f"{len(view.get('checkpoints', []))} replica(s)",
           file=sys.stderr, flush=True)
 
     seen = 0
+    seen_ticks = 0
     deadline = time.monotonic() + args.timeout_s
     while True:
         try:
@@ -76,6 +95,14 @@ def main():
                   + (f", {step['detail']}" if step.get("detail") else "")
                   + ")", file=sys.stderr, flush=True)
         seen = len(view.get("steps", []))
+        # canary verdict timeline: one line per judge tick as it lands
+        timeline = view.get("canary", {}).get("timeline", [])
+        for tick in timeline[seen_ticks:]:
+            print(f"[judge]  {tick.get('event', 'tick')}: "
+                  + ", ".join(f"{k}={v}" for k, v in tick.items()
+                              if k != "event"),
+                  file=sys.stderr, flush=True)
+        seen_ticks = len(timeline)
         if not view.get("deploying") and view.get("status") in TERMINAL:
             break
         if time.monotonic() > deadline:
@@ -85,6 +112,11 @@ def main():
         time.sleep(args.poll_s)
 
     print(json.dumps(view))
+    can = view.get("canary") or {}
+    if can.get("verdict"):
+        print(f"[deploy] canary verdict: {can['verdict']}"
+              + (f" ({can.get('reason')})" if can.get("reason") else ""),
+              file=sys.stderr)
     if view.get("status") == "done":
         print(f"[deploy] done: fleet generation "
               f"{view.get('fleet_generation')}, checkpoints "
