@@ -515,7 +515,15 @@ class ProcessReplica:
                 "batch_depth": int(h.get("batch_depth", 0)),
                 "service_ms": self._service_ms,
                 "prefill_token_ms": float(
-                    h.get("prefill_token_ms", 0.0) or 0.0)}
+                    h.get("prefill_token_ms", 0.0) or 0.0),
+                "free_block_frac": float(
+                    h.get("free_block_frac", 1.0))}
+
+    @property
+    def role(self) -> str:
+        """The child engine's serving role, known to the parent without a
+        round trip — it rides the spawn's ``--engine-cfg`` JSON."""
+        return str(self.engine_cfg.get("role", "both") or "both")
 
     @property
     def state(self) -> str:
@@ -550,6 +558,29 @@ class ProcessReplica:
                 "GET", f"/v1/prefix/events?since={int(since)}&replica=0")
         except Exception:
             return {"seq": int(since), "reset": False, "events": []}
+
+    # -- KV migration relay ---------------------------------------------------
+    def kv_export(self, prompt, skip_hashes=()):
+        """Relay of :meth:`~ddw_tpu.serve.ServingEngine.kv_export`
+        (``POST /v1/kv/export`` on the child's own gateway). Raises on an
+        unreachable child — the router's handoff fallback owns the retry
+        story; a silent ``None`` here would masquerade as "nothing
+        cached"."""
+        cli = self._ensure_client()
+        out = cli._json_call("POST", "/v1/kv/export", {
+            "replica": 0,
+            "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+            "skip": [str(h) for h in skip_hashes]})
+        return out.get("wire")
+
+    def kv_import(self, wire) -> dict:
+        """Relay of :meth:`~ddw_tpu.serve.ServingEngine.kv_import`
+        (``POST /v1/kv/import``); the child rejects a malformed wire
+        before touching its pool, which surfaces here as a
+        :class:`~ddw_tpu.gateway.client.GatewayError`."""
+        cli = self._ensure_client()
+        return cli._json_call("POST", "/v1/kv/import",
+                              {"replica": 0, "wire": wire})
 
     # -- trace relay (the fleet's merged Perfetto view) -----------------------
     def trace_events(self, since: int = 0) -> dict:

@@ -292,6 +292,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/admin/deploy":
             self._admin_deploy(gw)
             return
+        if self.path in ("/v1/kv/export", "/v1/kv/import"):
+            # migration plane, not client data plane: ungated by the
+            # lifecycle ledger (a draining gateway may still donate its
+            # KV blocks), POST because prompts are token arrays far too
+            # long for a query string
+            self._kv_migrate(gw)
+            return
         if self.path not in ("/v1/generate", "/v1/predict", "/v1/batch",
                              "/v1/batch/items"):
             self._send_json(404, {"error": "not_found", "path": self.path})
@@ -723,6 +730,56 @@ class _Handler(BaseHTTPRequestHandler):
                                   "events": []})
             return
         self._send_json(200, fetch(since))
+
+    def _kv_migrate(self, gw: "Gateway") -> None:
+        """``POST /v1/kv/export`` / ``POST /v1/kv/import`` — the KV
+        migration plane's relay surface. A parent gateway's disaggregated
+        router calls these against a :class:`~ddw_tpu.deploy.
+        ProcessReplica` child's own gateway: export answers
+        ``{"wire": ...}`` with the prompt's registered blocks on the
+        versioned wire (``null`` when nothing is cached), import lands a
+        wire into the replica's pool and answers the import summary.
+        A malformed wire is a **400** (:class:`~ddw_tpu.serve.blocks.
+        KVWireError` rejects before any pool mutation); pool exhaustion
+        surfaces as the structured refusal it is."""
+        body = self._read_body()
+        if body is None:
+            return
+        try:
+            r = int(body.get("replica", 0))
+        except (TypeError, ValueError):
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": "replica must be an int"})
+            return
+        replicas = gw.replica_set.replicas
+        if not 0 <= r < len(replicas):
+            self._send_json(404, {"error": "not_found", "replica": r})
+            return
+        eng = replicas[r]
+        try:
+            if self.path == "/v1/kv/export":
+                fn = getattr(eng, "kv_export", None)
+                if fn is None:      # non-paged/fake replica: nothing to
+                    self._send_json(200, {"wire": None})    # export
+                    return
+                prompt = np.asarray(body.get("prompt", ()), np.int32)
+                skip = [str(h) for h in body.get("skip", ())]
+                self._send_json(200, {"wire": fn(prompt, skip_hashes=skip)})
+            else:
+                fn = getattr(eng, "kv_import", None)
+                if fn is None:
+                    self._send_json(200, {"imported": 0, "skipped": 0,
+                                          "bytes": 0})
+                    return
+                self._send_json(200, fn(body.get("wire")))
+        except Rejected as e:
+            self._send_rejected(e)
+        except (TypeError, ValueError) as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": str(e)})
+        except Exception as e:
+            self._send_json(500, {"error": "internal",
+                                  "message": str(e)})
 
     def _admin_deploy(self, gw: "Gateway") -> None:
         """Kick a weight rollout across this gateway's fleet — the

@@ -162,19 +162,28 @@ class PrefixIndex:
             self._recency.pop(key, None)
 
     # -- consumers ------------------------------------------------------------
-    def match(self, prompt, count_hit: bool = True) -> dict[int, int]:
+    def match(self, prompt, count_hit: bool = True,
+              with_hashes: bool = False):
         """Longest cached prefix (tokens) of ``prompt`` per replica slot —
         empty until the feed has taught the index its block size. Matches
         are capped at ``len(prompt) - 1`` (the pool always prefills at
         least one real token, so savings can never exceed that). With
         ``count_hit`` the longest matched key is credited for the hot
-        list."""
+        list.
+
+        ``with_hashes`` returns ``(matches, hexes)`` instead, where
+        ``hexes`` is the prompt's full-block chain-hash list (hex, block
+        order) this match walked — the migration plane's transfer
+        directory reads it to name warm blocks a receiver can skip, so
+        router and directory hash each prompt ONCE per route instead of
+        twice. ``hexes`` is ``[]`` when matching was impossible (no feed
+        yet / prompt too short)."""
         with self._lock:
             bs = self._block_size
             have = bool(self._holders)
         p = int(np.asarray(prompt).reshape(-1).shape[0])
         if not bs or not have or p < 2:
-            return {}
+            return ({}, []) if with_hashes else {}
         hexes = chain_hash_hexes(prompt, bs)
         out: dict[int, int] = {}
         with self._lock:
@@ -192,7 +201,16 @@ class PrefixIndex:
                 self._hits[best] = self._hits.get(best, 0) + 1
                 self._touch += 1
                 self._recency[best] = self._touch
-        return out
+        return (out, hexes) if with_hashes else out
+
+    @property
+    def block_size(self) -> int:
+        """The fleet's KV block size as learned from the feed (0 until
+        the first registration arrives) — the unit ``match`` hashes in
+        and the migration router converts token credits to block counts
+        with."""
+        with self._lock:
+            return self._block_size
 
     def hot(self, k: int | None = None) -> list[list[int]]:
         """The top-K hottest prefixes as TOKEN lists, hottest first, each

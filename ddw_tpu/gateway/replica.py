@@ -21,6 +21,18 @@ replicas look like one engine to the transport layer above it:
   Outstanding counts are kept here, incremented at submit and decremented
   by a future done-callback, so routing needs no cross-thread peeking
   into engine internals;
+- **roles disaggregate prefill from decode**: a replica advertising
+  ``role="prefill"`` never takes decode-bearing traffic directly. A
+  generate submission against a mixed fleet is split instead: the
+  TTFT-aware splitter prefills on the replica whose prefix credit +
+  projected wait is lowest, exports the prompt's KV blocks over the
+  versioned wire (:meth:`~ddw_tpu.serve.blocks.BlockPool.export_blocks`),
+  imports them into the decode replica chosen by projected wait +
+  block-pool headroom, and submits the full request there — the prefix
+  index doubles as the transfer directory, so blocks the receiver already
+  holds warm never cross the wire. Any handoff failure falls back to
+  colocated routing on a decode-capable replica; clients never see a
+  migration error;
 - **every replica sits behind a circuit breaker**
   (:class:`CircuitBreaker`): consecutive :class:`~ddw_tpu.serve.admission.
   ReplicaFailed` outcomes — or the engine's own death report — open the
@@ -429,13 +441,26 @@ class ReplicaSet:
         tracer = self.tracer
         t_route = time.monotonic() if tracer is not None else 0.0
         matched = None
+        hexes: list = []
         if prompt is not None and self.route_by_prefix:
             try:        # index staleness/unavailability must never block
                 self.prefix_index.poll(self.replicas)
-                matched = self.prefix_index.match(prompt) or None
+                matched, hexes = self.prefix_index.match(
+                    prompt, with_hashes=True)
+                matched = matched or None
             except Exception:
-                matched = None
-        scored = self._scored(matched=matched)
+                matched, hexes = None, []
+        exclude = ()
+        if method in ("submit_generate", "submit_batch_item"):
+            # a pure prefill worker finishes every generate at its first
+            # emitted token — decode-bearing requests must not land there
+            # while a decode-capable sibling exists
+            exclude = self._prefill_only()
+        if method == "submit_generate" and exclude:
+            fut = self._try_handoff(args, kwargs, matched, hexes)
+            if fut is not None:
+                return fut
+        scored = self._scored(exclude=exclude, matched=matched)
         order = [s[-1] for s in scored]
         if not order:
             raise Unavailable("all replica circuits open",
@@ -506,6 +531,135 @@ class ReplicaSet:
         except Exception:
             pass        # fakes without metrics still route
 
+    # -- disaggregated prefill/decode ----------------------------------------
+    @staticmethod
+    def _role(eng) -> str:
+        """The replica's serving role (duck-typed; plain fakes and older
+        engines are full-service ``both``)."""
+        try:
+            return str(getattr(eng, "role", "both") or "both")
+        except Exception:
+            return "both"
+
+    def _prefill_only(self) -> tuple:
+        """Slots holding pure prefill workers — excluded from
+        decode-bearing submissions whenever a decode-capable sibling
+        exists (a ``role="prefill"`` engine finishes every generate at
+        its first emitted token, which would truncate a multi-step
+        request routed there). With no decode-capable sibling nothing is
+        excluded: a degenerate all-prefill fleet still answers."""
+        pre, dec = [], False
+        for i, eng in enumerate(self.replicas):
+            if self._role(eng) == "prefill":
+                pre.append(i)
+            else:
+                dec = True
+        return tuple(pre) if (pre and dec) else ()
+
+    def _decode_score(self, i: int, outstanding: int):
+        """Decode-placement key: projected wait first, then block-pool
+        headroom (``free_block_frac`` from ``load()``) — between equally
+        idle decode replicas the request lands where the KV pool has the
+        most room, so imported blocks don't reclaim someone else's warm
+        prefix."""
+        eng = self.replicas[i]
+        wait, free = float(outstanding), 1.0
+        if hasattr(eng, "load"):
+            try:
+                ld = eng.load()
+                wait = (float(ld["depth"] + ld["busy"])
+                        * float(ld.get("service_ms") or 0.0))
+                free = float(ld.get("free_block_frac", 1.0))
+            except Exception:
+                pass
+        return (wait, -free, i)
+
+    def _try_handoff(self, args, kwargs, matched, hexes):
+        """Disaggregated submit: prefill on P, migrate the prompt's KV
+        blocks, decode on D. Returns the decode replica's future, or
+        ``None`` to fall back to colocated routing — no viable pair, P
+        and D collapse to the same replica, or ANY migration step failed
+        (the fallback is the zero-client-visible-failure guarantee the
+        chaos drill pins). Runs synchronously on the submitting thread:
+        the handoff IS the request's prefill phase, so its latency is
+        TTFT, not hidden queueing."""
+        prompt, num_steps = args[0], args[1]
+        try:
+            if int(num_steps) <= 1:
+                return None     # a 1-step request is pure prefill —
+            #                     nothing to disaggregate
+        except Exception:
+            return None
+        try:
+            avail = [i for i in range(len(self.replicas))
+                     if self.breakers[i].available()]
+            pcap = [i for i in avail
+                    if self._role(self.replicas[i]) in ("prefill", "both")]
+            dcap = [i for i in avail
+                    if self._role(self.replicas[i]) != "prefill"]
+            if not pcap or not dcap:
+                return None
+            with self._lock:
+                outs = list(self._outstanding)
+            # TTFT-aware split: P chases the warm prefix (prefix credit
+            # against projected wait, the _score discipline), D weighs
+            # projected wait + pool headroom.
+            pi = min(self._score(i, outs[i],
+                                 matched.get(i, 0) if matched else 0)
+                     for i in pcap)[-1]
+            di = min(self._decode_score(i, outs[i]) for i in dcap)[-1]
+            if pi == di:
+                return None     # one replica wins both phases: colocated
+            p_eng, d_eng = self.replicas[pi], self.replicas[di]
+            if (not hasattr(p_eng, "kv_export")
+                    or not hasattr(d_eng, "kv_import")):
+                return None
+            t0 = time.monotonic()
+            # Phase 1 — prefill on P: a synthetic one-step GREEDY request
+            # (the sampled token is discarded; KV is sampling-independent)
+            # that finishes through the normal release path, leaving the
+            # prompt's blocks registered in P's prefix cache.
+            p_eng.submit_generate(prompt, 1,
+                                  temperature=0.0).result(timeout=60.0)
+            # Phase 2 — migrate. The prefix index doubles as the transfer
+            # directory: blocks D already holds warm are named in
+            # skip_hashes and never cross the wire.
+            bs = self.prefix_index.block_size
+            skip = (hexes[:matched.get(di, 0) // bs]
+                    if (matched and hexes and bs) else ())
+            wire = p_eng.kv_export(prompt, skip_hashes=skip)
+            if wire is not None:
+                d_eng.kv_import(wire)
+            # Phase 3 — the full request on D, with the router's normal
+            # accounting; D's admission prefix-hits the imported blocks
+            # and re-derives the first token bit-identically.
+            with self._lock:
+                self._outstanding[di] += 1
+            try:
+                fut = d_eng.submit_generate(*args, **kwargs)
+            except BaseException:
+                self._dec(di)
+                raise
+            self.fleet_metrics.count("handoffs")
+            self.fleet_metrics.count(
+                "handoff_ms", int((time.monotonic() - t0) * 1e3))
+            if matched:
+                self._count_routing(di, matched)
+            if self.tracer is not None:
+                self.tracer.instant(
+                    "handoff", "gateway",
+                    trace=kwargs.get("trace_id"), tid="router",
+                    args={"prefill": pi, "decode": di,
+                          "skip_blocks": len(skip),
+                          "ms": round((time.monotonic() - t0) * 1e3, 3)})
+            self.breakers[di].begin_probe()
+            with self._lock:
+                self._where[fut] = di
+            fut.add_done_callback(self._on_done)
+            return fut
+        except Exception:
+            return None     # ANY handoff failure → colocated fallback
+
     # -- failover (the dead replica's on_failure hook) -----------------------
     def _on_replica_failure(self, i: int, failure: ReplicaFailed,
                             salvage) -> None:
@@ -534,7 +688,9 @@ class ReplicaSet:
             self._complete(req, DeadlineExceeded(
                 kind, waited, (deadline - req.times.submitted) * 1e3))
             return
-        for j in self._order(exclude=(src,)):
+        exclude = (src,) + (self._prefill_only()
+                            if kind == "generate" else ())
+        for j in self._order(exclude=exclude):
             eng = self.replicas[j]
             if not hasattr(eng, "adopt"):
                 continue
@@ -608,7 +764,8 @@ class ReplicaSet:
         futures carrying the refusal (the pump requeues them); the
         group-level spill budget matches ``_submit``'s."""
         indices = list(indices)
-        order = self._order()
+        order = self._order(exclude=(self._prefill_only()
+                                     if kind == "generate" else ()))
         if not order:
             raise Unavailable("all replica circuits open",
                               retry_after_ms=self._min_retry_ms())

@@ -427,7 +427,7 @@ def signal_registry() -> dict[str, str]:
                  "serve.interactive_reserve_blocks",
                  "serve.reserve_free_blocks", "serve.prefix_cache_keys",
                  "serve.decode_bucket", "serve.batch_backlog",
-                 "serve.tp_degree"):
+                 "serve.tp_degree", "serve.spec_k_effective"):
         reg[name] = "gauge"
     # gateway routing state
     for name in ("gateway.connections", "gateway.inflight",

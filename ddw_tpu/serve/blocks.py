@@ -65,6 +65,7 @@ token-identical and never re-emits (vLLM's recompute preemption).
 
 from __future__ import annotations
 
+import base64
 import collections
 import hashlib
 import threading
@@ -82,6 +83,16 @@ from ddw_tpu.serve.slots import _pick
 
 class OutOfBlocks(RuntimeError):
     """Internal: the free list AND the idle prefix cache are exhausted."""
+
+
+KV_WIRE_VERSION = 1
+
+
+class KVWireError(ValueError):
+    """A migration payload failed validation — version skew, geometry
+    mismatch, hash-chain corruption, or truncation. Raised BEFORE any
+    pool state changes: a rejected import leaves the pool bit-identical
+    to before the call (no partial import, ever)."""
 
 
 class _Stream:
@@ -194,6 +205,7 @@ class BlockPool:
         #                             row-bucket width (decode_buckets)
         don = (0,) if donate else ()
         self._copy = jax.jit(self._copy_fn, donate_argnums=don)
+        self._import_write = jax.jit(self._import_fn, donate_argnums=don)
         self._ev_lock = threading.Lock()   # event log is read off-thread
         self._reset_host()
 
@@ -586,6 +598,203 @@ class BlockPool:
             self.stats["preemptions"] += 1
             if st.lane == "batch":
                 self.stats["batch_preemptions"] += 1
+
+    # -- KV block migration (prefill/decode disaggregation) -------------------
+    def _leaf_meta(self) -> list[tuple[tuple[int, ...], str]]:
+        """Per-block payload geometry: for every non-scalar cache leaf (in
+        canonical flatten order) the shape and dtype of one block's slice
+        ``leaf[blk]`` — the unit the wire format carries."""
+        return [(tuple(leaf.shape[1:]), str(leaf.dtype))
+                for leaf in jax.tree.leaves(self.cache) if leaf.ndim > 0]
+
+    def export_blocks(self, prompt, skip_hashes=()) -> dict | None:
+        """Serialize ``prompt``'s REGISTERED full-block chain into the
+        versioned migration wire format — call after :meth:`register`
+        published the blocks (content is on device). JSON-clean by
+        construction (hex hashes, int token lists, base64 payloads), so
+        the gateway relays it over plain HTTP unchanged.
+
+        ``skip_hashes`` (hex strings) names a warm prefix the RECEIVER
+        already holds — the fleet prefix index is the directory — and
+        those leading blocks ship hash-only, no payload. Returns ``None``
+        when the prompt has no registered full block (nothing worth
+        migrating: the receiver would recompute at most ``block_size - 1``
+        tokens anyway).
+
+        The chain-hash contract makes a migrated block bit-identical by
+        construction: equal hashes mean equal tokens at equal positions,
+        and K/V is deterministic in tokens+positions+params."""
+        prompt = np.asarray(prompt, np.int32)
+        bs = self.block_size
+        hashes = self._chain_hashes(prompt)
+        n = 0
+        for h in hashes:
+            if self._full_map.get(h) is None:
+                break
+            n += 1
+        if n == 0:
+            return None
+        skip = set(skip_hashes)
+        start = 0
+        while start < n and hashes[start].hex() in skip:
+            start += 1
+        leaves = [leaf for leaf in jax.tree.leaves(self.cache)
+                  if leaf.ndim > 0]
+        payload = []
+        for j in range(start, n):
+            blk = self._full_map[hashes[j]]
+            payload.append([
+                base64.b64encode(np.ascontiguousarray(
+                    np.asarray(leaf[blk])).tobytes()).decode("ascii")
+                for leaf in leaves])
+        return {
+            "version": KV_WIRE_VERSION,
+            "block_size": bs,
+            "tp": self.tp_degree,
+            "leaves": [[list(s), d] for s, d in self._leaf_meta()],
+            "hashes": [h.hex() for h in hashes[:n]],
+            "tokens": [int(t) for t in prompt[:n * bs]],
+            "start_block": start,
+            "payload": payload,
+        }
+
+    def import_blocks(self, wire: dict) -> dict:
+        """Land a migration payload: validate EVERYTHING first (version,
+        geometry, hash-chain integrity, payload completeness — any defect
+        raises :class:`KVWireError` before the pool changes at all), then
+        allocate a block per carried hash not already registered, write
+        the payload through one jitted per-block scatter (device_put per
+        leaf under the pool's own block sharding, so an equal-``tp``
+        transfer is a pure per-shard copy), and register each block in
+        the prefix cache under its ORIGINAL chain hash. Imported blocks
+        end ref 0 + registered — parked in the idle LRU exactly like a
+        released prompt block — so CoW/refcount/preemption semantics are
+        untouched and the very next :meth:`admit` prefix-hits them.
+
+        Returns ``{"imported", "skipped", "bytes"}`` — ``skipped`` counts
+        keep-first dedupe hits (blocks this pool already held warm)."""
+        bs = self.block_size
+        if not isinstance(wire, dict):
+            raise KVWireError("wire payload must be a dict")
+        if wire.get("version") != KV_WIRE_VERSION:
+            raise KVWireError(
+                f"wire version {wire.get('version')!r} != "
+                f"{KV_WIRE_VERSION} — refusing cross-version import")
+        if wire.get("block_size") != bs:
+            raise KVWireError(
+                f"wire block_size {wire.get('block_size')!r} != {bs}")
+        meta = self._leaf_meta()
+        try:
+            wire_meta = [(tuple(int(d) for d in s), str(t))
+                         for s, t in wire.get("leaves", ())]
+        except (TypeError, ValueError) as e:
+            raise KVWireError(f"malformed leaf metadata: {e}") from e
+        if wire_meta != meta:
+            raise KVWireError("cache leaf geometry mismatch — sender and "
+                              "receiver pools disagree on model shape")
+        hashes_hex = wire.get("hashes")
+        if not isinstance(hashes_hex, (list, tuple)) or not hashes_hex:
+            raise KVWireError("wire carries no chain hashes")
+        n = len(hashes_hex)
+        try:
+            tokens = np.asarray(wire.get("tokens", ()), np.int32)
+        except (TypeError, ValueError, OverflowError) as e:
+            raise KVWireError(f"malformed token list: {e}") from e
+        if tokens.ndim != 1 or len(tokens) != n * bs:
+            raise KVWireError(
+                f"token list length {tokens.size} != {n} blocks * "
+                f"{bs} tokens")
+        chain = self._chain_hashes(tokens)
+        if [h.hex() for h in chain] != [str(h) for h in hashes_hex]:
+            raise KVWireError("chain hash mismatch — wire tokens do not "
+                              "reproduce the carried hashes")
+        start = wire.get("start_block", 0)
+        if not isinstance(start, int) or not 0 <= start <= n:
+            raise KVWireError(f"start_block {start!r} outside [0, {n}]")
+        payload = wire.get("payload")
+        if not isinstance(payload, (list, tuple)) or \
+                len(payload) != n - start:
+            got = len(payload) if isinstance(payload, (list, tuple)) else 0
+            raise KVWireError(f"truncated payload: {got} block rows for "
+                              f"{n - start} carried blocks")
+        decoded = []
+        for row in payload:
+            if not isinstance(row, (list, tuple)) or len(row) != len(meta):
+                raise KVWireError(
+                    f"truncated payload row: {len(row) if isinstance(row, (list, tuple)) else 0} "
+                    f"leaves for {len(meta)}")
+            arrs = []
+            for b64, (shape, dtype) in zip(row, meta):
+                try:
+                    raw = base64.b64decode(b64, validate=True)
+                except Exception as e:
+                    raise KVWireError(f"undecodable leaf payload: {e}") \
+                        from e
+                want = int(np.dtype(dtype).itemsize * np.prod(shape,
+                                                              dtype=np.int64))
+                if len(raw) != want:
+                    raise KVWireError(f"truncated leaf payload: {len(raw)} "
+                                      f"bytes, expected {want}")
+                arrs.append(np.frombuffer(raw, np.dtype(dtype))
+                            .reshape(shape))
+            decoded.append(arrs)
+        # -- validation done; land the blocks (all-or-nothing) --
+        new_hashes = [chain[j] for j in range(start, n)
+                      if chain[j] not in self._full_map]
+        if len(new_hashes) > self.free_blocks_effective:
+            raise OutOfBlocks(
+                f"pool cannot hold {len(new_hashes)} imported blocks "
+                f"({self.free_blocks_effective} reclaimable)")
+        shardings = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            shardings = []
+            for leaf in jax.tree.leaves(self.cache):
+                if leaf.ndim == 0:
+                    continue
+                try:
+                    shardings.append(NamedSharding(
+                        self._mesh, PartitionSpec(*leaf.sharding.spec[1:])))
+                except Exception:
+                    shardings.append(None)
+        landed: list[int] = []
+        skipped = 0
+        nbytes = 0
+        try:
+            for j in range(start, n):
+                h = chain[j]
+                if h in self._full_map:      # keep-first dedupe / warm skip
+                    skipped += 1
+                    continue
+                blk = self._alloc()
+                arrs = decoded[j - start]
+                if shardings is not None:
+                    arrs = [a if s is None else jax.device_put(a, s)
+                            for a, s in zip(arrs, shardings)]
+                self.cache = self._import_write(self.cache, jnp.int32(blk),
+                                                tuple(arrs))
+                self._full_map[h] = blk
+                self._block_keys.setdefault(blk, []).append(("full", h))
+                toks = tuple(int(t) for t in tokens[:(j + 1) * bs])
+                with self._ev_lock:
+                    self._prefix_tokens[h] = toks
+                self._emit("register", h, toks)
+                landed.append(blk)
+                nbytes += sum(a.nbytes for a in decoded[j - start])
+        except OutOfBlocks:
+            # only reachable when LRU reclaim evicted a chain member the
+            # precheck counted as held — unwind to the pre-call state
+            for blk in landed:
+                self._unregister(blk)
+                self._decref(blk)
+            raise
+        # ref 1 -> 0: registered blocks park in the idle LRU, hittable by
+        # the next admit. Held at ref 1 during the loop so allocation
+        # pressure can never reclaim an earlier block of this very chain.
+        for blk in landed:
+            self._decref(blk)
+        return {"imported": len(landed), "skipped": skipped,
+                "bytes": nbytes}
 
     # -- decode-tick allocation (+ preemption policy) -------------------------
     def _extend(self, st: _Stream, k: int) -> None:
@@ -1002,6 +1211,18 @@ class BlockPool:
         self.cache = self._copy(self.cache, jnp.int32(0), jnp.int32(0))
 
     # -- jitted bodies --------------------------------------------------------
+    @staticmethod
+    def _import_fn(cache, dst, payload):
+        """Scatter one migrated block: ``payload`` is the tuple of per-
+        leaf block slices in canonical flatten order, covering exactly
+        the non-scalar leaves (skipping ndim==0 counters, mirroring
+        :meth:`_copy_fn`)."""
+        leaves, treedef = jax.tree.flatten(cache)
+        it = iter(payload)
+        out = [leaf if leaf.ndim == 0 else leaf.at[dst].set(next(it))
+               for leaf in leaves]
+        return jax.tree.unflatten(treedef, out)
+
     @staticmethod
     def _copy_fn(cache, dst, src):
         def fix(leaf):

@@ -192,8 +192,28 @@ class EngineCfg:
     # AND seeded; the sampling folds run on fully-replicated logits).
     # Requires paged=True; the head count must divide by tp.
     tp: int = 1
+    # prefill/decode disaggregation (docs/serving.md "Disaggregated
+    # prefill/decode"): a "prefill" replica runs suffix prefill, registers
+    # the prompt blocks, and finishes the request immediately — ZERO
+    # decode ticks; its result carries only the prefill-derived first
+    # token (the gateway's handoff path submits num_steps=1, then
+    # migrates the registered blocks via kv_export/kv_import). A "decode"
+    # replica is a routing role only: its admission path is unchanged —
+    # imported blocks prefix-hit, so it prefills at most the uncovered
+    # tail (< block_size tokens) and goes straight to the decode ladder.
+    # "both" (the default) is the colocated pre-disaggregation behaviour.
+    role: str = "both"
 
     def __post_init__(self):
+        if self.role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode', or 'both', got "
+                f"{self.role!r}")
+        if self.role != "both" and not self.paged:
+            raise ValueError(
+                f"role {self.role!r} requires the paged pool "
+                f"(paged=True): KV block migration is defined over the "
+                f"BlockPool's chain-hashed blocks only")
         # model-independent TP validation lives here so a bad config fails
         # at CONSTRUCTION with a structured error, not as an XLA shape
         # error mid-warmup; the model/device-dependent checks (head
@@ -382,6 +402,10 @@ class ServingEngine:
         self._last_tick = time.monotonic()
         self._fault_n: dict[str, int] = {}   # per-site hook counts (per gen)
         self._inflight_admit: list = []      # claimed reqs mid-device-work
+        self._pool_ops: list = []            # (fn, future) control ops the
+        #                                      loop runs between ticks — KV
+        #                                      export/import must never race
+        #                                      a donated-cache dispatch
 
         self.model_dir: str | None = None    # checkpoint dir behind _lm,
         #                                      when loaded from a package
@@ -444,6 +468,13 @@ class ServingEngine:
         single-device path)."""
         return int(self.mesh.shape[MODEL_AXIS]) if self.mesh is not None \
             else 1
+
+    @property
+    def role(self) -> str:
+        """``prefill`` | ``decode`` | ``both`` — the disaggregation role
+        the gateway routes by (duck-typed: :class:`ProcessReplica` relays
+        the same property)."""
+        return self.cfg.role
 
     def _init_lm(self, lm, draft=_UNSET) -> None:
         """Build (or rebuild) the LM handle + KV pool(s). Called at
@@ -512,6 +543,12 @@ class ServingEngine:
                                      donate=self.cfg.donate)
                 n = self.cfg.n_slots
             self._n_rows = n
+            # spec_k auto-tuning: the EFFECTIVE draft width, stepped by a
+            # bounded EWMA controller over live acceptance (reset with the
+            # pools on every handle rebuild — a new target/draft pair
+            # starts back at the configured width)
+            self._spec_k_eff = self.cfg.spec_k
+            self._spec_accept_ewma = 1.0
             self._slot_req: dict[int, _LMRequest] = {}
             self._cur = np.zeros((n,), np.int32)
             self._prev = np.zeros((n,), np.int32)   # H[-2] per row — the
@@ -696,6 +733,8 @@ class ServingEngine:
                 if isinstance(self.pool, BlockPool) else 0.0),
             "draining": self._draining.is_set(),
             "checkpoint": self.checkpoint_id,
+            "role": self.cfg.role,
+            "free_block_frac": self._free_block_frac(),
             # relayed by ProcessReplica.load() so cache-aware routing can
             # price a child's prefill without an extra round trip
             "prefill_token_ms": self._prefill_token_ms,
@@ -718,7 +757,17 @@ class ServingEngine:
                 "batch_depth": (self._ctrl.depth("lm_batch")
                                 + self._ctrl.depth("image_batch")),
                 "service_ms": self._service_ms,
-                "prefill_token_ms": self._prefill_token_ms}
+                "prefill_token_ms": self._prefill_token_ms,
+                # decode-placement signal for the disaggregation splitter:
+                # the fraction of the block pool still allocatable (free +
+                # reclaimable idle cache, net of the committed budget)
+                "free_block_frac": self._free_block_frac()}
+
+    def _free_block_frac(self) -> float:
+        if not isinstance(self.pool, BlockPool):
+            return 1.0
+        avail = self.pool.free_blocks_effective - self.pool._committed
+        return max(0.0, min(1.0, avail / max(self.pool.n_blocks, 1)))
 
     def trace_events(self, since: int = 0) -> dict:
         """Drain the trace ring past ``since`` (a ``seq`` watermark) — the
@@ -777,6 +826,74 @@ class ServingEngine:
         if isinstance(self.pool, BlockPool):
             return self.pool.prefix_events(since)
         return {"seq": 0, "reset": False, "events": []}
+
+    # -- KV block migration (prefill/decode disaggregation) -------------------
+    def kv_export(self, prompt, skip_hashes=()) -> dict | None:
+        """Export ``prompt``'s registered full-block chain in the versioned
+        migration wire format (:meth:`BlockPool.export_blocks`) — the
+        prefill half of a handoff. Runs ON the engine loop between ticks
+        (any-thread safe: a pool read must never race a donated-cache
+        dispatch). Returns ``None`` when nothing is registered — the
+        caller falls back to colocated serving."""
+        if not isinstance(self.pool, BlockPool):
+            raise ValueError("KV migration requires the paged pool "
+                             "(EngineCfg(paged=True))")
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        skip = tuple(skip_hashes)
+        return self._run_pool_op(
+            lambda: self.pool.export_blocks(prompt, skip_hashes=skip))
+
+    def kv_import(self, wire: dict) -> dict:
+        """Land a migration payload into this replica's prefix cache
+        (:meth:`BlockPool.import_blocks`; all-or-nothing —
+        :class:`~ddw_tpu.serve.blocks.KVWireError` on any defect, pool
+        untouched). Counts ``kv_blocks_migrated`` / ``kv_bytes_migrated``
+        for the blocks that actually landed, so a prefix-warm replica
+        that skipped payload blocks shows a smaller delta."""
+        if not isinstance(self.pool, BlockPool):
+            raise ValueError("KV migration requires the paged pool "
+                             "(EngineCfg(paged=True))")
+        res = self._run_pool_op(lambda: self.pool.import_blocks(wire))
+        if res.get("imported"):
+            self.metrics.count("kv_blocks_migrated", res["imported"])
+            self.metrics.count("kv_bytes_migrated", res["bytes"])
+        return res
+
+    def _run_pool_op(self, fn, timeout_s: float = 30.0):
+        """Run ``fn`` serialized with the engine loop: inline when the
+        loop is not running (or we ARE the loop thread), else as a control
+        op the loop drains between ticks. Exceptions propagate to the
+        caller — a rejected wire is the submitter's error, never a
+        replica degradation."""
+        if self._failure is not None:
+            raise self._refusal()
+        t = self._thread
+        if (t is None or not t.is_alive()
+                or threading.current_thread() is t):
+            return fn()
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._cv:
+            self._pool_ops.append((fn, fut))
+            self._cv.notify_all()
+        return fut.result(timeout=timeout_s)
+
+    def _drain_pool_ops(self) -> bool:
+        """Engine loop: run queued control ops (KV export/import). Their
+        exceptions resolve the submitter's future — deliberately OUTSIDE
+        :meth:`_guarded`, so a malformed wire never costs the replica its
+        error budget."""
+        with self._cv:
+            if not self._pool_ops:
+                return False
+            ops, self._pool_ops = self._pool_ops, []
+        for fn, fut in ops:
+            try:
+                fut.set_result(fn())
+            except BaseException as e:
+                fut.set_exception(e)
+        return True
 
     def force_fail(self, kind: str = "stalled", reason: str = "") -> None:
         """Declare this replica dead from OUTSIDE the engine thread — the
@@ -1189,6 +1306,11 @@ class ServingEngine:
                 + (self._service_ms * self._ctrl.depth(kind)))
 
     def _fail_pending(self, exc: Exception) -> None:
+        with self._cv:
+            ops, self._pool_ops = self._pool_ops, []
+        for _, fut in ops:
+            if not fut.done():
+                fut.set_exception(exc)
         for kind in ("lm", "lm_batch", "image", "image_batch"):
             drained, expired = self._ctrl.take(
                 kind, self._ctrl.depth(kind) + 1)
@@ -1233,6 +1355,7 @@ class ServingEngine:
                         self._shed(req, kind)
                         worked = True
                 if self.pool is not None:
+                    worked |= self._drain_pool_ops()
                     worked |= self._guarded(self._admit_lm)
                     worked |= self._guarded(self._decode_tick)
                 if self._image is not None:
@@ -1446,6 +1569,8 @@ class ServingEngine:
                     args={"free": int(free), "total": int(total)})
         gauges["batch_backlog"] = float(self._ctrl.depth("lm_batch")
                                         + self._ctrl.depth("image_batch"))
+        if self._draft_pool is not None:
+            gauges["spec_k_effective"] = float(self._spec_k_eff)
         self.metrics.set_gauges(gauges)
 
     def _preempt_batch_for_interactive(self) -> bool:
@@ -1660,7 +1785,12 @@ class ServingEngine:
                     req.emit(0)
                 # else: a resumed stream — tok0 is the bit-identical
                 # re-derivation of its newest pick; nothing new to emit
-                if req.emitted >= req.num_steps:
+                if req.emitted >= req.num_steps or \
+                        self.cfg.role == "prefill":
+                    # a prefill-role replica NEVER decodes: the request
+                    # finishes at its first token (blocks stay registered
+                    # for kv_export; the handoff path submits num_steps=1,
+                    # so nothing is truncated on the gateway path)
                     pool.release(row)
                     if self._draft_pool is not None:
                         self._draft_pool.release(row)
@@ -1896,7 +2026,11 @@ class ServingEngine:
             return False
         self._fault("decode")
         t_tick = time.monotonic() if self._tracing else 0.0
-        k = self.cfg.spec_k
+        # the auto-tuned EFFECTIVE width: admission always budgets the
+        # configured worst case (_draft_admit_shape), so any k <= cfg
+        # .spec_k is admission-safe; the draft/verify programs retrace
+        # once per width they actually run at
+        k = self._spec_k_eff
         pool, dpool = self.pool, self._draft_pool
         for row in self._spec_prepare(k + 1):
             req = self._slot_req.pop(row)
@@ -1969,12 +2103,30 @@ class ServingEngine:
             self._cur[row] = 0
             self._prev[row] = 0
             self._finish_lm(req)
+        if t_proposed:
+            # bounded EWMA controller over live acceptance: sustained
+            # rejections (< 0.5) step the effective width down toward 1
+            # (each rejected draft is a wasted draft dispatch AND a
+            # rolled-back block write), sustained acceptance (> 0.8)
+            # steps it back up toward the configured spec_k — one step
+            # per tick, so the width never thrashes across the retrace
+            # cache. A self-draft holds acceptance at 1.0 and never
+            # shrinks (the spec_ab bit-identity pins are untouched).
+            rate = t_accepted / t_proposed
+            self._spec_accept_ewma = (0.8 * self._spec_accept_ewma
+                                      + 0.2 * rate)
+            if self._spec_accept_ewma < 0.5 and self._spec_k_eff > 1:
+                self._spec_k_eff -= 1
+            elif (self._spec_accept_ewma > 0.8
+                  and self._spec_k_eff < self.cfg.spec_k):
+                self._spec_k_eff += 1
         if self._tracing:
             self.tracer.record_span(
                 "spec_tick", "serve", t_tick, time.monotonic(),
                 tid="engine",
                 args={"rows": rows_live, "proposed": t_proposed,
-                      "accepted": t_accepted, "bonus": t_bonus})
+                      "accepted": t_accepted, "bonus": t_bonus,
+                      "spec_k_effective": k})
         self._sync_pool_stats()
         return True
 

@@ -172,6 +172,17 @@ class EngineMetrics:
         self.surge_spawns = 0      # spawn-before-drain replacements landed
         self.journal_resumes = 0   # rollouts resumed from a journal after a
         #                            gateway restart (reconciler path)
+        # prefill/decode disaggregation (docs/serving.md "Disaggregated
+        # prefill/decode"): block migration counts land on the IMPORTING
+        # engine (so a prefix-warm receiver that skipped payload blocks
+        # shows a smaller delta); the handoff pair lands on the fleet
+        # metrics the gateway's ReplicaSet owns
+        self.kv_blocks_migrated = 0  # KV blocks landed via kv_import
+        self.kv_bytes_migrated = 0   # payload bytes of those blocks
+        self.handoffs = 0            # prefill→decode migrations completed
+        self.handoff_ms = 0          # accumulated wall-ms of the handoff
+        #                            stage (1-step prefill + export +
+        #                            import) — ÷ handoffs = per-handoff cost
         self._gauges: dict[str, float] = {}  # live block-pool state, pushed
         #                            by the engine loop (free/used blocks...)
         self._first_admit: float | None = None
@@ -319,6 +330,10 @@ class EngineMetrics:
                 "serve.canary_rejected": float(self.canary_rejected),
                 "serve.surge_spawns": float(self.surge_spawns),
                 "serve.journal_resumes": float(self.journal_resumes),
+                "serve.kv_blocks_migrated": float(self.kv_blocks_migrated),
+                "serve.kv_bytes_migrated": float(self.kv_bytes_migrated),
+                "serve.handoffs": float(self.handoffs),
+                "serve.handoff_ms": float(self.handoff_ms),
             }
             looked = self.prefix_hit_blocks + self.prefix_miss_blocks
             out["serve.prefix_hit_rate"] = (
@@ -497,6 +512,15 @@ _COUNTER_HELP = (
      "spawned and warmed before the old one drained)."),
     ("journal_resumes", "Rollouts resumed from a durable deploy journal "
      "after a gateway restart."),
+    ("kv_blocks_migrated", "KV blocks landed from another replica via the "
+     "migration wire format (counted at the importer)."),
+    ("kv_bytes_migrated", "Payload bytes of the KV blocks landed via "
+     "migration (counted at the importer)."),
+    ("handoffs", "Prefill-to-decode request handoffs completed by the "
+     "gateway's migration plane."),
+    ("handoff_ms", "Accumulated wall-ms of the handoff stage (1-step "
+     "prefill + block export + import); divide by handoffs for the "
+     "per-handoff cost."),
 )
 _HISTOGRAMS = ("queue_ms", "ttft_ms", "total_ms")
 

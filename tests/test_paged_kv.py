@@ -271,13 +271,14 @@ def test_overloaded_retry_hint_from_block_release(pm):
 
 # -- out-of-blocks mid-decode: preemption policy -----------------------------
 
-@pytest.mark.slow   # tier-1 budget (the spec-decode graft adds
-#                     test_spec_engine.py): the preempt-by-recompute
-#                     identity class's tier-1 representative is now
-#                     test_spec_engine.py::test_spec_preempt_resume_bit_identical_exactly_once,
+@pytest.mark.slow   # tier-1 budget: the preempt-by-recompute identity
+#                     class's tier-1 representative is (PR 17)
+#                     test_kv_migration.py::test_disagg_identity_through_mid_decode_preemption,
 #                     which drives the same requeue-front + fold-emitted
-#                     machinery through the stricter spec-rollback path;
-#                     this spec-off variant stays as the tier-2 sweep
+#                     machinery through the migrated-stream path; the
+#                     spec-rollback composition
+#                     (test_spec_engine.py::test_spec_preempt_resume_bit_identical_exactly_once)
+#                     and this spec-off variant are the tier-2 sweeps
 def test_out_of_blocks_preemption_resumes_token_identically(pm):
     """block_overcommit oversubscribes admission, so decode runs out of
     blocks mid-flight: the youngest stream is evicted, re-queued at the

@@ -162,6 +162,15 @@ def test_self_draft_acceptance_is_exactly_one(pm):
 
 # -- preemption under speculation --------------------------------------------
 
+@pytest.mark.slow   # tier-1 budget (PR 17): the preempt-by-recompute +
+#                     requeue-front + fold-emitted identity class keeps its
+#                     tier-1 rep in test_kv_migration.py::
+#                     test_disagg_identity_through_mid_decode_preemption
+#                     (same machinery driven through the migrated-stream
+#                     path); spec rollback keeps its tier-1 reps in the
+#                     rejecting-tick drills above and test_tp_serve's
+#                     sharded spec tick — this spec x preemption
+#                     composition rides tier-2 with the spec-off sweep
 def test_spec_preempt_resume_bit_identical_exactly_once(pm, dm):
     """Out-of-blocks mid-speculation: the youngest stream is evicted from
     BOTH pools, re-queued at the head with only ACCEPTED tokens folded

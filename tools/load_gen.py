@@ -53,7 +53,11 @@ the run. Prints one JSON line with the load row + the recovery record.
 The ``--canary`` arm is the rollout-safety sibling: a dark-canary deploy
 with ``DDW_FAULT=deploy:degrade_canary`` armed must auto-reject and
 restage the old weights with zero failed client requests and
-bit-identical tokens throughout. The burst is the honest 1-core framing: replicas sharing a core
+bit-identical tokens throughout. The ``--disagg`` arm is the migration
+plane's chaos sibling: a prefill/decode/both fleet under a shared-prefix
+burst loses its prefill replica mid-burst, and the drill pins zero
+client-visible failures (handoffs fall back to the ``role="both"``
+replica) with bit-identical probe tokens before and after. The burst is the honest 1-core framing: replicas sharing a core
 cannot exceed its service rate (the closed rows prove that), but doubling
 slot capacity halves queue wait for a burst, so strictly more requests
 complete within their SLO — and the shed ones cost no device time. On a
@@ -513,6 +517,111 @@ def chaos(prompt_len=12, steps=16, requests=32, n_slots=2, steps_per_tick=4,
                   f"restarts {out['restarts']}, "
                   f"states {out['replica_states']}",
                   file=sys.stderr, flush=True)
+            return out
+        finally:
+            if prev_fault is None:
+                os.environ.pop("DDW_FAULT", None)
+            else:
+                os.environ["DDW_FAULT"] = prev_fault
+            gw.stop()
+
+
+def disagg_arm(steps=8, requests=24, n_slots=4, steps_per_tick=4,
+               hidden=64, depth=2, clients=4, shared_len=16, uniq_len=8,
+               kill_after_prefills=8):
+    """Kill-the-prefill-replica-mid-burst drill — the disaggregation
+    chaos pin.
+
+    A supervised 3-replica fleet behind the real HTTP path: slot 0 is a
+    ``role="prefill"`` donor, slot 1 a ``role="decode"`` receiver, slot 2
+    the ``role="both"`` fallback. Closed-loop clients drive a
+    shared-prefix burst whose requests are split by the disaggregated
+    router (prefill on 0, KV blocks migrated to 1); ``DDW_FAULT`` crashes
+    the prefill replica at its ``kill_after_prefills``-th prefill — the
+    PREFILL site, because a pure prefill worker never reaches a decode
+    tick — i.e. provably mid-burst, under a live handoff stream. The pin is what
+    docs/serving.md promises for the migration plane: in-flight and
+    subsequent requests fall back to colocated serving on the
+    decode-capable replicas (the ``role="both"`` fallback keeps donating
+    prefills once slot 0's circuit opens) with ZERO client-visible
+    failures, handoffs and migrated blocks stay > 0, and a pinned greedy
+    probe answers bit-identically before and after the crash."""
+    import tempfile
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.gateway import Gateway, GatewayClient, ReplicaSet
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "disagg", hidden, depth, 2, 128, 96,
+                          dtype="float32")
+        engines = [ServingEngine(lm=pm, cfg=EngineCfg(
+            n_slots=n_slots, steps_per_tick=steps_per_tick,
+            kv_block_size=8, queue_depth=4 * max(clients, requests),
+            default_timeout_s=600.0, role=role))
+            for role in ("prefill", "decode", "both")]
+        gw = Gateway(ReplicaSet(engines), grace_s=60.0,
+                     supervisor_kw=dict(max_restarts=2, backoff_base_s=0.1,
+                                        backoff_max_s=0.5, jitter=0.0,
+                                        poll_interval_s=0.05))
+        gw.replica_set.prefix_index.poll_interval_s = 0.0
+        gw.start(warmup_prompt_lens=(shared_len + uniq_len, uniq_len, 1))
+        rng = np.random.RandomState(23)
+        shared = rng.randint(0, 128, size=(shared_len,)).astype(np.int32)
+
+        def mk_prompts(n):
+            return [np.concatenate([shared, rng.randint(
+                0, 128, size=(uniq_len,)).astype(np.int32)])
+                for _ in range(n)]
+
+        probe = mk_prompts(1)[0]
+        prev_fault = os.environ.get("DDW_FAULT")
+        os.environ["DDW_FAULT"] = (
+            f"serve:crash:site=prefill:replica=0"
+            f":after={kill_after_prefills}")
+        try:
+            cli = GatewayClient("127.0.0.1", gw.port, max_retries=2)
+            ref = cli.generate(probe, steps)["tokens"]
+            # retries generous: a 503 while the supervisor restarts the
+            # donor is absorbed by backoff — the pin is that NONE survive
+            row = closed_loop(gw.url, mk_prompts(requests), steps,
+                              clients, retries=6)
+            after = cli.generate(probe, steps)["tokens"]
+            stats = cli.stats()
+            out = {
+                "row": row,
+                "handoffs": int(stats.get("serve.handoffs", 0)),
+                "handoff_ms": int(stats.get("serve.handoff_ms", 0)),
+                "kv_blocks_migrated": int(
+                    stats.get("serve.kv_blocks_migrated", 0)),
+                "kv_bytes_migrated": int(
+                    stats.get("serve.kv_bytes_migrated", 0)),
+                "replica_failures": stats["gateway.replica_failures"],
+                "restarts": list(gw.replica_set.restarts),
+                "circuits": [b.state for b in gw.replica_set.breakers],
+                "roles": [h.get("role", "both")
+                          for h in stats["replica_health"]],
+                "identity_preserved": list(ref) == list(after),
+            }
+            print(f"[load_gen] disagg chaos: {row['completed']}/{requests}"
+                  f" completed, {out['handoffs']} handoffs, "
+                  f"{out['kv_blocks_migrated']} blocks migrated, "
+                  f"prefill-replica failures {out['replica_failures']}, "
+                  f"identity {out['identity_preserved']}",
+                  file=sys.stderr, flush=True)
+            if SMOKE:
+                # zero client-visible failures through the donor's death
+                assert row["completed"] == requests, out
+                assert sum(row["errors"].values()) == 0, out
+                # the migration plane actually ran before (and around)
+                # the crash
+                assert out["handoffs"] > 0, out
+                assert out["kv_blocks_migrated"] > 0, out
+                # the prefill replica provably died mid-burst
+                assert out["replica_failures"] >= 1, out
+                # and the crash changed placement, never content
+                assert out["identity_preserved"], out
             return out
         finally:
             if prev_fault is None:
@@ -1043,6 +1152,12 @@ def main():
                          "injected degrade fault; asserts auto-reject, "
                          "old weights restaged, zero failed client "
                          "requests, bit-identical tokens throughout")
+    ap.add_argument("--disagg", action="store_true",
+                    help="self-hosted disaggregation chaos arm: "
+                         "prefill/decode/both fleet under a shared-prefix "
+                         "burst; kills the prefill replica mid-burst and "
+                         "asserts zero client-visible failures with "
+                         "bit-identical tokens")
     ap.add_argument("--fleet-prefix", action="store_true",
                     help="self-hosted fleet prefix-cache arm: 2-replica "
                          "shared-prefix workload with a mid-run recycle "
@@ -1097,6 +1212,9 @@ def main():
     elif args.canary:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "canary": canary_arm()}
+    elif args.disagg:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "disagg": disagg_arm()}
     elif args.fleet_prefix:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "fleet_prefix": fleet_prefix_arm()}
