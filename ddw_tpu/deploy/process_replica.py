@@ -55,6 +55,7 @@ from ddw_tpu.gateway.client import (GatewayClient, GatewayDeadline,
                                     GatewayUnavailable)
 from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded, Rejected,
                                      ReplicaFailed, Unavailable)
+from ddw_tpu.deploy.transport import transport_for
 from ddw_tpu.serve.engine import GenerateResult, PredictResult
 from ddw_tpu.serve.metrics import EngineMetrics
 
@@ -110,7 +111,8 @@ class ProcessReplica:
                  spawn_timeout_s: float = 180.0,
                  request_timeout_s: float = 120.0, max_workers: int = 16,
                  warmup_lens=(8,), draft_dir: str | None = None,
-                 tp: int = 1):
+                 tp: int = 1, spawn_host: str | None = None,
+                 transport=None, staging_root: str | None = None):
         self.model_dir = model_dir
         self.draft_dir = draft_dir
         self.replica_id = replica_id
@@ -122,6 +124,26 @@ class ProcessReplica:
         self.tp = int(tp if tp != 1 else self.engine_cfg.get("tp", 1))
         self.warmup_lens = tuple(warmup_lens)
         self.host = host
+        # spawn placement: the machine the child runs ON (the pluggable
+        # transport seam — docs/serving.md "remote-host transport
+        # contract"). Default stays this box with plain Popen semantics.
+        self.spawn_host = spawn_host
+        self.staging_root = staging_root
+        if transport is None:
+            transport = transport_for(spawn_host, staging_root=staging_root)
+        elif isinstance(transport, str):
+            transport = transport_for(
+                None if transport == "local" else transport,
+                staging_root=staging_root)
+        self.transport = transport
+        if getattr(transport, "remote", False):
+            # remote child: it binds all interfaces on its own machine,
+            # the parent connects to the spawn host
+            self._bind_host = "0.0.0.0"
+            if spawn_host and self.host in ("127.0.0.1", "localhost"):
+                self.host = spawn_host
+        else:
+            self._bind_host = host
         self.grace_s = grace_s
         self.spawn_timeout_s = spawn_timeout_s
         self.request_timeout_s = request_timeout_s
@@ -196,15 +218,23 @@ class ProcessReplica:
             os.unlink(port_file)
         except FileNotFoundError:
             pass
+        # checkpoint staging: the weights must exist on the SPAWN host
+        # before the child boots there. The transport returns the path
+        # valid on that machine (identity on a local/shared filesystem,
+        # a digest-keyed staged copy otherwise — idempotent per digest,
+        # so respawns and same-checkpoint siblings reuse the copy).
+        staged_model = self.transport.stage(self.model_dir)
+        staged_draft = (self.transport.stage(self.draft_dir)
+                        if self.draft_dir else None)
         cmd = [sys.executable, "-m", "ddw_tpu.deploy._serve_worker",
-               "--model-dir", self.model_dir,
+               "--model-dir", staged_model,
                "--port-file", port_file,
                "--replica-id", str(self.replica_id),
-               "--host", self.host,
+               "--host", self._bind_host,
                "--grace-s", str(self.grace_s),
                "--warmup", json.dumps(list(self.warmup_lens))]
-        if self.draft_dir:
-            cmd += ["--draft-dir", self.draft_dir]
+        if staged_draft:
+            cmd += ["--draft-dir", staged_draft]
         if self.engine_cfg:
             cmd += ["--engine-cfg", json.dumps(self.engine_cfg)]
         if self.tp > 1:
@@ -222,9 +252,8 @@ class ProcessReplica:
         self._telem_child_seq = 0    # fresh child hub counts from 1 again
         self.log_path = os.path.join(self._workdir,
                                      f"child.gen{self.generation}.log")
-        with open(self.log_path, "ab") as log:
-            self._proc = subprocess.Popen(cmd, env=env, stdout=log,
-                                          stderr=log)
+        self._proc = self.transport.popen(cmd, env=env,
+                                          log_path=self.log_path)
         threading.Thread(target=self._watch, args=(self._proc,),
                          name=f"ddw-preplica{self.replica_id}-watch",
                          daemon=True).start()
@@ -270,9 +299,11 @@ class ProcessReplica:
                     f"replica {self.replica_id} child died during startup "
                     f"(exit {proc.poll() if proc else None})")
             try:
-                with open(port_file) as f:
-                    return int(json.load(f)["port"])
-            except (FileNotFoundError, ValueError, KeyError):
+                # through the transport: a remote child's port file lives
+                # on the spawn host, not this one
+                return int(json.loads(
+                    self.transport.read_file(port_file))["port"])
+            except (OSError, ValueError, KeyError):
                 time.sleep(0.02)
         raise RuntimeError(f"replica {self.replica_id} child never wrote "
                            f"its port file (waited {timeout_s:.0f}s)")
@@ -389,7 +420,10 @@ class ProcessReplica:
                              spawn_timeout_s=self.spawn_timeout_s,
                              request_timeout_s=self.request_timeout_s,
                              warmup_lens=self.warmup_lens,
-                             draft_dir=self.draft_dir, tp=self.tp)
+                             draft_dir=self.draft_dir, tp=self.tp,
+                             spawn_host=self.spawn_host,
+                             transport=self.transport,
+                             staging_root=self.staging_root)
         eng.generation = self.generation + 1
         eng.on_failure = self.on_failure
         return eng

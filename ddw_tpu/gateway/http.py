@@ -233,6 +233,15 @@ class _Handler(BaseHTTPRequestHandler):
                     if deg:
                         body["degraded"] = True
                         body["slo_degraded"] = deg
+                a = gw.autoscale_view()
+                if a is not None:
+                    last = a["last_decision"] or {}
+                    body["autoscale"] = {
+                        "enabled": a["enabled"], "desired": a["desired"],
+                        "actual": a["actual"],
+                        "last_action": last.get("action"),
+                        "last_reason": last.get("reason"),
+                        "cooldown_remaining_s": a["cooldown_remaining_s"]}
                 if ready:
                     self._send_json(200, body)
                 else:
@@ -263,6 +272,9 @@ class _Handler(BaseHTTPRequestHandler):
                 #              answer /stats
                 if gw.supervisor is not None:
                     out["supervisor"] = gw.supervisor.report()
+                a = gw.autoscale_view()
+                if a is not None:
+                    out["autoscale"] = a
                 ts = gw.trace_summary()
                 if ts is not None:
                     out["trace"] = ts
@@ -291,6 +303,9 @@ class _Handler(BaseHTTPRequestHandler):
         gw = self.server.gateway
         if self.path == "/admin/deploy":
             self._admin_deploy(gw)
+            return
+        if self.path == "/admin/autoscale":
+            self._admin_autoscale(gw)
             return
         if self.path in ("/v1/kv/export", "/v1/kv/import"):
             # migration plane, not client data plane: ungated by the
@@ -840,6 +855,51 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, gw.deploy_view())
 
+    def _admin_autoscale(self, gw: "Gateway") -> None:
+        """Operate the autoscaler: enable/disable the loop and move the
+        policy's min/max bounds. Same 409-under-lock semantics as
+        ``/admin/deploy`` — while a rollout (or a scale event) holds the
+        deploy lock, reconfiguration is refused, not raced."""
+        body = self._read_body()
+        if body is None:
+            return
+        ctrl = gw.autoscaler
+        if ctrl is None:
+            self._send_json(404, {"error": "not_found",
+                                  "message": "autoscaler disabled "
+                                             "(Gateway(autoscale=True))"})
+            return
+        cfg = {}
+        if "enabled" in body:
+            if not isinstance(body["enabled"], bool):
+                self._send_json(400, {"error": "invalid_request",
+                                      "message": "enabled must be a bool"})
+                return
+            cfg["enabled"] = body["enabled"]
+        for key in ("min_replicas", "max_replicas"):
+            if key not in body:
+                continue
+            v = body[key]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                self._send_json(400, {"error": "invalid_request",
+                                      "message": f"{key} must be a "
+                                                 f"positive int"})
+                return
+            cfg[key] = v
+        with gw._deploy_lock:
+            busy = bool(gw.deploy_status.get("deploying"))
+        if busy:
+            self._send_json(409, {"error": "deploy_in_progress",
+                                  **gw.deploy_view()})
+            return
+        try:
+            out = ctrl.configure(**cfg)
+        except ValueError as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": str(e)})
+            return
+        self._send_json(200, out)
+
     def _batch_job(self, gw: "Gateway"):
         """Resolve ``/v1/batch/<id>[/results]`` → (job, tail) or None after
         answering 404."""
@@ -916,7 +976,10 @@ class Gateway:
                  telemetry_capacity: int = 4096, slos=None,
                  slo_kw: dict | None = None,
                  degradation_dir: str | None = None,
-                 deploy_journal_dir: str | None = None):
+                 deploy_journal_dir: str | None = None,
+                 autoscale: bool = False,
+                 autoscale_kw: dict | None = None,
+                 autoscale_journal_dir: str | None = None):
         self.replica_set = (replicas if isinstance(replicas, ReplicaSet)
                             else ReplicaSet(replicas))
         # end-to-end tracing (docs/observability.md): the gateway mints
@@ -971,6 +1034,16 @@ class Gateway:
         self._deploy_journal_dir = deploy_journal_dir
         self.deploy_status: dict = {"deploying": False, "status": "idle",
                                     "fleet_generation": 0, "steps": []}
+        # traffic-driven autoscaling (docs/serving.md): a reconciler loop
+        # over the telemetry plane's windows, sharing the deploy lock so a
+        # rollout and a scale event can never interleave. Constructed in
+        # start() (it wants the supervisor); ``autoscale_kw`` forwards the
+        # controller/policy knobs; ``autoscale_journal_dir`` makes every
+        # scale event crash-recoverable the same way deploys are.
+        self._autoscale = bool(autoscale)
+        self._autoscale_kw = dict(autoscale_kw or {})
+        self._autoscale_journal_dir = autoscale_journal_dir
+        self.autoscaler = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, warmup_prompt_lens=(8,), on_listening=None) -> "Gateway":
@@ -1002,6 +1075,23 @@ class Gateway:
         #                                      job a dead gateway left behind
         self._reconcile_deploy()             # rollout journal: converge a
         #                                      half-rolled fleet the same way
+        if self._autoscale and self.autoscaler is None:
+            from ddw_tpu.autoscale.controller import AutoscaleController
+            kw = dict(
+                merged_fn=(self.fleet_telemetry.merged
+                           if self.fleet_telemetry is not None else None),
+                slo_status_fn=(self.slo_monitor.status
+                               if self.slo_monitor is not None else None),
+                lifecycle=self.lifecycle)
+            kw.update(self._autoscale_kw)   # tests inject their own inputs
+            self.autoscaler = AutoscaleController(
+                self.replica_set, supervisor=self.supervisor,
+                journal_dir=self._autoscale_journal_dir,
+                deploy_lock=self._deploy_lock,
+                deploy_status=self.deploy_status, **kw)
+            self.autoscaler.reconcile()      # scale journal: finalize what
+            #                                  a dead gateway left mid-scale
+            self.autoscaler.start()
         if self._telemetry and self._telemetry_thread is None:
             self.telem.start()
             self._telemetry_stop.clear()
@@ -1102,6 +1192,13 @@ class Gateway:
             self._deploy_thread = threading.Thread(
                 target=ctrl.run, name="ddw-deploy", daemon=True)
             self._deploy_thread.start()
+
+    def autoscale_view(self) -> dict | None:
+        """The /stats autoscale block (None when autoscaling is off):
+        enabled flag, desired vs actual, last decision + reason,
+        per-direction cooldown remaining, policy knobs, event counters."""
+        ctrl = self.autoscaler
+        return ctrl.view() if ctrl is not None else None
 
     # -- tracing --------------------------------------------------------------
     def trace_summary(self) -> dict | None:
@@ -1336,6 +1433,9 @@ class Gateway:
             #                        may resubmit into a closing fleet
             clean = self.lifecycle.await_drained(
                 grace_s if grace_s is not None else self.lifecycle.grace_s)
+            if self.autoscaler is not None:
+                self.autoscaler.stop()   # no scale events during teardown
+                self.autoscaler = None
             if self.supervisor is not None:
                 self.supervisor.stop()   # no resurrections during teardown
                 self.supervisor = None

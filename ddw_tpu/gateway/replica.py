@@ -219,6 +219,8 @@ class ReplicaSet:
         if not self.replicas:
             raise ValueError("ReplicaSet needs at least one engine replica")
         n = len(self.replicas)
+        self._failure_threshold = failure_threshold   # for added slots
+        self._cooldown_s = cooldown_s
         self._outstanding = [0] * n
         self._where: dict = {}      # future -> replica index (for the
         #                             done-callback and failover moves)
@@ -302,7 +304,78 @@ class ReplicaSet:
 
     def note_restart(self, i: int) -> None:
         with self._lock:
-            self.restarts[i] += 1
+            if 0 <= i < len(self.restarts):
+                self.restarts[i] += 1
+
+    # -- elastic membership (the autoscaler's actuation surface) -------------
+    #
+    # Membership changes REPLACE the per-slot lists instead of mutating
+    # them in place: a router thread that captured the old lists under the
+    # lock keeps a mutually consistent (replicas, breakers, outstanding)
+    # view for the rest of its submission — it can still route to a
+    # retiring replica (which refuses and spills sideways, never a torn
+    # IndexError), but it can never observe lists of different lengths.
+    # fleet_metrics is untouched by construction: it is owned here, not
+    # per-slot, so scale events can't lose canary/handoff/journal counters.
+
+    def add_replica(self, eng) -> int:
+        """Admit an ALREADY-WARM replica into the routed fleet (the
+        autoscale controller spawns, warms, and shadow-probes it first —
+        capacity is never consumed by a cold replica). Returns the new
+        slot index."""
+        self._wire(len(self.replicas), eng)
+        with self._lock:
+            i = len(self.replicas)
+            self.replicas = self.replicas + [eng]
+            self._outstanding = self._outstanding + [0]
+            self.breakers = self.breakers + [CircuitBreaker(
+                self._failure_threshold, self._cooldown_s)]
+            self.restarts = self.restarts + [0]
+        return i
+
+    def remove_replica(self, i: int):
+        """Retire slot ``i`` from the routed fleet: pop every per-slot
+        structure, renumber the slots above it (in-flight futures keep
+        their accounting through the renumbered ``_where`` map), and clear
+        the router-side caches — :meth:`PrefixIndex.drop_replica` and
+        :meth:`FleetTelemetry.drop_replica` — for every source whose slot
+        identity changed, so repeated scale cycles leak nothing. Returns
+        the removed engine; the CALLER owns its drain/stop discipline (by
+        the time this runs the victim should hold no outstanding work)."""
+        with self._lock:
+            n = len(self.replicas)
+            if n <= 1:
+                raise ValueError("cannot remove the last replica")
+            if not 0 <= i < n:
+                raise IndexError(f"replica slot {i} out of range 0..{n - 1}")
+            eng = self.replicas[i]
+            self.replicas = self.replicas[:i] + self.replicas[i + 1:]
+            self._outstanding = (self._outstanding[:i]
+                                 + self._outstanding[i + 1:])
+            self.breakers = self.breakers[:i] + self.breakers[i + 1:]
+            self.restarts = self.restarts[:i] + self.restarts[i + 1:]
+            for fut, j in list(self._where.items()):
+                if j == i:          # victim stragglers: accounting already
+                    self._where.pop(fut)        # popped with the slot
+                elif j > i:
+                    self._where[fut] = j - 1
+            can = self._canary
+            if can is not None:
+                ci, frac = can
+                if ci == i:
+                    self._canary = None
+                elif ci > i:
+                    self._canary = (ci - 1, frac)
+        # every slot >= i changed identity: drop the router-side caches
+        # keyed by the OLD slot numbers (the prefix feed's since=0 re-poll
+        # and the telemetry re-ingest rebuild them for the new numbering)
+        for old in range(i, n):
+            self.prefix_index.drop_replica(old)
+            if self.telemetry is not None:
+                self.telemetry.drop_replica(f"replica{old}")
+        for j in range(i, len(self.replicas)):
+            self._wire(j, self.replicas[j])
+        return eng
 
     # -- routing ------------------------------------------------------------
     def outstanding(self) -> list[int]:
@@ -311,18 +384,23 @@ class ReplicaSet:
 
     def fleet_health(self) -> list[dict]:
         """Per-replica health + circuit view (the /stats payload)."""
+        with self._lock:
+            replicas = self.replicas
+            breakers = self.breakers
+            restarts = list(self.restarts)
+            outs = list(self._outstanding)
         out = []
-        for i, eng in enumerate(self.replicas):
+        for i, eng in enumerate(replicas):
             h = (eng.health() if hasattr(eng, "health")
                  else {"state": "unknown", "replica": i})
-            h["circuit"] = self.breakers[i].state
-            h["restarts"] = self.restarts[i]
-            with self._lock:
-                h["outstanding"] = self._outstanding[i]
+            h["circuit"] = breakers[i].state
+            h["restarts"] = restarts[i]
+            h["outstanding"] = outs[i]
             out.append(h)
         return out
 
-    def _score(self, i: int, outstanding: int, saved_tokens: int = 0):
+    def _score(self, i: int, outstanding: int, saved_tokens: int = 0,
+               replicas=None):
         """Projected-wait routing key: (estimated wait ms, pending work,
         index). Engines exposing ``load()`` are scored on queue depth +
         busy slots x their own EWMA service estimate — the ROADMAP's
@@ -331,8 +409,10 @@ class ReplicaSet:
         ``saved_tokens`` is this replica's cached-prefix match for the
         prompt being routed: matched tokens x its per-prefilled-token EWMA
         are credited against the wait, so a warm replica wins exactly
-        until its queue costs more than the cold prefill elsewhere."""
-        eng = self.replicas[i]
+        until its queue costs more than the cold prefill elsewhere.
+        ``replicas`` is the caller's captured membership view (elastic
+        fleets replace the list on scale events)."""
+        eng = (replicas if replicas is not None else self.replicas)[i]
         if hasattr(eng, "load"):
             try:
                 ld = eng.load()
@@ -351,11 +431,17 @@ class ReplicaSet:
         """``weighted=False`` skips the canary reorder (and its diversion
         counter) — the telemetry sampler's read-only view."""
         with self._lock:
+            # one consistent membership view: the per-slot lists are
+            # replaced (never resized in place) on scale events, so
+            # capturing them together under the lock can't tear
             outs = list(self._outstanding)
+            replicas = self.replicas
+            breakers = self.breakers
         scored = [self._score(i, outs[i],
-                              matched.get(i, 0) if matched else 0)
-                  for i in range(len(self.replicas))
-                  if i not in exclude and self.breakers[i].available()]
+                              matched.get(i, 0) if matched else 0,
+                              replicas=replicas)
+                  for i in range(len(replicas))
+                  if i not in exclude and breakers[i].available()]
         scored.sort()
         return self._canary_reorder(scored) if weighted else scored
 
@@ -411,31 +497,35 @@ class ReplicaSet:
 
     def _dec(self, i: int) -> None:
         with self._lock:
-            self._outstanding[i] -= 1
+            if 0 <= i < len(self._outstanding):
+                self._outstanding[i] -= 1
 
     def _on_done(self, fut) -> None:
         """Every routed future lands here exactly once — the accounting
         decrement AND the breaker's outcome feed. Submission paths that
-        raise never registered the future, so the counter can't leak."""
+        raise never registered the future, so the counter can't leak.
+        ``_where`` is renumbered by ``remove_replica``, so the slot read
+        here tracks membership changes that happened mid-flight."""
         with self._lock:
             i = self._where.pop(fut, None)
-            if i is not None:
+            if i is not None and i < len(self._outstanding):
                 self._outstanding[i] -= 1
-        if i is None:
+            breakers = self.breakers
+        if i is None or i >= len(breakers):
             return
         try:
             exc = None if fut.cancelled() else fut.exception()
         except Exception:
             exc = None
         if exc is None:
-            self.breakers[i].record_success()
+            breakers[i].record_success()
         elif isinstance(exc, ReplicaFailed):
-            self.breakers[i].record_failure()
+            breakers[i].record_failure()
         else:
             # Overloaded/DeadlineExceeded are honest load answers from a
             # live replica — neutral for health, but a claimed probe slot
             # must not leak
-            self.breakers[i].abort_probe()
+            breakers[i].abort_probe()
 
     def _submit(self, method: str, args, kwargs, prompt=None):
         tracer = self.tracer
@@ -465,6 +555,9 @@ class ReplicaSet:
         if not order:
             raise Unavailable("all replica circuits open",
                               retry_after_ms=self._min_retry_ms())
+        with self._lock:
+            replicas = self.replicas       # consistent membership view for
+            breakers = self.breakers       # the rest of this submission
         # the routing span is allocated up front so the engine's own chain
         # (queue -> prefill -> decode) can parent on it across the hop
         route_sid = None
@@ -477,10 +570,13 @@ class ReplicaSet:
         for i in order:
             if overloads >= 2:
                 break               # the single-sideways-spill budget
+            if i >= len(replicas):
+                continue            # slot retired between score and submit
             with self._lock:
-                self._outstanding[i] += 1
+                if i < len(self._outstanding):
+                    self._outstanding[i] += 1
             try:
-                fut = getattr(self.replicas[i], method)(*args, **kwargs)
+                fut = getattr(replicas[i], method)(*args, **kwargs)
             except Overloaded as e:
                 self._dec(i)
                 last = e
@@ -492,7 +588,7 @@ class ReplicaSet:
             except ReplicaFailed as e:
                 self._dec(i)        # a corpse doesn't consume the 429
                 last = e            # budget — walk to any live sibling
-                self.breakers[i].record_failure()
+                breakers[i].record_failure()
                 continue
             except BaseException:
                 self._dec(i)     # validation errors etc. must not leak
@@ -509,7 +605,7 @@ class ReplicaSet:
                           "prefix_tokens": (matched.get(i, 0)
                                             if matched else 0),
                           "spills": overloads})
-            self.breakers[i].begin_probe()
+            breakers[i].begin_probe()
             with self._lock:
                 self._where[fut] = i
             fut.add_done_callback(self._on_done)
@@ -556,13 +652,13 @@ class ReplicaSet:
                 dec = True
         return tuple(pre) if (pre and dec) else ()
 
-    def _decode_score(self, i: int, outstanding: int):
+    def _decode_score(self, i: int, outstanding: int, replicas=None):
         """Decode-placement key: projected wait first, then block-pool
         headroom (``free_block_frac`` from ``load()``) — between equally
         idle decode replicas the request lands where the KV pool has the
         most room, so imported blocks don't reclaim someone else's warm
         prefix."""
-        eng = self.replicas[i]
+        eng = (replicas if replicas is not None else self.replicas)[i]
         wait, free = float(outstanding), 1.0
         if hasattr(eng, "load"):
             try:
@@ -591,26 +687,30 @@ class ReplicaSet:
         except Exception:
             return None
         try:
-            avail = [i for i in range(len(self.replicas))
-                     if self.breakers[i].available()]
+            with self._lock:
+                replicas = self.replicas    # one consistent membership view
+                breakers = self.breakers
+                outs = list(self._outstanding)
+            avail = [i for i in range(len(replicas))
+                     if breakers[i].available()]
             pcap = [i for i in avail
-                    if self._role(self.replicas[i]) in ("prefill", "both")]
+                    if self._role(replicas[i]) in ("prefill", "both")]
             dcap = [i for i in avail
-                    if self._role(self.replicas[i]) != "prefill"]
+                    if self._role(replicas[i]) != "prefill"]
             if not pcap or not dcap:
                 return None
-            with self._lock:
-                outs = list(self._outstanding)
             # TTFT-aware split: P chases the warm prefix (prefix credit
             # against projected wait, the _score discipline), D weighs
             # projected wait + pool headroom.
             pi = min(self._score(i, outs[i],
-                                 matched.get(i, 0) if matched else 0)
+                                 matched.get(i, 0) if matched else 0,
+                                 replicas=replicas)
                      for i in pcap)[-1]
-            di = min(self._decode_score(i, outs[i]) for i in dcap)[-1]
+            di = min(self._decode_score(i, outs[i], replicas=replicas)
+                     for i in dcap)[-1]
             if pi == di:
                 return None     # one replica wins both phases: colocated
-            p_eng, d_eng = self.replicas[pi], self.replicas[di]
+            p_eng, d_eng = replicas[pi], replicas[di]
             if (not hasattr(p_eng, "kv_export")
                     or not hasattr(d_eng, "kv_import")):
                 return None
@@ -634,7 +734,8 @@ class ReplicaSet:
             # accounting; D's admission prefix-hits the imported blocks
             # and re-derives the first token bit-identically.
             with self._lock:
-                self._outstanding[di] += 1
+                if di < len(self._outstanding):
+                    self._outstanding[di] += 1
             try:
                 fut = d_eng.submit_generate(*args, **kwargs)
             except BaseException:
@@ -652,7 +753,7 @@ class ReplicaSet:
                     args={"prefill": pi, "decode": di,
                           "skip_blocks": len(skip),
                           "ms": round((time.monotonic() - t0) * 1e3, 3)})
-            self.breakers[di].begin_probe()
+            breakers[di].begin_probe()
             with self._lock:
                 self._where[fut] = di
             fut.add_done_callback(self._on_done)
@@ -690,8 +791,12 @@ class ReplicaSet:
             return
         exclude = (src,) + (self._prefill_only()
                             if kind == "generate" else ())
+        with self._lock:
+            replicas = self.replicas        # consistent membership view
         for j in self._order(exclude=exclude):
-            eng = self.replicas[j]
+            if j >= len(replicas):
+                continue        # slot retired between score and adopt
+            eng = replicas[j]
             if not hasattr(eng, "adopt"):
                 continue
             if deadline is not None and hasattr(eng, "load"):
@@ -709,8 +814,10 @@ class ReplicaSet:
                 fut = req.future
                 prev = self._where.get(fut)
                 if prev is not None:    # move the outstanding count with it
-                    self._outstanding[prev] -= 1
-                    self._outstanding[j] += 1
+                    if prev < len(self._outstanding):
+                        self._outstanding[prev] -= 1
+                    if j < len(self._outstanding):
+                        self._outstanding[j] += 1
                     self._where[fut] = j
                 self.failed_over += 1
             if self.tracer is not None:
@@ -769,12 +876,17 @@ class ReplicaSet:
         if not order:
             raise Unavailable("all replica circuits open",
                               retry_after_ms=self._min_retry_ms())
+        with self._lock:
+            replicas = self.replicas        # consistent membership view
+            breakers = self.breakers
         last: Exception | None = None
         overloads = 0
         for i in order:
             if overloads >= 2:
                 break
-            eng = self.replicas[i]
+            if i >= len(replicas):
+                continue        # slot retired between score and submit
+            eng = replicas[i]
             try:
                 if hasattr(eng, "submit_batch_items"):
                     futs = eng.submit_batch_items(
@@ -794,12 +906,13 @@ class ReplicaSet:
                 continue
             except ReplicaFailed as e:
                 last = e
-                self.breakers[i].record_failure()
+                breakers[i].record_failure()
                 continue
-            self.breakers[i].begin_probe()
+            breakers[i].begin_probe()
             with self._lock:
+                ok = i < len(self._outstanding)
                 for fut in futs:
-                    if not fut.done():      # pre-failed stragglers stay
+                    if ok and not fut.done():   # pre-failed stragglers stay
                         self._outstanding[i] += 1   # out of the breaker
                         self._where[fut] = i        # feed — the replica
             #                                         never saw them
@@ -875,19 +988,21 @@ class ReplicaSet:
         with self._lock:
             outstanding = list(self._outstanding)
             restarts = list(self.restarts)
+            breakers = self.breakers
             out["gateway.retried_429"] = float(self.retried_429)
             out["gateway.replica_failures"] = float(self.replica_failures)
             out["gateway.failed_over"] = float(self.failed_over)
-        out["gateway.replicas"] = float(len(self.replicas))
+        out["gateway.replicas"] = float(len(outstanding))
         for i, n in enumerate(outstanding):
             out[f"gateway.outstanding_r{i}"] = float(n)
-            out[f"gateway.circuit_r{i}"] = _CIRCUIT_CODE[
-                self.breakers[i].state]
+            out[f"gateway.circuit_r{i}"] = _CIRCUIT_CODE[breakers[i].state]
             out[f"gateway.restarts_r{i}"] = float(restarts[i])
         return out
 
     def prometheus(self) -> str:
         with self._lock:
+            replicas = self.replicas
+            breakers = self.breakers
             gauges = {f'ddw_gateway_outstanding{{replica="{i}"}}': float(n)
                       for i, n in enumerate(self._outstanding)}
             gauges["ddw_gateway_retried_429"] = float(self.retried_429)
@@ -895,10 +1010,10 @@ class ReplicaSet:
                 self.replica_failures)
             for i, n in enumerate(self.restarts):
                 gauges[f'ddw_gateway_restarts{{replica="{i}"}}'] = float(n)
-        for i, b in enumerate(self.breakers):
+        for i, b in enumerate(breakers):
             gauges[f'ddw_gateway_circuit_state{{replica="{i}"}}'] = \
                 _CIRCUIT_CODE[b.state]
-        gauges["ddw_gateway_replicas"] = float(len(self.replicas))
-        return render_prometheus([eng.metrics for eng in self.replicas]
+        gauges["ddw_gateway_replicas"] = float(len(replicas))
+        return render_prometheus([eng.metrics for eng in replicas]
                                  + [self.fleet_metrics],
                                  extra_gauges=gauges)

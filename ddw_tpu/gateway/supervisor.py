@@ -161,6 +161,23 @@ class ReplicaSupervisor:
                     "attempts": [dataclasses.asdict(a)
                                  for a in self.attempts]}
 
+    # -- elastic membership (the autoscaler's bookkeeping hooks) -------------
+    def note_added(self) -> None:
+        """A slot was appended to the fleet (autoscale scale-out): grow the
+        per-slot recovery state in step."""
+        with self._lock:
+            self._next_attempt_at.append(0.0)
+            self._degraded_since.append(None)
+
+    def note_removed(self, i: int) -> None:
+        """Slot ``i`` was retired (scale-in): drop its recovery state — the
+        slots above renumber exactly as ``ReplicaSet.remove_replica`` did,
+        and their backoff clocks travel with them."""
+        with self._lock:
+            if 0 <= i < len(self._next_attempt_at):
+                self._next_attempt_at.pop(i)
+                self._degraded_since.pop(i)
+
     # -- monitor loop --------------------------------------------------------
     def _draining(self) -> bool:
         return (self.lifecycle is not None
@@ -177,6 +194,8 @@ class ReplicaSupervisor:
                 try:
                     if not hasattr(eng, "health"):
                         continue
+                    while i >= len(self._next_attempt_at):
+                        self.note_added()   # fleet grew under the monitor
                     h = eng.health()
                     if (h["state"] in ("alive", "degraded") and h["running"]
                             and h["last_tick_age_s"] > self.stall_timeout_s):

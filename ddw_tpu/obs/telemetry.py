@@ -429,6 +429,9 @@ def signal_registry() -> dict[str, str]:
                  "serve.decode_bucket", "serve.batch_backlog",
                  "serve.tp_degree", "serve.spec_k_effective"):
         reg[name] = "gauge"
+    # autoscaler convergence state (pushed on the fleet metrics each tick)
+    for name in ("serve.desired_replicas", "serve.fleet_size"):
+        reg[name] = "gauge"
     # gateway routing state
     for name in ("gateway.connections", "gateway.inflight",
                  "gateway.outstanding", "gateway.breaker_open",

@@ -118,6 +118,40 @@ mid_roll              BEFORE rolling the ``after``-th replica — the control
 ``replica`` defaults to ``*`` (any — the judge passes the canary's index);
 ``ttft_ms`` defaults to 250; ``errors`` to 0; ``after`` to 0 (crash before
 the first replica rolls).
+
+Autoscale scope
+---------------
+
+The autoscaler (:mod:`ddw_tpu.autoscale`) gets its own arms — an
+``autoscale:`` spec is invisible to the gang, serve, and deploy sites:
+
+    DDW_FAULT=autoscale:spawn_fail[:after=N]
+    DDW_FAULT=autoscale:stall_drain
+    DDW_FAULT=autoscale:flap
+    DDW_FAULT=autoscale:crash_mid_scale[:after=N]
+
+=============== ========= ===================================================
+kind             site      effect when the spec matches
+=============== ========= ===================================================
+spawn_fail       spawn     raise :class:`FaultInjected` where the controller
+                           spawns a surge child — the scale-out must abort
+                           with the journal finalized and ZERO capacity
+                           consumed (the cold replica was never admitted)
+stall_drain      drain     block while the spec stays configured (clearing
+                           ``DDW_FAULT`` resumes; the controller's abort
+                           signal raises) — holds a scale-in's drain wait
+                           open so the drain deadline fires and the victim
+                           is re-admitted instead of killed with work aboard
+flap             decide    RETURNED for the controller to apply: synthetic
+                           pressure alternating out/in every decide tick —
+                           the hysteresis band + per-direction cooldowns
+                           must absorb it into a bounded number of real
+                           scale events
+crash_mid_scale  mid_scale raise :class:`AutoscaleCrash` at the journal
+                           boundary after ``after`` journaled steps — the
+                           reconciler at ``Gateway.start()`` drills on the
+                           unfinalized scale journal it leaves behind
+=============== ========= ===================================================
 """
 
 from __future__ import annotations
@@ -202,6 +236,9 @@ def parse_fault(spec: str) -> FaultSpec | None:
         return None
     if spec.startswith("deploy:"):
         parse_deploy_fault(spec)    # validate, then ignore at gang sites
+        return None
+    if spec.startswith("autoscale:"):
+        parse_autoscale_fault(spec)  # validate, then ignore at gang sites
         return None
     parts = spec.split(":")
     kind = parts[0].strip()
@@ -507,6 +544,106 @@ def maybe_deploy_fault(site: str, replica: int = 0,
         raise DeployCrash(f"injected mid-roll crash (step {n}): journal "
                           f"left unfinalized")
     return spec
+
+
+# ---------------------------------------------------------------------------
+# Autoscale scope: deterministic arms for the fleet autoscaler
+# (ddw_tpu.autoscale) — spawn failure, stuck drain, oscillating pressure,
+# and the mid-scale gateway death the scale journal exists for.
+# ---------------------------------------------------------------------------
+
+AUTOSCALE_KINDS = ("spawn_fail", "stall_drain", "flap", "crash_mid_scale")
+AUTOSCALE_SITES = ("spawn", "drain", "decide", "mid_scale")
+
+_AUTOSCALE_SITE_BY_KIND = {"spawn_fail": "spawn", "stall_drain": "drain",
+                           "flap": "decide", "crash_mid_scale": "mid_scale"}
+
+
+class AutoscaleCrash(RuntimeError):
+    """Raised by ``autoscale:crash_mid_scale`` — the scale event's control
+    flow dies at a journal boundary WITHOUT finalizing the scale journal,
+    the in-process stand-in for a gateway SIGKILL mid-scale. The autoscale
+    reconciler (``Gateway.start``) must converge the fleet on restart."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleFaultSpec:
+    """Parsed ``DDW_FAULT=autoscale:...`` value. A bare spec fires on the
+    first matching site check (``after=0``)."""
+
+    kind: str
+    after: int = 0                # fire on the Nth matching check
+
+    @property
+    def site(self) -> str:
+        return _AUTOSCALE_SITE_BY_KIND[self.kind]
+
+    def matches(self, site: str, n: int = 0) -> bool:
+        """Pure matching logic. ``n`` is the caller's invocation count for
+        the site (journaled steps for ``mid_scale``, decide ticks for
+        ``decide``, spawn attempts for ``spawn``)."""
+        return site == self.site and n >= self.after
+
+
+def parse_autoscale_fault(spec: str) -> AutoscaleFaultSpec | None:
+    """Parse an ``autoscale:``-scoped ``DDW_FAULT`` value; non-autoscale
+    specs (and empty) -> None. Malformed specs raise, same rule as
+    :func:`parse_fault`."""
+    if not spec or not spec.startswith("autoscale:"):
+        return None
+    parts = spec.split(":")[1:]
+    if not parts or parts[0].strip() not in AUTOSCALE_KINDS:
+        raise ValueError(f"unknown DDW_FAULT autoscale kind "
+                         f"{parts[0].strip() if parts else ''!r}; expected "
+                         f"one of {AUTOSCALE_KINDS}")
+    kind = parts[0].strip()
+    fields: dict[str, int] = {}
+    for part in parts[1:]:
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key, val = key.strip(), val.strip()
+        if key == "after":
+            fields[key] = int(val)
+        else:
+            raise ValueError(f"unknown DDW_FAULT autoscale key {key!r} in "
+                             f"{spec!r}")
+    return AutoscaleFaultSpec(kind=kind, **fields)
+
+
+def active_autoscale_fault() -> AutoscaleFaultSpec | None:
+    """The currently configured autoscale fault, re-read from the env on
+    every call (tests monkeypatch ``DDW_FAULT`` mid-process)."""
+    return parse_autoscale_fault(os.environ.get("DDW_FAULT", ""))
+
+
+def maybe_autoscale_fault(site: str, n: int = 0,
+                          should_abort=None) -> AutoscaleFaultSpec | None:
+    """Autoscaler hook: at ``spawn`` a matching ``spawn_fail`` raises
+    :class:`FaultInjected`; at ``mid_scale`` a matching ``crash_mid_scale``
+    raises :class:`AutoscaleCrash`; at ``drain`` a matching ``stall_drain``
+    BLOCKS while the spec stays configured (clearing ``DDW_FAULT`` resumes
+    the drain wait cleanly; ``should_abort`` — the controller's stop signal
+    — raises so the wait always stays joinable); at ``decide`` a matching
+    ``flap`` is RETURNED for the controller to apply as synthetic
+    alternating pressure. No-op (None) without ``DDW_FAULT``."""
+    if "DDW_FAULT" not in os.environ:   # fast path for the reconcile tick
+        return None
+    spec = active_autoscale_fault()
+    if spec is None or not spec.matches(site, n=n):
+        return None
+    if spec.kind == "spawn_fail":
+        raise FaultInjected(f"injected autoscale spawn failure (attempt {n})")
+    if spec.kind == "crash_mid_scale":
+        raise AutoscaleCrash(f"injected mid-scale crash (step {n}): scale "
+                             f"journal left unfinalized")
+    if spec.kind == "stall_drain":
+        while should_abort is None or not should_abort():
+            if active_autoscale_fault() != spec:
+                return None     # fault cleared: the drain wait resumes
+            time.sleep(0.01)
+        raise AutoscaleCrash(f"injected drain stall aborted (n {n})")
+    return spec                 # flap: the controller applies it
 
 
 # ---------------------------------------------------------------------------

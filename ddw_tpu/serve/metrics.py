@@ -172,6 +172,12 @@ class EngineMetrics:
         self.surge_spawns = 0      # spawn-before-drain replacements landed
         self.journal_resumes = 0   # rollouts resumed from a journal after a
         #                            gateway restart (reconciler path)
+        # fleet autoscaling (ddw_tpu.autoscale; fleet-level like the rollout
+        # counters — membership changes must never reset them)
+        self.scale_outs = 0        # replicas added by the autoscaler
+        self.scale_ins = 0         # replicas drained and retired by it
+        self.autoscale_blocked = 0  # decisions deferred because a rollout
+        #                            held the deploy lock (counted, not raced)
         # prefill/decode disaggregation (docs/serving.md "Disaggregated
         # prefill/decode"): block migration counts land on the IMPORTING
         # engine (so a prefix-warm receiver that skipped payload blocks
@@ -330,6 +336,9 @@ class EngineMetrics:
                 "serve.canary_rejected": float(self.canary_rejected),
                 "serve.surge_spawns": float(self.surge_spawns),
                 "serve.journal_resumes": float(self.journal_resumes),
+                "serve.scale_outs": float(self.scale_outs),
+                "serve.scale_ins": float(self.scale_ins),
+                "serve.autoscale_blocked": float(self.autoscale_blocked),
                 "serve.kv_blocks_migrated": float(self.kv_blocks_migrated),
                 "serve.kv_bytes_migrated": float(self.kv_bytes_migrated),
                 "serve.handoffs": float(self.handoffs),
@@ -512,6 +521,12 @@ _COUNTER_HELP = (
      "spawned and warmed before the old one drained)."),
     ("journal_resumes", "Rollouts resumed from a durable deploy journal "
      "after a gateway restart."),
+    ("scale_outs", "Replicas added to the fleet by the autoscaler (admitted "
+     "only after warm shadow-probe)."),
+    ("scale_ins", "Replicas drained to completion and retired by the "
+     "autoscaler."),
+    ("autoscale_blocked", "Autoscale decisions deferred because a rollout "
+     "held the deploy lock (mutual exclusion, counted not raced)."),
     ("kv_blocks_migrated", "KV blocks landed from another replica via the "
      "migration wire format (counted at the importer)."),
     ("kv_bytes_migrated", "Payload bytes of the KV blocks landed via "

@@ -1,0 +1,590 @@
+"""Traffic-driven fleet autoscaler (ddw_tpu.autoscale) — tier-1.
+
+What is pinned here, and why it matters:
+
+- **policy math is pure**: burn-rate/queue/TTFT/occupancy in -> ONE
+  desired replica count out, with the hysteresis band (in strictly below
+  out), per-direction cooldowns (both stamped by any event — an out can
+  never be chased by an instant in), min/max clamps, and the two window
+  speeds (scale-OUT judged on the fast inputs, scale-IN quiescence on the
+  slow ones). Everything clock-injected: no fleet, no threads, no sleeps;
+- **the reconcile drill**: an injected burst scales a 1-replica fleet to
+  the policy max with SURGE semantics (the candidate is started, warmed,
+  and shadow-probed while provably NOT yet routed), idle scales it back
+  to min with drain-first retirement, zero client-visible failures and
+  bit-identical greedy outputs across every membership change;
+- **the journal closes the crash window**: ``crash_mid_scale`` kills the
+  scale event between admission and finalize; the journal is left
+  non-terminal and :meth:`AutoscaleController.reconcile` (the
+  ``Gateway.start`` path) finalizes it and counts ``journal_resumes``;
+- **rollouts and scale events exclude each other**: a tick under a held
+  deploy lock DEFERS and counts ``serve.autoscale_blocked`` — blocked is
+  counted, never raced — and leaves the rollout's status untouched;
+- **membership changes leak nothing**: ``fleet_metrics`` counters survive
+  add/remove cycles (they are fleet-owned, not per-slot), and ten scale
+  cycles leave no per-slot residue in ``PrefixIndex`` / ``FleetTelemetry``;
+- **the HTTP surface**: ``/readyz`` + ``/stats`` autoscale blocks,
+  ``POST /admin/autoscale`` (enable/disable/bounds) with the same
+  409-under-deploy-lock semantics as ``/admin/deploy``, and the new
+  counters/gauges in the Prometheus exposition.
+"""
+
+import concurrent.futures
+import json
+import os
+import threading
+
+import pytest
+
+from ddw_tpu.autoscale import (AutoscaleController, PolicyInputs,
+                               ScalePolicy, inputs_from_windows, max_burn)
+from ddw_tpu.deploy.journal import RolloutJournal
+from ddw_tpu.gateway import Gateway, GatewayClient, ReplicaSet
+from ddw_tpu.gateway.client import GatewayError
+from ddw_tpu.obs.telemetry import FleetTelemetry
+from ddw_tpu.runtime.faults import (AutoscaleCrash, AutoscaleFaultSpec,
+                                    FaultInjected, parse_autoscale_fault,
+                                    parse_fault)
+from ddw_tpu.serve.metrics import EngineMetrics
+
+
+class _Clock:
+    """Injectable monotonic clock — cooldown/drain tests never sleep."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _Eng:
+    """Scripted replica with a DETERMINISTIC greedy generate (a pure
+    function of the prompt), so bit-identity across membership changes is
+    checkable, plus start/warmup/probe/stop recording for the surge-order
+    pin."""
+
+    def __init__(self, rs_ref=None):
+        self.metrics = EngineMetrics()
+        self.events: list[str] = []
+        self.started = False
+        self.stopped = False
+        self._rs_ref = rs_ref       # surge pin: probe asserts not-yet-routed
+
+    def start(self):
+        self.started = True
+        self.events.append("start")
+        return self
+
+    def stop(self):
+        self.stopped = True
+        self.events.append("stop")
+
+    def warmup(self, prompt_lens=(8,)):
+        self.events.append("warmup")
+
+    def probe(self, timeout_s=None):
+        self.events.append("probe")
+        if self._rs_ref is not None:
+            # THE surge guarantee: shadow-probed while not yet admitted
+            assert self not in self._rs_ref.replicas, \
+                "candidate was routed before its probe"
+
+    def submit_generate(self, prompt, num_steps, **kw):
+        f = concurrent.futures.Future()
+        f.set_result([(sum(prompt) * 31 + k) % 50257
+                      for k in range(num_steps)])
+        return f
+
+
+def _merged_fn(state):
+    """Synthetic FleetTelemetry.merged() shape driven by a mutable dict —
+    the test's pressure knob."""
+    def merged():
+        sig = {"serve.queue_depth": {"kind": "gauge",
+                                     "last_sum": state.get("queue", 0.0)}}
+        win = {"signals": sig}
+        return {"windows": {"10s": win, "60s": win}}
+    return merged
+
+
+def _policy(clk, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("queue_out", 8.0)
+    kw.setdefault("queue_in", 1.0)
+    kw.setdefault("out_cooldown_s", 0.0)
+    kw.setdefault("in_cooldown_s", 0.0)
+    return ScalePolicy(clock=clk, **kw)
+
+
+# -- policy math (pure units: burn-rate in -> desired count out) --------------
+
+
+def test_policy_construction_validates():
+    with pytest.raises(ValueError):
+        ScalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        ScalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        ScalePolicy(step=0)
+    with pytest.raises(ValueError):            # thresholds set together
+        ScalePolicy(queue_out=8.0, queue_in=None)
+    with pytest.raises(ValueError):            # in strictly below out
+        ScalePolicy(queue_out=8.0, queue_in=8.0)
+
+
+def test_policy_burn_scales_out_and_clamps_at_max():
+    p = ScalePolicy(max_replicas=4)
+    d = p.decide(PolicyInputs(replicas=1, burn=3.0))
+    assert (d.action, d.desired, d.current) == ("out", 2, 1)
+    assert "burn" in d.reason and "(fast)" in d.reason
+    # at the max bound, pressure holds instead of overshooting
+    d = p.decide(PolicyInputs(replicas=4, burn=3.0))
+    assert d.action == "hold" and "max_replicas=4" in d.reason
+
+
+def test_policy_hysteresis_band_holds():
+    clk = _Clock()
+    p = _policy(clk)
+    # queue/replica of 4 sits between in(1) and out(8): the band holds
+    d = p.decide(PolicyInputs(replicas=2, queue_depth=8.0))
+    assert d.action == "hold" and "hysteresis band" in d.reason
+    # scale-in needs EVERY signal below its in-threshold on the SLOW window
+    fast = PolicyInputs(replicas=3)
+    slow = PolicyInputs(replicas=3, queue_depth=9.0)   # 3/replica >= 1
+    d = p.decide(fast, slow)
+    assert d.action == "hold" and "(slow)" in d.reason
+    d = p.decide(fast, PolicyInputs(replicas=3))
+    assert (d.action, d.desired) == ("in", 2)
+
+
+def test_policy_out_judged_on_fast_window_only():
+    clk = _Clock()
+    p = _policy(clk)
+    fast = PolicyInputs(replicas=1, queue_depth=100.0)
+    slow = PolicyInputs(replicas=1)          # 60s window still quiet
+    d = p.decide(fast, slow)
+    assert d.action == "out"                 # the burst answers in seconds
+
+
+def test_policy_cooldowns_stamp_both_directions():
+    clk = _Clock()
+    p = _policy(clk, out_cooldown_s=10.0, in_cooldown_s=30.0)
+    p.note_scaled("out")                     # t=0: an out event lands
+    clk.advance(5.0)
+    d = p.decide(PolicyInputs(replicas=2, queue_depth=100.0))
+    assert d.action == "hold" and "cooldown" in d.reason
+    assert d.cooldown_remaining_s == pytest.approx(5.0)
+    # the IN clock restarted too: an out chased by an instant in is flap
+    d = p.decide(PolicyInputs(replicas=2))
+    assert d.action == "hold" and "cooldown" in d.reason
+    assert d.cooldown_remaining_s == pytest.approx(25.0)
+    clk.advance(5.0)                         # t=10: out cooldown expired
+    assert p.decide(PolicyInputs(replicas=2, queue_depth=100.0)).action \
+        == "out"
+    clk.advance(20.0)                        # t=30: in cooldown expired
+    assert p.decide(PolicyInputs(replicas=2)).action == "in"
+
+
+def test_policy_min_clamp_and_describe():
+    clk = _Clock()
+    p = _policy(clk)
+    d = p.decide(PolicyInputs(replicas=1))
+    assert d.action == "hold" and "min_replicas=1" in d.reason
+    desc = p.describe()
+    assert desc["min_replicas"] == 1 and desc["max_replicas"] == 3
+    assert desc["queue_per_replica_out"] == 8.0
+    assert desc["burn_out"] == 2.0 and desc["burn_in"] == 0.5
+
+
+def test_max_burn_handles_full_slo_status_dict():
+    status = {"objectives": {
+        "ttft": {"burn": {"fast/1m": {"burn": 3.5, "ratio": 0.9},
+                          "slow/30m": {"burn": 1.1}}},
+        "availability": {"burn": {"fast/1m": {"burn": 0.2}}}},
+        "evals": 7, "history": [], "dumps": []}      # non-dict values ride
+    assert max_burn(status) == pytest.approx(3.5)
+    assert max_burn(None) == 0.0
+    assert max_burn({}) == 0.0
+    # a bare objectives map (no wrapper) also reads
+    assert max_burn({"o": {"burn": {"w": {"burn": 2.0}}}}) == 2.0
+
+
+def test_inputs_from_windows_extraction():
+    merged = {"windows": {"10s": {"signals": {
+        "serve.queue_depth": {"kind": "gauge", "last_sum": 12.0},
+        "serve.ttft_ms": {"kind": "dist", "p95": 850.0},
+        "serve.blocks_total": {"kind": "gauge", "last_sum": 100.0},
+        "serve.blocks_free": {"kind": "gauge", "last_sum": 25.0}}}}}
+    inp = inputs_from_windows(merged, "10s", replicas=3)
+    assert inp.queue_depth == 12.0
+    assert inp.queue_per_replica == pytest.approx(4.0)
+    assert inp.ttft_p95_ms == 850.0
+    assert inp.occupancy_pct == pytest.approx(75.0)
+    # an absent window reads as no pressure (and 0/0 occupancy is 0)
+    empty = inputs_from_windows({}, "10s", replicas=1)
+    assert empty.queue_depth == 0.0 and empty.occupancy_pct == 0.0
+
+
+# -- the autoscale fault scope ------------------------------------------------
+
+
+def test_autoscale_fault_parsing_and_sites():
+    spec = parse_autoscale_fault("autoscale:spawn_fail")
+    assert spec == AutoscaleFaultSpec("spawn_fail") and spec.site == "spawn"
+    spec = parse_autoscale_fault("autoscale:flap:after=3")
+    assert spec.after == 3 and spec.site == "decide"
+    assert spec.matches("decide", n=3) and not spec.matches("decide", n=2)
+    assert not spec.matches("spawn", n=99)
+    assert parse_autoscale_fault("deploy:crash_mid_roll") is None
+    with pytest.raises(ValueError):
+        parse_autoscale_fault("autoscale:meteor")
+    with pytest.raises(ValueError):
+        parse_autoscale_fault("autoscale:flap:jitter=1")
+    # the shared parse_fault router validates the scope (typos fail
+    # loudly at the first gang hook) but ignores it at gang sites
+    assert parse_fault("autoscale:stall_drain") is None
+    with pytest.raises(ValueError):
+        parse_fault("autoscale:meteor")
+
+
+# -- membership: the fleet-owned counters + no per-slot leaks -----------------
+
+
+def test_fleet_metrics_survive_membership_changes():
+    """Canary/handoff/journal counters are FLEET-owned: scale events must
+    not lose them (the per-slot lists are replaced; fleet_metrics never
+    is)."""
+    rs = ReplicaSet([_Eng(), _Eng()])
+    rs.fleet_metrics.count("handoffs", 5)
+    rs.fleet_metrics.count("journal_resumes", 2)
+    rs.fleet_metrics.count("warm_replays", 7)
+    for _ in range(3):
+        i = rs.add_replica(_Eng())
+        rs.remove_replica(i)
+    rs.remove_replica(0)
+    rs.add_replica(_Eng())
+    assert rs.fleet_metrics.handoffs == 5
+    assert rs.fleet_metrics.journal_resumes == 2
+    snap = rs.snapshot()                    # merged through the fleet view
+    assert snap["serve.handoffs"] == 5.0
+    assert snap["serve.warm_replays"] == 7.0
+    assert snap["gateway.replicas"] == 2.0
+
+
+def test_remove_replica_refuses_last_and_bounds():
+    rs = ReplicaSet([_Eng()])
+    with pytest.raises(ValueError):
+        rs.remove_replica(0)
+    rs.add_replica(_Eng())
+    with pytest.raises(IndexError):
+        rs.remove_replica(5)
+
+
+def test_ten_scale_cycles_leak_no_per_slot_state():
+    """PrefixIndex slot maps and FleetTelemetry per-source caches are
+    dropped with the slot — ten scale cycles leave the router-side
+    structures exactly as a never-scaled fleet."""
+    rs = ReplicaSet([_Eng()])
+    rs.telemetry = FleetTelemetry()
+    for cycle in range(10):
+        i = rs.add_replica(_Eng())
+        rs.telemetry.ingest(
+            f"replica{i}",
+            {"samples": [{"seq": 1, "t": 0.0, "signals": {}}],
+             "last_seq": 1})
+        rs.prefix_index.observe(
+            i, {"seq": 1, "events": [
+                ("register", f"k{cycle}", [1, 2, 3, 4])]})
+        rs.remove_replica(i)
+    assert rs.telemetry.sources() == []            # every source dropped
+    with rs.prefix_index._lock:
+        assert set(rs.prefix_index._seq) <= {0}
+        assert set(rs.prefix_index._last_poll) <= {0}
+        held = set().union(*rs.prefix_index._holders.values()) \
+            if rs.prefix_index._holders else set()
+    assert held == set()                           # no ghost holders
+    assert len(rs.replicas) == 1 and rs.outstanding() == [0]
+
+
+# -- the reconciler: burst out, idle in, surge semantics ----------------------
+
+
+def _controller(rs, clk, state, tmp_path=None, **kw):
+    spawned = []
+
+    def spawn():
+        e = _Eng(rs_ref=rs)
+        spawned.append(e)
+        return e
+
+    kw.setdefault("policy", _policy(clk))
+    ctrl = AutoscaleController(
+        rs, spawn_fn=spawn, merged_fn=_merged_fn(state),
+        journal_dir=str(tmp_path / "scale-journal") if tmp_path else None,
+        clock=clk, drain_timeout_s=kw.pop("drain_timeout_s", 5.0), **kw)
+    ctrl._spawned = spawned
+    return ctrl
+
+
+def test_burst_scales_out_idle_scales_in_zero_failures(tmp_path):
+    """THE acceptance drill: injected queue pressure takes 1 -> 3 with
+    surge admission (warm + probe provably before routing), idle drains
+    back to 1, every in-flight submission succeeds and greedy outputs are
+    bit-identical across every membership change, and every event left a
+    terminal journal."""
+    clk = _Clock()
+    first = _Eng()
+    rs = ReplicaSet([first])
+    state = {"queue": 100.0}
+    ctrl = _controller(rs, clk, state, tmp_path)
+    prompt, steps = [5, 6, 7], 4
+    expected = rs.submit_generate(prompt, steps).result(1.0)
+
+    sizes = []
+    for _ in range(3):                       # out, out, hold-at-max
+        ctrl.tick()
+        sizes.append(len(rs.replicas))
+        assert rs.submit_generate(prompt, steps).result(1.0) == expected
+    assert sizes == [2, 3, 3]
+    assert ctrl.last_decision["reason"].startswith("out pressed") \
+        or "max_replicas" in ctrl.last_decision["reason"]
+    for e in ctrl._spawned:                  # surge order, per candidate
+        assert e.events[:3] == ["start", "warmup", "probe"]
+    assert rs.fleet_metrics.scale_outs == 2
+
+    state["queue"] = 0.0                     # the burst ends
+    for _ in range(3):                       # in, in, hold-at-min
+        ctrl.tick()
+        assert rs.submit_generate(prompt, steps).result(1.0) == expected
+    assert len(rs.replicas) == 1
+    assert rs.fleet_metrics.scale_ins == 2
+    assert first.stopped                     # retired victims were stopped
+    assert ctrl.scale_events == 4 and ctrl.last_error is None
+
+    # gauges track the converged fleet; journal is terminal and stepped
+    g = rs.fleet_metrics.gauges_view()
+    assert g["fleet_size"] == 1.0 and g["desired_replicas"] == 1.0
+    assert rs.snapshot()["serve.scale_outs"] == 2.0
+    jdir = str(tmp_path / "scale-journal")
+    assert RolloutJournal.load(jdir) is None         # nothing left open
+    with open(os.path.join(jdir, "steps.jsonl")) as f:
+        steps_rows = [json.loads(line) for line in f]
+    assert [r["step"] for r in steps_rows] == ["drained", "removed"]
+
+
+def test_scale_out_prefers_spawn_fn_then_clone_fresh():
+    class _Cloner(_Eng):
+        def clone_fresh(self):
+            return _Eng()
+
+    clk = _Clock()
+    rs = ReplicaSet([_Cloner()])
+    ctrl = AutoscaleController(rs, policy=_policy(clk),
+                               merged_fn=_merged_fn({"queue": 100.0}),
+                               clock=clk)
+    ctrl.tick()
+    assert len(rs.replicas) == 2             # clone_fresh carried the spawn
+    rs2 = ReplicaSet([_Eng()])               # no spawn_fn, no clone_fresh
+    ctrl2 = AutoscaleController(rs2, policy=_policy(clk),
+                                merged_fn=_merged_fn({"queue": 100.0}),
+                                clock=clk)
+    ctrl2.tick()
+    assert len(rs2.replicas) == 1 and "spawn_fn" in ctrl2.last_error
+
+
+def test_disabled_and_draining_controllers_hold_still():
+    clk = _Clock()
+    rs = ReplicaSet([_Eng()])
+    ctrl = _controller(rs, clk, {"queue": 100.0}, enabled=False)
+    assert ctrl.tick() is None and len(rs.replicas) == 1
+    assert ctrl.configure(enabled=True)["enabled"] is True
+    with pytest.raises(ValueError):
+        ctrl.configure(min_replicas=0)
+    with pytest.raises(ValueError):
+        ctrl.configure(min_replicas=3, max_replicas=2)
+    ctrl.configure(max_replicas=2)
+    ctrl.tick()
+    ctrl.tick()
+    assert len(rs.replicas) == 2             # the moved bound clamps
+
+
+# -- injected faults: spawn failure, stuck drain, mid-scale crash, flap -------
+
+
+def test_spawn_fail_costs_the_fleet_nothing(monkeypatch, tmp_path):
+    monkeypatch.setenv("DDW_FAULT", "autoscale:spawn_fail")
+    clk = _Clock()
+    rs = ReplicaSet([_Eng()])
+    ctrl = _controller(rs, clk, {"queue": 100.0}, tmp_path)
+    d = ctrl.tick()                          # decision out; actuation fails
+    assert d.action == "out"
+    assert len(rs.replicas) == 1             # candidate never joined
+    assert ctrl.scale_events == 0
+    assert rs.fleet_metrics.scale_outs == 0
+    assert "spawn" in ctrl.last_error
+    assert RolloutJournal.load(str(tmp_path / "scale-journal")) is None
+    monkeypatch.delenv("DDW_FAULT")          # cleared: the next tick lands
+    ctrl.tick()
+    assert len(rs.replicas) == 2
+
+
+def test_stall_drain_aborts_scale_in_replica_keeps_serving(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv("DDW_FAULT", "autoscale:stall_drain")
+    clk = _Clock()
+    rs = ReplicaSet([_Eng(), _Eng()])
+    ctrl = _controller(rs, clk, {"queue": 0.0}, tmp_path,
+                       drain_timeout_s=0.0)  # deadline at once: the stall's
+    d = ctrl.tick()                          # should_abort fires immediately
+    assert d.action == "in"
+    assert len(rs.replicas) == 2             # the victim was NOT removed
+    assert rs.breakers[0].state == "closed"  # ...and re-admitted to routing
+    assert rs.fleet_metrics.scale_ins == 0
+    assert "drain stall" in ctrl.last_error
+    assert RolloutJournal.load(str(tmp_path / "scale-journal")) is None
+
+
+def test_crash_mid_scale_leaves_journal_for_reconcile(monkeypatch, tmp_path):
+    """Gateway killed between admission and finalize: the journal stays
+    non-terminal; a restarted controller's reconcile() finalizes it and
+    counts journal_resumes — the crash window the journal exists for."""
+    monkeypatch.setenv("DDW_FAULT", "autoscale:crash_mid_scale")
+    clk = _Clock()
+    rs = ReplicaSet([_Eng()])
+    ctrl = _controller(rs, clk, {"queue": 100.0}, tmp_path)
+    with pytest.raises(AutoscaleCrash):
+        ctrl.tick()
+    assert len(rs.replicas) == 2             # admitted before the crash
+    assert ctrl._deploy_status["deploying"] is False   # flag restored
+    jdir = str(tmp_path / "scale-journal")
+    left = RolloutJournal.load(jdir)
+    assert left is not None and left["meta"]["direction"] == "out"
+    assert [r["step"] for r in left["steps"]] == [
+        "warmed", "probed", "admitted"]
+
+    monkeypatch.delenv("DDW_FAULT")          # "restart": a fresh controller
+    ctrl2 = _controller(rs, clk, {"queue": 0.0}, tmp_path)
+    got = ctrl2.reconcile()
+    assert got is not None and got["meta"]["direction"] == "out"
+    assert RolloutJournal.load(jdir) is None             # finalized
+    assert rs.fleet_metrics.journal_resumes == 1
+    assert ctrl2.reconcile() is None         # idempotent: clean journal
+
+
+def test_flap_fault_is_damped_by_cooldowns(monkeypatch):
+    """Alternating synthetic pressure (the flap arm) against real
+    cooldowns: 20 decide ticks move the fleet at most once — the policy's
+    anti-thrash machinery, exercised end to end."""
+    monkeypatch.setenv("DDW_FAULT", "autoscale:flap")
+    clk = _Clock()
+    rs = ReplicaSet([_Eng()])
+    ctrl = _controller(
+        rs, clk, {"queue": 0.0},
+        policy=_policy(clk, out_cooldown_s=100.0, in_cooldown_s=100.0))
+    for _ in range(20):
+        ctrl.tick()
+        clk.advance(1.0)                     # 20s elapse: inside cooldown
+    assert ctrl.scale_events == 1            # the first out; nothing since
+    assert len(rs.replicas) == 2
+    assert ctrl.ticks == 20
+
+
+# -- mutual exclusion with rolling deploys ------------------------------------
+
+
+def test_autoscale_blocked_while_deploy_lock_held():
+    clk = _Clock()
+    rs = ReplicaSet([_Eng()])
+    lock = threading.Lock()
+    status = {"deploying": True, "status": "rolling"}
+    ctrl = AutoscaleController(
+        rs, policy=_policy(clk), merged_fn=_merged_fn({"queue": 100.0}),
+        deploy_lock=lock, deploy_status=status, clock=clk,
+        spawn_fn=lambda: _Eng())
+    d = ctrl.tick()
+    assert d.action == "hold" and "rollout holds the deploy lock" in d.reason
+    assert ctrl.blocked == 1
+    assert rs.fleet_metrics.autoscale_blocked == 1
+    assert len(rs.replicas) == 1
+    assert status == {"deploying": True, "status": "rolling"}   # untouched
+    status["deploying"] = False              # rollout finished
+    assert ctrl.tick().action == "out" and len(rs.replicas) == 2
+    assert status["status"] == "rolling"     # scale event restored it
+
+
+# -- the HTTP surface: /readyz, /stats, POST /admin/autoscale -----------------
+
+
+def test_gateway_autoscale_http_surface(tmp_path):
+    clk = _Clock()
+    rs = ReplicaSet([_Eng()])
+    state = {"queue": 0.0}
+    gw = Gateway(rs, supervise=False, autoscale=True,
+                 autoscale_journal_dir=str(tmp_path / "scale-journal"),
+                 autoscale_kw=dict(
+                     policy=_policy(clk), clock=clk,
+                     spawn_fn=lambda: _Eng(),
+                     merged_fn=_merged_fn(state),
+                     slo_status_fn=None,
+                     tick_interval_s=3600.0))   # ticks only when WE tick
+    gw.start(warmup_prompt_lens=())
+    try:
+        cli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+        _status, ready = cli.readyz()
+        assert ready["autoscale"]["enabled"] is True
+        assert ready["autoscale"]["actual"] == 1
+
+        state["queue"] = 100.0
+        gw.autoscaler.tick()
+        stats = cli.stats()
+        a = stats["autoscale"]
+        assert a["actual"] == 2 and a["scale_events"] == 1
+        assert a["last_decision"]["action"] == "out"
+        assert a["policy"]["max_replicas"] == 3
+        text = cli.metrics_text()
+        assert "ddw_serve_scale_outs_total 1" in text
+        assert "ddw_serve_desired_replicas" in text
+        assert "ddw_serve_fleet_size" in text
+
+        # the admin surface: bounds move, bad bounds 400, disable sticks
+        out = cli._json_call("POST", "/admin/autoscale",
+                             {"max_replicas": 2})
+        assert out["policy"]["max_replicas"] == 2
+        with pytest.raises(GatewayError) as ei:
+            cli._json_call("POST", "/admin/autoscale", {"min_replicas": 0})
+        assert ei.value.status == 400
+        with pytest.raises(GatewayError) as ei:
+            cli._json_call("POST", "/admin/autoscale",
+                           {"enabled": "sideways"})
+        assert ei.value.status == 400
+        out = cli._json_call("POST", "/admin/autoscale", {"enabled": False})
+        assert out["enabled"] is False and gw.autoscaler.tick() is None
+
+        # 409 under the deploy lock — same semantics as /admin/deploy
+        with gw._deploy_lock:
+            gw.deploy_status["deploying"] = True
+        try:
+            with pytest.raises(GatewayError) as ei:
+                cli._json_call("POST", "/admin/autoscale", {"enabled": True})
+            assert ei.value.status == 409
+            assert ei.value.body["error"] == "deploy_in_progress"
+        finally:
+            with gw._deploy_lock:
+                gw.deploy_status["deploying"] = False
+
+        # no autoscaler -> 404 (the discoverable off switch)
+        saved, gw.autoscaler = gw.autoscaler, None
+        try:
+            with pytest.raises(GatewayError) as ei:
+                cli._json_call("POST", "/admin/autoscale", {"enabled": True})
+            assert ei.value.status == 404
+        finally:
+            gw.autoscaler = saved
+    finally:
+        gw.stop()
+    assert gw.autoscaler is None             # drain stopped the reconciler

@@ -468,6 +468,10 @@ def test_kill_process_replica_supervisor_restarts_with_identity(fleet, pkgs):
     assert any(e.get("trace") == "pre-kill-drill" for e in flight["events"])
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): canary judge/controller logic
+                   # keeps its 20 tier-1 fake reps in test_rollout; the live
+                   # degraded-canary drill rides tier-2 with load_gen --canary
+                   # and Drills B/C.
 def test_dark_canary_auto_rejects_with_zero_client_impact(fleet, pkgs):
     """Drill A: a canary deploy of a checkpoint the judge measures as
     degraded (``deploy:degrade_canary`` injects real latency into the

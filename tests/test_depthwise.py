@@ -64,6 +64,10 @@ def test_stride2_and_fallbacks():
         np.asarray(_xla_depthwise(x, w, 1)), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): the mobilenet-level composition
+                   # of the dw units above — same pallas path, so on boxes
+                   # where the interpreter units fail this fails identically;
+                   # 14s of tier-1 for no extra signal.
 def test_mobilenet_dw_impl_preserves_function_and_checkpoint():
     """dw_impl='pallas' keeps the exact param tree and the model function
     (stride-2 depthwise layers fall back to XLA inside the same flag)."""

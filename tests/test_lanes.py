@@ -335,6 +335,10 @@ def cli(gwx):
     return c
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): lane math / batch-vs-direct
+                   # identity / exactly-once resume keep their in-process
+                   # tier-1 reps above; the process-fleet /v1/batch surface
+                   # rides tier-2 with the other fleet boots.
 def test_http_batch_endpoints_and_lane_stats(gwx, cli, pm):
     """/v1/batch submit → poll → NDJSON results → cancel, rows identical
     to the offline path (seeded, over the wire); lane depths + reserve
@@ -377,6 +381,10 @@ def test_http_batch_endpoints_and_lane_stats(gwx, cli, pm):
 
 
 @pytest.mark.faults
+@pytest.mark.slow  # tier-1 budget (PR 18): the exactly-once-across-death pin
+                   # keeps its tier-1 rep in test_job_resumes_across_engine_
+                   # restart_exactly_once (engine-level, same ledger math);
+                   # the HTTP chaos arm rides tier-2 with the gwx fleet boot.
 def test_chaos_batch_site_resumes_no_dup_no_loss(gwx, cli, pm,
                                                  monkeypatch):
     """DDW_FAULT=serve:crash:site=batch kills replica 0 at its 2nd

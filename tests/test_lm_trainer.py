@@ -86,6 +86,9 @@ def test_resume_already_complete_returns_checkpointed_metrics(tmp_path):
     assert res2.val_accuracy == pytest.approx(res.val_accuracy, abs=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): the PP-step numeric pin stays
+                   # tier-1 in test_pipeline[gpipe-2-1]; LM fit+resume keeps
+                   # test_fit_sharded_state_and_resume[zero] + test_resume.
 def test_fit_pipeline_gpipe_and_resume(tmp_path):
     """train.pipeline_stages=4 over 8 devices (DPxPP): the managed trainer
     runs the GPipe step, evals through the pipeline eval step, logs the

@@ -230,6 +230,10 @@ def test_registry_lora_guards():
         build_model(ModelCfg(name="vit", freeze_base=False, lora_rank=4))
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): LoRA validation/error paths keep
+                   # their tier-1 reps in test_mask_and_merge_errors +
+                   # test_registry_lora_guards (this one builds a full ViT
+                   # trainer just to hit the conflict).
 def test_vit_lora_freeze_base_conflict_raises():
     from ddw_tpu.models.mobilenet_v2 import MobileNetV2
     from ddw_tpu.train.step import init_state

@@ -141,6 +141,9 @@ def test_tp_rules_spec_resolution():
     assert patch.spec == P()
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): TP-in-training keeps tier-1 reps
+                   # in test_tp_rules_spec_resolution (rules unit) +
+                   # test_fsdp.py::test_fsdp_tp_learns_on_2x4 (composition).
 def test_tp_train_step_vit():
     """dp=4 x tp=2 GSPMD train step on ViT: runs, loss drops, params shard."""
     import optax

@@ -410,6 +410,9 @@ def test_expired_head_admission_prefills_the_popped_request(pm):
     _pool_clean(eng.pool)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): preempt-resume identity keeps its
+                   # tier-1 rep in test_kv_migration's mid-decode preemption
+                   # drill; drain-to-completion keeps the gateway drain pins.
 def test_drain_completes_preempted_streams(pm):
     """A stream preempted for blocks MID-DRAIN (block_overcommit > 1) is
     already-claimed in-flight work: drain keeps re-admitting it while

@@ -532,13 +532,14 @@ def test_metrics_bounded_records_p99_within_one_bucket_of_exact():
 
 def test_every_incremented_counter_is_exported_and_registered():
     """Every counter name incremented anywhere in serve/, obs/,
-    gateway/, or deploy/ source appears in the Prometheus exposition AND
-    in signal_registry — a new counter that skips either fails the
-    suite, not the operator staring at a dashboard with a hole in it."""
+    gateway/, deploy/, or autoscale/ source appears in the Prometheus
+    exposition AND in signal_registry — a new counter that skips either
+    fails the suite, not the operator staring at a dashboard with a hole
+    in it."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     srcs = []
     for pkg in ("ddw_tpu/serve", "ddw_tpu/obs", "ddw_tpu/gateway",
-                "ddw_tpu/deploy"):
+                "ddw_tpu/deploy", "ddw_tpu/autoscale"):
         srcs += glob.glob(os.path.join(root, pkg, "*.py"))
     assert srcs
     count_re = re.compile(r'\.count\(\s*"([a-z0-9_]+)"')
@@ -560,7 +561,8 @@ def test_every_incremented_counter_is_exported_and_registered():
             "routed_cache_hit", "warm_replays",
             "prefix_hit_tokens", "tp_dispatches",
             "canary_promoted", "canary_rejected", "surge_spawns",
-            "journal_resumes"} <= names
+            "journal_resumes", "scale_outs", "scale_ins",
+            "autoscale_blocked"} <= names
     reg = signal_registry()
     exposition = render_prometheus([EngineMetrics()])
     for name in sorted(names):

@@ -188,6 +188,10 @@ def test_decode_cache_shardings_shard_exactly_the_kv_pool(pm):
 
 # -- bit identity: tp=2 equals tp=1 ------------------------------------------
 
+@pytest.mark.slow   # tier-1 budget (PR 18): tp2-vs-package greedy identity
+                    # keeps its tier-1 rep in test_tp2_seeded_bit_identical_
+                    # to_tp1 (same engine, identity vs a tp=1 twin, and the
+                    # tp telemetry pins now ride there).
 def test_tp2_greedy_bit_identical_with_tp_telemetry(eng_tp2, pm):
     """THE acceptance pin: sharding is a pure layout change — the TP=2
     engine emits exactly the sequential package's greedy tokens, and the
@@ -230,6 +234,11 @@ def test_tp2_seeded_bit_identical_to_tp1(eng_tp2, pm):
             outs[name] = [f.result(timeout=300).tokens for f in futs]
     for i, (a, b) in enumerate(zip(outs["tp1"], outs["tp2"])):
         assert np.array_equal(a, b), i
+    snap = eng_tp2.snapshot()
+    assert snap["serve.tp_dispatches"] > 0
+    assert snap["serve.tp_dispatch_us"] > 0
+    assert snap["serve.tp_dispatch_cost_us"] > 0
+    assert snap["serve.tp_degree"] == 2.0
     _pool_clean(eng_tp2.pool)
 
 

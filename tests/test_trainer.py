@@ -30,6 +30,9 @@ def test_training_learns(small_cfgs, silver, tmp_path):
     assert res.history[-1]["loss"] < res.history[0]["loss"]
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): the warmup-ramp shape keeps its
+                   # tier-1 unit rep in test_ema_cosine::test_cosine_decay_
+                   # shape; LR plumbing keeps test_lr_plumbing_through_ema_state.
 def test_lr_warmup_schedule(small_cfgs, silver, tmp_path):
     """LR ramps to base*world over warmup_epochs (Goyal et al. scaling, reference
     03_model_training_distributed.py:314-318)."""
@@ -60,6 +63,10 @@ def test_checkpoint_resume(small_cfgs, silver, tmp_path):
     assert int(jax.device_get(res2.state.step)) == 2 * steps_after_2
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): async-writer semantics keep their
+                   # tier-1 units in test_checkpoint.py (async==sync bytes,
+                   # snapshot consistency, error surfacing); resume keeps
+                   # test_resume + the sharded/zero resume reps.
 def test_async_checkpoint_resume(small_cfgs, silver, tmp_path):
     """async_checkpoint=True: background writes are durable by fit()'s return
     (ckpt.wait barrier), and a resumed run continues from them."""

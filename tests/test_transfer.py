@@ -179,6 +179,9 @@ def test_feature_cache_convnext_stats_free(tmp_path):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (PR 18): the feature-cache path keeps
+                   # tier-1 reps in test_train_frozen_via_features_end_to_end
+                   # (reuse) + test_distributed_featurization_matches_single.
 def test_feature_cache_roundtrip_reuse_and_stale_rejection(tmp_path):
     """materialize_features: every record featurized (no drop-remainder), the
     cache is reused on identical backbone+source, and recomputed when the

@@ -41,6 +41,13 @@ over the routing path, ``serve.routed_cache_hit`` > 0) and that a
 mid-run recycle rejoins warm via the supervisor's top-K prefix replay
 (``serve.warm_replays`` > 0, bit-identical probe answers).
 
+Autoscale arm (``--autoscale``): the reconciler-loop pin — sustained
+closed-loop load against a 1-replica autoscaling fleet must reach the
+policy max with surge admission and drain back to one replica on idle,
+zero failed client requests, bit-identical greedy probes throughout, and
+the live ``/stats`` scale events agreeing with the offline recount over
+the polled transitions.
+
 Chaos arm (``--chaos``, or ``DDW_BENCH_CHAOS=1`` with the smoke): the
 robustness pin rather than the capacity pin — closed-loop clients drive a
 supervised 2-replica fleet while ``DDW_FAULT=serve:crash`` kills replica 0
@@ -1121,6 +1128,185 @@ def slo_arm(prompt_len=12, steps=12, requests=24, n_slots=4, clients=4,
     return out
 
 
+def autoscale_arm(prompt_len=12, steps=8, n_slots=2, steps_per_tick=4,
+                  hidden=32, depth=1, clients=10, max_replicas=3,
+                  load_deadline_s=150.0, settle_deadline_s=60.0):
+    """Traffic-driven autoscaling over the real HTTP path — the
+    reconciler-loop pin (docs/serving.md "Autoscaling").
+
+    Self-hosts a 1-replica telemetry fleet behind a gateway with the
+    autoscaler ON (queue-depth policy, aggressive cooldowns), then runs
+    sustained closed-loop load: the reconciler must scale the fleet to the
+    policy max with surge admission while the burst lasts, and drain it
+    back to one replica once the load stops — with not ONE failed client
+    request and a pinned greedy probe bit-identical before, during, and
+    after every membership change.
+
+    The cross-check is live-vs-offline, same discipline as the SLO arm: a
+    poller records every ``/stats`` autoscale transition as it happens,
+    and the offline recount over those samples (max fleet size reached,
+    distinct scale events observed) must agree with the gateway's own
+    counters (``serve.scale_outs`` / ``serve.scale_ins`` /
+    ``scale_events``) — the live plane and the recount must tell the same
+    story or one of them is lying.
+
+    CPU framing (same honesty as the fleet-scaling smoke): replicas
+    sharing one core add no throughput, so the pin here is STRUCTURAL —
+    the loop converges to the policy's desired count, admission stays
+    surge-safe, and retirement drains first. On a real fleet (replica per
+    chip/host, ``host=`` spawn transport) the same loop adds capacity."""
+    import tempfile
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.autoscale import ScalePolicy
+    from ddw_tpu.gateway import (Gateway, GatewayClient, GatewayError,
+                                 ReplicaSet)
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "autoscalearm", hidden, depth, 2, 128, 96,
+                          dtype="float32")
+        cfg = EngineCfg(n_slots=n_slots, steps_per_tick=steps_per_tick,
+                        telemetry=True, telemetry_interval_s=0.05,
+                        queue_depth=256, default_timeout_s=600.0)
+
+        def spawn():
+            return ServingEngine(lm=pm, cfg=cfg)
+
+        policy = ScalePolicy(
+            min_replicas=1, max_replicas=max_replicas,
+            queue_out=0.4, queue_in=0.1,         # any sustained queueing
+            occupancy_out_pct=None, occupancy_in_pct=None,
+            ttft_out_ms=None, ttft_in_ms=None,
+            out_cooldown_s=0.2, in_cooldown_s=0.5)
+        gw = Gateway(ReplicaSet([spawn()]), grace_s=60.0,
+                     supervise=False, telemetry=True,
+                     telemetry_interval_s=0.05, autoscale=True,
+                     autoscale_journal_dir=os.path.join(tmp, "scale-j"),
+                     autoscale_kw=dict(policy=policy, spawn_fn=spawn,
+                                       tick_interval_s=0.15,
+                                       warmup_prompt_lens=(prompt_len,),
+                                       drain_timeout_s=30.0))
+        gw.start(warmup_prompt_lens=(prompt_len,))
+        rng = np.random.RandomState(5)
+        probe = rng.randint(0, 128, size=(prompt_len,)).astype(np.int32)
+        stop, poll_stop = threading.Event(), threading.Event()
+        lock = threading.Lock()
+        done, failures, transitions = [0], [], []
+        t0 = time.perf_counter()
+
+        def worker():
+            cli = _client(gw.url, retries=8)
+            while not stop.is_set():
+                p = rng.randint(0, 128, size=(prompt_len,)).astype(np.int32)
+                try:
+                    cli.generate(p, steps)
+                    with lock:
+                        done[0] += 1
+                except (GatewayError, OSError) as e:
+                    with lock:
+                        failures.append(repr(e))
+
+        def poller():                     # the LIVE record of scale events
+            pcli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+            last = None
+            while not poll_stop.is_set():
+                try:
+                    a = pcli.stats()["autoscale"]
+                    key = (a["actual"], a["scale_events"])
+                    if key != last:
+                        last = key
+                        with lock:
+                            transitions.append(
+                                {"t": round(time.perf_counter() - t0, 2),
+                                 "actual": a["actual"],
+                                 "desired": a["desired"],
+                                 "scale_events": a["scale_events"]})
+                except Exception:
+                    pass
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=worker) for _ in range(clients)]
+        pth = threading.Thread(target=poller)
+        try:
+            cli = GatewayClient("127.0.0.1", gw.port, max_retries=2)
+            ref = cli.generate(probe, steps)["tokens"]
+            pth.start()
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + load_deadline_s
+            while (time.monotonic() < deadline
+                   and len(gw.replica_set.replicas) < max_replicas):
+                time.sleep(0.1)
+            peak = len(gw.replica_set.replicas)
+            mid = cli.generate(probe, steps)["tokens"]   # scaled-out fleet
+            stop.set()                    # the burst ends; idle drains in
+            for t in threads:
+                t.join()
+            deadline = time.monotonic() + settle_deadline_s
+            while (time.monotonic() < deadline
+                   and len(gw.replica_set.replicas) > 1):
+                time.sleep(0.1)
+            time.sleep(0.3)               # let the poller see the last event
+            after = cli.generate(probe, steps)["tokens"]
+            stats = cli.stats()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+            poll_stop.set()
+            pth.join()
+            gw.stop()
+        a = stats["autoscale"]
+        # the offline recount over the polled transitions (the poller sees
+        # membership changes the main thread's sampling can race past)
+        seen_max = max((tr["actual"] for tr in transitions), default=1)
+        seen_events = max((tr["scale_events"] for tr in transitions),
+                          default=0)
+        peak = max(peak, seen_max)
+        out = {
+            "completed": done[0], "failed": len(failures),
+            "failures": failures[:4],
+            "peak_replicas": peak, "final_replicas": a["actual"],
+            "live": {"scale_events": a["scale_events"],
+                     "scale_outs": int(stats.get("serve.scale_outs", 0)),
+                     "scale_ins": int(stats.get("serve.scale_ins", 0)),
+                     "blocked": a["blocked"],
+                     "last_decision": a["last_decision"]},
+            "recount": {"seen_max_replicas": seen_max,
+                        "seen_scale_events": seen_events,
+                        "transitions": transitions},
+            "identity_preserved": (list(ref) == list(mid)
+                                   and list(ref) == list(after)),
+        }
+        print(f"[load_gen] autoscale: 1 -> {peak} -> "
+              f"{out['final_replicas']} replicas, "
+              f"{out['live']['scale_outs']} outs / "
+              f"{out['live']['scale_ins']} ins, {done[0]} completed, "
+              f"{len(failures)} failed, identity "
+              f"{out['identity_preserved']}", file=sys.stderr, flush=True)
+        if SMOKE:
+            # the burst scaled the fleet to the policy max, idle shrank it
+            assert out["peak_replicas"] == max_replicas, out
+            assert out["final_replicas"] == 1, out
+            # zero client-visible failures through every membership change
+            assert out["failed"] == 0, out
+            assert done[0] > 0, out
+            # live counters vs the offline recount: same story
+            assert (out["live"]["scale_outs"]
+                    == out["live"]["scale_ins"]), out        # 1 -> ... -> 1
+            assert (out["live"]["scale_events"]
+                    == out["live"]["scale_outs"]
+                    + out["live"]["scale_ins"]), out
+            assert out["recount"]["seen_max_replicas"] == max_replicas, out
+            assert (out["recount"]["seen_scale_events"]
+                    == out["live"]["scale_events"]), out
+            # scaling changed placement, never content
+            assert out["identity_preserved"], out
+        return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default=None, help="target a live gateway")
@@ -1172,6 +1358,12 @@ def main():
     ap.add_argument("--trace-out", default="fleet_trace.json",
                     help="where the --trace arm writes the merged "
                          "Perfetto JSON")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="self-hosted autoscaler arm: sustained load must "
+                         "scale a 1-replica fleet to the policy max and "
+                         "idle must drain it back, zero failed requests, "
+                         "live /stats scale events matching the offline "
+                         "recount")
     ap.add_argument("--slo", action="store_true",
                     help="self-hosted SLO cross-check arm: 2-replica "
                          "telemetry fleet; asserts the gateway's live "
@@ -1221,6 +1413,9 @@ def main():
     elif args.trace:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "trace": trace_arm(out_path=args.trace_out)}
+    elif args.autoscale:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "autoscale": autoscale_arm()}
     elif args.slo:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "slo": slo_arm()}

@@ -37,6 +37,16 @@ docs/observability.md).
 Usage (chip): ``DDW_REQUIRE_TPU=1 python tools/serving_curve.py``
 CI smoke:     ``DDW_BENCH_SMOKE=1`` shrinks shapes/batches/steps.
 
+CPU framing for the fleet-shaped arms (and tools/load_gen.py's fleet
+smoke and ``--autoscale`` arm): every replica here shares ONE core, so
+adding replicas cannot add service rate — the honest CPU pins are
+STRUCTURAL (queue-wait halving on a burst at 2x slot capacity, the
+autoscaler converging actual to desired with surge admission and
+drain-first retirement, bit-identical outputs across membership changes),
+never raw throughput. On a real fleet — replica per chip/host, spawned
+over the ``host=`` transport (docs/serving.md "Autoscaling") — the same
+loops add genuine capacity, and these curves are re-measured there.
+
 Prints ONE JSON line: ``{"device": ..., "image_curve": [rows], "lm": {...},
 "engine": {...}}`` — each image row is {batch, median_ms, p90_ms,
 images_per_sec}; the LM block carries per-token ms for plain and speculative
