@@ -84,6 +84,13 @@ def _error_to_exc(err: dict) -> Rejected:
         return Overloaded(err.get("kind", "interactive"),
                           err.get("capacity", 0), err.get("depth", 0),
                           err.get("retry_after_ms"))
+    if kind == "quota_exceeded":
+        from ddw_tpu.serve.tenancy import QuotaExceeded
+        return QuotaExceeded(err.get("tenant", "default"),
+                             err.get("resource", "tokens"),
+                             err.get("used", 0), err.get("quota", 0),
+                             err.get("requested", 0),
+                             err.get("retry_after_ms", 0.0))
     if kind == "deadline_exceeded":
         return DeadlineExceeded(err.get("kind", "interactive"),
                                 err.get("waited_ms", 0.0),
@@ -616,6 +623,51 @@ class ProcessReplica:
         return cli._json_call("POST", "/v1/kv/import",
                               {"replica": 0, "wire": wire})
 
+    # -- adapter staging relay ------------------------------------------------
+    def load_adapter(self, adapter_id: str, adapter=None, *,
+                     path: str | None = None, alpha: float = 16.0,
+                     rank: int | None = None,
+                     digest: str | None = None) -> dict:
+        """Relay of :meth:`~ddw_tpu.serve.ServingEngine.load_adapter`
+        (``POST /admin/adapters`` on the child's own gateway). Adapters
+        cross the process boundary as FILES only — the same shared-disk
+        contract checkpoints use — so ``adapter`` arrays are refused
+        here. Raises on any child-side failure (the parent gateway's
+        staged load rolls back on it)."""
+        if adapter is not None:
+            raise ValueError("a process replica stages adapters by path "
+                             "only (save_adapter to shared disk first)")
+        if not path:
+            raise ValueError("load_adapter on a process replica needs "
+                             "path=")
+        cli = self._ensure_client()
+        out = cli.adapters(op="load", adapter_id=adapter_id, path=path,
+                           alpha=alpha, rank=rank, digest=digest)
+        if out.get("status") != "loaded":
+            raise RuntimeError(f"child adapter load failed: {out}")
+        return {"adapter_id": adapter_id, "slot": None,
+                "digest": out.get("digest")}
+
+    def unload_adapter(self, adapter_id: str) -> dict:
+        cli = self._ensure_client()
+        out = cli.adapters(op="unload", adapter_id=adapter_id)
+        if out.get("status") != "unloaded":
+            raise RuntimeError(f"child adapter unload failed: {out}")
+        return out
+
+    def adapter_view(self) -> dict:
+        """The child engine's adapter-pool view (empty when the child has
+        no pool or is unreachable) — feeds the parent's fleet view."""
+        cli = self._client
+        if cli is None or not self._ready or self.failure is not None:
+            return {}
+        try:
+            view = cli.adapters(op="list")
+        except Exception:
+            return {}
+        reps = view.get("replicas") or {}
+        return reps.get("0", {})
+
     # -- trace relay (the fleet's merged Perfetto view) -----------------------
     def trace_events(self, since: int = 0) -> dict:
         """The child engine's trace ring, relayed in one HTTP fetch
@@ -752,6 +804,15 @@ class ProcessReplica:
                     exc.generation = self.generation
                 return exc
             return Unavailable(body.get("state", "child_unavailable"))
+        if isinstance(e, GatewayError) \
+                and isinstance(getattr(e, "body", None), dict) \
+                and e.body.get("error") == "unknown_adapter":
+            # the child refused the adapter id — a client error, not a
+            # replica death: surface the same exception the in-thread
+            # engine raises so the gateway's 400 mapping fires
+            from ddw_tpu.serve.adapters import UnknownAdapter
+            return UnknownAdapter(e.body.get("adapter_id", "?"),
+                                  tuple(e.body.get("loaded", ())))
         if isinstance(e, (OSError, GatewayError)):
             return ReplicaFailed(
                 "transport", replica=self.replica_id,
@@ -765,7 +826,9 @@ class ProcessReplica:
                         temperature: float = 0.0, rng=None,
                         timeout_s: float = 0.0, on_token=None,
                         trace_id: str | None = None,
-                        parent_span: str | None = None
+                        parent_span: str | None = None,
+                        tenant: str | None = None,
+                        adapter_id: str | None = None
                         ) -> concurrent.futures.Future:
         self._admission_gate("interactive")
         cli = self._ensure_client()
@@ -782,7 +845,9 @@ class ProcessReplica:
                                    stream=on_token is not None,
                                    on_token=on_token,
                                    trace_id=trace_id,
-                                   parent_span=parent_span)
+                                   parent_span=parent_span,
+                                   tenant=tenant,
+                                   adapter_id=adapter_id)
             except Exception as e:
                 raise self._map_exc(e) from e
             self._note_service(res.get("total_ms",

@@ -194,7 +194,9 @@ class GatewayClient:
                  seed: int | None = None, timeout_s: float | None = None,
                  stream: bool = False, on_token=None,
                  key_data=None, trace_id: str | None = None,
-                 parent_span: str | None = None) -> dict:
+                 parent_span: str | None = None,
+                 tenant: str | None = None,
+                 adapter_id: str | None = None) -> dict:
         """One LM continuation. Returns the final reply dict (``tokens``
         plus the SLO numbers). ``stream=True`` reads the chunked NDJSON
         reply line by line, invoking ``on_token(index, token)`` as each
@@ -205,9 +207,17 @@ class GatewayClient:
         replica relaying an in-thread submission) gets bit-identical
         sampling across the HTTP hop. ``trace_id`` rides the
         ``x-ddw-trace-id`` header — the server honors it (or mints one
-        when tracing) and echoes it back in the reply."""
+        when tracing) and echoes it back in the reply. ``tenant`` names
+        the quota/fair-share account this request bills to;
+        ``adapter_id`` selects a hot-loaded LoRA adapter (absent = base
+        model). A quota refusal comes back as the same 429 backoff shape
+        as engine overload — the body names the tenant and resource."""
         body = {"prompt": [int(t) for t in prompt], "num_steps": num_steps,
                 "temperature": temperature}
+        if tenant is not None:
+            body["tenant"] = tenant
+        if adapter_id is not None:
+            body["adapter_id"] = adapter_id
         if seed is not None:
             body["seed"] = seed
         if key_data is not None:
@@ -362,6 +372,29 @@ class GatewayClient:
         if judge_window_s is not None:
             body["judge_window_s"] = judge_window_s
         return self._json_call("POST", "/admin/deploy", body)
+
+    def adapters(self, op: str = "list", adapter_id: str | None = None,
+                 path: str | None = None, alpha: float | None = None,
+                 rank: int | None = None,
+                 digest: str | None = None) -> dict:
+        """Operate the fleet's LoRA adapter pool (``POST /admin/adapters``).
+        ``op="load"`` stages the adapter at ``path`` onto every replica
+        (shadow-probed, rolled back on any failure), ``op="unload"`` drops
+        it fleet-wide, ``op="list"`` returns the per-replica residency
+        view. 409 (a deploy holds the lock) surfaces as
+        :class:`GatewayError` with the live deploy view in the body."""
+        body: dict = {"op": op}
+        if adapter_id is not None:
+            body["adapter_id"] = adapter_id
+        if path is not None:
+            body["path"] = path
+        if alpha is not None:
+            body["alpha"] = alpha
+        if rank is not None:
+            body["rank"] = rank
+        if digest is not None:
+            body["digest"] = digest
+        return self._json_call("POST", "/admin/adapters", body)
 
     def readyz(self) -> tuple[int, dict]:
         status, _h, resp, conn = self._request("GET", "/readyz",

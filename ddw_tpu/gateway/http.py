@@ -73,6 +73,7 @@ from __future__ import annotations
 import copy
 import json
 import math
+import os
 import queue
 import threading
 import time
@@ -88,7 +89,9 @@ from ddw_tpu.obs.telemetry import FleetTelemetry, TelemetryHub
 from ddw_tpu.obs.trace import Tracer, gen_id
 from ddw_tpu.serve.admission import (DeadlineExceeded, Overloaded, Rejected,
                                      ReplicaFailed, Unavailable)
+from ddw_tpu.serve.adapters import UnknownAdapter
 from ddw_tpu.serve.lanes import JobLedger
+from ddw_tpu.serve.tenancy import QuotaExceeded
 
 __all__ = ["Gateway"]
 
@@ -156,7 +159,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_rejected(self, e: Rejected) -> None:
         body = e.to_dict()
-        if isinstance(e, Overloaded):
+        if isinstance(e, QuotaExceeded):
+            # per-tenant refusal: same 429 backoff contract as engine
+            # overload, but the body names the tenant and the exhausted
+            # resource so the caller (and the drill's offline recount)
+            # can attribute the shed
+            ms = body.get("retry_after_ms")
+            secs = max(1, math.ceil(ms / 1e3)) if ms else 1
+            self._send_json(429, body, {"Retry-After": str(secs)})
+        elif isinstance(e, Overloaded):
             ms = body.get("retry_after_ms")
             # delay-seconds is an integer per RFC 9110; the exact ms hint
             # rides in the body for clients that can honor it precisely
@@ -270,6 +281,12 @@ class _Handler(BaseHTTPRequestHandler):
                 except Exception:
                     pass     # plain engine sets without an index still
                 #              answer /stats
+                try:
+                    adp = gw.adapters_view()
+                    if adp["registry"] or adp["replicas"] or adp["ops"]:
+                        out["adapters"] = adp
+                except Exception:
+                    pass     # fakes without adapter pools still answer
                 if gw.supervisor is not None:
                     out["supervisor"] = gw.supervisor.report()
                 a = gw.autoscale_view()
@@ -306,6 +323,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/admin/autoscale":
             self._admin_autoscale(gw)
+            return
+        if self.path == "/admin/adapters":
+            self._admin_adapters(gw)
             return
         if self.path in ("/v1/kv/export", "/v1/kv/import"):
             # migration plane, not client data plane: ungated by the
@@ -362,6 +382,10 @@ class _Handler(BaseHTTPRequestHandler):
             kw = {"temperature": float(body.get("temperature", 0.0)),
                   "timeout_s": None if timeout_s is None
                   else float(timeout_s)}
+            if body.get("tenant") is not None:
+                kw["tenant"] = str(body["tenant"])
+            if body.get("adapter_id") is not None:
+                kw["adapter_id"] = str(body["adapter_id"])
             if trace_id is not None:
                 kw["trace_id"] = trace_id
                 if hspan is not None:
@@ -404,9 +428,18 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             fut = gw.replica_set.submit_generate(prompt, num_steps, **kw)
-        except Rejected as e:       # Overloaded / Unavailable / ReplicaFailed
+        except Rejected as e:       # Overloaded / Unavailable / Quota / dead
             self._send_rejected(e)
             _finish_http(0)
+            return
+        except UnknownAdapter as e:
+            # structured 400: names the missing adapter and what IS
+            # resident, so a client can distinguish a typo from a
+            # not-yet-staged adapter
+            self._send_json(400, {"error": "unknown_adapter",
+                                  "adapter_id": e.adapter_id,
+                                  "loaded": sorted(e.loaded)})
+            _finish_http(400)
             return
         except ValueError as e:
             self._send_json(400, {"error": "invalid_request",
@@ -419,6 +452,13 @@ class _Handler(BaseHTTPRequestHandler):
             except Rejected as e:
                 self._send_rejected(e)
                 _finish_http(0)
+                return
+            except UnknownAdapter as e:
+                # a process replica's refusal arrives via the future
+                self._send_json(400, {"error": "unknown_adapter",
+                                      "adapter_id": e.adapter_id,
+                                      "loaded": sorted(e.loaded)})
+                _finish_http(400)
                 return
             except Exception as e:
                 self._send_json(500, {"error": "internal",
@@ -855,6 +895,70 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, gw.deploy_view())
 
+    def _admin_adapters(self, gw: "Gateway") -> None:
+        """Operate the fleet's LoRA adapter pool: ``op="load"`` stages the
+        adapter file at ``path`` onto EVERY replica (each load shadow-
+        probed with one off-path generate; any failure rolls the whole
+        stage back), ``op="unload"`` drops it fleet-wide, ``op="list"``
+        returns residency. Same 409-under-lock discipline as
+        ``/admin/deploy`` — adapter churn and weight rollouts never
+        interleave — and every op lands in the adapter journal."""
+        body = self._read_body()
+        if body is None:
+            return
+        op = body.get("op", "list")
+        if op not in ("load", "unload", "list"):
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": "op must be one of "
+                                             "load|unload|list"})
+            return
+        if op == "list":
+            self._send_json(200, gw.adapters_view())
+            return
+        adapter_id = body.get("adapter_id")
+        if not adapter_id or not isinstance(adapter_id, str):
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": "adapter_id (str) is "
+                                             "required"})
+            return
+        kw = {}
+        if op == "load":
+            path = body.get("path")
+            if not path or not isinstance(path, str):
+                self._send_json(400, {"error": "invalid_request",
+                                      "message": "path (str) is required "
+                                                 "for op=load"})
+                return
+            kw["path"] = path
+            if body.get("alpha") is not None:
+                kw["alpha"] = float(body["alpha"])
+            if body.get("rank") is not None:
+                kw["rank"] = int(body["rank"])
+            if body.get("digest") is not None:
+                kw["digest"] = str(body["digest"])
+        with gw._deploy_lock:
+            busy = bool(gw.deploy_status.get("deploying"))
+        if busy:
+            self._send_json(409, {"error": "deploy_in_progress",
+                                  **gw.deploy_view()})
+            return
+        try:
+            out = gw.admin_adapters(op, adapter_id, **kw)
+        except ValueError as e:
+            self._send_json(400, {"error": "invalid_request",
+                                  "message": str(e)})
+            return
+        except Exception as e:
+            self._send_json(500, {"error": "internal", "message": repr(e)})
+            return
+        status = out.get("status")
+        if status in ("loaded", "unloaded"):
+            self._send_json(200, out)
+        elif status == "pinned":
+            self._send_json(409, {"error": "adapter_busy", **out})
+        else:                       # rolled_back / partial
+            self._send_json(500, {"error": "stage_failed", **out})
+
     def _admin_autoscale(self, gw: "Gateway") -> None:
         """Operate the autoscaler: enable/disable the loop and move the
         policy's min/max bounds. Same 409-under-lock semantics as
@@ -1034,6 +1138,11 @@ class Gateway:
         self._deploy_journal_dir = deploy_journal_dir
         self.deploy_status: dict = {"deploying": False, "status": "idle",
                                     "fleet_generation": 0, "steps": []}
+        # adapter-op journal (the /admin/adapters side of the deploy
+        # discipline): every staged load/unload lands here with its
+        # per-replica step record; with ``deploy_journal_dir`` each entry
+        # is also appended to adapters.jsonl for post-crash forensics
+        self._adapter_ops: list[dict] = []
         # traffic-driven autoscaling (docs/serving.md): a reconciler loop
         # over the telemetry plane's windows, sharing the deploy lock so a
         # rollout and a scale event can never interleave. Constructed in
@@ -1192,6 +1301,140 @@ class Gateway:
             self._deploy_thread = threading.Thread(
                 target=ctrl.run, name="ddw-deploy", daemon=True)
             self._deploy_thread.start()
+
+    # -- adapter staging ------------------------------------------------------
+    def adapters_view(self) -> dict:
+        """The /stats adapters block: the gateway's digest registry (the
+        routing salt source), each replica's residency view, and the op
+        journal tail."""
+        per: dict[str, dict] = {}
+        for i, eng in enumerate(list(self.replica_set.replicas)):
+            fn = getattr(eng, "adapter_view", None)
+            if fn is None:
+                continue
+            try:
+                v = fn()
+                if v:               # {} = this replica has no adapter pool
+                    per[str(i)] = v
+            except Exception:
+                per[str(i)] = {"error": "unreachable"}
+        with self._deploy_lock:
+            ops = copy.deepcopy(self._adapter_ops[-16:])
+        return {"registry": dict(self.replica_set.adapter_digests),
+                "replicas": per, "ops": ops}
+
+    def _journal_adapter_op(self, entry: dict) -> None:
+        with self._deploy_lock:
+            self._adapter_ops.append(entry)
+            del self._adapter_ops[:-64]
+        if not self._deploy_journal_dir:
+            return
+        try:
+            os.makedirs(self._deploy_journal_dir, exist_ok=True)
+            with open(os.path.join(self._deploy_journal_dir,
+                                   "adapters.jsonl"), "a") as f:
+                f.write(json.dumps(entry) + "\n")
+                f.flush()
+        except OSError:
+            pass                    # forensics, not correctness
+
+    def admin_adapters(self, op: str, adapter_id: str,
+                       path: str | None = None, alpha: float = 16.0,
+                       rank: int | None = None,
+                       digest: str | None = None) -> dict:
+        """Stage (``op="load"``) or drop (``op="unload"``) a LoRA adapter
+        across the fleet — the ``POST /admin/adapters`` implementation.
+
+        Loads are STAGED like weight rollouts: replica by replica, each
+        load followed by a shadow probe (one real 1-step generate under
+        the adapter, off the routed path); the first failure unloads the
+        adapter from every replica that took it and the entry records
+        ``rolled_back`` — the fleet never ends half-resident. The adapter
+        rides a FILE (``save_adapter``'s npz), the same shared-disk
+        contract checkpoints use, so process replicas stage it the same
+        way in-thread ones do. On success the adapter's digest lands in
+        the ReplicaSet registry, which is what turns on adapter-salted
+        prefix routing for it."""
+        entry: dict = {"op": op, "adapter_id": adapter_id,
+                       "t": time.time(), "steps": []}
+        replicas = list(self.replica_set.replicas)
+        if op == "load":
+            entry["path"] = path
+            staged: list[int] = []
+            out_digest = None
+            for i, eng in enumerate(replicas):
+                step: dict = {"replica": i}
+                entry["steps"].append(step)
+                fn = getattr(eng, "load_adapter", None)
+                if fn is None:
+                    step.update(status="unsupported")
+                else:
+                    try:
+                        info = fn(adapter_id, path=path, alpha=alpha,
+                                  rank=rank, digest=digest)
+                        step.update(status="loaded",
+                                    slot=info.get("slot"),
+                                    digest=info.get("digest"))
+                        staged.append(i)
+                        out_digest = info.get("digest") or out_digest
+                        self._probe_adapter(eng, adapter_id)
+                        step["probe"] = "ok"
+                    except Exception as e:
+                        step.update(status="failed", error=repr(e))
+                if step.get("probe") != "ok":
+                    # roll the stage back: every replica that took the
+                    # adapter drops it, so routing state stays uniform
+                    for j in staged:
+                        try:
+                            replicas[j].unload_adapter(adapter_id)
+                        except Exception:
+                            pass
+                    entry["status"] = "rolled_back"
+                    self._journal_adapter_op(entry)
+                    return entry
+            entry["status"] = "loaded"
+            entry["digest"] = out_digest
+            if out_digest:
+                self.replica_set.adapter_digests[adapter_id] = out_digest
+            self._journal_adapter_op(entry)
+            return entry
+        if op != "unload":
+            raise ValueError(f"unknown adapter op {op!r}")
+        pinned = failed = False
+        for i, eng in enumerate(replicas):
+            step = {"replica": i}
+            entry["steps"].append(step)
+            fn = getattr(eng, "unload_adapter", None)
+            if fn is None:
+                step.update(status="unsupported")
+                continue
+            try:
+                fn(adapter_id)
+                step.update(status="unloaded")
+            except Exception as e:
+                msg = repr(e)
+                step.update(status=("pinned" if "pinned" in msg
+                                    else "failed"), error=msg)
+                pinned = pinned or step["status"] == "pinned"
+                failed = True
+        entry["status"] = ("pinned" if pinned
+                           else "partial" if failed else "unloaded")
+        if not failed:
+            self.replica_set.adapter_digests.pop(adapter_id, None)
+        self._journal_adapter_op(entry)
+        return entry
+
+    @staticmethod
+    def _probe_adapter(eng, adapter_id: str, timeout_s: float = 30.0):
+        """Shadow probe for a staged load: one real 1-step generate under
+        the adapter, off the routed path (mirrors ProcessReplica.probe).
+        Raises on any failure — the caller rolls the stage back."""
+        fut = eng.submit_generate(np.asarray([1, 2, 3, 4], np.int32), 1,
+                                  temperature=0.0, adapter_id=adapter_id)
+        res = fut.result(timeout=timeout_s)
+        if not len(res.tokens):
+            raise RuntimeError(f"adapter probe for {adapter_id!r} "
+                               f"returned no tokens")
 
     def autoscale_view(self) -> dict | None:
         """The /stats autoscale block (None when autoscaling is off):

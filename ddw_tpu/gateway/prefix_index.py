@@ -46,13 +46,17 @@ import numpy as np
 __all__ = ["PrefixIndex", "chain_hash_hexes"]
 
 
-def chain_hash_hexes(tokens, block_size: int) -> list[str]:
+def chain_hash_hexes(tokens, block_size: int, salt: bytes = b"") -> list[str]:
     """Per-full-block chain hashes of ``tokens``, hex-encoded — the exact
     keys :meth:`BlockPool._chain_hashes` computes (SHA1 over the previous
     digest + the block's int32 token bytes), so index lookups and pool
-    registrations can never disagree about what a prefix is."""
+    registrations can never disagree about what a prefix is. ``salt``
+    seeds the chain exactly as the pool's adapter salting does (the
+    adapter digest bytes): salted and unsalted chains over the same
+    tokens share no keys, so adapter-tagged lookups can only ever match
+    blocks prefilled under the SAME adapter."""
     arr = np.asarray(tokens, np.int32).reshape(-1)
-    out, h = [], b""
+    out, h = [], bytes(salt)
     for j in range(len(arr) // block_size):
         h = hashlib.sha1(
             h + arr[j * block_size:(j + 1) * block_size].tobytes()).digest()
@@ -163,7 +167,7 @@ class PrefixIndex:
 
     # -- consumers ------------------------------------------------------------
     def match(self, prompt, count_hit: bool = True,
-              with_hashes: bool = False):
+              with_hashes: bool = False, salt: bytes = b""):
         """Longest cached prefix (tokens) of ``prompt`` per replica slot —
         empty until the feed has taught the index its block size. Matches
         are capped at ``len(prompt) - 1`` (the pool always prefills at
@@ -184,7 +188,7 @@ class PrefixIndex:
         p = int(np.asarray(prompt).reshape(-1).shape[0])
         if not bs or not have or p < 2:
             return ({}, []) if with_hashes else {}
-        hexes = chain_hash_hexes(prompt, bs)
+        hexes = chain_hash_hexes(prompt, bs, salt)
         out: dict[int, int] = {}
         with self._lock:
             best = None

@@ -259,6 +259,13 @@ class ReplicaSet:
         #                             while a canary deploy holds one
         #                             replica at a weighted share
         self._canary_count = 0      # deterministic diversion counter
+        self.adapter_digests: dict[str, str] = {}   # adapter_id -> sha256
+        #                             hex, fed by the gateway's
+        #                             /admin/adapters staged load — the
+        #                             salt source for adapter-aware prefix
+        #                             routing (an unknown adapter routes
+        #                             by load alone; its salted chains
+        #                             can't match base keys anyway)
         for i, eng in enumerate(self.replicas):
             self._wire(i, eng)
 
@@ -532,11 +539,22 @@ class ReplicaSet:
         t_route = time.monotonic() if tracer is not None else 0.0
         matched = None
         hexes: list = []
+        adapter_id = kwargs.get("adapter_id")
+        salt = b""
+        if adapter_id is not None:
+            dg = self.adapter_digests.get(adapter_id)
+            if dg:
+                salt = bytes.fromhex(dg)
+            else:
+                # digest unknown at the routing layer: the request's
+                # salted chains can't match any base key, so a base match
+                # would route it to warmth it cannot use — skip matching
+                prompt = None
         if prompt is not None and self.route_by_prefix:
             try:        # index staleness/unavailability must never block
                 self.prefix_index.poll(self.replicas)
                 matched, hexes = self.prefix_index.match(
-                    prompt, with_hashes=True)
+                    prompt, with_hashes=True, salt=salt)
                 matched = matched or None
             except Exception:
                 matched, hexes = None, []
@@ -546,7 +564,10 @@ class ReplicaSet:
             # emitted token — decode-bearing requests must not land there
             # while a decode-capable sibling exists
             exclude = self._prefill_only()
-        if method == "submit_generate" and exclude:
+        if method == "submit_generate" and exclude and adapter_id is None:
+            # adapter-tagged requests never take the prefill→decode
+            # handoff: adapter residency (slot + salt) is replica-local
+            # and salted blocks are excluded from KV export by design
             fut = self._try_handoff(args, kwargs, matched, hexes)
             if fut is not None:
                 return fut

@@ -71,8 +71,9 @@ class CausalSelfAttention(nn.Module):
     lora_targets: tuple[str, ...] = ("query", "value")
 
     @nn.compact
-    def __call__(self, x, positions=None, block_tables=None, start_pos=None):
-        from ddw_tpu.models.lora import maybe_lora_dense
+    def __call__(self, x, positions=None, block_tables=None, start_pos=None,
+                 adapters=None):
+        from ddw_tpu.models.lora import maybe_lora_dense, row_lora_delta
 
         b, s, d = x.shape
         head_dim = d // self.num_heads
@@ -86,9 +87,19 @@ class CausalSelfAttention(nn.Module):
             return maybe_lora_dense((heads, head_dim), name,
                                     rank=self.lora_rank, alpha=self.lora_alpha,
                                     targets=self.lora_targets, dtype=self.dtype)
-        q = dense("query")(x)             # [B, S, H, hd]
-        k = dense("key", kv_heads)(x)     # [B, S, KV, hd]
-        v = dense("value", kv_heads)(x)
+
+        def with_delta(name, y, x_in, cn=1):
+            # hot-swapped per-row adapter delta (serving path); the delta is
+            # added where LoRADenseGeneral would add a trained one — before
+            # RoPE and before the cache write
+            ab = (adapters or {}).get(name)
+            if ab is None:
+                return y
+            return y + row_lora_delta(x_in, ab[0], ab[1], cn).astype(y.dtype)
+
+        q = with_delta("query", dense("query")(x), x)         # [B, S, H, hd]
+        k = with_delta("key", dense("key", kv_heads)(x), x)   # [B, S, KV, hd]
+        v = with_delta("value", dense("value", kv_heads)(x), x)
         if positions is not None:
             # RoPE: rotate q/k by ABSOLUTE position before any cache write or
             # ring hop — scores then depend only on relative distance, so the
@@ -268,10 +279,13 @@ class CausalSelfAttention(nn.Module):
                 # Pallas flash kernel for genuinely long context.
                 out = flash_mha(qh, kh, vh, causal=True)
             out = out.transpose(0, 2, 1, 3)  # [B, S, H, hd]
-        return maybe_lora_dense(d, "out", rank=self.lora_rank,
-                                alpha=self.lora_alpha,
-                                targets=self.lora_targets, dtype=self.dtype,
-                                contract_ndim=2)(out)
+        return with_delta(
+            "out",
+            maybe_lora_dense(d, "out", rank=self.lora_rank,
+                             alpha=self.lora_alpha,
+                             targets=self.lora_targets, dtype=self.dtype,
+                             contract_ndim=2)(out),
+            out, cn=2)
 
 
 class DecoderBlock(nn.Module):
@@ -297,7 +311,7 @@ class DecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool, positions=None, block_tables=None,
-                 start_pos=None):
+                 start_pos=None, adapters=None):
         h = nn.LayerNorm(dtype=jnp.float32)(x)
         h = CausalSelfAttention(self.num_heads, self.dtype, self.seq_axis,
                                 self.decode, self.max_len,
@@ -311,7 +325,8 @@ class DecoderBlock(nn.Module):
                                 kv_block_size=self.kv_block_size,
                                 name="attn")(h, positions=positions,
                                              block_tables=block_tables,
-                                             start_pos=start_pos)
+                                             start_pos=start_pos,
+                                             adapters=adapters)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -323,19 +338,23 @@ class DecoderBlock(nn.Module):
                        expert_axis=self.expert_axis, no_drop=self.decode,
                        router=self.moe_router, name="moe")(h)
         else:
-            from ddw_tpu.models.lora import maybe_lora_dense
+            from ddw_tpu.models.lora import maybe_lora_dense, row_lora_delta
 
             d = x.shape[-1]
 
-            def mlp_dense(feats, name):
-                return maybe_lora_dense(feats, name, rank=self.lora_rank,
-                                        alpha=self.lora_alpha,
-                                        targets=self.lora_targets,
-                                        dtype=self.dtype)
+            def mlp_dense(feats, name, inp):
+                y = maybe_lora_dense(feats, name, rank=self.lora_rank,
+                                     alpha=self.lora_alpha,
+                                     targets=self.lora_targets,
+                                     dtype=self.dtype)(inp)
+                ab = (adapters or {}).get(name)
+                if ab is not None:
+                    y = y + row_lora_delta(inp, ab[0], ab[1]).astype(y.dtype)
+                return y
 
-            h = mlp_dense(self.mlp_dim, "fc1")(h)
+            h = mlp_dense(self.mlp_dim, "fc1", h)
             h = nn.gelu(h)
-            h = mlp_dense(d, "fc2")(h)
+            h = mlp_dense(d, "fc2", h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return x + h
 
@@ -391,7 +410,14 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, block_tables=None,
-                 start_pos=None):
+                 start_pos=None, adapters=None):
+        # adapters: optional (stacks, idx) pair for heterogeneous-adapter
+        # batched serving (ddw_tpu.serve.adapters.AdapterPool). ``stacks`` is
+        # {f"backbone_block{i}": {target: (a_stack [S+1,*in,r],
+        # b_stack [S+1,r,*feats])}} with slot 0 all-zeros (the null adapter);
+        # ``idx`` is a per-row [B] int32 slot vector. The gather happens ONCE
+        # here; each block then applies its row-wise delta. Passed as a call
+        # ARGUMENT (like block_tables) so adapter churn never retraces.
         if self.lora_rank:
             from ddw_tpu.models.lora import validate_lora_targets
 
@@ -482,7 +508,16 @@ class TransformerLM(nn.Module):
             Block = DecoderBlock
         paged_kw = (dict(block_tables=block_tables, start_pos=start_pos)
                     if self.paged_decode else {})
+        row_adapters = None
+        if adapters is not None:
+            stacks, aidx = adapters
+            aidx = jnp.asarray(aidx, jnp.int32)
+            row_adapters = jax.tree.map(lambda st: jnp.asarray(st)[aidx],
+                                        stacks)
         for i in range(self.depth):
+            blk_kw = dict(paged_kw)
+            if row_adapters is not None:
+                blk_kw["adapters"] = row_adapters.get(f"backbone_block{i}")
             x = Block(self.num_heads, self.mlp_dim, self.dropout,
                       self.dtype, None if self.decode else self.seq_axis,
                       self.decode, self.max_len,
@@ -499,7 +534,7 @@ class TransformerLM(nn.Module):
                       kv_cache_blocks=self.kv_cache_blocks,
                       kv_block_size=self.kv_block_size,
                       name=f"backbone_block{i}")(x, train, positions,
-                                                 **paged_kw)
+                                                 **blk_kw)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # vocab head in f32: logits feed a softmax CE, keep full precision
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)

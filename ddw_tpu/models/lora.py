@@ -90,6 +90,28 @@ class LoRADenseGeneral(nn.Module):
 LM_LORA_TARGETS = ("query", "key", "value", "out", "fc1", "fc2")
 
 
+def row_lora_delta(x, a, b, contract_ndim: int = 1):
+    """Per-ROW adapter delta for heterogeneous-adapter batched serving
+    (S-LoRA, arXiv 2311.03285): each batch row carries its OWN ``(A, B)``
+    pair, gathered from an adapter stack by the row's adapter index, so one
+    decode tick serves many adapters (and the base model) at once.
+
+    ``x`` is ``[B, S, *in_dims]``; ``a`` is ``[B, *in_dims, r]``; ``b`` is
+    ``[B, r, *feats]`` with any ``alpha/rank`` scaling already folded in
+    (:class:`ddw_tpu.serve.adapters.AdapterPool` pre-scales at load).
+    Returns ``[B, S, *feats]``. A zero ``b`` row (the reserved null adapter)
+    contributes exactly ``+0.0`` — the base-model row in a mixed batch stays
+    token-identical to an adapter-free engine.
+    """
+    a = a.astype(x.dtype)
+    b = b.astype(x.dtype)
+    cn = contract_ndim
+    xdims = tuple(range(2, 2 + cn))          # trailing input dims of [B,S,*]
+    adims = tuple(range(1, 1 + cn))          # matching dims of [B,*in,r]
+    h = jax.lax.dot_general(x, a, ((xdims, adims), ((0,), (0,))))  # [B, S, r]
+    return jax.lax.dot_general(h, b, (((2,), (1,)), ((0,), (0,))))
+
+
 def validate_lora_targets(targets: Sequence[str],
                           known: Sequence[str] = LM_LORA_TARGETS) -> None:
     """Raise on a target name the model does not route through

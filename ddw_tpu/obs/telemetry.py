@@ -429,6 +429,11 @@ def signal_registry() -> dict[str, str]:
                  "serve.decode_bucket", "serve.batch_backlog",
                  "serve.tp_degree", "serve.spec_k_effective"):
         reg[name] = "gauge"
+    # LoRA adapter pool occupancy (AdapterPool.gauges(), pushed through the
+    # block-pool gauge path when EngineCfg.adapter_slots > 0)
+    for name in ("serve.adapter.slots_total", "serve.adapter.slots_used",
+                 "serve.adapter.slots_pinned", "serve.adapter.pins_inflight"):
+        reg[name] = "gauge"
     # autoscaler convergence state (pushed on the fleet metrics each tick)
     for name in ("serve.desired_replicas", "serve.fleet_size"):
         reg[name] = "gauge"

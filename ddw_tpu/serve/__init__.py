@@ -34,5 +34,21 @@ from ddw_tpu.serve.metrics import (  # noqa: F401
     RequestRecord,
     render_prometheus,
 )
+from ddw_tpu.serve.adapters import (  # noqa: F401
+    AdapterDigestMismatch,
+    AdapterError,
+    AdapterPool,
+    AdapterPoolFull,
+    UnknownAdapter,
+    load_adapter,
+    save_adapter,
+)
 from ddw_tpu.serve.blocks import BlockPool  # noqa: F401
 from ddw_tpu.serve.slots import SlotPool  # noqa: F401
+from ddw_tpu.serve.tenancy import (  # noqa: F401
+    QuotaExceeded,
+    TenancyController,
+    TenantAwareAdmission,
+    TenantSpec,
+    tenant_objectives,
+)

@@ -99,10 +99,11 @@ class _Stream:
     """One resident request's pool-side state (host bookkeeping only)."""
 
     __slots__ = ("row", "blocks", "prompt_len", "filled", "total", "seq",
-                 "lane")
+                 "lane", "adapter_slot", "salt")
 
     def __init__(self, row: int, prompt_len: int, total: int, seq: int,
-                 lane: str = "interactive"):
+                 lane: str = "interactive", adapter_slot: int = 0,
+                 salt: bytes = b""):
         self.row = row
         self.blocks: list[int] = []   # physical block ids, table order
         self.prompt_len = prompt_len  # effective prompt (incl. resumed toks)
@@ -113,6 +114,10 @@ class _Stream:
         self.lane = lane              # "interactive" | "batch" — batch
         #                               streams are preempted before ANY
         #                               interactive stream
+        self.adapter_slot = adapter_slot  # AdapterPool slot (0 = base model)
+        self.salt = salt              # prefix-cache chain salt (the adapter
+        #                               digest bytes; b"" = base — today's
+        #                               hashes exactly)
 
 
 class BlockPool:
@@ -133,7 +138,7 @@ class BlockPool:
                  block_size: int, max_resident: int,
                  steps_per_tick: int = 4, donate: bool = True,
                  overcommit: float = 1.0, interactive_reserve: int = 0,
-                 decode_buckets: bool = True, mesh=None):
+                 decode_buckets: bool = True, mesh=None, adapters=None):
         if n_blocks < 1:
             raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
         if interactive_reserve < 0:
@@ -169,6 +174,13 @@ class BlockPool:
         #                             max_resident rows every tick
         self.params = params
         self._donate = donate
+        # Optional AdapterPool (ddw_tpu.serve.adapters): when set, every
+        # device program below takes the adapter stacks plus a per-row slot
+        # index as two EXTRA call arguments (the block-table pattern one
+        # layer up). When None — the default — the traced programs are
+        # byte-identical to the pre-adapter ones: tenant-less deployments
+        # pay literally nothing.
+        self._adapters = adapters
         cap = -(-model.max_len // tile) * tile
         self.n_tbl = cap // block_size    # block-table width (cap coverage)
         self._cap = cap
@@ -339,7 +351,13 @@ class BlockPool:
         # reserve gauges are summable across replicas; the occupancy ratio
         # is derived at snapshot/render time from the summed pair
         avail = self.free_blocks_effective - self._committed
-        return {
+        out = {}
+        if self._adapters is not None:
+            # serve.adapter.* keys, stripped of the serve. prefix like every
+            # other pool gauge (the engine re-prefixes at snapshot time)
+            out.update({k.removeprefix("serve."): v
+                        for k, v in self._adapters.gauges().items()})
+        out.update({
             "blocks_total": float(self.n_blocks),
             "blocks_free": float(len(self._free)),
             "blocks_cached": float(len(self._cached)),
@@ -354,7 +372,8 @@ class BlockPool:
             "prefix_cache_keys": float(len(self._full_map)),
             "decode_bucket": float(self.last_decode_bucket),
             "tp_degree": float(self.tp_degree),
-        }
+        })
+        return out
 
     @property
     def tp_degree(self) -> int:
@@ -442,26 +461,35 @@ class BlockPool:
                                if s > since]}
 
     # -- prefix cache ---------------------------------------------------------
-    def _chain_hashes(self, prompt: np.ndarray) -> list[bytes]:
+    def _chain_hashes(self, prompt: np.ndarray,
+                      salt: bytes = b"") -> list[bytes]:
         """Per-full-block chain hashes: ``h[j]`` commits to tokens
         ``[0, (j+1)*bs)`` — equal hashes mean equal tokens at equal
         positions, which (K/V being deterministic in tokens+positions+
-        params) means bit-identical block content."""
+        params) means bit-identical block content.
+
+        ``salt`` seeds the chain (the request's adapter digest): adapted
+        K/V is a function of tokens+positions+params **+adapter**, so two
+        tenants' identical prompts under different adapters land on
+        DISJOINT chains — cross-adapter reuse is structurally impossible.
+        The empty salt reproduces today's hashes bit-for-bit, so base
+        traffic, the fleet prefix index, and KV migration (which only ever
+        exports unsalted chains) are untouched."""
         bs = self.block_size
-        out, h = [], b""
+        out, h = [], salt
         for j in range(len(prompt) // bs):
             h = hashlib.sha1(h + prompt[j * bs:(j + 1) * bs].tobytes()
                              ).digest()
             out.append(h)
         return out
 
-    def lookup(self, prompt: np.ndarray) -> int:
+    def lookup(self, prompt: np.ndarray, salt: bytes = b"") -> int:
         """Longest cached prefix (tokens) WITHOUT mutating state — capped
         at ``P - 1`` so at least one real token always prefills (its
         logits pick the first output token)."""
         bs = self.block_size
         p = len(prompt)
-        hashes = self._chain_hashes(prompt)
+        hashes = self._chain_hashes(prompt, salt)
         hit = 0
         for j, h in enumerate(hashes):
             if self._full_map.get(h) is None:
@@ -469,14 +497,15 @@ class BlockPool:
             hit = (j + 1) * bs
         full = p // bs
         if hit == full * bs and p % bs:
-            chain = hashes[full - 1] if full else b""
+            chain = hashes[full - 1] if full else salt
             if (chain, prompt[full * bs:].tobytes()) in self._tail_map:
                 hit = p
         return min(hit, p - 1)
 
     def admit(self, prompt: np.ndarray, num_steps: int,
               seq_hint: int | None = None,
-              lane: str = "interactive") -> tuple[int, int]:
+              lane: str = "interactive", adapter_slot: int = 0,
+              salt: bytes = b"") -> tuple[int, int]:
         """Claim a row and the prompt's blocks for one request. Prefix-hit
         FULL blocks the request never writes are shared by refcount; the
         block holding the first written position (``hit`` onward) is cloned
@@ -490,11 +519,11 @@ class BlockPool:
             raise ValueError("empty prompt")
         if not self._free_rows:
             raise RuntimeError("no free resident rows")
-        hit = self.lookup(prompt)
-        hashes = self._chain_hashes(prompt)
+        hit = self.lookup(prompt, salt)
+        hashes = self._chain_hashes(prompt, salt)
         st = _Stream(self._free_rows[-1], p,
                      self.total_positions(p, num_steps), self._seq,
-                     lane=lane)
+                     lane=lane, adapter_slot=adapter_slot, salt=salt)
         blocks: list[int] = []
         try:
             # shared full hit blocks: everything strictly before the first
@@ -516,7 +545,7 @@ class BlockPool:
                 if p % bs == 0:
                     src = self._full_map[hashes[j]]
                 else:
-                    chain = hashes[j - 1] if j else b""
+                    chain = hashes[j - 1] if j else salt
                     src = self._tail_map[(chain, prompt[j * bs:].tobytes())]
                 dst = self._alloc()
                 self.cache = self._copy(self.cache, jnp.int32(dst),
@@ -551,20 +580,28 @@ class BlockPool:
         either way; first-writer wins)."""
         bs = self.block_size
         st = self._streams[row]
-        hashes = self._chain_hashes(prompt)
+        hashes = self._chain_hashes(prompt, st.salt)
         for j, h in enumerate(hashes):
             blk = st.blocks[j]
             if h not in self._full_map:
                 self._full_map[h] = blk
                 self._block_keys.setdefault(blk, []).append(("full", h))
-                toks = tuple(int(t) for t in prompt[:(j + 1) * bs])
-                with self._ev_lock:
-                    self._prefix_tokens[h] = toks
-                self._emit("register", h, toks)
+                if st.salt:
+                    # salted (adapter) chains publish a holder-only event:
+                    # the gateway routes adapter traffic to residents by the
+                    # salted key, but the tokens stay out of the index — a
+                    # warm-replay through normal prefill would re-register
+                    # them UNSALTED, i.e. as base-model KV
+                    self._emit("register", h)
+                else:
+                    toks = tuple(int(t) for t in prompt[:(j + 1) * bs])
+                    with self._ev_lock:
+                        self._prefix_tokens[h] = toks
+                    self._emit("register", h, toks)
         t = len(prompt) % bs
         if t:
             j = len(prompt) // bs
-            chain = hashes[j - 1] if j else b""
+            chain = hashes[j - 1] if j else st.salt
             key = (chain, prompt[j * bs:].tobytes())
             blk = st.blocks[j]
             if key not in self._tail_map:
@@ -907,6 +944,21 @@ class BlockPool:
                 starts[i] = st.filled
         return tables, starts
 
+    def _adapter_extras(self, rows) -> tuple:
+        """Extra device-program arguments when an AdapterPool is attached:
+        ``(stacks, idx[R])`` with ``idx[i]`` the row's adapter slot (0 =
+        base / free / warmup row → the null stack row, delta exactly 0).
+        Empty tuple when adapters are off — the jitted signatures are then
+        byte-identical to the pre-adapter programs."""
+        if self._adapters is None:
+            return ()
+        idx = np.zeros((len(rows),), np.int32)
+        for i, row in enumerate(rows):
+            st = self._streams.get(row) if row is not None else None
+            if st is not None:
+                idx[i] = st.adapter_slot
+        return (self._adapters.stacks(), jnp.asarray(idx))
+
     def _dispatch(self, fn, cache, *args):
         """Run one device program. In mesh mode the dispatch is metered
         (wall-µs through the result barrier, so the TP collectives are in
@@ -944,10 +996,11 @@ class BlockPool:
             model = self._model
 
             def prefill_fn(cache, toks, tables, starts, true_lens, temps,
-                           keys):
+                           keys, *ad):
                 logits, vars_ = model.apply(
                     {"params": self.params, "cache": cache}, toks,
                     block_tables=tables, start_pos=starts,
+                    adapters=ad if ad else None,
                     mutable=["cache"])
                 last = jnp.take_along_axis(
                     logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
@@ -961,7 +1014,7 @@ class BlockPool:
             jnp.asarray(tables), jnp.asarray(starts),
             jnp.asarray(true_lens, jnp.int32),
             jnp.asarray(temps, jnp.float32),
-            jnp.asarray(keys))
+            jnp.asarray(keys), *self._adapter_extras(rows))
         return np.asarray(toks)
 
     def _live_bucket(self) -> int:
@@ -1012,13 +1065,15 @@ class BlockPool:
         if fn is None:
             model = self._model
 
-            def chain(cache, tok, starts, tables, temps, keys_sk):
+            def chain(cache, tok, starts, tables, temps, keys_sk, *ad):
+                adapters = ad if ad else None
+
                 def body(carry, key_s):
                     cache, tok, pos = carry
                     logits, vars_ = model.apply(
                         {"params": self.params, "cache": cache},
                         tok[:, None], block_tables=tables, start_pos=pos,
-                        mutable=["cache"])
+                        adapters=adapters, mutable=["cache"])
                     nxt = _pick(self._replicate(logits[:, 0]), temps, key_s)
                     return (vars_["cache"], nxt, pos + 1), nxt
 
@@ -1033,7 +1088,7 @@ class BlockPool:
             fn, self.cache, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(starts), jnp.asarray(tables),
             jnp.asarray(temps, jnp.float32),
-            jnp.asarray(keys))
+            jnp.asarray(keys), *self._adapter_extras(rows))
         return np.asarray(toks)
 
     def spec_draft(self, prev_tokens, cur_tokens, temps, keys) -> np.ndarray:
@@ -1137,10 +1192,11 @@ class BlockPool:
         if fn is None:
             model = self._model
 
-            def verify_fn(cache, toks, tables, starts, temps, keys_sk):
+            def verify_fn(cache, toks, tables, starts, temps, keys_sk, *ad):
                 logits, vars_ = model.apply(
                     {"params": self.params, "cache": cache}, toks,
                     block_tables=tables, start_pos=starts,
+                    adapters=ad if ad else None,
                     mutable=["cache"])
                 picks = jax.vmap(lambda lg, key: _pick(lg, temps, key),
                                  in_axes=1, out_axes=1)(
@@ -1153,7 +1209,7 @@ class BlockPool:
             fn, self.cache, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(tables), jnp.asarray(starts),
             jnp.asarray(temps, jnp.float32),
-            jnp.asarray(keys))
+            jnp.asarray(keys), *self._adapter_extras(rows))
         return np.asarray(picks)
 
     def warmup_spec(self, spec_k: int, role: str) -> None:

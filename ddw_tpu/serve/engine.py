@@ -89,10 +89,14 @@ from ddw_tpu.runtime.faults import ServeCrash, maybe_serve_fault
 from ddw_tpu.runtime.mesh import MODEL_AXIS
 from ddw_tpu.serve.admission import (AdmissionController, DeadlineExceeded,
                                      Overloaded, ReplicaFailed)
+from ddw_tpu.serve.adapters import (AdapterPool, UnknownAdapter,
+                                    load_adapter as load_adapter_file)
 from ddw_tpu.serve.blocks import BlockPool, OutOfBlocks
 from ddw_tpu.serve.bucketing import (batch_bucket, bucket_len, pad_to_bucket)
 from ddw_tpu.serve.metrics import EngineMetrics, RequestRecord
 from ddw_tpu.serve.slots import SlotPool
+from ddw_tpu.serve.tenancy import (QuotaExceeded, TenancyController,
+                                   TenantAwareAdmission, TenantSpec)
 
 __all__ = ["EngineCfg", "ServingEngine", "GenerateResult", "PredictResult",
            "Overloaded", "DeadlineExceeded", "ReplicaFailed"]
@@ -192,6 +196,28 @@ class EngineCfg:
     # AND seeded; the sampling folds run on fully-replicated logits).
     # Requires paged=True; the head count must divide by tp.
     tp: int = 1
+    # multi-tenant serving (docs/serving.md "Multi-tenant serving"): a
+    # hot-loadable LoRA adapter pool (ddw_tpu.serve.adapters.AdapterPool)
+    # shared by every stream — each request may name an adapter_id and the
+    # paged programs gather that row's (A, B) stack into the SAME compiled
+    # prefill/decode/verify dispatch (S-LoRA-style heterogeneous batching;
+    # slot 0 is the reserved null adapter, so tenant-less traffic stays
+    # bit-identical to adapter_slots=0). Requires paged=True.
+    adapter_slots: int = 0      # loadable adapter slots beyond the null
+    #                             slot; 0 = adapters off (programs compile
+    #                             without the stack arguments — traces are
+    #                             byte-identical to pre-adapter engines)
+    adapter_rank: int = 8       # pool-wide rank ceiling; smaller-rank
+    #                             adapters zero-pad up (delta-preserving)
+    adapter_targets: tuple = ()  # projections adapters may touch; () =
+    #                             every LM_LORA_TARGETS projection
+    # per-tenant QoS (ddw_tpu.serve.tenancy): TenantSpec entries (objects
+    # or their to_dict forms). Non-empty swaps the admission controller
+    # for TenantAwareAdmission (weighted fair share on the batch lane,
+    # priority tiers) and enforces token/block quotas at submission
+    # (QuotaExceeded — a structured 429, attributed to the tenant).
+    # Empty = single implicit tenant, admission byte-for-byte today's.
+    tenants: tuple = ()
     # prefill/decode disaggregation (docs/serving.md "Disaggregated
     # prefill/decode"): a "prefill" replica runs suffix prefill, registers
     # the prompt blocks, and finishes the request immediately — ZERO
@@ -225,6 +251,17 @@ class EngineCfg:
                 f"tp {self.tp} requires the paged pool (paged=True): only "
                 f"the BlockPool programs compile under a mesh — the "
                 f"contiguous slot pool is single-device")
+        if self.adapter_slots < 0:
+            raise ValueError(f"adapter_slots must be >= 0, got "
+                             f"{self.adapter_slots}")
+        if self.adapter_slots and not self.paged:
+            raise ValueError(
+                f"adapter_slots {self.adapter_slots} requires the paged "
+                f"pool (paged=True): per-row adapter gathers are defined "
+                f"over the BlockPool programs only")
+        if self.adapter_slots and self.adapter_rank < 1:
+            raise ValueError(f"adapter_rank must be >= 1 with adapters "
+                             f"on, got {self.adapter_rank}")
 
 
 @dataclasses.dataclass
@@ -261,11 +298,13 @@ class _LMRequest:
     __slots__ = ("prompt", "num_steps", "temperature", "keys", "deadline",
                  "future", "times", "tokens", "emitted", "on_token",
                  "claimed", "lane", "trace_id", "parent_span", "last_span",
-                 "ticks")
+                 "ticks", "tenant", "adapter_id", "adapter_slot", "salt",
+                 "quota_blocks", "quota_tokens", "released")
 
     def __init__(self, prompt, num_steps, temperature, keys, deadline, now,
                  on_token=None, lane="interactive", trace_id=None,
-                 parent_span=None):
+                 parent_span=None, tenant=None, adapter_id=None,
+                 adapter_slot=0, salt=b""):
         self.prompt = prompt
         self.num_steps = num_steps
         self.temperature = temperature
@@ -287,6 +326,13 @@ class _LMRequest:
         self.last_span = parent_span    # newest span in this request's
         #                             chain — the next span's parent
         self.ticks = 0              # decode ticks this request rode
+        self.tenant = tenant        # attribution label; None = untagged
+        self.adapter_id = adapter_id    # LoRA adapter, None = base model
+        self.adapter_slot = adapter_slot  # pinned pool slot (0 = null)
+        self.salt = salt            # prefix-cache salt (adapter digest)
+        self.quota_blocks = 0       # tenancy charge held by this request
+        self.quota_tokens = 0       # (released exactly once at resolution)
+        self.released = False       # pin + quota given back (idempotence)
 
     def effective_prompt(self) -> np.ndarray:
         """The prompt a (re-)prefill must run: the original tokens plus
@@ -368,10 +414,20 @@ class ServingEngine:
         self._telemetry = bool(self.cfg.telemetry)
         if self.telem is not None:
             self.telem.add_collector(self._telemetry_collector)
-        self._ctrl = AdmissionController(
-            self.cfg.queue_depth,
-            per_kind={"lm_batch": self.cfg.batch_queue_depth,
-                      "image_batch": self.cfg.batch_queue_depth})
+        per_kind = {"lm_batch": self.cfg.batch_queue_depth,
+                    "image_batch": self.cfg.batch_queue_depth}
+        specs = tuple(TenantSpec.from_dict(t) if isinstance(t, dict) else t
+                      for t in (self.cfg.tenants or ()))
+        self.tenancy = TenancyController(specs=specs) if specs else None
+        if self.tenancy is not None:
+            # tenants configured: quotas at submission, weighted fair
+            # share + priority tiers on the batch lane. Without specs the
+            # plain controller keeps admission byte-for-byte unchanged.
+            self._ctrl = TenantAwareAdmission(
+                self.cfg.queue_depth, self.tenancy, per_kind=per_kind)
+        else:
+            self._ctrl = AdmissionController(self.cfg.queue_depth,
+                                             per_kind=per_kind)
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -491,6 +547,7 @@ class ServingEngine:
                      if hasattr(draft, "engine_handle") else draft)
         self._draft = draft
         self._draft_pool: BlockPool | None = None
+        self.adapters: AdapterPool | None = None
         if self._lm is not None:
             spec = self.cfg.spec_k > 0
             if self.cfg.spec_k < 0:
@@ -525,8 +582,22 @@ class ServingEngine:
                             f"num_heads {heads}: attention heads are the "
                             f"tensor-parallel shard axis")
             if self.cfg.paged:
+                # the adapter pool is built BEFORE the block pool: the
+                # paged programs close over its presence (stack arguments
+                # in every dispatch signature). The DRAFT pool never gets
+                # one — spec proposals are verified by the adapted target,
+                # so the verify-based commit preserves output identity
+                # with an adapter-free draft.
+                self.adapters = None
+                if self.cfg.adapter_slots > 0:
+                    self.adapters = AdapterPool(
+                        self._lm.model, self.cfg.adapter_slots,
+                        self.cfg.adapter_rank,
+                        targets=(tuple(self.cfg.adapter_targets)
+                                 if self.cfg.adapter_targets else None))
                 self.pool = self._build_block_pool(
-                    self._lm, self.cfg.steps_per_tick)
+                    self._lm, self.cfg.steps_per_tick,
+                    adapters=self.adapters)
                 n = self.pool.max_resident
                 if spec:
                     # the draft's OWN paged pool: rows mirror the target
@@ -558,7 +629,8 @@ class ServingEngine:
         else:
             self.pool = None
 
-    def _build_block_pool(self, handle, steps_per_tick: int) -> BlockPool:
+    def _build_block_pool(self, handle, steps_per_tick: int,
+                          adapters: AdapterPool | None = None) -> BlockPool:
         """One paged pool over ``handle`` with the engine's geometry knobs
         (block size shrinks to the model's own tile divisor; block count
         defaults to equal-KV-memory scaled by the model's own capacity)."""
@@ -593,7 +665,7 @@ class ServingEngine:
             overcommit=self.cfg.block_overcommit,
             interactive_reserve=reserve,
             decode_buckets=self.cfg.decode_buckets,
-            mesh=self.mesh)
+            mesh=self.mesh, adapters=adapters)
 
     # -- checkpoint hot-swap (the deploy layer's weight-reload hook) ---------
     @property
@@ -743,6 +815,10 @@ class ServingEngine:
                              else {"seq": 0, "keys": 0}),
             "trace": (self.tracer.summary() if self._tracing else None),
             "telemetry": (self.telem.summary() if self._telemetry else None),
+            "adapters": (self.adapters.view()
+                         if self.adapters is not None else None),
+            "tenancy": (self.tenancy.view()
+                        if self.tenancy is not None else None),
         }
 
     def load(self) -> dict:
@@ -860,6 +936,49 @@ class ServingEngine:
             self.metrics.count("kv_blocks_migrated", res["imported"])
             self.metrics.count("kv_bytes_migrated", res["bytes"])
         return res
+
+    # -- LoRA adapter admin (the gateway's /admin/adapters relay) ------------
+    def load_adapter(self, adapter_id: str, adapter=None, *,
+                     path: str | None = None, alpha: float = 16.0,
+                     rank: int | None = None,
+                     digest: str | None = None) -> dict:
+        """Land (or re-land — same-digest loads are idempotent) a LoRA
+        adapter in the pool, serialized with the engine loop like every
+        pool mutation. ``adapter`` is an in-memory ``{block: {target:
+        {lora_a, lora_b}}}`` tree; ``path`` loads a ``.npz`` package saved
+        by :func:`ddw_tpu.serve.adapters.save_adapter` instead (its header
+        supplies alpha/rank/digest). Raises ``AdapterPoolFull`` when every
+        slot is pinned, ``AdapterDigestMismatch`` on an id collision."""
+        if self.adapters is None:
+            raise ValueError("engine was built without an adapter pool "
+                             "(EngineCfg(adapter_slots > 0))")
+        if (adapter is None) == (path is None):
+            raise ValueError("exactly one of adapter= or path= is required")
+        if path is not None:
+            adapter, header = load_adapter_file(path)
+            alpha = float(header.get("alpha", alpha))
+            rank = header.get("rank", rank)
+            digest = header.get("digest", digest)
+        slot = self._run_pool_op(lambda: self.adapters.load(
+            adapter_id, adapter, alpha=alpha, rank=rank, digest=digest))
+        self._sync_adapter_counters()
+        return {"adapter_id": adapter_id, "slot": slot,
+                "digest": self.adapters.digest_of(adapter_id)}
+
+    def unload_adapter(self, adapter_id: str) -> dict:
+        """Explicitly evict a loaded adapter (refuses while pinned — a
+        decoding stream must never lose its weights)."""
+        if self.adapters is None:
+            raise ValueError("engine was built without an adapter pool "
+                             "(EngineCfg(adapter_slots > 0))")
+        self._run_pool_op(lambda: self.adapters.unload(adapter_id))
+        self._sync_adapter_counters()
+        return {"adapter_id": adapter_id, "unloaded": True}
+
+    def adapter_view(self) -> dict:
+        """The pool's registry view (slots, digests, pins, LRU order) —
+        ``{}`` when adapters are off, so callers can always read it."""
+        return self.adapters.view() if self.adapters is not None else {}
 
     def _run_pool_op(self, fn, timeout_s: float = 30.0):
         """Run ``fn`` serialized with the engine loop: inline when the
@@ -1052,7 +1171,9 @@ class ServingEngine:
                         temperature: float = 0.0, rng=None,
                         timeout_s: float | None = None,
                         on_token=None, trace_id: str | None = None,
-                        parent_span: str | None = None
+                        parent_span: str | None = None,
+                        tenant: str | None = None,
+                        adapter_id: str | None = None
                         ) -> concurrent.futures.Future:
         """Queue one LM continuation; returns a future resolving to a
         :class:`GenerateResult` (or raising ``Overloaded`` here /
@@ -1071,17 +1192,31 @@ class ServingEngine:
         ``trace_id`` / ``parent_span`` thread end-to-end tracing through
         (the gateway's request id and its http span) — recorded on the
         engine's spans and in the request's jsonl row when tracing is on,
-        ignored otherwise."""
+        ignored otherwise.
+
+        ``tenant`` attributes the request (per-tenant counters, quotas and
+        fair share when ``EngineCfg.tenants`` is set — ``QuotaExceeded``
+        here when its budget is spent); ``adapter_id`` names a loaded LoRA
+        adapter (``UnknownAdapter``, a ``ValueError``, when absent) —
+        the adapter is PINNED in its pool slot until the request
+        resolves, so LRU eviction can never pull weights out from under a
+        decoding stream."""
         req = self._make_lm_request(prompt, num_steps, temperature, rng,
                                     timeout_s, on_token, "interactive",
                                     trace_id=trace_id,
-                                    parent_span=parent_span)
-        self._offer("lm", req)
+                                    parent_span=parent_span,
+                                    tenant=tenant, adapter_id=adapter_id)
+        try:
+            self._offer("lm", req)
+        except BaseException:
+            self._release_req_resources(req)
+            raise
         return req.future
 
     def _make_lm_request(self, prompt, num_steps, temperature, rng,
                          timeout_s, on_token, lane, trace_id=None,
-                         parent_span=None) -> "_LMRequest":
+                         parent_span=None, tenant=None,
+                         adapter_id=None) -> "_LMRequest":
         if self._lm is None:
             raise ValueError("engine was built without an LM model")
         prompt = np.asarray(prompt, np.int32)
@@ -1099,6 +1234,7 @@ class ServingEngine:
             raise ValueError(
                 f"prompt {prompt.size} + steps {num_steps} exceeds max_len "
                 f"{self._lm.cfg.max_len}")
+        need = 0
         if isinstance(self.pool, BlockPool):
             need = self.pool.blocks_for(
                 self.pool.total_positions(prompt.size, num_steps))
@@ -1141,10 +1277,43 @@ class ServingEngine:
             keys = np.asarray(jax.random.split(rng, num_steps))
         now = time.monotonic()
         timeout = self.cfg.default_timeout_s if timeout_s is None else timeout_s
-        return _LMRequest(prompt, num_steps, float(temperature), keys,
-                          now + timeout if timeout else None, now,
-                          on_token=on_token, lane=lane, trace_id=trace_id,
-                          parent_span=parent_span)
+        # resource acquisition happens LAST, after every validation that
+        # can refuse the request, so nothing needs unwinding on a plain
+        # ValueError. Order: pin the adapter (UnknownAdapter -> the
+        # gateway's 400), then charge the tenant quota (QuotaExceeded ->
+        # 429; the pin is returned on that path). The pin + charge are
+        # held until the request RESOLVES — completion, shed, cancel, or
+        # failure — released exactly once via _release_req_resources.
+        adapter_slot, salt = 0, b""
+        if adapter_id is not None:
+            if self.adapters is None:
+                raise UnknownAdapter(adapter_id, ())
+            adapter_slot = self.adapters.pin(adapter_id)
+            salt = self.adapters.salt_of(adapter_id)
+        quota_blocks = quota_tokens = 0
+        resolved = tenant
+        if self.tenancy is not None:
+            try:
+                resolved = self.tenancy.charge(
+                    tenant, need, num_steps,
+                    retry_after_ms=self._retry_hint_ms(
+                        "lm_batch" if lane == "batch" else "lm"))
+                quota_blocks, quota_tokens = need, num_steps
+            except QuotaExceeded as e:
+                if adapter_id is not None:
+                    self.adapters.unpin(adapter_id)
+                self.metrics.count_labeled("tenant_sheds", "tenant",
+                                           e.tenant)
+                self.tenancy.note_shed(e.tenant)
+                raise
+        req = _LMRequest(prompt, num_steps, float(temperature), keys,
+                         now + timeout if timeout else None, now,
+                         on_token=on_token, lane=lane, trace_id=trace_id,
+                         parent_span=parent_span, tenant=resolved,
+                         adapter_id=adapter_id, adapter_slot=adapter_slot,
+                         salt=salt)
+        req.quota_blocks, req.quota_tokens = quota_blocks, quota_tokens
+        return req
 
     def generate(self, prompt, num_steps: int, **kw) -> GenerateResult:
         """Synchronous :meth:`submit_generate`."""
@@ -1152,7 +1321,9 @@ class ServingEngine:
 
     def submit_batch_item(self, prompt, num_steps: int,
                           temperature: float = 0.0, rng=None,
-                          timeout_s: float | None = 0.0
+                          timeout_s: float | None = 0.0,
+                          tenant: str | None = None,
+                          adapter_id: str | None = None
                           ) -> concurrent.futures.Future:
         """Queue ONE batch-lane LM continuation — the per-item primitive a
         :class:`~ddw_tpu.serve.lanes.BatchJob` pump feeds. Same contract
@@ -1166,8 +1337,13 @@ class ServingEngine:
             raise ValueError("the batch lane requires the paged pool "
                              "(EngineCfg(paged=True))")
         req = self._make_lm_request(prompt, num_steps, temperature, rng,
-                                    timeout_s, None, "batch")
-        self._offer("lm_batch", req)
+                                    timeout_s, None, "batch",
+                                    tenant=tenant, adapter_id=adapter_id)
+        try:
+            self._offer("lm_batch", req)
+        except BaseException:
+            self._release_req_resources(req)
+            raise
         return req.future
 
     def submit_batch_predict(self, item, timeout_s: float | None = 0.0
@@ -1276,6 +1452,7 @@ class ServingEngine:
             # recycling: an honest load refusal (not a failure — the
             # breaker stays neutral, routing spills to a sibling)
             self.metrics.count_overloaded()
+            self._count_tenant_shed(req)
             raise Overloaded(kind, self._ctrl.capacity_for(kind),
                              self._ctrl.depth(kind),
                              retry_after_ms=self._service_ms or 100.0)
@@ -1284,9 +1461,17 @@ class ServingEngine:
                              retry_after_ms=self._retry_hint_ms(kind))
         except Overloaded:
             self.metrics.count_overloaded()
+            self._count_tenant_shed(req)
             raise
         with self._cv:
             self._cv.notify_all()
+
+    def _count_tenant_shed(self, req) -> None:
+        tenant = getattr(req, "tenant", None)
+        if tenant is not None:
+            self.metrics.count_labeled("tenant_sheds", "tenant", tenant)
+            if self.tenancy is not None:
+                self.tenancy.note_shed(tenant)
 
     def _retry_hint_ms(self, kind: str) -> float | None:
         """``Overloaded.retry_after_ms``: on the paged pool the hint is the
@@ -1315,19 +1500,47 @@ class ServingEngine:
             drained, expired = self._ctrl.take(
                 kind, self._ctrl.depth(kind) + 1)
             for req in drained + expired:
+                self._release_req_resources(req)
                 if not req.future.done():
                     req.future.set_exception(exc)
         if self.pool is not None:
             for req in self._slot_req.values():
+                self._release_req_resources(req)
                 if not req.future.done():
                     req.future.set_exception(exc)
             self._slot_req.clear()
 
+    def _release_req_resources(self, req) -> None:
+        """Give back everything a request holds OUTSIDE the block pool —
+        its adapter pin and its tenant quota charge — exactly once
+        (``released`` flips; every resolution path calls this, so losing
+        a race between them is harmless). Image requests carry neither
+        and pass through untouched."""
+        if getattr(req, "released", True):
+            return
+        req.released = True
+        if req.adapter_id is not None and self.adapters is not None:
+            try:
+                self.adapters.unpin(req.adapter_id)
+            except Exception:
+                pass        # pool rebuilt under us (checkpoint swap)
+        if self.tenancy is not None and (req.quota_blocks
+                                         or req.quota_tokens):
+            self.tenancy.release(req.tenant, req.quota_blocks,
+                                 req.quota_tokens)
+            req.quota_blocks = req.quota_tokens = 0
+
     def _shed(self, req, kind: str) -> None:
+        self._release_req_resources(req)
         if req.future.cancelled():      # cancelled first: nothing to tell
             self.metrics.count_cancelled()
             return
         self.metrics.count_deadline()
+        tenant = getattr(req, "tenant", None)
+        if tenant is not None:
+            self.metrics.count_labeled("tenant_sheds", "tenant", tenant)
+            if self.tenancy is not None:
+                self.tenancy.note_shed(tenant)
         waited = (time.monotonic() - req.times.submitted) * 1e3
         timeout = ((req.deadline - req.times.submitted) * 1e3
                    if req.deadline is not None else float("inf"))
@@ -1343,6 +1556,7 @@ class ServingEngine:
         if req.future.set_running_or_notify_cancel():
             req.claimed = True
             return True
+        self._release_req_resources(req)
         self.metrics.count_cancelled()
         return False
 
@@ -1404,6 +1618,7 @@ class ServingEngine:
         # inserted) — fail everything the device currently owns and reset
         # the pool; queued work is untouched and keeps serving
         for req in self._inflight_admit:
+            self._release_req_resources(req)
             self._fail_req(req, ReplicaFailed(
                 "error", replica=self.replica_id,
                 generation=self.generation, phase="admitted",
@@ -1412,6 +1627,7 @@ class ServingEngine:
         self._inflight_admit = []
         if self.pool is not None:
             for slot, req in list(self._slot_req.items()):
+                self._release_req_resources(req)
                 self._fail_req(req, ReplicaFailed(
                     "error", replica=self.replica_id,
                     generation=self.generation, phase="in_slot",
@@ -1473,6 +1689,7 @@ class ServingEngine:
         # in-slot + mid-admission work already touched the device (and may
         # have streamed tokens): not salvageable, fail with the record
         for req in self._inflight_admit:
+            self._release_req_resources(req)
             self._fail_req(req, ReplicaFailed(
                 kind, replica=self.replica_id, generation=self.generation,
                 phase="admitted", emitted=getattr(req, "emitted", 0),
@@ -1480,6 +1697,7 @@ class ServingEngine:
         self._inflight_admit = []
         if self.pool is not None:
             for req in self._slot_req.values():
+                self._release_req_resources(req)
                 self._fail_req(req, ReplicaFailed(
                     kind, replica=self.replica_id,
                     generation=self.generation, phase="in_slot",
@@ -1494,10 +1712,18 @@ class ServingEngine:
             for req in expired:
                 self._shed(req, kind_)
             for req in drained:
+                self._release_req_resources(req)
                 if req.future.cancelled():
                     self.metrics.count_cancelled()
                 elif req.future.done():
                     pass
+                elif getattr(req, "adapter_id", None) is not None:
+                    # adapter slot + salt are REPLICA-LOCAL (the sibling
+                    # may not hold this adapter at all): not salvageable
+                    self._fail_req(req, ReplicaFailed(
+                        kind, replica=self.replica_id,
+                        generation=self.generation, phase="queued",
+                        forensics=failure.forensics))
                 else:
                     salvage.append((kind_, req))
         handed_off = False
@@ -1559,6 +1785,7 @@ class ServingEngine:
                     self.tracer.instant(f"pool.{key}", "pool", tid="pool",
                                         args={"n": delta})
             self._pool_stats_seen[key] = val
+        self._sync_adapter_counters()
         gauges = pool.gauges()
         if self._tracing:
             free = gauges.get("blocks_free", 0.0)
@@ -1572,6 +1799,22 @@ class ServingEngine:
         if self._draft_pool is not None:
             gauges["spec_k_effective"] = float(self._spec_k_eff)
         self.metrics.set_gauges(gauges)
+
+    def _sync_adapter_counters(self) -> None:
+        """Mirror the adapter pool's monotonic counters into the engine
+        metrics (same delta discipline as the block-pool stats — a pool
+        rebuild rebases instead of rolling counters back)."""
+        ad = self.adapters
+        if ad is None:
+            return
+        for key, val in (("adapter_loads", ad.loads),
+                         ("adapter_evictions", ad.evictions),
+                         ("adapter_pins", ad.pin_events)):
+            seen = self._pool_stats_seen.get(key, 0)
+            delta = val - seen if val >= seen else val
+            if delta > 0:
+                self.metrics.count(key, delta)
+            self._pool_stats_seen[key] = val
 
     def _preempt_batch_for_interactive(self) -> bool:
         """Admission-side lane contract: an interactive head under block or
@@ -1652,7 +1895,9 @@ class ServingEngine:
                 worked = True
                 continue
             try:
-                row, hit = pool.admit(eff, ns, lane=lane)
+                row, hit = pool.admit(eff, ns, lane=lane,
+                                      adapter_slot=req.adapter_slot,
+                                      salt=req.salt)
             except OutOfBlocks:
                 # overcommitted budget met a physically empty pool —
                 # admit() unwound cleanly; head-of-line waits for releases
@@ -2131,6 +2376,7 @@ class ServingEngine:
         return True
 
     def _finish_lm(self, req: _LMRequest) -> None:
+        self._release_req_resources(req)
         req.times.done = time.monotonic()
         t = req.times
         gen_s = max(t.done - t.first_output, 1e-9)
@@ -2138,10 +2384,23 @@ class ServingEngine:
                             t.done, tokens=req.num_steps, lane=req.lane,
                             trace_id=req.trace_id or "")
         self.metrics.record(rec)
+        if req.tenant is not None:
+            self.metrics.count_labeled("tenant_requests", "tenant",
+                                       req.tenant)
+            self.metrics.count_labeled("tenant_tokens", "tenant",
+                                       req.tenant, req.num_steps)
+            if self.tenancy is not None:
+                self.tenancy.note_completed(req.tenant, req.num_steps)
         if self._telemetry and req.lane != "batch":
             self.telem.observe("serve.ttft_ms", rec.ttft_ms)
             self.telem.observe("serve.queue_ms", rec.queue_ms)
             self.telem.observe("serve.total_ms", rec.total_ms)
+            if req.tenant is not None:
+                # the tenant-attributed SLO feed: tenant_objectives()
+                # builds one burn-rate objective per tenant over THIS
+                # signal, so a tenant's surge pages as their degradation
+                self.telem.observe(
+                    f"serve.tenant.{req.tenant}.ttft_ms", rec.ttft_ms)
         if self._tracing:
             self._trace_req(req, "decode", t.first_output, t.done,
                             tokens=req.num_steps, ticks=req.ticks,

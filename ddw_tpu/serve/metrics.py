@@ -189,6 +189,19 @@ class EngineMetrics:
         self.handoff_ms = 0          # accumulated wall-ms of the handoff
         #                            stage (1-step prefill + export +
         #                            import) — ÷ handoffs = per-handoff cost
+        # multi-tenant serving (ddw_tpu.serve.tenancy / .adapters). The
+        # aggregates below are plain counters; the per-tenant breakdown
+        # lives in _labeled cells keyed (family, label, value) and renders
+        # as ddw_serve_<family>_total{<label>="<value>"} beside the
+        # unlabeled fleet total. count_labeled() bumps BOTH in one call so
+        # the aggregate is always the sum of its cells.
+        self.tenant_requests = 0   # requests completed, attributed by tenant
+        self.tenant_tokens = 0     # generated tokens, attributed by tenant
+        self.tenant_sheds = 0      # sheds (overload/deadline/quota) by tenant
+        self.adapter_loads = 0     # LoRA adapters landed in the pool
+        self.adapter_evictions = 0  # idle adapters LRU-evicted from slots
+        self.adapter_pins = 0      # adapter pin events (request → slot)
+        self._labeled: dict[tuple[str, str, str], float] = {}
         self._gauges: dict[str, float] = {}  # live block-pool state, pushed
         #                            by the engine loop (free/used blocks...)
         self._first_admit: float | None = None
@@ -286,6 +299,24 @@ class EngineMetrics:
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
 
+    def count_labeled(self, field: str, label: str, value: str,
+                      n: int = 1) -> None:
+        """Bump a labeled cell AND its unlabeled aggregate in one call —
+        ``count_labeled("tenant_sheds", "tenant", "acme")`` keeps
+        ``tenant_sheds`` equal to the sum over its cells by construction.
+        ``field`` must be a :data:`_COUNTER_HELP` counter."""
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+            key = (field, label, str(value))
+            self._labeled[key] = self._labeled.get(key, 0.0) + n
+
+    def labeled_view(self) -> dict[tuple[str, str, str], float]:
+        """Every labeled cell in one read: ``{(family, label, value): n}`` —
+        the per-tenant attribution feed (load_gen cross-checks its offline
+        recount against this via ``/stats``)."""
+        with self._lock:
+            return dict(self._labeled)
+
     def set_gauges(self, gauges: dict[str, float]) -> None:
         """Replace the live gauge set (block-pool free/used/resident state,
         pushed by the engine loop each tick). Gauges render as
@@ -343,7 +374,15 @@ class EngineMetrics:
                 "serve.kv_bytes_migrated": float(self.kv_bytes_migrated),
                 "serve.handoffs": float(self.handoffs),
                 "serve.handoff_ms": float(self.handoff_ms),
+                "serve.tenant_requests": float(self.tenant_requests),
+                "serve.tenant_tokens": float(self.tenant_tokens),
+                "serve.tenant_sheds": float(self.tenant_sheds),
+                "serve.adapter_loads": float(self.adapter_loads),
+                "serve.adapter_evictions": float(self.adapter_evictions),
+                "serve.adapter_pins": float(self.adapter_pins),
             }
+            for (fam, label, value), v in sorted(self._labeled.items()):
+                out[f'serve.{fam}{{{label}="{value}"}}'] = float(v)
             looked = self.prefix_hit_blocks + self.prefix_miss_blocks
             out["serve.prefix_hit_rate"] = (
                 self.prefix_hit_blocks / looked if looked else 0.0)
@@ -536,6 +575,16 @@ _COUNTER_HELP = (
     ("handoff_ms", "Accumulated wall-ms of the handoff stage (1-step "
      "prefill + block export + import); divide by handoffs for the "
      "per-handoff cost."),
+    ("tenant_requests", "Requests completed, attributed per tenant (the "
+     "unlabeled series is the fleet total; tenant=... cells break it "
+     "down)."),
+    ("tenant_tokens", "Generated LM tokens attributed per tenant."),
+    ("tenant_sheds", "Requests shed (overload, deadline, or quota) "
+     "attributed to the tenant that lost them."),
+    ("adapter_loads", "LoRA adapters landed in the serving adapter pool."),
+    ("adapter_evictions", "Idle LoRA adapters LRU-evicted from pool slots."),
+    ("adapter_pins", "Adapter pin events (a request bound an adapter slot "
+     "for its decode lifetime)."),
 )
 _HISTOGRAMS = ("queue_ms", "ttft_ms", "total_ms")
 
@@ -580,6 +629,8 @@ def merge_metrics(metrics_list) -> "EngineMetrics":
                     out._hist_max[key] = m._hist_max[key]
             for name, val in m._gauges.items():
                 out._gauges[name] = out._gauges.get(name, 0.0) + val
+            for key, val in m._labeled.items():
+                out._labeled[key] = out._labeled.get(key, 0.0) + val
             if m._first_admit is not None:
                 out._first_admit = (m._first_admit if out._first_admit is None
                                     else min(out._first_admit, m._first_admit))
@@ -602,12 +653,15 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
              for name in _HISTOGRAMS}
     hist_sums = {name: 0.0 for name in _HISTOGRAMS}
     pool_gauges: dict[str, float] = {}
+    labeled: dict[tuple[str, str, str], float] = {}
     first, last = None, None
     for m in metrics_list:
         with m._lock:
             recs.extend(m._records)
             for name, _ in _COUNTER_HELP:
                 counters[name] += float(getattr(m, name))
+            for key, val in m._labeled.items():
+                labeled[key] = labeled.get(key, 0.0) + val
             for (name, lane), counts in m._hists.items():
                 dst = hists[name]
                 for i, c in enumerate(counts):
@@ -629,6 +683,11 @@ def render_prometheus(metrics_list, extra_gauges: dict[str, float] | None
         full = f"ddw_serve_{name}_total"
         lines += [f"# HELP {full} {help_}", f"# TYPE {full} counter",
                   f"{full} {counters[name]:g}"]
+        # per-label breakdown cells ride under the same family (the
+        # unlabeled series above is their fleet-summed total)
+        for (fam, label, value), val in sorted(labeled.items()):
+            if fam == name:
+                lines.append(f'{full}{{{label}="{value}"}} {val:g}')
     tps = (tokens / (last - first)
            if tokens and last is not None and last > first else 0.0)
     lines += ["# HELP ddw_serve_tokens_per_sec Aggregate decode throughput "

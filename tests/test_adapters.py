@@ -1,0 +1,592 @@
+"""Multi-tenant serving (ddw_tpu.serve.adapters / .tenancy): hot-swappable
+LoRA adapters + heterogeneous-adapter batched decode + per-tenant QoS.
+
+The tentpole pins, all on the 8-fake-CPU-device backend:
+
+- **heterogeneous batch identity** (THE acceptance pin): one decode batch
+  holding two DIFFERENT adapters plus a base-model row produces, per row,
+  exactly the tokens each would produce served alone — greedy AND seeded —
+  where "alone" is the sequential ``generate`` over the merged-LoRA params
+  (adapter rows) / the base package (null row, slot 0, delta exactly +0.0);
+- **pool discipline**: refcounted pin-while-in-flight, LRU eviction of
+  unpinned adapters only, digest-keyed identity (same id + different bytes
+  is refused, torn files are refused), ``AdapterPoolFull`` when every slot
+  is pinned, unpin-underflow is an error;
+- **adapter-salted prefix cache**: the same prompt under two different
+  adapters (or base) NEVER cross-hits — chain hashes are seeded with the
+  adapter digest, so cross-adapter KV reuse is structurally impossible,
+  while a same-adapter repeat still hits its own salted chain;
+- **tenancy**: quota charges are all-or-nothing at submit and released on
+  every completion path; the batch lane's stride scheduler gives a
+  weight-3 tenant exactly 3x the picks of a weight-1 tenant under
+  contention; ``tenant_objectives`` names carry the tenant id so a noisy
+  tenant's burn pages as THEIR degradation;
+- **gateway staging**: /admin/adapters loads are staged per-replica with a
+  shadow probe and roll back fleet-wide on any failure; adapter churn and
+  weight deploys never interleave (409 under the deploy lock);
+- **no leaks**: hot load/evict cycles under live traffic return every
+  block, slot, and pin to baseline.
+
+The QoS isolation drill under real concurrent load lives in
+``tools/load_gen.py --tenants`` (live /stats vs offline recount); heavier
+identity sweeps (preemption, spec decode) ride tier-2 below.
+"""
+
+import dataclasses
+import zlib
+
+import jax
+import numpy as np
+import pytest
+
+from ddw_tpu.models.lm import build_lm, generate
+from ddw_tpu.models.lora import merge_base_params
+from ddw_tpu.serve import BlockPool, EngineCfg, ServingEngine
+from ddw_tpu.serve.adapters import (
+    AdapterDigestMismatch,
+    AdapterError,
+    AdapterPool,
+    AdapterPoolFull,
+    UnknownAdapter,
+    adapter_digest,
+    extract_adapter,
+    load_adapter,
+    save_adapter,
+)
+from ddw_tpu.serve.tenancy import (
+    QuotaExceeded,
+    TenancyController,
+    TenantAwareAdmission,
+    TenantSpec,
+    tenant_objectives,
+)
+from ddw_tpu.serving.lm_package import load_lm_package, save_lm_package
+from ddw_tpu.utils.config import LMCfg
+
+VOCAB = 64
+TARGETS = ("query", "value", "fc1")
+
+
+def _lm_pkg(out_dir, seed=0, **cfg_kw):
+    kw = dict(vocab_size=VOCAB, max_len=96, hidden=32, depth=2, num_heads=2,
+              mlp_dim=64, dropout=0.0, dtype="float32")
+    kw.update(cfg_kw)
+    cfg = LMCfg(**kw)
+    model = build_lm(cfg)
+    params = model.init({"params": jax.random.PRNGKey(seed)},
+                        np.zeros((1, 8), np.int32))["params"]
+    d = save_lm_package(str(out_dir), cfg, params, quantize=None)
+    return load_lm_package(d)
+
+
+@pytest.fixture(scope="module")
+def pm(tmp_path_factory):
+    return _lm_pkg(tmp_path_factory.mktemp("adapter_pkg") / "pkg")
+
+
+def _rand_b(node, seed, path=()):
+    """Randomize every lora_b leaf (deterministically, per path) so the
+    adapter's delta is far from zero — at init lora_b IS zero and the
+    adapted function equals the base, which would make identity vacuous."""
+    if isinstance(node, dict):
+        return {k: _rand_b(v, seed, path + (k,)) for k, v in node.items()}
+    if path and path[-1] == "lora_b":
+        k = jax.random.fold_in(jax.random.PRNGKey(seed),
+                               zlib.crc32("/".join(path).encode()))
+        return 2.0 * jax.random.normal(k, node.shape, node.dtype)
+    return node
+
+
+@pytest.fixture(scope="module")
+def lora(pm):
+    """(lora_model, {name: (merged_lparams, adapter_tree)}) — two adapters
+    with genuinely different weights over the package's backbone. The
+    merged params are the sequential reference each adapter row must
+    reproduce through the batched engine."""
+    lcfg = dataclasses.replace(pm.lm_cfg, lora_rank=2, lora_alpha=4.0,
+                               lora_targets=TARGETS)
+    lmodel = build_lm(lcfg)
+    out = {}
+    for name, seed in (("fin", 1), ("legal", 2)):
+        lparams = lmodel.init({"params": jax.random.PRNGKey(seed)},
+                              np.zeros((1, 8), np.int32))["params"]
+        lparams = _rand_b(merge_base_params(lparams, pm.params), seed)
+        out[name] = (lparams, extract_adapter(lparams))
+    return lmodel, out
+
+
+@pytest.fixture(scope="module")
+def aeng(pm, lora):
+    """One shared adapter-pooled engine (both adapters resident, tenants
+    configured) — the compiled prefill/decode programs amortize across the
+    identity / salting / quota tests below (all their asserts are
+    per-request or monotone, so sharing only ever helps)."""
+    _, ads = lora
+    cfg = EngineCfg(n_slots=4, steps_per_tick=2, default_timeout_s=600.0,
+                    adapter_slots=2, adapter_rank=4,
+                    tenants=({"name": "acme", "weight": 2.0},
+                             {"name": "noisy", "token_quota": 12}))
+    with ServingEngine(lm=pm, cfg=cfg) as e:
+        for name, (_, ad) in ads.items():
+            e.load_adapter(name, adapter=ad, alpha=4.0, rank=2)
+        yield e
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _ref(lmodel, lparams, p, n, rng=None, temperature=0.0):
+    return np.asarray(generate(lmodel, lparams, p[None, :], n, rng,
+                               temperature))[0]
+
+
+def _pool_clean(pool: BlockPool) -> None:
+    g = pool.gauges()
+    assert g["resident_streams"] == 0
+    assert g["blocks_used"] == 0, g
+    assert g["blocks_free"] + g["blocks_cached"] == g["blocks_total"], g
+    assert int(pool._ref.sum()) == 0
+    assert pool._committed == 0
+    assert pool.free_slots == pool.max_resident
+
+
+# -- AdapterPool unit surface ------------------------------------------------
+
+def test_pool_pin_refcounts_lru_eviction_and_refusals(pm, lora):
+    """Slots evict LRU among UNPINNED adapters only; a fully-pinned pool
+    refuses new loads; unload refuses while pinned; pin/unpin keep exact
+    refcounts (underflow is an error, unknown ids are UnknownAdapter)."""
+    _, ads = lora
+    fin, legal = ads["fin"][1], ads["legal"][1]
+    pool = AdapterPool(pm.model, slots=2, rank=2, targets=TARGETS)
+    assert pool.load("fin", fin, alpha=4.0) == 1
+    assert pool.load("legal", legal, alpha=4.0) == 2
+    assert pool.load("fin", fin, alpha=4.0) == 1     # idempotent re-land
+    assert pool.loads == 2
+    # the idempotent re-land touched fin, so legal is now LRU
+    assert pool.lru_order() == ("legal", "fin")
+    assert pool.pin("legal") == 2                     # pin refreshes LRU
+    assert pool.lru_order() == ("fin", "legal")
+    pool.pin("fin")
+    with pytest.raises(AdapterPoolFull):
+        pool.load("third", fin, alpha=4.0)            # every slot pinned
+    with pytest.raises(AdapterError, match="pins"):
+        pool.unload("fin")                            # in-flight: refused
+    pool.unpin("fin")
+    slot = pool.load("third", fin, alpha=4.0)         # evicts fin (LRU,
+    assert slot == 1                                  # unpinned), reuses
+    assert pool.evictions == 1                        # its slot
+    assert pool.loaded() == ("legal", "third")
+    assert pool.pins_of("legal") == 1
+    with pytest.raises(UnknownAdapter) as ei:
+        pool.pin("fin")
+    assert ei.value.adapter_id == "fin"
+    assert set(ei.value.loaded) == {"legal", "third"}
+    pool.unpin("legal")
+    pool.unpin("fin")                                 # post-evict unpin: noop
+    with pytest.raises(AdapterError, match="underflow"):
+        pool.unpin("legal")
+    g = pool.gauges()
+    assert g["serve.adapter.pins_inflight"] == 0
+    assert g["serve.adapter.slots_used"] == 2
+
+
+def test_digest_identity_and_package_roundtrip(pm, lora, tmp_path):
+    """An id is its bytes: re-loading the same id with different content is
+    refused (silent swap would corrupt the salted prefix cache), a wrong
+    supplied digest is refused, and a tampered package file is refused at
+    read — while the honest roundtrip preserves leaves and header."""
+    _, ads = lora
+    fin, legal = ads["fin"][1], ads["legal"][1]
+    path = str(tmp_path / "fin.npz")
+    dg = save_adapter(path, fin, rank=2, alpha=4.0, meta={"v": 1})
+    assert dg == adapter_digest(fin)
+    back, info = load_adapter(path)
+    assert info["digest"] == dg and info["rank"] == 2
+    assert info["alpha"] == 4.0 and info["meta"] == {"v": 1}
+    for block in fin:
+        for tgt in fin[block]:
+            for leaf in ("lora_a", "lora_b"):
+                np.testing.assert_array_equal(fin[block][tgt][leaf],
+                                              back[block][tgt][leaf])
+    pool = AdapterPool(pm.model, slots=2, rank=2, targets=TARGETS)
+    pool.load("fin", fin, alpha=4.0)
+    with pytest.raises(AdapterDigestMismatch):
+        pool.load("fin", legal, alpha=4.0)           # same id, new bytes
+    with pytest.raises(AdapterDigestMismatch):
+        pool.load("legal", legal, alpha=4.0, digest="0" * 64)
+    assert pool.digest_of("fin") == dg
+    assert pool.salt_of("fin") == bytes.fromhex(dg)
+    # torn/tampered file: flip one leaf, keep the recorded header
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    victim = next(k for k in arrays if k.endswith("lora_b"))
+    arrays[victim] = arrays[victim] + 1.0
+    np.savez(path, **arrays)
+    with pytest.raises(AdapterDigestMismatch):
+        load_adapter(path)
+
+
+def test_stride_scheduler_weighted_fair_share():
+    """Under contention the batch lane drains tenants by virtual-time
+    stride: weight 3 gets exactly 3 of every 4 picks against weight 1
+    (equal per-request cost), and priority tiers drain strictly first."""
+
+    class _Req:
+        def __init__(self, tenant):
+            self.tenant = tenant
+            self.fair_cost = 1.0
+            self.deadline = None
+            self.claimed = False
+
+    tc = TenancyController([TenantSpec("heavy", weight=3.0),
+                            TenantSpec("light", weight=1.0),
+                            TenantSpec("vip", weight=1.0, priority=-1)])
+    adm = TenantAwareAdmission(64, tc)
+    for _ in range(12):
+        adm.offer("lm_batch", _Req("heavy"))
+        adm.offer("lm_batch", _Req("light"))
+    adm.offer("lm_batch", _Req("vip"))
+    picks = [adm.take("lm_batch", 1)[0][0].tenant for _ in range(13)]
+    assert picks[0] == "vip"                       # lower tier drains first
+    window = picks[1:13]
+    assert window.count("heavy") == 9 and window.count("light") == 3, picks
+    assert adm.depth("lm_batch") == 12
+
+
+def test_quota_charge_is_all_or_nothing_and_released():
+    tc = TenancyController([TenantSpec("t", token_quota=10, block_quota=4)])
+    assert tc.charge("t", 2, 6) == "t"
+    with pytest.raises(QuotaExceeded) as ei:
+        tc.charge("t", 1, 6)                       # tokens would overflow
+    e = ei.value
+    assert (e.tenant, e.resource, e.used, e.quota) == ("t", "tokens", 6, 10)
+    assert e.to_dict()["error"] == "quota_exceeded"
+    v = tc.view()["t"]
+    assert (v["blocks_held"], v["tokens_held"]) == (2, 6)   # nothing charged
+    tc.release("t", 2, 6)
+    assert tc.charge("t", 4, 10) == "t"            # full headroom is back
+    assert tc.view()["t"]["sheds"] == 0
+
+
+# -- heterogeneous batched decode: token identity ----------------------------
+
+def test_heterogeneous_batch_token_identity_greedy_and_seeded(aeng, pm,
+                                                              lora):
+    """THE acceptance pin: one decode batch holding fin + legal + two base
+    rows reproduces, per row, exactly what each request produces alone —
+    greedy and seeded — against the sequential merged-LoRA / base-package
+    references. Slot 0's null adapter keeps base rows bit-identical to an
+    adapter-free engine by construction (delta is exactly +0.0)."""
+    lmodel, ads = lora
+    p0, p1, p2, p3 = _prompts([9, 14, 17, 11], seed=3)
+    refs = [pm.generate(p0[None, :], 8)[0],
+            _ref(lmodel, ads["fin"][0], p1, 8),
+            _ref(lmodel, ads["legal"][0], p2, 8),
+            pm.generate(p3[None, :], 8)[0]]
+    futs = [aeng.submit_generate(p0, 8),
+            aeng.submit_generate(p1, 8, adapter_id="fin", tenant="acme"),
+            aeng.submit_generate(p2, 8, adapter_id="legal", tenant="acme"),
+            aeng.submit_generate(p3, 8)]
+    for i, f in enumerate(futs):
+        assert np.array_equal(f.result(timeout=120).tokens, refs[i]), i
+    # the adapters genuinely steered their rows
+    assert not np.array_equal(refs[1], _ref(lmodel, ads["legal"][0], p1, 8))
+    # seeded sampling: the per-request key schedule is adapter-agnostic
+    key = jax.random.PRNGKey(11)
+    sref = [_ref(lmodel, ads["fin"][0], p1, 8, key, 0.7),
+            np.asarray(pm.generate(p3[None, :], 8, rng=key,
+                                   temperature=0.7))[0]]
+    futs = [aeng.submit_generate(p1, 8, adapter_id="fin", tenant="acme",
+                                 rng=key, temperature=0.7),
+            aeng.submit_generate(p3, 8, rng=key, temperature=0.7)]
+    assert np.array_equal(futs[0].result(timeout=120).tokens, sref[0])
+    assert np.array_equal(futs[1].result(timeout=120).tokens, sref[1])
+    # every pin returned with its request
+    assert aeng.adapters.gauges()["serve.adapter.pins_inflight"] == 0
+    _pool_clean(aeng.pool)
+
+
+def test_adapter_salted_prefix_never_cross_hits(aeng):
+    """The same prompt under base, fin, and legal must never share KV: the
+    chain hash is seeded with the adapter digest, so the three runs build
+    three disjoint cache lineages. A same-adapter repeat still hits its
+    OWN salted chain — salting isolates tenants, not reuse."""
+    (p,) = _prompts([32], seed=4)
+
+    def hits():
+        return aeng.snapshot()["serve.prefix_hit_tokens"]
+
+    aeng.generate(p, 4)                               # seeds base chains
+    h0 = hits()
+    aeng.generate(p, 4, adapter_id="fin", tenant="acme")
+    assert hits() == h0                               # no base->fin hit
+    aeng.generate(p, 4, adapter_id="legal", tenant="acme")
+    assert hits() == h0                               # no fin->legal hit
+    aeng.generate(p, 4, adapter_id="fin", tenant="acme")
+    assert hits() > h0                                # own salted chain hits
+    h1 = hits()
+    aeng.generate(p, 4)                               # base still hits base
+    assert hits() > h1
+    _pool_clean(aeng.pool)
+
+
+def test_unknown_adapter_and_quota_refusals_release_everything(aeng):
+    """A request naming an unknown adapter is refused at submit as a
+    client error; a tenant at its token quota sheds with a structured,
+    tenant-tagged QuotaExceeded while other tenants admit normally — and
+    every refusal path leaves zero pins and zero charges behind."""
+    (p,) = _prompts([8], seed=5)
+    with pytest.raises(UnknownAdapter) as ei:
+        aeng.submit_generate(p, 4, adapter_id="nope")
+    assert ei.value.adapter_id == "nope"
+    assert set(ei.value.loaded) == {"fin", "legal"}
+    # noisy's quota is 12 in-flight tokens: 8 charge fine, 8 more shed
+    f1 = aeng.submit_generate(p, 8, tenant="noisy", adapter_id="fin")
+    shed = None
+    try:
+        f2 = aeng.submit_generate(p, 8, tenant="noisy")
+    except QuotaExceeded as e:
+        shed = e
+    else:                     # f1 finished before the second submit: still
+        f2.result(timeout=120)                    # a valid (if rare) run
+    f1.result(timeout=120)
+    if shed is not None:
+        assert shed.tenant == "noisy" and shed.resource == "tokens"
+        snap = aeng.snapshot()
+        assert snap['serve.tenant_sheds{tenant="noisy"}'] >= 1
+    # charges released on completion: the full quota admits again
+    aeng.generate(p, 8, tenant="noisy")
+    assert aeng.adapters.gauges()["serve.adapter.pins_inflight"] == 0
+    assert aeng.tenancy.view()["noisy"]["tokens_held"] == 0
+    snap = aeng.snapshot()
+    assert snap['serve.tenant_requests{tenant="noisy"}'] >= 2
+    assert snap["serve.adapter_pins"] >= 1
+
+
+# -- hot churn: no leaks -----------------------------------------------------
+
+def test_hot_load_evict_cycles_leak_nothing(pm, lora):
+    """Load -> serve -> unload cycles (explicit and LRU-evicted) across a
+    1-slot pool return every block, slot, and pin to baseline, with the
+    churn visible in the engine counters."""
+    _, ads = lora
+    fin, legal = ads["fin"][1], ads["legal"][1]
+    cfg = EngineCfg(n_slots=2, steps_per_tick=2, default_timeout_s=600.0,
+                    adapter_slots=1, adapter_rank=2)
+    (p,) = _prompts([10], seed=6)
+    with ServingEngine(lm=pm, cfg=cfg) as eng:
+        for _ in range(2):
+            eng.load_adapter("fin", adapter=fin, alpha=4.0, rank=2)
+            eng.generate(p, 4, adapter_id="fin")
+            eng.unload_adapter("fin")                  # explicit evict
+            eng.load_adapter("legal", adapter=legal, alpha=4.0, rank=2)
+            eng.generate(p, 4, adapter_id="legal")
+            eng.load_adapter("fin", adapter=fin, alpha=4.0, rank=2)
+            # ^ 1 slot: LRU-evicts legal in place
+        snap = eng.snapshot()
+        g = eng.adapters.gauges()
+        view = eng.adapter_view()
+        _pool_clean(eng.pool)
+    assert snap["serve.adapter_loads"] == 5.0
+    # ^ 5, not 6: cycle 2's first "load fin" finds fin already resident
+    #   (it LRU-evicted legal at the end of cycle 1) — an idempotent
+    #   re-land, not a load
+    assert snap["serve.adapter_evictions"] == 2.0      # the LRU ones only
+    assert snap["serve.adapter_pins"] == 4.0
+    assert g["serve.adapter.pins_inflight"] == 0
+    assert g["serve.adapter.slots_used"] == 1          # fin resident
+    assert list(view["adapters"]) == ["fin"]
+
+
+# -- identity through the hard paths (tier-2 sweeps) -------------------------
+
+@pytest.mark.slow   # tier-1 budget: base-path preemption identity keeps
+#                     its tier-1 rep in test_paged_kv.py::test_out_of_
+#                     blocks_preemption_resumes_token_identically; this
+#                     adapters-resident variant rides tier-2
+def test_preemption_identity_with_adapter_rows_in_flight(pm, lora):
+    """Out-of-blocks preemption with an adapter row IN the batch: every
+    row (adapted and base) resumes bit-identically, the preempted rows'
+    pins survive recompute, nothing leaks."""
+    lmodel, ads = lora
+    prompts = _prompts([30, 31, 33, 34], seed=17)
+    steps = 40
+    refs = [pm.generate(p[None, :], steps)[0] for p in prompts[:2]]
+    refs += [_ref(lmodel, ads["fin"][0], prompts[2], steps),
+             _ref(lmodel, ads["legal"][0], prompts[3], steps)]
+    cfg = EngineCfg(n_slots=2, steps_per_tick=4, kv_cache_blocks=12,
+                    max_resident=4, block_overcommit=3.0,
+                    adapter_slots=2, adapter_rank=2,
+                    default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg) as eng:
+        eng.load_adapter("fin", adapter=ads["fin"][1], alpha=4.0, rank=2)
+        eng.load_adapter("legal", adapter=ads["legal"][1], alpha=4.0,
+                         rank=2)
+        futs = [eng.submit_generate(prompts[0], steps),
+                eng.submit_generate(prompts[1], steps),
+                eng.submit_generate(prompts[2], steps, adapter_id="fin"),
+                eng.submit_generate(prompts[3], steps, adapter_id="legal")]
+        out = [f.result(timeout=300) for f in futs]
+        snap = eng.snapshot()
+        assert eng.adapters.gauges()["serve.adapter.pins_inflight"] == 0
+        _pool_clean(eng.pool)
+    assert snap["serve.preemptions"] > 0, "overcommit never ran out"
+    for j, (r, ref) in enumerate(zip(out, refs)):
+        assert np.array_equal(r.tokens, ref), j
+
+
+@pytest.mark.slow   # tier-1 budget: spec-decode identity keeps its tier-1
+#                     rep in test_spec_engine.py::test_greedy_spec_on_bit_
+#                     identical_to_spec_off; the adapters-in-the-verify-
+#                     tick variant rides tier-2
+def test_spec_decode_identity_with_adapter_rows(pm, lora, tmp_path_factory):
+    """Speculative decode with adapter rows in the verify tick: the
+    adapter's stacks ride the draft/verify programs as call arguments, so
+    a low-agreement draft changes latency only, never content — for
+    adapted AND base rows in the same batch."""
+    lmodel, ads = lora
+    dm = _lm_pkg(tmp_path_factory.mktemp("spec_draft") / "pkg", seed=7)
+    prompts = _prompts([5, 17, 9], seed=2)
+    refs = [pm.generate(prompts[0][None, :], 6)[0],
+            _ref(lmodel, ads["fin"][0], prompts[1], 9),
+            _ref(lmodel, ads["legal"][0], prompts[2], 7)]
+    cfg = EngineCfg(n_slots=3, steps_per_tick=2, spec_k=3,
+                    decode_buckets=False, adapter_slots=2, adapter_rank=2,
+                    default_timeout_s=600.0)
+    with ServingEngine(lm=pm, cfg=cfg, draft=dm) as eng:
+        eng.load_adapter("fin", adapter=ads["fin"][1], alpha=4.0, rank=2)
+        eng.load_adapter("legal", adapter=ads["legal"][1], alpha=4.0,
+                         rank=2)
+        futs = [eng.submit_generate(prompts[0], 6),
+                eng.submit_generate(prompts[1], 9, adapter_id="fin"),
+                eng.submit_generate(prompts[2], 7, adapter_id="legal")]
+        for i, f in enumerate(futs):
+            assert np.array_equal(f.result(timeout=120).tokens, refs[i]), i
+        snap = eng.snapshot()
+        _pool_clean(eng.pool)
+        _pool_clean(eng._draft_pool)
+    assert snap["serve.spec_proposed"] > 0
+
+
+# -- gateway: staged fleet load, rollback, deploy-lock fences ---------------
+
+@pytest.mark.slow   # tier-1 budget: the gateway admin plane's happy path
+#                     is tier-1-pinned by tools/load_gen.py --tenants (CI
+#                     smoke) and test_load_gen; the rollback/409 failure
+#                     drills ride tier-2
+def test_gateway_staged_load_rollback_and_deploy_fence(pm, lora, tmp_path):
+    """A staged /admin/adapters load onto a fleet where one replica cannot
+    take the adapter rolls back EVERYWHERE (no half-resident fleet); under
+    an active deploy the endpoint 409s; on a healthy fleet the load lands,
+    salted routing turns on, and unload drops the registry entry."""
+    from ddw_tpu.gateway.client import GatewayClient, GatewayError
+    from ddw_tpu.gateway.http import Gateway
+
+    _, ads = lora
+    apath = str(tmp_path / "fin.npz")
+    dg = save_adapter(apath, ads["fin"][1], rank=2, alpha=4.0)
+    cfg_a = EngineCfg(n_slots=2, steps_per_tick=2, default_timeout_s=600.0,
+                      adapter_slots=2, adapter_rank=2)
+    cfg_none = dataclasses.replace(cfg_a, adapter_slots=0)
+    engines = [ServingEngine(lm=pm, cfg=cfg_a),
+               ServingEngine(lm=pm, cfg=cfg_none)]   # cannot take adapters
+    gw = Gateway(engines, grace_s=60.0, supervise=False)
+    gw.start(warmup_prompt_lens=(8,))
+    cli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+    try:
+        with pytest.raises(GatewayError) as ei:
+            cli.adapters(op="load", adapter_id="fin", path=apath)
+        assert ei.value.status == 500
+        assert ei.value.body["error"] == "stage_failed"
+        assert ei.value.body["status"] == "rolled_back"
+        # replica 0 took it and gave it back: the fleet stays uniform
+        assert engines[0].adapter_view()["adapters"] == {}
+        assert "fin" not in gw.replica_set.adapter_digests
+        with pytest.raises(GatewayError):
+            cli.generate([1, 2, 3, 4], 2, adapter_id="fin")
+    finally:
+        gw.stop()
+    # healthy single-replica fleet: staged load lands + deploy fence 409s
+    eng = ServingEngine(lm=pm, cfg=cfg_a)
+    gw = Gateway(eng, grace_s=60.0, supervise=False)
+    gw.start(warmup_prompt_lens=(8,))
+    cli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+    try:
+        out = cli.adapters(op="load", adapter_id="fin", path=apath)
+        assert out["status"] == "loaded" and out["digest"] == dg
+        assert gw.replica_set.adapter_digests["fin"] == dg
+        view = cli.adapters(op="list")
+        assert view["registry"]["fin"] == dg
+        assert "fin" in view["replicas"]["0"]["adapters"]
+        with gw._deploy_lock:
+            gw.deploy_status["deploying"] = True
+        with pytest.raises(GatewayError) as ei:
+            cli.adapters(op="load", adapter_id="other", path=apath)
+        assert ei.value.status == 409
+        assert ei.value.body["error"] == "deploy_in_progress"
+        with gw._deploy_lock:
+            gw.deploy_status["deploying"] = False
+        r = cli.generate([1, 2, 3, 4], 2, adapter_id="fin")
+        assert len(r["tokens"]) == 2
+        out = cli.adapters(op="unload", adapter_id="fin")
+        assert out["status"] == "unloaded"
+        assert cli.adapters(op="list")["registry"] == {}
+        ops = [o["op"] + ":" + o["status"]
+               for o in cli.stats()["adapters"]["ops"]]
+        assert ops == ["load:loaded", "unload:unloaded"]
+        # ^ the 409'd load never reached the fleet, so it never journals
+    finally:
+        gw.stop()
+
+
+@pytest.mark.slow   # tier-1 budget: the live QoS attribution drill (real
+#                     concurrency, telemetry sampler sleeps) — its tier-1
+#                     rep is the --tenants load_gen smoke's exact live-vs-
+#                     offline counter cross-check
+def test_tenant_slo_attribution_noisy_pages_quiet_holds(pm):
+    """Per-tenant objectives attribute burn to the RIGHT tenant: an
+    impossible TTFT objective on the noisy tenant accrues bad events under
+    its own name (``tenant:noisy:ttft``) while the quiet tenant's
+    objective holds perfect attainment over the same run — a noisy
+    tenant's surge pages as THEIR degradation, not the fleet's."""
+    import time as _time
+
+    from ddw_tpu.gateway.client import GatewayClient
+    from ddw_tpu.gateway.http import Gateway
+
+    specs = [TenantSpec("quiet", ttft_slo_ms=60_000.0, slo_target=0.9),
+             TenantSpec("noisy", token_quota=64, ttft_slo_ms=0.0,
+                        slo_target=0.9)]
+    objs = tenant_objectives(specs)
+    assert [o.name for o in objs] == ["tenant:quiet:ttft",
+                                      "tenant:noisy:ttft"]
+    cfg = EngineCfg(n_slots=4, steps_per_tick=4, telemetry=True,
+                    telemetry_interval_s=0.05, default_timeout_s=600.0,
+                    tenants=tuple(s.to_dict() for s in specs))
+    gw = Gateway(ServingEngine(lm=pm, cfg=cfg), grace_s=60.0,
+                 supervise=False, telemetry=True, telemetry_interval_s=0.05,
+                 slos=objs)
+    gw.start(warmup_prompt_lens=(8,))
+    cli = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+    try:
+        for p in _prompts([8, 9, 10, 11], seed=8):
+            cli.generate(p, 4, tenant="quiet")
+            cli.generate(p, 4, tenant="noisy")
+        _time.sleep(0.4)     # > 2 sampler+merge intervals
+        st = cli.stats()
+    finally:
+        gw.stop()
+    objectives = st["slo"]["objectives"]
+    quiet = objectives["tenant:quiet:ttft"]["budget"]
+    noisy = objectives["tenant:noisy:ttft"]["budget"]
+    assert quiet["events_total"] >= 4 and quiet["events_bad"] == 0
+    assert quiet["attainment"] == 1.0
+    assert noisy["events_bad"] == noisy["events_total"] >= 4
+    assert noisy["attainment"] == 0.0
+    # per-tenant counters attribute the traffic, not just the burn
+    assert st['serve.tenant_requests{tenant="quiet"}'] == 4.0
+    assert st['serve.tenant_requests{tenant="noisy"}'] == 4.0

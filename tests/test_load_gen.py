@@ -164,6 +164,33 @@ def test_load_gen_trace_arm_covers_every_request_once(tmp_path):
     assert any(n.startswith("replica") for n in names)
 
 
+def test_load_gen_tenants_arm_attributes_noisy_sheds():
+    """The multi-tenant QoS pin (tier-2; tests/test_adapters.py carries
+    the tier-1 unit/identity representatives): skewed adapter traffic
+    from two quiet tenants plus a quota-saturating noisy one — quiet
+    tenants complete everything with zero sheds, every 429 names the
+    noisy tenant, and the gateway's live per-tenant /stats counters
+    equal the clients' own offline ledger exactly. The arm's own
+    DDW_BENCH_SMOKE assertions enforce all of that; this test pins the
+    wire contract on top."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/load_gen.py"),
+         "--tenants"],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])["tenants"]
+    assert d["errors"] == []
+    assert d["ledger"]["acme"]["shed"] == 0
+    assert d["ledger"]["beta"]["shed"] == 0
+    assert d["ledger"]["noisy"]["shed"] >= 1
+    assert d["sheds_attributed"] == d["ledger"]["noisy"]["shed"]
+    for t, row in d["ledger"].items():
+        assert d["live"][t]["ok"] == row["ok"], t
+        assert d["live"][t]["shed"] == row["shed"], t
+    assert d["adapter_loads"] == 2.0
+    assert d["adapters_resident"] == ["fin", "legal"]
+
+
 def test_load_gen_refuses_cpu_fallback():
     env = dict(_env(), DDW_REQUIRE_TPU="1")
     out = subprocess.run(

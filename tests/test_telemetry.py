@@ -542,7 +542,9 @@ def test_every_incremented_counter_is_exported_and_registered():
                 "ddw_tpu/deploy", "ddw_tpu/autoscale"):
         srcs += glob.glob(os.path.join(root, pkg, "*.py"))
     assert srcs
-    count_re = re.compile(r'\.count\(\s*"([a-z0-9_]+)"')
+    # count_labeled sites increment the same aggregate attr as count, so
+    # both spellings feed the landscape
+    count_re = re.compile(r'\.count(?:_labeled)?\(\s*"([a-z0-9_]+)"')
     method_re = re.compile(r"\.count_(overloaded|deadline|cancelled)\(")
     stats_re = re.compile(r'self\.stats\["([a-z0-9_]+)"\]')
     method_map = {"overloaded": "shed_overloaded",
@@ -556,13 +558,22 @@ def test_every_incremented_counter_is_exported_and_registered():
         if path.endswith("blocks.py"):
             # BlockPool.stats keys mirror into engine counters each tick
             names.update(stats_re.findall(text))
+        if path.endswith("engine.py"):
+            # AdapterPool counters mirror through _sync_adapter_counters'
+            # (key, value) table — the key is a literal, the count() call
+            # takes it as a variable
+            names.update(re.findall(r'\("(adapter_[a-z0-9_]+)", ad\.',
+                                    text))
     # regex sanity: the landscape must include the known landmarks
     assert {"prefills", "decode_ticks", "shed_overloaded",
             "routed_cache_hit", "warm_replays",
             "prefix_hit_tokens", "tp_dispatches",
             "canary_promoted", "canary_rejected", "surge_spawns",
             "journal_resumes", "scale_outs", "scale_ins",
-            "autoscale_blocked"} <= names
+            "autoscale_blocked",
+            "tenant_requests", "tenant_tokens", "tenant_sheds",
+            "adapter_loads", "adapter_evictions",
+            "adapter_pins"} <= names
     reg = signal_registry()
     exposition = render_prometheus([EngineMetrics()])
     for name in sorted(names):

@@ -1128,6 +1128,160 @@ def slo_arm(prompt_len=12, steps=12, requests=24, n_slots=4, clients=4,
     return out
 
 
+def tenants_arm(prompt_len=12, steps=8, n_slots=4, steps_per_tick=4,
+                hidden=32, depth=2, quiet_requests=10, noisy_requests=12,
+                noisy_clients=3):
+    """Multi-tenant QoS drill — the tenancy plane's accounting pin.
+
+    Self-hosts a 1-replica fleet with an adapter pool, two hot-loaded LoRA
+    adapters with skewed popularity (the quiet tenants split them 80/20),
+    and one NOISY tenant whose token quota only admits a single in-flight
+    request — its own concurrency sheds it. Every client keeps its own
+    ledger of completions and quota-429s per tenant, then the arm
+    cross-checks the gateway's live ``/stats`` per-tenant counters against
+    that offline recount EXACTLY:
+
+    - every shed is attributed to the noisy tenant (the 429 body names
+      it; no quiet tenant ever sheds),
+    - quiet tenants complete everything — the noisy tenant's saturation
+      never leaks into their lane,
+    - per-tenant request/shed counters and the adapter load counter match
+      the client-side ledger.
+    """
+    import dataclasses
+    import tempfile
+
+    import jax
+
+    from serving_curve import _make_lm_pkg
+
+    from ddw_tpu.gateway import Gateway, GatewayClient
+    from ddw_tpu.gateway.client import GatewayError, GatewayOverloaded
+    from ddw_tpu.models.lm import build_lm
+    from ddw_tpu.serve import EngineCfg, ServingEngine
+    from ddw_tpu.serve.adapters import extract_adapter, save_adapter
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pm = _make_lm_pkg(tmp, "tenantsarm", hidden, depth, 2, 64, 96,
+                          dtype="float32")
+        # two adapters over the package's own backbone (zero-delta a/b
+        # randomized so the rows really differ from base)
+        lcfg = dataclasses.replace(pm.lm_cfg, lora_rank=2, lora_alpha=4.0,
+                                   lora_targets=("query", "fc1"))
+        lmodel = build_lm(lcfg)
+        paths = {}
+        for k, name in enumerate(("fin", "legal")):
+            lparams = lmodel.init({"params": jax.random.PRNGKey(10 + k)},
+                                  np.zeros((1, 8), np.int32))["params"]
+            ad = extract_adapter(lparams)
+            rng = np.random.RandomState(20 + k)
+            for block in ad.values():
+                for tgt in block.values():
+                    tgt["lora_b"] = rng.standard_normal(
+                        tgt["lora_b"].shape).astype(tgt["lora_b"].dtype)
+            paths[name] = os.path.join(tmp, f"{name}.npz")
+            save_adapter(paths[name], ad, alpha=4.0, rank=2)
+        specs = ({"name": "acme", "weight": 2.0},
+                 {"name": "beta"},
+                 # quota admits ONE noisy request's tokens at a time:
+                 # its second concurrent submission sheds on arrival
+                 {"name": "noisy", "token_quota": steps})
+        cfg = EngineCfg(n_slots=n_slots, steps_per_tick=steps_per_tick,
+                        adapter_slots=2, adapter_rank=2,
+                        tenants=specs, queue_depth=256,
+                        default_timeout_s=600.0)
+        gw = Gateway(ServingEngine(lm=pm, cfg=cfg), grace_s=60.0,
+                     supervise=False)
+        gw.start(warmup_prompt_lens=(prompt_len,))
+        admin = GatewayClient("127.0.0.1", gw.port, max_retries=0)
+        for name, path in paths.items():
+            assert admin.adapters(op="load", adapter_id=name,
+                                  path=path)["status"] == "loaded"
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, 64, size=(prompt_len,)).astype(np.int32)
+                   for _ in range(quiet_requests)]
+        lock = threading.Lock()
+        ledger = {"acme": {"ok": 0, "shed": 0}, "beta": {"ok": 0, "shed": 0},
+                  "noisy": {"ok": 0, "shed": 0}}
+        shed_bodies, errors = [], []
+
+        def run_one(cli, tenant, p, adapter_id=None):
+            try:
+                cli.generate(p, steps, tenant=tenant, adapter_id=adapter_id)
+                with lock:
+                    ledger[tenant]["ok"] += 1
+            except GatewayOverloaded as e:
+                with lock:
+                    ledger[tenant]["shed"] += 1
+                    shed_bodies.append(e.body)
+            except GatewayError as e:
+                with lock:
+                    errors.append((tenant, repr(e)))
+
+        def quiet_worker(tenant):
+            # skewed adapter popularity: 80% of this tenant's requests ride
+            # its primary adapter, the rest the other one
+            primary = "fin" if tenant == "acme" else "legal"
+            other = "legal" if tenant == "acme" else "fin"
+            cli = _client(gw.url, 0)
+            for i, p in enumerate(prompts):
+                run_one(cli, tenant, p, primary if i % 5 else other)
+
+        def noisy_worker(n):
+            cli = _client(gw.url, 0)
+            for i in range(n):
+                run_one(cli, "noisy", prompts[i % len(prompts)])
+
+        per_noisy = noisy_requests // noisy_clients
+        threads = ([threading.Thread(target=quiet_worker, args=(t,))
+                    for t in ("acme", "beta")]
+                   + [threading.Thread(target=noisy_worker,
+                                       args=(per_noisy,))
+                      for _ in range(noisy_clients)])
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            st = admin.stats()
+        finally:
+            gw.stop()
+    live = {t: {"ok": st.get(f'serve.tenant_requests{{tenant="{t}"}}', 0.0),
+                "shed": st.get(f'serve.tenant_sheds{{tenant="{t}"}}', 0.0)}
+            for t in ledger}
+    out = {"ledger": ledger, "live": live, "errors": errors,
+           "sheds_attributed": sum(1 for b in shed_bodies
+                                   if b.get("tenant") == "noisy"),
+           "adapter_loads": st.get("serve.adapter_loads", 0.0),
+           "adapters_resident": sorted(
+               (st.get("adapters", {}).get("registry") or {}))}
+    print(f"[load_gen] tenants arm: quiet "
+          f"{ledger['acme']['ok']}+{ledger['beta']['ok']} ok / 0 shed "
+          f"wanted, noisy {ledger['noisy']['ok']} ok "
+          f"{ledger['noisy']['shed']} shed, live counters {live}",
+          file=sys.stderr, flush=True)
+    if SMOKE:
+        assert not errors, out
+        # quiet tenants never shed; the noisy tenant's own concurrency did
+        assert ledger["acme"]["shed"] == 0 and ledger["beta"]["shed"] == 0, \
+            out
+        assert ledger["acme"]["ok"] == ledger["beta"]["ok"] \
+            == len(prompts), out
+        assert ledger["noisy"]["shed"] >= 1, out
+        assert ledger["noisy"]["ok"] + ledger["noisy"]["shed"] \
+            == per_noisy * noisy_clients, out
+        # every 429 body names the noisy tenant — attribution, not just
+        # counting
+        assert out["sheds_attributed"] == len(shed_bodies) > 0, out
+        # live /stats vs the offline recount: exact, per tenant
+        for t, row in ledger.items():
+            assert live[t]["ok"] == row["ok"], (t, out)
+            assert live[t]["shed"] == row["shed"], (t, out)
+        assert out["adapter_loads"] == 2.0, out
+        assert out["adapters_resident"] == ["fin", "legal"], out
+    return out
+
+
 def autoscale_arm(prompt_len=12, steps=8, n_slots=2, steps_per_tick=4,
                   hidden=32, depth=1, clients=10, max_replicas=3,
                   load_deadline_s=150.0, settle_deadline_s=60.0):
@@ -1370,6 +1524,14 @@ def main():
                          "attainment (/stats error budget) matches the "
                          "offline recount over the same server-reported "
                          "TTFTs within one event")
+    ap.add_argument("--tenants", action="store_true",
+                    help="self-hosted multi-tenant QoS arm: two hot-loaded "
+                         "adapters with skewed popularity + one noisy "
+                         "tenant saturating its token quota; asserts the "
+                         "noisy tenant's sheds are attributed to IT while "
+                         "quiet tenants complete everything, and the live "
+                         "/stats per-tenant counters match the client-side "
+                         "recount exactly")
     args = ap.parse_args()
 
     if args.url:
@@ -1419,6 +1581,9 @@ def main():
     elif args.slo:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "slo": slo_arm()}
+    elif args.tenants:
+        result = {"device": {"kind": kind, "n": jax.device_count()},
+                  "tenants": tenants_arm()}
     elif args.batch:
         result = {"device": {"kind": kind, "n": jax.device_count()},
                   "batch": batch_arm()}
