@@ -38,7 +38,13 @@ resume.
 Resharding restore: a requested device slice is assembled from every saved
 shard that overlaps it, so a state saved on one mesh (say ``{'data': 8}``)
 restores onto a different one (``{'data': 4}``, or different axis splits)
-without any intermediate full array.
+without any intermediate full array. This same reader is the LIVE recovery
+path for elastic shrink (docs/fault_tolerance.md "Shrink recovery"): an
+N-process checkpoint restores onto the N−1 survivors — each reads whatever
+slices its new mesh assigns it out of all N saved shard files — and the
+``proc_bytes`` completeness audit runs at the new size (the index records
+the *saving* world's process count, so a torn N-way dir quarantines no
+matter who reads it).
 
 Async writes: :func:`save_sharded` is the synchronous composition of
 :func:`snapshot_shards` (host copy at the chain boundary — donation-safe)
@@ -97,6 +103,22 @@ def _flat_with_paths(tree):
     return [(jax.tree_util.keystr(p), leaf) for p, leaf in flat]
 
 
+def _process_topology() -> tuple[int, int]:
+    """(pid, nproc) for the commit protocol. Elastic gangs skip
+    ``jax.distributed`` (every member sees ``jax.process_index() == 0``),
+    so there the rendezvous context supplies the generation-aware identity
+    — after a shrink, saves commit with the N−1 world's marker count and
+    the writer/quarantine election follows the remapped rank 0."""
+    if jax.process_count() > 1:
+        return jax.process_index(), jax.process_count()
+    from ddw_tpu.runtime.elastic import context
+
+    ctx = context()
+    if ctx is not None and ctx.world_size > 0:
+        return ctx.rank, ctx.world_size
+    return 0, 1
+
+
 def _start_offsets(index, shape) -> list[int]:
     """Global start offset per dim of a shard's index (tuple of slices)."""
     return [int(sl.indices(dim)[0]) for sl, dim in zip(index, shape)]
@@ -123,8 +145,7 @@ class ShardSnapshot:
 def snapshot_shards(state) -> ShardSnapshot:
     """Synchronously copy this process's shards (replica 0 only, so
     replicated leaves are written once) to host memory."""
-    pid = jax.process_index()
-    nproc = jax.process_count()
+    pid, nproc = _process_topology()
     entries: list[dict] = []
     leaves_meta: dict[str, dict] = {}
     blobs: list[bytes] = []
@@ -328,7 +349,7 @@ def latest_complete_step(ckpt_dir: str) -> int | None:
     for s in sorted(_list_steps(ckpt_dir), reverse=True):
         if _sharded_step_complete(ckpt_dir, s):
             return s
-        if jax.process_index() == 0:
+        if _process_topology()[0] == 0:
             _quarantine_step(ckpt_dir, s)
     return None
 
@@ -351,7 +372,7 @@ def restore_sharded(ckpt_dir: str, target, shardings, step: int | None = None):
             return target, None
     elif not _sharded_step_complete(ckpt_dir, step):
         quarantined = (_quarantine_step(ckpt_dir, step)
-                       if jax.process_index() == 0 else None)
+                       if _process_topology()[0] == 0 else None)
         raise FileNotFoundError(
             f"sharded checkpoint step {step} in {ckpt_dir} is missing or torn"
             + (f" (quarantined to {quarantined})" if quarantined else "")

@@ -268,7 +268,8 @@ class ShardedLoader:
         shards = list(table.shard_paths)
         if len(shards) >= shard_count:
             # Shard-level selection (petastorm semantics): disjoint round-robin.
-            self._my_shards = shards[cur_shard::shard_count]
+            plan = self.shard_plan(len(shards), shard_count)
+            self._my_shards = [shards[i] for i in plan[cur_shard]]
             self._record_stride = None
         else:
             # Fewer shards than workers: fall back to record-level modulo sharding
@@ -277,6 +278,19 @@ class ShardedLoader:
             # enough shards, this keeps small tables correct).
             self._my_shards = shards
             self._record_stride = (cur_shard, shard_count)
+
+    @staticmethod
+    def shard_plan(n_shards: int, shard_count: int) -> list[list[int]]:
+        """Round-robin assignment of ``n_shards`` table shards to
+        ``shard_count`` workers: worker ``r`` owns shard indices
+        ``range(r, n_shards, shard_count)``. The plan is a partition — every
+        shard index appears in exactly one worker's list — which is what makes
+        an elastic shrink (re-deriving loaders at world size N−1) cover every
+        sample exactly once per epoch: the N−1 plan re-partitions the same
+        shard set, leaving no shard orphaned on the evicted rank."""
+        if shard_count < 1:
+            raise ValueError(f"shard_count must be >= 1, got {shard_count}")
+        return [list(range(r, n_shards, shard_count)) for r in range(shard_count)]
 
     # -- sizing ----------------------------------------------------------------
     @property

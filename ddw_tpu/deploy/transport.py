@@ -40,6 +40,10 @@ The contract every driver honors (ProcessReplica's assumptions):
                  child's death; signalling it ends the child)
 ``read_file(p)`` the port-file handshake read; raises ``OSError`` (or
                  ``FileNotFoundError``) while the file does not exist yet
+``probe(...)``   host liveness check (bounded by ``timeout_s``): True when
+                 the target machine is reachable. The elastic launcher's
+                 permanent-loss verdict uses it to distinguish a crashed
+                 rank (respawn) from a lost host (shrink).
 ==============  ============================================================
 """
 
@@ -124,6 +128,10 @@ class LocalExecTransport:
     def read_file(self, path: str) -> str:
         with open(path) as f:
             return f.read()
+
+    def probe(self, timeout_s: float = 5.0) -> bool:
+        """Host liveness: this machine is running this code."""
+        return True
 
     def describe(self) -> dict:
         return {"driver": self.name, "staging_root": self.staging_root,
@@ -218,6 +226,18 @@ class SSHTransport:
         if out.returncode != 0:
             raise FileNotFoundError(path)
         return out.stdout.decode()
+
+    def probe(self, timeout_s: float = 5.0) -> bool:
+        """Host liveness: can an SSH session still reach the box? The
+        elastic launcher's permanent-loss verdict calls this before choosing
+        shrink over respawn — an unreachable host means its rank is gone for
+        good, not merely crashed."""
+        try:
+            out = self._run(list(self.ssh) + [self._target(), "true"],
+                            timeout_s=timeout_s)
+        except (OSError, subprocess.SubprocessError):
+            return False
+        return out.returncode == 0
 
     def describe(self) -> dict:
         return {"driver": self.name, "host": self._target(),

@@ -85,8 +85,13 @@ def main() -> int:
         # Elastic gang: membership/barrier/reduce live in the explicit
         # rendezvous object; jax.distributed stays out (its coordination
         # service cannot re-admit a respawned process id). Each process
-        # keeps its own local CPU/TPU devices for jitted compute.
+        # keeps its own local CPU/TPU devices for jitted compute. Under
+        # DDW_ELASTIC_JAX_DIST=1 the gang ALSO forms a real jax.distributed
+        # world, torn down and re-formed per generation on the generation's
+        # fresh coordinator port (global-mesh trainers survive rank loss).
+        from ddw_tpu.runtime.elastic import maybe_reinit_distributed
         rdzv.announce()
+        maybe_reinit_distributed()
     else:
         try:
             initialize_distributed()  # reads DDW_COORDINATOR / DDW_NUM_PROCESSES / DDW_PROCESS_ID
@@ -131,8 +136,13 @@ def main() -> int:
             # generation and re-run the fn IN THIS PROCESS — it restores
             # from the latest durable checkpoint exactly as a whole-world
             # restart would, but the pid/imports/compiled programs survive.
+            # A shrink record remaps this rank's identity inside advance();
+            # a jax.distributed gang then re-forms on the generation's
+            # fresh coordinator port.
+            from ddw_tpu.runtime.elastic import maybe_reinit_distributed
             rdzv.advance(e.generation)
             rdzv.announce()
+            maybe_reinit_distributed()
             continue
         except Preempted as e:
             # Graceful preemption: the step loop already checkpointed. A
@@ -149,15 +159,25 @@ def main() -> int:
                 # preempting peer, not an application bug — exit as
                 # preempted so the restart stays outside the crash budget.
                 status = ("preempted", {"step": None})
-            elif rdzv is not None and rdzv.recovery_pending() is not None:
+            elif rdzv is not None:
                 # Collateral of a dead peer (a sync aborted under it while
                 # recovery was being posted): park via the elastic path
                 # instead of dying — consuming the pending record bounds
-                # this to one re-run per generation.
-                rec = rdzv.recovery_pending()
-                rdzv.advance(int(rec["generation"]))
-                rdzv.announce()
-                continue
+                # this to one re-run per generation. The same vote/commit-
+                # aware check as a parked barrier, so a survivor never
+                # adopts a shrink record it vetoed or one the driver has
+                # not committed.
+                err = traceback.format_exc()
+                try:
+                    rdzv._check_recovery(None)
+                except ElasticRestart as e2:
+                    from ddw_tpu.runtime.elastic import (
+                        maybe_reinit_distributed)
+                    rdzv.advance(e2.generation)
+                    rdzv.announce()
+                    maybe_reinit_distributed()
+                    continue
+                status = ("error", err)
             else:
                 status = ("error", traceback.format_exc())
         break

@@ -37,10 +37,27 @@ and makes the rendezvous/reduce topology an **explicit object** instead:
   ``GangError`` — the supervisor's existing restart-from-checkpoint loop
   engages unchanged.
 
+Recovery records come in three kinds. ``respawn`` (PR 6) re-forms the gang
+at the *same* world size after the dead rank is restarted. ``shrink``
+re-forms it at N−1: the driver judges a member permanently lost (respawn
+budget exhausted, host unreachable via the ``deploy/transport`` probe, or an
+explicit ``host_lost`` fault), proposes a **contiguous rank assignment** for
+the survivors plus a fresh coordinator port, and every survivor votes
+(ack/veto) before adopting — a veto pins the proposal and the driver retries
+at a bumped generation or falls back to whole-world restart. ``grow`` is the
+inverse: a healthy host rejoins at the next generation boundary and the gang
+re-expands N−1→N through the same record/adopt machinery (no vote — growth
+never strands anyone's state). :meth:`GangRendezvous.advance` applies the
+record's assignment, so membership (``rank``/``world_size`` and therefore
+every ``range(self.world_size)`` barrier/reduce scan) is generation-aware:
+a post-shrink reduce never waits on an evicted rank's part file.
+
 Layout of the control directory (``DDW_RENDEZVOUS_DIR``)::
 
     member_g<gen>_r<rank>.json   # membership: pid + start time, per generation
     recover_g<gen>.json          # driver-posted recovery record -> generation g
+    vote_g<gen>_r<rank>.json     # survivor ack/veto of a shrink record
+    commit_g<gen>                # driver's commit of a unanimously-acked shrink
     arrive_g<gen>_<tag>_r<rank>  # barrier arrival markers
     reduce_g<gen>_<tag>_r<rank>.json  # host all-reduce contributions
 
@@ -61,7 +78,7 @@ import numpy as np
 
 __all__ = ["GangRendezvous", "ElasticRestart", "elastic_enabled", "context",
            "reset_context", "maybe_elastic_restart", "elastic_barrier",
-           "host_all_reduce"]
+           "host_all_reduce", "process_topology", "maybe_reinit_distributed"]
 
 
 class ElasticRestart(Exception):
@@ -109,6 +126,8 @@ class GangRendezvous:
         self.rank = int(rank)
         self.generation = int(generation)
         self.poll_s = poll_s
+        self._votes: dict[int, str] = {}     # generation -> "ack" | "veto"
+        self._vote_ordinal = 0               # per-process count of votes cast
         os.makedirs(root, exist_ok=True)
 
     # -- membership ----------------------------------------------------------
@@ -132,17 +151,161 @@ class GangRendezvous:
             return None
 
     # -- recovery ledger -----------------------------------------------------
-    def post_recovery(self, generation: int, dead_rank: int,
+    def post_recovery(self, generation: int, dead_rank: int | None,
                       exit_code: int | None = None,
-                      reason: str = "rank-death") -> dict:
+                      reason: str = "rank-death", kind: str = "respawn",
+                      assignment: dict | None = None,
+                      world_size: int | None = None,
+                      coordinator: str | None = None) -> dict:
         """Driver side: publish 'the gang re-forms at ``generation``'.
-        Idempotent per generation (one recovery record per bump)."""
-        record = {"generation": int(generation), "dead_rank": int(dead_rank),
-                  "exit_code": exit_code, "reason": reason,
-                  "world_size": self.world_size, "posted_unix": time.time()}
+        Idempotent per generation (one recovery record per bump).
+
+        ``kind`` is ``respawn`` (same world, dead rank restarted),
+        ``shrink`` (``assignment`` maps each survivor's *current* rank to
+        its new contiguous rank and ``world_size`` names the reduced size),
+        or ``grow`` (identity assignment, world grows by one). Shrink/grow
+        records also carry a fresh ``coordinator`` address so gangs running
+        a real ``jax.distributed`` world can re-initialize per generation
+        (the coordination service admits each process id exactly once, so a
+        re-formed world needs a fresh port)."""
+        record = {"generation": int(generation),
+                  "dead_rank": None if dead_rank is None else int(dead_rank),
+                  "exit_code": exit_code, "reason": reason, "kind": kind,
+                  "world_size": int(self.world_size if world_size is None
+                                    else world_size),
+                  "posted_unix": time.time()}
+        if assignment is not None:
+            record["assignment"] = {str(k): int(v)
+                                    for k, v in assignment.items()}
+        if coordinator is not None:
+            record["coordinator"] = coordinator
         _atomic_write_json(
             os.path.join(self.root, f"recover_g{generation}.json"), record)
         return record
+
+    def post_shrink(self, generation: int, dead_rank: int,
+                    assignment: dict, world_size: int,
+                    exit_code: int | None = None,
+                    coordinator: str | None = None,
+                    reason: str = "host-lost") -> dict:
+        """Driver side: propose re-forming the gang WITHOUT ``dead_rank`` at
+        the reduced ``world_size``. Survivors vote (:meth:`wait_votes`)
+        before the driver commits the eviction."""
+        return self.post_recovery(generation, dead_rank, exit_code=exit_code,
+                                  reason=reason, kind="shrink",
+                                  assignment=assignment,
+                                  world_size=world_size,
+                                  coordinator=coordinator)
+
+    def post_grow(self, generation: int, current_ranks: list[int],
+                  world_size: int, coordinator: str | None = None,
+                  reason: str = "regrow") -> dict:
+        """Driver side: re-expand the gang to ``world_size`` (a new rank is
+        being spawned at ``world_size - 1``). Identity assignment for the
+        incumbents; no vote — growth never strands anyone's state."""
+        return self.post_recovery(
+            generation, None, reason=reason, kind="grow",
+            assignment={str(r): int(r) for r in current_ranks},
+            world_size=world_size, coordinator=coordinator)
+
+    def commit_recovery(self, generation: int) -> None:
+        """Driver side: commit a voted shrink record. Survivors adopt a
+        shrink only after this marker lands (two-phase), so a proposal the
+        driver abandons — veto, vote timeout — strands nobody halfway into
+        a world that never forms."""
+        _atomic_write_json(
+            os.path.join(self.root, f"commit_g{generation}"),
+            {"generation": int(generation), "committed_unix": time.time()})
+
+    def recovery_committed(self, generation: int) -> bool:
+        return os.path.exists(
+            os.path.join(self.root, f"commit_g{generation}"))
+
+    def record_for(self, generation: int) -> dict | None:
+        """The recovery record that created ``generation``, or None (gen 0
+        has no record — it is the spawn-time world)."""
+        path = os.path.join(self.root, f"recover_g{generation}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def coordinator_for(self, generation: int) -> str | None:
+        """Coordinator address for ``generation``: the record's fresh port,
+        or the spawn-time ``DDW_COORDINATOR`` for generation 0 / records
+        that did not rotate the port."""
+        rec = self.record_for(generation)
+        if rec is not None and rec.get("coordinator"):
+            return rec["coordinator"]
+        return os.environ.get("DDW_COORDINATOR") or None
+
+    # -- shrink voting -------------------------------------------------------
+    def _cast_vote(self, record: dict) -> str:
+        """Survivor side: ack or veto a shrink record, exactly once per
+        generation (memoized + durable vote file). The ``shrink_veto``
+        fault arm hooks the ``shrink_vote`` site with ``step`` equal to the
+        per-process vote ordinal, so ``shrink_veto:rank=0`` vetoes only the
+        first proposal this process ever votes on (the retry then acks)."""
+        gen = int(record["generation"])
+        if gen in self._votes:
+            return self._votes[gen]
+        ordinal = self._vote_ordinal
+        self._vote_ordinal += 1
+        vote = "ack"
+        try:
+            from ddw_tpu.runtime.faults import ShrinkVeto, maybe_fault
+            try:
+                maybe_fault("shrink_vote", step=ordinal)
+            except ShrinkVeto:
+                vote = "veto"
+        except ImportError:     # pragma: no cover - faults always present
+            pass
+        _atomic_write_json(
+            os.path.join(self.root, f"vote_g{gen}_r{self.rank}.json"),
+            {"vote": vote, "rank": self.rank, "pid": os.getpid(),
+             "ordinal": ordinal, "voted_unix": time.time()})
+        self._votes[gen] = vote
+        return vote
+
+    def read_votes(self, generation: int) -> dict[int, str]:
+        """Driver side: rank -> "ack"/"veto" votes cast so far for the
+        shrink record at ``generation`` (keyed by pre-shrink ranks)."""
+        votes: dict[int, str] = {}
+        prefix = f"vote_g{generation}_r"
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return votes
+        for name in names:
+            if not (name.startswith(prefix) and name.endswith(".json")):
+                continue
+            try:
+                rank = int(name[len(prefix):-len(".json")])
+                with open(os.path.join(self.root, name)) as f:
+                    votes[rank] = json.load(f).get("vote", "ack")
+            except (OSError, ValueError):
+                continue
+        return votes
+
+    def wait_votes(self, generation: int, ranks: list[int],
+                   timeout_s: float = 30.0) -> dict[int, str] | None:
+        """Driver side: park until every survivor in ``ranks`` voted on the
+        shrink record at ``generation`` (or any veto arrives — a single
+        veto decides immediately). None on timeout: a survivor that cannot
+        vote cannot adopt either, so the driver falls back to whole-world
+        restart."""
+        deadline = time.monotonic() + timeout_s
+        want = set(int(r) for r in ranks)
+        while True:
+            votes = self.read_votes(generation)
+            if any(v == "veto" for r, v in votes.items() if r in want):
+                return votes
+            if want.issubset(votes.keys()):
+                return votes
+            if time.monotonic() > deadline:
+                return None
+            time.sleep(self.poll_s)
 
     def recovery_pending(self) -> dict | None:
         """The newest recovery record addressing a generation beyond this
@@ -182,16 +345,46 @@ class GangRendezvous:
 
     def advance(self, generation: int) -> None:
         """Adopt a new generation (after catching :class:`ElasticRestart`).
-        Also mirrors it into ``DDW_ELASTIC_GEN`` so env-keyed machinery
+        Mirrors it into ``DDW_ELASTIC_GEN`` so env-keyed machinery
         (fault-injection ``egen`` matching) sees the survivor's true
-        generation, not its spawn-time one."""
+        generation, not its spawn-time one. A shrink/grow record's rank
+        ``assignment`` and ``world_size`` are applied here — membership is
+        generation-aware, so every subsequent ``range(self.world_size)``
+        barrier/reduce scan covers exactly the re-formed gang and never
+        waits on an evicted rank's part file. The remapped rank/world are
+        mirrored into ``DDW_PROCESS_ID``/``DDW_NUM_PROCESSES`` so the
+        result-writer gate, checkpoint writer election and fault matching
+        all follow the survivor's new identity."""
+        rec = self.record_for(int(generation))
+        if rec is not None and rec.get("assignment") is not None:
+            new_rank = rec["assignment"].get(str(self.rank))
+            if new_rank is None:
+                raise RuntimeError(
+                    f"rank {self.rank} was evicted by the recovery record "
+                    f"at generation {generation}; it cannot adopt it")
+            self.rank = int(new_rank)
+            self.world_size = int(rec.get("world_size", self.world_size))
+            os.environ["DDW_PROCESS_ID"] = str(self.rank)
+            os.environ["DDW_NUM_PROCESSES"] = str(self.world_size)
         self.generation = int(generation)
         os.environ["DDW_ELASTIC_GEN"] = str(generation)
 
     def _check_recovery(self, step: int | None = None) -> None:
         rec = self.recovery_pending()
-        if rec is not None:
-            raise ElasticRestart(int(rec["generation"]), rec, step=step)
+        if rec is None:
+            return
+        if rec.get("kind") == "shrink" and rec.get("assignment") is not None:
+            gen = int(rec["generation"])
+            if rec["assignment"].get(str(self.rank)) is None:
+                # Evicted by this record (a zombie the driver gave up on):
+                # adopting would be wrong, parking forever is worse. Raise;
+                # advance() refuses and the worker exits via its error path.
+                raise ElasticRestart(gen, rec, step=step)
+            if self._cast_vote(rec) == "veto":
+                return      # pinned: keep parking until a retry supersedes it
+            if not self.recovery_committed(gen):
+                return      # voted ack; adopt only once the driver commits
+        raise ElasticRestart(int(rec["generation"]), rec, step=step)
 
     # -- barrier -------------------------------------------------------------
     def barrier(self, tag, timeout_s: float = 120.0) -> None:
@@ -372,3 +565,63 @@ def host_all_reduce(tag, value, op: str = "sum", timeout_s: float = 120.0):
         arr = np.asarray(value, np.float64)
         return arr if op in ("sum", "mean") else None
     return ctx.all_reduce(tag, value, op=op, timeout_s=timeout_s)
+
+
+def process_topology() -> tuple[int, int]:
+    """``(rank, world_size)`` of this process in the *current* generation.
+
+    The one topology query data sharding and writer election should use:
+    a real multi-process ``jax.distributed`` world wins (its mesh IS the
+    topology); otherwise the elastic rendezvous context supplies the
+    generation-aware rank/world (elastic workers skip ``jax.distributed``,
+    so ``jax.process_count()`` is 1 in every member); otherwise a world of
+    one. After a shrink, :meth:`GangRendezvous.advance` has already
+    remapped the context, so loaders/trainers that re-enter their fn pick
+    up the N−1 topology with no further plumbing."""
+    import jax
+    if jax.process_count() > 1:
+        return jax.process_index(), jax.process_count()
+    ctx = context()
+    if ctx is not None and ctx.world_size > 0:
+        return ctx.rank, ctx.world_size
+    return 0, 1
+
+
+def maybe_reinit_distributed() -> bool:
+    """Re-initialize ``jax.distributed`` for the current elastic generation
+    on the generation's fresh coordinator port. Opt-in via
+    ``DDW_ELASTIC_JAX_DIST=1``: elastic workers normally skip
+    ``jax.distributed`` entirely (host-level topology only), but a gang
+    that wants a real global mesh can tear the coordination service down
+    and re-form it each generation — this is what lets global-mesh
+    trainers survive single-rank loss, since the service admits each
+    process id exactly once per incarnation. Returns True when a (re)init
+    happened. Best-effort: on failure the gang still has its host-level
+    topology and the whole-world fallback."""
+    if os.environ.get("DDW_ELASTIC_JAX_DIST", "") not in ("1", "true"):
+        return False
+    ctx = context()
+    if ctx is None or ctx.world_size < 2:
+        return False
+    coord = ctx.coordinator_for(ctx.generation)
+    if not coord:
+        return False
+    import jax
+
+    from ddw_tpu.runtime.mesh import initialize_distributed
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass        # not initialized yet (generation 0) — nothing to tear down
+    try:
+        initialize_distributed(coordinator_address=coord,
+                               num_processes=ctx.world_size,
+                               process_id=ctx.rank)
+    except Exception:
+        return False
+    try:        # jax.distributed.initialize replaces signal dispositions
+        from ddw_tpu.runtime.faults import install_preemption_handler
+        install_preemption_handler()
+    except Exception:
+        pass
+    return True

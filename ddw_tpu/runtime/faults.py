@@ -36,6 +36,21 @@ ckpt_async_torn
                          quarantine across restart generations
 bind_fail   coord_bind   ``os._exit(EXIT_COORD_BIND)`` before the coordinator
                          binds — the port-collision (TOCTOU) analog
+host_lost   step         ``os._exit(EXIT_HOST_LOST)`` — the permanent-loss
+                         verdict: the rank dies AND its respawn always fails
+                         (``egen`` defaults to ``*`` for this kind only, so a
+                         respawned incarnation dies again at the same step).
+                         The launcher treats the exit code as "host gone for
+                         good", skips the respawn budget, and goes straight
+                         to the shrink ladder (see ``min_world_size``)
+shrink_veto shrink_vote  raise :class:`ShrinkVeto` inside a survivor's vote on
+                         a shrink record: the vote is recorded as ``veto``,
+                         the proposal is pinned, and the driver retries at a
+                         bumped generation or falls back to whole-world
+                         restart. ``step`` matches the per-process vote
+                         ordinal and defaults to 0 — veto the first proposal,
+                         ack the retry; ``step=*`` vetoes every proposal (the
+                         abort arm)
 ========== ============ ==========================================================
 
 Match keys (all optional): ``rank=N`` (default: any rank; read from
@@ -48,6 +63,13 @@ fault chase every respawn, the deterministic "re-rendezvous keeps failing"
 drill that forces the whole-world fallback), ``attempt=N|*`` (spawn attempt
 within one generation, from ``DDW_SPAWN_ATTEMPT``; default 0 so a bind
 failure clears on the launcher's respawn). ``*`` means "any".
+
+Several specs can be chained with ``;`` —
+``DDW_FAULT=host_lost:rank=2:step=3;shrink_veto:rank=0`` — and each hook
+site fires the first chained spec that matches it, so one drill can combine
+a permanent rank death with a shrink-vote veto. ``rank`` always matches the
+process's *spawn-time* rank for faults that fire before a shrink is adopted
+(the shrink remap updates ``DDW_PROCESS_ID`` only at adoption).
 
 Example: ``DDW_FAULT=crash:rank=1:step=3`` kills rank 1 at global step 3 of
 the first generation; every other process/step/generation is untouched. With
@@ -167,18 +189,28 @@ import time
 EXIT_FAULT_CRASH = 77   # injected hard crash (deterministic stand-in for SIGKILL)
 EXIT_PREEMPTED = 83     # graceful preemption: checkpointed, then clean exit
 EXIT_COORD_BIND = 84    # coordinator could not bind its port (spawn-time race)
+EXIT_HOST_LOST = 85     # permanent host loss: respawn is futile, shrink instead
 
 KINDS = ("crash", "kill", "raise", "stall", "exit0_early", "preempt",
-         "ckpt_torn", "ckpt_async_torn", "bind_fail")
+         "ckpt_torn", "ckpt_async_torn", "bind_fail", "host_lost",
+         "shrink_veto")
 
 _SITE_BY_KIND = {k: ("coord_bind" if k == "bind_fail"
                      else "ckpt_async" if k == "ckpt_async_torn"
+                     else "shrink_vote" if k == "shrink_veto"
                      else "step")
                  for k in KINDS}
 
 
 class FaultInjected(RuntimeError):
     """Raised by the ``raise`` fault kind — an injected application error."""
+
+
+class ShrinkVeto(RuntimeError):
+    """Raised by the ``shrink_veto`` kind inside a survivor's vote on a
+    shrink record (:meth:`~ddw_tpu.runtime.elastic.GangRendezvous._cast_vote`
+    catches it and records the veto) — the deterministic "one survivor
+    refuses the new topology" arm that pins the driver's retry/abort path."""
 
 
 class ServeCrash(RuntimeError):
@@ -255,10 +287,17 @@ def parse_fault(spec: str) -> FaultSpec | None:
             raise ValueError(f"unknown DDW_FAULT key {key!r} in {spec!r}")
         val = val.strip()
         fields[key] = None if val == "*" else int(val)
+    # Per-kind defaults: host_lost means "the respawn always fails too", so
+    # it chases every elastic generation unless pinned; shrink_veto means
+    # "reject ONCE" (vote ordinal 0), so the driver's retry gets an ack.
+    egen_default = None if kind == "host_lost" else 0
+    step_default = 0 if kind == "shrink_veto" else None
     return FaultSpec(kind=kind, rank=fields.get("rank"),
-                     step=fields.get("step"),
+                     step=fields["step"] if "step" in fields
+                     else step_default,
                      gen=fields.get("gen", 0),
-                     egen=fields.get("egen", 0),
+                     egen=fields["egen"] if "egen" in fields
+                     else egen_default,
                      attempt=fields.get("attempt", 0))
 
 
@@ -269,27 +308,46 @@ def _env_int(name: str, default: int = 0) -> int:
         return default
 
 
+def _fault_parts() -> list[str]:
+    """The ``;``-chained raw spec strings in ``DDW_FAULT`` (possibly one)."""
+    raw = os.environ.get("DDW_FAULT", "")
+    return [p.strip() for p in raw.split(";") if p.strip()]
+
+
+def active_faults() -> list[FaultSpec]:
+    """Every gang-scope fault currently configured (``;``-chained specs all
+    parse; scoped serve/deploy/autoscale entries validate but drop out)."""
+    specs = []
+    for part in _fault_parts():
+        spec = parse_fault(part)
+        if spec is not None:
+            specs.append(spec)
+    return specs
+
+
 def active_fault() -> FaultSpec | None:
-    """The currently configured fault, re-read from the env on every call
-    (tests monkeypatch ``DDW_FAULT`` mid-process)."""
-    return parse_fault(os.environ.get("DDW_FAULT", ""))
+    """The first currently configured gang-scope fault, re-read from the env
+    on every call (tests monkeypatch ``DDW_FAULT`` mid-process)."""
+    specs = active_faults()
+    return specs[0] if specs else None
 
 
 def maybe_fault(site: str, step: int | None = None,
                 ckpt_dir: str | None = None) -> None:
-    """Hook call: fire the configured fault iff its spec matches this site /
-    step / rank / generation / spawn attempt. No-op without ``DDW_FAULT``."""
+    """Hook call: fire the first configured fault whose spec matches this
+    site / step / rank / generation / spawn attempt. No-op without
+    ``DDW_FAULT``."""
     if "DDW_FAULT" not in os.environ:  # fast path for production step loops
         return
-    spec = active_fault()
-    if spec is None or not spec.matches(
-            site, step=step,
-            rank=_env_int("DDW_PROCESS_ID", 0),
-            gen=_env_int("DDW_RESTART_GEN", 0),
-            egen=_env_int("DDW_ELASTIC_GEN", 0),
-            attempt=_env_int("DDW_SPAWN_ATTEMPT", 0)):
-        return
-    _fire(spec, step, ckpt_dir)
+    for spec in active_faults():
+        if spec.matches(
+                site, step=step,
+                rank=_env_int("DDW_PROCESS_ID", 0),
+                gen=_env_int("DDW_RESTART_GEN", 0),
+                egen=_env_int("DDW_ELASTIC_GEN", 0),
+                attempt=_env_int("DDW_SPAWN_ATTEMPT", 0)):
+            _fire(spec, step, ckpt_dir)
+            return
 
 
 def _fire(spec: FaultSpec, step: int | None, ckpt_dir: str | None) -> None:
@@ -333,6 +391,14 @@ def _fire(spec: FaultSpec, step: int | None, ckpt_dir: str | None) -> None:
         os._exit(EXIT_FAULT_CRASH)
     if spec.kind == "bind_fail":
         os._exit(EXIT_COORD_BIND)
+    if spec.kind == "host_lost":
+        # The permanent-loss verdict, deterministically: the distinguished
+        # exit code tells the launcher respawning is futile (a real lost
+        # host earns the same verdict via the transport probe / exhausted
+        # respawn budget), so it goes straight to shrink-or-whole-world.
+        os._exit(EXIT_HOST_LOST)
+    if spec.kind == "shrink_veto":
+        raise ShrinkVeto(f"injected shrink veto ({where})")
 
 
 def _write_torn_step_dir(ckpt_dir: str, step: int) -> str:
@@ -414,7 +480,11 @@ def parse_serve_fault(spec: str) -> ServeFaultSpec | None:
 def active_serve_fault() -> ServeFaultSpec | None:
     """The currently configured serve fault, re-read from the env on every
     call (tests monkeypatch ``DDW_FAULT`` mid-process)."""
-    return parse_serve_fault(os.environ.get("DDW_FAULT", ""))
+    for part in _fault_parts():
+        spec = parse_serve_fault(part)
+        if spec is not None:
+            return spec
+    return None
 
 
 def maybe_serve_fault(site: str, replica: int, n: int, gen: int,
@@ -524,7 +594,11 @@ def parse_deploy_fault(spec: str) -> DeployFaultSpec | None:
 def active_deploy_fault() -> DeployFaultSpec | None:
     """The currently configured deploy fault, re-read from the env on every
     call (tests monkeypatch ``DDW_FAULT`` mid-process)."""
-    return parse_deploy_fault(os.environ.get("DDW_FAULT", ""))
+    for part in _fault_parts():
+        spec = parse_deploy_fault(part)
+        if spec is not None:
+            return spec
+    return None
 
 
 def maybe_deploy_fault(site: str, replica: int = 0,
@@ -614,7 +688,11 @@ def parse_autoscale_fault(spec: str) -> AutoscaleFaultSpec | None:
 def active_autoscale_fault() -> AutoscaleFaultSpec | None:
     """The currently configured autoscale fault, re-read from the env on
     every call (tests monkeypatch ``DDW_FAULT`` mid-process)."""
-    return parse_autoscale_fault(os.environ.get("DDW_FAULT", ""))
+    for part in _fault_parts():
+        spec = parse_autoscale_fault(part)
+        if spec is not None:
+            return spec
+    return None
 
 
 def maybe_autoscale_fault(site: str, n: int = 0,

@@ -39,7 +39,8 @@ import threading
 import time
 from typing import Any, Callable
 
-from ddw_tpu.runtime.faults import EXIT_COORD_BIND, EXIT_PREEMPTED
+from ddw_tpu.runtime.faults import (EXIT_COORD_BIND, EXIT_HOST_LOST,
+                                    EXIT_PREEMPTED)
 
 
 def _free_port() -> int:
@@ -50,18 +51,24 @@ def _free_port() -> int:
 
 @dataclasses.dataclass
 class ElasticEvent:
-    """One single-rank elastic recovery, as the launcher drove it: which
-    rank died (and how), which elastic generation the gang re-formed at,
-    and the pid of the respawned process. Harvested by the
+    """One elastic recovery, as the launcher drove it. ``kind`` is
+    ``"respawn"`` (PR 6: the dead rank was restarted at the same world
+    size), ``"shrink"`` (the dead rank was judged permanently lost and the
+    survivors re-formed at ``new_world`` — ``respawn_pid`` is None, nothing
+    was spawned), or ``"grow"`` (a healthy host rejoined: ``respawn_pid``
+    is the new member, ``dead_rank`` is None). Harvested by the
     :class:`~ddw_tpu.runtime.supervisor.GangSupervisor` into its
     ``AttemptReport`` forensics."""
 
     generation: int             # elastic generation the gang re-formed at
-    dead_rank: int
+    dead_rank: int | None
     exit_code: int | None       # the dead rank's raw waitpid code
     exit_signal: int | None     # the signal that killed it (exit_code < 0)
-    respawn_pid: int
+    respawn_pid: int | None
     at_unix: float
+    kind: str = "respawn"
+    old_world: int | None = None
+    new_world: int | None = None
 
 
 class GangError(RuntimeError):
@@ -122,7 +129,12 @@ class Launcher:
                  preempt_grace_s: float = 10.0,
                  forward_sigterm: bool = False,
                  elastic_restarts: int = 0,
-                 rendezvous_dir: str | None = None):
+                 rendezvous_dir: str | None = None,
+                 min_world_size: int | None = None,
+                 rank_hosts: list[str | None] | None = None,
+                 shrink_retries: int = 1,
+                 shrink_vote_timeout_s: float = 30.0,
+                 probe_timeout_s: float = 5.0):
         self.np = np
         self.devices_per_proc = devices_per_proc
         self.timeout_s = timeout_s
@@ -142,8 +154,28 @@ class Launcher:
         # jax.distributed and sync over the rendezvous control plane.
         self.elastic_restarts = max(0, elastic_restarts)
         self.rendezvous_dir = rendezvous_dir
+        # Shrink mode (docs/fault_tolerance.md "Shrink recovery"): when a
+        # rank is judged PERMANENTLY lost (EXIT_HOST_LOST, respawn budget
+        # exhausted, or its host fails the transport probe), re-form the
+        # gang at world-1 instead of falling back to whole-world restart —
+        # down to min_world_size, below which whole-world remains the
+        # fallback. None disables shrinking entirely.
+        if min_world_size is not None:
+            if np != -1 and not (1 <= min_world_size <= np):
+                raise ValueError(
+                    f"min_world_size={min_world_size} outside [1, np={np}]")
+        self.min_world_size = min_world_size
+        # Optional per-rank host list for the permanent-loss probe: a dead
+        # rank whose host no longer answers deploy.transport.probe() earns
+        # the permanent verdict even with respawn budget left. Entries are
+        # transport_for() host strings; None/"local" slots always probe OK.
+        self.rank_hosts = list(rank_hosts) if rank_hosts else None
+        self.shrink_retries = max(0, shrink_retries)
+        self.shrink_vote_timeout_s = shrink_vote_timeout_s
+        self.probe_timeout_s = probe_timeout_s
         self.elastic_events: list[ElasticEvent] = []  # last _run_multiproc
         self.last_rendezvous_dir: str | None = None
+        self._grow_requested = False
         self._procs: list = []        # live gang (broadcast target)
         self._procs_lock = threading.Lock()
 
@@ -162,6 +194,14 @@ class Launcher:
                     except OSError:
                         pass  # exited between poll and signal
         return n
+
+    def request_grow(self) -> None:
+        """Ask the live gang to re-expand by one rank at the next healthy
+        poll tick (only meaningful after a shrink freed a slot). The new
+        member joins at the next generation boundary through the same
+        record/adopt machinery as a shrink — thread-safe, callable from a
+        cluster-integration hook when a replacement host comes up."""
+        self._grow_requested = True
 
     def run(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         if self.np == -1:
@@ -184,6 +224,7 @@ class Launcher:
         else:
             fn_spec = ("pickled", pickle.dumps(fn), None)
         self.elastic_events = []
+        self._grow_requested = False
         with tempfile.TemporaryDirectory(prefix="ddw_launch_") as tmp:
             payload = os.path.join(tmp, "payload.pkl")
             result = os.path.join(tmp, "result.pkl")
@@ -206,7 +247,8 @@ class Launcher:
 
     def _spawn_rank(self, rank: int, payload: str, result: str, port: int,
                     attempt: int, extra_env: dict | None,
-                    rdzv_dir: str | None, elastic_gen: int = 0):
+                    rdzv_dir: str | None, elastic_gen: int = 0,
+                    world: int | None = None):
         env = dict(os.environ)
         # Force an isolated CPU backend in workers: disable the axon/TPU
         # plugin hook and give each process its own virtual device set.
@@ -217,7 +259,9 @@ class Launcher:
             + f" --xla_force_host_platform_device_count={self.devices_per_proc}"
         ).strip()
         env["DDW_COORDINATOR"] = f"127.0.0.1:{port}"
-        env["DDW_NUM_PROCESSES"] = str(self.np)
+        # `world` is the CURRENT gang size (spawns into a shrunken or grown
+        # world carry the re-negotiated size, not the launch-time np).
+        env["DDW_NUM_PROCESSES"] = str(self.np if world is None else world)
         env["DDW_PROCESS_ID"] = str(rank)
         env["DDW_SPAWN_ATTEMPT"] = str(attempt)
         if rdzv_dir is not None:
@@ -232,11 +276,70 @@ class Launcher:
             stderr=None,
         )
 
+    def _probe_slot(self, slot: int) -> bool:
+        """Is the dead rank's HOST still reachable? Unreachable upgrades the
+        loss verdict to permanent even with respawn budget left. Slots map
+        to launch-time ``rank_hosts`` entries; without a host list every
+        slot is local and trivially reachable."""
+        if not self.rank_hosts or slot >= len(self.rank_hosts):
+            return True
+        host = self.rank_hosts[slot]
+        if host in (None, "", "local", "localhost"):
+            return True
+        try:
+            from ddw_tpu.deploy.transport import transport_for
+            return bool(transport_for(host).probe(
+                timeout_s=self.probe_timeout_s))
+        except Exception:
+            return False
+
+    def _drive_shrink(self, rdzv_dir: str, ranks: list, slot: int,
+                      code: int | None, elastic_gen: int
+                      ) -> tuple[bool, int]:
+        """Propose evicting ``slot`` and re-forming the survivors at
+        world−1: post a shrink record with a contiguous rank assignment and
+        a fresh coordinator port, wait for every survivor's vote, and
+        commit on unanimous ack (two-phase: survivors adopt nothing until
+        the commit marker lands, so an abandoned proposal strands no one).
+        A veto pins the proposal; retry at a bumped generation up to
+        ``shrink_retries`` times. Returns ``(adopted, elastic_gen)`` —
+        not-adopted falls back to whole-world restart."""
+        from ddw_tpu.runtime.elastic import GangRendezvous
+
+        dead_rank = ranks[slot]
+        survivors = sorted(r for i, r in enumerate(ranks)
+                           if r is not None and i != slot)
+        assignment = {str(r): j for j, r in enumerate(survivors)}
+        new_world = len(survivors)
+        rdzv = GangRendezvous(rdzv_dir, new_world + 1, -1)
+        for _ in range(self.shrink_retries + 1):
+            elastic_gen += 1
+            rdzv.post_shrink(
+                elastic_gen, dead_rank=dead_rank, assignment=assignment,
+                world_size=new_world, exit_code=code,
+                coordinator=f"127.0.0.1:{_free_port()}")
+            votes = rdzv.wait_votes(elastic_gen, survivors,
+                                    timeout_s=self.shrink_vote_timeout_s)
+            if votes is None:
+                # a survivor that cannot vote cannot adopt either
+                return False, elastic_gen
+            if all(votes.get(r) == "ack" for r in survivors):
+                rdzv.commit_recovery(elastic_gen)
+                for i, r in enumerate(ranks):
+                    if r is not None and i != slot:
+                        ranks[i] = assignment[str(r)]
+                ranks[slot] = None
+                return True, elastic_gen
+            # veto: the next iteration re-proposes at a bumped generation
+            # (the veto arm is one-shot per proposal; a survivor that
+            # vetoes every proposal exhausts the retries -> whole-world)
+        return False, elastic_gen
+
     def _run_gang(self, payload: str, result: str, attempt: int,
                   extra_env: dict | None) -> Any:
         port = _free_port()
         rdzv_dir = None
-        if self.elastic_restarts > 0:
+        if self.elastic_restarts > 0 or self.min_world_size is not None:
             # A fresh control directory per gang launch: a whole-world
             # restart must not inherit the previous world's recovery ledger.
             if self.rendezvous_dir:
@@ -275,52 +378,91 @@ class Launcher:
             grace_end: float | None = None
             elastic_used = 0
             elastic_gen = 0
+            # Membership is SLOT-based: slot i holds the process spawned
+            # into launch-time rank i; ranks[i] is its CURRENT rank in the
+            # re-negotiated world (shrinks renumber survivors contiguously)
+            # and None marks an evicted slot — its exit code stays in
+            # `codes` for forensics but no longer gates the gang.
+            ranks: list[int | None] = list(range(self.np))
             codes: list[int | None] = [None] * self.np
-            while any(c is None for c in codes):
-                for i, p in enumerate(procs):
-                    if codes[i] is None:
-                        codes[i] = p.poll()
-                if any(c not in (None, 0, EXIT_PREEMPTED) for c in codes):
-                    # Elastic recovery (single dead rank, budget left, every
-                    # peer still running, not a coordinator port race):
-                    # respawn ONLY the dead rank at a bumped generation and
-                    # post the recovery record the survivors park on. Any
-                    # other shape — a second death, an exhausted budget —
-                    # falls through to the gang kill below, and the
-                    # supervisor's whole-world restart takes over.
-                    dead = [i for i, c in enumerate(codes)
-                            if c not in (None, 0, EXIT_PREEMPTED)]
-                    if (rdzv_dir is not None
-                            and elastic_used < self.elastic_restarts
-                            and len(dead) == 1
-                            and codes[dead[0]] != EXIT_COORD_BIND
-                            and all(codes[i] is None for i in range(self.np)
-                                    if i != dead[0])):
-                        r = dead[0]
-                        code = codes[r]
-                        elastic_used += 1
-                        elastic_gen += 1
-                        from ddw_tpu.runtime.elastic import GangRendezvous
 
-                        GangRendezvous(rdzv_dir, self.np, -1).post_recovery(
-                            elastic_gen, dead_rank=r, exit_code=code)
-                        p = self._spawn_rank(r, payload, result, port,
-                                             attempt, extra_env, rdzv_dir,
-                                             elastic_gen=elastic_gen)
-                        procs[r] = p
-                        codes[r] = None
-                        with self._procs_lock:
-                            self._procs = procs
-                        self.elastic_events.append(ElasticEvent(
-                            generation=elastic_gen, dead_rank=r,
-                            exit_code=code,
-                            exit_signal=-code if (code or 0) < 0 else None,
-                            respawn_pid=p.pid, at_unix=time.time()))
-                        # the re-formed gang earns a fresh deadline — the
-                        # recovery consumed wall-clock the healthy steps
-                        # were budgeted for
-                        deadline = time.monotonic() + self.timeout_s
-                        continue
+            def _active(i: int) -> bool:
+                return ranks[i] is not None
+
+            while any(codes[i] is None for i in range(self.np)
+                      if _active(i)):
+                for i, p in enumerate(procs):
+                    if _active(i) and codes[i] is None:
+                        codes[i] = p.poll()
+                abnormal = [i for i, c in enumerate(codes)
+                            if _active(i) and c not in (None, 0,
+                                                        EXIT_PREEMPTED)]
+                if abnormal:
+                    # The verdict ladder for a single dead rank (peers all
+                    # running, not a coordinator port race): TRANSIENT loss
+                    # -> respawn only that rank (budget permitting);
+                    # PERMANENT loss (EXIT_HOST_LOST, budget exhausted, or
+                    # its host fails the transport probe) -> shrink the
+                    # gang to world-1, down to min_world_size. Any other
+                    # shape — a second death, no shrink headroom, a vote
+                    # that never completes — falls through to the gang
+                    # kill, and the supervisor's whole-world restart takes
+                    # over.
+                    handled = False
+                    if (rdzv_dir is not None and len(abnormal) == 1
+                            and codes[abnormal[0]] != EXIT_COORD_BIND
+                            and all(codes[i] is None for i in range(self.np)
+                                    if _active(i) and i != abnormal[0])):
+                        slot = abnormal[0]
+                        code = codes[slot]
+                        world = sum(1 for x in ranks if x is not None)
+                        permanent = (code == EXIT_HOST_LOST
+                                     or elastic_used >= self.elastic_restarts
+                                     or not self._probe_slot(slot))
+                        if not permanent:
+                            r = ranks[slot]
+                            elastic_used += 1
+                            elastic_gen += 1
+                            from ddw_tpu.runtime.elastic import GangRendezvous
+
+                            GangRendezvous(rdzv_dir, world, -1).post_recovery(
+                                elastic_gen, dead_rank=r, exit_code=code)
+                            p = self._spawn_rank(r, payload, result, port,
+                                                 attempt, extra_env, rdzv_dir,
+                                                 elastic_gen=elastic_gen,
+                                                 world=world)
+                            procs[slot] = p
+                            codes[slot] = None
+                            with self._procs_lock:
+                                self._procs = procs
+                            self.elastic_events.append(ElasticEvent(
+                                generation=elastic_gen, dead_rank=r,
+                                exit_code=code,
+                                exit_signal=-code if (code or 0) < 0
+                                else None,
+                                respawn_pid=p.pid, at_unix=time.time()))
+                            handled = True
+                        elif (self.min_world_size is not None
+                              and world - 1 >= self.min_world_size):
+                            r = ranks[slot]
+                            adopted, elastic_gen = self._drive_shrink(
+                                rdzv_dir, ranks, slot, code, elastic_gen)
+                            if adopted:
+                                self.elastic_events.append(ElasticEvent(
+                                    generation=elastic_gen, dead_rank=r,
+                                    exit_code=code,
+                                    exit_signal=-code if (code or 0) < 0
+                                    else None,
+                                    respawn_pid=None, at_unix=time.time(),
+                                    kind="shrink", old_world=world,
+                                    new_world=world - 1))
+                                handled = True
+                        if handled:
+                            # the re-formed gang earns a fresh deadline —
+                            # the recovery consumed wall-clock the healthy
+                            # steps were budgeted for
+                            deadline = time.monotonic() + self.timeout_s
+                            continue
                     for p in procs:
                         if p.poll() is None:
                             p.kill()
@@ -332,7 +474,8 @@ class Launcher:
                         f"worker crashed (exit codes {codes}); gang killed"
                         + suffix,
                         kind=kind, exit_codes=codes, rank0_traceback=tb)
-                if EXIT_PREEMPTED in codes:
+                if any(codes[i] == EXIT_PREEMPTED for i in range(self.np)
+                       if _active(i)):
                     if grace_end is None:
                         grace_end = min(deadline,
                                         time.monotonic()
@@ -344,14 +487,50 @@ class Launcher:
                                 p.kill()
                         codes = [p.wait() for p in procs]
                         break
+                elif (self._grow_requested and rdzv_dir is not None
+                        and any(r is None for r in ranks)
+                        and all(codes[i] is None for i in range(self.np)
+                                if _active(i))):
+                    # Re-expansion (N-1 -> N): a healthy host rejoined. The
+                    # new member takes the next contiguous rank; incumbents
+                    # adopt the grow record at their next chain boundary.
+                    self._grow_requested = False
+                    world = sum(1 for x in ranks if x is not None)
+                    new_rank = world
+                    elastic_gen += 1
+                    from ddw_tpu.runtime.elastic import GangRendezvous
+
+                    GangRendezvous(rdzv_dir, world, -1).post_grow(
+                        elastic_gen,
+                        current_ranks=[x for x in ranks if x is not None],
+                        world_size=world + 1,
+                        coordinator=f"127.0.0.1:{_free_port()}")
+                    slot = ranks.index(None)
+                    p = self._spawn_rank(new_rank, payload, result, port,
+                                         attempt, extra_env, rdzv_dir,
+                                         elastic_gen=elastic_gen,
+                                         world=world + 1)
+                    procs[slot] = p
+                    ranks[slot] = new_rank
+                    codes[slot] = None
+                    with self._procs_lock:
+                        self._procs = procs
+                    self.elastic_events.append(ElasticEvent(
+                        generation=elastic_gen, dead_rank=None,
+                        exit_code=None, exit_signal=None,
+                        respawn_pid=p.pid, at_unix=time.time(),
+                        kind="grow", old_world=world, new_world=world + 1))
+                    deadline = time.monotonic() + self.timeout_s
                 if time.monotonic() > deadline:
                     raise GangError(
                         f"gang deadline ({self.timeout_s}s) exceeded; "
                         f"exit codes so far {codes}; killing all workers",
                         kind="deadline", exit_codes=codes)
-                if any(c is None for c in codes):
+                if any(codes[i] is None for i in range(self.np)
+                       if _active(i)):
                     time.sleep(0.05)
-            if EXIT_PREEMPTED in codes:
+            if any(codes[i] == EXIT_PREEMPTED for i in range(self.np)
+                   if _active(i)):
                 raise GangError(
                     f"gang preempted (exit codes {codes}); SIGTERM was "
                     f"forwarded to all ranks",

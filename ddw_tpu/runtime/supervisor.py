@@ -56,29 +56,36 @@ def restart_generation() -> int:
 @dataclasses.dataclass
 class AttemptReport:
     """One recovery-worthy event, as the supervisor saw it: a failed
-    generation (``recovery="whole-world"``) or a single-rank death the
-    launcher healed in place (``recovery="elastic"``). ``dead_rank`` /
+    generation (``recovery="whole-world"``), a single-rank death the
+    launcher healed in place (``recovery="elastic"``), a permanent loss the
+    gang absorbed by re-forming at a smaller world (``recovery="shrink"``,
+    with ``old_world_size``/``new_world_size`` and the evicted rank's
+    forensics), or a re-expansion (``recovery="grow"``). ``dead_rank`` /
     ``exit_signal`` carry the which-rank-died-and-how forensics (signal
     deaths — SIGKILL'd / OOM'd hosts — have a negative waitpid code; the
     positive signal number lands here)."""
 
     generation: int
     kind: str                       # crash | deadline | preempted | coord-bind
-    #                                 | result-missing | rank-death
+    #                                 | result-missing | rank-death | regrow
     exit_codes: list
     rank0_traceback: str | None
     elapsed_s: float
     dead_rank: int | None = None    # first abnormally-exited rank
     exit_signal: int | None = None  # signal that killed it, if any
-    recovery: str = "whole-world"   # elastic | whole-world
+    recovery: str = "whole-world"   # elastic | shrink | grow | whole-world
+    old_world_size: int | None = None   # shrink/grow: world before the event
+    new_world_size: int | None = None   # shrink/grow: world after the event
 
     def __str__(self) -> str:
         where = (f" (rank {self.dead_rank}"
                  + (f", signal {self.exit_signal}" if self.exit_signal
                     else "")
                  + f", {self.recovery})") if self.dead_rank is not None else ""
-        return (f"gen {self.generation}: {self.kind}{where}, exit codes "
-                f"{self.exit_codes}, after {self.elapsed_s:.1f}s")
+        world = (f", world {self.old_world_size}->{self.new_world_size}"
+                 if self.new_world_size is not None else "")
+        return (f"gen {self.generation}: {self.kind}{where}{world}, exit "
+                f"codes {self.exit_codes}, after {self.elapsed_s:.1f}s")
 
 
 class GangFailure(RuntimeError):
@@ -233,16 +240,26 @@ class GangSupervisor:
         return rank, (-code if code < 0 else None)
 
     def _harvest_elastic(self, gen: int) -> None:
-        """Fold the launcher's single-rank recoveries (ElasticEvent) into
-        the attempt record: same forensic surface as a whole-world restart,
-        tagged ``recovery="elastic"`` — so 'which rank died, how, and what
-        recovery it cost' is one queryable list either way."""
+        """Fold the launcher's in-place recoveries (ElasticEvent) into the
+        attempt record: same forensic surface as a whole-world restart,
+        tagged ``recovery="elastic"`` (single-rank respawn),
+        ``recovery="shrink"`` (permanent loss absorbed at world−1, with the
+        old/new world sizes and the evicted rank's exit forensics), or
+        ``recovery="grow"`` (re-expansion) — so 'which rank died, how, and
+        what recovery it cost' is one queryable list either way."""
+        recovery_by_kind = {"respawn": "elastic", "shrink": "shrink",
+                            "grow": "grow"}
         for ev in getattr(self.launcher, "elastic_events", []):
+            kind = getattr(ev, "kind", "respawn")
             self.attempts.append(AttemptReport(
-                generation=gen, kind="rank-death",
+                generation=gen,
+                kind="regrow" if kind == "grow" else "rank-death",
                 exit_codes=[ev.exit_code], rank0_traceback=None,
                 elapsed_s=0.0, dead_rank=ev.dead_rank,
-                exit_signal=ev.exit_signal, recovery="elastic"))
+                exit_signal=ev.exit_signal,
+                recovery=recovery_by_kind.get(kind, "elastic"),
+                old_world_size=getattr(ev, "old_world", None),
+                new_world_size=getattr(ev, "new_world", None)))
 
     def _report(self, outcome: str, crash_restarts: int,
                 preempt_restarts: int) -> None:
@@ -253,14 +270,25 @@ class GangSupervisor:
             return
         try:
             elastic = [a for a in self.attempts if a.recovery == "elastic"]
-            failed = [a for a in self.attempts if a.recovery != "elastic"]
+            shrinks = [a for a in self.attempts if a.recovery == "shrink"]
+            failed = [a for a in self.attempts
+                      if a.recovery not in ("elastic", "shrink", "grow")]
             run.log_metrics({
                 "supervisor.generations": float(self.generations),
                 "supervisor.failed_attempts": float(len(failed)),
                 "supervisor.crash_restarts": float(crash_restarts),
                 "supervisor.preemption_restarts": float(preempt_restarts),
                 "supervisor.elastic_recoveries": float(len(elastic)),
+                "supervisor.shrink_recoveries": float(len(shrinks)),
             })
+            # gang.world_size gauge: the world-size timeline across every
+            # re-negotiation (launch-time np, then each shrink/grow).
+            run.log_metric("gang.world_size", float(self.launcher.np),
+                           step=0)
+            for k, a in enumerate(a for a in self.attempts
+                                  if a.recovery in ("shrink", "grow")):
+                run.log_metric("gang.world_size", float(a.new_world_size),
+                               step=k + 1)
             for a in failed:
                 run.log_metric("supervisor.attempt_elapsed_s", a.elapsed_s,
                                step=a.generation)

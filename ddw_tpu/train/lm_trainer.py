@@ -31,7 +31,7 @@ import numpy as np
 
 from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.models.lm import build_lm
-from ddw_tpu.runtime.elastic import maybe_elastic_restart
+from ddw_tpu.runtime.elastic import maybe_elastic_restart, process_topology
 from ddw_tpu.runtime.faults import Preempted, maybe_fault, preemption_requested
 from ddw_tpu.runtime.mesh import (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MeshSpec,
                                   make_data_mesh, make_mesh)
@@ -284,7 +284,7 @@ class LMTrainer:
         # (make_array_from_process_local_data) via prefetch_to — the same
         # wiring as the vision Trainer. PP lacks a batch sharding to
         # assemble onto; refuse rather than silently duplicate data.
-        n_proc = jax.process_count()
+        cur_proc, n_proc = process_topology()
         if n_proc > 1 and self.pp:
             raise ValueError("fit_tables under multi-process pipeline "
                              "parallelism is not supported — run PP "
@@ -304,7 +304,7 @@ class LMTrainer:
                 raise ValueError("steps_per_dispatch > 1 under fit_tables "
                                  "needs a step with a batch sharding — the "
                                  "loader stacks super-batches on device")
-            shard_kw = dict(cur_shard=jax.process_index(),
+            shard_kw = dict(cur_shard=cur_proc,
                             shard_count=n_proc, prefetch_to=prefetch_to)
             train_iter = iter(ShardedLoader(
                 train_table, batch_size=host_batch, num_epochs=None,

@@ -35,7 +35,7 @@ from ddw_tpu.checkpoint.ckpt import CheckpointManager
 from ddw_tpu.data.loader import ShardedLoader
 from ddw_tpu.data.store import Table
 from ddw_tpu.models.registry import build_model
-from ddw_tpu.runtime.elastic import maybe_elastic_restart
+from ddw_tpu.runtime.elastic import maybe_elastic_restart, process_topology
 from ddw_tpu.runtime.faults import Preempted, maybe_fault, preemption_requested
 from ddw_tpu.runtime.mesh import make_data_mesh, make_mesh, MeshSpec, DATA_AXIS
 from ddw_tpu.tracking.tracker import Run
@@ -161,14 +161,19 @@ class Trainer:
 
     def _loaders(self, train_table: Table, val_table: Table,
                  consumed_batches: int = 0, super_plan=None):
-        n_proc = jax.process_count()
+        # Elastic-aware topology: under an elastic gang the data-parallel
+        # ranks live in the rendezvous (jax.distributed is per-process), and
+        # after a shrink recovery the re-derived loaders re-partition the
+        # same shard set at the N-1 world so every sample is covered exactly
+        # once per epoch (ShardedLoader.shard_plan).
+        cur_proc, n_proc = process_topology()
         per_host_batch = self.train_cfg.batch_size * self.world_size // n_proc
         sharding = batch_sharding(self.mesh, self.train_cfg.data_axis)
         train_loader = ShardedLoader(
             train_table,
             batch_size=per_host_batch,
             image_size=(self.data_cfg.img_height, self.data_cfg.img_width),
-            cur_shard=jax.process_index(),
+            cur_shard=cur_proc,
             shard_count=n_proc,
             num_epochs=None,  # infinite repeat: identical step counts (§2b.8)
             shuffle=True,
@@ -188,7 +193,7 @@ class Trainer:
             val_table,
             batch_size=per_host_batch,
             image_size=(self.data_cfg.img_height, self.data_cfg.img_width),
-            cur_shard=jax.process_index(),
+            cur_shard=cur_proc,
             shard_count=n_proc,
             num_epochs=None,  # infinite repeat: floor-divided val_steps can exceed
                               # one pass when shards are small (reference :199-200)
@@ -316,7 +321,7 @@ class Trainer:
 
         monitor = None
         if (cfg.monitor_interval_s > 0 and self.run is not None
-                and jax.process_index() == 0):
+                and process_topology()[0] == 0):
             # Ganglia role (SURVEY §5): sys.* utilization series next to the
             # training curves.
             from ddw_tpu.utils.sysmon import SystemMonitor
@@ -350,7 +355,7 @@ class Trainer:
             state = sched.initial_state(state, start_epoch, resumed)
             try:
                 for epoch in range(start_epoch, cfg.epochs):
-                    if cfg.trace_dir and epoch == start_epoch and jax.process_index() == 0:
+                    if cfg.trace_dir and epoch == start_epoch and process_topology()[0] == 0:
                         jax.profiler.start_trace(cfg.trace_dir)
                         tracing = True
                         if self.run is not None:
